@@ -1,0 +1,5 @@
+"""Embedding layers."""
+
+from .embedding import ConcatOneHotEmbedding, Embedding, TableConfig
+
+__all__ = ["ConcatOneHotEmbedding", "Embedding", "TableConfig"]

@@ -1,0 +1,216 @@
+"""Embedding layers (flax.linen).
+
+TPU-native counterpart of the reference Keras layers
+(`/root/reference/distributed_embeddings/python/layers/embedding.py:41-180`):
+an ``Embedding`` unifying plain and combiner (multi-hot) lookups over dense /
+ragged / sparse inputs, and ``ConcatOneHotEmbedding`` fusing N one-hot tables
+into one weight.
+
+Differences by design:
+- flax modules are pure; parameters live in pytrees, so the reference's
+  ``CPUInitializer`` (GPU-OOM workaround, `embedding.py:28-38`) is unnecessary —
+  giant tables are initialized directly into their sharded layout via
+  ``jax.jit`` + sharding annotations.
+- ``get_config`` / ``from_config`` are kept for planner interop
+  (``DistEmbeddingStrategy`` consumes layer configs the same way the reference
+  does, `dist_model_parallel.py:95-98`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.embedding_lookup import embedding_lookup
+from ..ops.ragged import RaggedIds, SparseIds
+
+Initializer = Callable[[jax.Array, tuple, Any], jax.Array]
+
+
+def _keras_uniform(scale=0.05):
+  def init(key, shape, dtype=jnp.float32):
+    return jax.random.uniform(key, shape, dtype, minval=-scale, maxval=scale)
+  return init
+
+
+_NAMED_INITIALIZERS = {
+    "uniform": _keras_uniform,
+    "random_uniform": _keras_uniform,
+    "normal": lambda: nn.initializers.normal(stddev=0.05),
+    "random_normal": lambda: nn.initializers.normal(stddev=0.05),
+    "zeros": lambda: nn.initializers.zeros_init(),
+    "ones": lambda: nn.initializers.ones_init(),
+    "glorot_uniform": lambda: nn.initializers.glorot_uniform(),
+    "glorot_normal": lambda: nn.initializers.glorot_normal(),
+    "he_uniform": lambda: nn.initializers.he_uniform(),
+    "he_normal": lambda: nn.initializers.he_normal(),
+}
+
+
+def resolve_initializer(spec: Union[str, Initializer, None]) -> Initializer:
+  """Accepts a named initializer (Keras-style), a callable, or None."""
+  if spec is None:
+    return _keras_uniform()
+  if callable(spec):
+    return spec
+  if isinstance(spec, str):
+    key = spec.lower()
+    if key in _NAMED_INITIALIZERS:
+      return _NAMED_INITIALIZERS[key]()
+    raise ValueError(f"Unknown initializer {spec!r}")
+  raise TypeError(f"Cannot resolve initializer from {spec!r}")
+
+
+class Embedding(nn.Module):
+  """Turns indices into vectors of fixed size; optional multi-hot reduce.
+
+  Parity with the reference ``Embedding`` (`embedding.py:41-152`). When
+  ``combiner`` is not None, supported inputs and output shapes:
+
+  - N-D integer array ``(d1,...,dn)`` -> ``(d1,...,dn-1, output_dim)``, N >= 2
+  - 2-D ``RaggedIds`` ``(batch, ragged)`` -> ``(batch, output_dim)``
+  - 2-D ``SparseIds`` ``(batch, max_hot)`` -> ``(batch, output_dim)``
+
+  With ``combiner=None``, output is ``input.shape + (output_dim,)``.
+
+  Attributes:
+    input_dim: vocabulary size (max index + 1).
+    output_dim: embedding width.
+    embeddings_initializer: named or callable initializer.
+    combiner: None, 'sum', or 'mean'.
+  """
+
+  input_dim: int
+  output_dim: int
+  embeddings_initializer: Union[str, Initializer, None] = "uniform"
+  combiner: Optional[str] = None
+  param_dtype: Any = jnp.float32
+
+  def __post_init__(self):
+    super().__post_init__()
+    if self.input_dim <= 0 or self.output_dim <= 0:
+      raise ValueError(
+          "Both input_dim and output_dim should be positive, "
+          f"found {self.input_dim} and {self.output_dim}")
+
+  @nn.compact
+  def __call__(self, inputs):
+    embeddings = self.param(
+        "embeddings",
+        resolve_initializer(self.embeddings_initializer),
+        (self.input_dim, self.output_dim),
+        self.param_dtype,
+    )
+    return self.lookup(embeddings, inputs)
+
+  def lookup(self, embeddings, inputs):
+    """Input normalization + lookup (reference `embedding.py:108-133`)."""
+    if isinstance(inputs, (RaggedIds, SparseIds)):
+      return embedding_lookup(embeddings, inputs, combiner=self.combiner)
+    inputs = jnp.asarray(inputs)
+    if not jnp.issubdtype(inputs.dtype, jnp.integer):
+      inputs = inputs.astype(jnp.int32)
+    out_shape = None
+    if inputs.ndim == 1:
+      if self.combiner is not None:
+        raise ValueError(
+            "1D input with combiner is ambiguous. Please create batch dimension.")
+      inputs = inputs.reshape(-1, 1)
+      out_shape = (-1, self.output_dim)
+    elif inputs.ndim > 2:
+      if self.combiner is None:
+        out_shape = inputs.shape + (self.output_dim,)
+      else:
+        out_shape = inputs.shape[:-1] + (self.output_dim,)
+      inputs = inputs.reshape(-1, inputs.shape[-1])
+    out = embedding_lookup(embeddings, inputs, combiner=self.combiner)
+    if out_shape is not None:
+      out = out.reshape(out_shape)
+    return out
+
+  def get_config(self):
+    return {
+        "input_dim": self.input_dim,
+        "output_dim": self.output_dim,
+        "embeddings_initializer": self.embeddings_initializer,
+        "combiner": self.combiner,
+        "name": self.name,
+    }
+
+  @classmethod
+  def from_config(cls, config):
+    config = dict(config)
+    config.pop("mask_zero", None)
+    config.pop("input_length", None)
+    config.pop("name", None)
+    return cls(**config)
+
+
+@dataclasses.dataclass
+class TableConfig:
+  """Plain-data description of one embedding table, for the planner.
+
+  Equivalent to a reference layer config dict
+  (`dist_model_parallel.py:95-98`). ``from_layer``/``to_layer`` convert to and
+  from ``Embedding`` modules.
+  """
+
+  input_dim: int
+  output_dim: int
+  combiner: Optional[str] = None
+  initializer: Union[str, Initializer, None] = "uniform"
+  name: Optional[str] = None
+
+  def size(self) -> int:
+    return self.input_dim * self.output_dim
+
+  @classmethod
+  def from_layer(cls, layer: Embedding) -> "TableConfig":
+    return cls(
+        input_dim=layer.input_dim,
+        output_dim=layer.output_dim,
+        combiner=layer.combiner,
+        initializer=layer.embeddings_initializer,
+        name=layer.name,
+    )
+
+  def to_layer(self) -> Embedding:
+    return Embedding(
+        input_dim=self.input_dim,
+        output_dim=self.output_dim,
+        embeddings_initializer=self.initializer,
+        combiner=self.combiner,
+    )
+
+
+class ConcatOneHotEmbedding(nn.Module):
+  """N one-hot tables concatenated row-wise into a single weight.
+
+  Parity with the reference ``ConcatOneHotEmbedding`` (`embedding.py:155-180`):
+  lookup adds per-feature row offsets, then performs one gather.
+  """
+
+  feature_sizes: tuple
+  embedding_width: int
+  params_initializer: Union[str, Initializer, None] = "uniform"
+
+  @nn.compact
+  def __call__(self, inputs):
+    import numpy as np
+
+    offsets = np.concatenate([[0], np.cumsum(self.feature_sizes)])
+    table = self.param(
+        "embeddings",
+        resolve_initializer(self.params_initializer),
+        (int(offsets[-1]), self.embedding_width),
+        jnp.float32,
+    )
+    if inputs.shape[-1] != len(self.feature_sizes):
+      raise ValueError(
+          f"Expected {len(self.feature_sizes)} features, got {inputs.shape[-1]}")
+    shifted = inputs + jnp.asarray(offsets[:-1], inputs.dtype)
+    return jnp.take(table, shifted, axis=0)

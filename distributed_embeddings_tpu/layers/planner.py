@@ -1,0 +1,407 @@
+"""Sharding planner for distributed embedding tables.
+
+Re-implementation of the reference ``DistEmbeddingStrategy``
+(`/root/reference/distributed_embeddings/python/layers/dist_model_parallel.py:59-324`)
+with the same observable semantics:
+
+- auto column-slice threshold when there are fewer tables than workers
+  (repeatedly halve the largest table until there are enough slices);
+- column slicing into the smallest power-of-two number of slices that brings
+  each slice under the threshold, capped by ``min(N, world, output_dim)``,
+  remainder columns spread over the first slices;
+- three placement strategies: ``basic`` (round-robin), ``memory_balanced``
+  (size-sorted boustrophedon, two per pass), ``memory_optimized`` (greedy
+  bin-pack onto the least-loaded worker);
+- re-merge of slices of the same table that land on the same worker (they are
+  always column-contiguous: slices are handed out in rank order);
+- per-rank fusion of same-(width, combiner) tables into one concatenated
+  table with row offsets;
+- deterministic pure-Python global view: every process computes the identical
+  plan with no collectives.
+
+On top of the per-rank view, this planner also emits a **width-class layout**
+unique to the TPU build: for every distinct (width, combiner) class, each
+rank's fused table becomes one row-padded entry of a uniform stacked array
+``[world, max_rows, width]``. That turns the reference's per-rank heterogeneous
+program (each GPU runs different lookups) into a single SPMD program — the same
+XLA code on every device — which is what ``shard_map``/``pjit`` require and what
+makes the hybrid-parallel backward a single compiled graph on TPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .embedding import Embedding, TableConfig
+
+ClassKey = Tuple[int, Optional[str]]  # (width, combiner)
+
+
+@dataclasses.dataclass
+class Shard:
+  """A (possibly merged) column shard of one table placed on one rank."""
+
+  table_id: int
+  col_start: int
+  col_end: int  # exclusive
+  input_dim: int
+  combiner: Optional[str]
+  initializer: object
+
+  @property
+  def width(self) -> int:
+    return self.col_end - self.col_start
+
+  def size(self) -> int:
+    return self.input_dim * self.width
+
+
+@dataclasses.dataclass
+class ClassSlot:
+  """One lookup slot of a width class on a rank: which global input feeds it
+  and where its shard's rows start inside the rank's fused buffer."""
+
+  input_id: int
+  row_offset: int
+  shard: Shard
+
+
+@dataclasses.dataclass
+class WidthClassPlan:
+  """Uniform stacked layout for one (width, combiner) class.
+
+  ``shards_per_rank[r]`` lists rank r's shards fused (row-concatenated) into
+  this class's buffer; ``rows_per_rank[r]`` is the unpadded row count. The
+  physical array is ``[world, max_rows, width]`` sharded over the mesh axis.
+  ``slots_per_rank[r]`` lists the lookups rank r performs for this class;
+  ``num_slots`` is the padded (max) slot count used by the SPMD program.
+  """
+
+  width: int
+  combiner: Optional[str]
+  shards_per_rank: List[List[Shard]]
+  row_offsets_per_rank: List[List[int]]
+  rows_per_rank: List[int]
+  slots_per_rank: List[List[ClassSlot]]
+
+  @property
+  def max_rows(self) -> int:
+    return max(self.rows_per_rank)
+
+  @property
+  def num_slots(self) -> int:
+    return max(len(s) for s in self.slots_per_rank)
+
+
+@dataclasses.dataclass
+class OutputPiece:
+  """Where one column slice of one input's output comes from."""
+
+  class_key: ClassKey
+  rank: int
+  slot: int
+  width: int
+  col_start: int
+
+
+def _normalize_configs(embeddings) -> List[TableConfig]:
+  configs = []
+  for e in embeddings:
+    if isinstance(e, TableConfig):
+      configs.append(dataclasses.replace(e))
+    elif isinstance(e, Embedding):
+      configs.append(TableConfig.from_layer(e))
+    elif isinstance(e, dict):
+      configs.append(TableConfig(**e))
+    else:
+      raise TypeError(f"Cannot build TableConfig from {type(e)}")
+  return configs
+
+
+def slice_columns(config: TableConfig, threshold: Optional[float],
+                  world_size: int) -> List[Tuple[int, int]]:
+  """Column ranges for one table under a slice threshold.
+
+  Semantics of the reference ``maybe_slice_table_column``
+  (`dist_model_parallel.py:157-188`): smallest power of two N with
+  ``size / N <= threshold``, capped at ``min(N, world, output_dim)``; columns
+  split evenly with the remainder spread over the first slices.
+  """
+  if threshold is None:
+    return [(0, config.output_dim)]
+  num_slices = 1
+  size = float(config.size())
+  while size > threshold:
+    num_slices *= 2
+    size /= 2
+  num_slices = min(num_slices, world_size, config.output_dim)
+  if num_slices <= 1:
+    return [(0, config.output_dim)]
+  base = config.output_dim // num_slices
+  rem = config.output_dim % num_slices
+  ranges, start = [], 0
+  for i in range(num_slices):
+    width = base + (1 if i < rem else 0)
+    ranges.append((start, start + width))
+    start += width
+  return ranges
+
+
+def auto_column_slice_threshold(sizes: Sequence[int],
+                                world_size: int) -> Optional[float]:
+  """Pick a threshold so every worker gets at least one slice.
+
+  Reference `dist_model_parallel.py:205-211`: while there are fewer tables
+  than workers, halve the largest table; the threshold ends just below the
+  largest table seen at the final halving step.
+  """
+  if len(sizes) >= world_size:
+    return None
+  sizes = sorted(sizes)
+  threshold = None
+  while world_size > len(sizes):
+    threshold = sizes[-1] - 1
+    largest = sizes.pop()
+    sizes += [largest // 2, largest // 2]
+    sizes.sort()
+  return threshold
+
+
+def apply_placement(mode: str, world_size: int,
+                    slice_sizes: List[int], slice_table_ids: List[int]
+                    ) -> List[List[int]]:
+  """Distribute slice ids (positions into the flat slice list) to workers.
+
+  Reference ``apply_stragety`` (`dist_model_parallel.py:227-263`), returning
+  per-rank lists of *flat slice indices* (the reference returns table ids; we
+  keep slice identity and map back to tables later, which avoids its
+  input-id/table-id conflation in slice-range bookkeeping).
+  """
+  n = len(slice_sizes)
+  flat = list(range(n))
+  if mode == "basic":
+    return [flat[i::world_size] for i in range(world_size)]
+  if mode == "memory_balanced":
+    order = [i for _, _, i in
+             sorted(((slice_sizes[i], slice_table_ids[i], i) for i in flat),
+                    reverse=True)]
+    return [
+        order[i::2 * world_size] + order[(2 * world_size - 1 - i)::2 * world_size]
+        for i in range(world_size)
+    ]
+  if mode == "memory_optimized":
+    # Greedy: biggest slice first onto the least-loaded worker.
+    order = sorted(flat, key=lambda i: (slice_sizes[i], slice_table_ids[i]),
+                   reverse=True)
+    loads = [(0, r) for r in range(world_size)]
+    assignment: List[List[int]] = [[] for _ in range(world_size)]
+    import heapq
+    heapq.heapify(loads)
+    for i in order:
+      load, r = heapq.heappop(loads)
+      assignment[r].append(i)
+      heapq.heappush(loads, (load + slice_sizes[i], r))
+    return assignment
+  raise ValueError(f"Unsupported strategy {mode}")
+
+
+class DistEmbeddingStrategy:
+  """Global-view embedding placement plan (deterministic, collective-free).
+
+  Args:
+    embeddings: global list of ``Embedding`` layers / ``TableConfig``s / dicts.
+    world_size: number of model-parallel workers.
+    strategy: 'basic' | 'memory_balanced' | 'memory_optimized'.
+    input_table_map: input i feeds table ``input_table_map[i]`` (shared
+      tables); None means the identity map.
+    column_slice_threshold: max elements per slice, or None for auto.
+  """
+
+  def __init__(self,
+               embeddings,
+               world_size: int,
+               strategy: str = "basic",
+               input_table_map: Optional[Sequence[int]] = None,
+               column_slice_threshold: Optional[int] = None):
+    if strategy not in ("basic", "memory_balanced", "memory_optimized"):
+      raise ValueError(f"Unsupported shard strategy {strategy}")
+    self.strategy = "basic" if world_size == 1 else strategy
+    self.world_size = world_size
+    self.global_configs = _normalize_configs(embeddings)
+    num_tables = len(self.global_configs)
+    if input_table_map is None:
+      input_table_map = list(range(num_tables))
+    self.input_table_map = list(input_table_map)
+    self.num_inputs = len(self.input_table_map)
+
+    # ---- column slicing --------------------------------------------------
+    self.column_slice_threshold = column_slice_threshold
+    threshold = column_slice_threshold
+    if threshold is None:
+      threshold = auto_column_slice_threshold(
+          [c.size() for c in self.global_configs], world_size)
+    self.table_col_ranges: List[List[Tuple[int, int]]] = [
+        slice_columns(c, threshold, world_size) for c in self.global_configs
+    ]
+
+    # API-parity view: [input_id, input_id + num_slices] per sliced input.
+    self.sliced_out_ranges = [
+        [i, i + len(self.table_col_ranges[t])]
+        for i, t in enumerate(self.input_table_map)
+        if len(self.table_col_ranges[t]) > 1
+    ]
+
+    # ---- placement -------------------------------------------------------
+    slice_sizes, slice_table_ids = [], []
+    for t, (config, ranges) in enumerate(
+        zip(self.global_configs, self.table_col_ranges)):
+      for (s, e) in ranges:
+        slice_sizes.append(config.input_dim * (e - s))
+        slice_table_ids.append(t)
+    placement = apply_placement(self.strategy, world_size, slice_sizes,
+                                slice_table_ids)
+
+    # ---- per-rank shards: hand out column ranges in rank order, merging
+    # same-table slices that land together (always column-contiguous).
+    next_slice: List[int] = [0] * num_tables
+    self.rank_shards: List[List[Shard]] = []
+    for rank in range(world_size):
+      shards: List[Shard] = []
+      by_table: Dict[int, Shard] = {}
+      for flat_idx in placement[rank]:
+        t = slice_table_ids[flat_idx]
+        config = self.global_configs[t]
+        s, e = self.table_col_ranges[t][next_slice[t]]
+        next_slice[t] += 1
+        if t in by_table:  # merge with earlier shard on this rank
+          by_table[t].col_end = e
+        else:
+          shard = Shard(table_id=t, col_start=s, col_end=e,
+                        input_dim=config.input_dim, combiner=config.combiner,
+                        initializer=config.initializer)
+          by_table[t] = shard
+          shards.append(shard)
+      self.rank_shards.append(shards)
+    if world_size > 1 and not all(self.rank_shards):
+      raise ValueError(
+          "Not enough tables after slicing to run on all workers. "
+          "Try decreasing column_slice_threshold or the worker count")
+
+    # reference-compatible per-rank table id lists (for get/set weights order)
+    self.table_ids = [[sh.table_id for sh in shards]
+                      for shards in self.rank_shards]
+
+    # ---- per-rank inputs + width-class fusion ----------------------------
+    class_keys: List[ClassKey] = []
+    for shards in self.rank_shards:
+      for sh in shards:
+        key = (sh.width, sh.combiner)
+        if key not in class_keys:
+          class_keys.append(key)
+    class_keys.sort(key=lambda k: (k[0], str(k[1])))
+    self.class_keys = class_keys
+
+    self.classes: Dict[ClassKey, WidthClassPlan] = {
+        key: WidthClassPlan(width=key[0], combiner=key[1],
+                            shards_per_rank=[[] for _ in range(world_size)],
+                            row_offsets_per_rank=[[] for _ in range(world_size)],
+                            rows_per_rank=[0] * world_size,
+                            slots_per_rank=[[] for _ in range(world_size)])
+        for key in class_keys
+    }
+
+    # worker-order input ids (an input appears once per slice of its table)
+    self.input_ids_list: List[List[int]] = []
+    # output routing: input_id -> pieces in column order
+    self.output_pieces: List[List[OutputPiece]] = [
+        [] for _ in range(self.num_inputs)
+    ]
+
+    for rank, shards in enumerate(self.rank_shards):
+      # fuse: row-concat shards of equal (width, combiner) in local order
+      for sh in shards:
+        plan = self.classes[(sh.width, sh.combiner)]
+        plan.shards_per_rank[rank].append(sh)
+        plan.row_offsets_per_rank[rank].append(plan.rows_per_rank[rank])
+        plan.rows_per_rank[rank] += sh.input_dim
+
+      rank_input_ids: List[int] = []
+      for sh in shards:
+        plan = self.classes[(sh.width, sh.combiner)]
+        idx_in_rank = plan.shards_per_rank[rank].index(sh)
+        row_offset = plan.row_offsets_per_rank[rank][idx_in_rank]
+        for input_id, mapped_table in enumerate(self.input_table_map):
+          if mapped_table == sh.table_id:
+            rank_input_ids.append(input_id)
+            slot = ClassSlot(input_id=input_id, row_offset=row_offset, shard=sh)
+            plan.slots_per_rank[rank].append(slot)
+            self.output_pieces[input_id].append(
+                OutputPiece(class_key=(sh.width, sh.combiner), rank=rank,
+                            slot=len(plan.slots_per_rank[rank]) - 1,
+                            width=sh.width, col_start=sh.col_start))
+      self.input_ids_list.append(rank_input_ids)
+
+    # column slices of one input must concat in column order
+    for pieces in self.output_pieces:
+      pieces.sort(key=lambda p: p.col_start)
+
+    # ---- reference-compatible per-rank fused views -----------------------
+    self.local_configs: List[List[dict]] = []
+    self.local_group_list: List[List[List[int]]] = []
+    self.local_weight_offsets: List[List[List[int]]] = []
+    self.local_maps: List[List[int]] = []
+    self.local_input_offsets: List[List[int]] = []
+    self.widths_list_flat: List[int] = []
+    for rank in range(world_size):
+      configs, groups, weight_offsets = [], [], []
+      # fused groups in class order, skipping classes absent on this rank
+      rank_class_keys = [k for k in class_keys
+                         if self.classes[k].shards_per_rank[rank]]
+      shards_flat = self.rank_shards[rank]
+      for key in rank_class_keys:
+        plan = self.classes[key]
+        members = plan.shards_per_rank[rank]
+        configs.append({
+            "input_dim": plan.rows_per_rank[rank],
+            "output_dim": key[0],
+            "combiner": key[1],
+        })
+        groups.append([shards_flat.index(sh) for sh in members])
+        offs = [0]
+        for sh in members:
+          offs.append(offs[-1] + sh.input_dim)
+        weight_offsets.append(offs)
+      self.local_configs.append(configs)
+      self.local_group_list.append(groups)
+      self.local_weight_offsets.append(weight_offsets)
+
+      input_map, input_offsets = [], []
+      for input_id in self.input_ids_list[rank]:
+        piece = next(p for p in self.output_pieces[input_id] if p.rank == rank)
+        # recover class + slot for this (input, rank)
+        key = piece.class_key
+        gid = rank_class_keys.index(key)
+        input_map.append(gid)
+        slot = self.classes[key].slots_per_rank[rank][piece.slot]
+        input_offsets.append(slot.row_offset)
+        # flat output widths in worker order (reference widths_list_flat)
+        self.widths_list_flat.append(piece.width)
+      self.local_maps.append(input_map)
+      self.local_input_offsets.append(input_offsets)
+
+    worker_order = [i for rank_ids in self.input_ids_list for i in rank_ids]
+    self.rev_global_input_ids = [
+        idx for _, idx in sorted(zip(worker_order, range(len(worker_order))))
+    ]
+
+  # ---- convenience -------------------------------------------------------
+  def table_shard_map(self, table_id: int) -> List[Tuple[int, Shard]]:
+    """All (rank, shard) holding columns of ``table_id``, in column order."""
+    entries = []
+    for rank, shards in enumerate(self.rank_shards):
+      for sh in shards:
+        if sh.table_id == table_id:
+          entries.append((rank, sh))
+    entries.sort(key=lambda e: e[1].col_start)
+    return entries

@@ -1,0 +1,13 @@
+"""Embedding lookup ops."""
+
+from .embedding_lookup import csr_lookup, embedding_lookup, sparse_dedup_grad
+from .ragged import RaggedIds, SparseIds, row_to_split
+
+__all__ = [
+    "csr_lookup",
+    "embedding_lookup",
+    "sparse_dedup_grad",
+    "RaggedIds",
+    "SparseIds",
+    "row_to_split",
+]

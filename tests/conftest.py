@@ -1,0 +1,28 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+The reference tests multi-worker behavior by launching real processes under
+horovodrun against real GPUs (`/root/reference/tests/dist_model_parallel_test.py:97-103`).
+JAX gives us a fake-backend capability the reference lacks: N virtual CPU
+devices in one process via XLA flags, so distributed tests run anywhere.
+
+This environment force-registers a real-TPU PJRT backend ('axon') for every
+Python process at interpreter startup and pins ``jax_platforms`` to it, so we
+must override the already-imported jax config — plain env vars are read too
+early to help. Unit tests must never touch the single real TPU (bench.py owns
+it).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+  os.environ["XLA_FLAGS"] = (
+      flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+assert len(jax.devices()) == 8, (
+    f"expected 8 virtual CPU devices, got {jax.devices()}")

@@ -1,0 +1,189 @@
+"""Planner unit tests: placement strategies, column slicing, merge, fusion.
+
+Zero-device pure-Python tests (reference pattern:
+`tests/dist_model_parallel_test.py:220-236,287-334,367-374`)."""
+
+import numpy as np
+import pytest
+
+from distributed_embeddings_tpu.layers import TableConfig
+from distributed_embeddings_tpu.layers.planner import (
+    DistEmbeddingStrategy,
+    apply_placement,
+    auto_column_slice_threshold,
+    slice_columns,
+)
+
+
+def _configs(sizes, width=8, combiner=None):
+  return [TableConfig(input_dim=s, output_dim=width, combiner=combiner)
+          for s in sizes]
+
+
+def _all_slices(plan):
+  return [sh for shards in plan.rank_shards for sh in shards]
+
+
+@pytest.mark.parametrize("mode", ["basic", "memory_balanced", "memory_optimized"])
+def test_every_table_placed_exactly_once(mode):
+  rng = np.random.default_rng(0)
+  sizes = rng.integers(10, 1000, size=13).tolist()
+  plan = DistEmbeddingStrategy(_configs(sizes), 4, strategy=mode)
+  placed = sorted(sh.table_id for sh in _all_slices(plan))
+  assert placed == list(range(13))
+  for sh in _all_slices(plan):
+    assert (sh.col_start, sh.col_end) == (0, 8)  # no slicing needed
+
+
+def test_basic_round_robin():
+  plan = DistEmbeddingStrategy(_configs([10] * 8), 4, strategy="basic")
+  assert plan.table_ids == [[0, 4], [1, 5], [2, 6], [3, 7]]
+
+
+def test_memory_balanced_even_count_and_size():
+  sizes = [100, 90, 80, 70, 60, 50, 40, 30]
+  plan = DistEmbeddingStrategy(_configs(sizes), 4, strategy="memory_balanced")
+  loads = [sum(sizes[t] * 8 // 8 * 8 for t in ids) for ids in plan.table_ids]
+  counts = [len(ids) for ids in plan.table_ids]
+  assert counts == [2, 2, 2, 2]
+  # boustrophedon: each worker gets one big + one small; loads near-equal
+  assert max(loads) - min(loads) <= 20 * 8
+
+
+def test_memory_optimized_balances_loads():
+  sizes = [1000, 10, 10, 10, 10, 10, 10, 980]
+  plan = DistEmbeddingStrategy(_configs(sizes), 2, strategy="memory_optimized")
+  loads = [sum(sh.size() for sh in shards) for shards in plan.rank_shards]
+  assert abs(loads[0] - loads[1]) <= 60 * 8
+
+
+def test_column_slice_power_of_two():
+  cfg = TableConfig(input_dim=100, output_dim=16)
+  # size=1600; threshold 500 -> need 4 slices of 4 cols
+  ranges = slice_columns(cfg, 500, world_size=8)
+  assert ranges == [(0, 4), (4, 8), (8, 12), (12, 16)]
+
+
+def test_column_slice_remainder_spread():
+  cfg = TableConfig(input_dim=10, output_dim=10)
+  ranges = slice_columns(cfg, 30, world_size=8)  # 100 -> 4 slices of 10 cols
+  widths = [e - s for s, e in ranges]
+  assert widths == [3, 3, 2, 2]
+  assert ranges[-1][1] == 10
+
+
+def test_column_slice_caps():
+  cfg = TableConfig(input_dim=1000, output_dim=3)
+  # would want many slices but capped by output_dim=3
+  assert len(slice_columns(cfg, 10, world_size=8)) == 3
+  # capped by world_size
+  cfg2 = TableConfig(input_dim=1000, output_dim=64)
+  assert len(slice_columns(cfg2, 10, world_size=2)) == 2
+
+
+def test_auto_threshold_when_fewer_tables_than_workers():
+  sizes = [1000, 10]
+  thr = auto_column_slice_threshold(sizes, 4)
+  assert thr is not None
+  # plan must give every one of 4 workers at least one slice
+  plan = DistEmbeddingStrategy(
+      _configs([125, 10], width=8), 4, strategy="basic")
+  assert all(plan.rank_shards)
+
+
+def test_not_enough_tables_raises():
+  with pytest.raises(ValueError):
+    # one table of width 1 cannot be split over 2 workers
+    DistEmbeddingStrategy([TableConfig(input_dim=5, output_dim=1)], 2)
+
+
+def test_slice_merge_on_same_rank():
+  # 1 table, 4 slices, 2 workers -> 2 slices per worker merge into one shard
+  plan = DistEmbeddingStrategy(
+      [TableConfig(input_dim=8, output_dim=16)], 2, strategy="basic",
+      column_slice_threshold=40)  # 128 elems -> 4 slices
+  assert [len(s) for s in plan.rank_shards] == [1, 1]
+  (sh0,), (sh1,) = plan.rank_shards
+  assert (sh0.col_start, sh0.col_end) == (0, 8)
+  assert (sh1.col_start, sh1.col_end) == (8, 16)
+
+
+def test_concat_fusion_same_width():
+  plan = DistEmbeddingStrategy(_configs([10, 20, 30], width=8), 1)
+  # all same width+combiner -> one fused local table
+  assert len(plan.local_configs[0]) == 1
+  cfg = plan.local_configs[0][0]
+  assert cfg["input_dim"] == 60 and cfg["output_dim"] == 8
+  assert plan.local_weight_offsets[0][0] == [0, 10, 30, 60]
+  assert plan.local_input_offsets[0] == [0, 10, 30]
+
+
+def test_no_fusion_across_widths_or_combiners():
+  configs = [
+      TableConfig(input_dim=10, output_dim=8),
+      TableConfig(input_dim=10, output_dim=4),
+      TableConfig(input_dim=10, output_dim=8, combiner="sum"),
+  ]
+  plan = DistEmbeddingStrategy(configs, 1)
+  assert len(plan.local_configs[0]) == 3
+
+
+def test_shared_table_input_map():
+  plan = DistEmbeddingStrategy(
+      _configs([10, 20], width=4), 2, input_table_map=[0, 0, 1])
+  all_inputs = sorted(i for ids in plan.input_ids_list for i in ids)
+  assert all_inputs == [0, 1, 2]
+  # reorder indices restore input order
+  assert sorted(plan.rev_global_input_ids) == [0, 1, 2]
+
+
+def test_shared_table_with_slicing_duplicates_outputs():
+  # table 0 sliced in 2, two inputs use it -> 4 worker-order outputs + 1
+  plan = DistEmbeddingStrategy(
+      _configs([64, 10], width=8), 2, input_table_map=[0, 0, 1],
+      column_slice_threshold=256)
+  worker_outputs = sum(len(ids) for ids in plan.input_ids_list)
+  assert worker_outputs == 5
+  assert len(plan.output_pieces[0]) == 2
+  assert [p.col_start for p in plan.output_pieces[0]] == [0, 4]
+
+
+def test_output_pieces_cover_full_width():
+  rng = np.random.default_rng(3)
+  configs = _configs(rng.integers(50, 500, size=5).tolist(), width=12)
+  plan = DistEmbeddingStrategy(configs, 4, strategy="memory_balanced",
+                               column_slice_threshold=800)
+  for i, t in enumerate(plan.input_table_map):
+    pieces = plan.output_pieces[i]
+    total = sum(p.width for p in pieces)
+    assert total == configs[t].output_dim
+    # contiguous column coverage
+    pos = 0
+    for p in pieces:
+      assert p.col_start == pos
+      pos += p.width
+
+
+def test_width_class_uniformity():
+  rng = np.random.default_rng(4)
+  configs = _configs(rng.integers(10, 100, size=9).tolist(), width=8)
+  plan = DistEmbeddingStrategy(configs, 4, strategy="memory_optimized")
+  assert len(plan.class_keys) == 1
+  plan_c = plan.classes[plan.class_keys[0]]
+  assert len(plan_c.rows_per_rank) == 4
+  assert plan_c.max_rows == max(plan_c.rows_per_rank)
+  # every table's rows appear exactly once across ranks
+  total_rows = sum(plan_c.rows_per_rank)
+  assert total_rows == sum(c.input_dim for c in configs)
+
+
+def test_world_one_keeps_fusion_but_skips_comm_strategy():
+  plan = DistEmbeddingStrategy(_configs([10, 20], width=8), 1,
+                               strategy="memory_balanced")
+  assert plan.strategy == "basic"
+  assert len(plan.local_configs) == 1
+
+
+def test_invalid_strategy_raises():
+  with pytest.raises(ValueError):
+    DistEmbeddingStrategy(_configs([10]), 2, strategy="bogus")
