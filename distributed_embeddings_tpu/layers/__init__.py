@@ -1,5 +1,27 @@
 """Embedding layers."""
 
+from .dist_model_parallel import (
+    BroadcastGlobalVariablesCallback,
+    DistributedEmbedding,
+    DistributedOptimizer,
+    broadcast_variables,
+    get_weights,
+    hybrid_partition_specs,
+    set_weights,
+)
 from .embedding import ConcatOneHotEmbedding, Embedding, TableConfig
+from .planner import DistEmbeddingStrategy
 
-__all__ = ["ConcatOneHotEmbedding", "Embedding", "TableConfig"]
+__all__ = [
+    "BroadcastGlobalVariablesCallback",
+    "ConcatOneHotEmbedding",
+    "DistEmbeddingStrategy",
+    "DistributedEmbedding",
+    "DistributedOptimizer",
+    "Embedding",
+    "TableConfig",
+    "broadcast_variables",
+    "get_weights",
+    "hybrid_partition_specs",
+    "set_weights",
+]

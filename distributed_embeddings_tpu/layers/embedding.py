@@ -24,6 +24,7 @@ from typing import Any, Callable, Optional, Union
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..ops.embedding_lookup import embedding_lookup
 from ..ops.ragged import RaggedIds, SparseIds
@@ -200,8 +201,6 @@ class ConcatOneHotEmbedding(nn.Module):
 
   @nn.compact
   def __call__(self, inputs):
-    import numpy as np
-
     offsets = np.concatenate([[0], np.cumsum(self.feature_sizes)])
     table = self.param(
         "embeddings",
@@ -212,5 +211,8 @@ class ConcatOneHotEmbedding(nn.Module):
     if inputs.shape[-1] != len(self.feature_sizes):
       raise ValueError(
           f"Expected {len(self.feature_sizes)} features, got {inputs.shape[-1]}")
-    shifted = inputs + jnp.asarray(offsets[:-1], inputs.dtype)
-    return jnp.take(table, shifted, axis=0)
+    # clamp per feature so a bad id cannot bleed into the next table's rows
+    sizes = jnp.asarray(np.asarray(self.feature_sizes), inputs.dtype)
+    clamped = jnp.clip(inputs, 0, sizes - 1)
+    shifted = clamped + jnp.asarray(offsets[:-1], inputs.dtype)
+    return jnp.take(table, shifted, axis=0, mode="clip")

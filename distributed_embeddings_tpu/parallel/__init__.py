@@ -1,0 +1,27 @@
+"""Mesh, collectives, and the distributed lookup engine."""
+
+from .lookup_engine import (
+    DistributedLookup,
+    class_param_name,
+    pack_mp_inputs,
+    ragged_to_padded,
+)
+from .mesh import (
+    DEFAULT_AXIS,
+    batch_sharding,
+    create_mesh,
+    replicated,
+    table_sharding,
+)
+
+__all__ = [
+    "DistributedLookup",
+    "class_param_name",
+    "pack_mp_inputs",
+    "ragged_to_padded",
+    "DEFAULT_AXIS",
+    "batch_sharding",
+    "create_mesh",
+    "replicated",
+    "table_sharding",
+]

@@ -1,0 +1,309 @@
+"""SPMD distributed lookup engine: route ids, look up local shards, route back.
+
+TPU-native re-design of the reference's ``DistributedEmbedding._call_base``
+(`/root/reference/distributed_embeddings/python/layers/dist_model_parallel.py:401-463`):
+
+  reference (MPMD, per-rank programs)        this engine (SPMD, one program)
+  -----------------------------------        --------------------------------
+  hvd.alltoall(ids, uneven splits)       ->  lax.all_to_all over the mesh axis
+                                             on a uniform [world, slots, B, H]
+                                             routing tensor (slot/hotness
+                                             padding with a sentinel id)
+  per-rank Python loop over local            one gather + segment-reduce over
+  Embedding layers (different code           the rank's width-class buffer
+  on every rank)                             [max_rows, width] — identical XLA
+                                             code on every device
+  hvd.alltoall(outputs)                  ->  lax.all_to_all back
+  reorder via rev_global_input_ids       ->  static piece-indexed reassembly
+                                             (handles column-slice re-concat)
+
+Uneven all-to-all splits (the reference's hardest comm case, SURVEY §5) are
+made uniform by padding each width class to its max slot count and max
+hotness; padded entries carry ``sentinel = max_rows`` and a gather with
+``mode='fill', fill_value=0`` makes them contribute nothing — forward or
+backward (scatter drops out-of-range). All shapes static, fully jit/grad
+compatible; ``shard_map`` differentiates through ``all_to_all`` natively,
+which is what replaces the reference's ~100 lines of Horovod tape patching.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..layers.planner import DistEmbeddingStrategy
+from ..ops.ragged import RaggedIds
+
+PAD_ID = -1  # marks hotness padding in dense-padded ragged inputs
+
+
+def class_param_name(width: int, combiner: Optional[str]) -> str:
+  return f"mp_table_w{width}_{combiner if combiner else 'cat'}"
+
+
+def ragged_to_padded(ids: RaggedIds, max_hot: int) -> jax.Array:
+  """RaggedIds -> dense [B, max_hot] with PAD_ID padding (for dp routing)."""
+  b = ids.nrows
+  lengths = ids.row_lengths()
+  pos = jax.lax.broadcasted_iota(jnp.int32, (b, max_hot), 1)
+  flat_idx = ids.row_splits[:-1, None] + pos
+  valid = pos < lengths[:, None]
+  gathered = jnp.take(ids.values, jnp.clip(flat_idx, 0, ids.values.shape[0] - 1),
+                      mode="clip").astype(jnp.int32)
+  return jnp.where(valid, gathered, PAD_ID)
+
+
+def _normalize_input(x) -> jax.Array:
+  """-> [B, H] int32 with PAD_ID for invalid entries."""
+  if isinstance(x, RaggedIds):
+    raise TypeError(
+        "Convert RaggedIds with ragged_to_padded(ids, max_hot) before the "
+        "distributed call; the routing tensor needs a static hotness.")
+  x = jnp.asarray(x)
+  if x.ndim == 1:
+    x = x[:, None]
+  if x.ndim != 2:
+    raise ValueError(f"Distributed inputs must be 1-D or 2-D, got {x.ndim}-D")
+  return x.astype(jnp.int32)
+
+
+class DistributedLookup:
+  """Functional forward engine bound to one :class:`DistEmbeddingStrategy`.
+
+  Call :meth:`forward` inside ``shard_map`` (world > 1) with each class param
+  passed as the local block ``[1, max_rows, width]``, or anywhere when
+  world == 1. Gradients flow through to the class params (locally, no
+  collective — the hybrid-parallel property) and through ``all_to_all`` to
+  nothing (ids are integers).
+  """
+
+  def __init__(self, plan: DistEmbeddingStrategy, dp_input: bool = True,
+               axis_name: str = "mp"):
+    self.plan = plan
+    self.dp_input = dp_input
+    self.axis_name = axis_name
+
+  # ---- shapes ------------------------------------------------------------
+  def param_shapes(self) -> Dict[str, tuple]:
+    shapes = {}
+    for key in self.plan.class_keys:
+      cp = self.plan.classes[key]
+      shapes[class_param_name(*key)] = (
+          self.plan.world_size, cp.max_rows, cp.width)
+    return shapes
+
+  def class_hotness(self, key, inputs: Sequence[jax.Array]) -> int:
+    cp = self.plan.classes[key]
+    h = 1
+    for slots in cp.slots_per_rank:
+      for slot in slots:
+        h = max(h, inputs[slot.input_id].shape[1])
+    return h
+
+  # ---- dp-side routing ---------------------------------------------------
+  def _build_routing(self, key, inputs: Sequence[jax.Array]) -> jax.Array:
+    """[world, num_slots, B_local, H_c] routing tensor for one class."""
+    cp = self.plan.classes[key]
+    world = self.plan.world_size
+    n_c, sentinel = cp.num_slots, cp.max_rows
+    h_c = self.class_hotness(key, inputs)
+    b = inputs[0].shape[0]
+    pad_block = jnp.full((b, h_c), sentinel, jnp.int32)
+    per_dest = []
+    for rank in range(world):
+      slots = cp.slots_per_rank[rank]
+      per_slot = []
+      for k in range(n_c):
+        if k < len(slots):
+          slot = slots[k]
+          ids = inputs[slot.input_id]
+          rows = slot.shard.input_dim
+          routed = jnp.where(ids < 0, sentinel,
+                             jnp.clip(ids, 0, rows - 1) + slot.row_offset)
+          if ids.shape[1] < h_c:
+            routed = jnp.pad(routed, ((0, 0), (0, h_c - ids.shape[1])),
+                             constant_values=sentinel)
+          per_slot.append(routed)
+        else:
+          per_slot.append(pad_block)
+      per_dest.append(jnp.stack(per_slot))
+    return jnp.stack(per_dest)
+
+  # ---- mp-side local lookup ----------------------------------------------
+  def _local_lookup(self, key, table_local: jax.Array,
+                    ids_all: jax.Array) -> jax.Array:
+    """ids_all [n_c, G, H] over local [max_rows, width] -> [n_c, G, width]."""
+    cp = self.plan.classes[key]
+    sentinel = cp.max_rows
+    rows = jnp.take(table_local, ids_all, axis=0, mode="fill",
+                    fill_value=0)  # [n_c, G, H, w]
+    if cp.combiner is None and ids_all.shape[-1] != 1:
+      raise ValueError("combiner=None requires hotness-1 inputs in the "
+                       "distributed path (2-D model-parallel outputs)")
+    if ids_all.shape[-1] == 1:
+      # hotness-1 fast path: sum/mean of one row (0 for padded slots) is the
+      # row itself
+      return rows[:, :, 0, :]
+    summed = jnp.sum(rows, axis=2)
+    if cp.combiner == "mean":
+      counts = jnp.sum(ids_all < sentinel, axis=2).astype(summed.dtype)
+      summed = summed / jnp.maximum(counts, 1)[..., None]
+    return summed
+
+  @staticmethod
+  def _squeeze_local(p: jax.Array) -> jax.Array:
+    if p.ndim != 3:
+      raise ValueError(f"class param must be 3-D [shards, rows, width], got {p.shape}")
+    if p.shape[0] != 1:
+      raise ValueError(
+          "expected the local block of a class param (leading dim 1); pass "
+          "params through shard_map with PartitionSpec('mp', None, None)")
+    return p[0]
+
+  # ---- full forward ------------------------------------------------------
+  def forward(self, class_params: Dict[str, jax.Array],
+              inputs: Sequence[jax.Array]) -> List[jax.Array]:
+    """Distributed lookup for data-parallel inputs.
+
+    Args:
+      class_params: name -> [1, max_rows, width] local block (or
+        [1, rows, width] when world == 1).
+      inputs: per global input, [B_local] or [B_local, H] int ids
+        (PAD_ID entries ignored).
+
+    Returns:
+      Per global input, [B_local, table_width] activations, input order.
+    """
+    plan = self.plan
+    world = plan.world_size
+    inputs = [_normalize_input(x) for x in inputs]
+    if len(inputs) != plan.num_inputs:
+      raise ValueError(f"Expected {plan.num_inputs} inputs, got {len(inputs)}")
+    b = inputs[0].shape[0]
+    for x in inputs:
+      if x.shape[0] != b:
+        raise ValueError("All inputs need the same batch size "
+                         f"(got {x.shape[0]} vs {b}).")
+
+    received: Dict[tuple, jax.Array] = {}
+    for key in plan.class_keys:
+      table_local = self._squeeze_local(class_params[class_param_name(*key)])
+      x = self._build_routing(key, inputs)  # [world, n_c, B, H]
+      if world > 1:
+        # dp -> mp: exchange id blocks over ICI
+        y = lax.all_to_all(x, self.axis_name, split_axis=0, concat_axis=0)
+      else:
+        y = x
+      n_c, h_c = y.shape[1], y.shape[3]
+      # global-batch-major ids for my local class buffer
+      ids_all = jnp.transpose(y, (1, 0, 2, 3)).reshape(n_c, world * b, h_c)
+      z = self._local_lookup(key, table_local, ids_all)  # [n_c, G, w]
+      z = z.reshape(n_c, world, b, -1).transpose(1, 0, 2, 3)
+      if world > 1:
+        # mp -> dp: return activations to their batch owners
+        r = lax.all_to_all(z, self.axis_name, split_axis=0, concat_axis=0)
+      else:
+        r = z
+      received[key] = r  # [world_owner, n_c, B, w]
+
+    return self._assemble(received)
+
+  def forward_mp(self, class_params: Dict[str, jax.Array],
+                 packed_inputs: Dict[str, jax.Array]) -> List[jax.Array]:
+    """Distributed lookup for model-parallel inputs (dp_input=False).
+
+    ``packed_inputs`` comes from :func:`pack_mp_inputs`: per class, the local
+    block ``[1, num_slots, G, H]`` of pre-offset ids for this rank's tables
+    over the *global* batch. Skips the dp->mp exchange; the output exchange
+    still runs (reference semantics, `dist_model_parallel.py:449-459`).
+    """
+    plan = self.plan
+    world = plan.world_size
+    received = {}
+    for key in plan.class_keys:
+      table_local = self._squeeze_local(class_params[class_param_name(*key)])
+      ids_all = packed_inputs[class_param_name(*key)]
+      if ids_all.ndim != 4 or ids_all.shape[0] != 1:
+        raise ValueError(
+            f"packed mp input must be [1, num_slots, G, H], got {ids_all.shape}")
+      ids_all = ids_all[0]
+      n_c, g = ids_all.shape[0], ids_all.shape[1]
+      if g % world:
+        raise ValueError(f"Global batch {g} not divisible by world {world}")
+      b = g // world
+      z = self._local_lookup(key, table_local, ids_all)
+      z = z.reshape(n_c, world, b, -1).transpose(1, 0, 2, 3)
+      if world > 1:
+        r = lax.all_to_all(z, self.axis_name, split_axis=0, concat_axis=0)
+      else:
+        r = z
+      received[key] = r
+    return self._assemble(received)
+
+  def _assemble(self, received: Dict[tuple, jax.Array]) -> List[jax.Array]:
+    """Per-input output re-assembly incl. column-slice concat.
+
+    Replaces the reference's rev_global_input_ids shuffle + range-wise output
+    concat (`dist_model_parallel.py:462-469`) with static piece indexing."""
+    results = []
+    for pieces in self.plan.output_pieces:
+      parts = [received[p.class_key][p.rank, p.slot] for p in pieces]
+      results.append(parts[0] if len(parts) == 1 else
+                     jnp.concatenate(parts, axis=-1))
+    return results
+
+
+def pack_mp_inputs(plan: DistEmbeddingStrategy,
+                   per_rank_inputs: Sequence[Sequence[jax.Array]],
+                   ) -> Dict[str, jax.Array]:
+  """Build global packed arrays for dp_input=False mode.
+
+  Args:
+    plan: the strategy.
+    per_rank_inputs: ``per_rank_inputs[r]`` lists rank r's local inputs in
+      ``plan.input_ids_list[r]`` order, each [G] or [G, H] over the *global*
+      batch (reference mp-input contract, `dist_model_parallel.py:344-346`).
+
+  Returns:
+    name -> [world, num_slots, G, H] arrays; shard axis 0 over the mesh, then
+    pass the per-device blocks to :meth:`DistributedLookup.forward_mp`.
+  """
+  world = plan.world_size
+  # resolve each (rank, class, slot) to its normalized local input once
+  slot_inputs = {}  # (key, rank, k) -> [G, H] array
+  for rank in range(world):
+    for pos, input_id in enumerate(plan.input_ids_list[rank]):
+      piece = next(p for p in plan.output_pieces[input_id] if p.rank == rank)
+      slot_inputs[(piece.class_key, rank, piece.slot)] = _normalize_input(
+          per_rank_inputs[rank][pos])
+
+  packed = {}
+  for key in plan.class_keys:
+    cp = plan.classes[key]
+    n_c, sentinel = cp.num_slots, cp.max_rows
+    class_xs = [slot_inputs[k] for k in slot_inputs if k[0] == key]
+    h_c = max((x.shape[1] for x in class_xs), default=1)
+    g = class_xs[0].shape[0] if class_xs else 0
+    per_rank = []
+    for rank in range(world):
+      entries = []
+      for k in range(n_c):
+        slots = cp.slots_per_rank[rank]
+        if k < len(slots):
+          slot = slots[k]
+          x = slot_inputs[(key, rank, k)]
+          rows = slot.shard.input_dim
+          routed = jnp.where(x < 0, sentinel,
+                             jnp.clip(x, 0, rows - 1) + slot.row_offset)
+          if routed.shape[1] < h_c:
+            routed = jnp.pad(routed, ((0, 0), (0, h_c - routed.shape[1])),
+                             constant_values=sentinel)
+        else:
+          routed = jnp.full((g, h_c), sentinel, jnp.int32)
+        entries.append(routed)
+      per_rank.append(jnp.stack(entries))
+    packed[class_param_name(*key)] = jnp.stack(per_rank)
+  return packed

@@ -1,0 +1,48 @@
+"""Mesh helpers: the TPU-native replacement for Horovod process bootstrap.
+
+The reference initializes Horovod and derives (world_size, rank) per process
+(`/root/reference/distributed_embeddings/python/layers/dist_model_parallel.py:369-372`).
+On TPU the equivalent is a 1-D ``jax.sharding.Mesh`` over all devices: the
+same axis carries the data-parallel batch shard AND the model-parallel table
+placement (exactly like the reference, where every Horovod rank is both a dp
+and an mp worker). Multi-host pods extend this mesh over ICI/DCN via
+``jax.distributed`` with no code change here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DEFAULT_AXIS = "mp"
+
+
+def create_mesh(world_size: Optional[int] = None,
+                axis_name: str = DEFAULT_AXIS,
+                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+  """1-D hybrid-parallel mesh over ``world_size`` devices."""
+  if devices is None:
+    devices = jax.devices()
+  if world_size is None:
+    world_size = len(devices)
+  if world_size > len(devices):
+    raise ValueError(
+        f"world_size {world_size} exceeds available devices {len(devices)}")
+  return Mesh(np.asarray(devices[:world_size]), (axis_name,))
+
+
+def table_sharding(mesh: Mesh, axis_name: str = DEFAULT_AXIS) -> NamedSharding:
+  """Sharding for class-stacked table params [world, rows, width]."""
+  return NamedSharding(mesh, P(axis_name, None, None))
+
+
+def batch_sharding(mesh: Mesh, axis_name: str = DEFAULT_AXIS) -> NamedSharding:
+  """Sharding for data-parallel batches [global_batch, ...]."""
+  return NamedSharding(mesh, P(axis_name))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+  return NamedSharding(mesh, P())

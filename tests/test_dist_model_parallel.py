@@ -1,0 +1,315 @@
+"""Distributed integration tests on a virtual 8-device mesh.
+
+Core pattern mirrors the reference
+(`/root/reference/tests/dist_model_parallel_test.py:157-192`): build a
+non-distributed reference model and the distributed model, load identical
+global weights, run forward + one SGD step on both, and assert forward
+outputs equal and post-update weights allclose. The mesh is 8 virtual CPU
+devices (conftest) — the fake-backend capability the reference lacks.
+"""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from distributed_embeddings_tpu.layers import TableConfig
+from distributed_embeddings_tpu.layers.dist_model_parallel import (
+    get_weights,
+    set_weights,
+)
+from distributed_embeddings_tpu.layers.planner import DistEmbeddingStrategy
+from distributed_embeddings_tpu.parallel import (
+    DistributedLookup,
+    class_param_name,
+    pack_mp_inputs,
+    ragged_to_padded,
+)
+from distributed_embeddings_tpu.ops import RaggedIds
+
+
+WORLD = 8
+
+
+def make_mesh(world=WORLD):
+  return Mesh(np.asarray(jax.devices()[:world]), ("mp",))
+
+
+def param_specs(plan):
+  return {class_param_name(*k): P("mp", None, None) for k in plan.class_keys}
+
+
+def gen_weights(rng, configs):
+  return [rng.standard_normal((c.input_dim, c.output_dim)).astype(np.float32)
+          for c in configs]
+
+
+def reference_forward(weights, input_table_map, inputs_np, combiners):
+  """Naive single-process model: plain gather + combine per input."""
+  outs = []
+  for i, t in enumerate(input_table_map):
+    w, ids = weights[t], inputs_np[i]
+    if ids.ndim == 1:
+      outs.append(w[ids])
+      continue
+    rows = np.where(ids[..., None] >= 0, w[np.clip(ids, 0, w.shape[0] - 1)], 0.0)
+    if combiners[t] == "sum" or combiners[t] is None:
+      out = rows.sum(1) if combiners[t] == "sum" else rows[:, 0]
+    else:
+      counts = np.maximum((ids >= 0).sum(1), 1)
+      out = rows.sum(1) / counts[:, None]
+    outs.append(out.astype(np.float32))
+  return outs
+
+
+def dist_forward_fn(plan, dp_input=True):
+  engine = DistributedLookup(plan, dp_input=dp_input, axis_name="mp")
+
+  def fn(class_params, *inputs):
+    if dp_input:
+      return tuple(engine.forward(class_params, list(inputs)))
+    return tuple(engine.forward_mp(class_params, inputs[0]))
+
+  return fn
+
+
+def run_parity(table_sizes, width=8, world=WORLD, strategy="basic",
+               input_table_map=None, column_slice_threshold=None,
+               combiner=None, hotness=None, seed=0):
+  """Forward + train-step parity: distributed vs naive reference."""
+  rng = np.random.default_rng(seed)
+  configs = [TableConfig(input_dim=s, output_dim=width, combiner=combiner)
+             for s in table_sizes]
+  plan = DistEmbeddingStrategy(configs, world, strategy,
+                               input_table_map=input_table_map,
+                               column_slice_threshold=column_slice_threshold)
+  table_map = plan.input_table_map
+  weights = gen_weights(rng, configs)
+  class_params = {k: jnp.asarray(v)
+                  for k, v in set_weights(plan, weights).items()}
+
+  batch = 2 * world
+  inputs_np = []
+  for t in table_map:
+    if hotness is None:
+      inputs_np.append(
+          rng.integers(0, table_sizes[t], size=batch).astype(np.int32))
+    else:
+      ids = rng.integers(0, table_sizes[t], size=(batch, hotness)).astype(np.int32)
+      # make hotness ragged via PAD_ID in a few slots
+      mask = rng.random((batch, hotness)) < 0.25
+      mask[:, 0] = False  # at least one valid id
+      ids[mask] = -1
+      inputs_np.append(ids)
+  inputs = [jnp.asarray(x) for x in inputs_np]
+
+  mesh = make_mesh(world)
+  fn = dist_forward_fn(plan)
+  specs_in = (param_specs(plan),) + tuple(P("mp") for _ in inputs)
+  n_out = len(table_map)
+  fwd = jax.jit(shard_map(fn, mesh=mesh, in_specs=specs_in,
+                          out_specs=tuple(P("mp") for _ in range(n_out))))
+  got = fwd(class_params, *inputs)
+
+  combiners = [combiner] * len(configs)
+  want = reference_forward(weights, table_map, inputs_np, combiners)
+  for i, (g, w) in enumerate(zip(got, want)):
+    np.testing.assert_allclose(np.asarray(g), w, rtol=1e-5, atol=1e-5,
+                               err_msg=f"forward mismatch on input {i}")
+
+  # ---- one SGD step parity ----
+  def local_loss(class_params, *inputs):
+    outs = fn(class_params, *inputs)
+    return sum(jnp.sum(o ** 2) for o in outs)
+
+  grad_fn = jax.jit(
+      shard_map(jax.grad(local_loss), mesh=mesh, in_specs=specs_in,
+                out_specs=param_specs(plan)))
+  grads = grad_fn(class_params, *inputs)
+  lr = 0.1
+  new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g, class_params,
+                                      grads)
+  got_weights = get_weights(plan, new_params)
+
+  def ref_loss(weights_list):
+    outs = []
+    for i, t in enumerate(table_map):
+      w, ids = weights_list[t], jnp.asarray(inputs_np[i])
+      if ids.ndim == 1:
+        outs.append(jnp.take(w, ids, axis=0, mode="clip"))
+      else:
+        rows = jnp.where((ids >= 0)[..., None],
+                         jnp.take(w, jnp.clip(ids, 0, w.shape[0] - 1),
+                                  axis=0, mode="clip"), 0.0)
+        if combiner == "mean":
+          counts = jnp.maximum((ids >= 0).sum(1), 1).astype(jnp.float32)
+          outs.append(rows.sum(1) / counts[:, None])
+        else:
+          outs.append(rows.sum(1))
+    return sum(jnp.sum(o ** 2) for o in outs)
+
+  ref_grads = jax.grad(ref_loss)([jnp.asarray(w) for w in weights])
+  want_weights = [np.asarray(w) - lr * np.asarray(g)
+                  for w, g in zip(weights, ref_grads)]
+  for t, (g, w) in enumerate(zip(got_weights, want_weights)):
+    np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5,
+                               err_msg=f"post-update weight mismatch table {t}")
+  return plan
+
+
+@pytest.mark.parametrize("strategy",
+                         ["basic", "memory_balanced", "memory_optimized"])
+def test_parity_each_strategy(strategy):
+  rng = np.random.default_rng(1)
+  sizes = rng.integers(16, 200, size=13).tolist()
+  run_parity(sizes, strategy=strategy, seed=2)
+
+
+def test_parity_single_table_many_workers_auto_slice():
+  # fewer tables than workers -> auto column slicing must cover all 8 ranks
+  plan = run_parity([300, 50], width=16, seed=3)
+  assert all(plan.rank_shards)
+
+
+def test_parity_explicit_column_slice():
+  plan = run_parity([512, 30, 40], width=16, seed=4,
+                    column_slice_threshold=1024)
+  assert len(plan.output_pieces[0]) > 1  # table 0 actually sliced
+
+
+def test_parity_shared_tables():
+  # 3 inputs share 2 tables (reference `tests/dist_model_parallel_test.py:238-285`)
+  run_parity([64, 96], input_table_map=[0, 0, 1], seed=5)
+
+
+def test_parity_multi_hot_sum():
+  run_parity([64, 80, 96], combiner="sum", hotness=5, seed=6)
+
+
+def test_parity_multi_hot_mean():
+  run_parity([64, 80, 96], combiner="mean", hotness=4, seed=7)
+
+
+def test_parity_mixed_widths():
+  rng = np.random.default_rng(8)
+  configs = [TableConfig(input_dim=int(s), output_dim=w)
+             for s, w in [(50, 4), (60, 8), (70, 4), (80, 8), (90, 16),
+                          (100, 4), (110, 8), (120, 16), (130, 4)]]
+  plan = DistEmbeddingStrategy(configs, WORLD, "memory_balanced")
+  assert len(plan.class_keys) >= 2
+  weights = gen_weights(rng, configs)
+  class_params = {k: jnp.asarray(v)
+                  for k, v in set_weights(plan, weights).items()}
+  batch = 16
+  inputs_np = [rng.integers(0, c.input_dim, batch).astype(np.int32)
+               for c in configs]
+  mesh = make_mesh()
+  fn = dist_forward_fn(plan)
+  fwd = jax.jit(shard_map(
+      fn, mesh=mesh,
+      in_specs=(param_specs(plan),) + tuple(P("mp") for _ in inputs_np),
+      out_specs=tuple(P("mp") for _ in inputs_np)))
+  got = fwd(class_params, *[jnp.asarray(x) for x in inputs_np])
+  want = reference_forward(weights, plan.input_table_map, inputs_np,
+                           [None] * len(configs))
+  for g, w in zip(got, want):
+    np.testing.assert_allclose(np.asarray(g), w, rtol=1e-5, atol=1e-5)
+
+
+def test_world_one_no_collectives():
+  rng = np.random.default_rng(9)
+  configs = [TableConfig(input_dim=40, output_dim=8),
+             TableConfig(input_dim=50, output_dim=8)]
+  plan = DistEmbeddingStrategy(configs, 1)
+  weights = gen_weights(rng, configs)
+  class_params = {k: jnp.asarray(v)
+                  for k, v in set_weights(plan, weights).items()}
+  engine = DistributedLookup(plan)
+  inputs_np = [rng.integers(0, 40, 6).astype(np.int32),
+               rng.integers(0, 50, 6).astype(np.int32)]
+  outs = engine.forward(class_params, [jnp.asarray(x) for x in inputs_np])
+  want = reference_forward(weights, [0, 1], inputs_np, [None, None])
+  for g, w in zip(outs, want):
+    np.testing.assert_allclose(np.asarray(g), w, rtol=1e-6)
+
+
+def test_mp_input_mode_matches_dp():
+  rng = np.random.default_rng(10)
+  sizes = [48, 64, 80, 96, 112]
+  configs = [TableConfig(input_dim=s, output_dim=8) for s in sizes]
+  plan = DistEmbeddingStrategy(configs, WORLD, "basic")
+  weights = gen_weights(rng, configs)
+  class_params = {k: jnp.asarray(v)
+                  for k, v in set_weights(plan, weights).items()}
+  batch = 2 * WORLD
+  inputs_np = [rng.integers(0, s, batch).astype(np.int32) for s in sizes]
+  mesh = make_mesh()
+
+  # dp path
+  fn_dp = dist_forward_fn(plan)
+  fwd_dp = jax.jit(shard_map(
+      fn_dp, mesh=mesh,
+      in_specs=(param_specs(plan),) + tuple(P("mp") for _ in sizes),
+      out_specs=tuple(P("mp") for _ in sizes)))
+  dp_out = fwd_dp(class_params, *[jnp.asarray(x) for x in inputs_np])
+
+  # mp-input path: each rank gets its local inputs over the GLOBAL batch
+  per_rank_inputs = [
+      [jnp.asarray(inputs_np[i]) for i in plan.input_ids_list[r]]
+      for r in range(WORLD)
+  ]
+  packed = pack_mp_inputs(plan, per_rank_inputs)
+  packed_specs = {k: P("mp", None, None, None) for k in packed}
+  fn_mp = dist_forward_fn(plan, dp_input=False)
+  fwd_mp = jax.jit(shard_map(
+      fn_mp, mesh=mesh, in_specs=(param_specs(plan), packed_specs),
+      out_specs=tuple(P("mp") for _ in sizes)))
+  mp_out = fwd_mp(class_params, packed)
+  for a, b in zip(dp_out, mp_out):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_ragged_to_padded_roundtrip():
+  ids = RaggedIds(jnp.asarray([3, 4, 5, 9], jnp.int32),
+                  jnp.asarray([0, 1, 1, 4], jnp.int32))
+  padded = ragged_to_padded(ids, 3)
+  np.testing.assert_array_equal(
+      np.asarray(padded), [[3, -1, -1], [-1, -1, -1], [4, 5, 9]])
+
+
+def test_get_set_weights_roundtrip():
+  rng = np.random.default_rng(11)
+  configs = [TableConfig(input_dim=int(s), output_dim=int(w))
+             for s, w in [(40, 8), (600, 16), (70, 8), (80, 16)]]
+  plan = DistEmbeddingStrategy(configs, WORLD, "memory_balanced",
+                               column_slice_threshold=2000)
+  weights = gen_weights(rng, configs)
+  back = get_weights(plan, set_weights(plan, weights))
+  for t, (a, b) in enumerate(zip(weights, back)):
+    np.testing.assert_array_equal(a, b, err_msg=f"table {t}")
+
+
+def test_set_weights_sharded_via_callback():
+  rng = np.random.default_rng(12)
+  configs = [TableConfig(input_dim=32, output_dim=8) for _ in range(8)]
+  plan = DistEmbeddingStrategy(configs, WORLD)
+  weights = gen_weights(rng, configs)
+  mesh = make_mesh()
+  params = set_weights(plan, weights, mesh=mesh)
+  for k, v in params.items():
+    assert v.sharding.spec == P("mp", None, None)
+  back = get_weights(plan, params)
+  for a, b in zip(weights, back):
+    np.testing.assert_array_equal(a, np.asarray(b))
+
+
+def test_set_weights_shape_mismatch_raises():
+  plan = DistEmbeddingStrategy([TableConfig(input_dim=4, output_dim=2)], 1)
+  with pytest.raises(ValueError):
+    set_weights(plan, [np.zeros((5, 2), np.float32)])
+  with pytest.raises(ValueError):
+    set_weights(plan, [np.zeros((4, 2), np.float32), np.zeros((1, 1))])
