@@ -1,0 +1,95 @@
+"""Driver benchmark: synthetic Tiny (55 tables, 4.2 GiB) train step on one chip.
+
+Baseline: the reference's published 1xA100 step time for the same model at
+global batch 65536 with Adagrad — 24.433 ms
+(`/root/reference/examples/benchmarks/synthetic_models/README.md:71`, see
+BASELINE.md). ``vs_baseline > 1`` means this TPU chip beats the A100.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": <ms>, "unit": "ms", "vs_baseline": <ratio>}
+"""
+
+import json
+import os
+import sys
+import time
+
+BASELINE_MS = 24.433  # 1xA100, Tiny, batch 65536, Adagrad
+MODEL = os.environ.get("BENCH_MODEL", "tiny")
+BATCH = int(os.environ.get("BENCH_BATCH", 65536))
+STEPS = int(os.environ.get("BENCH_STEPS", 20))
+
+
+def run(batch_size: int) -> float:
+  import jax
+  import jax.numpy as jnp
+  import numpy as np
+  import optax
+
+  from distributed_embeddings_tpu.models import (
+      SYNTHETIC_MODELS,
+      SyntheticModel,
+      bce_loss,
+      expand_tables,
+      generate_batch,
+  )
+  from distributed_embeddings_tpu.training import make_train_step
+
+  cfg = SYNTHETIC_MODELS[MODEL]
+  tables, tmap, _ = expand_tables(cfg)
+  model = SyntheticModel(config=cfg, world_size=1)
+
+  batches = []
+  for i in range(2):
+    numerical, cats, labels = generate_batch(cfg, batch_size, alpha=1.05,
+                                             seed=i)
+    cats = [np.minimum(c, tables[t].input_dim - 1).astype(np.int32)
+            for c, t in zip(cats, tmap)]
+    batches.append((jnp.asarray(numerical),
+                    [jnp.asarray(c) for c in cats], jnp.asarray(labels)))
+
+  params = model.init(jax.random.PRNGKey(0), batches[0][0],
+                      batches[0][1])["params"]
+  optimizer = optax.adagrad(0.01)
+  opt_state = optimizer.init(params)
+
+  def loss_fn(p, numerical, cats, labels):
+    return bce_loss(model.apply({"params": p}, numerical, cats), labels)
+
+  step = make_train_step(loss_fn, optimizer, None, params, opt_state,
+                         batches[0])
+  for i in range(3):
+    params, opt_state, loss = step(params, opt_state, *batches[i % 2])
+  jax.block_until_ready(loss)
+  t0 = time.perf_counter()
+  for i in range(STEPS):
+    params, opt_state, loss = step(params, opt_state, *batches[i % 2])
+  jax.block_until_ready(loss)
+  return (time.perf_counter() - t0) / STEPS * 1000
+
+
+def main():
+  batch = BATCH
+  while True:
+    try:
+      ms = run(batch)
+      break
+    except Exception as e:  # noqa: BLE001 - OOM fallback, report honestly
+      if "RESOURCE_EXHAUSTED" in str(e) and batch > 4096:
+        print(f"# batch {batch} OOM, retrying at {batch // 2}",
+              file=sys.stderr)
+        batch //= 2
+        continue
+      raise
+  # normalize to the baseline's global batch if we had to shrink
+  equiv_ms = ms * (BATCH / batch)
+  print(json.dumps({
+      "metric": f"synthetic_{MODEL}_step_time_1chip_batch{BATCH}",
+      "value": round(equiv_ms, 3),
+      "unit": "ms",
+      "vs_baseline": round(BASELINE_MS / equiv_ms, 4),
+  }))
+
+
+if __name__ == "__main__":
+  main()

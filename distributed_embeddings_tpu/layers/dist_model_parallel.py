@@ -110,6 +110,9 @@ class DistributedEmbedding(nn.Module):
   input_table_map: Optional[Sequence[int]] = None
   world_size: int = 1
   axis_name: str = "mp"
+  # dp_input=False only: per global input id, its static hotness (must match
+  # what was passed to pack_mp_inputs). None = all one-hot.
+  input_hotness: Optional[Sequence[int]] = None
 
   def __post_init__(self):
     super().__post_init__()
@@ -160,7 +163,8 @@ class DistributedEmbedding(nn.Module):
 
     if self.dp_input:
       return engine.forward(class_params, inputs)
-    return engine.forward_mp(class_params, inputs)
+    return engine.forward_mp(class_params, inputs,
+                             hotness=self.input_hotness)
 
 
 # ---------------------------------------------------------------------------

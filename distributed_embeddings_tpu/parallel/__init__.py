@@ -3,6 +3,7 @@
 from .lookup_engine import (
     DistributedLookup,
     class_param_name,
+    hotness_buckets,
     pack_mp_inputs,
     ragged_to_padded,
 )
@@ -17,6 +18,7 @@ from .mesh import (
 __all__ = [
     "DistributedLookup",
     "class_param_name",
+    "hotness_buckets",
     "pack_mp_inputs",
     "ragged_to_padded",
     "DEFAULT_AXIS",

@@ -44,6 +44,35 @@ def class_param_name(width: int, combiner: Optional[str]) -> str:
   return f"mp_table_w{width}_{combiner if combiner else 'cat'}"
 
 
+def hotness_buckets(plan: DistEmbeddingStrategy, key, hotness_of):
+  """Split a width class's slots into static hotness buckets.
+
+  Inputs of different hotness in the same width class would otherwise pad to
+  the class max (e.g. the synthetic Tiny model mixes 1-hot and 10-hot inputs
+  of the same width -> 10x wasted gather and all_to_all volume). Each bucket
+  becomes its own routing tensor with exact hotness.
+
+  Args:
+    plan: the strategy.
+    key: (width, combiner) class key.
+    hotness_of: input_id -> static hotness.
+
+  Returns:
+    list of (hotness, per-rank lists of slot indices into
+    ``classes[key].slots_per_rank[rank]``, padded slot count).
+  """
+  cp = plan.classes[key]
+  hs = sorted({hotness_of(slot.input_id)
+               for slots in cp.slots_per_rank for slot in slots})
+  buckets = []
+  for h in hs:
+    per_rank = [[i for i, s in enumerate(slots)
+                 if hotness_of(s.input_id) == h]
+                for slots in cp.slots_per_rank]
+    buckets.append((h, per_rank, max(len(i) for i in per_rank)))
+  return buckets
+
+
 def ragged_to_padded(ids: RaggedIds, max_hot: int) -> jax.Array:
   """RaggedIds -> dense [B, max_hot] with PAD_ID padding (for dp routing)."""
   b = ids.nrows
@@ -95,37 +124,27 @@ class DistributedLookup:
           self.plan.world_size, cp.max_rows, cp.width)
     return shapes
 
-  def class_hotness(self, key, inputs: Sequence[jax.Array]) -> int:
-    cp = self.plan.classes[key]
-    h = 1
-    for slots in cp.slots_per_rank:
-      for slot in slots:
-        h = max(h, inputs[slot.input_id].shape[1])
-    return h
-
   # ---- dp-side routing ---------------------------------------------------
-  def _build_routing(self, key, inputs: Sequence[jax.Array]) -> jax.Array:
-    """[world, num_slots, B_local, H_c] routing tensor for one class."""
+  def _build_routing(self, key, bucket, inputs: Sequence[jax.Array]
+                     ) -> jax.Array:
+    """[world, n_bucket, B_local, h] routing tensor for one hotness bucket."""
     cp = self.plan.classes[key]
     world = self.plan.world_size
-    n_c, sentinel = cp.num_slots, cp.max_rows
-    h_c = self.class_hotness(key, inputs)
+    sentinel = cp.max_rows
+    h, slot_idx_per_rank, n_b = bucket
     b = inputs[0].shape[0]
-    pad_block = jnp.full((b, h_c), sentinel, jnp.int32)
+    pad_block = jnp.full((b, h), sentinel, jnp.int32)
     per_dest = []
     for rank in range(world):
-      slots = cp.slots_per_rank[rank]
+      idxs = slot_idx_per_rank[rank]
       per_slot = []
-      for k in range(n_c):
-        if k < len(slots):
-          slot = slots[k]
+      for k in range(n_b):
+        if k < len(idxs):
+          slot = cp.slots_per_rank[rank][idxs[k]]
           ids = inputs[slot.input_id]
           rows = slot.shard.input_dim
           routed = jnp.where(ids < 0, sentinel,
                              jnp.clip(ids, 0, rows - 1) + slot.row_offset)
-          if ids.shape[1] < h_c:
-            routed = jnp.pad(routed, ((0, 0), (0, h_c - ids.shape[1])),
-                             constant_values=sentinel)
           per_slot.append(routed)
         else:
           per_slot.append(pad_block)
@@ -188,69 +207,96 @@ class DistributedLookup:
         raise ValueError("All inputs need the same batch size "
                          f"(got {x.shape[0]} vs {b}).")
 
+    hotness_of = lambda input_id: inputs[input_id].shape[1]  # noqa: E731
     received: Dict[tuple, jax.Array] = {}
     for key in plan.class_keys:
       table_local = self._squeeze_local(class_params[class_param_name(*key)])
-      x = self._build_routing(key, inputs)  # [world, n_c, B, H]
-      if world > 1:
-        # dp -> mp: exchange id blocks over ICI
-        y = lax.all_to_all(x, self.axis_name, split_axis=0, concat_axis=0)
-      else:
-        y = x
-      n_c, h_c = y.shape[1], y.shape[3]
-      # global-batch-major ids for my local class buffer
-      ids_all = jnp.transpose(y, (1, 0, 2, 3)).reshape(n_c, world * b, h_c)
-      z = self._local_lookup(key, table_local, ids_all)  # [n_c, G, w]
-      z = z.reshape(n_c, world, b, -1).transpose(1, 0, 2, 3)
-      if world > 1:
-        # mp -> dp: return activations to their batch owners
-        r = lax.all_to_all(z, self.axis_name, split_axis=0, concat_axis=0)
-      else:
-        r = z
-      received[key] = r  # [world_owner, n_c, B, w]
+      for bucket in hotness_buckets(plan, key, hotness_of):
+        h, _, n_b = bucket
+        x = self._build_routing(key, bucket, inputs)  # [world, n_b, B, h]
+        if world > 1:
+          # dp -> mp: exchange id blocks over ICI
+          y = lax.all_to_all(x, self.axis_name, split_axis=0, concat_axis=0)
+        else:
+          y = x
+        # global-batch-major ids for my local class buffer
+        ids_all = jnp.transpose(y, (1, 0, 2, 3)).reshape(n_b, world * b, h)
+        z = self._local_lookup(key, table_local, ids_all)  # [n_b, G, w]
+        z = z.reshape(n_b, world, b, -1).transpose(1, 0, 2, 3)
+        if world > 1:
+          # mp -> dp: return activations to their batch owners
+          r = lax.all_to_all(z, self.axis_name, split_axis=0, concat_axis=0)
+        else:
+          r = z
+        received[(key, h)] = r  # [world_owner, n_b, B, w]
 
-    return self._assemble(received)
+    return self._assemble(received, hotness_of)
 
   def forward_mp(self, class_params: Dict[str, jax.Array],
-                 packed_inputs: Dict[str, jax.Array]) -> List[jax.Array]:
+                 packed_inputs: Dict[str, jax.Array],
+                 hotness: Optional[Sequence[int]] = None) -> List[jax.Array]:
     """Distributed lookup for model-parallel inputs (dp_input=False).
 
-    ``packed_inputs`` comes from :func:`pack_mp_inputs`: per class, the local
-    block ``[1, num_slots, G, H]`` of pre-offset ids for this rank's tables
-    over the *global* batch. Skips the dp->mp exchange; the output exchange
-    still runs (reference semantics, `dist_model_parallel.py:449-459`).
+    ``packed_inputs`` comes from :func:`pack_mp_inputs`: per (class, hotness)
+    bucket, the local block ``[1, n_bucket, G, h]`` of pre-offset ids for
+    this rank's tables over the *global* batch. Skips the dp->mp exchange;
+    the output exchange still runs (reference semantics,
+    `dist_model_parallel.py:449-459`).
+
+    Args:
+      hotness: per global input id, its static hotness (must match what was
+        passed to pack_mp_inputs). Defaults to all-1 (pure one-hot models).
     """
     plan = self.plan
     world = plan.world_size
+    hotness_of = (lambda i: 1) if hotness is None else \
+        (lambda i: hotness[i])  # noqa: E731
     received = {}
     for key in plan.class_keys:
       table_local = self._squeeze_local(class_params[class_param_name(*key)])
-      ids_all = packed_inputs[class_param_name(*key)]
-      if ids_all.ndim != 4 or ids_all.shape[0] != 1:
-        raise ValueError(
-            f"packed mp input must be [1, num_slots, G, H], got {ids_all.shape}")
-      ids_all = ids_all[0]
-      n_c, g = ids_all.shape[0], ids_all.shape[1]
-      if g % world:
-        raise ValueError(f"Global batch {g} not divisible by world {world}")
-      b = g // world
-      z = self._local_lookup(key, table_local, ids_all)
-      z = z.reshape(n_c, world, b, -1).transpose(1, 0, 2, 3)
-      if world > 1:
-        r = lax.all_to_all(z, self.axis_name, split_axis=0, concat_axis=0)
-      else:
-        r = z
-      received[key] = r
-    return self._assemble(received)
+      for h, _, n_b in hotness_buckets(plan, key, hotness_of):
+        name = f"{class_param_name(*key)}_h{h}"
+        if name not in packed_inputs:
+          raise ValueError(
+              f"packed input {name!r} missing; pass the same `hotness` to "
+              "pack_mp_inputs and forward_mp")
+        ids_all = packed_inputs[name]
+        if (ids_all.ndim != 4 or ids_all.shape[0] != 1
+            or ids_all.shape[1] != n_b or ids_all.shape[3] != h):
+          raise ValueError(
+              f"packed input {name!r} has shape {ids_all.shape}, expected "
+              f"[1, {n_b}, G, {h}] — was it packed with a different plan or "
+              "hotness?")
+        ids_all = ids_all[0]
+        g = ids_all.shape[1]
+        if g % world:
+          raise ValueError(f"Global batch {g} not divisible by world {world}")
+        b = g // world
+        z = self._local_lookup(key, table_local, ids_all)
+        z = z.reshape(n_b, world, b, -1).transpose(1, 0, 2, 3)
+        if world > 1:
+          r = lax.all_to_all(z, self.axis_name, split_axis=0, concat_axis=0)
+        else:
+          r = z
+        received[(key, h)] = r
+    return self._assemble(received, hotness_of)
 
-  def _assemble(self, received: Dict[tuple, jax.Array]) -> List[jax.Array]:
+  def _assemble(self, received: Dict[tuple, jax.Array],
+                hotness_of) -> List[jax.Array]:
     """Per-input output re-assembly incl. column-slice concat.
 
     Replaces the reference's rev_global_input_ids shuffle + range-wise output
     concat (`dist_model_parallel.py:462-469`) with static piece indexing."""
+    plan = self.plan
     results = []
-    for pieces in self.plan.output_pieces:
-      parts = [received[p.class_key][p.rank, p.slot] for p in pieces]
+    for pieces in plan.output_pieces:
+      parts = []
+      for p in pieces:
+        slots = plan.classes[p.class_key].slots_per_rank[p.rank]
+        h = hotness_of(slots[p.slot].input_id)
+        # bucket position = rank of p.slot among same-hotness slots
+        idx = sum(1 for s in slots[:p.slot] if hotness_of(s.input_id) == h)
+        parts.append(received[(p.class_key, h)][p.rank, idx])
       results.append(parts[0] if len(parts) == 1 else
                      jnp.concatenate(parts, axis=-1))
     return results
@@ -258,6 +304,7 @@ class DistributedLookup:
 
 def pack_mp_inputs(plan: DistEmbeddingStrategy,
                    per_rank_inputs: Sequence[Sequence[jax.Array]],
+                   hotness: Optional[Sequence[int]] = None,
                    ) -> Dict[str, jax.Array]:
   """Build global packed arrays for dp_input=False mode.
 
@@ -266,44 +313,48 @@ def pack_mp_inputs(plan: DistEmbeddingStrategy,
     per_rank_inputs: ``per_rank_inputs[r]`` lists rank r's local inputs in
       ``plan.input_ids_list[r]`` order, each [G] or [G, H] over the *global*
       batch (reference mp-input contract, `dist_model_parallel.py:344-346`).
+    hotness: per global input id, its static hotness; pass the same value to
+      :meth:`DistributedLookup.forward_mp`. Default all-1.
 
   Returns:
-    name -> [world, num_slots, G, H] arrays; shard axis 0 over the mesh, then
-    pass the per-device blocks to :meth:`DistributedLookup.forward_mp`.
+    ``{class_name}_h{hotness}`` -> [world, n_bucket, G, h] arrays; shard
+    axis 0 over the mesh, then pass the per-device blocks to ``forward_mp``.
   """
   world = plan.world_size
+  hotness_of = (lambda i: 1) if hotness is None else \
+      (lambda i: hotness[i])  # noqa: E731
   # resolve each (rank, class, slot) to its normalized local input once
-  slot_inputs = {}  # (key, rank, k) -> [G, H] array
+  slot_inputs = {}  # (key, rank, slot_idx) -> [G, H] array
   for rank in range(world):
     for pos, input_id in enumerate(plan.input_ids_list[rank]):
       piece = next(p for p in plan.output_pieces[input_id] if p.rank == rank)
-      slot_inputs[(piece.class_key, rank, piece.slot)] = _normalize_input(
-          per_rank_inputs[rank][pos])
+      x = _normalize_input(per_rank_inputs[rank][pos])
+      if x.shape[1] != hotness_of(input_id):
+        raise ValueError(
+            f"input {input_id} has hotness {x.shape[1]}, `hotness` says "
+            f"{hotness_of(input_id)}")
+      slot_inputs[(piece.class_key, rank, piece.slot)] = x
 
   packed = {}
   for key in plan.class_keys:
     cp = plan.classes[key]
-    n_c, sentinel = cp.num_slots, cp.max_rows
-    class_xs = [slot_inputs[k] for k in slot_inputs if k[0] == key]
-    h_c = max((x.shape[1] for x in class_xs), default=1)
-    g = class_xs[0].shape[0] if class_xs else 0
-    per_rank = []
-    for rank in range(world):
-      entries = []
-      for k in range(n_c):
-        slots = cp.slots_per_rank[rank]
-        if k < len(slots):
-          slot = slots[k]
-          x = slot_inputs[(key, rank, k)]
-          rows = slot.shard.input_dim
-          routed = jnp.where(x < 0, sentinel,
-                             jnp.clip(x, 0, rows - 1) + slot.row_offset)
-          if routed.shape[1] < h_c:
-            routed = jnp.pad(routed, ((0, 0), (0, h_c - routed.shape[1])),
-                             constant_values=sentinel)
-        else:
-          routed = jnp.full((g, h_c), sentinel, jnp.int32)
-        entries.append(routed)
-      per_rank.append(jnp.stack(entries))
-    packed[class_param_name(*key)] = jnp.stack(per_rank)
+    sentinel = cp.max_rows
+    g = next((x.shape[0] for x in slot_inputs.values()), 0)
+    for h, slot_idx_per_rank, n_b in hotness_buckets(plan, key, hotness_of):
+      per_rank = []
+      for rank in range(world):
+        idxs = slot_idx_per_rank[rank]
+        entries = []
+        for k in range(n_b):
+          if k < len(idxs):
+            slot = cp.slots_per_rank[rank][idxs[k]]
+            x = slot_inputs[(key, rank, idxs[k])]
+            rows = slot.shard.input_dim
+            routed = jnp.where(x < 0, sentinel,
+                               jnp.clip(x, 0, rows - 1) + slot.row_offset)
+          else:
+            routed = jnp.full((g, h), sentinel, jnp.int32)
+          entries.append(routed)
+        per_rank.append(jnp.stack(entries))
+      packed[f"{class_param_name(*key)}_h{h}"] = jnp.stack(per_rank)
   return packed

@@ -1,0 +1,98 @@
+"""Embedding lookup microbenchmark.
+
+Equivalent of `/root/reference/examples/benchmarks/benchmark.py:23-98`: times
+the fused variable-hotness (CSR) lookup against the naive dense-padded
+gather+reduce, forward / backward / SGD-apply, at vocab 1M x width 128,
+batch 16384, hotness <= 500.
+
+  python examples/benchmarks/benchmark.py [--platform cpu] [--hotness 64]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def parse_args():
+  p = argparse.ArgumentParser()
+  p.add_argument("--vocab", type=int, default=1_000_000)
+  p.add_argument("--width", type=int, default=128)
+  p.add_argument("--batch", type=int, default=16384)
+  p.add_argument("--hotness", type=int, default=64,
+                 help="max hotness (uniform 1..max per row)")
+  p.add_argument("--steps", type=int, default=20)
+  p.add_argument("--combiner", default="sum", choices=["sum", "mean"])
+  p.add_argument("--platform", default=None)
+  return p.parse_args()
+
+
+def timeit(fn, *args, steps=20):
+  out = jax.block_until_ready(fn(*args))  # compile
+  t0 = time.perf_counter()
+  for _ in range(steps):
+    out = fn(*args)
+  jax.block_until_ready(out)
+  return (time.perf_counter() - t0) / steps * 1000
+
+
+def main():
+  args = parse_args()
+  if args.platform:
+    jax.config.update("jax_platforms", args.platform)
+  from distributed_embeddings_tpu.ops import RaggedIds, csr_lookup
+
+  rng = np.random.default_rng(0)
+  params = jnp.asarray(
+      rng.standard_normal((args.vocab, args.width)), jnp.float32)
+  lengths = rng.integers(1, args.hotness + 1, args.batch)
+  nnz = int(lengths.sum())
+  values = jnp.asarray(rng.integers(0, args.vocab, nnz), jnp.int32)
+  row_splits = jnp.asarray(
+      np.concatenate([[0], np.cumsum(lengths)]), jnp.int32)
+  dense_ids = jnp.asarray(
+      rng.integers(0, args.vocab, (args.batch, args.hotness)), jnp.int32)
+  print(f"vocab={args.vocab} width={args.width} batch={args.batch} "
+        f"avg_hotness={nnz / args.batch:.1f} nnz={nnz} on "
+        f"{jax.devices()[0].platform}")
+
+  fused_fwd = jax.jit(
+      lambda p: csr_lookup(p, values, row_splits, args.combiner))
+  naive_fwd = jax.jit(
+      lambda p: jnp.sum(jnp.take(p, dense_ids, axis=0), axis=1)
+      if args.combiner == "sum"
+      else jnp.mean(jnp.take(p, dense_ids, axis=0), axis=1))
+
+  def grad_of(fwd):
+    return jax.jit(jax.grad(lambda p: jnp.sum(fwd(p) ** 2)))
+
+  def sgd_of(fwd):
+    g = jax.grad(lambda p: jnp.sum(fwd(p) ** 2))
+    return jax.jit(lambda p: p - 0.01 * g(p), donate_argnums=0)
+
+  rows = []
+  for name, fwd in [("fused_csr", fused_fwd), ("padded_dense", naive_fwd)]:
+    t_f = timeit(fwd, params, steps=args.steps)
+    t_g = timeit(grad_of(fwd), params, steps=args.steps)
+    sgd = sgd_of(fwd)
+    p = params + 0  # fresh buffer: sgd donates its input
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+      p = sgd(p)
+    jax.block_until_ready(p)
+    t_s = (time.perf_counter() - t0) / args.steps * 1000
+    rows.append((name, t_f, t_g, t_s))
+    print(f"{name:>14}: forward {t_f:8.3f} ms  grad {t_g:8.3f} ms  "
+          f"sgd-step {t_s:8.3f} ms")
+  speedup = rows[1][3] / rows[0][3]
+  print(f"fused vs padded sgd-step speedup: {speedup:.2f}x")
+
+
+if __name__ == "__main__":
+  main()

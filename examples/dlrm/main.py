@@ -1,0 +1,216 @@
+"""DLRM training on Criteo (TPU-native).
+
+Equivalent of `/root/reference/examples/dlrm/main.py`: trains DLRM on the
+split-binary Criteo dataset (or dummy data) with hybrid model/data parallel
+embeddings, warmup+poly-decay SGD, AUC evaluation, and a final global-view
+numpy checkpoint.
+
+Usage:
+  python examples/dlrm/main.py --dataset dummy --steps 100 --batch_size 4096
+  python examples/dlrm/main.py --dataset_path /data/criteo --amp
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributed_embeddings_tpu.layers import get_weights
+from distributed_embeddings_tpu.models import DLRM, bce_loss
+from distributed_embeddings_tpu.models.dlrm import dlrm_embedding_plan
+from distributed_embeddings_tpu.parallel import create_mesh
+from distributed_embeddings_tpu.training import (
+    make_eval_step,
+    make_train_step,
+    shard_batch,
+    shard_params,
+)
+from distributed_embeddings_tpu.utils import (
+    DummyDataset,
+    RawBinaryCriteoDataset,
+    dlrm_lr_schedule,
+)
+
+CRITEO_1TB_VOCAB = [
+    39884406, 39043, 17289, 7420, 20263, 3, 7120, 1543, 63, 38532951,
+    2953546, 403346, 10, 2208, 11938, 155, 4, 976, 14, 39979771,
+    25641295, 39664984, 585935, 12972, 108, 36
+]
+
+
+def parse_args():
+  p = argparse.ArgumentParser(description=__doc__)
+  p.add_argument("--dataset", choices=["dummy", "criteo"], default="dummy")
+  p.add_argument("--dataset_path", default=None,
+                 help="split-binary Criteo dir (model_size.json supported)")
+  p.add_argument("--batch_size", type=int, default=8192,
+                 help="global batch size")
+  p.add_argument("--steps", type=int, default=100)
+  p.add_argument("--epochs", type=int, default=1)
+  p.add_argument("--lr", type=float, default=24.0)
+  p.add_argument("--warmup_steps", type=int, default=2750)
+  p.add_argument("--decay_start_step", type=int, default=49315)
+  p.add_argument("--decay_steps", type=int, default=27772)
+  p.add_argument("--embedding_dim", type=int, default=128)
+  p.add_argument("--strategy", default="memory_balanced",
+                 choices=["basic", "memory_balanced", "memory_optimized"])
+  p.add_argument("--column_slice_threshold", type=int, default=None)
+  p.add_argument("--amp", action="store_true", help="bf16 compute")
+  p.add_argument("--world_size", type=int, default=None,
+                 help="mesh size; default = all devices")
+  p.add_argument("--eval", action="store_true")
+  p.add_argument("--save_checkpoint", default=None,
+                 help="path for final np.savez global checkpoint")
+  p.add_argument("--vocab_scale", type=float, default=1.0,
+                 help="scale Criteo vocab sizes (for memory-limited runs)")
+  p.add_argument("--platform", default=None,
+                 help="force a jax platform (e.g. 'cpu'); this image pins a "
+                      "TPU backend via sitecustomize, so env vars are not "
+                      "enough")
+  return p.parse_args()
+
+
+def load_vocab(args):
+  if args.dataset_path:
+    meta = os.path.join(args.dataset_path, "model_size.json")
+    if os.path.exists(meta):
+      # reference reads table sizes from the dataset's model_size.json
+      # (`examples/dlrm/main.py:68-73`)
+      with open(meta) as f:
+        sizes = list(json.load(f).values())
+      return [s + 1 for s in sizes]
+  return [max(4, int(v * args.vocab_scale)) for v in CRITEO_1TB_VOCAB]
+
+
+def auc(labels: np.ndarray, scores: np.ndarray) -> float:
+  """Rank-based AUC (Mann-Whitney), no sklearn dependency."""
+  order = np.argsort(scores, kind="mergesort")
+  ranks = np.empty_like(order, dtype=np.float64)
+  ranks[order] = np.arange(1, len(scores) + 1)
+  # average ties
+  sorted_scores = scores[order]
+  i = 0
+  while i < len(sorted_scores):
+    j = i
+    while j + 1 < len(sorted_scores) and sorted_scores[j + 1] == sorted_scores[i]:
+      j += 1
+    if j > i:
+      ranks[order[i:j + 1]] = ranks[order[i:j + 1]].mean()
+    i = j + 1
+  pos = labels > 0.5
+  n_pos, n_neg = pos.sum(), (~pos).sum()
+  if n_pos == 0 or n_neg == 0:
+    return float("nan")
+  return float((ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
+
+
+def main():
+  args = parse_args()
+  if args.platform:
+    jax.config.update("jax_platforms", args.platform)
+  devices = jax.devices()
+  world = args.world_size or len(devices)
+  mesh = create_mesh(world) if world > 1 else None
+  vocab = load_vocab(args)
+  print(f"devices={len(devices)} world={world} tables={len(vocab)} "
+        f"total_rows={sum(vocab):,}")
+
+  model = DLRM(vocab_sizes=vocab,
+               embedding_dim=args.embedding_dim,
+               world_size=world,
+               strategy=args.strategy,
+               column_slice_threshold=args.column_slice_threshold,
+               compute_dtype=jnp.bfloat16 if args.amp else jnp.float32)
+
+  local_bs = args.batch_size // world
+  if args.dataset == "dummy":
+    train_data = DummyDataset(args.batch_size, 13, vocab,
+                              num_batches=args.steps)
+    eval_data = DummyDataset(args.batch_size, 13, vocab, num_batches=4,
+                             seed=777)
+  else:
+    train_data = RawBinaryCriteoDataset(
+        args.dataset_path, local_bs, numerical_features=13,
+        categorical_features=list(range(len(vocab))),
+        categorical_feature_sizes=vocab, world_size=world)
+    eval_data = RawBinaryCriteoDataset(
+        args.dataset_path, local_bs, numerical_features=13,
+        categorical_features=list(range(len(vocab))),
+        categorical_feature_sizes=vocab, world_size=world, valid=True)
+
+  numerical, cats, labels = train_data[0]
+  batch_example = (jnp.asarray(numerical), [jnp.asarray(c) for c in cats],
+                   jnp.asarray(labels))
+  params = model.init(jax.random.PRNGKey(0), batch_example[0],
+                      batch_example[1])["params"]
+  schedule = dlrm_lr_schedule(args.lr, args.warmup_steps,
+                              args.decay_start_step, args.decay_steps)
+  optimizer = optax.sgd(schedule)
+  opt_state = optimizer.init(params)
+  params = shard_params(params, mesh)
+  opt_state = shard_params(opt_state, mesh)
+
+  def loss_fn(params, numerical, cats, labels):
+    logits = model.apply({"params": params}, numerical, cats)
+    return bce_loss(logits, labels)
+
+  step_fn = make_train_step(loss_fn, optimizer, mesh, params, opt_state,
+                            batch_example)
+
+  t_start, losses = time.time(), []
+  steps_done = 0
+  for epoch in range(args.epochs):
+    for batch in train_data:
+      numerical, cats, labels = batch
+      sharded = shard_batch(
+          (jnp.asarray(numerical), [jnp.asarray(c) for c in cats],
+           jnp.asarray(labels)), mesh)
+      params, opt_state, loss = step_fn(params, opt_state, *sharded)
+      losses.append(float(loss))
+      steps_done += 1
+      if steps_done % 100 == 0:
+        rate = steps_done * args.batch_size / (time.time() - t_start)
+        print(f"step {steps_done} loss {np.mean(losses[-100:]):.5f} "
+              f"{rate:,.0f} samples/sec")
+      if steps_done >= args.steps:
+        break
+    if steps_done >= args.steps:
+      break
+  elapsed = time.time() - t_start
+  print(f"trained {steps_done} steps in {elapsed:.1f}s "
+        f"({steps_done * args.batch_size / max(elapsed, 1e-9):,.0f} samples/sec)"
+        f" final loss {np.mean(losses[-10:]):.5f}")
+
+  if args.eval:
+    def pred_fn(params, numerical, cats):
+      return jax.nn.sigmoid(model.apply({"params": params}, numerical, cats))
+
+    eval_step = make_eval_step(pred_fn, mesh, params, batch_example[:2])
+    all_scores, all_labels = [], []
+    for numerical, cats, labels in eval_data:
+      sharded = shard_batch(
+          (jnp.asarray(numerical), [jnp.asarray(c) for c in cats]), mesh)
+      all_scores.append(np.asarray(eval_step(params, *sharded)))
+      all_labels.append(labels)
+    score = auc(np.concatenate(all_labels), np.concatenate(all_scores))
+    print(f"eval AUC: {score:.5f}")
+
+  if args.save_checkpoint:
+    # global-view numpy checkpoint (reference `examples/dlrm/main.py:245-248`)
+    plan = dlrm_embedding_plan(vocab, args.embedding_dim, world,
+                               args.strategy, args.column_slice_threshold)
+    tables = get_weights(plan, params["embeddings"])
+    np.savez(args.save_checkpoint, *tables)
+    print(f"saved {len(tables)} tables to {args.save_checkpoint}")
+
+
+if __name__ == "__main__":
+  main()

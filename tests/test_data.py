@@ -1,0 +1,115 @@
+"""Criteo split-binary reader + dummy data + LR schedule tests."""
+
+import numpy as np
+import pytest
+
+from distributed_embeddings_tpu.utils import (
+    DummyDataset,
+    RawBinaryCriteoDataset,
+    categorical_dtype,
+    dlrm_lr_schedule,
+    write_dummy_criteo_split,
+)
+
+
+def test_categorical_dtype_selection():
+  assert categorical_dtype(100) == np.int8
+  assert categorical_dtype(30_000) == np.int16
+  assert categorical_dtype(1_000_000) == np.int32
+  assert categorical_dtype(3_000_000_000) == np.int64
+
+
+def test_raw_binary_roundtrip(tmp_path):
+  vocab = [50, 20_000, 1_000_000]
+  write_dummy_criteo_split(str(tmp_path), num_samples=64, vocab_sizes=vocab,
+                           seed=5)
+  ds = RawBinaryCriteoDataset(str(tmp_path), batch_size=16,
+                              numerical_features=13,
+                              categorical_features=[0, 1, 2],
+                              categorical_feature_sizes=vocab)
+  assert len(ds) == 4
+  numerical, cats, labels = ds[0]
+  assert numerical.shape == (16, 13) and numerical.dtype == np.float32
+  assert labels.shape == (16,)
+  assert len(cats) == 3
+  for c, v in zip(cats, vocab):
+    assert c.dtype == np.int32
+    assert c.min() >= 0 and c.max() < v
+  # dtype widths on disk follow vocabulary size
+  assert (tmp_path / "train" / "cat_0.bin").stat().st_size == 64  # int8
+  assert (tmp_path / "train" / "cat_1.bin").stat().st_size == 128  # int16
+  assert (tmp_path / "train" / "cat_2.bin").stat().st_size == 256  # int32
+
+
+def test_raw_binary_dp_slicing(tmp_path):
+  vocab = [100]
+  write_dummy_criteo_split(str(tmp_path), num_samples=64, vocab_sizes=vocab)
+  full = RawBinaryCriteoDataset(str(tmp_path), batch_size=8,
+                                categorical_features=[0],
+                                categorical_feature_sizes=vocab)
+  r0 = RawBinaryCriteoDataset(str(tmp_path), batch_size=4,
+                              categorical_features=[0],
+                              categorical_feature_sizes=vocab,
+                              rank=0, world_size=2)
+  r1 = RawBinaryCriteoDataset(str(tmp_path), batch_size=4,
+                              categorical_features=[0],
+                              categorical_feature_sizes=vocab,
+                              rank=1, world_size=2)
+  assert len(r0) == len(r1) == 8
+  _, full_cats, _ = full[0]
+  _, c0, _ = r0[0]
+  _, c1, _ = r1[0]
+  np.testing.assert_array_equal(np.concatenate([c0[0], c1[0]]), full_cats[0])
+
+
+def test_raw_binary_prefetch_iteration(tmp_path):
+  vocab = [100]
+  write_dummy_criteo_split(str(tmp_path), num_samples=32, vocab_sizes=vocab)
+  ds = RawBinaryCriteoDataset(str(tmp_path), batch_size=8,
+                              categorical_features=[0],
+                              categorical_feature_sizes=vocab,
+                              prefetch_depth=2)
+  batches = list(ds)
+  assert len(batches) == 4
+  for i, (num, cats, labels) in enumerate(batches):
+    want_num, want_cats, want_labels = ds[i]
+    np.testing.assert_array_equal(cats[0], want_cats[0])
+    np.testing.assert_array_equal(labels, want_labels)
+
+
+def test_raw_binary_size_mismatch_raises(tmp_path):
+  vocab = [100]
+  write_dummy_criteo_split(str(tmp_path), num_samples=32, vocab_sizes=vocab)
+  # truncate a cat file -> mismatch must raise
+  p = tmp_path / "train" / "cat_0.bin"
+  p.write_bytes(p.read_bytes()[:-8])
+  with pytest.raises(ValueError):
+    RawBinaryCriteoDataset(str(tmp_path), batch_size=8,
+                           categorical_features=[0],
+                           categorical_feature_sizes=vocab)
+
+
+def test_dummy_dataset_deterministic():
+  a = DummyDataset(8, 13, [10, 20], num_batches=3, seed=1)
+  b = DummyDataset(8, 13, [10, 20], num_batches=3, seed=1)
+  na, ca, la = a[1]
+  nb, cb, lb = b[1]
+  np.testing.assert_array_equal(na, nb)
+  np.testing.assert_array_equal(ca[0], cb[0])
+  np.testing.assert_array_equal(la, lb)
+
+
+def test_lr_schedule_phases():
+  import jax.numpy as jnp
+
+  sched = dlrm_lr_schedule(24.0, warmup_steps=10, decay_start_step=100,
+                           decay_steps=50)
+  # warmup ramps linearly
+  assert float(sched(0)) == pytest.approx(2.4)
+  assert float(sched(9)) == pytest.approx(24.0)
+  # plateau
+  assert float(sched(50)) == pytest.approx(24.0)
+  # poly decay to zero
+  assert float(sched(125)) == pytest.approx(24.0 * 0.25, rel=1e-5)
+  assert float(sched(150)) == pytest.approx(0.0, abs=1e-6)
+  assert float(sched(1000)) == pytest.approx(0.0, abs=1e-6)

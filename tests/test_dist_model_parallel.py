@@ -313,3 +313,96 @@ def test_set_weights_shape_mismatch_raises():
     set_weights(plan, [np.zeros((5, 2), np.float32)])
   with pytest.raises(ValueError):
     set_weights(plan, [np.zeros((4, 2), np.float32), np.zeros((1, 1))])
+
+
+def test_parity_mixed_hotness_one_class():
+  """1-hot and multi-hot inputs sharing one width class: the hotness-bucket
+  bookkeeping (routing build + output re-assembly) must stay aligned."""
+  rng = np.random.default_rng(21)
+  sizes = [60, 70, 80, 90, 100, 110, 120, 130, 140]
+  configs = [TableConfig(input_dim=s, output_dim=8, combiner="sum")
+             for s in sizes]
+  plan = DistEmbeddingStrategy(configs, WORLD, "memory_balanced")
+  assert len(plan.class_keys) == 1  # all in one width class
+  weights = gen_weights(rng, configs)
+  class_params = {k: jnp.asarray(v)
+                  for k, v in set_weights(plan, weights).items()}
+  batch = 2 * WORLD
+  hots = [1, 5, 1, 3, 5, 1, 3, 1, 5]  # mixed hotness across the class
+  inputs_np = []
+  for t, h in enumerate(hots):
+    ids = rng.integers(0, sizes[t], size=(batch, h)).astype(np.int32)
+    if h > 1:  # ragged padding in some slots
+      mask = rng.random((batch, h)) < 0.3
+      mask[:, 0] = False
+      ids[mask] = -1
+    inputs_np.append(ids)
+  mesh = make_mesh()
+  fn = dist_forward_fn(plan)
+  fwd = jax.jit(shard_map(
+      fn, mesh=mesh,
+      in_specs=(param_specs(plan),) + tuple(P("mp") for _ in inputs_np),
+      out_specs=tuple(P("mp") for _ in inputs_np)))
+  got = fwd(class_params, *[jnp.asarray(x) for x in inputs_np])
+  want = reference_forward(weights, plan.input_table_map, inputs_np,
+                           ["sum"] * len(configs))
+  for i, (g, w) in enumerate(zip(got, want)):
+    np.testing.assert_allclose(np.asarray(g), w, rtol=1e-5, atol=1e-5,
+                               err_msg=f"input {i} (hotness {hots[i]})")
+
+
+def test_mp_input_mode_multi_hot_mixed():
+  """dp_input=False with mixed hotness: pack_mp_inputs + forward_mp must
+  agree on bucket layout via the explicit `hotness` argument."""
+  rng = np.random.default_rng(22)
+  sizes = [48, 64, 80, 96, 112, 128, 144, 160]
+  configs = [TableConfig(input_dim=s, output_dim=8, combiner="sum")
+             for s in sizes]
+  plan = DistEmbeddingStrategy(configs, WORLD, "basic")
+  weights = gen_weights(rng, configs)
+  class_params = {k: jnp.asarray(v)
+                  for k, v in set_weights(plan, weights).items()}
+  batch = 2 * WORLD
+  hots = [1, 4, 1, 4, 1, 4, 1, 4]
+  inputs_np = [rng.integers(0, s, size=(batch, h)).astype(np.int32)
+               for s, h in zip(sizes, hots)]
+  mesh = make_mesh()
+
+  # dp path as the oracle
+  fn_dp = dist_forward_fn(plan)
+  fwd_dp = jax.jit(shard_map(
+      fn_dp, mesh=mesh,
+      in_specs=(param_specs(plan),) + tuple(P("mp") for _ in sizes),
+      out_specs=tuple(P("mp") for _ in sizes)))
+  dp_out = fwd_dp(class_params, *[jnp.asarray(x) for x in inputs_np])
+
+  per_rank_inputs = [
+      [jnp.asarray(inputs_np[i]) for i in plan.input_ids_list[r]]
+      for r in range(WORLD)
+  ]
+  packed = pack_mp_inputs(plan, per_rank_inputs, hotness=hots)
+  assert any(k.endswith("_h4") for k in packed), list(packed)
+  packed_specs = {k: P("mp", None, None, None) for k in packed}
+  engine = DistributedLookup(plan, dp_input=False, axis_name="mp")
+
+  def fn_mp(class_params, packed):
+    return tuple(engine.forward_mp(class_params, packed, hotness=hots))
+
+  fwd_mp = jax.jit(shard_map(
+      fn_mp, mesh=mesh, in_specs=(param_specs(plan), packed_specs),
+      out_specs=tuple(P("mp") for _ in sizes)))
+  mp_out = fwd_mp(class_params, packed)
+  for i, (a, b) in enumerate(zip(dp_out, mp_out)):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6,
+                               err_msg=f"input {i}")
+
+
+def test_forward_mp_stale_packed_shape_raises():
+  plan = DistEmbeddingStrategy(
+      [TableConfig(input_dim=16, output_dim=8) for _ in range(8)], WORLD)
+  engine = DistributedLookup(plan, dp_input=False)
+  name = class_param_name(8, None) + "_h1"
+  bad = {name: jnp.zeros((1, 3, 8, 2), jnp.int32)}  # wrong n_b and h
+  params = {class_param_name(8, None): jnp.zeros((1, 16, 8))}
+  with pytest.raises(ValueError, match="packed input"):
+    engine.forward_mp(params, bad)

@@ -1,0 +1,177 @@
+"""DLRM + synthetic model tests: shapes, interaction math, distributed
+training convergence, data pipeline."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from distributed_embeddings_tpu.models import (
+    DLRM,
+    SYNTHETIC_MODELS,
+    SyntheticModel,
+    bce_loss,
+    dot_interact,
+    expand_tables,
+    generate_batch,
+    model_size_gib,
+    power_law_ids,
+)
+from distributed_embeddings_tpu.parallel import create_mesh
+from distributed_embeddings_tpu.training import (
+    make_eval_step,
+    make_train_step,
+    shard_batch,
+    shard_params,
+)
+
+WORLD = 8
+
+
+def test_dot_interact_matches_naive():
+  rng = np.random.default_rng(0)
+  b, f, d = 4, 5, 8
+  bottom = rng.standard_normal((b, d)).astype(np.float32)
+  embs = [rng.standard_normal((b, d)).astype(np.float32) for _ in range(f - 1)]
+  out = dot_interact(jnp.asarray(bottom), [jnp.asarray(e) for e in embs])
+  feats = np.stack([bottom] + embs, 1)
+  gram = np.einsum("bfd,bgd->bfg", feats, feats)
+  rows, cols = np.tril_indices(f, k=-1)
+  want = np.concatenate([gram[:, rows, cols], bottom], axis=1)
+  assert out.shape == (b, f * (f - 1) // 2 + d)
+  np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5, atol=1e-5)
+
+
+def test_dlrm_single_device_forward_and_loss():
+  rng = np.random.default_rng(1)
+  vocab = [50, 60, 70, 80]
+  model = DLRM(vocab_sizes=vocab, embedding_dim=16, bottom_mlp=(32, 16),
+               top_mlp=(32, 1))
+  b = 8
+  numerical = jnp.asarray(rng.standard_normal((b, 13)), jnp.float32)
+  cats = [jnp.asarray(rng.integers(0, v, b), jnp.int32) for v in vocab]
+  params = model.init(jax.random.PRNGKey(0), numerical, cats)
+  logits = model.apply(params, numerical, cats)
+  assert logits.shape == (b,) and logits.dtype == jnp.float32
+  labels = jnp.asarray(rng.integers(0, 2, b), jnp.float32)
+  loss = bce_loss(logits, labels)
+  assert np.isfinite(float(loss))
+
+
+def test_dlrm_bad_bottom_mlp_raises():
+  model = DLRM(vocab_sizes=[10], embedding_dim=16, bottom_mlp=(32, 8))
+  with pytest.raises(ValueError):
+    model.init(jax.random.PRNGKey(0), jnp.zeros((2, 4)),
+               [jnp.zeros((2,), jnp.int32)])
+
+
+def test_dlrm_distributed_training_converges():
+  rng = np.random.default_rng(2)
+  vocab = [64] * 8
+  mesh = create_mesh(WORLD)
+  model = DLRM(vocab_sizes=vocab, embedding_dim=8, bottom_mlp=(16, 8),
+               top_mlp=(16, 1), world_size=WORLD, strategy="memory_balanced")
+  b = 4 * WORLD
+  numerical = jnp.asarray(rng.standard_normal((b, 13)), jnp.float32)
+  cats = [jnp.asarray(rng.integers(0, v, b), jnp.int32) for v in vocab]
+  labels = jnp.asarray(rng.integers(0, 2, b), jnp.float32)
+  params = model.init(jax.random.PRNGKey(0), numerical, cats)["params"]
+  optimizer = optax.sgd(0.1)
+  opt_state = optimizer.init(params)
+  params = shard_params(params, mesh)
+  opt_state = shard_params(opt_state, mesh)
+
+  def loss_fn(p, numerical, cats, labels):
+    return bce_loss(model.apply({"params": p}, numerical, cats), labels)
+
+  batch = (numerical, cats, labels)
+  step = make_train_step(loss_fn, optimizer, mesh, params, opt_state, batch)
+  sharded = shard_batch(batch, mesh)
+  losses = []
+  for _ in range(8):
+    params, opt_state, loss = step(params, opt_state, *sharded)
+    losses.append(float(loss))
+  assert losses[-1] < losses[0], losses
+
+  def pred_fn(p, numerical, cats):
+    return jax.nn.sigmoid(model.apply({"params": p}, numerical, cats))
+
+  eval_step = make_eval_step(pred_fn, mesh, params, (numerical, cats))
+  preds = eval_step(params, *shard_batch((numerical, cats), mesh))
+  assert preds.shape == (b,)
+  assert np.all((np.asarray(preds) >= 0) & (np.asarray(preds) <= 1))
+
+
+def test_dlrm_amp_bf16():
+  vocab = [32, 32]
+  model = DLRM(vocab_sizes=vocab, embedding_dim=8, bottom_mlp=(8,),
+               top_mlp=(8, 1), compute_dtype=jnp.bfloat16)
+  numerical = jnp.zeros((4, 4))
+  cats = [jnp.zeros((4,), jnp.int32)] * 2
+  params = model.init(jax.random.PRNGKey(0), numerical, cats)
+  logits = model.apply(params, numerical, cats)
+  assert logits.dtype == jnp.float32  # output upcast
+
+
+def test_synthetic_zoo_table_counts():
+  # published counts: SURVEY.md §6 / reference synthetic_models README
+  expected = {"tiny": 55, "small": 107, "medium": 311, "large": 612,
+              "jumbo": 1022, "colossal": 2002}
+  for name, count in expected.items():
+    tables, _, _ = expand_tables(SYNTHETIC_MODELS[name])
+    assert len(tables) == count, (name, len(tables))
+
+
+def test_synthetic_zoo_sizes_match_published_gib():
+  published = {"tiny": 4.2, "small": 26.3, "medium": 206.2, "large": 773.8,
+               "jumbo": 3109.5, "colossal": 22327.4}
+  for name, gib in published.items():
+    got = model_size_gib(SYNTHETIC_MODELS[name])
+    assert abs(got - gib) / gib < 0.02, (name, got, gib)
+
+
+def test_power_law_distribution_skews_low():
+  rng = np.random.default_rng(3)
+  ids = power_law_ids(rng, 2000, 1, 10_000, alpha=1.1)
+  assert ids.min() >= 0 and ids.max() < 10_000
+  # strong skew: majority of mass in the lowest decile
+  frac_low = (ids < 1000).mean()
+  assert frac_low > 0.5, frac_low
+  uniform = power_law_ids(rng, 2000, 1, 10_000, alpha=0)
+  assert (uniform < 1000).mean() < 0.2
+
+
+def test_synthetic_model_trains_distributed():
+  cfg = SYNTHETIC_MODELS["tiny"]
+  # shrink tables for test speed but keep structure (incl. shared multi-hot)
+  import dataclasses
+  groups = tuple(
+      dataclasses.replace(g, num_rows=min(g.num_rows, 1000))
+      for g in cfg.embedding_groups)
+  cfg = dataclasses.replace(cfg, embedding_groups=groups)
+  mesh = create_mesh(WORLD)
+  model = SyntheticModel(config=cfg, world_size=WORLD)
+  numerical, cats, labels = generate_batch(cfg, 2 * WORLD, alpha=1.05, seed=4)
+  # shrink ids to the shrunk tables
+  tables, tmap, _ = expand_tables(cfg)
+  cats = [np.minimum(c, tables[t].input_dim - 1) for c, t in zip(cats, tmap)]
+  batch = (jnp.asarray(numerical), [jnp.asarray(c) for c in cats],
+           jnp.asarray(labels))
+  params = model.init(jax.random.PRNGKey(0), batch[0], batch[1])["params"]
+  optimizer = optax.adagrad(0.002)
+  opt_state = optimizer.init(params)
+  params = shard_params(params, mesh)
+  opt_state = shard_params(opt_state, mesh)
+
+  def loss_fn(p, numerical, cats, labels):
+    return bce_loss(model.apply({"params": p}, numerical, cats), labels)
+
+  step = make_train_step(loss_fn, optimizer, mesh, params, opt_state, batch)
+  sharded = shard_batch(batch, mesh)
+  losses = []
+  for _ in range(10):
+    params, opt_state, loss = step(params, opt_state, *sharded)
+    losses.append(float(loss))
+  assert losses[-1] < losses[0], losses
+  assert np.isfinite(losses).all()
