@@ -5,6 +5,7 @@ from .dist_model_parallel import (
     DistributedEmbedding,
     DistributedOptimizer,
     broadcast_variables,
+    finalize_hybrid_grads,
     get_weights,
     hybrid_partition_specs,
     set_weights,
@@ -21,6 +22,7 @@ __all__ = [
     "Embedding",
     "TableConfig",
     "broadcast_variables",
+    "finalize_hybrid_grads",
     "get_weights",
     "hybrid_partition_specs",
     "set_weights",

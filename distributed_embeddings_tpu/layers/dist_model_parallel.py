@@ -12,7 +12,7 @@ TPU-native execution model:
   model a uniform SPMD program (see ``parallel/lookup_engine.py``).
 - Comm: ``lax.all_to_all`` inside ``shard_map`` replaces ``hvd.alltoall``.
 - Hybrid single-backward: embedding grads are grads of mesh-sharded arrays —
-  local by construction. Dense grads are psum'd by ``DistributedOptimizer``
+  local by construction. Dense grads are finalized by ``DistributedOptimizer``
   (an optax transformation) — replacing the reference's Horovod tape/optimizer
   monkey-patching (`dist_model_parallel.py:696-799`) with ~20 functional lines.
 - Checkpoint: :func:`get_weights` / :func:`set_weights` give the reference's
@@ -289,42 +289,45 @@ def hybrid_partition_specs(tree, axis_name: str = "mp"):
   variables; here it is one tree_map).
   """
   def spec(path, leaf):
-    del leaf
     names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
-    if is_model_parallel_param(names):
+    if is_model_parallel_param(names) and getattr(leaf, "ndim", 0) == 3:
       return P(axis_name, None, None)
     return P()
 
   return jax.tree_util.tree_map_with_path(spec, tree)
 
 
-def psum_dense_grads(grads, axis_name: str = "mp"):
-  """psum every gradient leaf except sharded embedding tables.
+def finalize_hybrid_grads(grads, axis_name: str = "mp"):
+  """Convert in-shard_map autodiff grads to global-batch-mean grads.
 
-  The single-backward hybrid-parallel core: inside shard_map, dense layers
-  compute per-device grads on their batch shard (need summing), while
-  ``mp_table_*`` class params are device-local shards (grads must stay
-  local). The reference needed ``register_local_source``/``register_local_var``
-  Horovod patches for this distinction (`dist_model_parallel.py:715-773`);
-  here it is one tree_map over param paths.
+  The single-backward hybrid-parallel core, TPU-style. With a per-device
+  loss of ``mean(batch_shard)``, autodiff under ``jax.shard_map`` already
+  produces, per leaf:
+
+  - dense (replicated, ``P()``) params: the *psum* of all devices'
+    local-mean grads — shard_map inserts the psum because the transpose of
+    replication is a sum (so do NOT psum again);
+  - ``mp_table_*`` (sharded) params: the local shard's grad, with remote
+    contributions already summed in by the reverse ``all_to_all``.
+
+  Both are ``world_size ×`` the single-device global-batch-mean gradient, so
+  dividing every leaf by the axis size yields grads *numerically identical*
+  to non-distributed training — which is what the reference achieves with
+  ``register_local_var`` + averaging Horovod allreduce
+  (`dist_model_parallel.py:715-773`).
   """
-
-  def maybe_psum(path, g):
-    names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
-    if is_model_parallel_param(names):
-      return g
-    return jax.lax.psum(g, axis_name)
-
-  return jax.tree_util.tree_map_with_path(maybe_psum, grads)
+  scale = 1.0 / jax.lax.axis_size(axis_name)
+  return jax.tree_util.tree_map(lambda g: g * scale, grads)
 
 
 def DistributedOptimizer(optimizer, axis_name: str = "mp"):
   """Wrap an optax optimizer for hybrid parallel in a single backward.
 
   Equivalent of the reference ``DistributedOptimizer``
-  (`dist_model_parallel.py:743-773`): the returned transformation psums
-  data-parallel grads over the mesh axis and applies model-parallel
-  (``mp_table_*``) grads locally. Use inside shard_map.
+  (`dist_model_parallel.py:743-773`): rescales shard_map autodiff grads to
+  the global-batch-mean convention (see :func:`finalize_hybrid_grads`) and
+  applies model-parallel (``mp_table_*``) grads locally. Use inside
+  shard_map with a local-mean loss.
   """
   import optax
 
@@ -332,7 +335,7 @@ def DistributedOptimizer(optimizer, axis_name: str = "mp"):
     return optimizer.init(params)
 
   def update_fn(updates, state, params=None):
-    updates = psum_dense_grads(updates, axis_name)
+    updates = finalize_hybrid_grads(updates, axis_name)
     return optimizer.update(updates, state, params)
 
   return optax.GradientTransformation(init_fn, update_fn)
@@ -343,10 +346,10 @@ def DistributedGradientTape(*args, **kwargs):
   allreduced (data-parallel) grads in one backward
   (`dist_model_parallel.py:715-740`). JAX has no tape: use
   ``jax.value_and_grad`` inside shard_map and pass the grads through
-  :func:`psum_dense_grads` (or use :func:`DistributedOptimizer`)."""
+  :func:`finalize_hybrid_grads` (or use :func:`DistributedOptimizer`)."""
   raise NotImplementedError(
       "JAX has no gradient tape. Use jax.value_and_grad inside shard_map + "
-      "psum_dense_grads / DistributedOptimizer for hybrid parallel.")
+      "finalize_hybrid_grads / DistributedOptimizer for hybrid parallel.")
 
 
 class BroadcastGlobalVariablesCallback:

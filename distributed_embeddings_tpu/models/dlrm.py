@@ -104,11 +104,17 @@ class DLRM(nn.Module):
                       dtype=self.compute_dtype, name="bottom_mlp")
     self.top = MLP(self.top_mlp, dtype=self.compute_dtype, name="top_mlp")
 
-  def __call__(self, numerical, categorical):
+  def __call__(self, numerical, categorical, emb_acts=None):
     """numerical [B, num_numerical]; categorical: list of [B] int ids (or
-    the packed dict in mp-input mode). Returns [B] logits."""
+    the packed dict in mp-input mode). Returns [B] logits.
+
+    ``emb_acts`` overrides the embedding lookup with precomputed activations
+    (the sparse-gradient training path computes them outside autodiff; see
+    ``training.make_sparse_train_step``).
+    """
     bottom_out = self.bottom(numerical.astype(self.compute_dtype))
-    emb_outs = self.embeddings(categorical)
+    emb_outs = emb_acts if emb_acts is not None \
+        else self.embeddings(categorical)
     emb_outs = [e.astype(self.compute_dtype) for e in emb_outs]
     x = dot_interact(bottom_out, emb_outs)
     logit = self.top(x.astype(self.compute_dtype))

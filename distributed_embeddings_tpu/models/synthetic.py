@@ -222,8 +222,9 @@ class SyntheticModel(nn.Module):
     self.mlp = MLP(tuple(self.config.mlp_sizes) + (1,),
                    dtype=self.compute_dtype, name="mlp")
 
-  def __call__(self, numerical, cat_features):
-    outs = self.embeddings(cat_features)
+  def __call__(self, numerical, cat_features, emb_acts=None):
+    outs = emb_acts if emb_acts is not None \
+        else self.embeddings(cat_features)
     x = jnp.concatenate([o.astype(self.compute_dtype) for o in outs], axis=1)
     if self.config.interact_stride is not None:
       # strided average pooling over the concatenated feature axis emulates a

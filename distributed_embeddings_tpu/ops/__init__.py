@@ -1,13 +1,29 @@
 """Embedding lookup ops."""
 
 from .embedding_lookup import csr_lookup, embedding_lookup, sparse_dedup_grad
+from .pallas_lookup import multihot_lookup
 from .ragged import RaggedIds, SparseIds, row_to_split
+from .sparse_grad import (
+    SparseOptimizer,
+    SparseRows,
+    dedup_rows,
+    sparse_adagrad,
+    sparse_optimizer,
+    sparse_sgd,
+)
 
 __all__ = [
     "csr_lookup",
     "embedding_lookup",
+    "multihot_lookup",
     "sparse_dedup_grad",
     "RaggedIds",
     "SparseIds",
     "row_to_split",
+    "SparseOptimizer",
+    "SparseRows",
+    "dedup_rows",
+    "sparse_adagrad",
+    "sparse_optimizer",
+    "sparse_sgd",
 ]

@@ -184,7 +184,8 @@ class DistributedLookup:
 
   # ---- full forward ------------------------------------------------------
   def forward(self, class_params: Dict[str, jax.Array],
-              inputs: Sequence[jax.Array]) -> List[jax.Array]:
+              inputs: Sequence[jax.Array],
+              return_residuals: bool = False):
     """Distributed lookup for data-parallel inputs.
 
     Args:
@@ -192,9 +193,14 @@ class DistributedLookup:
         [1, rows, width] when world == 1).
       inputs: per global input, [B_local] or [B_local, H] int ids
         (PAD_ID entries ignored).
+      return_residuals: also return the post-exchange local id tensors
+        (``(key, hotness) -> [n_bucket, G, H]``) for
+        :meth:`backward_sparse` — the saved-ids residual of the reference
+        backward, avoiding a second dp->mp id exchange.
 
     Returns:
-      Per global input, [B_local, table_width] activations, input order.
+      Per global input, [B_local, table_width] activations, input order;
+      with ``return_residuals``, ``(outputs, residuals)``.
     """
     plan = self.plan
     world = plan.world_size
@@ -209,6 +215,7 @@ class DistributedLookup:
 
     hotness_of = lambda input_id: inputs[input_id].shape[1]  # noqa: E731
     received: Dict[tuple, jax.Array] = {}
+    residuals: Dict[tuple, jax.Array] = {}
     for key in plan.class_keys:
       table_local = self._squeeze_local(class_params[class_param_name(*key)])
       for bucket in hotness_buckets(plan, key, hotness_of):
@@ -221,6 +228,7 @@ class DistributedLookup:
           y = x
         # global-batch-major ids for my local class buffer
         ids_all = jnp.transpose(y, (1, 0, 2, 3)).reshape(n_b, world * b, h)
+        residuals[(key, h)] = ids_all
         z = self._local_lookup(key, table_local, ids_all)  # [n_b, G, w]
         z = z.reshape(n_b, world, b, -1).transpose(1, 0, 2, 3)
         if world > 1:
@@ -230,7 +238,113 @@ class DistributedLookup:
           r = z
         received[(key, h)] = r  # [world_owner, n_b, B, w]
 
-    return self._assemble(received, hotness_of)
+    outs = self._assemble(received, hotness_of)
+    if return_residuals:
+      return outs, residuals
+    return outs
+
+  # ---- sparse backward ---------------------------------------------------
+  def backward_sparse(self, d_outs: Sequence[jax.Array],
+                      residuals: Dict[tuple, jax.Array],
+                      hotness: Optional[Sequence[int]] = None
+                      ) -> Dict[str, "SparseRows"]:
+    """Row-sparse embedding gradients from output cotangents.
+
+    The IndexedSlices backward of the reference
+    (`dist_model_parallel.py:449-463` reversed +
+    `embedding_lookup_ops.py:105-122`): splits each input's grad into its
+    column-slice pieces, routes them mp-ward through the reverse
+    ``all_to_all``, expands combiner grads onto individual ids, and
+    sort-dedups per width class. The result touches only looked-up rows —
+    no dense [max_rows, width] gradient ever exists.
+
+    Args:
+      d_outs: per global input, [B_local, table_width] cotangent (same
+        structure :meth:`forward` returns).
+      residuals: the id tensors from ``forward(..., return_residuals=True)``
+        (dp input) or the unpacked ``[n_bucket, G, H]`` blocks from packed
+        mp inputs (see :meth:`mp_residuals`).
+      hotness: per global input id, its static hotness (``input.shape[1]``
+        after normalization; 1 for 1-D inputs). None = all one-hot.
+
+    Returns:
+      class param name -> :class:`SparseRows` over the *local* [max_rows,
+      width] block (apply under the same shard_map as the forward).
+    """
+    from ..ops.sparse_grad import SparseRows, dedup_rows
+
+    plan = self.plan
+    world = plan.world_size
+    if len(d_outs) != plan.num_inputs:
+      raise ValueError(f"Expected {plan.num_inputs} grads, got {len(d_outs)}")
+    b = d_outs[0].shape[0]
+
+    if hotness is None:
+      hotness_of = lambda i: 1  # noqa: E731
+    else:
+      hotness_of = lambda i: hotness[i]  # noqa: E731
+
+    # scatter output grads back into per-(class, hotness) received layout
+    d_received: Dict[tuple, List] = {}
+    for (key, h) in residuals:
+      n_b = next(n for hh, _, n in hotness_buckets(plan, key, hotness_of)
+                 if hh == h)
+      d_received[(key, h)] = [
+          [jnp.zeros((b, key[0]), d_outs[0].dtype) for _ in range(n_b)]
+          for _ in range(world)
+      ]
+    for input_id, pieces in enumerate(plan.output_pieces):
+      col = 0
+      for p in pieces:
+        slots = plan.classes[p.class_key].slots_per_rank[p.rank]
+        h = hotness_of(slots[p.slot].input_id)
+        idx = sum(1 for s in slots[:p.slot] if hotness_of(s.input_id) == h)
+        piece_grad = d_outs[input_id][:, col:col + p.width]
+        d_received[(p.class_key, h)][p.rank][idx] = piece_grad
+        col += p.width
+
+    grads: Dict[str, SparseRows] = {}
+    flat_by_class: Dict[tuple, list] = {}
+    for (key, h), blocks in d_received.items():
+      d_r = jnp.stack([jnp.stack(bl) for bl in blocks])  # [world, n_b, B, w]
+      n_b = d_r.shape[1]
+      if world > 1:
+        # reverse of the mp -> dp output exchange (self-inverse axes)
+        d_zp = lax.all_to_all(d_r, self.axis_name, split_axis=0,
+                              concat_axis=0)
+      else:
+        d_zp = d_r
+      d_z = d_zp.transpose(1, 0, 2, 3).reshape(n_b, world * b, -1)
+      ids_all = residuals[(key, h)]  # [n_b, G, h]
+      cp = plan.classes[key]
+      sentinel = cp.max_rows
+      valid = ids_all < sentinel
+      if cp.combiner == "mean" and h > 1:
+        counts = jnp.sum(valid, axis=2).astype(d_z.dtype)  # [n_b, G]
+        d_z = d_z / jnp.maximum(counts, 1)[..., None]
+      d_rows = jnp.broadcast_to(
+          d_z[:, :, None, :], ids_all.shape + (d_z.shape[-1],))
+      flat_by_class.setdefault(key, []).append(
+          (ids_all.reshape(-1), d_rows.reshape(-1, d_z.shape[-1])))
+
+    for key, parts in flat_by_class.items():
+      ids = jnp.concatenate([p[0] for p in parts])
+      rows = jnp.concatenate([p[1] for p in parts])
+      grads[class_param_name(*key)] = dedup_rows(
+          ids, rows, plan.classes[key].max_rows)
+    return grads
+
+  @staticmethod
+  def mp_residuals(packed_inputs: Dict[str, jax.Array]) -> Dict[tuple, jax.Array]:
+    """Packed mp-input blocks -> the residual dict backward_sparse expects."""
+    res = {}
+    for name, arr in packed_inputs.items():
+      stem, hpart = name.rsplit("_h", 1)
+      width_comb = stem[len("mp_table_w"):]
+      wpart, comb = width_comb.split("_", 1)
+      key = (int(wpart), None if comb == "cat" else comb)
+      res[(key, int(hpart))] = arr[0]
+    return res
 
   def forward_mp(self, class_params: Dict[str, jax.Array],
                  packed_inputs: Dict[str, jax.Array],

@@ -39,8 +39,9 @@ def make_train_step(loss_fn: Callable,
     loss_fn: ``loss_fn(params, *batch) -> scalar`` local loss (mean over the
       device's batch shard).
     optimizer: plain optax transformation; it is wrapped with
-      :func:`DistributedOptimizer` so data-parallel grads are psum'd and
-      model-parallel (``mp_table_*``) grads stay local.
+      :func:`DistributedOptimizer` so all grads are rescaled to the exact
+      global-batch-mean convention (shard_map autodiff already sums across
+      devices) and model-parallel (``mp_table_*``) grads stay local.
     mesh: 1-D device mesh, or None for single-device training.
     params / opt_state: used only to derive partition specs.
     batch_example: pytree with the batch structure (used for specs).
@@ -75,6 +76,126 @@ def make_train_step(loss_fn: Callable,
           batch_specs if isinstance(batch_specs, tuple) else (batch_specs,)),
       out_specs=(pspec, sspec, P()))
   return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
+
+
+def init_sparse_state(params: Any,
+                      dense_optimizer: optax.GradientTransformation,
+                      sparse_opt,
+                      emb_collection: str = "embeddings"):
+  """Optimizer state for :func:`make_sparse_train_step`.
+
+  Returns ``(dense_opt_state, table_state)``: plain optax state over the
+  non-embedding subtree, and per class-param sparse-optimizer state (e.g.
+  adagrad accumulators shaped like the [world, rows, width] class arrays —
+  shard them with :func:`shard_params` alongside the params).
+  """
+  tables = params[emb_collection]
+  dense = {k: v for k, v in params.items() if k != emb_collection}
+  dense_state = dense_optimizer.init(dense)
+  table_state = {name: sparse_opt.init(arr) for name, arr in tables.items()}
+  return dense_state, table_state
+
+
+def make_sparse_train_step(model, plan, loss_fn: Callable,
+                           dense_optimizer: optax.GradientTransformation,
+                           sparse_opt,
+                           mesh: Optional[Mesh],
+                           params: Any,
+                           dense_state: Any,
+                           table_state: Any,
+                           batch_example: Any,
+                           axis_name: str = "mp",
+                           emb_collection: str = "embeddings",
+                           donate: bool = True):
+  """Hybrid-parallel train step with row-sparse embedding updates.
+
+  The IndexedSlices training path of the reference
+  (`dist_model_parallel.py:715-773` + TF sparse optimizer applies), built
+  TPU-natively: the embedding forward runs *outside* autodiff, the single
+  backward produces dense-layer grads plus per-input activation cotangents,
+  and ``DistributedLookup.backward_sparse`` turns those into deduplicated
+  row gradients applied by a :class:`~..ops.sparse_grad.SparseOptimizer`.
+  No dense [rows, width] gradient or optimizer traffic ever exists, so a
+  table's step cost scales with the batch's unique rows, not the vocabulary —
+  the property that makes terabyte tables trainable.
+
+  Args:
+    model: flax module whose ``__call__(numerical, cats, emb_acts=None)``
+      skips its ``DistributedEmbedding`` when ``emb_acts`` is given (DLRM and
+      SyntheticModel do).
+    plan: the embedding's ``DistEmbeddingStrategy``.
+    loss_fn: ``loss_fn(logits, labels) -> scalar`` (local-batch mean).
+    dense_optimizer / sparse_opt: optax transformation for dense params;
+      :class:`SparseOptimizer` for embedding tables.
+    mesh: 1-D device mesh or None.
+    params / dense_state / table_state / batch_example: structure examples
+      for partition specs (``init_sparse_state`` builds the states).
+    emb_collection: params key of the ``DistributedEmbedding`` submodule.
+
+  Returns:
+    ``step(params, dense_state, table_state, numerical, cats, labels) ->
+    (params, dense_state, table_state, loss)``.
+  """
+  from .layers.dist_model_parallel import hybrid_partition_specs
+  from .parallel.lookup_engine import DistributedLookup
+
+  engine = DistributedLookup(plan, dp_input=True, axis_name=axis_name)
+
+  def split(p):
+    return ({k: v for k, v in p.items() if k != emb_collection},
+            p[emb_collection])
+
+  def local_step(params, dense_state, table_state, numerical, cats, labels):
+    dense, tables = split(params)
+    acts, residuals = engine.forward(tables, cats, return_residuals=True)
+
+    def loss_with(dense_p, acts_p):
+      logits = model.apply({"params": {**dense_p, emb_collection: tables}},
+                           numerical, cats, emb_acts=acts_p)
+      return loss_fn(logits, labels)
+
+    loss, (d_dense, d_acts) = jax.value_and_grad(
+        loss_with, argnums=(0, 1))(dense, acts)
+    if mesh is not None:
+      # shard_map autodiff already psums replicated-param grads; a uniform
+      # 1/world rescale (of dense grads AND activation cotangents feeding
+      # the sparse backward) restores exact global-batch-mean semantics —
+      # see layers.dist_model_parallel.finalize_hybrid_grads.
+      scale = 1.0 / jax.lax.axis_size(axis_name)
+      d_dense, d_acts = jax.tree_util.tree_map(
+          lambda g: g * scale, (d_dense, d_acts))
+      loss = jax.lax.pmean(loss, axis_name)
+    updates, dense_state = dense_optimizer.update(d_dense, dense_state, dense)
+    dense = optax.apply_updates(dense, updates)
+
+    hotness = [1 if c.ndim == 1 else c.shape[1] for c in cats]
+    sgrads = engine.backward_sparse(d_acts, residuals, hotness=hotness)
+    new_tables, new_tstate = {}, {}
+    for name, tbl in tables.items():
+      # local blocks arrive as [1, rows, width]; state leaves shaped like the
+      # class array lose the same leading dim, scalars (counts) pass through
+      local_state = jax.tree_util.tree_map(
+          lambda x: x[0] if getattr(x, "ndim", 0) == 3 else x,
+          table_state[name])
+      t2, s2 = sparse_opt.apply(tbl[0], local_state, sgrads[name])
+      new_tables[name] = t2[None]
+      new_tstate[name] = jax.tree_util.tree_map(
+          lambda x: x[None] if getattr(x, "ndim", 0) == 2 else x, s2)
+    params = {**dense, emb_collection: new_tables}
+    return params, dense_state, new_tstate, loss
+
+  if mesh is None:
+    return jax.jit(local_step, donate_argnums=(0, 1, 2) if donate else ())
+
+  pspec = hybrid_partition_specs(params, axis_name)
+  dspec = jax.tree_util.tree_map(lambda _: P(), dense_state)
+  tspec = hybrid_partition_specs(table_state, axis_name)
+  bspec = jax.tree_util.tree_map(lambda _: P(axis_name), batch_example)
+  sharded = shard_map(
+      local_step, mesh=mesh,
+      in_specs=(pspec, dspec, tspec) + tuple(bspec),
+      out_specs=(pspec, dspec, tspec, P()))
+  return jax.jit(sharded, donate_argnums=(0, 1, 2) if donate else ())
 
 
 def make_eval_step(pred_fn: Callable, mesh: Optional[Mesh],
