@@ -80,8 +80,9 @@ def run(batch_size: int) -> float:
         params, dense_state, table_state, *batches[i % 2])
   warm = float(loss)  # force the warmup chain before timing
 
-  # fetch-RTT estimate (subtracted below): time fetching a ready scalar
-  probe = jax.jit(lambda x: x + 1)(jnp.zeros(()))
+  # fetch-RTT estimate (subtracted below): time fetching a ready scalar.
+  # block_until_ready first so compile/dispatch are not counted in the RTT.
+  probe = jax.block_until_ready(jax.jit(lambda x: x + 1)(jnp.zeros(())))
   t0 = time.perf_counter()
   float(probe)
   rtt = time.perf_counter() - t0

@@ -43,10 +43,6 @@ _NSEM = 8  # row DMAs in flight per grid step
 _VMEM_BUDGET = 2 * 1024 * 1024  # staging buffer budget (bytes)
 
 
-def _use_pallas_default() -> bool:
-  return jax.default_backend() == "tpu"
-
-
 def choose_tile_b(batch: int, hotness: int, width: int, dtype) -> int:
   """Samples per grid step.
 

@@ -61,9 +61,15 @@ class RawBinaryCriteoDataset:
                rank: int = 0,
                world_size: int = 1,
                prefetch_depth: int = 10,
-               drop_last_batch: bool = True):
+               drop_last_batch: bool = True,
+               backend: str = "auto"):
+    if backend not in ("auto", "native", "numpy"):
+      raise ValueError(f"backend must be auto|native|numpy, got {backend!r}")
     split = "test" if valid else "train"
     base = os.path.join(data_path, split)
+    self._base = base
+    self._backend = backend
+    self._drop_last = drop_last_batch
     self.batch_size = batch_size
     self.numerical_features = numerical_features
     self.rank, self.world_size = rank, world_size
@@ -129,8 +135,70 @@ class RawBinaryCriteoDataset:
     return numerical, cats, labels
 
   def __iter__(self):
-    """Background-prefetched iteration (reference prefetch thread,
+    """Background-prefetched iteration.
+
+    Uses the native C++ loader (``cc/data_loader.cc``: pread thread pool,
+    in-worker fp16->fp32 and intN->int32 widening) when available; else the
+    numpy memmap path with a prefetch thread (reference prefetch thread,
     `utils.py:262-292`)."""
+    if self._backend != "numpy":
+      it = self._iter_native()
+      if it is not None:
+        yield from it
+        return
+      if self._backend == "native":
+        raise RuntimeError("native data loader unavailable (build failed?)")
+    yield from self._iter_numpy()
+
+  def _iter_native(self):
+    from ..cc import load_data_loader
+    lib = load_data_loader()
+    if lib is None:
+      return None
+    return self._native_batches(lib)
+
+  def _native_batches(self, lib):
+    import ctypes
+
+    n_cat = len(self.categorical_ids)
+    cat_ids = (ctypes.c_int32 * n_cat)(*self.categorical_ids)
+    itemsizes = (ctypes.c_int64 * n_cat)(
+        *[arr.dtype.itemsize for arr in self.categorical])
+    handle = lib.de_loader_open(
+        self._base.encode(), self.numerical_features, n_cat, cat_ids,
+        itemsizes, self.batch_size, self.rank, self.world_size,
+        1 if self._drop_last else 0, self._prefetch_depth,
+        min(8, max(2, self._prefetch_depth)))
+    try:
+      err = lib.de_loader_error(handle)
+      if err:
+        raise RuntimeError(f"native loader: {err.decode()}")
+      lib.de_loader_start(handle)
+      fptr = ctypes.POINTER(ctypes.c_float)
+      iptr = ctypes.POINTER(ctypes.c_int32)
+      while True:
+        numerical = (np.empty((self.batch_size, self.numerical_features),
+                              np.float32)
+                     if self.numerical_features else None)
+        cats = np.empty((n_cat, self.batch_size), np.int32)
+        labels = np.empty(self.batch_size, np.float32)
+        n = lib.de_loader_next(
+            handle,
+            numerical.ctypes.data_as(fptr) if numerical is not None else None,
+            cats.ctypes.data_as(iptr) if n_cat else None,
+            labels.ctypes.data_as(fptr))
+        if n == -2:  # end of epoch (n == 0 is a real, empty per-rank slice)
+          return
+        if n < 0:
+          err = lib.de_loader_error(handle)
+          raise RuntimeError(
+              f"native loader: {err.decode() if err else 'unknown error'}")
+        yield (numerical[:n] if numerical is not None else None,
+               [cats[f, :n] for f in range(n_cat)], labels[:n])
+    finally:
+      lib.de_loader_close(handle)
+
+  def _iter_numpy(self):
     q: queue.Queue = queue.Queue(maxsize=self._prefetch_depth)
     stop = threading.Event()
 

@@ -138,9 +138,6 @@ def test_sparse_step_matches_dense_step_single_device(opt_name):
   np.testing.assert_allclose(float(loss_dense), float(loss_sparse),
                              rtol=1e-5, atol=1e-6)
   flat_d = jax.tree_util.tree_leaves_with_path(p_dense)
-  flat_s = dict(jax.tree_util.tree_leaves_with_path(p_sparse))
-
-  # compare as dict keyed by path string
   flat_s = {jax.tree_util.keystr(k): v
             for k, v in jax.tree_util.tree_leaves_with_path(p_sparse)}
   for k, v in flat_d:
@@ -202,14 +199,15 @@ def test_sparse_step_distributed_matches_single_reference(opt_name):
   for t, (g, w) in enumerate(zip(got_w, want_w)):
     np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-5,
                                err_msg=f"table {t}")
-  # dense layers updated identically too
+  # dense layers updated identically too (every leaf of both MLPs)
   for key in ("bottom_mlp", "top_mlp"):
+    got = {jax.tree_util.keystr(k): v
+           for k, v in jax.tree_util.tree_leaves_with_path(p2[key])}
     for k, v in jax.tree_util.tree_leaves_with_path(ref_after[key]):
-      pass
-  np.testing.assert_allclose(
-      np.asarray(jax.tree_util.tree_leaves(p2["top_mlp"])[0]),
-      np.asarray(jax.tree_util.tree_leaves(ref_after["top_mlp"])[0]),
-      rtol=1e-4, atol=1e-5)
+      ks = jax.tree_util.keystr(k)
+      np.testing.assert_allclose(np.asarray(got[ks]), np.asarray(v),
+                                 rtol=1e-4, atol=1e-5,
+                                 err_msg=f"{key}{ks}")
 
 
 def test_sparse_step_synthetic_multihot():
