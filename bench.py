@@ -6,10 +6,18 @@ global batch 65536 with Adagrad — 24.433 ms
 BASELINE.md). ``vs_baseline > 1`` means this TPU chip beats the A100.
 
 Uses the sparse (IndexedSlices-equivalent) training path
-(``make_sparse_train_step`` + ``sparse_adagrad``): like the reference, only
+(``make_sparse_train_step`` + fused packed tables): like the reference, only
 batch-touched rows see gradient/optimizer HBM traffic — a dense optax step
 on 4.2 GiB of tables would spend ~17 GiB of HBM traffic per step on the
 adagrad accumulator alone (and OOM a 16 GB chip on the dense grad temps).
+
+Memory discipline (16 GB v5e, state alone is 8.4 GiB):
+- the train step is AOT-compiled from abstract shapes BEFORE any big
+  allocation (compile scratch needs headroom);
+- the packed state is drawn directly in its physical layout
+  (``init_sparse_state_direct``) — the [rows, width] tables never exist;
+- on OOM the process re-execs itself at half the batch so retries start
+  with a genuinely empty device.
 
 Timing notes: the TPU is reached through a tunnel whose host<->device fetch
 RTT is ~100 ms, so steps are chained on device (params donation) and a
@@ -28,6 +36,7 @@ import time
 BASELINE_MS = 24.433  # 1xA100, Tiny, batch 65536, Adagrad
 MODEL = os.environ.get("BENCH_MODEL", "tiny")
 BATCH = int(os.environ.get("BENCH_BATCH", 65536))
+CUR_BATCH = int(os.environ.get("BENCH_CUR_BATCH", BATCH))
 STEPS = int(os.environ.get("BENCH_STEPS", 30))
 
 
@@ -45,16 +54,17 @@ def run(batch_size: int) -> float:
       expand_tables,
       generate_batch,
   )
-  from distributed_embeddings_tpu.ops.sparse_grad import sparse_adagrad
+  from distributed_embeddings_tpu.ops.packed_table import adagrad_rule
   from distributed_embeddings_tpu.training import (
-      init_sparse_state,
+      init_sparse_state_direct,
       make_sparse_train_step,
   )
 
   cfg = SYNTHETIC_MODELS[MODEL]
   tables, tmap, hotness = expand_tables(cfg)
   model = SyntheticModel(config=cfg, world_size=1)
-  plan = DistEmbeddingStrategy(tables, 1, "basic", input_table_map=tmap)
+  plan = DistEmbeddingStrategy(tables, 1, "basic", input_table_map=tmap,
+                               dense_row_threshold=model.dense_row_threshold)
 
   batches = []
   for i in range(2):
@@ -66,18 +76,32 @@ def run(batch_size: int) -> float:
             for c, h in zip(cats, hotness)]
     batches.append((jnp.asarray(numerical), cats, jnp.asarray(labels)))
 
-  params = model.init(jax.random.PRNGKey(0), batches[0][0],
-                      batches[0][1])["params"]
   dense_opt = optax.adagrad(0.01)
-  sparse_opt = sparse_adagrad(0.01)
-  dense_state, table_state = init_sparse_state(params, dense_opt, sparse_opt)
+  rule = adagrad_rule(0.01)
 
-  step = make_sparse_train_step(model, plan, bce_loss, dense_opt, sparse_opt,
-                                None, params, dense_state, table_state,
-                                batches[0])
+  # dense (MLP) params only: emb_acts short-circuits the embedding module,
+  # so model.init never creates the 4.2 GiB tables
+  dummy_acts = [jnp.zeros((2, tables[t].output_dim), jnp.float32)
+                for t in tmap]
+  small_cats = [c[:2] for c in batches[0][1]]
+  dense_params = model.init(jax.random.PRNGKey(0), batches[0][0][:2],
+                            small_cats, emb_acts=dummy_acts)["params"]
+
+  # ---- AOT compile from abstract shapes, before the big allocations ------
+  def abstract_state():
+    return init_sparse_state_direct(plan, rule, dense_params, dense_opt,
+                                    jax.random.PRNGKey(1))
+  state_avals = jax.eval_shape(abstract_state)
+  step = make_sparse_train_step(model, plan, bce_loss, dense_opt, rule,
+                                None, state_avals, batches[0])
+  compiled = step.lower(state_avals, *batches[0]).compile()
+
+  # ---- real state, directly in packed layout -----------------------------
+  state = init_sparse_state_direct(plan, rule, dense_params, dense_opt,
+                                   jax.random.PRNGKey(1))
+
   for i in range(3):
-    params, dense_state, table_state, loss = step(
-        params, dense_state, table_state, *batches[i % 2])
+    state, loss = compiled(state, *batches[i % 2])
   warm = float(loss)  # force the warmup chain before timing
 
   # fetch-RTT estimate (subtracted below): time fetching a ready scalar.
@@ -89,8 +113,7 @@ def run(batch_size: int) -> float:
 
   t0 = time.perf_counter()
   for i in range(STEPS):
-    params, dense_state, table_state, loss = step(
-        params, dense_state, table_state, *batches[i % 2])
+    state, loss = compiled(state, *batches[i % 2])
   final = float(loss)  # forces the whole chain through the tunnel
   elapsed = time.perf_counter() - t0 - rtt
   del warm, final
@@ -98,20 +121,17 @@ def run(batch_size: int) -> float:
 
 
 def main():
-  batch = BATCH
-  while True:
-    try:
-      ms = run(batch)
-      break
-    except Exception as e:  # noqa: BLE001 - OOM fallback, report honestly
-      msg = str(e)
-      if ("RESOURCE_EXHAUSTED" in msg or "Ran out of memory" in msg) \
-          and batch > 4096:
-        print(f"# batch {batch} OOM, retrying at {batch // 2}",
-              file=sys.stderr)
-        batch //= 2
-        continue
-      raise
+  batch = CUR_BATCH
+  try:
+    ms = run(batch)
+  except Exception as e:  # noqa: BLE001 - OOM fallback, report honestly
+    msg = str(e)
+    if ("RESOURCE_EXHAUSTED" in msg or "Ran out of memory" in msg) \
+        and batch > 4096:
+      print(f"# batch {batch} OOM, re-exec at {batch // 2}", file=sys.stderr)
+      os.environ["BENCH_CUR_BATCH"] = str(batch // 2)
+      os.execv(sys.executable, [sys.executable] + sys.argv)
+    raise
   # normalize to the baseline's global batch if we had to shrink
   equiv_ms = ms * (BATCH / batch)
   print(json.dumps({

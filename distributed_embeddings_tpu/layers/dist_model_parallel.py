@@ -36,6 +36,7 @@ from ..parallel.lookup_engine import (
     DistributedLookup,
     class_param_name,
     pack_mp_inputs,
+    padded_rows,
 )
 from .embedding import resolve_initializer
 from .planner import DistEmbeddingStrategy
@@ -59,6 +60,7 @@ def make_class_initializer(plan: DistEmbeddingStrategy, key):
   """
   cp = plan.classes[key]
   world = plan.world_size
+  rows = padded_rows(plan, key)
 
   def init(rng, shape, dtype=jnp.float32):
     del shape  # fixed by the plan
@@ -69,11 +71,11 @@ def make_class_initializer(plan: DistEmbeddingStrategy, key):
         rng, sub = jax.random.split(rng)
         fn = resolve_initializer(sh.initializer)
         parts.append(jnp.asarray(fn(sub, (sh.input_dim, cp.width)), dtype))
-      pad = cp.max_rows - cp.rows_per_rank[rank]
+      pad = rows - cp.rows_per_rank[rank]
       if pad:
         parts.append(jnp.zeros((pad, cp.width), dtype))
       blocks.append(jnp.concatenate(parts, axis=0) if parts
-                    else jnp.zeros((cp.max_rows, cp.width), dtype))
+                    else jnp.zeros((rows, cp.width), dtype))
     return jnp.stack(blocks)
 
   return init
@@ -110,6 +112,9 @@ class DistributedEmbedding(nn.Module):
   input_table_map: Optional[Sequence[int]] = None
   world_size: int = 1
   axis_name: str = "mp"
+  # Tables with input_dim <= dense_row_threshold are served by the MXU
+  # one-hot path instead of HBM row gathers (see planner); 0 disables.
+  dense_row_threshold: int = 0
   # dp_input=False only: per global input id, its static hotness (must match
   # what was passed to pack_mp_inputs). None = all one-hot.
   input_hotness: Optional[Sequence[int]] = None
@@ -128,7 +133,8 @@ class DistributedEmbedding(nn.Module):
               list(self.embeddings), self.world_size, self.strategy,
               input_table_map=(list(self.input_table_map)
                                if self.input_table_map is not None else None),
-              column_slice_threshold=self.column_slice_threshold))
+              column_slice_threshold=self.column_slice_threshold,
+              dense_row_threshold=self.dense_row_threshold))
     return self._plan_cache
 
   @nn.compact
@@ -192,7 +198,7 @@ def get_weights(plan: DistEmbeddingStrategy,
   for t, config in enumerate(plan.global_configs):
     col_parts = []
     for rank, shard in plan.table_shard_map(t):
-      key = (shard.width, shard.combiner)
+      key = plan.class_key_of(shard)
       cp = plan.classes[key]
       idx = cp.shards_per_rank[rank].index(shard)
       row0 = cp.row_offsets_per_rank[rank][idx]
@@ -233,7 +239,7 @@ def set_weights(plan: DistEmbeddingStrategy,
 
   def rank_block(key, rank) -> np.ndarray:
     cp = plan.classes[key]
-    block = np.zeros((cp.max_rows, cp.width), np.float32)
+    block = np.zeros((padded_rows(plan, key), cp.width), np.float32)
     for idx, shard in enumerate(cp.shards_per_rank[rank]):
       row0 = cp.row_offsets_per_rank[rank][idx]
       block[row0:row0 + shard.input_dim] = (
@@ -244,7 +250,7 @@ def set_weights(plan: DistEmbeddingStrategy,
   for key in plan.class_keys:
     cp = plan.classes[key]
     name = class_param_name(*key)
-    shape = (plan.world_size, cp.max_rows, cp.width)
+    shape = (plan.world_size, padded_rows(plan, key), cp.width)
     if mesh is None:
       out[name] = np.stack([rank_block(key, r)
                             for r in range(plan.world_size)])

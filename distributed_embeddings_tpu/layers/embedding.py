@@ -35,6 +35,11 @@ Initializer = Callable[[jax.Array, tuple, Any], jax.Array]
 def _keras_uniform(scale=0.05):
   def init(key, shape, dtype=jnp.float32):
     return jax.random.uniform(key, shape, dtype, minval=-scale, maxval=scale)
+  # marker consumed by the direct packed-state initializer
+  # (training.init_sparse_state_direct): uniform(-scale, scale) can be
+  # generated straight into the packed physical layout without ever
+  # materializing the [rows, width] logical table
+  init.scale = scale
   return init
 
 

@@ -35,7 +35,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from .embedding import Embedding, TableConfig
 
-ClassKey = Tuple[int, Optional[str]]  # (width, combiner)
+# (width, combiner, kind) — kind is 'sparse' (row-gather path) or 'dense'
+# (small-vocab MXU one-hot path; see DistEmbeddingStrategy.dense_row_threshold)
+ClassKey = Tuple[int, Optional[str], str]
 
 
 @dataclasses.dataclass
@@ -80,6 +82,7 @@ class WidthClassPlan:
 
   width: int
   combiner: Optional[str]
+  kind: str  # 'sparse' | 'dense'
   shards_per_rank: List[List[Shard]]
   row_offsets_per_rank: List[List[int]]
   rows_per_rank: List[int]
@@ -223,11 +226,19 @@ class DistEmbeddingStrategy:
                world_size: int,
                strategy: str = "basic",
                input_table_map: Optional[Sequence[int]] = None,
-               column_slice_threshold: Optional[int] = None):
+               column_slice_threshold: Optional[int] = None,
+               dense_row_threshold: int = 0):
     if strategy not in ("basic", "memory_balanced", "memory_optimized"):
       raise ValueError(f"Unsupported shard strategy {strategy}")
     self.strategy = "basic" if world_size == 1 else strategy
     self.world_size = world_size
+    # Tables with input_dim <= dense_row_threshold are served by the MXU
+    # one-hot-matmul path (zero indexed row ops, dense autodiff grads)
+    # instead of HBM row gathers; 0 disables. On v5e every gathered/scattered
+    # row costs ~8-23ns regardless of width, so small tables are strictly
+    # cheaper as matmuls (the TPU answer to the reference's
+    # ConcatOneHotEmbedding, `embedding.py:155-180`).
+    self.dense_row_threshold = dense_row_threshold
     self.global_configs = _normalize_configs(embeddings)
     num_tables = len(self.global_configs)
     if input_table_map is None:
@@ -296,14 +307,14 @@ class DistEmbeddingStrategy:
     class_keys: List[ClassKey] = []
     for shards in self.rank_shards:
       for sh in shards:
-        key = (sh.width, sh.combiner)
+        key = self.class_key_of(sh)
         if key not in class_keys:
           class_keys.append(key)
-    class_keys.sort(key=lambda k: (k[0], str(k[1])))
+    class_keys.sort(key=lambda k: (k[0], str(k[1]), k[2]))
     self.class_keys = class_keys
 
     self.classes: Dict[ClassKey, WidthClassPlan] = {
-        key: WidthClassPlan(width=key[0], combiner=key[1],
+        key: WidthClassPlan(width=key[0], combiner=key[1], kind=key[2],
                             shards_per_rank=[[] for _ in range(world_size)],
                             row_offsets_per_rank=[[] for _ in range(world_size)],
                             rows_per_rank=[0] * world_size,
@@ -319,16 +330,17 @@ class DistEmbeddingStrategy:
     ]
 
     for rank, shards in enumerate(self.rank_shards):
-      # fuse: row-concat shards of equal (width, combiner) in local order
+      # fuse: row-concat shards of equal (width, combiner, kind) in local order
       for sh in shards:
-        plan = self.classes[(sh.width, sh.combiner)]
+        plan = self.classes[self.class_key_of(sh)]
         plan.shards_per_rank[rank].append(sh)
         plan.row_offsets_per_rank[rank].append(plan.rows_per_rank[rank])
         plan.rows_per_rank[rank] += sh.input_dim
 
       rank_input_ids: List[int] = []
       for sh in shards:
-        plan = self.classes[(sh.width, sh.combiner)]
+        key = self.class_key_of(sh)
+        plan = self.classes[key]
         idx_in_rank = plan.shards_per_rank[rank].index(sh)
         row_offset = plan.row_offsets_per_rank[rank][idx_in_rank]
         for input_id, mapped_table in enumerate(self.input_table_map):
@@ -337,7 +349,7 @@ class DistEmbeddingStrategy:
             slot = ClassSlot(input_id=input_id, row_offset=row_offset, shard=sh)
             plan.slots_per_rank[rank].append(slot)
             self.output_pieces[input_id].append(
-                OutputPiece(class_key=(sh.width, sh.combiner), rank=rank,
+                OutputPiece(class_key=key, rank=rank,
                             slot=len(plan.slots_per_rank[rank]) - 1,
                             width=sh.width, col_start=sh.col_start))
       self.input_ids_list.append(rank_input_ids)
@@ -396,6 +408,11 @@ class DistEmbeddingStrategy:
     ]
 
   # ---- convenience -------------------------------------------------------
+  def class_key_of(self, shard: Shard) -> ClassKey:
+    kind = ("dense" if shard.input_dim <= self.dense_row_threshold
+            else "sparse")
+    return (shard.width, shard.combiner, kind)
+
   def table_shard_map(self, table_id: int) -> List[Tuple[int, Shard]]:
     """All (rank, shard) holding columns of ``table_id``, in column order."""
     entries = []

@@ -83,6 +83,8 @@ class DLRM(nn.Module):
   column_slice_threshold: Optional[int] = None
   dp_input: bool = True
   compute_dtype: Any = jnp.float32
+  # small-vocab tables ride the MXU one-hot path (see planner)
+  dense_row_threshold: int = 2048
 
   def setup(self):
     if self.bottom_mlp[-1] != self.embedding_dim:
@@ -99,6 +101,7 @@ class DLRM(nn.Module):
         column_slice_threshold=self.column_slice_threshold,
         dp_input=self.dp_input,
         world_size=self.world_size,
+        dense_row_threshold=self.dense_row_threshold,
         name="embeddings")
     self.bottom = MLP(self.bottom_mlp, activate_final=True,
                       dtype=self.compute_dtype, name="bottom_mlp")
@@ -123,7 +126,8 @@ class DLRM(nn.Module):
 
 def dlrm_embedding_plan(vocab_sizes, embedding_dim: int = 128,
                         world_size: int = 1, strategy: str = "basic",
-                        column_slice_threshold: Optional[int] = None):
+                        column_slice_threshold: Optional[int] = None,
+                        dense_row_threshold: int = 2048):
   """The placement plan a :class:`DLRM`'s embeddings use (for
   get_weights/set_weights on the ``embeddings`` param subtree)."""
   from ..layers.planner import DistEmbeddingStrategy
@@ -131,7 +135,8 @@ def dlrm_embedding_plan(vocab_sizes, embedding_dim: int = 128,
   tables = [TableConfig(input_dim=int(v), output_dim=embedding_dim)
             for v in vocab_sizes]
   return DistEmbeddingStrategy(tables, world_size, strategy,
-                               column_slice_threshold=column_slice_threshold)
+                               column_slice_threshold=column_slice_threshold,
+                               dense_row_threshold=dense_row_threshold)
 
 
 def _dlrm_initializer(rows: int):
@@ -142,6 +147,7 @@ def _dlrm_initializer(rows: int):
   def init(key, shape, dtype=jnp.float32):
     return jax.random.uniform(key, shape, dtype, minval=-scale, maxval=scale)
 
+  init.scale = scale  # enables direct packed init (init_sparse_state_direct)
   return init
 
 

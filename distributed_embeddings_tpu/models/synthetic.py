@@ -207,6 +207,8 @@ class SyntheticModel(nn.Module):
   column_slice_threshold: Optional[int] = None
   dp_input: bool = True
   compute_dtype: Any = jnp.float32
+  # small-vocab tables ride the MXU one-hot path (see planner)
+  dense_row_threshold: int = 2048
 
   def setup(self):
     tables, input_table_map, self._hotness = expand_tables(self.config)
@@ -218,6 +220,7 @@ class SyntheticModel(nn.Module):
         input_table_map=tuple(input_table_map),
         world_size=self.world_size,
         input_hotness=None if self.dp_input else tuple(self._hotness),
+        dense_row_threshold=self.dense_row_threshold,
         name="embeddings")
     self.mlp = MLP(tuple(self.config.mlp_sizes) + (1,),
                    dtype=self.compute_dtype, name="mlp")

@@ -2,6 +2,15 @@
 
 from .embedding_lookup import csr_lookup, embedding_lookup, sparse_dedup_grad
 from .pallas_lookup import multihot_lookup
+from .packed_table import (
+    PackedLayout,
+    SparseRule,
+    adagrad_rule,
+    gather_fused,
+    scatter_add_fused,
+    sgd_rule,
+    sparse_rule,
+)
 from .ragged import RaggedIds, SparseIds, row_to_split
 from .sparse_grad import (
     SparseOptimizer,
@@ -17,6 +26,13 @@ __all__ = [
     "embedding_lookup",
     "multihot_lookup",
     "sparse_dedup_grad",
+    "PackedLayout",
+    "SparseRule",
+    "adagrad_rule",
+    "gather_fused",
+    "scatter_add_fused",
+    "sgd_rule",
+    "sparse_rule",
     "RaggedIds",
     "SparseIds",
     "row_to_split",

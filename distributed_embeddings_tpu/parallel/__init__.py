@@ -1,10 +1,12 @@
 """Mesh, collectives, and the distributed lookup engine."""
 
 from .lookup_engine import (
+    Bucket,
     DistributedLookup,
+    class_buckets,
     class_param_name,
-    hotness_buckets,
     pack_mp_inputs,
+    padded_rows,
     ragged_to_padded,
 )
 from .mesh import (
@@ -16,10 +18,12 @@ from .mesh import (
 )
 
 __all__ = [
+    "Bucket",
     "DistributedLookup",
+    "class_buckets",
     "class_param_name",
-    "hotness_buckets",
     "pack_mp_inputs",
+    "padded_rows",
     "ragged_to_padded",
     "DEFAULT_AXIS",
     "batch_sharding",

@@ -9,68 +9,140 @@ TPU-native re-design of the reference's ``DistributedEmbedding._call_base``
                                              on a uniform [world, slots, B, H]
                                              routing tensor (slot/hotness
                                              padding with a sentinel id)
-  per-rank Python loop over local            one gather + segment-reduce over
-  Embedding layers (different code           the rank's width-class buffer
-  on every rank)                             [max_rows, width] — identical XLA
-                                             code on every device
+  per-rank Python loop over local            two uniform local paths:
+  Embedding layers (different code           * sparse classes: one fused-row
+  on every rank)                               gather over the rank's packed
+                                               class buffer (ops/packed_table)
+                                             * dense classes (small vocab):
+                                               windowed one-hot MXU matmuls —
+                                               zero indexed row ops
   hvd.alltoall(outputs)                  ->  lax.all_to_all back
   reorder via rev_global_input_ids       ->  static piece-indexed reassembly
                                              (handles column-slice re-concat)
 
+Performance model (measured, v5e): indexed row ops cost ~8 ns/row gathered
+and ~23 ns/row scattered regardless of row bytes, and ``sort_key_val`` is
+~200 ns/element. The engine therefore (1) serves small-vocab tables from the
+MXU (no rows touched), (2) stores sparse tables lane-packed with optimizer
+state interleaved so one gather feeds the forward AND the optimizer read,
+and one scatter-add applies the whole update (`ops/packed_table.py`), and
+(3) keeps the sort-based exact dedup (the reference's CUB pipeline,
+`embedding_lookup_kernels.cu:464-633`) as an opt-in ``exact=True`` path.
+
 Uneven all-to-all splits (the reference's hardest comm case, SURVEY §5) are
-made uniform by padding each width class to its max slot count and max
-hotness; padded entries carry ``sentinel = max_rows`` and a gather with
-``mode='fill', fill_value=0`` makes them contribute nothing — forward or
-backward (scatter drops out-of-range). All shapes static, fully jit/grad
-compatible; ``shard_map`` differentiates through ``all_to_all`` natively,
-which is what replaces the reference's ~100 lines of Horovod tape patching.
+made uniform by padding each width class to its max slot count and bucketing
+by hotness; padded entries carry a sentinel id and contribute nothing in
+either direction. All shapes static, fully jit/grad compatible; ``shard_map``
+differentiates through ``all_to_all`` natively, which is what replaces the
+reference's ~100 lines of Horovod tape patching.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+import dataclasses
+from typing import Dict, List, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..layers.planner import DistEmbeddingStrategy
+from ..ops.packed_table import (
+    PackedLayout,
+    SparseRule,
+    gather_fused,
+    gather_fused_chunked,
+    scatter_add_fused,
+)
 from ..ops.ragged import RaggedIds
 
 PAD_ID = -1  # marks hotness padding in dense-padded ragged inputs
 
 
-def class_param_name(width: int, combiner: Optional[str]) -> str:
-  return f"mp_table_w{width}_{combiner if combiner else 'cat'}"
+def class_param_name(width: int, combiner: Optional[str],
+                     kind: str = "sparse") -> str:
+  base = f"mp_table_w{width}_{combiner if combiner else 'cat'}"
+  return base if kind == "sparse" else base + "_dense"
 
 
-def hotness_buckets(plan: DistEmbeddingStrategy, key, hotness_of):
-  """Split a width class's slots into static hotness buckets.
+def vocab_cap(n: int) -> int:
+  """Static one-hot window size for a dense-class slot: pow2, >= 8."""
+  cap = 8
+  while cap < n:
+    cap *= 2
+  return cap
 
-  Inputs of different hotness in the same width class would otherwise pad to
-  the class max (e.g. the synthetic Tiny model mixes 1-hot and 10-hot inputs
-  of the same width -> 10x wasted gather and all_to_all volume). Each bucket
-  becomes its own routing tensor with exact hotness.
 
-  Args:
-    plan: the strategy.
-    key: (width, combiner) class key.
-    hotness_of: input_id -> static hotness.
+class Bucket(NamedTuple):
+  """Slots of one class sharing (hotness, one-hot window size)."""
 
-  Returns:
-    list of (hotness, per-rank lists of slot indices into
-    ``classes[key].slots_per_rank[rank]``, padded slot count).
+  h: int
+  vcap: int  # 0 for sparse classes
+  slot_idx_per_rank: tuple  # per rank, indices into slots_per_rank[rank]
+  n_b: int  # padded slot count (max over ranks)
+
+
+class BucketKey(NamedTuple):
+  """Sortable dict key for one (class, hotness, vocab-window) bucket.
+
+  These keys live in dicts that cross jit/autodiff boundaries, where JAX
+  sorts dict keys during pytree flattening; ``combiner=None`` is encoded as
+  ``""`` so keys stay totally ordered when same-width classes mix a None
+  and a string combiner."""
+
+  width: int
+  combiner: str  # "" encodes combiner=None
+  kind: str
+  h: int
+  vcap: int
+
+  @property
+  def class_key(self):
+    return (self.width, self.combiner or None, self.kind)
+
+
+def bucket_key(class_key, h: int, vcap: int) -> BucketKey:
+  w, c, kind = class_key
+  return BucketKey(w, c or "", kind, h, vcap)
+
+
+def class_buckets(plan: DistEmbeddingStrategy, key, hotness_of) -> List[Bucket]:
+  """Split a class's slots into static (hotness, vocab-window) buckets.
+
+  Inputs of different hotness in one class would otherwise pad to the class
+  max (e.g. the synthetic Tiny model mixes 1-hot and 10-hot inputs of the
+  same width -> 10x wasted gather and all_to_all volume); dense-class slots
+  of very different vocab would pad the one-hot window to the class max.
   """
   cp = plan.classes[key]
-  hs = sorted({hotness_of(slot.input_id)
-               for slots in cp.slots_per_rank for slot in slots})
+  dense = cp.kind == "dense"
+
+  def bkey(slot):
+    return (hotness_of(slot.input_id),
+            vocab_cap(slot.shard.input_dim) if dense else 0)
+
+  keys = sorted({bkey(s) for slots in cp.slots_per_rank for s in slots})
   buckets = []
-  for h in hs:
-    per_rank = [[i for i, s in enumerate(slots)
-                 if hotness_of(s.input_id) == h]
-                for slots in cp.slots_per_rank]
-    buckets.append((h, per_rank, max(len(i) for i in per_rank)))
+  for h, vcap_ in keys:
+    per_rank = tuple(
+        tuple(i for i, s in enumerate(slots) if bkey(s) == (h, vcap_))
+        for slots in cp.slots_per_rank)
+    buckets.append(Bucket(h, vcap_, per_rank,
+                          max(len(i) for i in per_rank)))
   return buckets
+
+
+def padded_rows(plan: DistEmbeddingStrategy, key) -> int:
+  """Buffer rows for a class: max fused rows, plus for dense classes enough
+  tail padding that every slot's one-hot window fits inside the buffer."""
+  cp = plan.classes[key]
+  rows = cp.max_rows
+  if cp.kind == "dense":
+    for slots in cp.slots_per_rank:
+      for s in slots:
+        rows = max(rows, s.row_offset + vocab_cap(s.shard.input_dim))
+  return rows
 
 
 def ragged_to_padded(ids: RaggedIds, max_hot: int) -> jax.Array:
@@ -99,46 +171,105 @@ def _normalize_input(x) -> jax.Array:
   return x.astype(jnp.int32)
 
 
-class DistributedLookup:
-  """Functional forward engine bound to one :class:`DistEmbeddingStrategy`.
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class SparseResiduals:
+  """Forward-saved state for the fused sparse backward: post-exchange ids and
+  the optimizer-state rows that rode along in the forward gather."""
 
-  Call :meth:`forward` inside ``shard_map`` (world > 1) with each class param
-  passed as the local block ``[1, max_rows, width]``, or anywhere when
-  world == 1. Gradients flow through to the class params (locally, no
-  collective — the hybrid-parallel property) and through ``all_to_all`` to
-  nothing (ids are integers).
+  ids_all: Dict[tuple, jax.Array]  # bk -> [n_b, G, h]
+  aux_rows: Dict[tuple, jax.Array]  # bk -> [n_b, G, h, n_aux*w] (may be empty)
+
+  def tree_flatten(self):
+    ik = sorted(self.ids_all)
+    ak = sorted(self.aux_rows)
+    return (tuple(self.ids_all[k] for k in ik)
+            + tuple(self.aux_rows[k] for k in ak)), (tuple(ik), tuple(ak))
+
+  @classmethod
+  def tree_unflatten(cls, aux, children):
+    ik, ak = aux
+    return cls(ids_all=dict(zip(ik, children[:len(ik)])),
+               aux_rows=dict(zip(ak, children[len(ik):])))
+
+
+class DistributedLookup:
+  """Functional lookup engine bound to one :class:`DistEmbeddingStrategy`.
+
+  Call the methods inside ``shard_map`` (world > 1) with each class param
+  passed as the local block ``[1, rows, width]`` (simple layout) or
+  ``[1, phys_rows, phys_width]`` (fused layout), or anywhere when world == 1.
+
+  Two layouts/paths:
+
+  - **simple** (:meth:`forward`): class params ``[world, rows, width]``;
+    fully differentiable (XLA autodiff produces dense table grads). Used by
+    the flax module, tests, eval, and small models.
+  - **fused** (:meth:`forward_fused` / :meth:`apply_sparse`): sparse-class
+    params packed with optimizer-state rows (`ops/packed_table.py`); the
+    performance training path — forward gathers carry the optimizer state,
+    backward is one scatter-add per class.
   """
 
   def __init__(self, plan: DistEmbeddingStrategy, dp_input: bool = True,
-               axis_name: str = "mp"):
+               axis_name: str = "mp", apply_chunk: int = 1 << 18):
     self.plan = plan
     self.dp_input = dp_input
     self.axis_name = axis_name
+    # occurrences per scatter chunk in apply_sparse (bounds the backward's
+    # lane-expansion temporaries; exposed mainly so tests can exercise the
+    # multi-chunk path at small sizes)
+    self.apply_chunk = apply_chunk
+    # trace-time caches keyed by (class key, per-slot hotness signature):
+    # bucket enumeration is pure Python over every slot and would otherwise
+    # rerun per bucket lookup on each trace (quadratic on big models)
+    self._bucket_cache: Dict[tuple, List[Bucket]] = {}
+    self._slot_map_cache: Dict[tuple, Dict[tuple, tuple]] = {}
 
   # ---- shapes ------------------------------------------------------------
   def param_shapes(self) -> Dict[str, tuple]:
+    """Simple-layout class param shapes (flax module / checkpoint view)."""
     shapes = {}
     for key in self.plan.class_keys:
       cp = self.plan.classes[key]
       shapes[class_param_name(*key)] = (
-          self.plan.world_size, cp.max_rows, cp.width)
+          self.plan.world_size, padded_rows(self.plan, key), cp.width)
     return shapes
 
+  def fused_layouts(self, rule: SparseRule) -> Dict[str, PackedLayout]:
+    """Per sparse-class :class:`PackedLayout` under ``rule`` (n_aux slots)."""
+    layouts = {}
+    for key in self.plan.class_keys:
+      cp = self.plan.classes[key]
+      if cp.kind != "sparse":
+        continue
+      layouts[class_param_name(*key)] = PackedLayout(
+          rows=padded_rows(self.plan, key), width=cp.width, n_aux=rule.n_aux)
+    return layouts
+
   # ---- dp-side routing ---------------------------------------------------
-  def _build_routing(self, key, bucket, inputs: Sequence[jax.Array]
-                     ) -> jax.Array:
-    """[world, n_bucket, B_local, h] routing tensor for one hotness bucket."""
+  def _my_rank(self):
+    if self.plan.world_size == 1:
+      return 0
+    return lax.axis_index(self.axis_name)
+
+  def _build_routing(self, key, bucket: Bucket,
+                     inputs: Sequence[jax.Array]) -> jax.Array:
+    """[world, n_b, B_local, h] routing tensor for one bucket.
+
+    Sentinel (= buffer row count) marks padded slots and PAD_ID entries; for
+    dense-class slots ids stay slot-local *plus row_offset* exactly like
+    sparse ones — the lookup subtracts the offset again inside its window."""
     cp = self.plan.classes[key]
     world = self.plan.world_size
-    sentinel = cp.max_rows
-    h, slot_idx_per_rank, n_b = bucket
+    sentinel = padded_rows(self.plan, key)
     b = inputs[0].shape[0]
-    pad_block = jnp.full((b, h), sentinel, jnp.int32)
+    pad_block = jnp.full((b, bucket.h), sentinel, jnp.int32)
     per_dest = []
     for rank in range(world):
-      idxs = slot_idx_per_rank[rank]
+      idxs = bucket.slot_idx_per_rank[rank]
       per_slot = []
-      for k in range(n_b):
+      for k in range(bucket.n_b):
         if k < len(idxs):
           slot = cp.slots_per_rank[rank][idxs[k]]
           ids = inputs[slot.input_id]
@@ -151,56 +282,13 @@ class DistributedLookup:
       per_dest.append(jnp.stack(per_slot))
     return jnp.stack(per_dest)
 
-  # ---- mp-side local lookup ----------------------------------------------
-  def _local_lookup(self, key, table_local: jax.Array,
-                    ids_all: jax.Array) -> jax.Array:
-    """ids_all [n_c, G, H] over local [max_rows, width] -> [n_c, G, width]."""
-    cp = self.plan.classes[key]
-    sentinel = cp.max_rows
-    rows = jnp.take(table_local, ids_all, axis=0, mode="fill",
-                    fill_value=0)  # [n_c, G, H, w]
-    if cp.combiner is None and ids_all.shape[-1] != 1:
-      raise ValueError("combiner=None requires hotness-1 inputs in the "
-                       "distributed path (2-D model-parallel outputs)")
-    if ids_all.shape[-1] == 1:
-      # hotness-1 fast path: sum/mean of one row (0 for padded slots) is the
-      # row itself
-      return rows[:, :, 0, :]
-    summed = jnp.sum(rows, axis=2)
-    if cp.combiner == "mean":
-      counts = jnp.sum(ids_all < sentinel, axis=2).astype(summed.dtype)
-      summed = summed / jnp.maximum(counts, 1)[..., None]
-    return summed
+  def route_ids(self, inputs: Sequence[jax.Array],
+                hotness_of=None) -> Dict[tuple, jax.Array]:
+    """dp->mp id exchange: per bucket, global-batch ids for my local tables.
 
-  @staticmethod
-  def _squeeze_local(p: jax.Array) -> jax.Array:
-    if p.ndim != 3:
-      raise ValueError(f"class param must be 3-D [shards, rows, width], got {p.shape}")
-    if p.shape[0] != 1:
-      raise ValueError(
-          "expected the local block of a class param (leading dim 1); pass "
-          "params through shard_map with PartitionSpec('mp', None, None)")
-    return p[0]
-
-  # ---- full forward ------------------------------------------------------
-  def forward(self, class_params: Dict[str, jax.Array],
-              inputs: Sequence[jax.Array],
-              return_residuals: bool = False):
-    """Distributed lookup for data-parallel inputs.
-
-    Args:
-      class_params: name -> [1, max_rows, width] local block (or
-        [1, rows, width] when world == 1).
-      inputs: per global input, [B_local] or [B_local, H] int ids
-        (PAD_ID entries ignored).
-      return_residuals: also return the post-exchange local id tensors
-        (``(key, hotness) -> [n_bucket, G, H]``) for
-        :meth:`backward_sparse` — the saved-ids residual of the reference
-        backward, avoiding a second dp->mp id exchange.
-
-    Returns:
-      Per global input, [B_local, table_width] activations, input order;
-      with ``return_residuals``, ``(outputs, residuals)``.
+    Returns ``bk -> [n_b, G, h]`` (bk = (class_key, h, vcap)); G = world * B.
+    The all_to_all here is the reference's first Horovod exchange
+    (`dist_model_parallel.py:414-423`) with splits made uniform by padding.
     """
     plan = self.plan
     world = plan.world_size
@@ -212,208 +300,467 @@ class DistributedLookup:
       if x.shape[0] != b:
         raise ValueError("All inputs need the same batch size "
                          f"(got {x.shape[0]} vs {b}).")
+    if hotness_of is None:
+      hotness_of = lambda i: inputs[i].shape[1]  # noqa: E731
 
-    hotness_of = lambda input_id: inputs[input_id].shape[1]  # noqa: E731
-    received: Dict[tuple, jax.Array] = {}
-    residuals: Dict[tuple, jax.Array] = {}
+    ids_all: Dict[tuple, jax.Array] = {}
     for key in plan.class_keys:
-      table_local = self._squeeze_local(class_params[class_param_name(*key)])
-      for bucket in hotness_buckets(plan, key, hotness_of):
-        h, _, n_b = bucket
+      for bucket in self._buckets(key, hotness_of):
         x = self._build_routing(key, bucket, inputs)  # [world, n_b, B, h]
         if world > 1:
-          # dp -> mp: exchange id blocks over ICI
           y = lax.all_to_all(x, self.axis_name, split_axis=0, concat_axis=0)
         else:
           y = x
-        # global-batch-major ids for my local class buffer
-        ids_all = jnp.transpose(y, (1, 0, 2, 3)).reshape(n_b, world * b, h)
-        residuals[(key, h)] = ids_all
-        z = self._local_lookup(key, table_local, ids_all)  # [n_b, G, w]
-        z = z.reshape(n_b, world, b, -1).transpose(1, 0, 2, 3)
-        if world > 1:
-          # mp -> dp: return activations to their batch owners
-          r = lax.all_to_all(z, self.axis_name, split_axis=0, concat_axis=0)
-        else:
-          r = z
-        received[(key, h)] = r  # [world_owner, n_b, B, w]
+        ids_all[bucket_key(key, bucket.h, bucket.vcap)] = (
+            jnp.transpose(y, (1, 0, 2, 3)).reshape(
+                bucket.n_b, world * b, bucket.h))
+    return ids_all
 
-    outs = self._assemble(received, hotness_of)
-    if return_residuals:
-      return outs, residuals
-    return outs
+  # ---- mp-side local lookups ---------------------------------------------
+  def _combine(self, rows: jax.Array, ids_all: jax.Array, key) -> jax.Array:
+    """[n_b, G, h, w] gathered rows -> [n_b, G, w] via the class combiner."""
+    cp = self.plan.classes[key]
+    sentinel = padded_rows(self.plan, key)
+    if cp.combiner is None and ids_all.shape[-1] != 1:
+      raise ValueError("combiner=None requires hotness-1 inputs in the "
+                       "distributed path (2-D model-parallel outputs)")
+    if ids_all.shape[-1] == 1:
+      return rows[:, :, 0, :]
+    summed = jnp.sum(rows, axis=2)
+    if cp.combiner == "mean":
+      counts = jnp.sum(ids_all < sentinel, axis=2).astype(summed.dtype)
+      summed = summed / jnp.maximum(counts, 1)[..., None]
+    return summed
 
-  # ---- sparse backward ---------------------------------------------------
-  def backward_sparse(self, d_outs: Sequence[jax.Array],
-                      residuals: Dict[tuple, jax.Array],
-                      hotness: Optional[Sequence[int]] = None
-                      ) -> Dict[str, "SparseRows"]:
-    """Row-sparse embedding gradients from output cotangents.
+  def _z_sparse_simple(self, key, table_local: jax.Array,
+                       ids_all: jax.Array) -> jax.Array:
+    """Differentiable gather path on the simple [rows, w] buffer."""
+    rows = jnp.take(table_local, ids_all, axis=0, mode="fill", fill_value=0)
+    return self._combine(rows, ids_all, key)
 
-    The IndexedSlices backward of the reference
-    (`dist_model_parallel.py:449-463` reversed +
-    `embedding_lookup_ops.py:105-122`): splits each input's grad into its
-    column-slice pieces, routes them mp-ward through the reverse
-    ``all_to_all``, expands combiner grads onto individual ids, and
-    sort-dedups per width class. The result touches only looked-up rows —
-    no dense [max_rows, width] gradient ever exists.
+  def _dense_offsets(self, key, bucket: Bucket) -> np.ndarray:
+    cp = self.plan.classes[key]
+    offs = np.zeros((self.plan.world_size, bucket.n_b), np.int32)
+    for rank in range(self.plan.world_size):
+      for k, idx in enumerate(bucket.slot_idx_per_rank[rank]):
+        offs[rank, k] = cp.slots_per_rank[rank][idx].row_offset
+    return offs
+
+  def _z_dense(self, key, bucket: Bucket, table_local: jax.Array,
+               ids_all: jax.Array) -> jax.Array:
+    """Small-vocab lookup as windowed one-hot MXU matmuls (zero row ops).
+
+    The TPU equivalent of the reference's ``ConcatOneHotEmbedding``
+    (`embedding.py:155-180`) — but applied automatically to every table
+    under ``dense_row_threshold``. Per slot, a ``[vcap, w]`` window of the
+    class buffer starting at the slot's row offset is contracted with the
+    slot's one-hot ids; out-of-window / sentinel ids one-hot to zero. SPMD
+    uniform: window starts are data (indexed by ``lax.axis_index``), window
+    size is the bucket's static ``vcap``.
+    """
+    n_b, g, h = ids_all.shape
+    cp_check = self.plan.classes[key]
+    if cp_check.combiner is None and h != 1:
+      # same contract as the sparse path's _combine: without a combiner a
+      # multi-hot input has no defined reduction (the einsum below would
+      # silently sum over h)
+      raise ValueError("combiner=None requires hotness-1 inputs in the "
+                       "distributed path (2-D model-parallel outputs)")
+    vcap = bucket.vcap
+    offs_const = jnp.asarray(self._dense_offsets(key, bucket))  # [world, n_b]
+    offs = offs_const[self._my_rank()]  # [n_b]
+    ids_local = ids_all - offs[:, None, None]  # slot-local; OOB -> no one-hot
+
+    def window(o):
+      return lax.dynamic_slice(table_local, (o, 0), (vcap, table_local.shape[1]))
+
+    wins = jax.vmap(window)(offs)  # [n_b, vcap, w]
+
+    # bf16 one-hot is exact (values are 0/1) and halves the [G, vcap]
+    # staging memory; HIGHEST precision keeps the f32 table values intact
+    # through the MXU (default precision would round them to bf16).
+    def z_of(ids_c):  # [n_b, C, h] -> [n_b, C, w]
+      oh = jax.nn.one_hot(ids_c, vcap, dtype=jnp.bfloat16)
+      return jnp.einsum("nghv,nvw->ngw", oh, wins,
+                        precision=jax.lax.Precision.HIGHEST,
+                        preferred_element_type=jnp.float32
+                        ).astype(table_local.dtype)
+
+    if n_b * g * h * vcap <= (1 << 25):
+      z = z_of(ids_local)
+    else:
+      # chunk the batch axis so the one-hot staging stays bounded; remat the
+      # body so scan doesn't stack per-iteration one-hot residuals for the
+      # backward (rebuilding them is a few VPU compares per element)
+      chunk = max(1, (1 << 25) // max(1, n_b * h * vcap))
+      nchunks = -(-g // chunk)
+      pad = nchunks * chunk - g
+      ids_c = ids_local
+      if pad:
+        ids_c = jnp.concatenate(
+            [ids_c, jnp.full((n_b, pad, h), -1, ids_c.dtype)], axis=1)
+      xs = ids_c.reshape(n_b, nchunks, chunk, h).transpose(1, 0, 2, 3)
+      _, zs = lax.scan(
+          jax.checkpoint(lambda c, i: (c, z_of(i))), None, xs)
+      z = zs.transpose(1, 0, 2, 3).reshape(n_b, nchunks * chunk, -1)[:, :g]
+    cp = self.plan.classes[key]
+    if cp.combiner == "mean" and h > 1:
+      sentinel = padded_rows(self.plan, key)
+      counts = jnp.sum(ids_all < sentinel, axis=2).astype(z.dtype)
+      z = z / jnp.maximum(counts, 1)[..., None]
+    return z
+
+  def _z_sparse_fused(self, key, layout: PackedLayout, buf_local: jax.Array,
+                      ids_all: jax.Array):
+    """Fused gather: returns (z, aux_rows) — optimizer state rides along."""
+    fused = gather_fused_chunked(layout, buf_local, ids_all)  # [n_b,G,h,stride]
+    w = layout.width
+    rows = fused[..., :w]
+    aux = fused[..., w:]
+    return self._combine(rows, ids_all, key), aux
+
+  # ---- mp -> dp exchange + assembly --------------------------------------
+  def exchange(self, z: Dict[tuple, jax.Array], batch_local: int
+               ) -> Dict[tuple, jax.Array]:
+    """mp->dp activation exchange (reference `dist_model_parallel.py:449-459`).
+
+    z: bk -> [n_b, G, w]; returns bk -> [world_owner, n_b, B_local, w].
+    Differentiable — autodiff inserts the reverse all_to_all, which is how
+    the backward routes output cotangents to the owning shard without any of
+    the reference's tape patching."""
+    world = self.plan.world_size
+    received = {}
+    for bk, zb in z.items():
+      n_b = zb.shape[0]
+      zb = zb.reshape(n_b, world, batch_local, -1).transpose(1, 0, 2, 3)
+      if world > 1:
+        zb = lax.all_to_all(zb, self.axis_name, split_axis=0, concat_axis=0)
+      received[bk] = zb
+    return received
+
+  def _hot_sig(self, key, hotness_of) -> tuple:
+    cp = self.plan.classes[key]
+    return tuple(hotness_of(s.input_id)
+                 for slots in cp.slots_per_rank for s in slots)
+
+  def _buckets(self, key, hotness_of) -> List[Bucket]:
+    """Cached :func:`class_buckets` (pure-Python, hotness-dependent)."""
+    ck = (key, self._hot_sig(key, hotness_of))
+    got = self._bucket_cache.get(ck)
+    if got is None:
+      got = class_buckets(self.plan, key, hotness_of)
+      self._bucket_cache[ck] = got
+    return got
+
+  def _slot_bucket_map(self, hotness_of) -> Dict[tuple, tuple]:
+    """(class_key, rank, slot_idx) -> (bucket key, index within bucket),
+    built in one pass over each class's buckets (assemble would otherwise
+    rescan every bucket per output piece — quadratic trace-time cost on
+    thousand-table models)."""
+    ck = tuple((key, self._hot_sig(key, hotness_of))
+               for key in self.plan.class_keys)
+    got = self._slot_map_cache.get(ck)
+    if got is not None:
+      return got
+    out = {}
+    for key in self.plan.class_keys:
+      for bucket in self._buckets(key, hotness_of):
+        bk = bucket_key(key, bucket.h, bucket.vcap)
+        for rank, idxs in enumerate(bucket.slot_idx_per_rank):
+          for pos, slot_idx in enumerate(idxs):
+            out[(key, rank, slot_idx)] = (bk, pos)
+    self._slot_map_cache[ck] = out
+    return out
+
+  def assemble(self, received: Dict[tuple, jax.Array],
+               hotness_of) -> List[jax.Array]:
+    """Per-input output reassembly incl. column-slice concat.
+
+    Replaces the reference's rev_global_input_ids shuffle + range-wise output
+    concat (`dist_model_parallel.py:462-469`) with static piece indexing."""
+    plan = self.plan
+    slot_map = self._slot_bucket_map(hotness_of)
+    results = []
+    for pieces in plan.output_pieces:
+      parts = []
+      for p in pieces:
+        bk, idx = slot_map[(p.class_key, p.rank, p.slot)]
+        parts.append(received[bk][p.rank, idx])
+      results.append(parts[0] if len(parts) == 1 else
+                     jnp.concatenate(parts, axis=-1))
+    return results
+
+  # ---- composed forwards -------------------------------------------------
+  def forward(self, class_params: Dict[str, jax.Array],
+              inputs: Sequence[jax.Array],
+              return_residuals: bool = False):
+    """Differentiable distributed lookup on simple-layout params.
 
     Args:
-      d_outs: per global input, [B_local, table_width] cotangent (same
-        structure :meth:`forward` returns).
-      residuals: the id tensors from ``forward(..., return_residuals=True)``
-        (dp input) or the unpacked ``[n_bucket, G, H]`` blocks from packed
-        mp inputs (see :meth:`mp_residuals`).
-      hotness: per global input id, its static hotness (``input.shape[1]``
-        after normalization; 1 for 1-D inputs). None = all one-hot.
+      class_params: name -> [1, rows, width] local block (or the full
+        [world, rows, width] when world == 1... the leading dim must be 1).
+      inputs: per global input, [B_local] or [B_local, H] int ids
+        (PAD_ID entries ignored).
+      return_residuals: also return the post-exchange id tensors
+        (``bk -> [n_b, G, H]``) for an external sparse backward.
 
     Returns:
-      class param name -> :class:`SparseRows` over the *local* [max_rows,
-      width] block (apply under the same shard_map as the forward).
+      Per global input, [B_local, table_width] activations; with
+      ``return_residuals``, ``(outputs, ids_all)``.
     """
-    from ..ops.sparse_grad import SparseRows, dedup_rows
-
-    plan = self.plan
-    world = plan.world_size
-    if len(d_outs) != plan.num_inputs:
-      raise ValueError(f"Expected {plan.num_inputs} grads, got {len(d_outs)}")
-    b = d_outs[0].shape[0]
-
-    if hotness is None:
-      hotness_of = lambda i: 1  # noqa: E731
-    else:
-      hotness_of = lambda i: hotness[i]  # noqa: E731
-
-    # scatter output grads back into per-(class, hotness) received layout
-    d_received: Dict[tuple, List] = {}
-    for (key, h) in residuals:
-      n_b = next(n for hh, _, n in hotness_buckets(plan, key, hotness_of)
-                 if hh == h)
-      d_received[(key, h)] = [
-          [jnp.zeros((b, key[0]), d_outs[0].dtype) for _ in range(n_b)]
-          for _ in range(world)
-      ]
-    for input_id, pieces in enumerate(plan.output_pieces):
-      col = 0
-      for p in pieces:
-        slots = plan.classes[p.class_key].slots_per_rank[p.rank]
-        h = hotness_of(slots[p.slot].input_id)
-        idx = sum(1 for s in slots[:p.slot] if hotness_of(s.input_id) == h)
-        piece_grad = d_outs[input_id][:, col:col + p.width]
-        d_received[(p.class_key, h)][p.rank][idx] = piece_grad
-        col += p.width
-
-    grads: Dict[str, SparseRows] = {}
-    flat_by_class: Dict[tuple, list] = {}
-    for (key, h), blocks in d_received.items():
-      d_r = jnp.stack([jnp.stack(bl) for bl in blocks])  # [world, n_b, B, w]
-      n_b = d_r.shape[1]
-      if world > 1:
-        # reverse of the mp -> dp output exchange (self-inverse axes)
-        d_zp = lax.all_to_all(d_r, self.axis_name, split_axis=0,
-                              concat_axis=0)
+    inputs = [_normalize_input(x) for x in inputs]
+    hotness_of = lambda i: inputs[i].shape[1]  # noqa: E731
+    b = inputs[0].shape[0]
+    ids_all = self.route_ids(inputs, hotness_of)
+    z = {}
+    for bk, ids in ids_all.items():
+      key = bk.class_key
+      table_local = self._squeeze_local(
+          class_params[class_param_name(*key)])
+      if self.plan.classes[key].kind == "dense":
+        bucket = self._find_bucket(key, bk.h, bk.vcap, hotness_of)
+        z[bk] = self._z_dense(key, bucket, table_local, ids)
       else:
-        d_zp = d_r
-      d_z = d_zp.transpose(1, 0, 2, 3).reshape(n_b, world * b, -1)
-      ids_all = residuals[(key, h)]  # [n_b, G, h]
-      cp = plan.classes[key]
-      sentinel = cp.max_rows
-      valid = ids_all < sentinel
-      if cp.combiner == "mean" and h > 1:
-        counts = jnp.sum(valid, axis=2).astype(d_z.dtype)  # [n_b, G]
-        d_z = d_z / jnp.maximum(counts, 1)[..., None]
-      d_rows = jnp.broadcast_to(
-          d_z[:, :, None, :], ids_all.shape + (d_z.shape[-1],))
-      flat_by_class.setdefault(key, []).append(
-          (ids_all.reshape(-1), d_rows.reshape(-1, d_z.shape[-1])))
+        z[bk] = self._z_sparse_simple(key, table_local, ids)
+    received = self.exchange(z, b)
+    outs = self.assemble(received, hotness_of)
+    if return_residuals:
+      return outs, ids_all
+    return outs
 
-    for key, parts in flat_by_class.items():
-      ids = jnp.concatenate([p[0] for p in parts])
-      rows = jnp.concatenate([p[1] for p in parts])
-      grads[class_param_name(*key)] = dedup_rows(
-          ids, rows, plan.classes[key].max_rows)
-    return grads
+  def _find_bucket(self, key, h, vcap, hotness_of) -> Bucket:
+    for bucket in self._buckets(key, hotness_of):
+      if bucket.h == h and bucket.vcap == vcap:
+        return bucket
+    raise KeyError((key, h, vcap))
 
   @staticmethod
-  def mp_residuals(packed_inputs: Dict[str, jax.Array]) -> Dict[tuple, jax.Array]:
-    """Packed mp-input blocks -> the residual dict backward_sparse expects."""
-    res = {}
-    for name, arr in packed_inputs.items():
-      stem, hpart = name.rsplit("_h", 1)
-      width_comb = stem[len("mp_table_w"):]
-      wpart, comb = width_comb.split("_", 1)
-      key = (int(wpart), None if comb == "cat" else comb)
-      res[(key, int(hpart))] = arr[0]
-    return res
+  def _squeeze_local(p: jax.Array) -> jax.Array:
+    if p.ndim != 3:
+      raise ValueError(
+          f"class param must be 3-D [shards, rows, width], got {p.shape}")
+    if p.shape[0] != 1:
+      raise ValueError(
+          "expected the local block of a class param (leading dim 1); pass "
+          "params through shard_map with PartitionSpec('mp', None, None)")
+    return p[0]
 
+  # ---- fused training path -----------------------------------------------
+  def lookup_sparse_fused(self, fused_params: Dict[str, jax.Array],
+                          layouts: Dict[str, PackedLayout],
+                          ids_all: Dict[tuple, jax.Array]):
+    """Non-differentiable mp-side fused lookup for all sparse classes.
+
+    Returns ``(z_sparse, residuals)``; run *outside* autodiff, then feed
+    ``z_sparse`` into the differentiable tail (exchange/assemble/model) and
+    its cotangent into :meth:`apply_sparse`.
+    """
+    z: Dict[tuple, jax.Array] = {}
+    aux: Dict[tuple, jax.Array] = {}
+    for bk, ids in ids_all.items():
+      key = bk.class_key
+      if self.plan.classes[key].kind != "sparse":
+        continue
+      name = class_param_name(*key)
+      buf_local = self._squeeze_local(fused_params[name])
+      zb, auxb = self._z_sparse_fused(key, layouts[name], buf_local, ids)
+      z[bk] = zb
+      aux[bk] = auxb
+    return z, SparseResiduals(ids_all=dict(ids_all), aux_rows=aux)
+
+  def finish_forward(self, z_sparse: Dict[tuple, jax.Array],
+                     dense_params: Dict[str, jax.Array],
+                     ids_all: Dict[tuple, jax.Array],
+                     batch_local: int, hotness_of) -> List[jax.Array]:
+    """Differentiable tail: dense-class lookups + exchange + assembly.
+
+    Differentiable w.r.t. ``z_sparse`` (cotangents feed
+    :meth:`apply_sparse`) and ``dense_params`` (dense autodiff grads for the
+    MXU one-hot tables)."""
+    z = dict(z_sparse)
+    for bk, ids in ids_all.items():
+      key = bk.class_key
+      if self.plan.classes[key].kind != "dense":
+        continue
+      table_local = self._squeeze_local(dense_params[class_param_name(*key)])
+      bucket = self._find_bucket(key, bk.h, bk.vcap, hotness_of)
+      # remat: don't keep the [G, vcap] one-hot staging alive for the
+      # backward — rebuilding it is a handful of VPU compares
+      z_fn = jax.checkpoint(
+          lambda t, i, key=key, bucket=bucket: self._z_dense(
+              key, bucket, t, i))
+      z[bk] = z_fn(table_local, ids)
+    received = self.exchange(z, batch_local)
+    return self.assemble(received, hotness_of)
+
+  def apply_sparse(self, fused_params: Dict[str, jax.Array],
+                   layouts: Dict[str, PackedLayout],
+                   d_z: Dict[tuple, jax.Array],
+                   residuals: SparseResiduals,
+                   rule: SparseRule, step: jax.Array,
+                   exact: bool = False) -> Dict[str, jax.Array]:
+    """Apply the sparse update: one fused scatter-add per sparse class.
+
+    The IndexedSlices backward + optimizer apply of the reference
+    (`embedding_lookup_ops.py:105-122` + TF sparse applies) collapsed into a
+    single indexed op per class: per-occurrence cotangent rows are combined
+    with the forward-saved optimizer-state rows by ``rule.delta`` and
+    scatter-added (table delta | state delta) into the packed buffer.
+
+    ``exact=True`` reproduces the reference's deduplicated semantics
+    (sort + segment-sum, `embedding_lookup_kernels.cu:464-633`) at the cost
+    of a sort and one extra gather.
+    """
+    from ..ops.sparse_grad import dedup_rows
+
+    plan = self.plan
+    by_class: Dict[str, list] = {}
+    for bk, dzb in d_z.items():
+      key, h = bk.class_key, bk.h
+      if plan.classes[key].kind != "sparse":
+        continue
+      cp = plan.classes[key]
+      name = class_param_name(*key)
+      ids = residuals.ids_all[bk]  # [n_b, G, h]
+      sentinel = padded_rows(plan, key)
+      if cp.combiner == "mean" and h > 1:
+        counts = jnp.sum(ids < sentinel, axis=2).astype(dzb.dtype)
+        dzb = dzb / jnp.maximum(counts, 1)[..., None]
+      aux = residuals.aux_rows[bk] if rule.n_aux else None
+      by_class.setdefault(name, []).append((ids, dzb, aux, h))
+
+    new_params = dict(fused_params)
+    for name, parts in by_class.items():
+      layout = layouts[name]
+      w = layout.width
+      buf = self._squeeze_local(fused_params[name])
+      if exact:
+        # class-level dedup (cross-bucket duplicates of shared tables must
+        # merge) — the reference's sorted/unique semantics
+        ids = jnp.concatenate([p[0].reshape(-1) for p in parts])
+        g = jnp.concatenate([
+            jnp.broadcast_to(dzb[:, :, None, :], idb.shape + (w,))
+            .reshape(-1, w) for idb, dzb, _, _ in parts])
+        sr = dedup_rows(ids, g, layout.rows)
+        ids, g = sr.ids, sr.rows
+        fused_rows = gather_fused(layout, buf, ids)
+        aux = fused_rows[..., w:].reshape(
+            ids.shape + (rule.n_aux, w)) if rule.n_aux else None
+        delta = rule.delta(g, aux, step)
+        buf = scatter_add_fused(layout, buf, ids, delta)
+      else:
+        # fast path: lax.scan over fixed-size id chunks. Each iteration
+        # slices its cotangent rows out of the compact [n_b*G, w] tensor
+        # (the per-occurrence broadcast is never materialized), computes the
+        # fused delta, and scatter-adds it; the carried buffer updates in
+        # place, so peak temps are one chunk regardless of batch/hotness.
+        for ids, dzb, aux, h in parts:
+          n = int(np.prod(ids.shape))
+          ids_f = ids.reshape(-1)
+          dz_f = dzb.reshape(-1, w)
+          aux_f = aux.reshape(-1, rule.n_aux * w) if aux is not None else None
+          chunk = max(h, (self.apply_chunk // h) * h)
+
+          def delta_of(ids_c, g_c, aux_c):
+            d = rule.delta(
+                g_c, aux_c.reshape(ids_c.shape + (rule.n_aux, w))
+                if aux_c is not None else None, step)
+            return d
+
+          if n <= chunk:
+            buf = scatter_add_fused(
+                layout, buf, ids_f,
+                delta_of(ids_f,
+                         jnp.repeat(dz_f, h, axis=0) if h > 1 else dz_f,
+                         aux_f))
+            continue
+          nchunks = -(-n // chunk)
+          pad = nchunks * chunk - n
+          ids_p = jnp.concatenate(
+              [ids_f, jnp.full((pad,), -1, ids_f.dtype)]) if pad else ids_f
+          if pad:
+            # pad the gradient/aux sources to the same occurrence count so
+            # the per-chunk slices stay aligned with the ids (an
+            # edge-clamped slice would shift the whole last chunk)
+            dz_f = jnp.concatenate(
+                [dz_f, jnp.zeros((pad // h, dz_f.shape[1]), dz_f.dtype)])
+            if aux_f is not None:
+              aux_f = jnp.concatenate(
+                  [aux_f, jnp.zeros((pad, aux_f.shape[1]), aux_f.dtype)])
+
+          def body(b, xs, dz_f=dz_f, aux_f=aux_f, h=h, chunk=chunk,
+                   layout=layout):
+            ids_c, k = xs
+            start = k * chunk
+            g_c = lax.dynamic_slice(dz_f, (start // h, 0),
+                                    (chunk // h, dz_f.shape[1]))
+            if h > 1:
+              g_c = jnp.broadcast_to(g_c[:, None, :],
+                                     (chunk // h, h, g_c.shape[1]))
+              g_c = g_c.reshape(chunk, -1)
+            aux_c = None if aux_f is None else lax.dynamic_slice(
+                aux_f, (start, 0), (chunk, aux_f.shape[1]))
+            return scatter_add_fused(layout, b, ids_c,
+                                     delta_of(ids_c, g_c, aux_c)), None
+
+          buf, _ = lax.scan(
+              body, buf,
+              (ids_p.reshape(nchunks, chunk), jnp.arange(nchunks)))
+      new_params[name] = buf[None]
+    return new_params
+
+  # ---- model-parallel input mode -----------------------------------------
   def forward_mp(self, class_params: Dict[str, jax.Array],
                  packed_inputs: Dict[str, jax.Array],
                  hotness: Optional[Sequence[int]] = None) -> List[jax.Array]:
     """Distributed lookup for model-parallel inputs (dp_input=False).
 
-    ``packed_inputs`` comes from :func:`pack_mp_inputs`: per (class, hotness)
-    bucket, the local block ``[1, n_bucket, G, h]`` of pre-offset ids for
-    this rank's tables over the *global* batch. Skips the dp->mp exchange;
-    the output exchange still runs (reference semantics,
-    `dist_model_parallel.py:449-459`).
-
-    Args:
-      hotness: per global input id, its static hotness (must match what was
-        passed to pack_mp_inputs). Defaults to all-1 (pure one-hot models).
+    ``packed_inputs`` comes from :func:`pack_mp_inputs`: per bucket, the
+    local block ``[1, n_b, G, h]`` of pre-offset ids for this rank's tables
+    over the *global* batch. Skips the dp->mp exchange; the output exchange
+    still runs (reference semantics, `dist_model_parallel.py:449-459`).
     """
     plan = self.plan
     world = plan.world_size
     hotness_of = (lambda i: 1) if hotness is None else \
         (lambda i: hotness[i])  # noqa: E731
-    received = {}
+    z = {}
+    g = None
     for key in plan.class_keys:
       table_local = self._squeeze_local(class_params[class_param_name(*key)])
-      for h, _, n_b in hotness_buckets(plan, key, hotness_of):
-        name = f"{class_param_name(*key)}_h{h}"
+      for bucket in self._buckets(key, hotness_of):
+        name = _packed_input_name(key, bucket)
         if name not in packed_inputs:
           raise ValueError(
               f"packed input {name!r} missing; pass the same `hotness` to "
               "pack_mp_inputs and forward_mp")
         ids_all = packed_inputs[name]
         if (ids_all.ndim != 4 or ids_all.shape[0] != 1
-            or ids_all.shape[1] != n_b or ids_all.shape[3] != h):
+            or ids_all.shape[1] != bucket.n_b
+            or ids_all.shape[3] != bucket.h):
           raise ValueError(
               f"packed input {name!r} has shape {ids_all.shape}, expected "
-              f"[1, {n_b}, G, {h}] — was it packed with a different plan or "
-              "hotness?")
+              f"[1, {bucket.n_b}, G, {bucket.h}] — was it packed with a "
+              "different plan or hotness?")
         ids_all = ids_all[0]
         g = ids_all.shape[1]
         if g % world:
           raise ValueError(f"Global batch {g} not divisible by world {world}")
-        b = g // world
-        z = self._local_lookup(key, table_local, ids_all)
-        z = z.reshape(n_b, world, b, -1).transpose(1, 0, 2, 3)
-        if world > 1:
-          r = lax.all_to_all(z, self.axis_name, split_axis=0, concat_axis=0)
+        if plan.classes[key].kind == "dense":
+          z[bucket_key(key, bucket.h, bucket.vcap)] = self._z_dense(
+              key, bucket, table_local, ids_all)
         else:
-          r = z
-        received[(key, h)] = r
-    return self._assemble(received, hotness_of)
+          z[bucket_key(key, bucket.h, bucket.vcap)] = self._z_sparse_simple(
+              key, table_local, ids_all)
+    received = self.exchange(z, g // world)
+    return self.assemble(received, hotness_of)
 
-  def _assemble(self, received: Dict[tuple, jax.Array],
-                hotness_of) -> List[jax.Array]:
-    """Per-input output re-assembly incl. column-slice concat.
 
-    Replaces the reference's rev_global_input_ids shuffle + range-wise output
-    concat (`dist_model_parallel.py:462-469`) with static piece indexing."""
-    plan = self.plan
-    results = []
-    for pieces in plan.output_pieces:
-      parts = []
-      for p in pieces:
-        slots = plan.classes[p.class_key].slots_per_rank[p.rank]
-        h = hotness_of(slots[p.slot].input_id)
-        # bucket position = rank of p.slot among same-hotness slots
-        idx = sum(1 for s in slots[:p.slot] if hotness_of(s.input_id) == h)
-        parts.append(received[(p.class_key, h)][p.rank, idx])
-      results.append(parts[0] if len(parts) == 1 else
-                     jnp.concatenate(parts, axis=-1))
-    return results
+def _packed_input_name(key, bucket: Bucket) -> str:
+  name = f"{class_param_name(*key)}_h{bucket.h}"
+  if bucket.vcap:
+    name += f"_v{bucket.vcap}"
+  return name
 
 
 def pack_mp_inputs(plan: DistEmbeddingStrategy,
@@ -431,8 +778,8 @@ def pack_mp_inputs(plan: DistEmbeddingStrategy,
       :meth:`DistributedLookup.forward_mp`. Default all-1.
 
   Returns:
-    ``{class_name}_h{hotness}`` -> [world, n_bucket, G, h] arrays; shard
-    axis 0 over the mesh, then pass the per-device blocks to ``forward_mp``.
+    packed-input name -> [world, n_b, G, h] arrays; shard axis 0 over the
+    mesh, then pass the per-device blocks to ``forward_mp``.
   """
   world = plan.world_size
   hotness_of = (lambda i: 1) if hotness is None else \
@@ -452,14 +799,14 @@ def pack_mp_inputs(plan: DistEmbeddingStrategy,
   packed = {}
   for key in plan.class_keys:
     cp = plan.classes[key]
-    sentinel = cp.max_rows
+    sentinel = padded_rows(plan, key)
     g = next((x.shape[0] for x in slot_inputs.values()), 0)
-    for h, slot_idx_per_rank, n_b in hotness_buckets(plan, key, hotness_of):
+    for bucket in class_buckets(plan, key, hotness_of):
       per_rank = []
       for rank in range(world):
-        idxs = slot_idx_per_rank[rank]
+        idxs = bucket.slot_idx_per_rank[rank]
         entries = []
-        for k in range(n_b):
+        for k in range(bucket.n_b):
           if k < len(idxs):
             slot = cp.slots_per_rank[rank][idxs[k]]
             x = slot_inputs[(key, rank, idxs[k])]
@@ -467,8 +814,8 @@ def pack_mp_inputs(plan: DistEmbeddingStrategy,
             routed = jnp.where(x < 0, sentinel,
                                jnp.clip(x, 0, rows - 1) + slot.row_offset)
           else:
-            routed = jnp.full((g, h), sentinel, jnp.int32)
+            routed = jnp.full((g, bucket.h), sentinel, jnp.int32)
           entries.append(routed)
         per_rank.append(jnp.stack(entries))
-      packed[f"{class_param_name(*key)}_h{h}"] = jnp.stack(per_rank)
+      packed[_packed_input_name(key, bucket)] = jnp.stack(per_rank)
   return packed

@@ -6,11 +6,27 @@ The reference wires hybrid parallel into training with four Horovod patches
 single backward, dense-grad psum, optimizer update — is one ``shard_map``'d
 jitted function; this module builds it from a loss function and an optax
 optimizer.
+
+Two step builders:
+
+- :func:`make_train_step`: plain autodiff over everything (dense table
+  grads). Correct and simple; right for models whose tables fit the dense
+  gradient/optimizer traffic.
+- :func:`make_sparse_train_step`: the performance path. Embedding tables are
+  held in the lane-packed fused layout (`ops/packed_table.py`) with
+  optimizer state interleaved; the forward gather brings the state along and
+  the whole backward+update for a sparse class is ONE scatter-add. This is
+  the reference's IndexedSlices pipeline (custom grad op ->
+  ``tf.IndexedSlices`` -> TF sparse optimizer apply,
+  `embedding_lookup_ops.py:105-122`) collapsed into a single indexed op,
+  which on TPU (where every indexed row op costs ~10-25 ns/row regardless of
+  width) is the difference between HBM-bound and row-issue-bound training.
+  Small-vocab tables ride the MXU one-hot path with dense grads + optax.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -22,6 +38,9 @@ from .layers.dist_model_parallel import (
     DistributedOptimizer,
     hybrid_partition_specs,
 )
+from .layers.planner import DistEmbeddingStrategy
+from .ops.packed_table import SparseRule
+from .parallel.lookup_engine import DistributedLookup, class_param_name
 
 
 def make_train_step(loss_fn: Callable,
@@ -33,7 +52,7 @@ def make_train_step(loss_fn: Callable,
                     axis_name: str = "mp",
                     batch_specs: Any = None,
                     donate: bool = True):
-  """Build a jitted hybrid-parallel train step.
+  """Build a jitted hybrid-parallel train step (dense autodiff path).
 
   Args:
     loss_fn: ``loss_fn(params, *batch) -> scalar`` local loss (mean over the
@@ -78,135 +97,326 @@ def make_train_step(loss_fn: Callable,
   return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
 
 
-def init_sparse_state(params: Any,
-                      dense_optimizer: optax.GradientTransformation,
-                      sparse_opt,
-                      emb_collection: str = "embeddings"):
-  """Optimizer state for :func:`make_sparse_train_step`.
+# ---------------------------------------------------------------------------
+# Fused sparse training path
+# ---------------------------------------------------------------------------
 
-  Returns ``(dense_opt_state, table_state)``: plain optax state over the
-  non-embedding subtree, and per class-param sparse-optimizer state (e.g.
-  adagrad accumulators shaped like the [world, rows, width] class arrays —
-  shard them with :func:`shard_params` alongside the params).
+
+def init_sparse_state(plan: DistEmbeddingStrategy,
+                      params: Any,
+                      rule: SparseRule,
+                      dense_optimizer: optax.GradientTransformation,
+                      emb_dense_optimizer: Optional[
+                          optax.GradientTransformation] = None,
+                      emb_collection: str = "embeddings",
+                      axis_name: str = "mp") -> Dict[str, Any]:
+  """Build the fused train state from freshly-initialized model params.
+
+  Packs every sparse-class table into its :class:`PackedLayout` buffer with
+  ``rule``'s optimizer-state rows interleaved (e.g. the Adagrad accumulator
+  at its initial value — the reference's TF slot variable); dense-class
+  tables keep the simple layout and get a plain optax state.
+
+  Returns a state dict pytree:
+    ``{'dense', 'dense_opt', 'emb_dense', 'emb_dense_opt', 'fused', 'step'}``
   """
+  engine = DistributedLookup(plan, axis_name=axis_name)
+  layouts = engine.fused_layouts(rule)
   tables = params[emb_collection]
   dense = {k: v for k, v in params.items() if k != emb_collection}
-  dense_state = dense_optimizer.init(dense)
-  table_state = {name: sparse_opt.init(arr) for name, arr in tables.items()}
-  return dense_state, table_state
+
+  fused = {}
+  emb_dense = {}
+  for key in plan.class_keys:
+    name = class_param_name(*key)
+    arr = tables[name]
+    if plan.classes[key].kind == "sparse":
+      layout = layouts[name]
+
+      # chunked pack with bounded temporaries; the caller's params stay
+      # valid (no donation — a "pure constructor" must not invalidate its
+      # inputs). For classes near HBM size, where holding source + packed
+      # at once cannot fit, use init_sparse_state_direct instead.
+      def pack_all(a, layout=layout):
+        return jnp.stack([layout.pack_chunked(a[r], rule.aux_init)
+                          for r in range(a.shape[0])])
+
+      fused[name] = jax.jit(pack_all)(arr)
+    else:
+      emb_dense[name] = arr
+
+  opt = emb_dense_optimizer or dense_optimizer
+  return {
+      "dense": dense,
+      "dense_opt": dense_optimizer.init(dense),
+      "emb_dense": emb_dense,
+      "emb_dense_opt": opt.init(emb_dense),
+      "fused": fused,
+      "step": jnp.zeros((), jnp.int32),
+  }
 
 
-def make_sparse_train_step(model, plan, loss_fn: Callable,
+def init_sparse_state_direct(plan: DistEmbeddingStrategy,
+                             rule: SparseRule,
+                             dense_params: Any,
+                             dense_optimizer: optax.GradientTransformation,
+                             rng: jax.Array,
+                             emb_dense_optimizer: Optional[
+                                 optax.GradientTransformation] = None,
+                             axis_name: str = "mp",
+                             dtype=jnp.float32) -> Dict[str, Any]:
+  """Build the fused train state WITHOUT materializing simple-layout tables.
+
+  :func:`init_sparse_state` packs tables out of a fully-initialized params
+  tree, which transiently needs (simple + packed) = 1.5x the class bytes —
+  an OOM for classes near HBM size, and wasted work for fresh training runs.
+  This variant draws every sparse class directly in its packed physical
+  layout (``ops.packed_table.init_packed_uniform``): peak memory is the
+  buffer itself plus one chunk. Requires every sparse table's initializer to
+  be uniform with a known ``.scale`` (the library's named initializers and
+  the DLRM ``1/sqrt(rows)`` initializer qualify); anything else needs the
+  generic packing path.
+
+  Args:
+    dense_params: the model's non-embedding params (e.g. from
+      ``model.init(rng, numerical, cats, emb_acts=dummy)``, which skips
+      embedding param creation entirely).
+  """
+  from .layers.dist_model_parallel import make_class_initializer
+  from .layers.embedding import resolve_initializer
+  from .ops.packed_table import init_packed_uniform
+  from .parallel.lookup_engine import padded_rows
+
+  engine = DistributedLookup(plan, axis_name=axis_name)
+  layouts = engine.fused_layouts(rule)
+  fused = {}
+  emb_dense = {}
+  for ki, key in enumerate(plan.class_keys):
+    name = class_param_name(*key)
+    cp = plan.classes[key]
+    sub = jax.random.fold_in(rng, ki)
+    if cp.kind == "sparse":
+      layout = layouts[name]
+      blocks = []
+      for r in range(plan.world_size):
+        spans = []
+        for sh, off in zip(cp.shards_per_rank[r],
+                           cp.row_offsets_per_rank[r]):
+          scale = getattr(resolve_initializer(sh.initializer), "scale", None)
+          if scale is None:
+            raise NotImplementedError(
+                f"table {sh.table_id} initializer has no .scale; use "
+                "init_sparse_state (generic packing) for this model")
+          spans.append((off, sh.input_dim, float(scale)))
+
+        def build(k, spans=tuple(spans), layout=layout):
+          r_idx = jnp.arange(layout.rows, dtype=jnp.int32)
+          scale_rows = jnp.zeros((layout.rows,), dtype)
+          for off, n, sc in spans:
+            scale_rows = jnp.where((r_idx >= off) & (r_idx < off + n), sc,
+                                   scale_rows)
+          # leading world dim added inside jit: a reshape here fuses into
+          # the builder, while an out-of-jit [None] would copy the buffer
+          return init_packed_uniform(layout, k, scale_rows, rule.aux_init,
+                                     dtype)[None]
+
+        blocks.append(jax.jit(build)(jax.random.fold_in(sub, r)))
+      fused[name] = (jnp.concatenate(blocks) if len(blocks) > 1
+                     else blocks[0])
+    else:
+      shape = (plan.world_size, padded_rows(plan, key), cp.width)
+      emb_dense[name] = make_class_initializer(plan, key)(sub, shape, dtype)
+
+  opt = emb_dense_optimizer or dense_optimizer
+  return {
+      "dense": dense_params,
+      "dense_opt": dense_optimizer.init(dense_params),
+      "emb_dense": emb_dense,
+      "emb_dense_opt": opt.init(emb_dense),
+      "fused": fused,
+      "step": jnp.zeros((), jnp.int32),
+  }
+
+
+def unpack_sparse_state(plan: DistEmbeddingStrategy, rule: SparseRule,
+                        state: Dict[str, Any],
+                        emb_collection: str = "embeddings",
+                        axis_name: str = "mp",
+                        include_aux: bool = False):
+  """Fused state -> ``(params, aux)`` in the simple/flax layout.
+
+  ``params[emb_collection]`` holds every class table as
+  ``[world, rows, width]`` (checkpoint / ``get_weights`` view); with
+  ``include_aux``, ``aux`` maps sparse class names to their optimizer-state
+  arrays (otherwise empty)."""
+  engine = DistributedLookup(plan, axis_name=axis_name)
+  layouts = engine.fused_layouts(rule)
+  tables = {}
+  aux_out = {}
+  for key in plan.class_keys:
+    name = class_param_name(*key)
+    if plan.classes[key].kind == "sparse":
+      layout = layouts[name]
+      buf = state["fused"][name]
+      tables[name] = jnp.stack(
+          [layout.unpack_table_chunked(buf[r]) for r in range(buf.shape[0])])
+      if include_aux:
+        aux_out[name] = tuple(
+            jnp.stack([layout.unpack(buf[r])[1][j]
+                       for r in range(buf.shape[0])])
+            for j in range(rule.n_aux))
+    else:
+      tables[name] = state["emb_dense"][name]
+  params = {**state["dense"], emb_collection: tables}
+  return params, aux_out
+
+
+def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
+                           loss_fn: Callable,
                            dense_optimizer: optax.GradientTransformation,
-                           sparse_opt,
+                           rule: SparseRule,
                            mesh: Optional[Mesh],
-                           params: Any,
-                           dense_state: Any,
-                           table_state: Any,
+                           state: Dict[str, Any],
                            batch_example: Any,
                            axis_name: str = "mp",
                            emb_collection: str = "embeddings",
+                           emb_dense_optimizer: Optional[
+                               optax.GradientTransformation] = None,
+                           exact: bool = False,
                            donate: bool = True):
-  """Hybrid-parallel train step with row-sparse embedding updates.
+  """Hybrid-parallel train step on the fused sparse state.
 
-  The IndexedSlices training path of the reference
-  (`dist_model_parallel.py:715-773` + TF sparse optimizer applies), built
-  TPU-natively: the embedding forward runs *outside* autodiff, the single
-  backward produces dense-layer grads plus per-input activation cotangents,
-  and ``DistributedLookup.backward_sparse`` turns those into deduplicated
-  row gradients applied by a :class:`~..ops.sparse_grad.SparseOptimizer`.
-  No dense [rows, width] gradient or optimizer traffic ever exists, so a
-  table's step cost scales with the batch's unique rows, not the vocabulary —
-  the property that makes terabyte tables trainable.
+  One jitted/shard_map'd function per step:
+
+  1. route ids dp->mp (``all_to_all``; ints, outside autodiff);
+  2. fused gather per sparse class — activations + optimizer-state rows in
+     one row-bound op;
+  3. differentiable tail (dense-class MXU lookups, mp->dp exchange, output
+     assembly, the user model, the loss) — ``jax.value_and_grad`` w.r.t.
+     (dense params, dense-class tables, sparse activations): autodiff
+     routes output cotangents back through the reverse ``all_to_all``;
+  4. optax on dense params and dense-class tables; ONE fused scatter-add
+     per sparse class applies ``rule`` (:meth:`DistributedLookup.apply_sparse`).
 
   Args:
     model: flax module whose ``__call__(numerical, cats, emb_acts=None)``
-      skips its ``DistributedEmbedding`` when ``emb_acts`` is given (DLRM and
-      SyntheticModel do).
-    plan: the embedding's ``DistEmbeddingStrategy``.
+      skips its ``DistributedEmbedding`` when ``emb_acts`` is given (DLRM
+      and SyntheticModel do).
     loss_fn: ``loss_fn(logits, labels) -> scalar`` (local-batch mean).
-    dense_optimizer / sparse_opt: optax transformation for dense params;
-      :class:`SparseOptimizer` for embedding tables.
-    mesh: 1-D device mesh or None.
-    params / dense_state / table_state / batch_example: structure examples
-      for partition specs (``init_sparse_state`` builds the states).
-    emb_collection: params key of the ``DistributedEmbedding`` submodule.
+    rule: :class:`SparseRule` (``sgd_rule`` / ``adagrad_rule``).
+    exact: reproduce the reference's deduplicated backward exactly
+      (sort-based; slower). Default False = per-occurrence semantics of
+      stock TF sparse optimizer applies.
 
   Returns:
-    ``step(params, dense_state, table_state, numerical, cats, labels) ->
-    (params, dense_state, table_state, loss)``.
+    ``step(state, numerical, cats, labels) -> (state, loss)``.
   """
-  from .layers.dist_model_parallel import hybrid_partition_specs
-  from .parallel.lookup_engine import DistributedLookup
-
   engine = DistributedLookup(plan, dp_input=True, axis_name=axis_name)
+  layouts = engine.fused_layouts(rule)
+  emb_opt = emb_dense_optimizer or dense_optimizer
 
-  def split(p):
-    return ({k: v for k, v in p.items() if k != emb_collection},
-            p[emb_collection])
+  def local_step(state, numerical, cats, labels):
+    b = numerical.shape[0]
+    hotness = [1 if c.ndim == 1 else c.shape[1] for c in cats]
+    hotness_of = lambda i: hotness[i]  # noqa: E731
+    ids_all = engine.route_ids(cats, hotness_of)
+    z_sparse, residuals = engine.lookup_sparse_fused(
+        state["fused"], layouts, ids_all)
 
-  def local_step(params, dense_state, table_state, numerical, cats, labels):
-    dense, tables = split(params)
-    acts, residuals = engine.forward(tables, cats, return_residuals=True)
-
-    def loss_with(dense_p, acts_p):
-      logits = model.apply({"params": {**dense_p, emb_collection: tables}},
-                           numerical, cats, emb_acts=acts_p)
+    def loss_with(dense_p, emb_dense, z_sp):
+      acts = engine.finish_forward(z_sp, emb_dense, ids_all, b, hotness_of)
+      logits = model.apply({"params": dense_p}, numerical, cats,
+                           emb_acts=acts)
       return loss_fn(logits, labels)
 
-    loss, (d_dense, d_acts) = jax.value_and_grad(
-        loss_with, argnums=(0, 1))(dense, acts)
+    loss, (d_dense, d_emb_dense, d_z) = jax.value_and_grad(
+        loss_with, argnums=(0, 1, 2))(state["dense"], state["emb_dense"],
+                                      z_sparse)
     if mesh is not None:
-      # shard_map autodiff already psums replicated-param grads; a uniform
-      # 1/world rescale (of dense grads AND activation cotangents feeding
-      # the sparse backward) restores exact global-batch-mean semantics —
-      # see layers.dist_model_parallel.finalize_hybrid_grads.
+      # shard_map autodiff psums replicated-param grads; a uniform 1/world
+      # rescale (dense grads AND sparse cotangents) restores exact
+      # global-batch-mean semantics (see finalize_hybrid_grads).
       scale = 1.0 / jax.lax.axis_size(axis_name)
-      d_dense, d_acts = jax.tree_util.tree_map(
-          lambda g: g * scale, (d_dense, d_acts))
+      d_dense, d_emb_dense, d_z = jax.tree_util.tree_map(
+          lambda g: g * scale, (d_dense, d_emb_dense, d_z))
       loss = jax.lax.pmean(loss, axis_name)
-    updates, dense_state = dense_optimizer.update(d_dense, dense_state, dense)
-    dense = optax.apply_updates(dense, updates)
 
-    hotness = [1 if c.ndim == 1 else c.shape[1] for c in cats]
-    sgrads = engine.backward_sparse(d_acts, residuals, hotness=hotness)
-    new_tables, new_tstate = {}, {}
-    for name, tbl in tables.items():
-      # local blocks arrive as [1, rows, width]; state leaves shaped like the
-      # class array lose the same leading dim, scalars (counts) pass through
-      local_state = jax.tree_util.tree_map(
-          lambda x: x[0] if getattr(x, "ndim", 0) == 3 else x,
-          table_state[name])
-      t2, s2 = sparse_opt.apply(tbl[0], local_state, sgrads[name])
-      new_tables[name] = t2[None]
-      new_tstate[name] = jax.tree_util.tree_map(
-          lambda x: x[None] if getattr(x, "ndim", 0) == 2 else x, s2)
-    params = {**dense, emb_collection: new_tables}
-    return params, dense_state, new_tstate, loss
+    upd, dense_opt = dense_optimizer.update(
+        d_dense, state["dense_opt"], state["dense"])
+    dense = optax.apply_updates(state["dense"], upd)
+    if state["emb_dense"]:
+      upd, emb_dense_opt = emb_opt.update(
+          d_emb_dense, state["emb_dense_opt"], state["emb_dense"])
+      emb_dense = optax.apply_updates(state["emb_dense"], upd)
+    else:
+      emb_dense, emb_dense_opt = state["emb_dense"], state["emb_dense_opt"]
+
+    fused = engine.apply_sparse(state["fused"], layouts, d_z, residuals,
+                                rule, state["step"], exact=exact)
+    new_state = {
+        "dense": dense,
+        "dense_opt": dense_opt,
+        "emb_dense": emb_dense,
+        "emb_dense_opt": emb_dense_opt,
+        "fused": fused,
+        "step": state["step"] + 1,
+    }
+    return new_state, loss
 
   if mesh is None:
-    return jax.jit(local_step, donate_argnums=(0, 1, 2) if donate else ())
+    return jax.jit(local_step, donate_argnums=(0,) if donate else ())
 
-  pspec = hybrid_partition_specs(params, axis_name)
-  dspec = jax.tree_util.tree_map(lambda _: P(), dense_state)
-  tspec = hybrid_partition_specs(table_state, axis_name)
-  bspec = jax.tree_util.tree_map(lambda _: P(axis_name), batch_example)
+  sspec = hybrid_partition_specs(state, axis_name)
+  bspec = jax.tree_util.tree_map(
+      lambda _: P(axis_name), tuple(batch_example))
   sharded = shard_map(
       local_step, mesh=mesh,
-      in_specs=(pspec, dspec, tspec) + tuple(bspec),
-      out_specs=(pspec, dspec, tspec, P()))
-  return jax.jit(sharded, donate_argnums=(0, 1, 2) if donate else ())
+      in_specs=(sspec,) + bspec,
+      out_specs=(sspec, P()))
+  return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_sparse_eval_step(model, plan: DistEmbeddingStrategy,
+                          rule: SparseRule,
+                          mesh: Optional[Mesh],
+                          state: Dict[str, Any],
+                          batch_example: Any,
+                          axis_name: str = "mp"):
+  """Jitted distributed forward on the fused state (predictions only).
+
+  Per-device predictions come back batch-sharded (``P(axis_name)``);
+  reading the returned global array gives all predictions — the
+  single-controller equivalent of the reference's ``hvd.allgather`` of eval
+  outputs (`examples/dlrm/main.py:222-243`)."""
+  engine = DistributedLookup(plan, dp_input=True, axis_name=axis_name)
+  layouts = engine.fused_layouts(rule)
+
+  def local_eval(state, numerical, cats):
+    b = numerical.shape[0]
+    hotness = [1 if c.ndim == 1 else c.shape[1] for c in cats]
+    hotness_of = lambda i: hotness[i]  # noqa: E731
+    ids_all = engine.route_ids(cats, hotness_of)
+    z_sparse, _ = engine.lookup_sparse_fused(state["fused"], layouts, ids_all)
+    acts = engine.finish_forward(z_sparse, state["emb_dense"], ids_all, b,
+                                 hotness_of)
+    return model.apply({"params": state["dense"]}, numerical, cats,
+                       emb_acts=acts)
+
+  if mesh is None:
+    return jax.jit(local_eval)
+  sspec = hybrid_partition_specs(state, axis_name)
+  bspec = jax.tree_util.tree_map(
+      lambda _: P(axis_name), tuple(batch_example[:2]))
+  return jax.jit(shard_map(
+      local_eval, mesh=mesh,
+      in_specs=(sspec,) + bspec,
+      out_specs=P(axis_name)))
 
 
 def make_eval_step(pred_fn: Callable, mesh: Optional[Mesh],
                    params: Any, batch_example: Any, axis_name: str = "mp",
                    batch_specs: Any = None):
-  """Jitted distributed forward for evaluation.
-
-  Per-device predictions come back batch-sharded (``P(axis_name)``); reading
-  the returned global array gives all predictions — the single-controller
-  equivalent of the reference's ``hvd.allgather`` of eval outputs
-  (`examples/dlrm/main.py:222-243`)."""
+  """Jitted distributed forward for evaluation (simple-layout params)."""
 
   def local_eval(params, *batch):
     return pred_fn(params, *batch)
