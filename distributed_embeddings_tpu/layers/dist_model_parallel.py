@@ -7,9 +7,10 @@ column_slice_threshold, row_slice, dp_input, input_table_map) but a
 TPU-native execution model:
 
 - Physical layout: per (width, combiner) class, all ranks' fused tables are
-  stacked into one array ``[world, max_rows, width]`` sharded over the mesh
-  axis. One array per class instead of N per-rank variables makes the whole
-  model a uniform SPMD program (see ``parallel/lookup_engine.py``).
+  stacked row-wise into one 2-D array ``[world * max_rows, width]`` sharded
+  over the mesh axis. One array per class instead of N per-rank variables
+  makes the whole model a uniform SPMD program (see
+  ``parallel/lookup_engine.py``).
 - Comm: ``lax.all_to_all`` inside ``shard_map`` replaces ``hvd.alltoall``.
 - Hybrid single-backward: embedding grads are grads of mesh-sharded arrays —
   local by construction. Dense grads are finalized by ``DistributedOptimizer``
@@ -50,13 +51,14 @@ def is_model_parallel_param(path_element_names: Sequence[str]) -> bool:
 
 
 def make_class_initializer(plan: DistEmbeddingStrategy, key):
-  """Initializer for one class buffer [world, max_rows, width].
+  """Initializer for one class buffer [world * max_rows, width].
 
   Each member shard's rows are drawn from its own table initializer (column
   slices get independent draws at slice shape, matching the reference where
   each slice is its own variable); padding rows are zeros. Equivalent of the
   reference ``ConcatInitializer`` (`dist_model_parallel.py:29-40`) extended
-  with row padding.
+  with row padding. Rank blocks concatenate along the row axis (see
+  ``DistributedLookup.param_shapes``).
   """
   cp = plan.classes[key]
   world = plan.world_size
@@ -76,7 +78,7 @@ def make_class_initializer(plan: DistEmbeddingStrategy, key):
         parts.append(jnp.zeros((pad, cp.width), dtype))
       blocks.append(jnp.concatenate(parts, axis=0) if parts
                     else jnp.zeros((rows, cp.width), dtype))
-    return jnp.stack(blocks)
+    return jnp.concatenate(blocks, axis=0)
 
   return init
 
@@ -99,8 +101,8 @@ class DistributedEmbedding(nn.Module):
     axis_name: mesh axis to communicate over.
 
   Usage with a mesh (world > 1): init params outside shard_map (class params
-  get shape [world, max_rows, width]), shard them with
-  ``PartitionSpec(axis_name, None, None)``, and call apply inside
+  get shape [world * max_rows, width]), shard them with
+  ``PartitionSpec(axis_name, None)``, and call apply inside
   ``shard_map``. With world == 1 it is an ordinary layer.
   """
 
@@ -151,9 +153,9 @@ class DistributedEmbedding(nn.Module):
         class_params[name] = self.param(
             name, make_class_initializer(plan, key), shape)
       else:
-        # Read the stored value directly: under shard_map the [world, R, w]
-        # param arrives as its local [1, R, w] block, which flax's
-        # shape-checking self.param would reject.
+        # Read the stored value directly: under shard_map the
+        # [world * R, w] param arrives as its local [R, w] block, which
+        # flax's shape-checking self.param would reject.
         class_params[name] = self.scope.get_variable("params", name)
 
     if self.is_initializing() and self.world_size > 1:
@@ -201,8 +203,9 @@ def get_weights(plan: DistEmbeddingStrategy,
       key = plan.class_key_of(shard)
       cp = plan.classes[key]
       idx = cp.shards_per_rank[rank].index(shard)
-      row0 = cp.row_offsets_per_rank[rank][idx]
-      block = host[class_param_name(*key)][rank, row0:row0 + shard.input_dim, :]
+      row0 = rank * padded_rows(plan, key) + \
+          cp.row_offsets_per_rank[rank][idx]
+      block = host[class_param_name(*key)][row0:row0 + shard.input_dim, :]
       col_parts.append(block)
     weights.append(np.concatenate(col_parts, axis=1) if len(col_parts) > 1
                    else col_parts[0])
@@ -225,7 +228,7 @@ def set_weights(plan: DistEmbeddingStrategy,
       (TPU-native replacement for the reference's chunked scatter_update).
 
   Returns:
-    name -> [world, max_rows, width] arrays (numpy if mesh is None).
+    name -> [world * max_rows, width] arrays (numpy if mesh is None).
   """
   if len(weights) != len(plan.global_configs):
     raise ValueError(
@@ -250,16 +253,17 @@ def set_weights(plan: DistEmbeddingStrategy,
   for key in plan.class_keys:
     cp = plan.classes[key]
     name = class_param_name(*key)
-    shape = (plan.world_size, padded_rows(plan, key), cp.width)
+    rows = padded_rows(plan, key)
+    shape = (plan.world_size * rows, cp.width)
     if mesh is None:
-      out[name] = np.stack([rank_block(key, r)
-                            for r in range(plan.world_size)])
+      out[name] = np.concatenate([rank_block(key, r)
+                                  for r in range(plan.world_size)])
     else:
-      sharding = NamedSharding(mesh, P(axis_name, None, None))
+      sharding = NamedSharding(mesh, P(axis_name, None))
 
-      def cb(index, key=key):
-        rank = index[0].start or 0
-        return rank_block(key, rank)[None]
+      def cb(index, key=key, rows=rows):
+        rank = (index[0].start or 0) // rows
+        return rank_block(key, rank)
 
       out[name] = jax.make_array_from_callback(shape, sharding, cb)
   return out
@@ -287,17 +291,17 @@ def broadcast_variables(variables, root_rank: int = 0):
 def hybrid_partition_specs(tree, axis_name: str = "mp"):
   """PartitionSpecs for any params-structured pytree (incl. optax states).
 
-  Leaves under an ``mp_table_*`` key get ``P(axis_name, None, None)`` (the
-  class-stacked table layout); everything else is replicated ``P()``. Use for
-  shard_map in/out_specs of params, grads, and optimizer states — e.g.
-  adagrad's ``sum_of_squares`` mirrors the param tree and must shard the
-  same way (the reference gets this implicitly from per-rank TF slot
-  variables; here it is one tree_map).
+  Leaves under an ``mp_table_*`` key get ``P(axis_name, None)`` (the
+  class-stacked ``[world * rows, width]`` table layout); everything else is
+  replicated ``P()``. Use for shard_map in/out_specs of params, grads, and
+  optimizer states — e.g. adagrad's ``sum_of_squares`` mirrors the param
+  tree and must shard the same way (the reference gets this implicitly from
+  per-rank TF slot variables; here it is one tree_map).
   """
   def spec(path, leaf):
     names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
-    if is_model_parallel_param(names) and getattr(leaf, "ndim", 0) == 3:
-      return P(axis_name, None, None)
+    if is_model_parallel_param(names) and getattr(leaf, "ndim", 0) == 2:
+      return P(axis_name, None)
     return P()
 
   return jax.tree_util.tree_map_with_path(spec, tree)

@@ -21,8 +21,9 @@ with the same observable semantics:
 
 On top of the per-rank view, this planner also emits a **width-class layout**
 unique to the TPU build: for every distinct (width, combiner) class, each
-rank's fused table becomes one row-padded entry of a uniform stacked array
-``[world, max_rows, width]``. That turns the reference's per-rank heterogeneous
+rank's fused table becomes one row-padded block of a uniform row-stacked 2-D
+array ``[world * max_rows, width]`` (sharded ``PartitionSpec(axis, None)``
+over the mesh). That turns the reference's per-rank heterogeneous
 program (each GPU runs different lookups) into a single SPMD program — the same
 XLA code on every device — which is what ``shard_map``/``pjit`` require and what
 makes the hybrid-parallel backward a single compiled graph on TPU.
@@ -35,9 +36,15 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from .embedding import Embedding, TableConfig
 
-# (width, combiner, kind) — kind is 'sparse' (row-gather path) or 'dense'
-# (small-vocab MXU one-hot path; see DistEmbeddingStrategy.dense_row_threshold)
-ClassKey = Tuple[int, Optional[str], str]
+# (width, combiner, kind, gen) — kind is 'sparse' (row-gather path) or
+# 'dense' (small-vocab MXU one-hot path; see
+# DistEmbeddingStrategy.dense_row_threshold). gen splits one width class
+# into multiple fused buffers so each per-rank buffer stays under
+# ``max_class_bytes``: XLA inserts a full copy of any >= 4 GiB buffer on
+# every use (2^32-byte addressing), which would cost two multi-GiB copies
+# per train step under unbounded fusion. Every input's ids statically
+# target exactly one generation, so the split adds no per-index work.
+ClassKey = Tuple[int, Optional[str], str, int]
 
 
 @dataclasses.dataclass
@@ -50,6 +57,7 @@ class Shard:
   input_dim: int
   combiner: Optional[str]
   initializer: object
+  gen: int = 0  # width-class generation (assigned by the planner)
 
   @property
   def width(self) -> int:
@@ -75,7 +83,8 @@ class WidthClassPlan:
 
   ``shards_per_rank[r]`` lists rank r's shards fused (row-concatenated) into
   this class's buffer; ``rows_per_rank[r]`` is the unpadded row count. The
-  physical array is ``[world, max_rows, width]`` sharded over the mesh axis.
+  physical array is ``[world * max_rows, width]`` sharded over the mesh axis
+  (rank r's block at rows ``[r * max_rows, (r + 1) * max_rows)``).
   ``slots_per_rank[r]`` lists the lookups rank r performs for this class;
   ``num_slots`` is the padded (max) slot count used by the SPMD program.
   """
@@ -227,7 +236,8 @@ class DistEmbeddingStrategy:
                strategy: str = "basic",
                input_table_map: Optional[Sequence[int]] = None,
                column_slice_threshold: Optional[int] = None,
-               dense_row_threshold: int = 0):
+               dense_row_threshold: int = 0,
+               max_class_bytes: int = 2 * 1024 ** 3):
     if strategy not in ("basic", "memory_balanced", "memory_optimized"):
       raise ValueError(f"Unsupported shard strategy {strategy}")
     self.strategy = "basic" if world_size == 1 else strategy
@@ -304,13 +314,34 @@ class DistEmbeddingStrategy:
                       for shards in self.rank_shards]
 
     # ---- per-rank inputs + width-class fusion ----------------------------
+    # Generation assignment (first-fit per rank): cap each rank's fused
+    # buffer at max_class_bytes of simple-layout f32 (the packed layout
+    # doubles this per optimizer-state slot — one aux slot lands just
+    # under XLA's 4 GiB copy-on-use threshold at the 2 GiB default). A
+    # single shard larger than the cap gets a generation of its own.
+    self.max_class_bytes = max_class_bytes
+    for shards in self.rank_shards:
+      gen_rows: Dict[tuple, List[int]] = {}
+      for sh in shards:
+        base = (sh.width, sh.combiner, self._kind_of(sh))
+        rows_list = gen_rows.setdefault(base, [0])
+        cap_rows = max(1, max_class_bytes // (sh.width * 4))
+        for g, r in enumerate(rows_list):
+          if r == 0 or r + sh.input_dim <= cap_rows:
+            sh.gen = g
+            rows_list[g] += sh.input_dim
+            break
+        else:
+          sh.gen = len(rows_list)
+          rows_list.append(sh.input_dim)
+
     class_keys: List[ClassKey] = []
     for shards in self.rank_shards:
       for sh in shards:
         key = self.class_key_of(sh)
         if key not in class_keys:
           class_keys.append(key)
-    class_keys.sort(key=lambda k: (k[0], str(k[1]), k[2]))
+    class_keys.sort(key=lambda k: (k[0], str(k[1]), k[2], k[3]))
     self.class_keys = class_keys
 
     self.classes: Dict[ClassKey, WidthClassPlan] = {
@@ -408,10 +439,12 @@ class DistEmbeddingStrategy:
     ]
 
   # ---- convenience -------------------------------------------------------
-  def class_key_of(self, shard: Shard) -> ClassKey:
-    kind = ("dense" if shard.input_dim <= self.dense_row_threshold
+  def _kind_of(self, shard: Shard) -> str:
+    return ("dense" if shard.input_dim <= self.dense_row_threshold
             else "sparse")
-    return (shard.width, shard.combiner, kind)
+
+  def class_key_of(self, shard: Shard) -> ClassKey:
+    return (shard.width, shard.combiner, self._kind_of(shard), shard.gen)
 
   def table_shard_map(self, table_id: int) -> List[Tuple[int, Shard]]:
     """All (rank, shard) holding columns of ``table_id``, in column order."""

@@ -261,19 +261,21 @@ def gather_fused(layout: PackedLayout, buf: jax.Array,
 
 
 def gather_fused_chunked(layout: PackedLayout, buf: jax.Array,
-                         ids: jax.Array, chunk: int = 1 << 18) -> jax.Array:
+                         ids: jax.Array, chunk: int = 1 << 20) -> jax.Array:
   """:func:`gather_fused` with bounded temporaries.
 
-  A one-shot fused gather of N ids materializes ``[N, phys_width]`` (512 B
-  per id) plus the sub-row-select einsum chain — several GiB at benchmark
-  batch sizes. Running the same gather as a ``lax.map`` over fixed-size id
-  chunks bounds the live temporaries to one chunk (the stacked output is
-  exactly the final ``[N, stride]`` result) at identical row-op cost, since
-  indexed ops are row-bound, not launch-bound.
+  When ``rows_per_phys == 1`` (stride >= 128 lanes — e.g. the width-128
+  DLRM tables) a fused gather is a single XLA row gather with no staging
+  beyond its own output, so it runs one-shot regardless of size. Narrow
+  rows (``rpp > 1``) stage ``[N, phys_width]`` (512 B per id) plus the
+  sub-row-select einsum chain — several GiB at benchmark batch sizes — so
+  they run as a ``lax.map`` over fixed-size id chunks, which bounds live
+  temporaries to one chunk at identical row-op cost (indexed ops are
+  row-bound, not launch-bound).
   """
   flat = ids.reshape(-1)
   n = flat.shape[0]
-  if n <= chunk:
+  if layout.rows_per_phys == 1 or n <= chunk:
     return gather_fused(layout, buf, ids)
   nchunks = -(-n // chunk)
   pad = nchunks * chunk - n

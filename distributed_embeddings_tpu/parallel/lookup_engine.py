@@ -61,9 +61,11 @@ PAD_ID = -1  # marks hotness padding in dense-padded ragged inputs
 
 
 def class_param_name(width: int, combiner: Optional[str],
-                     kind: str = "sparse") -> str:
+                     kind: str = "sparse", gen: int = 0) -> str:
   base = f"mp_table_w{width}_{combiner if combiner else 'cat'}"
-  return base if kind == "sparse" else base + "_dense"
+  if kind != "sparse":
+    base += "_dense"
+  return base if gen == 0 else f"{base}_g{gen}"
 
 
 def vocab_cap(n: int) -> int:
@@ -94,17 +96,18 @@ class BucketKey(NamedTuple):
   width: int
   combiner: str  # "" encodes combiner=None
   kind: str
+  gen: int
   h: int
   vcap: int
 
   @property
   def class_key(self):
-    return (self.width, self.combiner or None, self.kind)
+    return (self.width, self.combiner or None, self.kind, self.gen)
 
 
 def bucket_key(class_key, h: int, vcap: int) -> BucketKey:
-  w, c, kind = class_key
-  return BucketKey(w, c or "", kind, h, vcap)
+  w, c, kind, gen = class_key
+  return BucketKey(w, c or "", kind, gen, h, vcap)
 
 
 def class_buckets(plan: DistEmbeddingStrategy, key, hotness_of) -> List[Bucket]:
@@ -197,14 +200,16 @@ class DistributedLookup:
   """Functional lookup engine bound to one :class:`DistEmbeddingStrategy`.
 
   Call the methods inside ``shard_map`` (world > 1) with each class param
-  passed as the local block ``[1, rows, width]`` (simple layout) or
-  ``[1, phys_rows, phys_width]`` (fused layout), or anywhere when world == 1.
+  passed as the local block ``[rows, width]`` (simple layout) or
+  ``[phys_rows, phys_width]`` (fused layout), or anywhere when world == 1.
+  Global class params are ``[world * rows, width]`` with rank blocks
+  stacked along the row axis, sharded ``PartitionSpec(axis, None)``.
 
   Two layouts/paths:
 
-  - **simple** (:meth:`forward`): class params ``[world, rows, width]``;
-    fully differentiable (XLA autodiff produces dense table grads). Used by
-    the flax module, tests, eval, and small models.
+  - **simple** (:meth:`forward`): fully differentiable (XLA autodiff
+    produces dense table grads). Used by the flax module, tests, eval,
+    and small models.
   - **fused** (:meth:`forward_fused` / :meth:`apply_sparse`): sparse-class
     params packed with optimizer-state rows (`ops/packed_table.py`); the
     performance training path — forward gathers carry the optimizer state,
@@ -212,7 +217,7 @@ class DistributedLookup:
   """
 
   def __init__(self, plan: DistEmbeddingStrategy, dp_input: bool = True,
-               axis_name: str = "mp", apply_chunk: int = 1 << 18):
+               axis_name: str = "mp", apply_chunk: int = 1 << 22):
     self.plan = plan
     self.dp_input = dp_input
     self.axis_name = axis_name
@@ -228,12 +233,17 @@ class DistributedLookup:
 
   # ---- shapes ------------------------------------------------------------
   def param_shapes(self) -> Dict[str, tuple]:
-    """Simple-layout class param shapes (flax module / checkpoint view)."""
+    """Simple-layout class param shapes (flax module / checkpoint view).
+
+    ``[world * padded_rows, width]``: rank r's fused block lives at rows
+    ``[r * padded_rows, (r + 1) * padded_rows)``; sharding the row axis
+    over the mesh (``PartitionSpec(axis, None)``) gives each device
+    exactly its block."""
     shapes = {}
     for key in self.plan.class_keys:
       cp = self.plan.classes[key]
       shapes[class_param_name(*key)] = (
-          self.plan.world_size, padded_rows(self.plan, key), cp.width)
+          self.plan.world_size * padded_rows(self.plan, key), cp.width)
     return shapes
 
   def fused_layouts(self, rule: SparseRule) -> Dict[str, PackedLayout]:
@@ -497,8 +507,9 @@ class DistributedLookup:
     """Differentiable distributed lookup on simple-layout params.
 
     Args:
-      class_params: name -> [1, rows, width] local block (or the full
-        [world, rows, width] when world == 1... the leading dim must be 1).
+      class_params: name -> [rows, width] local block (under shard_map
+        with ``PartitionSpec(axis, None)``; with world == 1 the full
+        array is the block).
       inputs: per global input, [B_local] or [B_local, H] int ids
         (PAD_ID entries ignored).
       return_residuals: also return the post-exchange id tensors
@@ -536,14 +547,20 @@ class DistributedLookup:
 
   @staticmethod
   def _squeeze_local(p: jax.Array) -> jax.Array:
-    if p.ndim != 3:
+    """Validate a local class-param block.
+
+    Class params are 2-D ``[world * rows, width]`` sharded
+    ``PartitionSpec(axis, None)``; inside shard_map the local block is
+    ``[rows, width]`` and is used directly. (An earlier ``[world, rows,
+    width]`` convention left a unit leading dim on the local block, which
+    made XLA pick a non-default {2,0,1:T(1,128)} layout for the multi-GiB
+    buffer and insert full layout-conversion copies every step.)
+    """
+    if p.ndim != 2:
       raise ValueError(
-          f"class param must be 3-D [shards, rows, width], got {p.shape}")
-    if p.shape[0] != 1:
-      raise ValueError(
-          "expected the local block of a class param (leading dim 1); pass "
-          "params through shard_map with PartitionSpec('mp', None, None)")
-    return p[0]
+          f"class param must be 2-D [rows, width] (the local block of a "
+          f"[world * rows, width] array), got {p.shape}")
+    return p
 
   # ---- fused training path -----------------------------------------------
   def lookup_sparse_fused(self, fused_params: Dict[str, jax.Array],
@@ -649,64 +666,54 @@ class DistributedLookup:
         delta = rule.delta(g, aux, step)
         buf = scatter_add_fused(layout, buf, ids, delta)
       else:
-        # fast path: lax.scan over fixed-size id chunks. Each iteration
-        # slices its cotangent rows out of the compact [n_b*G, w] tensor
-        # (the per-occurrence broadcast is never materialized), computes the
-        # fused delta, and scatter-adds it; the carried buffer updates in
-        # place, so peak temps are one chunk regardless of batch/hotness.
-        for ids, dzb, aux, h in parts:
-          n = int(np.prod(ids.shape))
-          ids_f = ids.reshape(-1)
-          dz_f = dzb.reshape(-1, w)
-          aux_f = aux.reshape(-1, rule.n_aux * w) if aux is not None else None
-          chunk = max(h, (self.apply_chunk // h) * h)
-
-          def delta_of(ids_c, g_c, aux_c):
-            d = rule.delta(
-                g_c, aux_c.reshape(ids_c.shape + (rule.n_aux, w))
-                if aux_c is not None else None, step)
-            return d
-
-          if n <= chunk:
-            buf = scatter_add_fused(
-                layout, buf, ids_f,
-                delta_of(ids_f,
-                         jnp.repeat(dz_f, h, axis=0) if h > 1 else dz_f,
-                         aux_f))
-            continue
-          nchunks = -(-n // chunk)
-          pad = nchunks * chunk - n
-          ids_p = jnp.concatenate(
-              [ids_f, jnp.full((pad,), -1, ids_f.dtype)]) if pad else ids_f
-          if pad:
-            # pad the gradient/aux sources to the same occurrence count so
-            # the per-chunk slices stay aligned with the ids (an
-            # edge-clamped slice would shift the whole last chunk)
-            dz_f = jnp.concatenate(
-                [dz_f, jnp.zeros((pad // h, dz_f.shape[1]), dz_f.dtype)])
-            if aux_f is not None:
-              aux_f = jnp.concatenate(
-                  [aux_f, jnp.zeros((pad, aux_f.shape[1]), aux_f.dtype)])
-
-          def body(b, xs, dz_f=dz_f, aux_f=aux_f, h=h, chunk=chunk,
-                   layout=layout):
-            ids_c, k = xs
-            start = k * chunk
-            g_c = lax.dynamic_slice(dz_f, (start // h, 0),
-                                    (chunk // h, dz_f.shape[1]))
+        # fast path: ONE scatter-add for the whole class. Any chain of
+        # scatters on the same buffer (lax.scan carry or unrolled
+        # ``.at[].add`` links) defeats XLA's in-place buffer aliasing on
+        # TPU: each link inserts a full copy of the multi-GiB class buffer
+        # (measured: 5 copies x ~16 ms/step on the DLRM bench). A single
+        # scatter aliases the donated buffer with zero copies, so all
+        # buckets' ids/deltas are concatenated and applied at once.
+        n_total = sum(int(np.prod(ids.shape)) for ids, _, _, _ in parts)
+        if n_total <= self.apply_chunk:
+          all_ids, all_deltas = [], []
+          for ids, dzb, aux, h in parts:
+            n = int(np.prod(ids.shape))
+            g = dzb.reshape(-1, w)
             if h > 1:
-              g_c = jnp.broadcast_to(g_c[:, None, :],
-                                     (chunk // h, h, g_c.shape[1]))
-              g_c = g_c.reshape(chunk, -1)
-            aux_c = None if aux_f is None else lax.dynamic_slice(
-                aux_f, (start, 0), (chunk, aux_f.shape[1]))
-            return scatter_add_fused(layout, b, ids_c,
-                                     delta_of(ids_c, g_c, aux_c)), None
-
-          buf, _ = lax.scan(
-              body, buf,
-              (ids_p.reshape(nchunks, chunk), jnp.arange(nchunks)))
-      new_params[name] = buf[None]
+              g = jnp.broadcast_to(g[:, None, :],
+                                   (n // h, h, w)).reshape(n, w)
+            aux_r = (aux.reshape(-1, rule.n_aux, w) if aux is not None
+                     else None)
+            all_ids.append(ids.reshape(-1))
+            all_deltas.append(rule.delta(g, aux_r, step))
+          buf = scatter_add_fused(
+              layout, buf,
+              all_ids[0] if len(all_ids) == 1
+              else jnp.concatenate(all_ids),
+              all_deltas[0] if len(all_deltas) == 1
+              else jnp.concatenate(all_deltas))
+        else:
+          # memory escape hatch for extreme occurrence counts (hotness
+          # 200-500 models): compute the delta per chunk (never holding
+          # the full per-occurrence delta) and scatter chunk-wise, at the
+          # cost of one buffer copy per extra link.
+          for ids, dzb, aux, h in parts:
+            n = int(np.prod(ids.shape))
+            ids_f = ids.reshape(-1)
+            dz_f = dzb.reshape(-1, w)
+            aux_f = (aux.reshape(-1, rule.n_aux, w) if aux is not None
+                     else None)
+            chunk = max(h, (self.apply_chunk // h) * h)
+            for c0 in range(0, n, chunk):
+              cn = min(chunk, n - c0)
+              g_c = dz_f[c0 // h:(c0 + cn) // h]
+              if h > 1:
+                g_c = jnp.broadcast_to(g_c[:, None, :],
+                                       (cn // h, h, w)).reshape(cn, w)
+              aux_c = None if aux_f is None else aux_f[c0:c0 + cn]
+              buf = scatter_add_fused(layout, buf, ids_f[c0:c0 + cn],
+                                      rule.delta(g_c, aux_c, step))
+      new_params[name] = buf
     return new_params
 
   # ---- model-parallel input mode -----------------------------------------

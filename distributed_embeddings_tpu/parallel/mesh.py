@@ -35,8 +35,8 @@ def create_mesh(world_size: Optional[int] = None,
 
 
 def table_sharding(mesh: Mesh, axis_name: str = DEFAULT_AXIS) -> NamedSharding:
-  """Sharding for class-stacked table params [world, rows, width]."""
-  return NamedSharding(mesh, P(axis_name, None, None))
+  """Sharding for class-stacked table params [world * rows, width]."""
+  return NamedSharding(mesh, P(axis_name, None))
 
 
 def batch_sharding(mesh: Mesh, axis_name: str = DEFAULT_AXIS) -> NamedSharding:

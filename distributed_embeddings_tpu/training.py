@@ -138,8 +138,10 @@ def init_sparse_state(plan: DistEmbeddingStrategy,
       # inputs). For classes near HBM size, where holding source + packed
       # at once cannot fit, use init_sparse_state_direct instead.
       def pack_all(a, layout=layout):
-        return jnp.stack([layout.pack_chunked(a[r], rule.aux_init)
-                          for r in range(a.shape[0])])
+        rows = a.shape[0] // plan.world_size
+        return jnp.concatenate(
+            [layout.pack_chunked(a[r * rows:(r + 1) * rows], rule.aux_init)
+             for r in range(plan.world_size)])
 
       fused[name] = jax.jit(pack_all)(arr)
     else:
@@ -215,16 +217,14 @@ def init_sparse_state_direct(plan: DistEmbeddingStrategy,
           for off, n, sc in spans:
             scale_rows = jnp.where((r_idx >= off) & (r_idx < off + n), sc,
                                    scale_rows)
-          # leading world dim added inside jit: a reshape here fuses into
-          # the builder, while an out-of-jit [None] would copy the buffer
           return init_packed_uniform(layout, k, scale_rows, rule.aux_init,
-                                     dtype)[None]
+                                     dtype)
 
         blocks.append(jax.jit(build)(jax.random.fold_in(sub, r)))
       fused[name] = (jnp.concatenate(blocks) if len(blocks) > 1
                      else blocks[0])
     else:
-      shape = (plan.world_size, padded_rows(plan, key), cp.width)
+      shape = (plan.world_size * padded_rows(plan, key), cp.width)
       emb_dense[name] = make_class_initializer(plan, key)(sub, shape, dtype)
 
   opt = emb_dense_optimizer or dense_optimizer
@@ -246,7 +246,7 @@ def unpack_sparse_state(plan: DistEmbeddingStrategy, rule: SparseRule,
   """Fused state -> ``(params, aux)`` in the simple/flax layout.
 
   ``params[emb_collection]`` holds every class table as
-  ``[world, rows, width]`` (checkpoint / ``get_weights`` view); with
+  ``[world * rows, width]`` (checkpoint / ``get_weights`` view); with
   ``include_aux``, ``aux`` maps sparse class names to their optimizer-state
   arrays (otherwise empty)."""
   engine = DistributedLookup(plan, axis_name=axis_name)
@@ -258,12 +258,16 @@ def unpack_sparse_state(plan: DistEmbeddingStrategy, rule: SparseRule,
     if plan.classes[key].kind == "sparse":
       layout = layouts[name]
       buf = state["fused"][name]
-      tables[name] = jnp.stack(
-          [layout.unpack_table_chunked(buf[r]) for r in range(buf.shape[0])])
+
+      def rank_bufs(buf=buf, layout=layout):
+        return [buf[r * layout.phys_rows:(r + 1) * layout.phys_rows]
+                for r in range(plan.world_size)]
+
+      tables[name] = jnp.concatenate(
+          [layout.unpack_table_chunked(b) for b in rank_bufs()])
       if include_aux:
         aux_out[name] = tuple(
-            jnp.stack([layout.unpack(buf[r])[1][j]
-                       for r in range(buf.shape[0])])
+            jnp.concatenate([layout.unpack(b)[1][j] for b in rank_bufs()])
             for j in range(rule.n_aux))
     else:
       tables[name] = state["emb_dense"][name]

@@ -45,10 +45,10 @@ def test_module_init_and_apply_under_shard_map():
   for key in plan.class_keys:
     cp = plan.classes[key]
     arr = variables["params"][f"mp_table_w{key[0]}_cat"]
-    assert arr.shape == (WORLD, cp.max_rows, cp.width)
+    assert arr.shape == (WORLD * cp.max_rows, cp.width)
 
   mesh = make_mesh()
-  pspecs = {"params": {n: P("mp", None, None) for n in names}}
+  pspecs = {"params": {n: P("mp", None) for n in names}}
 
   def fwd(variables, *inputs):
     return tuple(dmp.apply(variables, list(inputs)))
@@ -80,7 +80,7 @@ def test_module_trains_with_distributed_optimizer():
   opt = DistributedOptimizer(optax.sgd(0.05), axis_name="mp")
   opt_state = opt.init(params)
   mesh = make_mesh()
-  emb_specs = {n: P("mp", None, None) for n in emb_vars}
+  emb_specs = {n: P("mp", None) for n in emb_vars}
   pspec = {"emb": emb_specs, "dense": {"w": P()}}
   ospec = jax.tree_util.tree_map(lambda _: P(), opt_state)
   # optimizer state mirrors param sharding where it has param structure
@@ -157,6 +157,6 @@ def test_hybrid_partition_specs_for_adagrad_state():
   for path, spec in leaves:
     names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
     if any(str(n).startswith("mp_table_") for n in names):
-      assert spec == P("mp", None, None), (names, spec)
+      assert spec == P("mp", None), (names, spec)
     else:
       assert spec == P(), (names, spec)

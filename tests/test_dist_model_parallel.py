@@ -40,7 +40,7 @@ def make_mesh(world=WORLD):
 
 
 def param_specs(plan):
-  return {class_param_name(*k): P("mp", None, None) for k in plan.class_keys}
+  return {class_param_name(*k): P("mp", None) for k in plan.class_keys}
 
 
 def gen_weights(rng, configs):
@@ -301,7 +301,7 @@ def test_set_weights_sharded_via_callback():
   mesh = make_mesh()
   params = set_weights(plan, weights, mesh=mesh)
   for k, v in params.items():
-    assert v.sharding.spec == P("mp", None, None)
+    assert v.sharding.spec == P("mp", None)
   back = get_weights(plan, params)
   for a, b in zip(weights, back):
     np.testing.assert_array_equal(a, np.asarray(b))
@@ -403,6 +403,6 @@ def test_forward_mp_stale_packed_shape_raises():
   engine = DistributedLookup(plan, dp_input=False)
   name = class_param_name(8, None) + "_h1"
   bad = {name: jnp.zeros((1, 3, 8, 2), jnp.int32)}  # wrong n_b and h
-  params = {class_param_name(8, None): jnp.zeros((1, 16, 8))}
+  params = {class_param_name(8, None): jnp.zeros((16, 8))}
   with pytest.raises(ValueError, match="packed input"):
     engine.forward_mp(params, bad)

@@ -187,3 +187,69 @@ def test_world_one_keeps_fusion_but_skips_comm_strategy():
 def test_invalid_strategy_raises():
   with pytest.raises(ValueError):
     DistEmbeddingStrategy(_configs([10]), 2, strategy="bogus")
+
+
+def test_class_generation_split_caps_buffer_bytes():
+  """max_class_bytes splits a width class into generations so no per-rank
+  fused buffer exceeds the cap (XLA copies any >= 4 GiB buffer on every
+  use; see ClassKey docs). Forced here with a tiny cap."""
+  sizes = [100, 80, 60, 50, 40, 30]
+  cap = 120 * 8 * 4  # rows*width*4 bytes -> 120 rows per generation
+  plan = DistEmbeddingStrategy(_configs(sizes), 2, strategy="basic",
+                               max_class_bytes=cap)
+  assert len(plan.class_keys) > 1  # split happened
+  gens = {k[3] for k in plan.class_keys}
+  assert gens == set(range(len(gens)))
+  for key in plan.class_keys:
+    cp = plan.classes[key]
+    for rows in cp.rows_per_rank:
+      # a generation holding a single over-cap shard may exceed the cap;
+      # none of these shards are over-cap, so all gens obey it
+      assert rows * cp.width * 4 <= cap
+  # every table's rows appear exactly once across (rank, gen)
+  total = sum(sum(cp.rows_per_rank) for cp in plan.classes.values())
+  assert total == sum(sizes)
+
+
+def test_class_generation_single_oversized_shard_gets_own_gen():
+  sizes = [500, 10]
+  cap = 100 * 8 * 4  # smaller than the big table alone
+  plan = DistEmbeddingStrategy(_configs(sizes), 1, strategy="basic",
+                               max_class_bytes=cap)
+  rows_by_gen = {k[3]: plan.classes[k].rows_per_rank[0]
+                 for k in plan.class_keys}
+  assert sorted(rows_by_gen.values()) == [10, 500]
+
+
+def test_generation_split_forward_matches_unsplit():
+  """Same lookup results with and without a forced generation split."""
+  import jax
+  import jax.numpy as jnp
+
+  from distributed_embeddings_tpu.layers.dist_model_parallel import (
+      get_weights,
+      set_weights,
+  )
+  from distributed_embeddings_tpu.parallel.lookup_engine import (
+      DistributedLookup,
+      class_param_name,
+  )
+
+  rng = np.random.default_rng(7)
+  sizes = [40, 30, 20, 10]
+  weights = [rng.standard_normal((s, 8)).astype(np.float32) for s in sizes]
+  ids = [jnp.asarray(rng.integers(0, s, 16).astype(np.int32)) for s in sizes]
+
+  outs = {}
+  for cap in (1 << 30, 24 * 8 * 4):
+    plan = DistEmbeddingStrategy(_configs(sizes), 1, strategy="basic",
+                                 max_class_bytes=cap)
+    params = {name: jnp.asarray(arr)
+              for name, arr in set_weights(plan, weights).items()}
+    engine = DistributedLookup(plan)
+    outs[cap] = engine.forward(params, ids)
+    got = get_weights(plan, params)
+    for w, g in zip(weights, got):
+      np.testing.assert_array_equal(w, g)
+  for a, b in zip(outs[1 << 30], outs[24 * 8 * 4]):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -462,7 +462,7 @@ def test_apply_sparse_chunked_matches_single_shot():
     acc = np.full((50, 8), 0.1, np.float32)
     buf = jnp.asarray(layout.pack(
         np.pad(table, ((0, layout.rows - 50), (0, 0))),
-        [np.pad(acc, ((0, layout.rows - 50), (0, 0)))]))[None]
+        [np.pad(acc, ((0, layout.rows - 50), (0, 0)))]))
     fused = {name: buf}
     ids_all = engine.route_ids([ids_in])
     _, residuals = engine.lookup_sparse_fused(fused, layouts, ids_all)

@@ -1,0 +1,89 @@
+"""Isolate apply_sparse cost on the chip: chunked scan vs one-shot scatter.
+
+Usage: python tools/profile_apply.py [apply_chunk_log2] [model] [batch]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributed_embeddings_tpu.layers.planner import DistEmbeddingStrategy
+from distributed_embeddings_tpu.models import (
+    SYNTHETIC_MODELS,
+    SyntheticModel,
+    bce_loss,
+    expand_tables,
+    generate_batch,
+)
+from distributed_embeddings_tpu.ops.packed_table import adagrad_rule
+from distributed_embeddings_tpu.parallel.lookup_engine import DistributedLookup
+from distributed_embeddings_tpu.training import init_sparse_state_direct
+
+CHUNK_LOG = int(sys.argv[1]) if len(sys.argv) > 1 else 23
+MODEL = sys.argv[2] if len(sys.argv) > 2 else "tiny"
+BATCH = int(sys.argv[3]) if len(sys.argv) > 3 else 65536
+K = 4
+
+
+def main():
+  cfg = SYNTHETIC_MODELS[MODEL]
+  tables, tmap, hotness = expand_tables(cfg)
+  model = SyntheticModel(config=cfg, world_size=1)
+  plan = DistEmbeddingStrategy(tables, 1, "basic", input_table_map=tmap,
+                               dense_row_threshold=model.dense_row_threshold)
+  numerical, cats, labels = generate_batch(cfg, BATCH, alpha=1.05, seed=0)
+  cats = [np.minimum(c, tables[t].input_dim - 1).astype(np.int32)
+          for c, t in zip(cats, tmap)]
+  cats = [jnp.asarray(c if h > 1 else c[:, 0])
+          for c, h in zip(cats, hotness)]
+
+  rule = adagrad_rule(0.01)
+  dense_opt = optax.adagrad(0.01)
+  dummy_acts = [jnp.zeros((2, tables[t].output_dim), jnp.float32)
+                for t in tmap]
+  small_cats = [c[:2] for c in cats]
+  dense_params = model.init(jax.random.PRNGKey(0),
+                            jnp.asarray(numerical[:2]), small_cats,
+                            emb_acts=dummy_acts)["params"]
+  state = init_sparse_state_direct(plan, rule, dense_params, dense_opt,
+                                   jax.random.PRNGKey(1))
+  fused = state["fused"]
+  jax.block_until_ready(fused)
+
+  engine = DistributedLookup(plan, apply_chunk=1 << CHUNK_LOG)
+  layouts = engine.fused_layouts(rule)
+  hotness_of = lambda i: hotness[i]  # noqa: E731
+
+  @jax.jit
+  def roundtrip(fused, cats_):
+    """gather + apply, returning the updated fused params (donatable)."""
+    ids_all = engine.route_ids(cats_, hotness_of)
+    z, res = engine.lookup_sparse_fused(fused, layouts, ids_all)
+    d_z = {bk: zb * 1e-6 for bk, zb in z.items()}
+    return engine.apply_sparse(fused, layouts, d_z, res, rule,
+                               jnp.zeros((), jnp.int32))
+
+  rt = jax.jit(roundtrip, donate_argnums=(0,))
+  fused = rt(fused, cats)
+  probe = float(next(iter(fused.values()))[0, 0])  # force
+
+  def run(n):
+    nonlocal fused
+    t0 = time.perf_counter()
+    for _ in range(n):
+      fused = rt(fused, cats)
+    _ = float(next(iter(fused.values()))[0, 0])
+    return time.perf_counter() - t0
+
+  t1 = run(K)
+  t2 = run(2 * K)
+  print(f"apply_chunk=2^{CHUNK_LOG}: gather+apply roundtrip "
+        f"{(t2 - t1) / K * 1e3:8.2f} ms/iter (probe {probe:.3g})")
+
+
+if __name__ == "__main__":
+  main()

@@ -1,0 +1,97 @@
+"""Time a single-chip DLRM (Criteo-shape) train step on the real TPU.
+
+The north-star metric (BASELINE.json): Criteo DLRM step time / samples per
+second per chip; reference = 9,157,869 samples/s on 8xA100 => 1,144,734
+samples/s/chip (TF32), 1,302,029 (AMP).
+
+Vocabulary is scaled to fit one 16 GB chip (f32 tables, SGD has no
+per-row optimizer state); per-step indexed-row cost is vocab-size
+insensitive (measured: gather/scatter cost per row is flat from 2^16 to
+2^26 rows), so samples/s at scaled vocab is representative.
+
+Usage: python tools/profile_dlrm.py [batch] [vocab_scale] [amp]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributed_embeddings_tpu.layers.planner import DistEmbeddingStrategy
+from distributed_embeddings_tpu.models import DLRM, bce_loss
+from distributed_embeddings_tpu.ops.packed_table import sgd_rule
+from distributed_embeddings_tpu.training import (
+    init_sparse_state_direct,
+    make_sparse_train_step,
+)
+
+CRITEO_1TB_VOCAB = [
+    39884406, 39043, 17289, 7420, 20263, 3, 7120, 1543, 63, 38532951,
+    2953546, 403346, 10, 2208, 11938, 155, 4, 976, 14, 39979771,
+    25641295, 39664984, 585935, 12972, 108, 36
+]
+
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+SCALE = float(sys.argv[2]) if len(sys.argv) > 2 else 0.125
+AMP = len(sys.argv) > 3 and sys.argv[3] == "amp"
+K = 8
+
+
+def main():
+  vocab = [max(4, int(v * SCALE)) for v in CRITEO_1TB_VOCAB]
+  rows = sum(vocab)
+  print(f"batch={BATCH} scale={SCALE} amp={AMP} rows={rows / 1e6:.1f}M "
+        f"tables_gib={rows * 128 * 4 / 2**30:.2f}")
+  model = DLRM(vocab_sizes=vocab, embedding_dim=128, world_size=1,
+               compute_dtype=jnp.bfloat16 if AMP else jnp.float32)
+  plan = DistEmbeddingStrategy(
+      [dict(input_dim=v, output_dim=128, combiner=None) for v in vocab],
+      1, "basic", dense_row_threshold=model.dense_row_threshold)
+
+  rng = np.random.default_rng(0)
+  numerical = jnp.asarray(rng.standard_normal((BATCH, 13)), jnp.float32)
+  cats = [jnp.asarray(rng.integers(0, v, BATCH), jnp.int32) for v in vocab]
+  labels = jnp.asarray(rng.integers(0, 2, BATCH), jnp.float32)
+  batch = (numerical, cats, labels)
+
+  rule = sgd_rule(24.0)
+  dense_opt = optax.sgd(24.0)
+  dummy_acts = [jnp.zeros((2, 128), jnp.float32) for _ in vocab]
+  small_cats = [c[:2] for c in cats]
+  dense_params = model.init(jax.random.PRNGKey(0), numerical[:2], small_cats,
+                            emb_acts=dummy_acts)["params"]
+
+  state_avals = jax.eval_shape(
+      lambda: init_sparse_state_direct(plan, rule, dense_params, dense_opt,
+                                       jax.random.PRNGKey(1)))
+  step = make_sparse_train_step(model, plan, bce_loss, dense_opt, rule,
+                                None, state_avals, batch)
+  compiled = step.lower(state_avals, *batch).compile()
+  state = init_sparse_state_direct(plan, rule, dense_params, dense_opt,
+                                   jax.random.PRNGKey(1))
+
+  for _ in range(3):
+    state, loss = compiled(state, *batch)
+  float(loss)
+
+  def run(n, state):
+    t0 = time.perf_counter()
+    for _ in range(n):
+      state, loss = compiled(state, *batch)
+    float(loss)
+    return time.perf_counter() - t0, state
+
+  t1, state = run(K, state)
+  t2, state = run(2 * K, state)
+  ms = (t2 - t1) / K * 1e3
+  sps = BATCH / (ms / 1e3)
+  base = 1302029.0 if AMP else 1144734.0
+  print(f"DLRM step: {ms:.2f} ms  {sps:,.0f} samples/s/chip  "
+        f"vs A100-chip {sps / base:.3f}x")
+
+
+if __name__ == "__main__":
+  main()

@@ -1,0 +1,146 @@
+"""Ablation timing of the DLRM step: fwd only / fwd+bwd / full.
+
+Usage: python tools/profile_dlrm_parts.py [batch] [vocab_scale]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributed_embeddings_tpu.layers.planner import DistEmbeddingStrategy
+from distributed_embeddings_tpu.models import DLRM, bce_loss
+from distributed_embeddings_tpu.ops.packed_table import sgd_rule
+from distributed_embeddings_tpu.parallel.lookup_engine import DistributedLookup
+from distributed_embeddings_tpu.training import init_sparse_state_direct
+
+CRITEO_1TB_VOCAB = [
+    39884406, 39043, 17289, 7420, 20263, 3, 7120, 1543, 63, 38532951,
+    2953546, 403346, 10, 2208, 11938, 155, 4, 976, 14, 39979771,
+    25641295, 39664984, 585935, 12972, 108, 36
+]
+
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+SCALE = float(sys.argv[2]) if len(sys.argv) > 2 else 0.0625
+K = 8
+
+
+def main():
+  vocab = [max(4, int(v * SCALE)) for v in CRITEO_1TB_VOCAB]
+  model = DLRM(vocab_sizes=vocab, embedding_dim=128, world_size=1)
+  plan = DistEmbeddingStrategy(
+      [dict(input_dim=v, output_dim=128, combiner=None) for v in vocab],
+      1, "basic", dense_row_threshold=model.dense_row_threshold)
+  engine = DistributedLookup(plan)
+  rule = sgd_rule(24.0)
+  layouts = engine.fused_layouts(rule)
+  dense_opt = optax.sgd(24.0)
+
+  rng = np.random.default_rng(0)
+  numerical = jnp.asarray(rng.standard_normal((BATCH, 13)), jnp.float32)
+  cats = [jnp.asarray(rng.integers(0, v, BATCH), jnp.int32) for v in vocab]
+  labels = jnp.asarray(rng.integers(0, 2, BATCH), jnp.float32)
+
+  dummy_acts = [jnp.zeros((2, 128), jnp.float32) for _ in vocab]
+  dense_params = model.init(jax.random.PRNGKey(0), numerical[:2],
+                            [c[:2] for c in cats],
+                            emb_acts=dummy_acts)["params"]
+  state = init_sparse_state_direct(plan, rule, dense_params, dense_opt,
+                                   jax.random.PRNGKey(1))
+  jax.block_until_ready(state["fused"])
+  hotness_of = lambda i: 1  # noqa: E731
+
+  def timeit(name, step, state):
+    state2 = step(state, numerical, cats, labels)
+    float(jnp.ravel(jax.tree_util.tree_leaves(state2)[0])[0])
+
+    def run(n, st):
+      t0 = time.perf_counter()
+      for _ in range(n):
+        st = step(st, numerical, cats, labels)
+      float(jnp.ravel(jax.tree_util.tree_leaves(st)[0])[0])
+      return time.perf_counter() - t0, st
+
+    t1, state2 = run(K, state2)
+    t2, state2 = run(2 * K, state2)
+    print(f"{name:28s}: {(t2 - t1) / K * 1e3:8.2f} ms", flush=True)
+
+  # 1. route only
+  def route_only(state, numerical, cats, labels):
+    ids_all = engine.route_ids(cats, hotness_of)
+    bump = sum(v.sum() for v in ids_all.values()) % 2
+    return {**state, "step": state["step"] + bump}
+
+  timeit("route_ids", jax.jit(route_only), state)
+
+  # 2. route + gather
+  def gather_only(state, numerical, cats, labels):
+    ids_all = engine.route_ids(cats, hotness_of)
+    z, res = engine.lookup_sparse_fused(state["fused"], layouts, ids_all)
+    bump = (sum(zb.sum() for zb in z.values()) * 0).astype(jnp.int32)
+    return {**state, "step": state["step"] + 1 + bump}
+
+  timeit("route+gather", jax.jit(gather_only), state)
+
+  # 3. forward to loss
+  def fwd_only(state, numerical, cats, labels):
+    ids_all = engine.route_ids(cats, hotness_of)
+    z, res = engine.lookup_sparse_fused(state["fused"], layouts, ids_all)
+    acts = engine.finish_forward(z, state["emb_dense"], ids_all, BATCH,
+                                 hotness_of)
+    logits = model.apply({"params": state["dense"]}, numerical, cats,
+                         emb_acts=acts)
+    loss = bce_loss(logits, labels)
+    return {**state, "step": state["step"] + 1 + (loss * 0).astype(jnp.int32)}
+
+  timeit("forward(loss)", jax.jit(fwd_only), state)
+
+  # 4. fwd + bwd, no sparse apply
+  def bwd_no_apply(state, numerical, cats, labels):
+    ids_all = engine.route_ids(cats, hotness_of)
+    z, res = engine.lookup_sparse_fused(state["fused"], layouts, ids_all)
+
+    def loss_with(dp, z_sp):
+      acts = engine.finish_forward(z_sp, state["emb_dense"], ids_all, BATCH,
+                                   hotness_of)
+      logits = model.apply({"params": dp}, numerical, cats, emb_acts=acts)
+      return bce_loss(logits, labels)
+
+    loss, (d_dense, d_z) = jax.value_and_grad(
+        loss_with, argnums=(0, 1))(state["dense"], z)
+    upd, dop = dense_opt.update(d_dense, state["dense_opt"], state["dense"])
+    dense = optax.apply_updates(state["dense"], upd)
+    bump = (sum(v.sum() for v in d_z.values()) * 0).astype(jnp.int32)
+    return {**state, "dense": dense, "dense_opt": dop,
+            "step": state["step"] + 1 + bump}
+
+  timeit("fwd+bwd (no apply)", jax.jit(bwd_no_apply), state)
+
+  # 5. full
+  def full(state, numerical, cats, labels):
+    ids_all = engine.route_ids(cats, hotness_of)
+    z, res = engine.lookup_sparse_fused(state["fused"], layouts, ids_all)
+
+    def loss_with(dp, z_sp):
+      acts = engine.finish_forward(z_sp, state["emb_dense"], ids_all, BATCH,
+                                   hotness_of)
+      logits = model.apply({"params": dp}, numerical, cats, emb_acts=acts)
+      return bce_loss(logits, labels)
+
+    loss, (d_dense, d_z) = jax.value_and_grad(
+        loss_with, argnums=(0, 1))(state["dense"], z)
+    upd, dop = dense_opt.update(d_dense, state["dense_opt"], state["dense"])
+    dense = optax.apply_updates(state["dense"], upd)
+    fused = engine.apply_sparse(state["fused"], layouts, d_z, res, rule,
+                                state["step"])
+    return {**state, "dense": dense, "dense_opt": dop, "fused": fused,
+            "step": state["step"] + 1}
+
+  timeit("full step", jax.jit(full, donate_argnums=(0,)), state)
+
+
+if __name__ == "__main__":
+  main()

@@ -1,0 +1,84 @@
+"""Isolate XLA scatter-add cost factors on the chip.
+
+Factors: table size, id distribution (uniform vs power-law), update operand
+(precomputed vs computed-by-expansion), update width.
+
+Usage: python tools/profile_scatter.py
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+K = 4
+N = 1 << 22  # 4.2M update rows
+
+
+def zipf_ids(n, rows, alpha=1.05, seed=0):
+  rng = np.random.default_rng(seed)
+  u = rng.random(n)
+  # inverse-CDF approximate zipf over [0, rows)
+  s = 1.0 - alpha
+  ids = ((rows ** s - 1.0) * u + 1.0) ** (1.0 / s) - 1.0
+  return np.clip(ids.astype(np.int64), 0, rows - 1).astype(np.int32)
+
+
+def time_donated(step, state, args, k=K):
+  st = step(state, *args)
+  float(jnp.ravel(st)[0])
+
+  def run(n, st):
+    t0 = time.perf_counter()
+    for _ in range(n):
+      st = step(st, *args)
+    float(jnp.ravel(st)[0])
+    return time.perf_counter() - t0, st
+
+  t1, st = run(k, st)
+  t2, st = run(2 * k, st)
+  return (t2 - t1) / k
+
+
+def main():
+  for rows_log in (22,):
+    rows = 1 << rows_log
+    fresh = lambda: jnp.zeros((rows, 128), jnp.float32)  # noqa: E731
+    upd = jax.random.normal(jax.random.PRNGKey(2), (N, 128), jnp.float32)
+    upd32 = jax.random.normal(jax.random.PRNGKey(3), (N, 32), jnp.float32)
+    for dist in ("uniform", "zipf"):
+      if dist == "uniform":
+        ids = jnp.asarray(
+            np.random.default_rng(0).integers(0, rows, N, dtype=np.int64)
+            .astype(np.int32))
+      else:
+        ids = jnp.asarray(zipf_ids(N, rows))
+
+      scat = jax.jit(lambda b, i, u: b.at[i].add(u, mode="drop"),
+                     donate_argnums=(0,))
+      dt = time_donated(scat, fresh(), (ids, upd))
+      print(f"rows=2^{rows_log} {dist:7s} precomputed [N,128]: "
+            f"{dt * 1e3:7.2f} ms  {dt / N * 1e9:6.2f} ns/row", flush=True)
+
+      # expansion fused into scatter: [N,32] delta -> one-hot [N,128]
+      def exp_scat(b, i, u32):
+        sub = i % 4
+        oh = jax.nn.one_hot(sub, 4, dtype=u32.dtype)
+        full = jnp.einsum("ns,nr->nrs", u32, oh).reshape(-1, 128)
+        return b.at[i // 4].add(full, mode="drop")
+
+      scat2 = jax.jit(exp_scat, donate_argnums=(0,))
+      dt = time_donated(scat2, fresh(), (ids, upd32))
+      print(f"rows=2^{rows_log} {dist:7s} fused-expand [N,32]: "
+            f"{dt * 1e3:7.2f} ms  {dt / N * 1e9:6.2f} ns/row", flush=True)
+
+      # sorted uniform ids (locality effect)
+      ids_sorted = jnp.sort(ids)
+      dt = time_donated(scat, fresh(), (ids_sorted, upd))
+      print(f"rows=2^{rows_log} {dist:7s} sorted  [N,128]: "
+            f"{dt * 1e3:7.2f} ms  {dt / N * 1e9:6.2f} ns/row", flush=True)
+
+
+if __name__ == "__main__":
+  main()
