@@ -91,8 +91,13 @@ class DistributedEmbedding(nn.Module):
     strategy: 'basic' | 'memory_balanced' | 'memory_optimized'.
     column_slice_threshold: max elements per slice; None = auto when there
       are fewer tables than workers.
-    row_slice: unsupported, present for API parity with the reference
-      (which also raises, `dist_model_parallel.py:364-365`).
+    row_slice: max elements per row (vocabulary) slice, or None. Tables
+      larger than this are split along the vocab dim into the smallest
+      power-of-two number of row slices under the threshold (capped by
+      world size), placed like any other shard. Goes beyond the reference,
+      which stubs row slicing with NotImplementedError
+      (`dist_model_parallel.py:364-365`). Column slicing wins when both
+      thresholds trigger on one table.
     dp_input: True = [B_local, ...] data-parallel inputs; False = packed
       model-parallel inputs from :func:`pack_mp_inputs`.
     input_table_map: input i feeds table input_table_map[i]; None = identity.
@@ -123,8 +128,10 @@ class DistributedEmbedding(nn.Module):
 
   def __post_init__(self):
     super().__post_init__()
-    if self.row_slice is not None:
-      raise NotImplementedError("Row slicing embedding is not supported yet!")
+    if self.row_slice is not None and not isinstance(self.row_slice, int):
+      raise TypeError(
+          f"row_slice must be an int element threshold, got "
+          f"{self.row_slice!r}")
 
   @property
   def plan(self) -> DistEmbeddingStrategy:
@@ -136,7 +143,8 @@ class DistributedEmbedding(nn.Module):
               input_table_map=(list(self.input_table_map)
                                if self.input_table_map is not None else None),
               column_slice_threshold=self.column_slice_threshold,
-              dense_row_threshold=self.dense_row_threshold))
+              dense_row_threshold=self.dense_row_threshold,
+              row_slice_threshold=self.row_slice))
     return self._plan_cache
 
   @nn.compact
@@ -198,17 +206,22 @@ def get_weights(plan: DistEmbeddingStrategy,
   host = {name: _to_numpy_global(arr) for name, arr in class_params.items()}
   weights = []
   for t, config in enumerate(plan.global_configs):
-    col_parts = []
+    parts = []
+    row_sliced = False
     for rank, shard in plan.table_shard_map(t):
       key = plan.class_key_of(shard)
       cp = plan.classes[key]
       idx = cp.shards_per_rank[rank].index(shard)
       row0 = rank * padded_rows(plan, key) + \
           cp.row_offsets_per_rank[rank][idx]
-      block = host[class_param_name(*key)][row0:row0 + shard.input_dim, :]
-      col_parts.append(block)
-    weights.append(np.concatenate(col_parts, axis=1) if len(col_parts) > 1
-                   else col_parts[0])
+      parts.append(host[class_param_name(*key)][row0:row0 + shard.input_dim])
+      row_sliced = shard.row_sliced
+    if len(parts) == 1:
+      weights.append(parts[0])
+    else:
+      # table_shard_map orders by (col_start, row_start); a table is sliced
+      # along exactly one dim, so this is a plain concat either way
+      weights.append(np.concatenate(parts, axis=0 if row_sliced else 1))
   return weights
 
 
@@ -246,7 +259,9 @@ def set_weights(plan: DistEmbeddingStrategy,
     for idx, shard in enumerate(cp.shards_per_rank[rank]):
       row0 = cp.row_offsets_per_rank[rank][idx]
       block[row0:row0 + shard.input_dim] = (
-          loaded[shard.table_id][:, shard.col_start:shard.col_end])
+          loaded[shard.table_id][
+              shard.row_start:shard.row_start + shard.input_dim,
+              shard.col_start:shard.col_end])
     return block
 
   out = {}

@@ -49,7 +49,13 @@ ClassKey = Tuple[int, Optional[str], str, int]
 
 @dataclasses.dataclass
 class Shard:
-  """A (possibly merged) column shard of one table placed on one rank."""
+  """A (possibly merged) column or row shard of one table on one rank.
+
+  ``input_dim`` is the number of vocabulary rows this shard holds. For a
+  row shard (``row_sliced``), those are global rows ``[row_start,
+  row_start + input_dim)`` of the table; ids outside the window are served
+  by other ranks' shards (routing sends them to the sentinel here).
+  """
 
   table_id: int
   col_start: int
@@ -58,6 +64,8 @@ class Shard:
   combiner: Optional[str]
   initializer: object
   gen: int = 0  # width-class generation (assigned by the planner)
+  row_start: int = 0
+  row_sliced: bool = False
 
   @property
   def width(self) -> int:
@@ -108,13 +116,19 @@ class WidthClassPlan:
 
 @dataclasses.dataclass
 class OutputPiece:
-  """Where one column slice of one input's output comes from."""
+  """Where one slice of one input's output comes from.
+
+  Column slices (``row_sliced=False``) concatenate along the width axis;
+  row slices (``row_sliced=True``) are full-width partial results that SUM
+  (each holds the rows its vocab window served; the rest gathered the
+  sentinel and contributed zeros)."""
 
   class_key: ClassKey
   rank: int
   slot: int
   width: int
   col_start: int
+  row_sliced: bool = False
 
 
 def _normalize_configs(embeddings) -> List[TableConfig]:
@@ -131,33 +145,54 @@ def _normalize_configs(embeddings) -> List[TableConfig]:
   return configs
 
 
-def slice_columns(config: TableConfig, threshold: Optional[float],
-                  world_size: int) -> List[Tuple[int, int]]:
-  """Column ranges for one table under a slice threshold.
-
-  Semantics of the reference ``maybe_slice_table_column``
-  (`dist_model_parallel.py:157-188`): smallest power of two N with
-  ``size / N <= threshold``, capped at ``min(N, world, output_dim)``; columns
-  split evenly with the remainder spread over the first slices.
-  """
+def _pow2_ranges(total_units: int, size: float, threshold: Optional[float],
+                 world_size: int) -> List[Tuple[int, int]]:
+  """Split ``total_units`` into the smallest power-of-two number of
+  contiguous ranges with ``size / N <= threshold``, capped at
+  ``min(N, world, total_units)``; the remainder spreads over the first
+  ranges. The split rule of the reference ``maybe_slice_table_column``
+  (`dist_model_parallel.py:157-188`), shared by column and row slicing."""
   if threshold is None:
-    return [(0, config.output_dim)]
+    return [(0, total_units)]
+  if threshold <= 0:
+    raise ValueError(f"slice threshold must be positive, got {threshold}")
   num_slices = 1
-  size = float(config.size())
   while size > threshold:
     num_slices *= 2
     size /= 2
-  num_slices = min(num_slices, world_size, config.output_dim)
+  num_slices = min(num_slices, world_size, total_units)
   if num_slices <= 1:
-    return [(0, config.output_dim)]
-  base = config.output_dim // num_slices
-  rem = config.output_dim % num_slices
+    return [(0, total_units)]
+  base = total_units // num_slices
+  rem = total_units % num_slices
   ranges, start = [], 0
   for i in range(num_slices):
-    width = base + (1 if i < rem else 0)
-    ranges.append((start, start + width))
-    start += width
+    n = base + (1 if i < rem else 0)
+    ranges.append((start, start + n))
+    start += n
   return ranges
+
+
+def slice_columns(config: TableConfig, threshold: Optional[float],
+                  world_size: int) -> List[Tuple[int, int]]:
+  """Column ranges for one table under a slice threshold (semantics of the
+  reference ``maybe_slice_table_column``, `dist_model_parallel.py:157-188`)."""
+  return _pow2_ranges(config.output_dim, float(config.size()), threshold,
+                      world_size)
+
+
+def slice_rows(config: TableConfig, threshold: Optional[float],
+               world_size: int) -> List[Tuple[int, int]]:
+  """Row (vocabulary) ranges for one table under a row-slice threshold.
+
+  Same split rule as :func:`slice_columns` applied to the vocab dim. The
+  reference only stubs row slicing (`dist_model_parallel.py:343,364-365`
+  raises NotImplementedError); this build implements it — the natural
+  split for tables whose single-column footprint still exceeds one device
+  (e.g. multi-hundred-GiB vocabularies).
+  """
+  return _pow2_ranges(config.input_dim, float(config.size()), threshold,
+                      world_size)
 
 
 def auto_column_slice_threshold(sizes: Sequence[int],
@@ -237,7 +272,8 @@ class DistEmbeddingStrategy:
                input_table_map: Optional[Sequence[int]] = None,
                column_slice_threshold: Optional[int] = None,
                dense_row_threshold: int = 0,
-               max_class_bytes: int = 2 * 1024 ** 3):
+               max_class_bytes: int = 2 * 1024 ** 3,
+               row_slice_threshold: Optional[int] = None):
     if strategy not in ("basic", "memory_balanced", "memory_optimized"):
       raise ValueError(f"Unsupported shard strategy {strategy}")
     self.strategy = "basic" if world_size == 1 else strategy
@@ -273,18 +309,36 @@ class DistEmbeddingStrategy:
         if len(self.table_col_ranges[t]) > 1
     ]
 
+    # ---- row slicing (vocab dim; this build's extension — the reference
+    # stubs it, `dist_model_parallel.py:364-365`). A table is sliced along
+    # ONE dim: column slicing wins when both thresholds would trigger.
+    self.row_slice_threshold = row_slice_threshold
+    self.table_row_ranges: List[List[Tuple[int, int]]] = [
+        slice_rows(c, row_slice_threshold, world_size)
+        if len(self.table_col_ranges[t]) == 1 else [(0, c.input_dim)]
+        for t, c in enumerate(self.global_configs)
+    ]
+
     # ---- placement -------------------------------------------------------
+    # one placement unit per (table, column range or row range)
     slice_sizes, slice_table_ids = [], []
-    for t, (config, ranges) in enumerate(
-        zip(self.global_configs, self.table_col_ranges)):
-      for (s, e) in ranges:
+    for t, config in enumerate(self.global_configs):
+      for (s, e) in self.table_col_ranges[t]:
+        if len(self.table_row_ranges[t]) > 1 and (s, e) == (
+            0, config.output_dim):
+          continue  # row-sliced table: units come from row ranges below
         slice_sizes.append(config.input_dim * (e - s))
         slice_table_ids.append(t)
+      if len(self.table_row_ranges[t]) > 1:
+        for (r0, r1) in self.table_row_ranges[t]:
+          slice_sizes.append((r1 - r0) * config.output_dim)
+          slice_table_ids.append(t)
     placement = apply_placement(self.strategy, world_size, slice_sizes,
                                 slice_table_ids)
 
-    # ---- per-rank shards: hand out column ranges in rank order, merging
-    # same-table slices that land together (always column-contiguous).
+    # ---- per-rank shards: hand out column/row ranges in rank order,
+    # merging same-table slices that land together (always contiguous in
+    # the sliced dim: slices are handed out in rank order).
     next_slice: List[int] = [0] * num_tables
     self.rank_shards: List[List[Shard]] = []
     for rank in range(world_size):
@@ -293,16 +347,32 @@ class DistEmbeddingStrategy:
       for flat_idx in placement[rank]:
         t = slice_table_ids[flat_idx]
         config = self.global_configs[t]
-        s, e = self.table_col_ranges[t][next_slice[t]]
-        next_slice[t] += 1
-        if t in by_table:  # merge with earlier shard on this rank
-          by_table[t].col_end = e
+        row_sliced = len(self.table_row_ranges[t]) > 1
+        if row_sliced:
+          r0, r1 = self.table_row_ranges[t][next_slice[t]]
+          next_slice[t] += 1
+          if t in by_table:  # merge row-contiguous slices on this rank
+            by_table[t].input_dim += r1 - r0
+          else:
+            shard = Shard(table_id=t, col_start=0,
+                          col_end=config.output_dim, input_dim=r1 - r0,
+                          combiner=config.combiner,
+                          initializer=config.initializer,
+                          row_start=r0, row_sliced=True)
+            by_table[t] = shard
+            shards.append(shard)
         else:
-          shard = Shard(table_id=t, col_start=s, col_end=e,
-                        input_dim=config.input_dim, combiner=config.combiner,
-                        initializer=config.initializer)
-          by_table[t] = shard
-          shards.append(shard)
+          s, e = self.table_col_ranges[t][next_slice[t]]
+          next_slice[t] += 1
+          if t in by_table:  # merge with earlier shard on this rank
+            by_table[t].col_end = e
+          else:
+            shard = Shard(table_id=t, col_start=s, col_end=e,
+                          input_dim=config.input_dim,
+                          combiner=config.combiner,
+                          initializer=config.initializer)
+            by_table[t] = shard
+            shards.append(shard)
       self.rank_shards.append(shards)
     if world_size > 1 and not all(self.rank_shards):
       raise ValueError(
@@ -382,7 +452,8 @@ class DistEmbeddingStrategy:
             self.output_pieces[input_id].append(
                 OutputPiece(class_key=key, rank=rank,
                             slot=len(plan.slots_per_rank[rank]) - 1,
-                            width=sh.width, col_start=sh.col_start))
+                            width=sh.width, col_start=sh.col_start,
+                            row_sliced=sh.row_sliced))
       self.input_ids_list.append(rank_input_ids)
 
     # column slices of one input must concat in column order
@@ -440,6 +511,10 @@ class DistEmbeddingStrategy:
 
   # ---- convenience -------------------------------------------------------
   def _kind_of(self, shard: Shard) -> str:
+    # row shards always take the gather path: the one-hot window trick
+    # assumes slot-local ids cover the full table from offset 0
+    if shard.row_sliced:
+      return "sparse"
     return ("dense" if shard.input_dim <= self.dense_row_threshold
             else "sparse")
 
@@ -447,11 +522,12 @@ class DistEmbeddingStrategy:
     return (shard.width, shard.combiner, self._kind_of(shard), shard.gen)
 
   def table_shard_map(self, table_id: int) -> List[Tuple[int, Shard]]:
-    """All (rank, shard) holding columns of ``table_id``, in column order."""
+    """All (rank, shard) holding part of ``table_id``, in (column, row)
+    order — column slices concat along width, row slices along vocab."""
     entries = []
     for rank, shards in enumerate(self.rank_shards):
       for sh in shards:
         if sh.table_id == table_id:
           entries.append((rank, sh))
-    entries.sort(key=lambda e: e[1].col_start)
+    entries.sort(key=lambda e: (e[1].col_start, e[1].row_start))
     return entries

@@ -77,12 +77,13 @@ def vocab_cap(n: int) -> int:
 
 
 class Bucket(NamedTuple):
-  """Slots of one class sharing (hotness, one-hot window size)."""
+  """Slots of one class sharing (hotness, one-hot window size, row-sliced)."""
 
   h: int
   vcap: int  # 0 for sparse classes
   slot_idx_per_rank: tuple  # per rank, indices into slots_per_rank[rank]
   n_b: int  # padded slot count (max over ranks)
+  rs: bool = False  # slots of row-sliced shards (partial-sum semantics)
 
 
 class BucketKey(NamedTuple):
@@ -99,15 +100,16 @@ class BucketKey(NamedTuple):
   gen: int
   h: int
   vcap: int
+  rs: bool = False
 
   @property
   def class_key(self):
     return (self.width, self.combiner or None, self.kind, self.gen)
 
 
-def bucket_key(class_key, h: int, vcap: int) -> BucketKey:
+def bucket_key(class_key, h: int, vcap: int, rs: bool = False) -> BucketKey:
   w, c, kind, gen = class_key
-  return BucketKey(w, c or "", kind, gen, h, vcap)
+  return BucketKey(w, c or "", kind, gen, h, vcap, rs)
 
 
 def class_buckets(plan: DistEmbeddingStrategy, key, hotness_of) -> List[Bucket]:
@@ -122,17 +124,21 @@ def class_buckets(plan: DistEmbeddingStrategy, key, hotness_of) -> List[Bucket]:
   dense = cp.kind == "dense"
 
   def bkey(slot):
+    # row-sliced slots bucket separately: their routing windows make
+    # per-shard sentinel counts partial, so mean division moves to the
+    # dp side (assemble) instead of the mp-side combine
     return (hotness_of(slot.input_id),
-            vocab_cap(slot.shard.input_dim) if dense else 0)
+            vocab_cap(slot.shard.input_dim) if dense else 0,
+            slot.shard.row_sliced)
 
   keys = sorted({bkey(s) for slots in cp.slots_per_rank for s in slots})
   buckets = []
-  for h, vcap_ in keys:
+  for h, vcap_, rs in keys:
     per_rank = tuple(
-        tuple(i for i, s in enumerate(slots) if bkey(s) == (h, vcap_))
+        tuple(i for i, s in enumerate(slots) if bkey(s) == (h, vcap_, rs))
         for slots in cp.slots_per_rank)
     buckets.append(Bucket(h, vcap_, per_rank,
-                          max(len(i) for i in per_rank)))
+                          max(len(i) for i in per_rank), rs))
   return buckets
 
 
@@ -283,9 +289,24 @@ class DistributedLookup:
         if k < len(idxs):
           slot = cp.slots_per_rank[rank][idxs[k]]
           ids = inputs[slot.input_id]
-          rows = slot.shard.input_dim
-          routed = jnp.where(ids < 0, sentinel,
-                             jnp.clip(ids, 0, rows - 1) + slot.row_offset)
+          sh = slot.shard
+          if sh.row_sliced:
+            # row shard: serve only ids inside this shard's vocab window
+            # [row_start, row_start + rows); other shards' rows and PAD go
+            # to the sentinel and contribute zeros to the partial sum.
+            # Out-of-vocab ids clamp to the last table row FIRST so
+            # enabling row_slice (a sharding knob) cannot change numerics
+            # vs the unsliced clamp policy.
+            vocab = self.plan.global_configs[sh.table_id].input_dim
+            clamped = jnp.clip(ids, 0, vocab - 1)
+            in_win = (ids >= 0) & (clamped >= sh.row_start) & (
+                clamped < sh.row_start + sh.input_dim)
+            routed = jnp.where(
+                in_win, clamped - sh.row_start + slot.row_offset, sentinel)
+          else:
+            routed = jnp.where(ids < 0, sentinel,
+                               jnp.clip(ids, 0, sh.input_dim - 1)
+                               + slot.row_offset)
           per_slot.append(routed)
         else:
           per_slot.append(pad_block)
@@ -321,14 +342,19 @@ class DistributedLookup:
           y = lax.all_to_all(x, self.axis_name, split_axis=0, concat_axis=0)
         else:
           y = x
-        ids_all[bucket_key(key, bucket.h, bucket.vcap)] = (
+        ids_all[bucket_key(key, bucket.h, bucket.vcap, bucket.rs)] = (
             jnp.transpose(y, (1, 0, 2, 3)).reshape(
                 bucket.n_b, world * b, bucket.h))
     return ids_all
 
   # ---- mp-side local lookups ---------------------------------------------
-  def _combine(self, rows: jax.Array, ids_all: jax.Array, key) -> jax.Array:
-    """[n_b, G, h, w] gathered rows -> [n_b, G, w] via the class combiner."""
+  def _combine(self, rows: jax.Array, ids_all: jax.Array, key,
+               rs: bool = False) -> jax.Array:
+    """[n_b, G, h, w] gathered rows -> [n_b, G, w] via the class combiner.
+
+    For row-sliced buckets (``rs``) the mean division is deferred to
+    :meth:`assemble`: the sentinel count here reflects only the ids this
+    shard's vocab window served, not the sample's true hotness."""
     cp = self.plan.classes[key]
     sentinel = padded_rows(self.plan, key)
     if cp.combiner is None and ids_all.shape[-1] != 1:
@@ -337,16 +363,16 @@ class DistributedLookup:
     if ids_all.shape[-1] == 1:
       return rows[:, :, 0, :]
     summed = jnp.sum(rows, axis=2)
-    if cp.combiner == "mean":
+    if cp.combiner == "mean" and not rs:
       counts = jnp.sum(ids_all < sentinel, axis=2).astype(summed.dtype)
       summed = summed / jnp.maximum(counts, 1)[..., None]
     return summed
 
   def _z_sparse_simple(self, key, table_local: jax.Array,
-                       ids_all: jax.Array) -> jax.Array:
+                       ids_all: jax.Array, rs: bool = False) -> jax.Array:
     """Differentiable gather path on the simple [rows, w] buffer."""
     rows = jnp.take(table_local, ids_all, axis=0, mode="fill", fill_value=0)
-    return self._combine(rows, ids_all, key)
+    return self._combine(rows, ids_all, key, rs)
 
   def _dense_offsets(self, key, bucket: Bucket) -> np.ndarray:
     cp = self.plan.classes[key]
@@ -421,13 +447,13 @@ class DistributedLookup:
     return z
 
   def _z_sparse_fused(self, key, layout: PackedLayout, buf_local: jax.Array,
-                      ids_all: jax.Array):
+                      ids_all: jax.Array, rs: bool = False):
     """Fused gather: returns (z, aux_rows) — optimizer state rides along."""
     fused = gather_fused_chunked(layout, buf_local, ids_all)  # [n_b,G,h,stride]
     w = layout.width
     rows = fused[..., :w]
     aux = fused[..., w:]
-    return self._combine(rows, ids_all, key), aux
+    return self._combine(rows, ids_all, key, rs), aux
 
   # ---- mp -> dp exchange + assembly --------------------------------------
   def exchange(self, z: Dict[tuple, jax.Array], batch_local: int
@@ -475,7 +501,7 @@ class DistributedLookup:
     out = {}
     for key in self.plan.class_keys:
       for bucket in self._buckets(key, hotness_of):
-        bk = bucket_key(key, bucket.h, bucket.vcap)
+        bk = bucket_key(key, bucket.h, bucket.vcap, bucket.rs)
         for rank, idxs in enumerate(bucket.slot_idx_per_rank):
           for pos, slot_idx in enumerate(idxs):
             out[(key, rank, slot_idx)] = (bk, pos)
@@ -483,22 +509,60 @@ class DistributedLookup:
     return out
 
   def assemble(self, received: Dict[tuple, jax.Array],
-               hotness_of) -> List[jax.Array]:
-    """Per-input output reassembly incl. column-slice concat.
+               hotness_of,
+               mean_counts: Optional[Dict[int, jax.Array]] = None
+               ) -> List[jax.Array]:
+    """Per-input output reassembly: column-slice concat, row-slice sum.
 
     Replaces the reference's rev_global_input_ids shuffle + range-wise output
-    concat (`dist_model_parallel.py:462-469`) with static piece indexing."""
+    concat (`dist_model_parallel.py:462-469`) with static piece indexing.
+    Row-sliced pieces are full-width partial sums and ADD; their mean
+    division happens here (differentiably) using ``mean_counts`` — per
+    input id, the [B_local] count of valid (non-PAD) ids per sample (see
+    :meth:`mean_counts`)."""
     plan = self.plan
     slot_map = self._slot_bucket_map(hotness_of)
     results = []
-    for pieces in plan.output_pieces:
+    for input_id, pieces in enumerate(plan.output_pieces):
       parts = []
       for p in pieces:
         bk, idx = slot_map[(p.class_key, p.rank, p.slot)]
         parts.append(received[bk][p.rank, idx])
-      results.append(parts[0] if len(parts) == 1 else
-                     jnp.concatenate(parts, axis=-1))
+      if pieces and pieces[0].row_sliced:
+        out = parts[0] if len(parts) == 1 else sum(parts[1:], parts[0])
+        combiner = plan.global_configs[
+            plan.input_table_map[input_id]].combiner
+        if combiner == "mean" and hotness_of(input_id) > 1:
+          if mean_counts is None or input_id not in mean_counts:
+            raise ValueError(
+                "mean combiner on a row-sliced table needs mean_counts "
+                "(pass the forward inputs through DistributedLookup."
+                "mean_counts)")
+          counts = mean_counts[input_id].astype(out.dtype)
+          out = out / jnp.maximum(counts, 1)[:, None]
+        results.append(out)
+      else:
+        results.append(parts[0] if len(parts) == 1 else
+                       jnp.concatenate(parts, axis=-1))
     return results
+
+  def mean_counts(self, inputs: Sequence[jax.Array]
+                  ) -> Dict[int, jax.Array]:
+    """Per-sample valid-id counts for mean x row-sliced inputs.
+
+    Returns ``input_id -> [B_local]`` for every input that feeds a
+    row-sliced mean-combined table (empty dict when none exist)."""
+    plan = self.plan
+    out = {}
+    for input_id, pieces in enumerate(plan.output_pieces):
+      if not (pieces and pieces[0].row_sliced):
+        continue
+      if plan.global_configs[plan.input_table_map[input_id]].combiner \
+          != "mean":
+        continue
+      x = _normalize_input(inputs[input_id])
+      out[input_id] = jnp.sum(x >= 0, axis=1)
+    return out
 
   # ---- composed forwards -------------------------------------------------
   def forward(self, class_params: Dict[str, jax.Array],
@@ -522,6 +586,7 @@ class DistributedLookup:
     inputs = [_normalize_input(x) for x in inputs]
     hotness_of = lambda i: inputs[i].shape[1]  # noqa: E731
     b = inputs[0].shape[0]
+    counts = self.mean_counts(inputs)
     ids_all = self.route_ids(inputs, hotness_of)
     z = {}
     for bk, ids in ids_all.items():
@@ -532,9 +597,9 @@ class DistributedLookup:
         bucket = self._find_bucket(key, bk.h, bk.vcap, hotness_of)
         z[bk] = self._z_dense(key, bucket, table_local, ids)
       else:
-        z[bk] = self._z_sparse_simple(key, table_local, ids)
+        z[bk] = self._z_sparse_simple(key, table_local, ids, bk.rs)
     received = self.exchange(z, b)
-    outs = self.assemble(received, hotness_of)
+    outs = self.assemble(received, hotness_of, counts)
     if return_residuals:
       return outs, ids_all
     return outs
@@ -580,7 +645,8 @@ class DistributedLookup:
         continue
       name = class_param_name(*key)
       buf_local = self._squeeze_local(fused_params[name])
-      zb, auxb = self._z_sparse_fused(key, layouts[name], buf_local, ids)
+      zb, auxb = self._z_sparse_fused(key, layouts[name], buf_local, ids,
+                                      bk.rs)
       z[bk] = zb
       aux[bk] = auxb
     return z, SparseResiduals(ids_all=dict(ids_all), aux_rows=aux)
@@ -588,12 +654,17 @@ class DistributedLookup:
   def finish_forward(self, z_sparse: Dict[tuple, jax.Array],
                      dense_params: Dict[str, jax.Array],
                      ids_all: Dict[tuple, jax.Array],
-                     batch_local: int, hotness_of) -> List[jax.Array]:
+                     batch_local: int, hotness_of,
+                     mean_counts: Optional[Dict[int, jax.Array]] = None
+                     ) -> List[jax.Array]:
     """Differentiable tail: dense-class lookups + exchange + assembly.
 
     Differentiable w.r.t. ``z_sparse`` (cotangents feed
     :meth:`apply_sparse`) and ``dense_params`` (dense autodiff grads for the
-    MXU one-hot tables)."""
+    MXU one-hot tables). ``mean_counts`` (from :meth:`mean_counts`) is
+    required when a row-sliced table uses the mean combiner — the division
+    happens in this differentiable tail, so its cotangent reaches
+    :meth:`apply_sparse` pre-divided."""
     z = dict(z_sparse)
     for bk, ids in ids_all.items():
       key = bk.class_key
@@ -608,7 +679,7 @@ class DistributedLookup:
               key, bucket, t, i))
       z[bk] = z_fn(table_local, ids)
     received = self.exchange(z, batch_local)
-    return self.assemble(received, hotness_of)
+    return self.assemble(received, hotness_of, mean_counts)
 
   def apply_sparse(self, fused_params: Dict[str, jax.Array],
                    layouts: Dict[str, PackedLayout],
@@ -640,7 +711,9 @@ class DistributedLookup:
       name = class_param_name(*key)
       ids = residuals.ids_all[bk]  # [n_b, G, h]
       sentinel = padded_rows(plan, key)
-      if cp.combiner == "mean" and h > 1:
+      if cp.combiner == "mean" and h > 1 and not bk.rs:
+        # row-sliced buckets skip this: their mean division lives in the
+        # differentiable assemble, so d_z arrives pre-divided
         counts = jnp.sum(ids < sentinel, axis=2).astype(dzb.dtype)
         dzb = dzb / jnp.maximum(counts, 1)[..., None]
       aux = residuals.aux_rows[bk] if rule.n_aux else None
@@ -729,6 +802,11 @@ class DistributedLookup:
     """
     plan = self.plan
     world = plan.world_size
+    if any(sh.row_sliced for shards in plan.rank_shards for sh in shards):
+      raise NotImplementedError(
+          "row-sliced tables are not supported with model-parallel inputs "
+          "(dp_input=False): every rank holding a row slice needs the full "
+          "id stream, which contradicts the mp-input contract")
     hotness_of = (lambda i: 1) if hotness is None else \
         (lambda i: hotness[i])  # noqa: E731
     z = {}
@@ -754,10 +832,10 @@ class DistributedLookup:
         if g % world:
           raise ValueError(f"Global batch {g} not divisible by world {world}")
         if plan.classes[key].kind == "dense":
-          z[bucket_key(key, bucket.h, bucket.vcap)] = self._z_dense(
+          z[bucket_key(key, bucket.h, bucket.vcap, bucket.rs)] = self._z_dense(
               key, bucket, table_local, ids_all)
         else:
-          z[bucket_key(key, bucket.h, bucket.vcap)] = self._z_sparse_simple(
+          z[bucket_key(key, bucket.h, bucket.vcap, bucket.rs)] = self._z_sparse_simple(
               key, table_local, ids_all)
     received = self.exchange(z, g // world)
     return self.assemble(received, hotness_of)

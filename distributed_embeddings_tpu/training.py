@@ -324,11 +324,13 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
     hotness = [1 if c.ndim == 1 else c.shape[1] for c in cats]
     hotness_of = lambda i: hotness[i]  # noqa: E731
     ids_all = engine.route_ids(cats, hotness_of)
+    counts = engine.mean_counts(cats)
     z_sparse, residuals = engine.lookup_sparse_fused(
         state["fused"], layouts, ids_all)
 
     def loss_with(dense_p, emb_dense, z_sp):
-      acts = engine.finish_forward(z_sp, emb_dense, ids_all, b, hotness_of)
+      acts = engine.finish_forward(z_sp, emb_dense, ids_all, b, hotness_of,
+                                   counts)
       logits = model.apply({"params": dense_p}, numerical, cats,
                            emb_acts=acts)
       return loss_fn(logits, labels)
@@ -400,9 +402,10 @@ def make_sparse_eval_step(model, plan: DistEmbeddingStrategy,
     hotness = [1 if c.ndim == 1 else c.shape[1] for c in cats]
     hotness_of = lambda i: hotness[i]  # noqa: E731
     ids_all = engine.route_ids(cats, hotness_of)
+    counts = engine.mean_counts(cats)
     z_sparse, _ = engine.lookup_sparse_fused(state["fused"], layouts, ids_all)
     acts = engine.finish_forward(z_sparse, state["emb_dense"], ids_all, b,
-                                 hotness_of)
+                                 hotness_of, counts)
     return model.apply({"params": state["dense"]}, numerical, cats,
                        emb_acts=acts)
 

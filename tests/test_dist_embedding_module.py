@@ -122,9 +122,17 @@ def test_module_trains_with_distributed_optimizer():
   assert any(not np.allclose(a, b) for a, b in zip(w0, w1))
 
 
-def test_row_slice_raises():
-  with pytest.raises(NotImplementedError):
+def test_row_slice_accepts_int_threshold_only():
+  # the reference raises NotImplementedError for ANY row_slice
+  # (`dist_model_parallel.py:364-365`); this build implements integer
+  # element thresholds and rejects other types
+  with pytest.raises(TypeError, match="row_slice"):
     DistributedEmbedding(embeddings=(TableConfig(4, 2),), row_slice="rows")
+  dmp = DistributedEmbedding(embeddings=(TableConfig(64, 2),
+                                         TableConfig(64, 2)),
+                             world_size=2, row_slice=64)
+  assert all(sh.row_sliced for shards in dmp.plan.rank_shards
+             for sh in shards)
 
 
 def test_world_one_module_is_plain_layer():

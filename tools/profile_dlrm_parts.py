@@ -1,8 +1,12 @@
-"""Ablation timing of the DLRM step: fwd only / fwd+bwd / full.
+"""Ablation timing of the DLRM step: route / gather / fwd / bwd / full.
 
-Usage: python tools/profile_dlrm_parts.py [batch] [vocab_scale]
+Big state is closed over (captured constant) so non-donated cases do not
+duplicate the multi-GiB buffers; only a scalar carry chains iterations.
+
+Usage: [AMP=1] python tools/profile_dlrm_parts.py [batch] [vocab_scale]
 """
 
+import os
 import sys
 import time
 
@@ -25,12 +29,14 @@ CRITEO_1TB_VOCAB = [
 
 BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
 SCALE = float(sys.argv[2]) if len(sys.argv) > 2 else 0.0625
+AMP = os.environ.get("AMP", "0") == "1"
 K = 8
 
 
 def main():
   vocab = [max(4, int(v * SCALE)) for v in CRITEO_1TB_VOCAB]
-  model = DLRM(vocab_sizes=vocab, embedding_dim=128, world_size=1)
+  model = DLRM(vocab_sizes=vocab, embedding_dim=128, world_size=1,
+               compute_dtype=jnp.bfloat16 if AMP else jnp.float32)
   plan = DistEmbeddingStrategy(
       [dict(input_dim=v, output_dim=128, combiner=None) for v in vocab],
       1, "basic", dense_row_threshold=model.dense_row_threshold)
@@ -53,55 +59,55 @@ def main():
   jax.block_until_ready(state["fused"])
   hotness_of = lambda i: 1  # noqa: E731
 
-  def timeit(name, step, state):
-    state2 = step(state, numerical, cats, labels)
-    float(jnp.ravel(jax.tree_util.tree_leaves(state2)[0])[0])
+  def timeit(name, body):
+    """body(carry_scalar) -> scalar; closes over state/batch."""
+    step = jax.jit(body)
+    c = step(jnp.zeros((), jnp.float32))
+    float(c)
 
-    def run(n, st):
+    def run(n, c):
       t0 = time.perf_counter()
       for _ in range(n):
-        st = step(st, numerical, cats, labels)
-      float(jnp.ravel(jax.tree_util.tree_leaves(st)[0])[0])
-      return time.perf_counter() - t0, st
+        c = step(c)
+      float(c)
+      return time.perf_counter() - t0, c
 
-    t1, state2 = run(K, state2)
-    t2, state2 = run(2 * K, state2)
-    print(f"{name:28s}: {(t2 - t1) / K * 1e3:8.2f} ms", flush=True)
+    t1, c = run(K, c)
+    t2, c = run(2 * K, c)
+    print(f"{name:22s}: {(t2 - t1) / K * 1e3:8.2f} ms", flush=True)
 
-  # 1. route only
-  def route_only(state, numerical, cats, labels):
-    ids_all = engine.route_ids(cats, hotness_of)
-    bump = sum(v.sum() for v in ids_all.values()) % 2
-    return {**state, "step": state["step"] + bump}
+  def cats_dep(carry):
+    bump = (carry * 0).astype(jnp.int32)
+    return [c + bump for c in cats]
 
-  timeit("route_ids", jax.jit(route_only), state)
+  def route_only(carry):
+    ids_all = engine.route_ids(cats_dep(carry), hotness_of)
+    return carry + sum(v.sum() for v in ids_all.values()).astype(
+        jnp.float32) * 0
 
-  # 2. route + gather
-  def gather_only(state, numerical, cats, labels):
-    ids_all = engine.route_ids(cats, hotness_of)
-    z, res = engine.lookup_sparse_fused(state["fused"], layouts, ids_all)
-    bump = (sum(zb.sum() for zb in z.values()) * 0).astype(jnp.int32)
-    return {**state, "step": state["step"] + 1 + bump}
+  timeit("route_ids", route_only)
 
-  timeit("route+gather", jax.jit(gather_only), state)
+  def gather_only(carry):
+    ids_all = engine.route_ids(cats_dep(carry), hotness_of)
+    z, _ = engine.lookup_sparse_fused(state["fused"], layouts, ids_all)
+    return carry + sum(zb.sum() for zb in z.values()).astype(jnp.float32) * 0
 
-  # 3. forward to loss
-  def fwd_only(state, numerical, cats, labels):
-    ids_all = engine.route_ids(cats, hotness_of)
-    z, res = engine.lookup_sparse_fused(state["fused"], layouts, ids_all)
+  timeit("route+gather", gather_only)
+
+  def fwd_only(carry):
+    ids_all = engine.route_ids(cats_dep(carry), hotness_of)
+    z, _ = engine.lookup_sparse_fused(state["fused"], layouts, ids_all)
     acts = engine.finish_forward(z, state["emb_dense"], ids_all, BATCH,
                                  hotness_of)
     logits = model.apply({"params": state["dense"]}, numerical, cats,
                          emb_acts=acts)
-    loss = bce_loss(logits, labels)
-    return {**state, "step": state["step"] + 1 + (loss * 0).astype(jnp.int32)}
+    return carry + bce_loss(logits, labels) * 0
 
-  timeit("forward(loss)", jax.jit(fwd_only), state)
+  timeit("forward(loss)", fwd_only)
 
-  # 4. fwd + bwd, no sparse apply
-  def bwd_no_apply(state, numerical, cats, labels):
-    ids_all = engine.route_ids(cats, hotness_of)
-    z, res = engine.lookup_sparse_fused(state["fused"], layouts, ids_all)
+  def bwd_no_apply(carry):
+    ids_all = engine.route_ids(cats_dep(carry), hotness_of)
+    z, _ = engine.lookup_sparse_fused(state["fused"], layouts, ids_all)
 
     def loss_with(dp, z_sp):
       acts = engine.finish_forward(z_sp, state["emb_dense"], ids_all, BATCH,
@@ -111,35 +117,29 @@ def main():
 
     loss, (d_dense, d_z) = jax.value_and_grad(
         loss_with, argnums=(0, 1))(state["dense"], z)
-    upd, dop = dense_opt.update(d_dense, state["dense_opt"], state["dense"])
-    dense = optax.apply_updates(state["dense"], upd)
-    bump = (sum(v.sum() for v in d_z.values()) * 0).astype(jnp.int32)
-    return {**state, "dense": dense, "dense_opt": dop,
-            "step": state["step"] + 1 + bump}
+    s = sum(jnp.sum(v) for v in jax.tree_util.tree_leaves(d_dense))
+    s = s + sum(v.sum() for v in d_z.values())
+    return carry + (loss + s).astype(jnp.float32) * 0
 
-  timeit("fwd+bwd (no apply)", jax.jit(bwd_no_apply), state)
+  timeit("fwd+bwd (no apply)", bwd_no_apply)
 
-  # 5. full
-  def full(state, numerical, cats, labels):
-    ids_all = engine.route_ids(cats, hotness_of)
-    z, res = engine.lookup_sparse_fused(state["fused"], layouts, ids_all)
+  from distributed_embeddings_tpu.training import make_sparse_train_step
+  batch = (numerical, cats, labels)
+  step = make_sparse_train_step(model, plan, bce_loss, dense_opt, rule,
+                                None, state, batch)
+  st, loss = step(state, *batch)
+  float(loss)
 
-    def loss_with(dp, z_sp):
-      acts = engine.finish_forward(z_sp, state["emb_dense"], ids_all, BATCH,
-                                   hotness_of)
-      logits = model.apply({"params": dp}, numerical, cats, emb_acts=acts)
-      return bce_loss(logits, labels)
+  def run(n, st):
+    t0 = time.perf_counter()
+    for _ in range(n):
+      st, loss = step(st, *batch)
+    float(loss)
+    return time.perf_counter() - t0, st
 
-    loss, (d_dense, d_z) = jax.value_and_grad(
-        loss_with, argnums=(0, 1))(state["dense"], z)
-    upd, dop = dense_opt.update(d_dense, state["dense_opt"], state["dense"])
-    dense = optax.apply_updates(state["dense"], upd)
-    fused = engine.apply_sparse(state["fused"], layouts, d_z, res, rule,
-                                state["step"])
-    return {**state, "dense": dense, "dense_opt": dop, "fused": fused,
-            "step": state["step"] + 1}
-
-  timeit("full step", jax.jit(full, donate_argnums=(0,)), state)
+  t1, st = run(K, st)
+  t2, st = run(2 * K, st)
+  print(f"{'full step':22s}: {(t2 - t1) / K * 1e3:8.2f} ms", flush=True)
 
 
 if __name__ == "__main__":
