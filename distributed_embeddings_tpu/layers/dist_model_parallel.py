@@ -128,7 +128,9 @@ class DistributedEmbedding(nn.Module):
 
   def __post_init__(self):
     super().__post_init__()
-    if self.row_slice is not None and not isinstance(self.row_slice, int):
+    if self.row_slice is not None and (isinstance(self.row_slice, bool)
+                                       or not isinstance(self.row_slice,
+                                                         int)):
       raise TypeError(
           f"row_slice must be an int element threshold, got "
           f"{self.row_slice!r}")

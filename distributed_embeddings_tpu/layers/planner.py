@@ -295,7 +295,12 @@ class DistEmbeddingStrategy:
     # ---- column slicing --------------------------------------------------
     self.column_slice_threshold = column_slice_threshold
     threshold = column_slice_threshold
-    if threshold is None:
+    if threshold is None and row_slice_threshold is None:
+      # the auto threshold exists to give every worker a shard when there
+      # are fewer tables than workers; an explicit row_slice request can
+      # provide that coverage itself, so auto column slicing must not
+      # preempt it (it would cap at output_dim and crash for one huge
+      # narrow table across many workers)
       threshold = auto_column_slice_threshold(
           [c.size() for c in self.global_configs], world_size)
     self.table_col_ranges: List[List[Tuple[int, int]]] = [

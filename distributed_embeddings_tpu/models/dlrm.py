@@ -81,6 +81,7 @@ class DLRM(nn.Module):
   world_size: int = 1
   strategy: str = "basic"
   column_slice_threshold: Optional[int] = None
+  row_slice: Optional[int] = None
   dp_input: bool = True
   compute_dtype: Any = jnp.float32
   # small-vocab tables ride the MXU one-hot path (see planner)
@@ -99,6 +100,7 @@ class DLRM(nn.Module):
         embeddings=tables,
         strategy=self.strategy,
         column_slice_threshold=self.column_slice_threshold,
+        row_slice=self.row_slice,
         dp_input=self.dp_input,
         world_size=self.world_size,
         dense_row_threshold=self.dense_row_threshold,
@@ -127,7 +129,8 @@ class DLRM(nn.Module):
 def dlrm_embedding_plan(vocab_sizes, embedding_dim: int = 128,
                         world_size: int = 1, strategy: str = "basic",
                         column_slice_threshold: Optional[int] = None,
-                        dense_row_threshold: int = 2048):
+                        dense_row_threshold: int = 2048,
+                        row_slice: Optional[int] = None):
   """The placement plan a :class:`DLRM`'s embeddings use (for
   get_weights/set_weights on the ``embeddings`` param subtree)."""
   from ..layers.planner import DistEmbeddingStrategy
@@ -136,7 +139,8 @@ def dlrm_embedding_plan(vocab_sizes, embedding_dim: int = 128,
             for v in vocab_sizes]
   return DistEmbeddingStrategy(tables, world_size, strategy,
                                column_slice_threshold=column_slice_threshold,
-                               dense_row_threshold=dense_row_threshold)
+                               dense_row_threshold=dense_row_threshold,
+                               row_slice_threshold=row_slice)
 
 
 def _dlrm_initializer(rows: int):

@@ -205,6 +205,7 @@ class SyntheticModel(nn.Module):
   world_size: int = 1
   strategy: str = "memory_balanced"
   column_slice_threshold: Optional[int] = None
+  row_slice: Optional[int] = None
   dp_input: bool = True
   compute_dtype: Any = jnp.float32
   # small-vocab tables ride the MXU one-hot path (see planner)
@@ -216,6 +217,7 @@ class SyntheticModel(nn.Module):
         embeddings=tuple(tables),
         strategy=self.strategy,
         column_slice_threshold=self.column_slice_threshold,
+        row_slice=self.row_slice,
         dp_input=self.dp_input,
         input_table_map=tuple(input_table_map),
         world_size=self.world_size,

@@ -867,6 +867,10 @@ def pack_mp_inputs(plan: DistEmbeddingStrategy,
     mesh, then pass the per-device blocks to ``forward_mp``.
   """
   world = plan.world_size
+  if any(sh.row_sliced for shards in plan.rank_shards for sh in shards):
+    raise NotImplementedError(
+        "row-sliced tables are not supported with model-parallel inputs: "
+        "per-rank id streams cannot cover a table split across ranks")
   hotness_of = (lambda i: 1) if hotness is None else \
       (lambda i: hotness[i])  # noqa: E731
   # resolve each (rank, class, slot) to its normalized local input once

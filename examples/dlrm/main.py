@@ -69,6 +69,16 @@ def parse_args():
   p.add_argument("--eval", action="store_true")
   p.add_argument("--save_checkpoint", default=None,
                  help="path for final np.savez global checkpoint")
+  p.add_argument("--sparse", action="store_true",
+                 help="fused sparse training path (packed tables, "
+                      "row-sparse SGD; the bench.py path)")
+  p.add_argument("--checkpoint_dir", default=None,
+                 help="full train-state checkpoint dir (sparse path only); "
+                      "auto-resumes when it exists")
+  p.add_argument("--checkpoint_every", type=int, default=0,
+                 help="save the full state every N steps (0 = end only)")
+  p.add_argument("--row_slice", type=int, default=None,
+                 help="row (vocab) slice threshold in elements")
   p.add_argument("--vocab_scale", type=float, default=1.0,
                  help="scale Criteo vocab sizes (for memory-limited runs)")
   p.add_argument("--platform", default=None,
@@ -128,6 +138,7 @@ def main():
                world_size=world,
                strategy=args.strategy,
                column_slice_threshold=args.column_slice_threshold,
+               row_slice=args.row_slice,
                compute_dtype=jnp.bfloat16 if args.amp else jnp.float32)
 
   local_bs = args.batch_size // world
@@ -149,21 +160,59 @@ def main():
   numerical, cats, labels = train_data[0]
   batch_example = (jnp.asarray(numerical), [jnp.asarray(c) for c in cats],
                    jnp.asarray(labels))
-  params = model.init(jax.random.PRNGKey(0), batch_example[0],
-                      batch_example[1])["params"]
   schedule = dlrm_lr_schedule(args.lr, args.warmup_steps,
                               args.decay_start_step, args.decay_steps)
   optimizer = optax.sgd(schedule)
-  opt_state = optimizer.init(params)
-  params = shard_params(params, mesh)
-  opt_state = shard_params(opt_state, mesh)
+  plan = dlrm_embedding_plan(vocab, args.embedding_dim, world,
+                             args.strategy, args.column_slice_threshold,
+                             row_slice=args.row_slice)
 
-  def loss_fn(params, numerical, cats, labels):
-    logits = model.apply({"params": params}, numerical, cats)
-    return bce_loss(logits, labels)
+  if args.sparse:
+    # fused sparse path: packed tables with row-sparse SGD, full-state
+    # checkpoint/resume (beyond the reference, which checkpoints weights
+    # only -- `examples/dlrm/main.py:245-248`)
+    from distributed_embeddings_tpu import checkpoint as ckpt
+    from distributed_embeddings_tpu.ops.packed_table import sgd_rule
+    from distributed_embeddings_tpu.training import (
+        init_sparse_state,
+        make_sparse_train_step,
+    )
+    rule = sgd_rule(schedule)
+    params = model.init(jax.random.PRNGKey(0), batch_example[0],
+                        batch_example[1])["params"]
+    state = init_sparse_state(plan, params, rule, optimizer)
+    state = shard_params(state, mesh)
+    if args.checkpoint_dir and os.path.isdir(args.checkpoint_dir):
+      state = ckpt.restore(args.checkpoint_dir, plan, rule, state, mesh=mesh)
+      print(f"resumed from {args.checkpoint_dir} at step "
+            f"{int(jax.device_get(state['step']))}")
+    sparse_step = make_sparse_train_step(model, plan, bce_loss, optimizer,
+                                         rule, mesh, state, batch_example)
 
-  step_fn = make_train_step(loss_fn, optimizer, mesh, params, opt_state,
-                            batch_example)
+    def step_fn(carry, *batch):  # unified: carry -> (carry, loss)
+      st, loss = sparse_step(carry, *batch)
+      return st, loss
+
+    carry = state
+  else:
+    params = model.init(jax.random.PRNGKey(0), batch_example[0],
+                        batch_example[1])["params"]
+    opt_state = optimizer.init(params)
+    params = shard_params(params, mesh)
+    opt_state = shard_params(opt_state, mesh)
+
+    def loss_fn(params, numerical, cats, labels):
+      logits = model.apply({"params": params}, numerical, cats)
+      return bce_loss(logits, labels)
+
+    dense_step = make_train_step(loss_fn, optimizer, mesh, params,
+                                 opt_state, batch_example)
+
+    def step_fn(carry, *batch):  # unified: carry -> (carry, loss)
+      params, opt_state, loss = dense_step(*carry, *batch)
+      return (params, opt_state), loss
+
+    carry = (params, opt_state)
 
   t_start, losses = time.time(), []
   steps_done = 0
@@ -173,13 +222,17 @@ def main():
       sharded = shard_batch(
           (jnp.asarray(numerical), [jnp.asarray(c) for c in cats],
            jnp.asarray(labels)), mesh)
-      params, opt_state, loss = step_fn(params, opt_state, *sharded)
+      carry, loss = step_fn(carry, *sharded)
       losses.append(float(loss))
       steps_done += 1
       if steps_done % 100 == 0:
         rate = steps_done * args.batch_size / (time.time() - t_start)
         print(f"step {steps_done} loss {np.mean(losses[-100:]):.5f} "
               f"{rate:,.0f} samples/sec")
+      if args.sparse and args.checkpoint_dir and args.checkpoint_every \
+          and steps_done % args.checkpoint_every == 0:
+        ckpt.save(args.checkpoint_dir, plan, rule, carry)
+        print(f"checkpointed step {steps_done} -> {args.checkpoint_dir}")
       if steps_done >= args.steps:
         break
     if steps_done >= args.steps:
@@ -189,25 +242,45 @@ def main():
         f"({steps_done * args.batch_size / max(elapsed, 1e-9):,.0f} samples/sec)"
         f" final loss {np.mean(losses[-10:]):.5f}")
 
-  if args.eval:
-    def pred_fn(params, numerical, cats):
-      return jax.nn.sigmoid(model.apply({"params": params}, numerical, cats))
+  if args.sparse and args.checkpoint_dir:
+    ckpt.save(args.checkpoint_dir, plan, rule, carry)
+    print(f"saved full train state -> {args.checkpoint_dir}")
 
-    eval_step = make_eval_step(pred_fn, mesh, params, batch_example[:2])
+  if args.eval:
+    if args.sparse:
+      from distributed_embeddings_tpu.training import make_sparse_eval_step
+
+      raw_eval = make_sparse_eval_step(model, plan, rule, mesh, carry,
+                                       batch_example[:2])
+      eval_step = lambda _, *xs: jax.nn.sigmoid(  # noqa: E731
+          raw_eval(carry, *xs))
+      eval_params = None
+    else:
+      def pred_fn(params, numerical, cats):
+        return jax.nn.sigmoid(model.apply({"params": params}, numerical,
+                                          cats))
+
+      eval_step = make_eval_step(pred_fn, mesh, carry[0],
+                                 batch_example[:2])
+      eval_params = carry[0]
     all_scores, all_labels = [], []
     for numerical, cats, labels in eval_data:
       sharded = shard_batch(
           (jnp.asarray(numerical), [jnp.asarray(c) for c in cats]), mesh)
-      all_scores.append(np.asarray(eval_step(params, *sharded)))
+      all_scores.append(np.asarray(eval_step(eval_params, *sharded)))
       all_labels.append(labels)
     score = auc(np.concatenate(all_labels), np.concatenate(all_scores))
     print(f"eval AUC: {score:.5f}")
 
   if args.save_checkpoint:
-    # global-view numpy checkpoint (reference `examples/dlrm/main.py:245-248`)
-    plan = dlrm_embedding_plan(vocab, args.embedding_dim, world,
-                               args.strategy, args.column_slice_threshold)
-    tables = get_weights(plan, params["embeddings"])
+    # global-view numpy table checkpoint (reference
+    # `examples/dlrm/main.py:245-248`)
+    if args.sparse:
+      from distributed_embeddings_tpu.training import unpack_sparse_state
+      full_params, _ = unpack_sparse_state(plan, rule, carry)
+      tables = get_weights(plan, full_params["embeddings"])
+    else:
+      tables = get_weights(plan, carry[0]["embeddings"])
     np.savez(args.save_checkpoint, *tables)
     print(f"saved {len(tables)} tables to {args.save_checkpoint}")
 

@@ -1,0 +1,134 @@
+"""Checkpoint/resume tests (full fused train state).
+
+The reference has no optimizer-state or step checkpointing (SURVEY §5);
+this subsystem snapshots everything, so the key property is bit-exact
+resume: train k steps, save, restore, train k more == train 2k straight.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_embeddings_tpu import checkpoint
+from distributed_embeddings_tpu.layers import TableConfig
+from distributed_embeddings_tpu.layers.planner import DistEmbeddingStrategy
+from distributed_embeddings_tpu.models import DLRM, bce_loss
+from distributed_embeddings_tpu.ops.packed_table import adagrad_rule, sgd_rule
+from distributed_embeddings_tpu.parallel import create_mesh
+from distributed_embeddings_tpu.training import (
+    init_sparse_state,
+    make_sparse_train_step,
+    shard_batch,
+    shard_params,
+)
+
+WORLD = 8
+VOCAB = [300, 200, 150, 120, 100, 80, 60, 40, 30, 20]
+
+
+def build(world, rule_name="adagrad"):
+  model = DLRM(vocab_sizes=VOCAB, embedding_dim=16, bottom_mlp=(32, 16),
+               top_mlp=(32, 1), world_size=world, dense_row_threshold=32)
+  plan = DistEmbeddingStrategy(
+      [dict(input_dim=v, output_dim=16,
+            initializer={"name": "uniform", "scale": 0.05}) for v in VOCAB],
+      world, "basic", dense_row_threshold=32)
+  rule = (adagrad_rule if rule_name == "adagrad" else sgd_rule)(0.05)
+  opt = optax.adagrad(0.05) if rule_name == "adagrad" else optax.sgd(0.05)
+  return model, plan, rule, opt
+
+
+def make_batch(world, seed=0):
+  rng = np.random.default_rng(seed)
+  b = 4 * world
+  numerical = jnp.asarray(rng.standard_normal((b, 13)), jnp.float32)
+  cats = [jnp.asarray(rng.integers(0, v, b).astype(np.int32)) for v in VOCAB]
+  labels = jnp.asarray(rng.integers(0, 2, b).astype(np.float32))
+  return numerical, cats, labels
+
+
+def init_state(model, plan, rule, opt, batch, mesh=None):
+  numerical, cats, _ = batch
+  params = model.init(jax.random.PRNGKey(0), numerical, cats)["params"]
+  state = init_sparse_state(plan, params, rule, opt)
+  if mesh is not None:
+    state = shard_params(state, mesh)
+  return state
+
+
+@pytest.mark.parametrize("use_mesh,rule_name",
+                         [(False, "adagrad"), (True, "adagrad"),
+                          (True, "sgd")])
+def test_save_restore_resume_bit_exact(tmp_path, use_mesh, rule_name):
+  world = WORLD if use_mesh else 1
+  mesh = create_mesh(world) if use_mesh else None
+  model, plan, rule, opt = build(world, rule_name)
+  batch = make_batch(world)
+  state = init_state(model, plan, rule, opt, batch, mesh)
+  step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
+                                state, batch, donate=False)
+  sb = shard_batch(batch, mesh) if mesh is not None else batch
+
+  # straight run: 4 steps
+  s = state
+  for _ in range(4):
+    s, _ = step(s, *sb)
+  straight = jax.device_get(s)
+
+  # interrupted run: 2 steps, save, restore, 2 more
+  s = state
+  for _ in range(2):
+    s, _ = step(s, *sb)
+  path = os.path.join(tmp_path, "ckpt")
+  checkpoint.save(path, plan, rule, s)
+  restored = checkpoint.restore(path, plan, rule, s, mesh=mesh)
+  assert int(jax.device_get(restored["step"])) == 2
+  for _ in range(2):
+    restored, _ = step(restored, *sb)
+  resumed = jax.device_get(restored)
+
+  flat_a = jax.tree_util.tree_leaves(straight)
+  flat_b = jax.tree_util.tree_leaves(resumed)
+  assert len(flat_a) == len(flat_b)
+  for a, b in zip(flat_a, flat_b):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_restore_rejects_wrong_rule(tmp_path):
+  model, plan, rule, opt = build(1)
+  batch = make_batch(1)
+  state = init_state(model, plan, rule, opt, batch)
+  path = os.path.join(tmp_path, "ckpt")
+  checkpoint.save(path, plan, rule, state)
+  with pytest.raises(ValueError, match="rule"):
+    checkpoint.restore(path, plan, sgd_rule(0.05), state)
+
+
+def test_restore_rejects_wrong_plan(tmp_path):
+  model, plan, rule, opt = build(1)
+  batch = make_batch(1)
+  state = init_state(model, plan, rule, opt, batch)
+  path = os.path.join(tmp_path, "ckpt")
+  checkpoint.save(path, plan, rule, state)
+  other = DistEmbeddingStrategy(
+      [dict(input_dim=v + 1, output_dim=16) for v in VOCAB], 1, "basic")
+  with pytest.raises(ValueError, match="plan"):
+    checkpoint.restore(path, other, rule, state)
+
+
+def test_save_is_atomic_and_keeps_backup(tmp_path):
+  model, plan, rule, opt = build(1)
+  batch = make_batch(1)
+  state = init_state(model, plan, rule, opt, batch)
+  path = os.path.join(tmp_path, "ckpt")
+  checkpoint.save(path, plan, rule, state)
+  first_manifest = open(os.path.join(path, "manifest.json")).read()
+  # second save replaces, keeps .old
+  checkpoint.save(path, plan, rule, state)
+  assert os.path.isdir(path + ".old")
+  assert open(os.path.join(path + ".old",
+                           "manifest.json")).read() == first_manifest
