@@ -1,0 +1,28 @@
+# Developer entry points (the reference drives its native build + tests from
+# make, `/root/reference/Makefile`; here the native loader builds itself on
+# first import, so these are conveniences).
+
+PY ?= python
+
+.PHONY: test test-fast native bench dryrun clean
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test-fast:
+	$(PY) -m pytest tests/ -q -x -k "not training and not checkpoint"
+
+# force-(re)build the native C++ data loader
+native:
+	$(PY) -c "from distributed_embeddings_tpu.cc import build; print('built:', build(force=True))"
+
+# the driver-facing benchmark (real TPU; BENCH_AMP=1 for bf16 compute)
+bench:
+	$(PY) bench.py
+
+# multi-chip compile/execute validation on 8 virtual CPU devices
+dryrun:
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+clean:
+	rm -rf distributed_embeddings_tpu/cc/*.so __pycache__ */__pycache__

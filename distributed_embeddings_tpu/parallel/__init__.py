@@ -13,6 +13,7 @@ from .mesh import (
     DEFAULT_AXIS,
     batch_sharding,
     create_mesh,
+    initialize_multihost,
     replicated,
     table_sharding,
 )
@@ -28,6 +29,7 @@ __all__ = [
     "DEFAULT_AXIS",
     "batch_sharding",
     "create_mesh",
+    "initialize_multihost",
     "replicated",
     "table_sharding",
 ]

@@ -271,7 +271,12 @@ class DistributedLookup:
 
   def _build_routing(self, key, bucket: Bucket,
                      inputs: Sequence[jax.Array]) -> jax.Array:
-    """[world, n_b, B_local, h] routing tensor for one bucket.
+    """[world, n_b, B_local, h] routing tensor for one bucket (h == 1
+    buckets drop the hotness axis: [world, n_b, B_local]).
+
+    Squeezing the trailing unit axis matters: TPU tiling pads the minor
+    dim to 128 lanes, so an int32 [..., B, 1] tensor occupies (and an
+    all_to_all would move) 128x its logical bytes.
 
     Sentinel (= buffer row count) marks padded slots and PAD_ID entries; for
     dense-class slots ids stay slot-local *plus row_offset* exactly like
@@ -280,7 +285,8 @@ class DistributedLookup:
     world = self.plan.world_size
     sentinel = padded_rows(self.plan, key)
     b = inputs[0].shape[0]
-    pad_block = jnp.full((b, bucket.h), sentinel, jnp.int32)
+    pad_shape = (b,) if bucket.h == 1 else (b, bucket.h)
+    pad_block = jnp.full(pad_shape, sentinel, jnp.int32)
     per_dest = []
     for rank in range(world):
       idxs = bucket.slot_idx_per_rank[rank]
@@ -289,6 +295,8 @@ class DistributedLookup:
         if k < len(idxs):
           slot = cp.slots_per_rank[rank][idxs[k]]
           ids = inputs[slot.input_id]
+          if bucket.h == 1:
+            ids = ids[:, 0]
           sh = slot.shard
           if sh.row_sliced:
             # row shard: serve only ids inside this shard's vocab window
@@ -337,31 +345,37 @@ class DistributedLookup:
     ids_all: Dict[tuple, jax.Array] = {}
     for key in plan.class_keys:
       for bucket in self._buckets(key, hotness_of):
-        x = self._build_routing(key, bucket, inputs)  # [world, n_b, B, h]
+        x = self._build_routing(key, bucket, inputs)  # [world, n_b, B(, h)]
         if world > 1:
           y = lax.all_to_all(x, self.axis_name, split_axis=0, concat_axis=0)
         else:
           y = x
-        ids_all[bucket_key(key, bucket.h, bucket.vcap, bucket.rs)] = (
-            jnp.transpose(y, (1, 0, 2, 3)).reshape(
-                bucket.n_b, world * b, bucket.h))
+        if bucket.h == 1:  # [world, n_b, B] -> [n_b, G]
+          routed = jnp.transpose(y, (1, 0, 2)).reshape(bucket.n_b, world * b)
+        else:  # [world, n_b, B, h] -> [n_b, G, h]
+          routed = jnp.transpose(y, (1, 0, 2, 3)).reshape(
+              bucket.n_b, world * b, bucket.h)
+        ids_all[bucket_key(key, bucket.h, bucket.vcap, bucket.rs)] = routed
     return ids_all
 
   # ---- mp-side local lookups ---------------------------------------------
   def _combine(self, rows: jax.Array, ids_all: jax.Array, key,
                rs: bool = False) -> jax.Array:
-    """[n_b, G, h, w] gathered rows -> [n_b, G, w] via the class combiner.
+    """Gathered rows -> [n_b, G, w] via the class combiner.
+
+    ``ids_all`` is [n_b, G] for hotness-1 buckets (rows [n_b, G, w] pass
+    through) or [n_b, G, h] for multi-hot (rows [n_b, G, h, w] reduce).
 
     For row-sliced buckets (``rs``) the mean division is deferred to
     :meth:`assemble`: the sentinel count here reflects only the ids this
     shard's vocab window served, not the sample's true hotness."""
     cp = self.plan.classes[key]
     sentinel = padded_rows(self.plan, key)
-    if cp.combiner is None and ids_all.shape[-1] != 1:
+    if ids_all.ndim == 2 or ids_all.shape[-1] == 1:
+      return rows if ids_all.ndim == 2 else rows[:, :, 0, :]
+    if cp.combiner is None:
       raise ValueError("combiner=None requires hotness-1 inputs in the "
                        "distributed path (2-D model-parallel outputs)")
-    if ids_all.shape[-1] == 1:
-      return rows[:, :, 0, :]
     summed = jnp.sum(rows, axis=2)
     if cp.combiner == "mean" and not rs:
       counts = jnp.sum(ids_all < sentinel, axis=2).astype(summed.dtype)
@@ -394,7 +408,9 @@ class DistributedLookup:
     uniform: window starts are data (indexed by ``lax.axis_index``), window
     size is the bucket's static ``vcap``.
     """
-    n_b, g, h = ids_all.shape
+    two_d = ids_all.ndim == 2  # hotness-1 buckets drop the h axis
+    n_b, g = ids_all.shape[:2]
+    h = 1 if two_d else ids_all.shape[2]
     cp_check = self.plan.classes[key]
     if cp_check.combiner is None and h != 1:
       # same contract as the sparse path's _combine: without a combiner a
@@ -405,7 +421,8 @@ class DistributedLookup:
     vcap = bucket.vcap
     offs_const = jnp.asarray(self._dense_offsets(key, bucket))  # [world, n_b]
     offs = offs_const[self._my_rank()]  # [n_b]
-    ids_local = ids_all - offs[:, None, None]  # slot-local; OOB -> no one-hot
+    off_bcast = offs[:, None] if two_d else offs[:, None, None]
+    ids_local = ids_all - off_bcast  # slot-local; OOB -> no one-hot
 
     def window(o):
       return lax.dynamic_slice(table_local, (o, 0), (vcap, table_local.shape[1]))
@@ -415,9 +432,10 @@ class DistributedLookup:
     # bf16 one-hot is exact (values are 0/1) and halves the [G, vcap]
     # staging memory; HIGHEST precision keeps the f32 table values intact
     # through the MXU (default precision would round them to bf16).
-    def z_of(ids_c):  # [n_b, C, h] -> [n_b, C, w]
+    def z_of(ids_c):  # [n_b, C(, h)] -> [n_b, C, w]
       oh = jax.nn.one_hot(ids_c, vcap, dtype=jnp.bfloat16)
-      return jnp.einsum("nghv,nvw->ngw", oh, wins,
+      eq = "ngv,nvw->ngw" if two_d else "nghv,nvw->ngw"
+      return jnp.einsum(eq, oh, wins,
                         precision=jax.lax.Precision.HIGHEST,
                         preferred_element_type=jnp.float32
                         ).astype(table_local.dtype)
@@ -433,9 +451,13 @@ class DistributedLookup:
       pad = nchunks * chunk - g
       ids_c = ids_local
       if pad:
+        pad_shape = (n_b, pad) if two_d else (n_b, pad, h)
         ids_c = jnp.concatenate(
-            [ids_c, jnp.full((n_b, pad, h), -1, ids_c.dtype)], axis=1)
-      xs = ids_c.reshape(n_b, nchunks, chunk, h).transpose(1, 0, 2, 3)
+            [ids_c, jnp.full(pad_shape, -1, ids_c.dtype)], axis=1)
+      if two_d:
+        xs = ids_c.reshape(n_b, nchunks, chunk).transpose(1, 0, 2)
+      else:
+        xs = ids_c.reshape(n_b, nchunks, chunk, h).transpose(1, 0, 2, 3)
       _, zs = lax.scan(
           jax.checkpoint(lambda c, i: (c, z_of(i))), None, xs)
       z = zs.transpose(1, 0, 2, 3).reshape(n_b, nchunks * chunk, -1)[:, :g]
@@ -673,7 +695,8 @@ class DistributedLookup:
       table_local = self._squeeze_local(dense_params[class_param_name(*key)])
       bucket = self._find_bucket(key, bk.h, bk.vcap, hotness_of)
       # remat: don't keep the [G, vcap] one-hot staging alive for the
-      # backward — rebuilding it is a handful of VPU compares
+      # backward — rebuilding it is a handful of VPU compares (measured
+      # neutral on the DLRM bench, and it saves ~1 GiB live at batch 64k)
       z_fn = jax.checkpoint(
           lambda t, i, key=key, bucket=bucket: self._z_dense(
               key, bucket, t, i))
@@ -729,6 +752,7 @@ class DistributedLookup:
         # merge) — the reference's sorted/unique semantics
         ids = jnp.concatenate([p[0].reshape(-1) for p in parts])
         g = jnp.concatenate([
+            dzb.reshape(-1, w) if idb.ndim == 2 else
             jnp.broadcast_to(dzb[:, :, None, :], idb.shape + (w,))
             .reshape(-1, w) for idb, dzb, _, _ in parts])
         sr = dedup_rows(ids, g, layout.rows)
@@ -759,12 +783,14 @@ class DistributedLookup:
                      else None)
             all_ids.append(ids.reshape(-1))
             all_deltas.append(rule.delta(g, aux_r, step))
-          buf = scatter_add_fused(
-              layout, buf,
-              all_ids[0] if len(all_ids) == 1
-              else jnp.concatenate(all_ids),
-              all_deltas[0] if len(all_deltas) == 1
-              else jnp.concatenate(all_deltas))
+          ids_cat = (all_ids[0] if len(all_ids) == 1
+                     else jnp.concatenate(all_ids))
+          delta_cat = (all_deltas[0] if len(all_deltas) == 1
+                       else jnp.concatenate(all_deltas))
+          # materialize the updates before the scatter: letting XLA fuse
+          # the delta computation into the scatter slows its update loop
+          ids_cat, delta_cat = lax.optimization_barrier((ids_cat, delta_cat))
+          buf = scatter_add_fused(layout, buf, ids_cat, delta_cat)
         else:
           # memory escape hatch for extreme occurrence counts (hotness
           # 200-500 models): compute the delta per chunk (never holding

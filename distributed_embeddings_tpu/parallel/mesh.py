@@ -34,6 +34,25 @@ def create_mesh(world_size: Optional[int] = None,
   return Mesh(np.asarray(devices[:world_size]), (axis_name,))
 
 
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> Mesh:
+  """Bring up the multi-host runtime and return the global 1-D mesh.
+
+  The TPU-native replacement for the reference's ``hvd.init()`` + MPI
+  launcher bootstrap: call once per host process before any jax op (on
+  Cloud TPU pods the arguments are auto-detected from the environment and
+  may be omitted). Afterwards ``jax.devices()`` is the global device list,
+  and every train step built by this library runs unchanged — within-slice
+  collectives ride ICI, cross-slice DCN, both inserted by XLA from the
+  same ``PartitionSpec``s.
+  """
+  jax.distributed.initialize(coordinator_address=coordinator_address,
+                             num_processes=num_processes,
+                             process_id=process_id)
+  return create_mesh()
+
+
 def table_sharding(mesh: Mesh, axis_name: str = DEFAULT_AXIS) -> NamedSharding:
   """Sharding for class-stacked table params [world * rows, width]."""
   return NamedSharding(mesh, P(axis_name, None))

@@ -1,7 +1,9 @@
 """Ablation timing of the DLRM step: route / gather / fwd / bwd / full.
 
-Big state is closed over (captured constant) so non-donated cases do not
-duplicate the multi-GiB buffers; only a scalar carry chains iterations.
+State is passed as explicit args but only a scalar is returned, so
+non-donated cases neither copy the multi-GiB buffers on output nor bake
+them into the executable as constants (closing over them exploded
+compile time).
 
 Usage: [AMP=1] python tools/profile_dlrm_parts.py [batch] [vocab_scale]
 """
@@ -60,15 +62,15 @@ def main():
   hotness_of = lambda i: 1  # noqa: E731
 
   def timeit(name, body):
-    """body(carry_scalar) -> scalar; closes over state/batch."""
+    """body(state, carry_scalar) -> scalar."""
     step = jax.jit(body)
-    c = step(jnp.zeros((), jnp.float32))
+    c = step(state, jnp.zeros((), jnp.float32))
     float(c)
 
     def run(n, c):
       t0 = time.perf_counter()
       for _ in range(n):
-        c = step(c)
+        c = step(state, c)
       float(c)
       return time.perf_counter() - t0, c
 
@@ -80,21 +82,21 @@ def main():
     bump = (carry * 0).astype(jnp.int32)
     return [c + bump for c in cats]
 
-  def route_only(carry):
+  def route_only(state, carry):
     ids_all = engine.route_ids(cats_dep(carry), hotness_of)
     return carry + sum(v.sum() for v in ids_all.values()).astype(
         jnp.float32) * 0
 
   timeit("route_ids", route_only)
 
-  def gather_only(carry):
+  def gather_only(state, carry):
     ids_all = engine.route_ids(cats_dep(carry), hotness_of)
     z, _ = engine.lookup_sparse_fused(state["fused"], layouts, ids_all)
     return carry + sum(zb.sum() for zb in z.values()).astype(jnp.float32) * 0
 
   timeit("route+gather", gather_only)
 
-  def fwd_only(carry):
+  def fwd_only(state, carry):
     ids_all = engine.route_ids(cats_dep(carry), hotness_of)
     z, _ = engine.lookup_sparse_fused(state["fused"], layouts, ids_all)
     acts = engine.finish_forward(z, state["emb_dense"], ids_all, BATCH,
@@ -105,7 +107,7 @@ def main():
 
   timeit("forward(loss)", fwd_only)
 
-  def bwd_no_apply(carry):
+  def bwd_no_apply(state, carry):
     ids_all = engine.route_ids(cats_dep(carry), hotness_of)
     z, _ = engine.lookup_sparse_fused(state["fused"], layouts, ids_all)
 
