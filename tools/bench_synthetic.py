@@ -1,0 +1,93 @@
+"""Synthetic model zoo step-time benchmark on the real chip.
+
+Counterpart of the reference's synthetic benchmark
+(`/root/reference/examples/benchmarks/synthetic_models/README.md:71-75`,
+1xA100 column): one full fused train step (Adagrad) at global batch 65536.
+
+Usage: python tools/bench_synthetic.py [model] [batch] [steps]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributed_embeddings_tpu.layers.planner import DistEmbeddingStrategy
+from distributed_embeddings_tpu.models import (
+    SYNTHETIC_MODELS,
+    SyntheticModel,
+    bce_loss,
+    expand_tables,
+    generate_batch,
+)
+from distributed_embeddings_tpu.ops.packed_table import adagrad_rule
+from distributed_embeddings_tpu.training import (
+    init_sparse_state_direct,
+    make_sparse_train_step,
+)
+
+A100_1X_MS = {"tiny": 24.433, "small": 67.355}  # reference README:71-72
+
+MODEL = sys.argv[1] if len(sys.argv) > 1 else "tiny"
+BATCH = int(sys.argv[2]) if len(sys.argv) > 2 else 65536
+STEPS = int(sys.argv[3]) if len(sys.argv) > 3 else 12
+
+
+def main():
+  cfg = SYNTHETIC_MODELS[MODEL]
+  tables, tmap, hotness = expand_tables(cfg)
+  model = SyntheticModel(config=cfg, world_size=1)
+  plan = DistEmbeddingStrategy(tables, 1, "basic", input_table_map=tmap,
+                               dense_row_threshold=model.dense_row_threshold)
+
+  batches = []
+  for i in range(2):
+    numerical, cats, labels = generate_batch(cfg, BATCH, alpha=1.05, seed=i)
+    cats = [np.minimum(c, tables[t].input_dim - 1).astype(np.int32)
+            for c, t in zip(cats, tmap)]
+    cats = [jnp.asarray(c if h > 1 else c[:, 0])
+            for c, h in zip(cats, hotness)]
+    batches.append((jnp.asarray(numerical), cats, jnp.asarray(labels)))
+
+  dense_opt = optax.adagrad(0.01)
+  rule = adagrad_rule(0.01)
+  dummy_acts = [jnp.zeros((2, tables[t].output_dim), jnp.float32)
+                for t in tmap]
+  small_cats = [c[:2] for c in batches[0][1]]
+  dense_params = model.init(jax.random.PRNGKey(0), batches[0][0][:2],
+                            small_cats, emb_acts=dummy_acts)["params"]
+
+  # AOT compile from abstract shapes BEFORE the big allocations
+  state_avals = jax.eval_shape(
+      lambda: init_sparse_state_direct(plan, rule, dense_params, dense_opt,
+                                       jax.random.PRNGKey(1)))
+  step = make_sparse_train_step(model, plan, bce_loss, dense_opt, rule,
+                                None, state_avals, batches[0])
+  compiled = step.lower(state_avals, *batches[0]).compile()
+  state = init_sparse_state_direct(plan, rule, dense_params, dense_opt,
+                                   jax.random.PRNGKey(1))
+  for i in range(3):
+    state, loss = compiled(state, *batches[i % 2])
+  float(loss)
+
+  def chain(n, state):
+    t0 = time.perf_counter()
+    for i in range(n):
+      state, loss = compiled(state, *batches[i % 2])
+    float(loss)
+    return time.perf_counter() - t0, state
+
+  t1, state = chain(STEPS, state)
+  t2, state = chain(2 * STEPS, state)
+  ms = (t2 - t1) / STEPS * 1e3
+  base = A100_1X_MS.get(MODEL)
+  vs = f"  vs 1xA100 {base / ms:.3f}x" if base else ""
+  print(f"{MODEL} batch={BATCH}: {ms:.2f} ms/step "
+        f"({BATCH / ms * 1e3:,.0f} samples/s){vs}")
+
+
+if __name__ == "__main__":
+  main()
