@@ -139,7 +139,17 @@ def _normalize_configs(embeddings) -> List[TableConfig]:
     elif isinstance(e, Embedding):
       configs.append(TableConfig.from_layer(e))
     elif isinstance(e, dict):
-      configs.append(TableConfig(**e))
+      # accept stock-Keras Embedding configs like the reference
+      # (`embedding.py:145-152` drops mask_zero/input_length): map the
+      # Keras initializer key and ignore Keras-only fields
+      d = dict(e)
+      if "embeddings_initializer" in d:
+        d.setdefault("initializer", d.pop("embeddings_initializer"))
+      for k in ("mask_zero", "input_length", "embeddings_regularizer",
+                "embeddings_constraint", "activity_regularizer", "dtype",
+                "batch_input_shape", "trainable"):
+        d.pop(k, None)
+      configs.append(TableConfig(**d))
     else:
       raise TypeError(f"Cannot build TableConfig from {type(e)}")
   return configs

@@ -441,12 +441,25 @@ def make_eval_step(pred_fn: Callable, mesh: Optional[Mesh],
 
 
 def shard_batch(batch, mesh: Optional[Mesh], axis_name: str = "mp"):
-  """Place a host batch onto the mesh with batch-dim sharding."""
+  """Place a host batch onto the mesh with batch-dim sharding.
+
+  Raises a clear error for a global batch not divisible by the mesh size
+  (the reference's equivalent check, `dist_model_parallel.py:352-365`,
+  errors on indivisible model-parallel batches)."""
   if mesh is None:
     return jax.tree_util.tree_map(jnp.asarray, batch)
+  world = mesh.devices.size
   sharding = NamedSharding(mesh, P(axis_name))
-  return jax.tree_util.tree_map(
-      lambda x: jax.device_put(jnp.asarray(x), sharding), batch)
+
+  def put(x):
+    x = jnp.asarray(x)
+    if x.ndim and x.shape[0] % world:
+      raise ValueError(
+          f"global batch {x.shape[0]} is not divisible by the mesh size "
+          f"{world}")
+    return jax.device_put(x, sharding)
+
+  return jax.tree_util.tree_map(put, batch)
 
 
 def shard_params(params, mesh: Optional[Mesh], axis_name: str = "mp"):

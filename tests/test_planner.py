@@ -253,3 +253,32 @@ def test_generation_split_forward_matches_unsplit():
       np.testing.assert_array_equal(w, g)
   for a, b in zip(outs[1 << 30], outs[24 * 8 * 4]):
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_accepts_stock_keras_embedding_configs():
+  """The reference accepts stock tf.keras Embedding configs by dropping
+  Keras-only fields (`embedding.py:145-152`); dict inputs here do the
+  same, mapping embeddings_initializer -> initializer."""
+  plan = DistEmbeddingStrategy(
+      [{"input_dim": 32, "output_dim": 8, "mask_zero": False,
+        "input_length": None, "embeddings_initializer": "uniform",
+        "dtype": "float32", "trainable": True},
+       {"input_dim": 16, "output_dim": 8}], 2)
+  assert [c.input_dim for c in plan.global_configs] == [32, 16]
+  assert plan.global_configs[0].initializer == "uniform"
+
+
+def test_planner_scales_to_colossal_table_counts():
+  """Plan construction must stay sub-second at the zoo's largest config
+  (2002 tables / 128 workers) — it runs identically on every process."""
+  import time
+
+  from distributed_embeddings_tpu.models import SYNTHETIC_MODELS, expand_tables
+  cfg = SYNTHETIC_MODELS["colossal"]
+  tables, tmap, _ = expand_tables(cfg)
+  t0 = time.perf_counter()
+  plan = DistEmbeddingStrategy(tables, 128, "memory_balanced",
+                               input_table_map=tmap,
+                               dense_row_threshold=2048)
+  assert time.perf_counter() - t0 < 5.0
+  assert sum(len(s) for s in plan.rank_shards) >= len(tables)

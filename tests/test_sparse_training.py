@@ -505,3 +505,15 @@ def test_sparse_optimizer_apply_matches_optax(name):
     updates, _ = opt.update(dense_grad, opt.init(table), table)
     got2, _ = jax.jit(sopt.apply)(got, sstate2, sr)
     assert np.isfinite(np.asarray(got2)).all()
+
+
+def test_shard_batch_rejects_indivisible_global_batch():
+  """Reference parity: an indivisible model-parallel batch errors clearly
+  (`dist_model_parallel.py:352-365`)."""
+  import pytest
+
+  from distributed_embeddings_tpu.parallel import create_mesh
+  from distributed_embeddings_tpu.training import shard_batch
+  mesh = create_mesh(8)
+  with pytest.raises(ValueError, match="not divisible"):
+    shard_batch((jnp.zeros((10, 4)),), mesh)
