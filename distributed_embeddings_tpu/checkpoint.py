@@ -74,6 +74,20 @@ def _unflatten_like(tree, flat: Dict[str, np.ndarray]):
 
 
 def _plan_fingerprint(plan: DistEmbeddingStrategy) -> Dict[str, Any]:
+  # "layout" pins the PHYSICAL placement, not just the logical tables: two
+  # plans with identical tables/world/strategy but different row/column
+  # slice thresholds produce different per-rank shard windows, and a
+  # checkpoint written under one must not restore under the other (the
+  # per-rank files would load rows into the wrong vocab windows).
+  layout = {}
+  for key in plan.class_keys:
+    cp = plan.classes[key]
+    layout[class_param_name(*key)] = [
+        [[s.shard.table_id, s.row_offset, s.shard.row_start,
+          s.shard.input_dim, s.shard.col_start, s.shard.col_end,
+          int(s.shard.row_sliced)]
+         for s in slots]
+        for slots in cp.slots_per_rank]
   return {
       "world_size": plan.world_size,
       "strategy": plan.strategy,
@@ -81,7 +95,13 @@ def _plan_fingerprint(plan: DistEmbeddingStrategy) -> Dict[str, Any]:
                  for c in plan.global_configs],
       "input_table_map": list(plan.input_table_map),
       "class_names": [class_param_name(*k) for k in plan.class_keys],
+      "layout": layout,
   }
+
+
+def _abbrev(v, limit: int = 200) -> str:
+  s = repr(v)
+  return s if len(s) <= limit else s[:limit] + f"... (+{len(s) - limit} chars)"
 
 
 def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
@@ -94,19 +114,28 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
   engine = DistributedLookup(plan)
   layouts = engine.fused_layouts(rule)
   tmp = path + ".tmp"
-  os.makedirs(tmp, exist_ok=True)
+  if os.path.exists(tmp):
+    # a stale .tmp from a crashed save would otherwise merge its files
+    # into this checkpoint via makedirs(exist_ok=True)
+    import shutil
+    shutil.rmtree(tmp)
+  os.makedirs(tmp)
 
   fused_meta = {}
   for name, arr in state["fused"].items():
     layout = layouts[name]
-    host = np.asarray(jax.device_get(arr))
     for r in range(plan.world_size):
-      block = host[r * layout.phys_rows:(r + 1) * layout.phys_rows]
+      # fetch ONE rank block at a time: device_get of the whole fused
+      # array would stage a global (possibly multi-rank x multi-GiB)
+      # buffer on this host, defeating the streaming design the restore
+      # side already has
+      block = np.asarray(
+          jax.device_get(arr[r * layout.phys_rows:(r + 1) * layout.phys_rows]))
       np.save(os.path.join(tmp, f"fused_{name}_r{r}.npy"), block)
     fused_meta[name] = {
         "phys_rows": layout.phys_rows,
         "phys_width": layout.phys_width,
-        "dtype": str(host.dtype),
+        "dtype": str(np.dtype(arr.dtype)),
     }
 
   for part in ("dense", "dense_opt", "emb_dense", "emb_dense_opt"):
@@ -149,6 +178,16 @@ def restore(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
   """
   engine = DistributedLookup(plan)
   layouts = engine.fused_layouts(rule)
+  if mesh is not None and mesh.devices.size != plan.world_size:
+    raise ValueError(
+        f"mesh has {mesh.devices.size} devices but the plan was built for "
+        f"world_size={plan.world_size}; restore() assembles one per-rank "
+        "file per mesh device")
+  if not os.path.exists(os.path.join(path, "manifest.json")) \
+      and os.path.exists(os.path.join(path + ".old", "manifest.json")):
+    # a crash between save()'s two renames leaves only the backup; fall
+    # back to it rather than silently restarting training from scratch
+    path = path + ".old"
   with open(os.path.join(path, "manifest.json")) as f:
     manifest = json.load(f)
   if manifest["format_version"] != FORMAT_VERSION:
@@ -160,11 +199,20 @@ def restore(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
         f"checkpoint was written with rule {manifest['rule']}, restoring "
         f"with {{'name': {rule.name!r}, 'n_aux': {rule.n_aux}}}")
   want = _plan_fingerprint(plan)
+  if "layout" not in manifest["plan"]:
+    # checkpoint written before the fingerprint carried the physical
+    # layout: fall back to the logical comparison (the fused-meta check
+    # below still guards phys shapes)
+    want = {k: v for k, v in want.items() if k != "layout"}
   if manifest["plan"] != want:
+    diff_keys = sorted(k for k in set(manifest["plan"]) | set(want)
+                       if manifest["plan"].get(k) != want.get(k))
+    detail = "; ".join(
+        f"{k}: saved={_abbrev(manifest['plan'].get(k))} "
+        f"have={_abbrev(want.get(k))}" for k in diff_keys)
     raise ValueError(
         "checkpoint plan does not match: re-create the DistEmbeddingStrategy "
-        f"with the same tables/world/strategy (saved {manifest['plan']}, "
-        f"have {want})")
+        f"with the same tables/world/strategy/slicing (differs in {detail})")
 
   fused = {}
   for key in plan.class_keys:
@@ -172,6 +220,15 @@ def restore(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
       continue
     name = class_param_name(*key)
     layout = layouts[name]
+    meta = manifest.get("fused", {}).get(name)
+    if meta is not None and (meta["phys_rows"] != layout.phys_rows
+                             or meta["phys_width"] != layout.phys_width):
+      raise ValueError(
+          f"checkpoint class {name!r} was saved with physical shape "
+          f"[{meta['phys_rows']}, {meta['phys_width']}] per rank, but the "
+          f"current plan/rule implies [{layout.phys_rows}, "
+          f"{layout.phys_width}] — the slicing thresholds or optimizer "
+          "rule differ from the saving run")
     files = [os.path.join(path, f"fused_{name}_r{r}.npy")
              for r in range(plan.world_size)]
     shape = (plan.world_size * layout.phys_rows, layout.phys_width)

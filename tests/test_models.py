@@ -43,6 +43,75 @@ def test_dot_interact_matches_naive():
   np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("self_interaction", [False, True])
+@pytest.mark.parametrize("pack", [1, 2, 4])
+def test_dot_interact_grad_matches_autodiff(self_interaction, pack):
+  """The hand-written VJP (sentinel zero column, symmetrized inv map,
+  self-interaction diagonal 2x, packed cross-sample zero blocks) must match
+  plain autodiff of the naive formulation exactly."""
+  rng = np.random.default_rng(1)
+  b, f, d = 8, 5, 16
+  bottom = jnp.asarray(rng.standard_normal((b, d)), jnp.float32)
+  embs = [jnp.asarray(rng.standard_normal((b, d)), jnp.float32)
+          for _ in range(f - 1)]
+
+  def naive(bo, es):
+    feats = jnp.stack([bo] + list(es), axis=1)
+    gram = jnp.einsum("bfd,bgd->bfg", feats, feats)
+    rows, cols = np.tril_indices(f, k=0 if self_interaction else -1)
+    acts = jnp.take(gram.reshape(b, f * f),
+                    jnp.asarray(rows * f + cols), axis=1)
+    return jnp.concatenate([acts, bo], axis=1)
+
+  def loss_custom(bo, es):
+    return jnp.sum(jnp.tanh(dot_interact(
+        bo, es, self_interaction=self_interaction, pack=pack)))
+
+  def loss_naive(bo, es):
+    return jnp.sum(jnp.tanh(naive(bo, es)))
+
+  np.testing.assert_allclose(loss_custom(bottom, embs),
+                             loss_naive(bottom, embs), rtol=1e-5)
+  g_c = jax.grad(loss_custom, argnums=(0, 1))(bottom, embs)
+  g_n = jax.grad(loss_naive, argnums=(0, 1))(bottom, embs)
+  np.testing.assert_allclose(np.asarray(g_c[0]), np.asarray(g_n[0]),
+                             rtol=1e-4, atol=1e-5)
+  for got, want in zip(g_c[1], g_n[1]):
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_dot_interact_grad_bf16_close():
+  """AMP path: the backward rounds the cotangent to bf16 (documented AMP
+  convention); grads must still match autodiff within bf16 tolerance."""
+  rng = np.random.default_rng(2)
+  b, f, d = 8, 5, 16
+  bottom = jnp.asarray(rng.standard_normal((b, d)), jnp.bfloat16)
+  embs = [jnp.asarray(rng.standard_normal((b, d)), jnp.bfloat16)
+          for _ in range(f - 1)]
+
+  def naive(bo, es):
+    feats = jnp.stack([bo] + list(es), axis=1)
+    gram = jnp.einsum("bfd,bgd->bfg", feats, feats,
+                      preferred_element_type=jnp.float32)
+    rows, cols = np.tril_indices(f, k=-1)
+    acts = jnp.take(gram.reshape(b, f * f),
+                    jnp.asarray(rows * f + cols), axis=1)
+    return jnp.concatenate([acts, bo.astype(acts.dtype)], axis=1)
+
+  loss_c = lambda bo: jnp.sum(jnp.tanh(dot_interact(bo, embs)))  # noqa: E731
+  loss_n = lambda bo: jnp.sum(jnp.tanh(naive(bo, embs)))  # noqa: E731
+  g_c = np.asarray(jax.grad(loss_c)(bottom), np.float32)
+  g_n = np.asarray(jax.grad(loss_n)(bottom), np.float32)
+  np.testing.assert_allclose(g_c, g_n, rtol=2e-2, atol=2e-2)
+
+
+def test_dot_interact_rejects_bad_pack():
+  x = jnp.zeros((4, 8))
+  with pytest.raises(ValueError, match="pack"):
+    dot_interact(x, [x], pack=0)
+
+
 def test_dlrm_single_device_forward_and_loss():
   rng = np.random.default_rng(1)
   vocab = [50, 60, 70, 80]

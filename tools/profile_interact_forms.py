@@ -1,0 +1,89 @@
+"""Find the fastest TPU formulation of the DLRM pairwise interaction.
+
+Usage: python tools/profile_interact_forms.py [batch]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+K = 8
+F = 27
+D = 128
+
+
+def timeit(name, fn, *args):
+  step = jax.jit(fn)
+  carry = step(*args)
+  jax.block_until_ready(carry)
+  float(carry)
+
+  def run(n):
+    t0 = time.perf_counter()
+    for _ in range(n):
+      c = step(*args)
+    float(c)
+    return time.perf_counter() - t0
+
+  t1 = run(K)
+  t2 = run(2 * K)
+  print(f"{name:44s}: {(t2 - t1) / K * 1e3:8.2f} ms", flush=True)
+
+
+def main():
+  key = jax.random.PRNGKey(0)
+  feats = jax.random.normal(key, (BATCH, F, D), jnp.float32)
+  feats16 = feats.astype(jnp.bfloat16)
+
+  def naive(x):
+    return jnp.sum(jnp.einsum("bfd,bgd->bfg", x, x,
+                              preferred_element_type=jnp.float32))
+
+  timeit("einsum bfg f32", naive, feats)
+  timeit("einsum bfg bf16 in", naive, feats16)
+
+  for pack in (2, 4, 8, 16):
+    def packed(x, pack=pack):
+      p = x.reshape(BATCH // pack, pack * F, D)
+      return jnp.sum(jnp.einsum("bpd,bqd->bpq", p, p,
+                                preferred_element_type=jnp.float32))
+    timeit(f"packed x{pack} f32", packed, feats)
+    timeit(f"packed x{pack} bf16 in", packed, feats16)
+
+  def packed_bf16out(x, pack=8):
+    p = x.reshape(BATCH // pack, pack * F, D)
+    return jnp.sum(jnp.einsum("bpd,bqd->bpq", p, p,
+                              preferred_element_type=jnp.bfloat16)
+                   .astype(jnp.float32))
+
+  timeit("packed x8 bf16 in+out", packed_bf16out, feats16)
+
+  # pad F to 32 first (aligned sublanes), then batched matmul
+  def padded32(x):
+    xp = jnp.pad(x, ((0, 0), (0, 5), (0, 0)))
+    return jnp.sum(jnp.einsum("bfd,bgd->bfg", xp, xp,
+                              preferred_element_type=jnp.float32))
+
+  timeit("einsum F->32 padded f32", padded32, feats)
+
+  # dot_general with explicit transpose staged
+  def matmul_t(x):
+    xt = jnp.swapaxes(x, 1, 2)  # [B, D, F]
+    return jnp.sum(jnp.matmul(x, xt))
+
+  timeit("matmul + swapaxes f32", matmul_t, feats)
+
+  # one-sided: big single matmul [B*F, D] x [D, D] as calibration of peak
+  def calib(x):
+    w = jnp.ones((D, D), x.dtype)
+    return jnp.sum(jnp.matmul(x.reshape(-1, D), w))
+
+  timeit("calib [B*27,128]x[128,128] f32", calib, feats)
+  timeit("calib bf16", calib, feats16)
+
+
+if __name__ == "__main__":
+  main()

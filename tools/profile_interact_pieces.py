@@ -1,0 +1,95 @@
+"""Isolate stack/einsum/take/concat costs inside dot_interact.
+
+Usage: python tools/profile_interact_pieces.py [batch]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+K = 8
+F = 27
+D = 128
+
+
+def timeit(name, fn, *args):
+  step = jax.jit(fn)
+  c = step(*args)
+  jax.block_until_ready(c)
+  float(c)
+
+  def run(n):
+    t0 = time.perf_counter()
+    for _ in range(n):
+      c = step(*args)
+    float(c)
+    return time.perf_counter() - t0
+
+  t1 = run(K)
+  t2 = run(2 * K)
+  print(f"{name:40s}: {(t2 - t1) / K * 1e3:8.2f} ms", flush=True)
+
+
+def main():
+  key = jax.random.PRNGKey(0)
+  parts = [jax.random.normal(jax.random.fold_in(key, i), (BATCH, D),
+                             jnp.float32) for i in range(F)]
+  feats = jnp.stack(parts, axis=1)
+  rows, cols = np.tril_indices(F, k=-1)
+  take = jnp.asarray(rows * F + cols, jnp.int32)
+  p = len(rows)
+
+  timeit("stack 27x[B,128]", lambda *ps: jnp.sum(jnp.stack(ps, 1)), *parts)
+
+  def einsum_only(x):
+    return jnp.sum(jnp.einsum("bfd,bgd->bfg", x, x,
+                              preferred_element_type=jnp.float32))
+
+  timeit("einsum only", einsum_only, feats)
+
+  inter = jnp.einsum("bfd,bgd->bfg", feats, feats)
+  flat = inter.reshape(BATCH, F * F)
+
+  timeit("take axis1 379-of-729", lambda f: jnp.sum(jnp.take(f, take, axis=1)),
+         flat)
+
+  sel = np.zeros((F * F, p), np.float32)
+  sel[np.asarray(take), np.arange(p)] = 1.0
+  sel16 = jnp.asarray(sel, jnp.bfloat16)
+
+  def take_mm(f):
+    return jnp.sum(jnp.einsum("bi,ip->bp", f.astype(jnp.bfloat16), sel16,
+                              preferred_element_type=jnp.float32))
+
+  timeit("take via bf16 matmul", take_mm, flat)
+
+  def einsum_take(x):
+    i = jnp.einsum("bfd,bgd->bfg", x, x, preferred_element_type=jnp.float32)
+    return jnp.sum(jnp.take(i.reshape(BATCH, F * F), take, axis=1))
+
+  timeit("einsum + take", einsum_take, feats)
+
+  def einsum_take_mm(x):
+    i = jnp.einsum("bfd,bgd->bfg", x, x, preferred_element_type=jnp.float32)
+    return jnp.sum(jnp.einsum("bi,ip->bp", i.reshape(BATCH, F * F)
+                              .astype(jnp.bfloat16), sel16,
+                              preferred_element_type=jnp.float32))
+
+  timeit("einsum + take-matmul", einsum_take_mm, feats)
+
+  # full fwd as in dot_interact (stack from parts)
+  def full(x0, *rest):
+    fe = jnp.stack([x0] + list(rest), 1)
+    i = jnp.einsum("bfd,bgd->bfg", fe, fe, preferred_element_type=jnp.float32)
+    acts = jnp.take(i.reshape(BATCH, F * F), take, axis=1)
+    return jnp.sum(jnp.concatenate([acts, x0], axis=1))
+
+  timeit("full fwd (stack+einsum+take+cat)", full, *parts)
+
+
+if __name__ == "__main__":
+  main()

@@ -1,0 +1,117 @@
+"""Break down DLRM model fwd+bwd cost: MLPs, interaction, precision.
+
+Usage: python tools/profile_model_parts.py [batch]
+"""
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+K = 8
+W = 128
+N_TABLES = 26
+
+
+def timeit(name, fn, *args):
+  step = jax.jit(fn)
+  carry = step(*args)
+  jax.block_until_ready(carry)
+  float(carry)  # fetch warmup
+
+  def run(n):
+    c = carry
+    t0 = time.perf_counter()
+    for _ in range(n):
+      c = step(*args)
+    float(c)
+    return time.perf_counter() - t0
+
+  t1 = run(K)
+  t2 = run(2 * K)
+  print(f"{name:40s}: {(t2 - t1) / K * 1e3:8.2f} ms", flush=True)
+
+
+def main():
+  key = jax.random.PRNGKey(0)
+  rng = np.random.default_rng(0)
+  x13 = jnp.asarray(rng.standard_normal((BATCH, 13)), jnp.float32)
+  labels = jnp.asarray(rng.integers(0, 2, BATCH), jnp.float32)
+  acts = [jax.random.normal(jax.random.fold_in(key, i), (BATCH, W),
+                            jnp.float32) for i in range(N_TABLES)]
+
+  import flax.linen as nn
+  from distributed_embeddings_tpu.models.dlrm import MLP, dot_interact, bce_loss
+
+  bottom = MLP((512, 256, 128), activate_final=True)
+  top = MLP((1024, 1024, 512, 256, 1))
+  pb = bottom.init(key, x13)["params"]
+
+  f = N_TABLES + 1
+  inter_dim = f * (f - 1) // 2 + W
+  xi = jax.random.normal(key, (BATCH, inter_dim), jnp.float32)
+  pt = top.init(key, xi)["params"]
+
+  def bottom_loss(p):
+    return jnp.sum(bottom.apply({"params": p}, x13))
+
+  def top_loss(p):
+    logits = jnp.squeeze(top.apply({"params": p}, xi), -1)
+    return bce_loss(logits, labels)
+
+  def inter_loss(b_out, acts):
+    return jnp.sum(dot_interact(b_out, acts))
+
+  b_out = jax.random.normal(key, (BATCH, W), jnp.float32)
+
+  timeit("bottom fwd", bottom_loss, pb)
+  timeit("bottom fwd+bwd", lambda p: jax.value_and_grad(bottom_loss)(p)[0]
+         + sum(jnp.sum(v) for v in jax.tree_util.tree_leaves(
+             jax.grad(bottom_loss)(p))) * 0, pb)
+
+  def bottom_vg(p):
+    l, g = jax.value_and_grad(bottom_loss)(p)
+    return l + sum(jnp.sum(v) for v in jax.tree_util.tree_leaves(g)) * 1e-30
+
+  def top_vg(p):
+    l, g = jax.value_and_grad(top_loss)(p)
+    return l + sum(jnp.sum(v) for v in jax.tree_util.tree_leaves(g)) * 1e-30
+
+  def inter_vg(b_out, acts):
+    l, (gb, ga) = jax.value_and_grad(inter_loss, argnums=(0, 1))(b_out, acts)
+    return l + jnp.sum(gb) * 1e-30 + sum(a.sum() for a in ga) * 1e-30
+
+  timeit("bottom fwd+bwd", bottom_vg, pb)
+  timeit("top fwd", top_loss, pt)
+  timeit("top fwd+bwd", top_vg, pt)
+  timeit("interact fwd", inter_loss, b_out, acts)
+  timeit("interact fwd+bwd", inter_vg, b_out, acts)
+
+  # precision sweep on the top MLP (the FLOPs king)
+  for prec in ("bfloat16", "tensorfloat32", "float32", "highest"):
+    with jax.default_matmul_precision(prec):
+      def top_vg_p(p):
+        l, g = jax.value_and_grad(top_loss)(p)
+        return l + sum(jnp.sum(v) for v in jax.tree_util.tree_leaves(g)) \
+            * 1e-30
+      timeit(f"top fwd+bwd prec={prec}", top_vg_p, pt)
+
+  # bf16 compute dtype (params f32, compute bf16 = AMP)
+  top16 = MLP((1024, 1024, 512, 256, 1), dtype=jnp.bfloat16)
+
+  def top16_vg(p):
+    def loss(p):
+      logits = jnp.squeeze(top16.apply({"params": p}, xi), -1)
+      return bce_loss(logits, labels)
+    l, g = jax.value_and_grad(loss)(p)
+    return l + sum(jnp.sum(v) for v in jax.tree_util.tree_leaves(g)) * 1e-30
+
+  timeit("top fwd+bwd bf16 compute", top16_vg, pt)
+
+
+if __name__ == "__main__":
+  main()

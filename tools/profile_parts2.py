@@ -1,0 +1,153 @@
+"""Fine-grained DLRM step ablation: isolate apply scatter, MLP bwd,
+interaction bwd, dense one-hot bwd at the exact bench shapes.
+
+Usage: python tools/profile_parts2.py [batch] [vocab_scale]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+CRITEO_1TB_VOCAB = [
+    39884406, 39043, 17289, 7420, 20263, 3, 7120, 1543, 63, 38532951,
+    2953546, 403346, 10, 2208, 11938, 155, 4, 976, 14, 39979771,
+    25641295, 39664984, 585935, 12972, 108, 36
+]
+
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+SCALE = float(sys.argv[2]) if len(sys.argv) > 2 else 0.0625
+K = 8
+W = 128
+
+
+def timeit(name, fn, *args, donate=()):
+  """Times fn; with donate=(0,), fn must return the donated arg's successor.
+
+  Returns the final carry (the live successor buffer) so callers can keep
+  using it after the original was consumed by donation."""
+  step = jax.jit(fn, donate_argnums=donate)
+  args = list(args)
+  carry = step(*args)
+  jax.block_until_ready(carry)
+
+  def run(n, carry):
+    t0 = time.perf_counter()
+    for _ in range(n):
+      if donate:
+        args[donate[0]] = carry if not isinstance(carry, tuple) else carry[0]
+      carry = step(*args)
+    jax.tree_util.tree_map(
+        lambda x: float(x[(0,) * x.ndim]),
+        carry if isinstance(carry, tuple) else (carry,))
+    return time.perf_counter() - t0, carry
+
+  t1, carry = run(K, carry)
+  t2, carry = run(2 * K, carry)
+  print(f"{name:34s}: {(t2 - t1) / K * 1e3:8.2f} ms", flush=True)
+  return carry if not isinstance(carry, tuple) else carry[0]
+
+
+def main():
+  vocab = [max(4, int(v * SCALE)) for v in CRITEO_1TB_VOCAB]
+  sparse_vocab = [v for v in vocab if v > 2048]
+  n_sparse = len(sparse_vocab)
+  rows_total = sum(sparse_vocab)
+  print(f"sparse tables: {n_sparse}, total rows {rows_total}")
+
+  rng = np.random.default_rng(0)
+  key = jax.random.PRNGKey(0)
+
+  # ---- 1. the apply scatter in isolation (exact shapes) ----
+  buf = jax.random.normal(key, (rows_total, W), jnp.float32)
+  ids = jnp.asarray(rng.integers(0, rows_total, n_sparse * BATCH), jnp.int32)
+  d_z = jax.random.normal(key, (n_sparse, BATCH, W), jnp.float32)
+
+  def apply_like(buf, ids, d_z):
+    g = d_z.reshape(-1, W)
+    delta = -24.0 * g
+    ids2, delta = jax.lax.optimization_barrier((ids, delta))
+    return buf.at[ids2].add(delta, mode="drop")
+
+  buf = timeit("apply scatter (barrier)", apply_like, buf, ids, d_z, donate=(0,))
+
+  def apply_nobarrier(buf, ids, d_z):
+    g = d_z.reshape(-1, W)
+    return buf.at[ids].add(-24.0 * g, mode="drop")
+
+  buf = timeit("apply scatter (fused)", apply_nobarrier, buf, ids, d_z, donate=(0,))
+
+  # scatter with ids pre-sorted (locality)
+  ids_sorted = jnp.sort(ids)
+  buf = timeit("apply scatter (sorted ids)", apply_like, buf, ids_sorted, d_z,
+               donate=(0,))
+
+  # per-table scatter windows (9 scatters of 64k rows each, into one donated
+  # buffer) -- mimics per-bucket chunking
+  offs = np.cumsum([0] + sparse_vocab[:-1])
+  ids_tbl = jnp.stack([
+      jnp.asarray(rng.integers(0, v, BATCH) + o, jnp.int32)
+      for v, o in zip(sparse_vocab, offs)])
+
+  # ---- 2. MLPs + interaction fwd / fwd+bwd ----
+  from distributed_embeddings_tpu.models import DLRM, bce_loss
+  model = DLRM(vocab_sizes=vocab, embedding_dim=W, world_size=1)
+  numerical = jnp.asarray(rng.standard_normal((BATCH, 13)), jnp.float32)
+  cats = [jnp.asarray(rng.integers(0, v, BATCH), jnp.int32) for v in vocab]
+  labels = jnp.asarray(rng.integers(0, 2, BATCH), jnp.float32)
+  acts = [jax.random.normal(jax.random.fold_in(key, i), (BATCH, W),
+                            jnp.float32) for i in range(len(vocab))]
+  dense_params = model.init(jax.random.PRNGKey(0), numerical[:2],
+                            [c[:2] for c in cats],
+                            emb_acts=[a[:2] for a in acts])["params"]
+
+  def mlp_fwd(p, acts):
+    logits = model.apply({"params": p}, numerical, cats, emb_acts=acts)
+    return bce_loss(logits, labels)
+
+  timeit("model fwd (acts given)", mlp_fwd, dense_params, acts)
+
+  def mlp_bwd(p, acts):
+    loss, (d_p, d_a) = jax.value_and_grad(mlp_fwd, argnums=(0, 1))(p, acts)
+    return loss + sum(jnp.sum(v) for v in jax.tree_util.tree_leaves(d_p)) \
+        + sum(a.sum() for a in d_a)
+
+  timeit("model fwd+bwd (acts given)", mlp_bwd, dense_params, acts)
+
+  # ---- 3. one big scatter vs same volume as one scatter per table ----
+  def apply_per_table(buf, ids_tbl, d_z):
+    for t in range(n_sparse):
+      g = d_z[t]
+      buf = buf.at[ids_tbl[t]].add(-24.0 * g, mode="drop")
+    return buf
+
+  buf = timeit("apply 9x per-table scatter", apply_per_table, buf, ids_tbl,
+               d_z, donate=(0,))
+
+  # ---- 4. scatter into small buffer (microbench replica) ----
+  buf_small = jax.random.normal(key, (1 << 22, W), jnp.float32)
+  ids_small = jnp.asarray(rng.integers(0, 1 << 22, n_sparse * BATCH),
+                          jnp.int32)
+  buf_small = timeit("scatter 590k -> 4M rows", apply_like, buf_small,
+                     ids_small, d_z, donate=(0,))
+
+  # ---- 5. pure scatter, no delta compute (deltas precomputed) ----
+  delta_pre = jax.random.normal(key, (n_sparse * BATCH, W), jnp.float32)
+
+  def pure_scatter(buf, ids, delta):
+    return buf.at[ids].add(delta, mode="drop")
+
+  buf = timeit("pure scatter (pre delta)", pure_scatter, buf, ids, delta_pre,
+               donate=(0,))
+
+  # ---- 6. gather same volume (reference point) ----
+  def pure_gather(buf, ids):
+    return jnp.take(buf, ids, axis=0, mode="fill", fill_value=0).sum()
+
+  timeit("pure gather 590k", pure_gather, buf, ids)
+
+
+if __name__ == "__main__":
+  main()

@@ -1,0 +1,91 @@
+"""Discriminate what bounds the XLA scatter-add at bench shapes.
+
+Axes: buffer rows (row-bound vs buffer-bound), n_ids scaling,
+unique_indices, id sortedness, width.
+
+Usage: python tools/profile_scatter2.py
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+K = 8
+W = 128
+
+
+def timeit(name, fn, buf, *args):
+  step = jax.jit(fn, donate_argnums=(0,))
+  carry = step(buf, *args)
+  jax.block_until_ready(carry)
+
+  def run(n, carry):
+    t0 = time.perf_counter()
+    for _ in range(n):
+      carry = step(carry, *args)
+    float(carry[0, 0])
+    return time.perf_counter() - t0, carry
+
+  t1, carry = run(K, carry)
+  t2, carry = run(2 * K, carry)
+  dt = (t2 - t1) / K
+  n = args[0].shape[0]
+  print(f"{name:42s}: {dt * 1e3:8.2f} ms  {dt / n * 1e9:6.1f} ns/row",
+        flush=True)
+  return carry
+
+
+def main():
+  rng = np.random.default_rng(0)
+  key = jax.random.PRNGKey(0)
+  n_ids = 9 * 65536
+
+  def scatter(buf, ids, delta):
+    return buf.at[ids].add(delta, mode="drop")
+
+  def scatter_uniq(buf, ids, delta):
+    return buf.at[ids].add(delta, mode="drop", unique_indices=True)
+
+  delta = jax.random.normal(key, (n_ids, W), jnp.float32)
+
+  for rows_log in (24.5, 23.5, 22, 20, 18, 16):
+    rows = int(2 ** rows_log)
+    buf = jnp.zeros((rows, W), jnp.float32)
+    ids = jnp.asarray(rng.integers(0, rows, n_ids), jnp.int32)
+    buf = timeit(f"scatter 590k -> 2^{rows_log:g} rows", scatter, buf, ids,
+                 delta)
+    del buf
+
+  rows = int(2 ** 23.5)
+  buf = jnp.zeros((rows, W), jnp.float32)
+  ids = jnp.asarray(rng.integers(0, rows, n_ids), jnp.int32)
+  buf = timeit("scatter unique_indices=True", scatter_uniq, buf, ids, delta)
+  ids_sorted = jnp.sort(ids)
+  buf = timeit("scatter sorted + unique", scatter_uniq, buf, ids_sorted,
+               delta)
+
+  # n_ids scaling at fixed buffer
+  for n_log in (16, 18, 20):
+    n = 1 << n_log
+    ids_n = jnp.asarray(rng.integers(0, rows, n), jnp.int32)
+    delta_n = jax.random.normal(key, (n, W), jnp.float32)
+    buf = timeit(f"scatter 2^{n_log} ids -> 2^23.5 rows", scatter, buf,
+                 ids_n, delta_n)
+
+  # width scaling: is it per-row or per-byte?
+  for w in (8, 32, 512):
+    bufw = jnp.zeros((rows, w), jnp.float32)
+    deltaw = jax.random.normal(key, (n_ids, w), jnp.float32)
+    bufw = timeit(f"scatter 590k width {w}", scatter, bufw, ids, deltaw)
+    del bufw
+
+  # f32 vs bf16 updates
+  bufh = jnp.zeros((rows, W), jnp.bfloat16)
+  deltah = delta.astype(jnp.bfloat16)
+  bufh = timeit("scatter 590k bf16", scatter, bufh, ids, deltah)
+
+
+if __name__ == "__main__":
+  main()
