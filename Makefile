@@ -20,6 +20,11 @@ native:
 bench:
 	$(PY) bench.py
 
+# real-TPU smoke test of the Pallas RMW apply kernel (single-tenant chip:
+# don't run while a bench/profile process holds the tunnel)
+tpu-smoke:
+	PYTHONPATH=$(CURDIR):$$PYTHONPATH $(PY) tools/smoke_pallas_apply.py
+
 # multi-chip compile/execute validation on 8 virtual CPU devices
 dryrun:
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -287,13 +287,31 @@ def gather_fused_chunked(layout: PackedLayout, buf: jax.Array,
   return out.reshape(ids.shape + (layout.stride,))
 
 
+def _use_pallas_apply() -> bool:
+  """True when the Pallas RMW apply kernel can run (real TPU backend)."""
+  try:
+    return jax.default_backend() == "tpu"
+  except RuntimeError:
+    return False
+
+
 def scatter_add_fused(layout: PackedLayout, buf: jax.Array, ids: jax.Array,
-                      fused_delta: jax.Array) -> jax.Array:
+                      fused_delta: jax.Array,
+                      few_duplicates: bool = False) -> jax.Array:
   """``buf[ids] += fused_delta`` (one indexed RMW for table + all aux).
 
   ``fused_delta``: ``[..., stride]`` additive deltas in gather_fused's lane
   order. Duplicate ids accumulate; OOB ids are dropped. Donate ``buf`` at
   the jit boundary for an in-place update.
+
+  Lowering (measured on v5e, `docs/BENCHMARKS.md`): the two backends win
+  in opposite regimes. XLA's scatter runs ~75 ns/row on near-unique id
+  streams but ~23 ns/row on heavily duplicated (power-law multi-hot) ones;
+  the Pallas RMW cache kernel (`ops/pallas_apply.py`) is ~55 ns/row
+  regardless. Callers that know the stream is near-unique (e.g. one-hot
+  inputs over large vocabularies) pass ``few_duplicates=True`` to pick the
+  Pallas kernel; the default keeps XLA. ``DE_TPU_PALLAS_APPLY=0/1``
+  force-overrides.
   """
   grp, sub, valid = _grp_sub(layout, ids)
   fused_delta = jnp.where(valid[..., None], fused_delta, 0)
@@ -307,6 +325,9 @@ def scatter_add_fused(layout: PackedLayout, buf: jax.Array, ids: jax.Array,
           axis=-1)
     upd = fused_delta
   else:
+    # narrow rows: expand the sub-row delta to the full physical row (the
+    # RMW below is per PHYSICAL row either way); duplicates on the same
+    # physical row still accumulate
     oh = jax.nn.one_hot(sub, rpp, dtype=fused_delta.dtype)
     upd = jnp.einsum("...s,...r->...rs", fused_delta, oh)
     upd = upd.reshape(ids.shape + (rpp * layout.stride,))
@@ -315,8 +336,15 @@ def scatter_add_fused(layout: PackedLayout, buf: jax.Array, ids: jax.Array,
       upd = jnp.concatenate(
           [upd, jnp.zeros(upd.shape[:-1] + (lane_pad,), upd.dtype)], axis=-1)
   flat_grp = grp.reshape(-1)
-  flat_upd = upd.reshape(-1, layout.phys_width)
-  return buf.at[flat_grp].add(flat_upd.astype(buf.dtype), mode="drop")
+  flat_upd = upd.reshape(-1, layout.phys_width).astype(buf.dtype)
+  import os
+  forced = os.environ.get("DE_TPU_PALLAS_APPLY", "auto")
+  use_pallas = (few_duplicates if forced == "auto" else forced == "1") \
+      and _use_pallas_apply() and buf.dtype == jnp.float32
+  if use_pallas:
+    from .pallas_apply import apply_rows_cached
+    return apply_rows_cached(buf, flat_grp, flat_upd)
+  return buf.at[flat_grp].add(flat_upd, mode="drop")
 
 
 # ---------------------------------------------------------------------------

@@ -761,7 +761,9 @@ class DistributedLookup:
         aux = fused_rows[..., w:].reshape(
             ids.shape + (rule.n_aux, w)) if rule.n_aux else None
         delta = rule.delta(g, aux, step)
-        buf = scatter_add_fused(layout, buf, ids, delta)
+        # post-dedup ids are unique: the Pallas RMW kernel's regime
+        buf = scatter_add_fused(layout, buf, ids, delta,
+                                few_duplicates=True)
       else:
         # fast path: ONE scatter-add for the whole class. Any chain of
         # scatters on the same buffer (lax.scan carry or unrolled
@@ -790,7 +792,13 @@ class DistributedLookup:
           # materialize the updates before the scatter: letting XLA fuse
           # the delta computation into the scatter slows its update loop
           ids_cat, delta_cat = lax.optimization_barrier((ids_cat, delta_cat))
-          buf = scatter_add_fused(layout, buf, ids_cat, delta_cat)
+          # 1-hot classes produce a near-unique id stream (the Pallas RMW
+          # kernel's winning regime); multi-hot power-law streams carry
+          # heavy duplication, where XLA's scatter is faster (measured,
+          # docs/BENCHMARKS.md)
+          buf = scatter_add_fused(
+              layout, buf, ids_cat, delta_cat,
+              few_duplicates=all(h == 1 for _, _, _, h in parts))
         else:
           # memory escape hatch for extreme occurrence counts (hotness
           # 200-500 models): compute the delta per chunk (never holding

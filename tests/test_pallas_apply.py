@@ -1,0 +1,59 @@
+"""Tests for the apply-scatter dispatch (`ops/packed_table.scatter_add_fused`
+regime selection + `ops/pallas_apply` wrapper contracts).
+
+The Pallas kernel itself needs a real TPU (its input/output aliasing has no
+faithful interpret-mode equivalent) — `tools/smoke_pallas_apply.py` /
+`make tpu-smoke` covers it on hardware. Here we pin:
+- the XLA fallback stays numerically exact for both regimes on CPU;
+- wrapper argument validation;
+- the env-var override logic.
+"""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_embeddings_tpu.ops.packed_table import (
+    PackedLayout,
+    scatter_add_fused,
+)
+from distributed_embeddings_tpu.ops.pallas_apply import apply_rows_cached
+
+
+@pytest.mark.parametrize("few_duplicates", [False, True])
+@pytest.mark.parametrize("n_aux", [0, 1])
+def test_scatter_add_fused_regimes_match(few_duplicates, n_aux):
+  """Both dispatch regimes must produce the same result (on CPU both lower
+  to XLA scatter; on TPU one runs the Pallas kernel — tools/smoke covers
+  that equivalence on hardware)."""
+  layout = PackedLayout(rows=64, width=128, n_aux=n_aux)
+  rng = np.random.default_rng(0)
+  buf = jnp.asarray(rng.standard_normal(layout.shape), jnp.float32)
+  ids = jnp.asarray(rng.integers(-2, layout.rows + 2, 200), jnp.int32)
+  delta = jnp.asarray(rng.standard_normal((200, layout.stride)), jnp.float32)
+  got = scatter_add_fused(layout, buf, ids, delta,
+                          few_duplicates=few_duplicates)
+  want = scatter_add_fused(layout, buf, ids, delta, few_duplicates=False)
+  np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+
+def test_env_override_forces_off(monkeypatch):
+  monkeypatch.setenv("DE_TPU_PALLAS_APPLY", "0")
+  layout = PackedLayout(rows=32, width=128)
+  buf = jnp.zeros(layout.shape, jnp.float32)
+  ids = jnp.asarray([1, 1, 5], jnp.int32)
+  delta = jnp.ones((3, 128), jnp.float32)
+  out = scatter_add_fused(layout, buf, ids, delta, few_duplicates=True)
+  assert float(out[1, 0]) == 2.0 and float(out[5, 0]) == 1.0
+
+
+def test_apply_rows_cached_validates():
+  buf = jnp.zeros((16, 128), jnp.float32)
+  ids = jnp.zeros((4,), jnp.int32)
+  with pytest.raises(ValueError, match="delta shape"):
+    apply_rows_cached(buf, ids, jnp.zeros((4, 64), jnp.float32))
+  with pytest.raises(ValueError, match="power of two"):
+    apply_rows_cached(buf, ids, jnp.zeros((4, 128), jnp.float32), slots=48)

@@ -69,9 +69,6 @@ def main():
   b_out = jax.random.normal(key, (BATCH, W), jnp.float32)
 
   timeit("bottom fwd", bottom_loss, pb)
-  timeit("bottom fwd+bwd", lambda p: jax.value_and_grad(bottom_loss)(p)[0]
-         + sum(jnp.sum(v) for v in jax.tree_util.tree_leaves(
-             jax.grad(bottom_loss)(p))) * 0, pb)
 
   def bottom_vg(p):
     l, g = jax.value_and_grad(bottom_loss)(p)
