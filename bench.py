@@ -65,7 +65,9 @@ def run(batch_size: int) -> float:
   )
 
   vocab = [max(4, int(v * SCALE)) for v in CRITEO_1TB_VOCAB]
+  dense_thr = int(os.environ.get("BENCH_DENSE_THR", 4096))
   model = DLRM(vocab_sizes=vocab, embedding_dim=128, world_size=1,
+               dense_row_threshold=dense_thr,
                compute_dtype=jnp.bfloat16 if AMP else jnp.float32)
   plan = DistEmbeddingStrategy(
       [dict(input_dim=v, output_dim=128, combiner=None) for v in vocab],

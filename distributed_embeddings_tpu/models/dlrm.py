@@ -178,8 +178,10 @@ class DLRM(nn.Module):
   row_slice: Optional[int] = None
   dp_input: bool = True
   compute_dtype: Any = jnp.float32
-  # small-vocab tables ride the MXU one-hot path (see planner)
-  dense_row_threshold: int = 2048
+  # small-vocab tables ride the MXU one-hot path (see planner); 4096 is
+  # the measured crossover on v5e where the windowed one-hot matmul
+  # (fwd + bwd) still beats gather + scatter-apply for a 65k batch
+  dense_row_threshold: int = 4096
 
   def setup(self):
     if self.bottom_mlp[-1] != self.embedding_dim:
@@ -223,7 +225,7 @@ class DLRM(nn.Module):
 def dlrm_embedding_plan(vocab_sizes, embedding_dim: int = 128,
                         world_size: int = 1, strategy: str = "basic",
                         column_slice_threshold: Optional[int] = None,
-                        dense_row_threshold: int = 2048,
+                        dense_row_threshold: int = 4096,
                         row_slice: Optional[int] = None):
   """The placement plan a :class:`DLRM`'s embeddings use (for
   get_weights/set_weights on the ``embeddings`` param subtree)."""

@@ -339,8 +339,11 @@ def scatter_add_fused(layout: PackedLayout, buf: jax.Array, ids: jax.Array,
   flat_upd = upd.reshape(-1, layout.phys_width).astype(buf.dtype)
   import os
   forced = os.environ.get("DE_TPU_PALLAS_APPLY", "auto")
+  # rpp > 1 packs several logical rows per physical row, so even a unique
+  # logical id stream is rpp-fold duplicated at the physical level — the
+  # regime where XLA's scatter wins (docs/BENCHMARKS.md)
   use_pallas = (few_duplicates if forced == "auto" else forced == "1") \
-      and _use_pallas_apply() and buf.dtype == jnp.float32
+      and rpp == 1 and _use_pallas_apply() and buf.dtype == jnp.float32
   if use_pallas:
     from .pallas_apply import apply_rows_cached
     return apply_rows_cached(buf, flat_grp, flat_upd)

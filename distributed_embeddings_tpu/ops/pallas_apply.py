@@ -172,6 +172,10 @@ def apply_rows_cached(buf: jax.Array, ids: jax.Array, delta: jax.Array,
   w = buf.shape[1]
   if slots & (slots - 1):
     raise ValueError(f"slots must be a power of two, got {slots}")
+  if chunk is not None and chunk % 128:
+    # multiple of 128 for the SMEM block layout; evenness for the 2x
+    # unrolled pair loop (an odd chunk would silently skip one id/step)
+    raise ValueError(f"chunk must be a multiple of 128, got {chunk}")
   if delta.shape != (n, w):
     raise ValueError(f"delta shape {delta.shape} != ({n}, {w})")
   if buf.dtype != jnp.float32:

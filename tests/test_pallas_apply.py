@@ -40,13 +40,37 @@ def test_scatter_add_fused_regimes_match(few_duplicates, n_aux):
   np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
 
 
-def test_env_override_forces_off(monkeypatch):
-  monkeypatch.setenv("DE_TPU_PALLAS_APPLY", "0")
-  layout = PackedLayout(rows=32, width=128)
+def test_dispatch_logic(monkeypatch):
+  """Pin the env-override + regime selection by spying on the kernel entry
+  (on the CPU CI backend the kernel can't run, so capability is stubbed)."""
+  import distributed_embeddings_tpu.ops.packed_table as pt
+
+  calls = []
+  monkeypatch.setattr(pt, "_use_pallas_apply", lambda: True)
+  monkeypatch.setattr(
+      "distributed_embeddings_tpu.ops.pallas_apply.apply_rows_cached",
+      lambda buf, ids, delta, **kw: calls.append(len(ids)) or buf)
+
+  layout = PackedLayout(rows=32, width=128)       # rpp == 1
+  narrow = PackedLayout(rows=32, width=16)        # rpp > 1
   buf = jnp.zeros(layout.shape, jnp.float32)
+  nbuf = jnp.zeros(narrow.shape, jnp.float32)
   ids = jnp.asarray([1, 1, 5], jnp.int32)
   delta = jnp.ones((3, 128), jnp.float32)
+  ndelta = jnp.ones((3, narrow.stride), jnp.float32)
+
+  scatter_add_fused(layout, buf, ids, delta, few_duplicates=True)
+  assert len(calls) == 1, "few_duplicates + rpp==1 must take the kernel"
+  scatter_add_fused(layout, buf, ids, delta, few_duplicates=False)
+  assert len(calls) == 1, "duplicated streams must keep XLA scatter"
+  scatter_add_fused(narrow, nbuf, ids, ndelta, few_duplicates=True)
+  assert len(calls) == 1, "rpp > 1 must keep XLA scatter"
+  monkeypatch.setenv("DE_TPU_PALLAS_APPLY", "1")
+  scatter_add_fused(layout, buf, ids, delta, few_duplicates=False)
+  assert len(calls) == 2, "DE_TPU_PALLAS_APPLY=1 must force the kernel"
+  monkeypatch.setenv("DE_TPU_PALLAS_APPLY", "0")
   out = scatter_add_fused(layout, buf, ids, delta, few_duplicates=True)
+  assert len(calls) == 2, "DE_TPU_PALLAS_APPLY=0 must force XLA"
   assert float(out[1, 0]) == 2.0 and float(out[5, 0]) == 1.0
 
 
