@@ -2,7 +2,7 @@
 
 The reference builds its native code into ``_embedding_lookup_ops.so`` with
 nvcc (`/root/reference/Makefile:38-52`); here TPU device code is Pallas
-(``ops/pallas_lookup.py``) and the native host code — the data loader — is
+(``ops/pallas_apply.py``) and the native host code — the data loader — is
 built by the Makefile in this directory into ``_data_loader.so``.
 
 ``load_data_loader()`` returns the ctypes library, building it on first use

@@ -10,7 +10,14 @@ from .dist_model_parallel import (
     hybrid_partition_specs,
     set_weights,
 )
-from .embedding import ConcatOneHotEmbedding, Embedding, TableConfig
+from .embedding import (
+    ConcatOneHotEmbedding,
+    Embedding,
+    TableConfig,
+    collect_regularization_losses,
+    resolve_constraint,
+    resolve_regularizer,
+)
 from .planner import DistEmbeddingStrategy
 
 __all__ = [
@@ -22,8 +29,11 @@ __all__ = [
     "Embedding",
     "TableConfig",
     "broadcast_variables",
+    "collect_regularization_losses",
     "finalize_hybrid_grads",
     "get_weights",
     "hybrid_partition_specs",
+    "resolve_constraint",
+    "resolve_regularizer",
     "set_weights",
 ]

@@ -71,6 +71,85 @@ def resolve_initializer(spec: Union[str, Initializer, None]) -> Initializer:
   raise TypeError(f"Cannot resolve initializer from {spec!r}")
 
 
+# ---------------------------------------------------------------------------
+# Regularizers / constraints (reference `embedding.py:62-70,96-100` accepts
+# Keras regularizer/constraint objects; here the Keras names resolve to
+# plain callables)
+# ---------------------------------------------------------------------------
+
+
+def _l1(factor=0.01):
+  return lambda w: factor * jnp.sum(jnp.abs(w))
+
+
+def _l2(factor=0.01):
+  return lambda w: factor * jnp.sum(jnp.square(w))
+
+
+_NAMED_REGULARIZERS = {
+    "l1": _l1,
+    "l2": _l2,
+    "l1_l2": lambda: (lambda w: 0.01 * jnp.sum(jnp.abs(w))
+                      + 0.01 * jnp.sum(jnp.square(w))),
+}
+
+
+def resolve_regularizer(spec) -> Optional[Callable[[jax.Array], jax.Array]]:
+  """``None`` | Keras name ('l1'/'l2'/'l1_l2') | callable -> callable.
+
+  The callable maps a weight array to a scalar penalty added to the loss
+  (Keras regularizer semantics, defaults matching ``keras.regularizers``)."""
+  if spec is None:
+    return None
+  if callable(spec):
+    return spec
+  if isinstance(spec, str):
+    key = spec.lower()
+    if key in _NAMED_REGULARIZERS:
+      return _NAMED_REGULARIZERS[key]()
+    raise ValueError(f"Unknown regularizer {spec!r}")
+  raise TypeError(f"Cannot resolve regularizer from {spec!r}")
+
+
+def _max_norm(max_value=2.0, eps=1e-7):
+  def project(w):
+    norms = jnp.sqrt(jnp.sum(jnp.square(w), axis=-1, keepdims=True))
+    desired = jnp.clip(norms, 0, max_value)
+    return w * (desired / (eps + norms))
+  return project
+
+
+def _unit_norm(eps=1e-7):
+  def project(w):
+    return w / (eps + jnp.sqrt(jnp.sum(jnp.square(w), axis=-1,
+                                       keepdims=True)))
+  return project
+
+
+_NAMED_CONSTRAINTS = {
+    "non_neg": lambda: (lambda w: jnp.maximum(w, 0.0)),
+    "max_norm": _max_norm,
+    "unit_norm": _unit_norm,
+}
+
+
+def resolve_constraint(spec) -> Optional[Callable[[jax.Array], jax.Array]]:
+  """``None`` | Keras name ('non_neg'/'max_norm'/'unit_norm') | callable.
+
+  The callable projects a weight array after each optimizer update (Keras
+  constraint semantics; per-row norms use the last axis)."""
+  if spec is None:
+    return None
+  if callable(spec):
+    return spec
+  if isinstance(spec, str):
+    key = spec.lower()
+    if key in _NAMED_CONSTRAINTS:
+      return _NAMED_CONSTRAINTS[key]()
+    raise ValueError(f"Unknown constraint {spec!r}")
+  raise TypeError(f"Cannot resolve constraint from {spec!r}")
+
+
 class Embedding(nn.Module):
   """Turns indices into vectors of fixed size; optional multi-hot reduce.
 
@@ -83,16 +162,32 @@ class Embedding(nn.Module):
 
   With ``combiner=None``, output is ``input.shape + (output_dim,)``.
 
+  Regularizers (reference `embedding.py:64-70,96-100`): penalties are
+  ``sow``n into the ``"losses"`` collection — run
+  ``apply({...}, x, mutable=["losses"])`` and add
+  :func:`collect_regularization_losses` of the mutated collection to the
+  loss. The constraint is a post-update projection: apply
+  :meth:`apply_constraint` (the train-step builders in ``training.py`` do
+  both automatically for distributed plans).
+
   Attributes:
     input_dim: vocabulary size (max index + 1).
     output_dim: embedding width.
     embeddings_initializer: named or callable initializer.
+    embeddings_regularizer: None | 'l1'/'l2'/'l1_l2' | callable -> scalar
+      penalty on the table.
+    activity_regularizer: same, applied to the layer output.
+    embeddings_constraint: None | 'non_neg'/'max_norm'/'unit_norm' |
+      callable row projection applied after optimizer updates.
     combiner: None, 'sum', or 'mean'.
   """
 
   input_dim: int
   output_dim: int
   embeddings_initializer: Union[str, Initializer, None] = "uniform"
+  embeddings_regularizer: Any = None
+  activity_regularizer: Any = None
+  embeddings_constraint: Any = None
   combiner: Optional[str] = None
   param_dtype: Any = jnp.float32
 
@@ -111,7 +206,26 @@ class Embedding(nn.Module):
         (self.input_dim, self.output_dim),
         self.param_dtype,
     )
-    return self.lookup(embeddings, inputs)
+    out = self.lookup(embeddings, inputs)
+    reg = resolve_regularizer(self.embeddings_regularizer)
+    if reg is not None:
+      # overwrite, don't append: a shared layer applied N times must count
+      # its WEIGHT penalty once (Keras adds it per variable, not per call)
+      self.sow("losses", "embeddings_regularizer", reg(embeddings),
+               reduce_fn=lambda prev, new: new,
+               init_fn=lambda: jnp.zeros(()))
+    act_reg = resolve_regularizer(self.activity_regularizer)
+    if act_reg is not None:
+      # accumulate: the ACTIVITY penalty applies to every call's output
+      self.sow("losses", "activity_regularizer", act_reg(out),
+               reduce_fn=lambda prev, new: prev + new,
+               init_fn=lambda: jnp.zeros(()))
+    return out
+
+  def apply_constraint(self, embeddings: jax.Array) -> jax.Array:
+    """Post-update projection of the table (Keras constraint semantics)."""
+    proj = resolve_constraint(self.embeddings_constraint)
+    return embeddings if proj is None else proj(embeddings)
 
   def lookup(self, embeddings, inputs):
     """Input normalization + lookup (reference `embedding.py:108-133`)."""
@@ -143,6 +257,9 @@ class Embedding(nn.Module):
         "input_dim": self.input_dim,
         "output_dim": self.output_dim,
         "embeddings_initializer": self.embeddings_initializer,
+        "embeddings_regularizer": self.embeddings_regularizer,
+        "activity_regularizer": self.activity_regularizer,
+        "embeddings_constraint": self.embeddings_constraint,
         "combiner": self.combiner,
         "name": self.name,
     }
@@ -154,6 +271,19 @@ class Embedding(nn.Module):
     config.pop("input_length", None)
     config.pop("name", None)
     return cls(**config)
+
+
+def collect_regularization_losses(variables) -> jax.Array:
+  """Sum every penalty sown into a ``"losses"`` collection.
+
+  ``variables`` is the mutated-collection dict returned by
+  ``module.apply(..., mutable=["losses"])`` (or its ``"losses"`` subtree)."""
+  tree = variables.get("losses", variables) if isinstance(variables, dict) \
+      else variables
+  leaves = jax.tree_util.tree_leaves(tree)
+  if not leaves:
+    return jnp.zeros(())
+  return sum(jnp.sum(jnp.asarray(x)) for x in leaves)
 
 
 @dataclasses.dataclass
@@ -169,6 +299,8 @@ class TableConfig:
   output_dim: int
   combiner: Optional[str] = None
   initializer: Union[str, Initializer, None] = "uniform"
+  regularizer: Any = None  # table penalty (None | name | callable)
+  constraint: Any = None  # post-update row projection (None | name | callable)
   name: Optional[str] = None
 
   def size(self) -> int:
@@ -176,11 +308,18 @@ class TableConfig:
 
   @classmethod
   def from_layer(cls, layer: Embedding) -> "TableConfig":
+    if layer.activity_regularizer is not None:
+      raise ValueError(
+          "activity_regularizer is not supported in the distributed path "
+          f"(table {layer.name!r}): apply it to the layer outputs in the "
+          "model's loss instead")
     return cls(
         input_dim=layer.input_dim,
         output_dim=layer.output_dim,
         combiner=layer.combiner,
         initializer=layer.embeddings_initializer,
+        regularizer=layer.embeddings_regularizer,
+        constraint=layer.embeddings_constraint,
         name=layer.name,
     )
 
@@ -189,6 +328,8 @@ class TableConfig:
         input_dim=self.input_dim,
         output_dim=self.output_dim,
         embeddings_initializer=self.initializer,
+        embeddings_regularizer=self.regularizer,
+        embeddings_constraint=self.constraint,
         combiner=self.combiner,
     )
 

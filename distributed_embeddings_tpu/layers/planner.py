@@ -145,8 +145,19 @@ def _normalize_configs(embeddings) -> List[TableConfig]:
       d = dict(e)
       if "embeddings_initializer" in d:
         d.setdefault("initializer", d.pop("embeddings_initializer"))
-      for k in ("mask_zero", "input_length", "embeddings_regularizer",
-                "embeddings_constraint", "activity_regularizer", "dtype",
+      if "embeddings_regularizer" in d:
+        d.setdefault("regularizer", d.pop("embeddings_regularizer"))
+      if "embeddings_constraint" in d:
+        d.setdefault("constraint", d.pop("embeddings_constraint"))
+      # a non-None activity regularizer cannot be honored by the
+      # distributed path (outputs are assembled from shards) — error
+      # instead of the silent drop the reference-config acceptance used
+      # to do (reference accepts it, `embedding.py:64-70`)
+      if d.pop("activity_regularizer", None) is not None:
+        raise ValueError(
+            "activity_regularizer is not supported in the distributed "
+            "path: apply it to the model outputs in the loss instead")
+      for k in ("mask_zero", "input_length", "dtype",
                 "batch_input_shape", "trainable"):
         d.pop(k, None)
       configs.append(TableConfig(**d))
@@ -316,6 +327,13 @@ class DistEmbeddingStrategy:
     self.table_col_ranges: List[List[Tuple[int, int]]] = [
         slice_columns(c, threshold, world_size) for c in self.global_configs
     ]
+    for t, c in enumerate(self.global_configs):
+      if c.constraint is not None and len(self.table_col_ranges[t]) > 1:
+        raise ValueError(
+            f"table {t} has an embeddings_constraint but would be column-"
+            "sliced: a row projection (e.g. max_norm) needs the full row "
+            "on one shard. Raise column_slice_threshold for this table or "
+            "drop the constraint.")
 
     # API-parity view: [input_id, input_id + num_slices] per sliced input.
     self.sliced_out_ranges = [

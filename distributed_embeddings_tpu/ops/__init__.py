@@ -1,7 +1,6 @@
 """Embedding lookup ops."""
 
 from .embedding_lookup import csr_lookup, embedding_lookup, sparse_dedup_grad
-from .pallas_lookup import multihot_lookup
 from .packed_table import (
     PackedLayout,
     SparseRule,
@@ -24,7 +23,6 @@ from .sparse_grad import (
 __all__ = [
     "csr_lookup",
     "embedding_lookup",
-    "multihot_lookup",
     "sparse_dedup_grad",
     "PackedLayout",
     "SparseRule",

