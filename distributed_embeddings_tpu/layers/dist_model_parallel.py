@@ -190,22 +190,38 @@ class DistributedEmbedding(nn.Module):
 # ---------------------------------------------------------------------------
 
 
-def _to_numpy_global(arr) -> np.ndarray:
-  """Device (possibly sharded, fully-addressable) array -> host numpy."""
-  return np.asarray(jax.device_get(arr))
+def _fetch_rows(arr, row0: int, n: int, width: int,
+                max_fetch_elements: int) -> np.ndarray:
+  """Fetch rows ``[row0, row0+n)`` of a (possibly sharded) device array in
+  bounded host-memory chunks."""
+  chunk = max(1, max_fetch_elements // max(1, width))
+  if n <= chunk:
+    return np.asarray(jax.device_get(arr[row0:row0 + n]))
+  out = None
+  for c0 in range(0, n, chunk):
+    cn = min(chunk, n - c0)
+    block = np.asarray(jax.device_get(arr[row0 + c0:row0 + c0 + cn]))
+    if out is None:
+      out = np.empty((n,) + block.shape[1:], block.dtype)
+    out[c0:c0 + cn] = block
+  return out
 
 
 def get_weights(plan: DistEmbeddingStrategy,
-                class_params: Dict[str, Any]) -> List[np.ndarray]:
+                class_params: Dict[str, Any],
+                max_fetch_elements: int = 1 << 27) -> List[np.ndarray]:
   """Reassemble the global per-table weights from class-stacked params.
 
   Inverse of :func:`set_weights`: unstacks each rank's fused rows, undoes
   concat fusion via shard row offsets, and re-concatenates column slices in
-  column order. Runs on host; on a single-controller setup the sharded arrays
-  are fully addressable so this is collective-free (the reference needed
-  chunked ``hvd.allgather`` for the same global view).
+  column order. On a single-controller setup the sharded arrays are fully
+  addressable so this is collective-free (the reference needed chunked
+  ``hvd.allgather``, capped at 2G elements per chunk,
+  `dist_model_parallel.py:596-617`, for the same reason this function
+  fetches per-shard row windows in ``max_fetch_elements``-bounded blocks:
+  a global view of a jumbo class buffer must never be staged on one host
+  at once — peak extra host memory here is one table plus one block).
   """
-  host = {name: _to_numpy_global(arr) for name, arr in class_params.items()}
   weights = []
   for t, config in enumerate(plan.global_configs):
     parts = []
@@ -216,7 +232,9 @@ def get_weights(plan: DistEmbeddingStrategy,
       idx = cp.shards_per_rank[rank].index(shard)
       row0 = rank * padded_rows(plan, key) + \
           cp.row_offsets_per_rank[rank][idx]
-      parts.append(host[class_param_name(*key)][row0:row0 + shard.input_dim])
+      parts.append(_fetch_rows(class_params[class_param_name(*key)],
+                               row0, shard.input_dim, cp.width,
+                               max_fetch_elements))
       row_sliced = shard.row_sliced
     if len(parts) == 1:
       weights.append(parts[0])

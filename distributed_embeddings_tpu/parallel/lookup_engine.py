@@ -127,8 +127,20 @@ def class_buckets(plan: DistEmbeddingStrategy, key, hotness_of) -> List[Bucket]:
     # row-sliced slots bucket separately: their routing windows make
     # per-shard sentinel counts partial, so mean division moves to the
     # dp side (assemble) instead of the mp-side combine
-    return (hotness_of(slot.input_id),
-            vocab_cap(slot.shard.input_dim) if dense else 0,
+    h = hotness_of(slot.input_id)
+    if h < 0:  # ragged value stream
+      if dense:
+        raise NotImplementedError(
+            "ragged inputs into a dense-class (MXU one-hot) table are not "
+            "supported in the distributed path; raise dense_row_threshold "
+            "below this table's vocab or pre-pad the input")
+      if slot.shard.row_sliced:
+        raise NotImplementedError(
+            "ragged inputs into a row-sliced table are not supported")
+      if cp.combiner is None:
+        raise ValueError("ragged distributed inputs require a combiner "
+                         "('sum' or 'mean')")
+    return (h, vocab_cap(slot.shard.input_dim) if dense else 0,
             slot.shard.row_sliced)
 
   keys = sorted({bkey(s) for slots in cp.slots_per_rank for s in slots})
@@ -166,18 +178,43 @@ def ragged_to_padded(ids: RaggedIds, max_hot: int) -> jax.Array:
   return jnp.where(valid, gathered, PAD_ID)
 
 
-def _normalize_input(x) -> jax.Array:
-  """-> [B, H] int32 with PAD_ID for invalid entries."""
+def ragged_hotness(x) -> int:
+  """Engine-internal hotness code of one input: ``>= 1`` = static hotness;
+  ``-(V + 1)`` = ragged with value-stream capacity V (``values.shape[0]``;
+  the +1 keeps a capacity-0 ragged input distinct from the static codes)."""
   if isinstance(x, RaggedIds):
-    raise TypeError(
-        "Convert RaggedIds with ragged_to_padded(ids, max_hot) before the "
-        "distributed call; the routing tensor needs a static hotness.")
+    return -(int(x.values.shape[0]) + 1)
+  x = jnp.asarray(x)
+  return 1 if x.ndim == 1 else int(x.shape[1])
+
+
+def _normalize_input(x):
+  """-> [B, H] int32 with PAD_ID for invalid entries, or RaggedIds as-is.
+
+  Ragged inputs flow through the engine as their VALUE STREAM (static
+  capacity = ``values.shape[0]``) plus per-sample lengths — the TPU
+  equivalent of the reference's uneven-split alltoall for true variable
+  hotness (`dist_model_parallel.py:407-429`): comm and gather volume scale
+  with the actual number of ids, not ``B x max_hotness``."""
+  if isinstance(x, RaggedIds):
+    return x
   x = jnp.asarray(x)
   if x.ndim == 1:
     x = x[:, None]
   if x.ndim != 2:
     raise ValueError(f"Distributed inputs must be 1-D or 2-D, got {x.ndim}-D")
   return x.astype(jnp.int32)
+
+
+def _seg_ids(lengths: jax.Array, capacity: int) -> jax.Array:
+  """Per value-stream position, its sample index (clamped to B-1 for the
+  sentinel-padded tail). lengths: [B] -> [capacity] int32."""
+  splits = jnp.concatenate(
+      [jnp.zeros((1,), jnp.int32), jnp.cumsum(lengths).astype(jnp.int32)])
+  pos = jnp.arange(capacity, dtype=jnp.int32)
+  return jnp.clip(
+      jnp.searchsorted(splits, pos, side="right").astype(jnp.int32) - 1,
+      0, lengths.shape[0] - 1)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -200,6 +237,11 @@ class SparseResiduals:
     ik, ak = aux
     return cls(ids_all=dict(zip(ik, children[:len(ik)])),
                aux_rows=dict(zip(ak, children[len(ik):])))
+
+
+def _batch_of(inputs) -> int:
+  x = inputs[0]
+  return x.nrows if isinstance(x, RaggedIds) else x.shape[0]
 
 
 class DistributedLookup:
@@ -284,7 +326,9 @@ class DistributedLookup:
     cp = self.plan.classes[key]
     world = self.plan.world_size
     sentinel = padded_rows(self.plan, key)
-    b = inputs[0].shape[0]
+    if bucket.h < 0:
+      return self._build_ragged_routing(key, bucket, inputs)
+    b = _batch_of(inputs)
     pad_shape = (b,) if bucket.h == 1 else (b, bucket.h)
     pad_block = jnp.full(pad_shape, sentinel, jnp.int32)
     per_dest = []
@@ -321,6 +365,47 @@ class DistributedLookup:
       per_dest.append(jnp.stack(per_slot))
     return jnp.stack(per_dest)
 
+  def _build_ragged_routing(self, key, bucket: Bucket, inputs):
+    """Value-stream routing for a ragged bucket.
+
+    Returns ``(vals [world, n_b, V], lens [world, n_b, B])``: per dest
+    rank and slot, the sentinel-padded routed value stream and per-sample
+    POSITIONAL lengths (row_lengths; they segment the value stream — the
+    mean combiner's divisor is the VALID-id count, recomputed mp-side
+    from the sentinel pattern). V is the bucket's exact static capacity:
+    bucket membership is keyed on ``values.shape[0]``, so all member
+    inputs share it."""
+    cp = self.plan.classes[key]
+    world = self.plan.world_size
+    sentinel = padded_rows(self.plan, key)
+    cap = -bucket.h - 1
+    b = _batch_of(inputs)
+    pad_vals = jnp.full((cap,), sentinel, jnp.int32)
+    pad_lens = jnp.zeros((b,), jnp.int32)
+    all_vals, all_lens = [], []
+    for rank in range(world):
+      idxs = bucket.slot_idx_per_rank[rank]
+      vals_r, lens_r = [], []
+      for k in range(bucket.n_b):
+        if k < len(idxs):
+          slot = cp.slots_per_rank[rank][idxs[k]]
+          rg: RaggedIds = inputs[slot.input_id]
+          v = rg.values.astype(jnp.int32)
+          total = rg.row_splits[-1].astype(jnp.int32)
+          live = jnp.arange(cap, dtype=jnp.int32) < total
+          sh = slot.shard
+          routed = jnp.where(
+              live & (v >= 0),
+              jnp.clip(v, 0, sh.input_dim - 1) + slot.row_offset, sentinel)
+          vals_r.append(routed)
+          lens_r.append(rg.row_lengths().astype(jnp.int32))
+        else:
+          vals_r.append(pad_vals)
+          lens_r.append(pad_lens)
+      all_vals.append(jnp.stack(vals_r))
+      all_lens.append(jnp.stack(lens_r))
+    return jnp.stack(all_vals), jnp.stack(all_lens)
+
   def route_ids(self, inputs: Sequence[jax.Array],
                 hotness_of=None) -> Dict[tuple, jax.Array]:
     """dp->mp id exchange: per bucket, global-batch ids for my local tables.
@@ -334,29 +419,45 @@ class DistributedLookup:
     inputs = [_normalize_input(x) for x in inputs]
     if len(inputs) != plan.num_inputs:
       raise ValueError(f"Expected {plan.num_inputs} inputs, got {len(inputs)}")
-    b = inputs[0].shape[0]
+    b = _batch_of(inputs)
     for x in inputs:
-      if x.shape[0] != b:
+      nrows = x.nrows if isinstance(x, RaggedIds) else x.shape[0]
+      if nrows != b:
         raise ValueError("All inputs need the same batch size "
-                         f"(got {x.shape[0]} vs {b}).")
+                         f"(got {nrows} vs {b}).")
     if hotness_of is None:
-      hotness_of = lambda i: inputs[i].shape[1]  # noqa: E731
+      hotness_of = lambda i: ragged_hotness(inputs[i])  # noqa: E731
 
     ids_all: Dict[tuple, jax.Array] = {}
     for key in plan.class_keys:
       for bucket in self._buckets(key, hotness_of):
         x = self._build_routing(key, bucket, inputs)  # [world, n_b, B(, h)]
-        if world > 1:
+        if bucket.h < 0:  # ragged: (vals [world,n_b,V], lens [world,n_b,B])
+          vals, lens = x
+          if world > 1:
+            vals = lax.all_to_all(vals, self.axis_name, split_axis=0,
+                                  concat_axis=0)
+            lens = lax.all_to_all(lens, self.axis_name, split_axis=0,
+                                  concat_axis=0)
+          # -> (vals [n_b, world, V], lens [n_b, world, B]); the world
+          # (source-rank) axis stays explicit because each source block
+          # has its own CSR segmentation
+          routed = (jnp.transpose(vals, (1, 0, 2)),
+                    jnp.transpose(lens, (1, 0, 2)))
+        elif world > 1:
           y = lax.all_to_all(x, self.axis_name, split_axis=0, concat_axis=0)
+          routed = self._reshape_routed(y, bucket, world, b)
         else:
-          y = x
-        if bucket.h == 1:  # [world, n_b, B] -> [n_b, G]
-          routed = jnp.transpose(y, (1, 0, 2)).reshape(bucket.n_b, world * b)
-        else:  # [world, n_b, B, h] -> [n_b, G, h]
-          routed = jnp.transpose(y, (1, 0, 2, 3)).reshape(
-              bucket.n_b, world * b, bucket.h)
+          routed = self._reshape_routed(x, bucket, world, b)
         ids_all[bucket_key(key, bucket.h, bucket.vcap, bucket.rs)] = routed
     return ids_all
+
+  @staticmethod
+  def _reshape_routed(y, bucket, world, b):
+    if bucket.h == 1:  # [world, n_b, B] -> [n_b, G]
+      return jnp.transpose(y, (1, 0, 2)).reshape(bucket.n_b, world * b)
+    return jnp.transpose(y, (1, 0, 2, 3)).reshape(  # -> [n_b, G, h]
+        bucket.n_b, world * b, bucket.h)
 
   # ---- mp-side local lookups ---------------------------------------------
   def _combine(self, rows: jax.Array, ids_all: jax.Array, key,
@@ -385,8 +486,48 @@ class DistributedLookup:
   def _z_sparse_simple(self, key, table_local: jax.Array,
                        ids_all: jax.Array, rs: bool = False) -> jax.Array:
     """Differentiable gather path on the simple [rows, w] buffer."""
+    if isinstance(ids_all, tuple):  # ragged value stream
+      vals, lens = ids_all
+      rows = jnp.take(table_local, vals, axis=0, mode="fill", fill_value=0)
+      return self._combine_ragged(rows, vals, lens, key)
     rows = jnp.take(table_local, ids_all, axis=0, mode="fill", fill_value=0)
     return self._combine(rows, ids_all, key, rs)
+
+  def _ragged_valid_counts(self, vals, lens, key):
+    """Per-sample VALID-id counts [n_b*world, B]: entries a sample's length
+    window covers minus the ones routed to the sentinel (invalid/negative
+    ids) — the same divisor the padded path's ``sum(ids < sentinel)``
+    computes, keeping ragged and padded mean semantics identical."""
+    sentinel = padded_rows(self.plan, key)
+    n_b, world, cap = vals.shape
+    b = lens.shape[2]
+    seg = jax.vmap(lambda l: _seg_ids(l, cap))(
+        lens.reshape(n_b * world, b))
+    valid = (vals < sentinel).astype(jnp.int32).reshape(n_b * world, cap)
+    counts = jax.vmap(
+        lambda v, s: jax.ops.segment_sum(v, s, num_segments=b))(valid, seg)
+    return seg, counts
+
+  def _combine_ragged(self, rows: jax.Array, vals: jax.Array,
+                      lens: jax.Array, key) -> jax.Array:
+    """Per-occurrence rows [n_b, world, V, w] + lens [n_b, world, B]
+    -> [n_b, G, w] via segment-sum over each source block's CSR structure.
+
+    Sentinel-padded tail positions gathered zero rows and clamp to the
+    last segment, so they never perturb the sums; the mean combiner
+    divides by the per-sample VALID-id counts."""
+    cp = self.plan.classes[key]
+    n_b, world, cap, w = rows.shape
+    b = lens.shape[2]
+    seg, counts = self._ragged_valid_counts(vals, lens, key)
+    summed = jax.vmap(
+        lambda r, s: jax.ops.segment_sum(r, s, num_segments=b))(
+            rows.reshape(n_b * world, cap, w), seg)
+    summed = summed.reshape(n_b, world * b, w)
+    if cp.combiner == "mean":
+      counts = counts.reshape(n_b, world * b).astype(summed.dtype)
+      summed = summed / jnp.maximum(counts, 1)[..., None]
+    return summed
 
   def _dense_offsets(self, key, bucket: Bucket) -> np.ndarray:
     cp = self.plan.classes[key]
@@ -471,6 +612,12 @@ class DistributedLookup:
   def _z_sparse_fused(self, key, layout: PackedLayout, buf_local: jax.Array,
                       ids_all: jax.Array, rs: bool = False):
     """Fused gather: returns (z, aux_rows) — optimizer state rides along."""
+    if isinstance(ids_all, tuple):  # ragged value stream
+      vals, lens = ids_all
+      fused = gather_fused_chunked(layout, buf_local, vals)
+      w = layout.width
+      return (self._combine_ragged(fused[..., :w], vals, lens, key),
+              fused[..., w:])
     fused = gather_fused_chunked(layout, buf_local, ids_all)  # [n_b,G,h,stride]
     w = layout.width
     rows = fused[..., :w]
@@ -583,6 +730,9 @@ class DistributedLookup:
           != "mean":
         continue
       x = _normalize_input(inputs[input_id])
+      if isinstance(x, RaggedIds):
+        raise NotImplementedError(
+            "ragged inputs into a row-sliced mean table are not supported")
       out[input_id] = jnp.sum(x >= 0, axis=1)
     return out
 
@@ -606,8 +756,8 @@ class DistributedLookup:
       ``return_residuals``, ``(outputs, ids_all)``.
     """
     inputs = [_normalize_input(x) for x in inputs]
-    hotness_of = lambda i: inputs[i].shape[1]  # noqa: E731
-    b = inputs[0].shape[0]
+    hotness_of = lambda i: ragged_hotness(inputs[i])  # noqa: E731
+    b = _batch_of(inputs)
     counts = self.mean_counts(inputs)
     ids_all = self.route_ids(inputs, hotness_of)
     z = {}
@@ -732,14 +882,33 @@ class DistributedLookup:
         continue
       cp = plan.classes[key]
       name = class_param_name(*key)
-      ids = residuals.ids_all[bk]  # [n_b, G, h]
+      ids = residuals.ids_all[bk]  # [n_b, G, h] | ragged (vals, lens)
       sentinel = padded_rows(plan, key)
+      aux = residuals.aux_rows[bk] if rule.n_aux else None
+      if h < 0:
+        # ragged: expand the per-sample cotangent to per-occurrence rows
+        # (h=0 marks pre-expanded parts downstream: no hotness broadcast)
+        vals, lens = ids
+        n_b, world, cap = vals.shape
+        b = lens.shape[2]
+        w = cp.width
+        seg, counts = self._ragged_valid_counts(vals, lens, key)
+        dz_blocks = dzb.reshape(n_b * world, b, w)
+        g_occ = jax.vmap(lambda d, s: jnp.take(d, s, axis=0))(
+            dz_blocks, seg)  # [n_b*world, V, w]
+        if cp.combiner == "mean":
+          # mirror the forward's valid-count divisor exactly
+          cnt = jax.vmap(lambda c, s: jnp.take(c, s))(
+              counts, seg).astype(g_occ.dtype)
+          g_occ = g_occ / jnp.maximum(cnt, 1)[..., None]
+        by_class.setdefault(name, []).append(
+            (vals.reshape(-1), g_occ.reshape(-1, w), aux, 0))
+        continue
       if cp.combiner == "mean" and h > 1 and not bk.rs:
         # row-sliced buckets skip this: their mean division lives in the
         # differentiable assemble, so d_z arrives pre-divided
         counts = jnp.sum(ids < sentinel, axis=2).astype(dzb.dtype)
         dzb = dzb / jnp.maximum(counts, 1)[..., None]
-      aux = residuals.aux_rows[bk] if rule.n_aux else None
       by_class.setdefault(name, []).append((ids, dzb, aux, h))
 
     new_params = dict(fused_params)
@@ -752,9 +921,9 @@ class DistributedLookup:
         # merge) — the reference's sorted/unique semantics
         ids = jnp.concatenate([p[0].reshape(-1) for p in parts])
         g = jnp.concatenate([
-            dzb.reshape(-1, w) if idb.ndim == 2 else
             jnp.broadcast_to(dzb[:, :, None, :], idb.shape + (w,))
-            .reshape(-1, w) for idb, dzb, _, _ in parts])
+            .reshape(-1, w) if idb.ndim == 3 else dzb.reshape(-1, w)
+            for idb, dzb, _, _ in parts])
         sr = dedup_rows(ids, g, layout.rows)
         ids, g = sr.ids, sr.rows
         fused_rows = gather_fused(layout, buf, ids)
@@ -810,10 +979,11 @@ class DistributedLookup:
             dz_f = dzb.reshape(-1, w)
             aux_f = (aux.reshape(-1, rule.n_aux, w) if aux is not None
                      else None)
-            chunk = max(h, (self.apply_chunk // h) * h)
+            hh = max(1, h)  # h == 0: ragged parts arrive pre-expanded
+            chunk = max(hh, (self.apply_chunk // hh) * hh)
             for c0 in range(0, n, chunk):
               cn = min(chunk, n - c0)
-              g_c = dz_f[c0 // h:(c0 + cn) // h]
+              g_c = dz_f[c0 // hh:(c0 + cn) // hh]
               if h > 1:
                 g_c = jnp.broadcast_to(g_c[:, None, :],
                                        (cn // h, h, w)).reshape(cn, w)

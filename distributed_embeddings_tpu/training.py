@@ -40,7 +40,11 @@ from .layers.dist_model_parallel import (
 )
 from .layers.planner import DistEmbeddingStrategy
 from .ops.packed_table import SparseRule
-from .parallel.lookup_engine import DistributedLookup, class_param_name
+from .parallel.lookup_engine import (
+    DistributedLookup,
+    class_param_name,
+    ragged_hotness,
+)
 
 
 def _per_rank_windows(plan: DistEmbeddingStrategy):
@@ -464,7 +468,7 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
 
   def local_step(state, numerical, cats, labels):
     b = numerical.shape[0]
-    hotness = [1 if c.ndim == 1 else c.shape[1] for c in cats]
+    hotness = [ragged_hotness(c) for c in cats]
     hotness_of = lambda i: hotness[i]  # noqa: E731
     ids_all = engine.route_ids(cats, hotness_of)
     counts = engine.mean_counts(cats)
@@ -542,7 +546,7 @@ def make_sparse_eval_step(model, plan: DistEmbeddingStrategy,
 
   def local_eval(state, numerical, cats):
     b = numerical.shape[0]
-    hotness = [1 if c.ndim == 1 else c.shape[1] for c in cats]
+    hotness = [ragged_hotness(c) for c in cats]
     hotness_of = lambda i: hotness[i]  # noqa: E731
     ids_all = engine.route_ids(cats, hotness_of)
     counts = engine.mean_counts(cats)

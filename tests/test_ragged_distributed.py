@@ -1,0 +1,248 @@
+"""Ragged inputs through the DISTRIBUTED engine (VERDICT item 5).
+
+The engine routes ragged inputs as their value stream (static capacity)
+plus per-sample lengths — true variable hotness, the reference's uneven-
+split alltoall (`dist_model_parallel.py:407-429`) — instead of requiring
+pre-padding to a static max hotness. These tests pin parity of the
+value-stream path against the padded path and the single-device op, on an
+8-virtual-device mesh, for forward and fused training.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from distributed_embeddings_tpu.layers import DistEmbeddingStrategy, TableConfig
+from distributed_embeddings_tpu.ops import embedding_lookup
+from distributed_embeddings_tpu.ops.packed_table import adagrad_rule, sgd_rule
+from distributed_embeddings_tpu.ops.ragged import RaggedIds
+from distributed_embeddings_tpu.parallel import create_mesh
+from distributed_embeddings_tpu.parallel.lookup_engine import (
+    DistributedLookup,
+    ragged_to_padded,
+)
+from distributed_embeddings_tpu.layers.dist_model_parallel import (
+    get_weights,
+    set_weights,
+)
+from distributed_embeddings_tpu.training import shard_batch, shard_params
+
+WORLD = 8
+
+
+def _make_ragged(rng, b, vocab, max_hot, capacity):
+  """Random per-sample variable hotness, total ids <= capacity."""
+  lengths = rng.integers(0, max_hot + 1, b)
+  while lengths.sum() > capacity:
+    lengths[rng.integers(0, b)] = max(0, lengths[rng.integers(0, b)] - 1)
+  total = int(lengths.sum())
+  values = rng.integers(0, vocab, total).astype(np.int32)
+  # static capacity: pad the value buffer (slack past row_splits[-1])
+  values = np.concatenate(
+      [values, np.zeros(capacity - total, np.int32)])
+  splits = np.concatenate([[0], np.cumsum(lengths)]).astype(np.int32)
+  return RaggedIds(jnp.asarray(values), jnp.asarray(splits)), lengths
+
+
+def _stack_ragged(parts):
+  """Per-device ragged blocks -> one global RaggedIds whose values and
+  row_splits shard evenly over the mesh batch axis."""
+  return RaggedIds(
+      jnp.concatenate([p.values for p in parts]),
+      jnp.concatenate([p.row_splits for p in parts]))
+
+
+def _local_ragged_view(x: RaggedIds, world: int):
+  """The engine receives per-device [V] values + [B+1] splits blocks."""
+  return x
+
+
+@pytest.mark.parametrize("combiner", ["sum", "mean"])
+def test_distributed_ragged_matches_padded_and_single(combiner):
+  rng = np.random.default_rng(0)
+  tables = [TableConfig(50, 16, combiner=combiner),
+            TableConfig(80, 16, combiner=combiner)] + \
+           [TableConfig(20 + i, 16, combiner=combiner) for i in range(7)]
+  plan = DistEmbeddingStrategy(tables, WORLD, "basic",
+                               dense_row_threshold=0)
+  engine = DistributedLookup(plan)
+  weights = [rng.standard_normal((c.input_dim, c.output_dim))
+             .astype(np.float32) for c in tables]
+  params = set_weights(plan, weights)
+  params = {k: jnp.asarray(v) for k, v in params.items()}
+
+  b_local, max_hot, cap = 4, 5, 16
+  # per-device ragged blocks, stacked (values [world*cap], splits
+  # [world*(b+1)] shard evenly over the mesh)
+  per_dev = [_make_ragged(rng, b_local, 50, max_hot, cap)
+             for _ in range(WORLD)]
+  ragged_blocks = [p[0] for p in per_dev]
+  global_ragged = _stack_ragged(ragged_blocks)
+  dense_inputs = [jnp.asarray(
+      rng.integers(0, c.input_dim, (WORLD * b_local, 1)), jnp.int32)
+      for c in tables[1:]]
+
+  mesh = create_mesh(WORLD)
+  from jax.sharding import NamedSharding, PartitionSpec as P
+  from jax import shard_map
+
+  def fwd(params, rg_values, rg_splits, *dense):
+    rg = RaggedIds(rg_values, rg_splits)
+    return engine.forward(params, [rg] + list(dense))
+
+  pspec = jax.tree_util.tree_map(lambda _: P("mp", None), params)
+  outs = jax.jit(shard_map(
+      fwd, mesh=mesh,
+      in_specs=(pspec, P("mp"), P("mp")) + (P("mp"),) * len(dense_inputs),
+      out_specs=P("mp")))(
+          shard_params(params, mesh),
+          jax.device_put(global_ragged.values,
+                         NamedSharding(mesh, P("mp"))),
+          jax.device_put(global_ragged.row_splits,
+                         NamedSharding(mesh, P("mp"))),
+          *[jax.device_put(d, NamedSharding(mesh, P("mp")))
+            for d in dense_inputs])
+
+  # single-device reference for table 0 (concatenate per-device blocks)
+  want_blocks = []
+  for rg in ragged_blocks:
+    want_blocks.append(np.asarray(
+        embedding_lookup(jnp.asarray(weights[0]), rg, combiner=combiner)))
+  want0 = np.concatenate(want_blocks)
+  np.testing.assert_allclose(np.asarray(outs[0]), want0, rtol=1e-5,
+                             atol=1e-5)
+
+  # padded-path parity for the same ragged input
+  padded_blocks = [ragged_to_padded(rg, max_hot) for rg in ragged_blocks]
+  padded = jnp.concatenate(padded_blocks)
+
+  def fwd_padded(params, x0, *dense):
+    return engine.forward(params, [x0] + list(dense))
+
+  outs_p = jax.jit(shard_map(
+      fwd_padded, mesh=mesh,
+      in_specs=(pspec, P("mp")) + (P("mp"),) * len(dense_inputs),
+      out_specs=P("mp")))(
+          shard_params(params, mesh),
+          jax.device_put(padded, NamedSharding(mesh, P("mp"))),
+          *[jax.device_put(d, NamedSharding(mesh, P("mp")))
+            for d in dense_inputs])
+  np.testing.assert_allclose(np.asarray(outs[0]), np.asarray(outs_p[0]),
+                             rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("rulename", ["sgd", "adagrad"])
+def test_fused_training_ragged_matches_padded(rulename):
+  """One fused train step with ragged cats must update the tables exactly
+  like the same step with the equivalent padded-dense cats."""
+  from distributed_embeddings_tpu.models import bce_loss
+  from distributed_embeddings_tpu.training import (
+      init_sparse_state_direct, make_sparse_train_step)
+  import flax.linen as nn
+
+  class TinyModel(nn.Module):
+    """Minimal model consuming precomputed embedding activations."""
+
+    @nn.compact
+    def __call__(self, numerical, cats, emb_acts=None):
+      x = jnp.concatenate([numerical] + list(emb_acts), axis=1)
+      return jnp.squeeze(nn.Dense(1)(x), -1)
+
+  rng = np.random.default_rng(1)
+  vocab = [60, 90]
+  tables = [TableConfig(v, 16, combiner="sum",
+                        initializer="uniform") for v in vocab]
+  b, max_hot, cap = 16, 4, 48
+
+  def build(cats):
+    plan = DistEmbeddingStrategy(tables, 1, "basic",
+                                 dense_row_threshold=0)
+    model = TinyModel()
+    numerical = jnp.asarray(rng2.standard_normal((b, 4)), jnp.float32)
+    labels = jnp.asarray(rng2.integers(0, 2, b), jnp.float32)
+    rule = sgd_rule(0.5) if rulename == "sgd" else adagrad_rule(0.5)
+    opt = optax.sgd(0.5)
+    dummy = [jnp.zeros((2, 16), jnp.float32) for _ in vocab]
+    dp = model.init(jax.random.PRNGKey(0), numerical[:2],
+                    None, emb_acts=dummy)["params"]
+    state = init_sparse_state_direct(plan, rule, dp, opt,
+                                     jax.random.PRNGKey(1))
+    step = make_sparse_train_step(model, plan, bce_loss, opt, rule, None,
+                                  state, (numerical, cats, labels),
+                                  donate=False)
+    state, loss = step(state, numerical, cats, labels)
+    from distributed_embeddings_tpu.training import unpack_sparse_state
+    params, _ = unpack_sparse_state(plan, rule, state)
+    return get_weights(plan, params["embeddings"]), float(loss)
+
+  rng2 = np.random.default_rng(2)
+  ragged = []
+  padded = []
+  for v in vocab:
+    rg, _ = _make_ragged(rng, b, v, max_hot, cap)
+    ragged.append(rg)
+    padded.append(ragged_to_padded(rg, max_hot))
+
+  rng2 = np.random.default_rng(2)
+  w_ragged, loss_r = build(ragged)
+  rng2 = np.random.default_rng(2)
+  w_padded, loss_p = build(padded)
+  assert abs(loss_r - loss_p) < 1e-5
+  for a, b_ in zip(w_ragged, w_padded):
+    np.testing.assert_allclose(a, b_, rtol=1e-4, atol=1e-5)
+
+
+def test_ragged_mean_ignores_negative_ids_like_padded():
+  """A negative id inside a sample's length window must be excluded from
+  BOTH the sum and the mean divisor, matching the padded path's
+  valid-count semantics."""
+  tables = [TableConfig(12, 8, combiner="mean")]
+  plan = DistEmbeddingStrategy(tables, 1, "basic", dense_row_threshold=0)
+  engine = DistributedLookup(plan)
+  rng = np.random.default_rng(3)
+  w = rng.standard_normal((12, 8)).astype(np.float32)
+  params = {k: jnp.asarray(v)
+            for k, v in set_weights(plan, [w]).items()}
+  # sample 0: ids [3, -1, 5] (one invalid); sample 1: [7]
+  rg = RaggedIds(jnp.asarray([3, -1, 5, 7], jnp.int32),
+                 jnp.asarray([0, 3, 4], jnp.int32))
+  out = engine.forward(params, [rg])[0]
+  want0 = (w[3] + w[5]) / 2.0  # divisor counts the 2 VALID ids, not 3
+  want1 = w[7]
+  np.testing.assert_allclose(np.asarray(out[0]), want0, rtol=1e-5)
+  np.testing.assert_allclose(np.asarray(out[1]), want1, rtol=1e-5)
+  # padded path agrees
+  padded = ragged_to_padded(rg, 3)
+  out_p = engine.forward(params, [padded])[0]
+  np.testing.assert_allclose(np.asarray(out), np.asarray(out_p), rtol=1e-5)
+
+
+def test_zero_capacity_ragged_is_handled():
+  tables = [TableConfig(12, 8, combiner="sum")]
+  plan = DistEmbeddingStrategy(tables, 1, "basic", dense_row_threshold=0)
+  engine = DistributedLookup(plan)
+  params = {k: jnp.zeros(s, jnp.float32) + 1.0
+            for k, s in engine.param_shapes().items()}
+  rg = RaggedIds(jnp.zeros((0,), jnp.int32), jnp.zeros((3,), jnp.int32))
+  out = engine.forward(params, [rg])[0]
+  np.testing.assert_allclose(np.asarray(out), 0.0)
+
+
+def test_ragged_rejects_unsupported_combos():
+  tables = [TableConfig(50, 16)]  # combiner None
+  plan = DistEmbeddingStrategy(tables, 1, "basic", dense_row_threshold=0)
+  engine = DistributedLookup(plan)
+  rg = RaggedIds(jnp.asarray([1, 2, 3], jnp.int32),
+                 jnp.asarray([0, 2, 3], jnp.int32))
+  with pytest.raises(ValueError, match="combiner"):
+    engine.forward({k: jnp.zeros(s, jnp.float32)
+                    for k, s in engine.param_shapes().items()}, [rg])
+
+  small = [TableConfig(10, 16, combiner="sum")]
+  plan2 = DistEmbeddingStrategy(small, 1, "basic", dense_row_threshold=2048)
+  engine2 = DistributedLookup(plan2)
+  with pytest.raises(NotImplementedError, match="dense-class"):
+    engine2.forward({k: jnp.zeros(s, jnp.float32)
+                     for k, s in engine2.param_shapes().items()}, [rg])
