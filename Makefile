@@ -12,6 +12,11 @@ test:
 test-fast:
 	$(PY) -m pytest tests/ -q -x -k "not training and not checkpoint"
 
+# build a pip wheel (includes the C++ loader sources + any prebuilt .so;
+# reference parity: setup.py / build_pip_pkg.sh)
+wheel:
+	$(PY) -m pip wheel --no-deps --no-build-isolation -w dist .
+
 # force-(re)build the native C++ data loader
 native:
 	$(PY) -c "from distributed_embeddings_tpu.cc import build; print('built:', build(force=True))"

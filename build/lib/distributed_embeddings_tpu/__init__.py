@@ -1,0 +1,16 @@
+"""distributed_embeddings_tpu: TPU-native distributed embedding training.
+
+A from-scratch JAX/XLA/Pallas framework with the capabilities of NVIDIA's
+``distributed-embeddings`` (reference at ``/root/reference``): fused
+variable-hotness embedding lookups (``ops``), ``Embedding`` layers and the
+``DistEmbeddingStrategy`` placement planner (``layers``), and the
+``DistributedEmbedding`` hybrid model-parallel + data-parallel wrapper
+(``layers.dist_model_parallel``) that shards embedding tables over a TPU mesh
+and routes activations with XLA collectives over ICI.
+"""
+
+from .ops.embedding_lookup import embedding_lookup
+
+__version__ = "0.1.0"
+
+__all__ = ["embedding_lookup", "__version__"]

@@ -172,8 +172,7 @@ class DistributedEmbedding(nn.Module):
       # init runs outside shard_map on global shapes; skip the collective
       # forward and just report output structure.
       if self.dp_input:
-        from ..parallel.lookup_engine import _batch_of
-        b = _batch_of(inputs)
+        b = jnp.asarray(inputs[0]).shape[0]
       else:
         first = next(iter(inputs.values()))
         b = first.shape[2] // self.world_size

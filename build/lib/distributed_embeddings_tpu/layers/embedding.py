@@ -1,0 +1,364 @@
+"""Embedding layers (flax.linen).
+
+TPU-native counterpart of the reference Keras layers
+(`/root/reference/distributed_embeddings/python/layers/embedding.py:41-180`):
+an ``Embedding`` unifying plain and combiner (multi-hot) lookups over dense /
+ragged / sparse inputs, and ``ConcatOneHotEmbedding`` fusing N one-hot tables
+into one weight.
+
+Differences by design:
+- flax modules are pure; parameters live in pytrees, so the reference's
+  ``CPUInitializer`` (GPU-OOM workaround, `embedding.py:28-38`) is unnecessary —
+  giant tables are initialized directly into their sharded layout via
+  ``jax.jit`` + sharding annotations.
+- ``get_config`` / ``from_config`` are kept for planner interop
+  (``DistEmbeddingStrategy`` consumes layer configs the same way the reference
+  does, `dist_model_parallel.py:95-98`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.embedding_lookup import embedding_lookup
+from ..ops.ragged import RaggedIds, SparseIds
+
+Initializer = Callable[[jax.Array, tuple, Any], jax.Array]
+
+
+def _keras_uniform(scale=0.05):
+  def init(key, shape, dtype=jnp.float32):
+    return jax.random.uniform(key, shape, dtype, minval=-scale, maxval=scale)
+  # marker consumed by the direct packed-state initializer
+  # (training.init_sparse_state_direct): uniform(-scale, scale) can be
+  # generated straight into the packed physical layout without ever
+  # materializing the [rows, width] logical table
+  init.scale = scale
+  return init
+
+
+_NAMED_INITIALIZERS = {
+    "uniform": _keras_uniform,
+    "random_uniform": _keras_uniform,
+    "normal": lambda: nn.initializers.normal(stddev=0.05),
+    "random_normal": lambda: nn.initializers.normal(stddev=0.05),
+    "zeros": lambda: nn.initializers.zeros_init(),
+    "ones": lambda: nn.initializers.ones_init(),
+    "glorot_uniform": lambda: nn.initializers.glorot_uniform(),
+    "glorot_normal": lambda: nn.initializers.glorot_normal(),
+    "he_uniform": lambda: nn.initializers.he_uniform(),
+    "he_normal": lambda: nn.initializers.he_normal(),
+}
+
+
+def resolve_initializer(spec: Union[str, Initializer, None]) -> Initializer:
+  """Accepts a named initializer (Keras-style), a callable, or None."""
+  if spec is None:
+    return _keras_uniform()
+  if callable(spec):
+    return spec
+  if isinstance(spec, str):
+    key = spec.lower()
+    if key in _NAMED_INITIALIZERS:
+      return _NAMED_INITIALIZERS[key]()
+    raise ValueError(f"Unknown initializer {spec!r}")
+  raise TypeError(f"Cannot resolve initializer from {spec!r}")
+
+
+# ---------------------------------------------------------------------------
+# Regularizers / constraints (reference `embedding.py:62-70,96-100` accepts
+# Keras regularizer/constraint objects; here the Keras names resolve to
+# plain callables)
+# ---------------------------------------------------------------------------
+
+
+def _l1(factor=0.01):
+  return lambda w: factor * jnp.sum(jnp.abs(w))
+
+
+def _l2(factor=0.01):
+  return lambda w: factor * jnp.sum(jnp.square(w))
+
+
+_NAMED_REGULARIZERS = {
+    "l1": _l1,
+    "l2": _l2,
+    "l1_l2": lambda: (lambda w: 0.01 * jnp.sum(jnp.abs(w))
+                      + 0.01 * jnp.sum(jnp.square(w))),
+}
+
+
+def resolve_regularizer(spec) -> Optional[Callable[[jax.Array], jax.Array]]:
+  """``None`` | Keras name ('l1'/'l2'/'l1_l2') | callable -> callable.
+
+  The callable maps a weight array to a scalar penalty added to the loss
+  (Keras regularizer semantics, defaults matching ``keras.regularizers``)."""
+  if spec is None:
+    return None
+  if callable(spec):
+    return spec
+  if isinstance(spec, str):
+    key = spec.lower()
+    if key in _NAMED_REGULARIZERS:
+      return _NAMED_REGULARIZERS[key]()
+    raise ValueError(f"Unknown regularizer {spec!r}")
+  raise TypeError(f"Cannot resolve regularizer from {spec!r}")
+
+
+def _max_norm(max_value=2.0, eps=1e-7):
+  def project(w):
+    norms = jnp.sqrt(jnp.sum(jnp.square(w), axis=-1, keepdims=True))
+    desired = jnp.clip(norms, 0, max_value)
+    return w * (desired / (eps + norms))
+  return project
+
+
+def _unit_norm(eps=1e-7):
+  def project(w):
+    return w / (eps + jnp.sqrt(jnp.sum(jnp.square(w), axis=-1,
+                                       keepdims=True)))
+  return project
+
+
+_NAMED_CONSTRAINTS = {
+    "non_neg": lambda: (lambda w: jnp.maximum(w, 0.0)),
+    "max_norm": _max_norm,
+    "unit_norm": _unit_norm,
+}
+
+
+def resolve_constraint(spec) -> Optional[Callable[[jax.Array], jax.Array]]:
+  """``None`` | Keras name ('non_neg'/'max_norm'/'unit_norm') | callable.
+
+  The callable projects a weight array after each optimizer update (Keras
+  constraint semantics; per-row norms use the last axis)."""
+  if spec is None:
+    return None
+  if callable(spec):
+    return spec
+  if isinstance(spec, str):
+    key = spec.lower()
+    if key in _NAMED_CONSTRAINTS:
+      return _NAMED_CONSTRAINTS[key]()
+    raise ValueError(f"Unknown constraint {spec!r}")
+  raise TypeError(f"Cannot resolve constraint from {spec!r}")
+
+
+class Embedding(nn.Module):
+  """Turns indices into vectors of fixed size; optional multi-hot reduce.
+
+  Parity with the reference ``Embedding`` (`embedding.py:41-152`). When
+  ``combiner`` is not None, supported inputs and output shapes:
+
+  - N-D integer array ``(d1,...,dn)`` -> ``(d1,...,dn-1, output_dim)``, N >= 2
+  - 2-D ``RaggedIds`` ``(batch, ragged)`` -> ``(batch, output_dim)``
+  - 2-D ``SparseIds`` ``(batch, max_hot)`` -> ``(batch, output_dim)``
+
+  With ``combiner=None``, output is ``input.shape + (output_dim,)``.
+
+  Regularizers (reference `embedding.py:64-70,96-100`): penalties are
+  ``sow``n into the ``"losses"`` collection — run
+  ``apply({...}, x, mutable=["losses"])`` and add
+  :func:`collect_regularization_losses` of the mutated collection to the
+  loss. The constraint is a post-update projection: apply
+  :meth:`apply_constraint` (the train-step builders in ``training.py`` do
+  both automatically for distributed plans).
+
+  Attributes:
+    input_dim: vocabulary size (max index + 1).
+    output_dim: embedding width.
+    embeddings_initializer: named or callable initializer.
+    embeddings_regularizer: None | 'l1'/'l2'/'l1_l2' | callable -> scalar
+      penalty on the table.
+    activity_regularizer: same, applied to the layer output.
+    embeddings_constraint: None | 'non_neg'/'max_norm'/'unit_norm' |
+      callable row projection applied after optimizer updates.
+    combiner: None, 'sum', or 'mean'.
+  """
+
+  input_dim: int
+  output_dim: int
+  embeddings_initializer: Union[str, Initializer, None] = "uniform"
+  embeddings_regularizer: Any = None
+  activity_regularizer: Any = None
+  embeddings_constraint: Any = None
+  combiner: Optional[str] = None
+  param_dtype: Any = jnp.float32
+
+  def __post_init__(self):
+    super().__post_init__()
+    if self.input_dim <= 0 or self.output_dim <= 0:
+      raise ValueError(
+          "Both input_dim and output_dim should be positive, "
+          f"found {self.input_dim} and {self.output_dim}")
+
+  @nn.compact
+  def __call__(self, inputs):
+    embeddings = self.param(
+        "embeddings",
+        resolve_initializer(self.embeddings_initializer),
+        (self.input_dim, self.output_dim),
+        self.param_dtype,
+    )
+    out = self.lookup(embeddings, inputs)
+    reg = resolve_regularizer(self.embeddings_regularizer)
+    if reg is not None:
+      # overwrite, don't append: a shared layer applied N times must count
+      # its WEIGHT penalty once (Keras adds it per variable, not per call)
+      self.sow("losses", "embeddings_regularizer", reg(embeddings),
+               reduce_fn=lambda prev, new: new,
+               init_fn=lambda: jnp.zeros(()))
+    act_reg = resolve_regularizer(self.activity_regularizer)
+    if act_reg is not None:
+      # accumulate: the ACTIVITY penalty applies to every call's output
+      self.sow("losses", "activity_regularizer", act_reg(out),
+               reduce_fn=lambda prev, new: prev + new,
+               init_fn=lambda: jnp.zeros(()))
+    return out
+
+  def apply_constraint(self, embeddings: jax.Array) -> jax.Array:
+    """Post-update projection of the table (Keras constraint semantics)."""
+    proj = resolve_constraint(self.embeddings_constraint)
+    return embeddings if proj is None else proj(embeddings)
+
+  def lookup(self, embeddings, inputs):
+    """Input normalization + lookup (reference `embedding.py:108-133`)."""
+    if isinstance(inputs, (RaggedIds, SparseIds)):
+      return embedding_lookup(embeddings, inputs, combiner=self.combiner)
+    inputs = jnp.asarray(inputs)
+    if not jnp.issubdtype(inputs.dtype, jnp.integer):
+      inputs = inputs.astype(jnp.int32)
+    out_shape = None
+    if inputs.ndim == 1:
+      if self.combiner is not None:
+        raise ValueError(
+            "1D input with combiner is ambiguous. Please create batch dimension.")
+      inputs = inputs.reshape(-1, 1)
+      out_shape = (-1, self.output_dim)
+    elif inputs.ndim > 2:
+      if self.combiner is None:
+        out_shape = inputs.shape + (self.output_dim,)
+      else:
+        out_shape = inputs.shape[:-1] + (self.output_dim,)
+      inputs = inputs.reshape(-1, inputs.shape[-1])
+    out = embedding_lookup(embeddings, inputs, combiner=self.combiner)
+    if out_shape is not None:
+      out = out.reshape(out_shape)
+    return out
+
+  def get_config(self):
+    return {
+        "input_dim": self.input_dim,
+        "output_dim": self.output_dim,
+        "embeddings_initializer": self.embeddings_initializer,
+        "embeddings_regularizer": self.embeddings_regularizer,
+        "activity_regularizer": self.activity_regularizer,
+        "embeddings_constraint": self.embeddings_constraint,
+        "combiner": self.combiner,
+        "name": self.name,
+    }
+
+  @classmethod
+  def from_config(cls, config):
+    config = dict(config)
+    config.pop("mask_zero", None)
+    config.pop("input_length", None)
+    config.pop("name", None)
+    return cls(**config)
+
+
+def collect_regularization_losses(variables) -> jax.Array:
+  """Sum every penalty sown into a ``"losses"`` collection.
+
+  ``variables`` is the mutated-collection dict returned by
+  ``module.apply(..., mutable=["losses"])`` (or its ``"losses"`` subtree)."""
+  tree = variables.get("losses", variables) if isinstance(variables, dict) \
+      else variables
+  leaves = jax.tree_util.tree_leaves(tree)
+  if not leaves:
+    return jnp.zeros(())
+  return sum(jnp.sum(jnp.asarray(x)) for x in leaves)
+
+
+@dataclasses.dataclass
+class TableConfig:
+  """Plain-data description of one embedding table, for the planner.
+
+  Equivalent to a reference layer config dict
+  (`dist_model_parallel.py:95-98`). ``from_layer``/``to_layer`` convert to and
+  from ``Embedding`` modules.
+  """
+
+  input_dim: int
+  output_dim: int
+  combiner: Optional[str] = None
+  initializer: Union[str, Initializer, None] = "uniform"
+  regularizer: Any = None  # table penalty (None | name | callable)
+  constraint: Any = None  # post-update row projection (None | name | callable)
+  name: Optional[str] = None
+
+  def size(self) -> int:
+    return self.input_dim * self.output_dim
+
+  @classmethod
+  def from_layer(cls, layer: Embedding) -> "TableConfig":
+    if layer.activity_regularizer is not None:
+      raise ValueError(
+          "activity_regularizer is not supported in the distributed path "
+          f"(table {layer.name!r}): apply it to the layer outputs in the "
+          "model's loss instead")
+    return cls(
+        input_dim=layer.input_dim,
+        output_dim=layer.output_dim,
+        combiner=layer.combiner,
+        initializer=layer.embeddings_initializer,
+        regularizer=layer.embeddings_regularizer,
+        constraint=layer.embeddings_constraint,
+        name=layer.name,
+    )
+
+  def to_layer(self) -> Embedding:
+    return Embedding(
+        input_dim=self.input_dim,
+        output_dim=self.output_dim,
+        embeddings_initializer=self.initializer,
+        embeddings_regularizer=self.regularizer,
+        embeddings_constraint=self.constraint,
+        combiner=self.combiner,
+    )
+
+
+class ConcatOneHotEmbedding(nn.Module):
+  """N one-hot tables concatenated row-wise into a single weight.
+
+  Parity with the reference ``ConcatOneHotEmbedding`` (`embedding.py:155-180`):
+  lookup adds per-feature row offsets, then performs one gather.
+  """
+
+  feature_sizes: tuple
+  embedding_width: int
+  params_initializer: Union[str, Initializer, None] = "uniform"
+
+  @nn.compact
+  def __call__(self, inputs):
+    offsets = np.concatenate([[0], np.cumsum(self.feature_sizes)])
+    table = self.param(
+        "embeddings",
+        resolve_initializer(self.params_initializer),
+        (int(offsets[-1]), self.embedding_width),
+        jnp.float32,
+    )
+    if inputs.shape[-1] != len(self.feature_sizes):
+      raise ValueError(
+          f"Expected {len(self.feature_sizes)} features, got {inputs.shape[-1]}")
+    # clamp per feature so a bad id cannot bleed into the next table's rows
+    sizes = jnp.asarray(np.asarray(self.feature_sizes), inputs.dtype)
+    clamped = jnp.clip(inputs, 0, sizes - 1)
+    shifted = clamped + jnp.asarray(offsets[:-1], inputs.dtype)
+    return jnp.take(table, shifted, axis=0, mode="clip")

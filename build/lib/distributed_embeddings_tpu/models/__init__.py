@@ -1,0 +1,28 @@
+"""Model zoo: DLRM + synthetic benchmark models."""
+
+from .dlrm import DLRM, MLP, bce_loss, dot_interact
+from .synthetic import (
+    SYNTHETIC_MODELS,
+    EmbeddingGroup,
+    SyntheticModel,
+    SyntheticModelConfig,
+    expand_tables,
+    generate_batch,
+    model_size_gib,
+    power_law_ids,
+)
+
+__all__ = [
+    "DLRM",
+    "MLP",
+    "bce_loss",
+    "dot_interact",
+    "SYNTHETIC_MODELS",
+    "EmbeddingGroup",
+    "SyntheticModel",
+    "SyntheticModelConfig",
+    "expand_tables",
+    "generate_batch",
+    "model_size_gib",
+    "power_law_ids",
+]

@@ -1,0 +1,241 @@
+"""Synthetic recommender model zoo (tiny -> colossal) + power-law inputs.
+
+Mirror of the reference benchmark models
+(`/root/reference/examples/benchmarks/synthetic_models/config_v3.py:30-142`,
+`synthetic_models.py:31-233`): the model-size table and per-config embedding
+specs are the reference's published benchmark definitions; the model itself
+(sum-combined embeddings -> optional strided average-pool "interaction" ->
+MLP -> logit) is re-implemented as a flax module over
+``DistributedEmbedding``.
+
+| config   | tables | embedding GiB |
+|----------|--------|---------------|
+| tiny     |     55 |           4.2 |
+| small    |    107 |          26.3 |
+| medium   |    311 |         206.2 |
+| large    |    612 |         773.8 |
+| jumbo    |   1022 |        3109.5 |
+| colossal |   2002 |       22327.4 |
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..layers.dist_model_parallel import DistributedEmbedding
+from ..layers.embedding import TableConfig
+from .dlrm import MLP
+
+
+@dataclasses.dataclass(frozen=True)
+class EmbeddingGroup:
+  """A group of identical tables (reference ``EmbeddingConfig``,
+  `config_v3.py:21-23`). ``nnz`` lists the hotness of each input reading the
+  table; len(nnz) > 1 requires ``shared`` (multiple inputs, one table)."""
+
+  num_tables: int
+  nnz: Tuple[int, ...]
+  num_rows: int
+  width: int
+  shared: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class SyntheticModelConfig:
+  name: str
+  embedding_groups: Tuple[EmbeddingGroup, ...]
+  mlp_sizes: Tuple[int, ...]
+  num_numerical_features: int
+  interact_stride: Optional[int]
+
+
+def _cfg(name, groups, mlp, numerical, stride):
+  return SyntheticModelConfig(
+      name=name,
+      embedding_groups=tuple(EmbeddingGroup(*g) for g in groups),
+      mlp_sizes=tuple(mlp),
+      num_numerical_features=numerical,
+      interact_stride=stride)
+
+
+# Model definitions transcribed from the reference benchmark suite
+# (`config_v3.py:30-142`); (num_tables, nnz, rows, width, shared).
+SYNTHETIC_MODELS = {
+    "criteo": _cfg("Criteo-dlrm-like",
+                   [(26, (1,), 100_000, 128, False)],
+                   [512, 256, 128], 13, None),
+    "tiny": _cfg("Tiny V3",
+                 [(1, (1, 10), 10_000, 8, True),
+                  (1, (1, 10), 1_000_000, 16, True),
+                  (1, (1, 10), 25_000_000, 16, True),
+                  (1, (1,), 25_000_000, 16, False),
+                  (16, (1,), 10, 8, False),
+                  (10, (1,), 1_000, 8, False),
+                  (4, (1,), 10_000, 8, False),
+                  (2, (1,), 100_000, 16, False),
+                  (19, (1,), 1_000_000, 16, False)],
+                 [256, 128], 10, None),
+    "small": _cfg("Small V3",
+                  [(5, (1, 30), 10_000, 16, True),
+                   (3, (1, 30), 4_000_000, 32, True),
+                   (1, (1, 30), 50_000_000, 32, True),
+                   (1, (1,), 50_000_000, 32, False),
+                   (30, (1,), 10, 16, False),
+                   (30, (1,), 1_000, 16, False),
+                   (5, (1,), 10_000, 16, False),
+                   (5, (1,), 100_000, 32, False),
+                   (27, (1,), 4_000_000, 32, False)],
+                  [512, 256, 128], 10, None),
+    "medium": _cfg("Medium v3",
+                   [(20, (1, 50), 100_000, 64, True),
+                    (5, (1, 50), 10_000_000, 64, True),
+                    (1, (1, 50), 100_000_000, 128, True),
+                    (1, (1,), 100_000_000, 128, False),
+                    (80, (1,), 10, 32, False),
+                    (60, (1,), 1_000, 32, False),
+                    (80, (1,), 100_000, 64, False),
+                    (24, (1,), 200_000, 64, False),
+                    (40, (1,), 10_000_000, 64, False)],
+                   [1024, 512, 256, 128], 25, 7),
+    "large": _cfg("Large v3",
+                  [(40, (1, 100), 100_000, 64, True),
+                   (16, (1, 100), 15_000_000, 64, True),
+                   (1, (1, 100), 200_000_000, 128, True),
+                   (1, (1,), 200_000_000, 128, False),
+                   (100, (1,), 10, 32, False),
+                   (100, (1,), 10_000, 32, False),
+                   (160, (1,), 100_000, 64, False),
+                   (50, (1,), 500_000, 64, False),
+                   (144, (1,), 15_000_000, 64, False)],
+                  [2048, 1024, 512, 256], 100, 8),
+    "jumbo": _cfg("Jumbo v3",
+                  [(50, (1, 200), 100_000, 128, True),
+                   (24, (1, 200), 20_000_000, 128, True),
+                   (1, (1, 200), 400_000_000, 256, True),
+                   (1, (1,), 400_000_000, 256, False),
+                   (100, (1,), 10, 32, False),
+                   (200, (1,), 10_000, 64, False),
+                   (350, (1,), 100_000, 128, False),
+                   (80, (1,), 1_000_000, 128, False),
+                   (216, (1,), 20_000_000, 128, False)],
+                  [2048, 1024, 512, 256], 200, 20),
+    "colossal": _cfg("Colossal v3",
+                     [(100, (1, 300), 100_000, 128, True),
+                      (50, (1, 300), 40_000_000, 256, True),
+                      (1, (1, 300), 2_000_000_000, 256, True),
+                      (1, (1,), 1_000_000_000, 256, False),
+                      (100, (1,), 10, 32, False),
+                      (400, (1,), 10_000, 128, False),
+                      (100, (1,), 100_000, 128, False),
+                      (800, (1,), 1_000_000, 128, False),
+                      (450, (1,), 40_000_000, 256, False)],
+                     [4096, 2048, 1024, 512, 256], 500, 30),
+}
+
+
+def expand_tables(config: SyntheticModelConfig
+                  ) -> Tuple[List[TableConfig], List[int], List[int]]:
+  """-> (table configs, input_table_map, per-input hotness)."""
+  tables: List[TableConfig] = []
+  input_table_map: List[int] = []
+  hotness: List[int] = []
+  for group in config.embedding_groups:
+    if len(group.nnz) > 1 and not group.shared:
+      raise NotImplementedError(
+          "Non-shared multi-hot embedding groups are not supported "
+          "(reference `synthetic_models.py:136-137` has the same restriction)")
+    for _ in range(group.num_tables):
+      tables.append(TableConfig(input_dim=group.num_rows,
+                                output_dim=group.width, combiner="sum"))
+      for h in group.nnz:
+        input_table_map.append(len(tables) - 1)
+        hotness.append(h)
+  return tables, input_table_map, hotness
+
+
+def model_size_gib(config: SyntheticModelConfig) -> float:
+  tables, _, _ = expand_tables(config)
+  return sum(t.size() for t in tables) * 4 / 2**30
+
+
+def power_law_ids(rng: np.random.Generator, batch: int, hotness: int,
+                  num_rows: int, alpha: float) -> np.ndarray:
+  """Power-law distributed ids in [0, num_rows) (reference ``power_law``,
+  `synthetic_models.py:31-46`): inverse-CDF transform of uniform samples with
+  exponent alpha; alpha=0 degenerates to uniform."""
+  if alpha == 0:
+    return rng.integers(0, num_rows, size=(batch, hotness), dtype=np.int64)
+  gamma = 1.0 - alpha
+  r = rng.random(batch * hotness)
+  lo, hi = 1.0, float(num_rows + 1)
+  y = (r * (hi**gamma - lo**gamma) + lo**gamma) ** (1.0 / gamma)
+  return (y.astype(np.int64) - 1).clip(0, num_rows - 1).reshape(batch, hotness)
+
+
+def generate_batch(config: SyntheticModelConfig, global_batch: int,
+                   alpha: float = 0.0, seed: int = 0,
+                   ) -> Tuple[np.ndarray, List[np.ndarray], np.ndarray]:
+  """One synthetic (numerical, categorical list, labels) batch
+  (reference ``InputGenerator``, `synthetic_models.py:51-113`)."""
+  rng = np.random.default_rng(seed)
+  tables, input_table_map, hotness = expand_tables(config)
+  cats = [
+      power_law_ids(rng, global_batch, h, tables[t].input_dim, alpha)
+      .astype(np.int32)
+      for t, h in zip(input_table_map, hotness)
+  ]
+  numerical = rng.uniform(0, 100, size=(
+      global_batch, config.num_numerical_features)).astype(np.float32)
+  labels = rng.integers(0, 2, size=(global_batch,)).astype(np.float32)
+  return numerical, cats, labels
+
+
+class SyntheticModel(nn.Module):
+  """Synthetic benchmark model (reference ``SyntheticModelTFDE``,
+  `synthetic_models.py:116-176`): sum-combined embeddings over power-law
+  inputs, optional strided average-pool interaction, MLP head."""
+
+  config: SyntheticModelConfig
+  world_size: int = 1
+  strategy: str = "memory_balanced"
+  column_slice_threshold: Optional[int] = None
+  row_slice: Optional[int] = None
+  dp_input: bool = True
+  compute_dtype: Any = jnp.float32
+  # small-vocab tables ride the MXU one-hot path (see planner)
+  dense_row_threshold: int = 2048
+
+  def setup(self):
+    tables, input_table_map, self._hotness = expand_tables(self.config)
+    self.embeddings = DistributedEmbedding(
+        embeddings=tuple(tables),
+        strategy=self.strategy,
+        column_slice_threshold=self.column_slice_threshold,
+        row_slice=self.row_slice,
+        dp_input=self.dp_input,
+        input_table_map=tuple(input_table_map),
+        world_size=self.world_size,
+        input_hotness=None if self.dp_input else tuple(self._hotness),
+        dense_row_threshold=self.dense_row_threshold,
+        name="embeddings")
+    self.mlp = MLP(tuple(self.config.mlp_sizes) + (1,),
+                   dtype=self.compute_dtype, name="mlp")
+
+  def __call__(self, numerical, cat_features, emb_acts=None):
+    outs = emb_acts if emb_acts is not None \
+        else self.embeddings(cat_features)
+    x = jnp.concatenate([o.astype(self.compute_dtype) for o in outs], axis=1)
+    if self.config.interact_stride is not None:
+      # strided average pooling over the concatenated feature axis emulates a
+      # bandwidth-limited interaction (reference `synthetic_models.py:151-156`)
+      x = nn.avg_pool(x[..., None], window_shape=(self.config.interact_stride,),
+                      strides=(self.config.interact_stride,),
+                      padding="SAME")[..., 0]
+    x = jnp.concatenate([x, numerical.astype(self.compute_dtype)], axis=1)
+    return jnp.squeeze(self.mlp(x), -1).astype(jnp.float32)

@@ -1,0 +1,43 @@
+"""Embedding lookup ops."""
+
+from .embedding_lookup import csr_lookup, embedding_lookup, sparse_dedup_grad
+from .packed_table import (
+    PackedLayout,
+    SparseRule,
+    adagrad_rule,
+    gather_fused,
+    scatter_add_fused,
+    sgd_rule,
+    sparse_rule,
+)
+from .ragged import RaggedIds, SparseIds, row_to_split
+from .sparse_grad import (
+    SparseOptimizer,
+    SparseRows,
+    dedup_rows,
+    sparse_adagrad,
+    sparse_optimizer,
+    sparse_sgd,
+)
+
+__all__ = [
+    "csr_lookup",
+    "embedding_lookup",
+    "sparse_dedup_grad",
+    "PackedLayout",
+    "SparseRule",
+    "adagrad_rule",
+    "gather_fused",
+    "scatter_add_fused",
+    "sgd_rule",
+    "sparse_rule",
+    "RaggedIds",
+    "SparseIds",
+    "row_to_split",
+    "SparseOptimizer",
+    "SparseRows",
+    "dedup_rows",
+    "sparse_adagrad",
+    "sparse_optimizer",
+    "sparse_sgd",
+]

@@ -1,0 +1,424 @@
+"""Lane-packed table storage with fused optimizer-state rows.
+
+TPU performance foundation for the sparse embedding path. Measured on v5e,
+every indexed row op (gather / scatter) costs ~8-23 ns **per row regardless
+of row width** up to one 512-byte tile line — bytes are free, rows are
+expensive. Narrow embedding rows (the reference's width 8..128 tables,
+`/root/reference/examples/benchmarks/synthetic_models/config_v3.py:30-142`)
+are therefore stored packed, several logical rows per 128-lane physical row,
+and the optimizer's per-row state (e.g. the Adagrad accumulator the
+reference keeps as a TF slot variable) is **interleaved into the same
+physical row** as its table row:
+
+    physical row (128 lanes, f32):
+    [ t[4k] | acc[4k] | t[4k+1] | acc[4k+1] | ... ]   (width 16, 1 aux slot)
+
+Consequences:
+- the forward gather brings the optimizer state along *for free* (row-bound
+  cost), so the backward needs **one** scatter-add of a fused
+  (table-delta | state-delta) row — replacing the reference backward's
+  sort/unique/segment-sum + separate accumulator and table scatter traffic
+  (`embedding_lookup_kernels.cu:464-633` + TF sparse Adagrad apply) with a
+  single indexed op;
+- physical rows are always a multiple of 128 lanes, so XLA never inserts
+  the tile-padding relayout copies that a raw ``[rows, 16]`` operand
+  triggers (8x memory and an OOM at 70M rows).
+
+All ops are jit/shard_map safe with static shapes; ids outside
+``[0, rows)`` are padding sentinels (gather returns zero rows, scatter
+drops).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LANES = 128
+
+
+@dataclasses.dataclass(frozen=True)
+class PackedLayout:
+  """Physical layout of one logical ``[rows, width]`` table with ``n_aux``
+  interleaved per-row optimizer-state rows."""
+
+  rows: int
+  width: int
+  n_aux: int = 0
+
+  @property
+  def stride(self) -> int:
+    """Lanes per logical row: table row + its aux rows."""
+    return self.width * (1 + self.n_aux)
+
+  @property
+  def rows_per_phys(self) -> int:
+    return max(1, LANES // self.stride)
+
+  @property
+  def phys_width(self) -> int:
+    return max(LANES, -(-self.stride // LANES) * LANES)
+
+  @property
+  def phys_rows(self) -> int:
+    return -(-self.rows // self.rows_per_phys)
+
+  @property
+  def shape(self):
+    return (self.phys_rows, self.phys_width)
+
+  # ---- packing (host or device; pure reshapes) ---------------------------
+  def pack(self, table, aux: Sequence = ()):
+    """``[rows, width]`` table (+ per-aux ``[rows, width]``) -> packed buf."""
+    xp = jnp if isinstance(table, jax.Array) else np
+    parts = [table] + list(aux)
+    if len(parts) != 1 + self.n_aux:
+      raise ValueError(f"Expected {self.n_aux} aux arrays, got {len(aux)}")
+    rpp = self.rows_per_phys
+    pad_rows = self.phys_rows * rpp - self.rows
+    stacked = xp.stack(parts, axis=1)  # [rows, 1+n_aux, width]
+    if pad_rows:
+      stacked = xp.concatenate(
+          [stacked, xp.zeros((pad_rows,) + stacked.shape[1:], stacked.dtype)],
+          axis=0)
+    flat = stacked.reshape(self.phys_rows, rpp * self.stride)
+    lane_pad = self.phys_width - rpp * self.stride
+    if lane_pad:
+      flat = xp.concatenate(
+          [flat, xp.zeros((self.phys_rows, lane_pad), flat.dtype)], axis=1)
+    return flat
+
+  def pack_chunked(self, table: jax.Array, aux_values: Sequence[float],
+                   chunk_rows: int = 1 << 18) -> jax.Array:
+    """Device-side pack with bounded intermediates (constant-filled aux).
+
+    A one-shot ``pack`` of a large narrow table materializes a tile-padded
+    intermediate (XLA pads sub-128 minor dims to 128 lanes — 8x memory for
+    width 16, an instant OOM at 70M rows). This variant streams logical-row
+    chunks through small padded temps into the 128-lane output buffer via
+    ``dynamic_update_slice``. Aux rows are constant fills (the optimizer
+    initial state), so no aux source arrays are ever allocated.
+    """
+    rpp = self.rows_per_phys
+    chunk_rows = max(rpp, (chunk_rows // rpp) * rpp)
+    # lane template: aux lanes at their init constants, table lanes 0
+    tmpl = np.zeros((self.phys_width,), np.float32)
+    for j in range(rpp):
+      for s, v in enumerate(aux_values):
+        lo = j * self.stride + (1 + s) * self.width
+        tmpl[lo:lo + self.width] = v
+    buf = jnp.broadcast_to(jnp.asarray(tmpl, table.dtype),
+                           (self.phys_rows, self.phys_width))
+    if not aux_values:
+      buf = jnp.zeros((self.phys_rows, self.phys_width), table.dtype)
+    aux_fill = jnp.asarray(
+        np.concatenate([np.full((self.width,), v, np.float32)
+                        for v in aux_values]) if aux_values
+        else np.zeros((0,), np.float32), table.dtype)
+    for c0 in range(0, self.rows, chunk_rows):
+      cr = min(chunk_rows, self.rows - c0)
+      cr_pad = -(-cr // rpp) * rpp
+      rows_c = table[c0:c0 + cr]
+      if cr_pad != cr:
+        rows_c = jnp.concatenate(
+            [rows_c, jnp.zeros((cr_pad - cr, self.width), table.dtype)])
+      rows_c = rows_c.reshape(cr_pad // rpp, rpp, self.width)
+      if self.n_aux:
+        af = jnp.broadcast_to(aux_fill,
+                              (cr_pad // rpp, rpp, aux_fill.shape[0]))
+        rows_c = jnp.concatenate([rows_c, af], axis=-1)
+      chunk = rows_c.reshape(cr_pad // rpp, rpp * self.stride)
+      lane_pad = self.phys_width - rpp * self.stride
+      if lane_pad:
+        chunk = jnp.concatenate(
+            [chunk, jnp.zeros((chunk.shape[0], lane_pad), table.dtype)],
+            axis=1)
+      buf = jax.lax.dynamic_update_slice(buf, chunk, (c0 // rpp, 0))
+    return buf
+
+  def unpack_table_chunked(self, buf: jax.Array,
+                           chunk_phys: int = 1 << 16) -> jax.Array:
+    """Packed buf -> table ``[rows, width]`` with bounded intermediates."""
+    rpp = self.rows_per_phys
+    parts = []
+    for p0 in range(0, self.phys_rows, chunk_phys):
+      pc = min(chunk_phys, self.phys_rows - p0)
+      blk = buf[p0:p0 + pc, :rpp * self.stride]
+      blk = blk.reshape(pc * rpp, self.stride)[:, :self.width]
+      parts.append(blk)
+    table = jnp.concatenate(parts, axis=0)
+    return table[:self.rows]
+
+  def unpack(self, buf):
+    """Packed buf -> ``(table [rows, width], [aux_0, aux_1, ...])``."""
+    xp = jnp if isinstance(buf, jax.Array) else np
+    del xp
+    rpp = self.rows_per_phys
+    flat = buf[:, :rpp * self.stride]
+    stacked = flat.reshape(self.phys_rows * rpp, 1 + self.n_aux, self.width)
+    stacked = stacked[:self.rows]
+    table = stacked[:, 0, :]
+    aux = [stacked[:, 1 + j, :] for j in range(self.n_aux)]
+    return table, aux
+
+
+def init_packed_uniform(layout: PackedLayout, key: jax.Array,
+                        scale_rows: jax.Array, aux_values: Sequence[float],
+                        dtype=jnp.float32, chunk_phys: int = 1 << 16
+                        ) -> jax.Array:
+  """Initialize a packed buffer directly in its physical layout.
+
+  Table lanes get ``uniform(-1, 1) * scale_rows[row]`` (per-logical-row
+  scale, e.g. the DLRM ``1/sqrt(rows)`` or Keras ``0.05``); aux lanes get
+  their ``aux_values`` constants; rows with ``scale_rows == 0`` (padding /
+  unused) are zero. The ``[rows, width]`` logical table is never
+  materialized — the peak allocation is the buffer itself plus one
+  ``chunk_phys``-row temporary, which is what lets a near-HBM-sized class
+  initialize on chip (the generic ``pack_chunked`` path needs the simple
+  table as input, a 1.5x transient).
+  """
+  rpp = layout.rows_per_phys
+  stride = layout.stride
+  w = layout.width
+  # per-lane template: 1 where a table lane lives, aux constant elsewhere
+  lane_is_table = np.zeros((layout.phys_width,), bool)
+  aux_tmpl = np.zeros((layout.phys_width,), np.float32)
+  for j in range(rpp):
+    lo = j * stride
+    lane_is_table[lo:lo + w] = True
+    for s, v in enumerate(aux_values):
+      aux_tmpl[lo + (1 + s) * w:lo + (2 + s) * w] = v
+  lane_is_table = jnp.asarray(lane_is_table)
+  aux_tmpl = jnp.asarray(aux_tmpl, dtype)
+
+  pr = layout.phys_rows
+  scale_p = jnp.zeros((pr * rpp,), dtype).at[:layout.rows].set(
+      scale_rows.astype(dtype))
+  scale_p = scale_p.reshape(pr, rpp)
+  cp = min(chunk_phys, pr)
+
+  def chunk_at(k, start):
+    sub = jax.random.fold_in(key, k)
+    u = jax.random.uniform(sub, (cp, rpp, stride), dtype,
+                           minval=-1.0, maxval=1.0)
+    sc = jax.lax.dynamic_slice(scale_p, (start, 0), (cp, rpp))
+    vals = (u * sc[..., None]).reshape(cp, rpp * stride)
+    pad = layout.phys_width - rpp * stride
+    if pad:
+      vals = jnp.concatenate([vals, jnp.zeros((cp, pad), dtype)], axis=1)
+    # aux lanes: constant where the row is live (scale > 0 marks live rows)
+    live = (sc > 0).any(axis=1)
+    aux_part = jnp.where(live[:, None], aux_tmpl[None, :], 0)
+    return jnp.where(lane_is_table[None, :], vals, aux_part)
+
+  if cp == pr:
+    return chunk_at(0, 0)
+  # overlap-safe starts: the tail chunk re-draws a few rows with a different
+  # subkey, which keeps every row's scale mapping exact without a copy
+  nchunks = -(-pr // cp)
+  starts = np.minimum(np.arange(nchunks) * cp, pr - cp).astype(np.int32)
+  buf = jnp.zeros((pr, layout.phys_width), dtype)
+
+  def body(b, xs):
+    k, start = xs
+    return jax.lax.dynamic_update_slice(b, chunk_at(k, start), (start, 0)), None
+
+  buf, _ = jax.lax.scan(
+      body, buf, (jnp.arange(nchunks), jnp.asarray(starts)))
+  return buf
+
+
+def _grp_sub(layout: PackedLayout, ids: jax.Array):
+  """ids -> (physical row, sub-row) with OOB ids sent past the buffer."""
+  valid = (ids >= 0) & (ids < layout.rows)
+  ids = jnp.where(valid, ids, 0).astype(jnp.int32)
+  rpp = layout.rows_per_phys
+  grp = jnp.where(valid, ids // rpp, layout.phys_rows)
+  sub = ids % rpp
+  return grp, sub, valid
+
+
+def gather_fused(layout: PackedLayout, buf: jax.Array,
+                 ids: jax.Array) -> jax.Array:
+  """Gather fused rows: ``[..., stride]`` = (table row | aux rows).
+
+  One row-bound gather serves both the lookup and the optimizer-state read
+  (the reference needs a separate accumulator read in its sparse Adagrad
+  apply). OOB/sentinel ids return all-zero rows.
+  """
+  grp, sub, _ = _grp_sub(layout, ids)
+  g = jnp.take(buf, grp, axis=0, mode="fill", fill_value=0)
+  rpp = layout.rows_per_phys
+  if rpp == 1:
+    return g[..., :layout.stride]
+  g = g[..., :rpp * layout.stride].reshape(ids.shape + (rpp, layout.stride))
+  oh = jax.nn.one_hot(sub, rpp, dtype=g.dtype)
+  return jnp.einsum("...rs,...r->...s", g, oh)
+
+
+def gather_fused_chunked(layout: PackedLayout, buf: jax.Array,
+                         ids: jax.Array, chunk: int = 1 << 20) -> jax.Array:
+  """:func:`gather_fused` with bounded temporaries.
+
+  When ``rows_per_phys == 1`` (stride >= 128 lanes — e.g. the width-128
+  DLRM tables) a fused gather is a single XLA row gather with no staging
+  beyond its own output, so it runs one-shot regardless of size. Narrow
+  rows (``rpp > 1``) stage ``[N, phys_width]`` (512 B per id) plus the
+  sub-row-select einsum chain — several GiB at benchmark batch sizes — so
+  they run as a ``lax.map`` over fixed-size id chunks, which bounds live
+  temporaries to one chunk at identical row-op cost (indexed ops are
+  row-bound, not launch-bound).
+  """
+  flat = ids.reshape(-1)
+  n = flat.shape[0]
+  if layout.rows_per_phys == 1 or n <= chunk:
+    return gather_fused(layout, buf, ids)
+  nchunks = -(-n // chunk)
+  pad = nchunks * chunk - n
+  if pad:
+    flat = jnp.concatenate([flat, jnp.full((pad,), -1, flat.dtype)])
+  out = jax.lax.map(lambda c: gather_fused(layout, buf, c),
+                    flat.reshape(nchunks, chunk))
+  out = out.reshape(nchunks * chunk, layout.stride)[:n]
+  return out.reshape(ids.shape + (layout.stride,))
+
+
+def _use_pallas_apply() -> bool:
+  """True when the Pallas RMW apply kernel can run (real TPU backend)."""
+  try:
+    return jax.default_backend() == "tpu"
+  except RuntimeError:
+    return False
+
+
+def scatter_add_fused(layout: PackedLayout, buf: jax.Array, ids: jax.Array,
+                      fused_delta: jax.Array,
+                      few_duplicates: bool = False) -> jax.Array:
+  """``buf[ids] += fused_delta`` (one indexed RMW for table + all aux).
+
+  ``fused_delta``: ``[..., stride]`` additive deltas in gather_fused's lane
+  order. Duplicate ids accumulate; OOB ids are dropped. Donate ``buf`` at
+  the jit boundary for an in-place update.
+
+  Lowering (measured on v5e, `docs/BENCHMARKS.md`): the two backends win
+  in opposite regimes. XLA's scatter runs ~75 ns/row on near-unique id
+  streams but ~23 ns/row on heavily duplicated (power-law multi-hot) ones;
+  the Pallas RMW cache kernel (`ops/pallas_apply.py`) is ~55 ns/row
+  regardless. Callers that know the stream is near-unique (e.g. one-hot
+  inputs over large vocabularies) pass ``few_duplicates=True`` to pick the
+  Pallas kernel; the default keeps XLA. ``DE_TPU_PALLAS_APPLY=0/1``
+  force-overrides.
+  """
+  grp, sub, valid = _grp_sub(layout, ids)
+  fused_delta = jnp.where(valid[..., None], fused_delta, 0)
+  rpp = layout.rows_per_phys
+  if rpp == 1:
+    lane_pad = layout.phys_width - layout.stride
+    if lane_pad:
+      fused_delta = jnp.concatenate(
+          [fused_delta,
+           jnp.zeros(fused_delta.shape[:-1] + (lane_pad,), fused_delta.dtype)],
+          axis=-1)
+    upd = fused_delta
+  else:
+    # narrow rows: expand the sub-row delta to the full physical row (the
+    # RMW below is per PHYSICAL row either way); duplicates on the same
+    # physical row still accumulate
+    oh = jax.nn.one_hot(sub, rpp, dtype=fused_delta.dtype)
+    upd = jnp.einsum("...s,...r->...rs", fused_delta, oh)
+    upd = upd.reshape(ids.shape + (rpp * layout.stride,))
+    lane_pad = layout.phys_width - rpp * layout.stride
+    if lane_pad:
+      upd = jnp.concatenate(
+          [upd, jnp.zeros(upd.shape[:-1] + (lane_pad,), upd.dtype)], axis=-1)
+  flat_grp = grp.reshape(-1)
+  flat_upd = upd.reshape(-1, layout.phys_width).astype(buf.dtype)
+  import os
+  forced = os.environ.get("DE_TPU_PALLAS_APPLY", "auto")
+  # rpp > 1 packs several logical rows per physical row, so even a unique
+  # logical id stream is rpp-fold duplicated at the physical level — the
+  # regime where XLA's scatter wins (docs/BENCHMARKS.md)
+  use_pallas = (few_duplicates if forced == "auto" else forced == "1") \
+      and rpp == 1 and _use_pallas_apply() and buf.dtype == jnp.float32
+  if use_pallas:
+    from .pallas_apply import apply_rows_cached
+    return apply_rows_cached(buf, flat_grp, flat_upd)
+  return buf.at[flat_grp].add(flat_upd, mode="drop")
+
+
+# ---------------------------------------------------------------------------
+# Sparse update rules (fused-delta form)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseRule:
+  """Per-occurrence sparse update rule in additive (scatter-add) form.
+
+  ``n_aux`` per-row state slots ride in the packed layout; ``aux_init``
+  gives their fill values; ``delta(g, aux_rows, step)`` maps an occurrence's
+  cotangent row ``g [..., W]`` and its *pre-step* aux rows
+  ``[..., n_aux, W]`` to the fused additive delta ``[..., stride]``.
+
+  With duplicate ids in a batch, each occurrence computes its delta from the
+  forward-time state — the semantics of stock TF sparse optimizer applies
+  (scatter_add on slot + param), which the reference relies on outside its
+  fused op. Exact deduplicated semantics (the reference fused backward,
+  `embedding_lookup_kernels.cu:464-633`) are available via the engine's
+  ``exact=True`` path.
+  """
+
+  name: str
+  n_aux: int
+  aux_init: Sequence[float]
+  delta: callable
+
+  def init_aux(self, rows: int, width: int, dtype=jnp.float32) -> List:
+    return [np.full((rows, width), v, dtype) for v in self.aux_init]
+
+
+def _lr_at(lr, step):
+  return lr(step) if callable(lr) else jnp.asarray(lr, jnp.float32)
+
+
+def sgd_rule(learning_rate) -> SparseRule:
+  """Row-sparse SGD: table[id] -= lr * g (exact even with duplicates)."""
+
+  def delta(g, aux_rows, step):
+    del aux_rows
+    return -_lr_at(learning_rate, step) * g
+
+  return SparseRule("sgd", 0, (), delta)
+
+
+def adagrad_rule(learning_rate, initial_accumulator_value: float = 0.1,
+                 eps: float = 1e-7) -> SparseRule:
+  """Row-sparse Adagrad matching ``optax.adagrad``'s update rule.
+
+  acc' = acc + g^2; table -= lr * g * rsqrt(acc' + eps) (with optax's
+  ``acc' > 0`` guard). acc rides in the fused row, so the whole update is
+  one scatter-add of ``[-lr*scaled | g^2]``.
+  """
+
+  def delta(g, aux_rows, step):
+    acc = aux_rows[..., 0, :]
+    g2 = g * g
+    acc_new = acc + g2
+    scaled = jnp.where(acc_new > 0, g * jax.lax.rsqrt(acc_new + eps), 0.0)
+    lr = _lr_at(learning_rate, step)
+    return jnp.concatenate([-lr * scaled, g2], axis=-1)
+
+  return SparseRule("adagrad", 1, (initial_accumulator_value,), delta)
+
+
+_RULES = {"sgd": sgd_rule, "adagrad": adagrad_rule}
+
+
+def sparse_rule(name: str, learning_rate, **kwargs) -> SparseRule:
+  if name not in _RULES:
+    raise ValueError(f"Unknown sparse rule {name!r}; have {sorted(_RULES)}")
+  return _RULES[name](learning_rate, **kwargs)

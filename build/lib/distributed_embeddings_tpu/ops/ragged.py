@@ -1,0 +1,123 @@
+"""Ragged (CSR) and sparse (COO) index containers for embedding lookups.
+
+JAX has no RaggedTensor / SparseTensor. These light pytree containers carry the
+same information the reference library consumes
+(`/root/reference/distributed_embeddings/python/ops/embedding_lookup_ops.py:37-102`):
+
+- ``RaggedIds``: CSR-style variable-hotness ids — ``values`` is the flat column
+  index array, ``row_splits`` the per-sample offsets. Matches the layout
+  ``tf.RaggedTensor(values, row_splits)`` the reference feeds its fused CUDA op.
+- ``SparseIds``: COO ids as produced by a ``tf.SparseTensor`` — 2-D ``indices``
+  with sorted rows, flat ``values``, and a static ``dense_shape``.
+
+All shapes are static (JAX/XLA requirement): ``values`` has a fixed length per
+trace; callers pad or bucket upstream. ``row_splits`` has length ``nrows + 1``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class RaggedIds:
+  """CSR-format variable-hotness ids: ``values[row_splits[i]:row_splits[i+1]]``
+  are the ids of sample ``i``."""
+
+  values: jax.Array  # [nnz] int32/int64
+  row_splits: jax.Array  # [nrows + 1] int
+
+  def tree_flatten(self):
+    return (self.values, self.row_splits), None
+
+  @classmethod
+  def tree_unflatten(cls, aux, children):
+    del aux
+    return cls(*children)
+
+  @property
+  def nrows(self) -> int:
+    return self.row_splits.shape[0] - 1
+
+  @property
+  def dtype(self):
+    return self.values.dtype
+
+  @property
+  def shape(self):
+    # 2-D logical shape with an unknown (ragged) second dim.
+    return (self.nrows, None)
+
+  def row_lengths(self) -> jax.Array:
+    return self.row_splits[1:] - self.row_splits[:-1]
+
+  @classmethod
+  def from_row_lengths(cls, values, row_lengths):
+    row_lengths = jnp.asarray(row_lengths)
+    row_splits = jnp.concatenate(
+        [jnp.zeros((1,), row_lengths.dtype), jnp.cumsum(row_lengths)])
+    return cls(jnp.asarray(values), row_splits)
+
+  @classmethod
+  def from_dense(cls, dense):
+    """Every element kept: dense [B, H] -> ragged with uniform hotness H."""
+    dense = jnp.asarray(dense)
+    b, h = dense.shape
+    row_splits = jnp.arange(b + 1, dtype=jnp.int32) * h
+    return cls(dense.reshape(-1), row_splits)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class SparseIds:
+  """COO-format ids: ``indices`` is [nnz, 2] (row, col) with rows sorted
+  ascending, ``values`` is [nnz]; ``dense_shape`` is a static (nrows, ncols)."""
+
+  indices: jax.Array  # [nnz, 2] int
+  values: jax.Array  # [nnz] int
+  dense_shape: tuple  # static (nrows, ncols)
+
+  def tree_flatten(self):
+    return (self.indices, self.values), tuple(self.dense_shape)
+
+  @classmethod
+  def tree_unflatten(cls, aux, children):
+    return cls(children[0], children[1], tuple(aux))
+
+  @property
+  def nrows(self) -> int:
+    return int(self.dense_shape[0])
+
+  @property
+  def dtype(self):
+    return self.values.dtype
+
+  @property
+  def shape(self):
+    return tuple(self.dense_shape)
+
+
+def row_to_split(indices: jax.Array, nrows: int, dtype=jnp.int32) -> jax.Array:
+  """COO sorted row ids -> CSR row_splits.
+
+  TPU-native equivalent of the reference ``RowToSplit`` CUDA kernel
+  (`/root/reference/distributed_embeddings/cc/kernels/embedding_lookup_kernels.cu:337-356`),
+  which runs one binary search per output element. ``jnp.searchsorted`` is the
+  same vectorized binary search and compiles to a single fused XLA op, so no
+  custom kernel is needed. Handles empty trailing rows (searchsorted saturates).
+
+  Args:
+    indices: [nnz, 2] COO indices with sorted ``indices[:, 0]``, or [nnz] rows.
+    nrows: static number of rows.
+    dtype: output dtype.
+
+  Returns:
+    [nrows + 1] row_splits with row_splits[0] == 0, row_splits[-1] == nnz.
+  """
+  rows = indices[:, 0] if indices.ndim == 2 else indices
+  targets = jnp.arange(nrows + 1, dtype=rows.dtype)
+  return jnp.searchsorted(rows, targets, side="left").astype(dtype)

@@ -1,0 +1,35 @@
+"""Mesh, collectives, and the distributed lookup engine."""
+
+from .lookup_engine import (
+    Bucket,
+    DistributedLookup,
+    class_buckets,
+    class_param_name,
+    pack_mp_inputs,
+    padded_rows,
+    ragged_to_padded,
+)
+from .mesh import (
+    DEFAULT_AXIS,
+    batch_sharding,
+    create_mesh,
+    initialize_multihost,
+    replicated,
+    table_sharding,
+)
+
+__all__ = [
+    "Bucket",
+    "DistributedLookup",
+    "class_buckets",
+    "class_param_name",
+    "pack_mp_inputs",
+    "padded_rows",
+    "ragged_to_padded",
+    "DEFAULT_AXIS",
+    "batch_sharding",
+    "create_mesh",
+    "initialize_multihost",
+    "replicated",
+    "table_sharding",
+]

@@ -1083,11 +1083,6 @@ def pack_mp_inputs(plan: DistEmbeddingStrategy,
     for pos, input_id in enumerate(plan.input_ids_list[rank]):
       piece = next(p for p in plan.output_pieces[input_id] if p.rank == rank)
       x = _normalize_input(per_rank_inputs[rank][pos])
-      if isinstance(x, RaggedIds):
-        raise TypeError(
-            "model-parallel inputs (dp_input=False) do not support "
-            "RaggedIds; convert with ragged_to_padded(ids, max_hot) — "
-            "value-stream routing only exists for the dp-input exchange")
       if x.shape[1] != hotness_of(input_id):
         raise ValueError(
             f"input {input_id} has hotness {x.shape[1]}, `hotness` says "

@@ -1,0 +1,17 @@
+"""Data pipelines and training utilities."""
+
+from .data import (
+    DummyDataset,
+    RawBinaryCriteoDataset,
+    categorical_dtype,
+    dlrm_lr_schedule,
+    write_dummy_criteo_split,
+)
+
+__all__ = [
+    "DummyDataset",
+    "RawBinaryCriteoDataset",
+    "categorical_dtype",
+    "dlrm_lr_schedule",
+    "write_dummy_criteo_split",
+]

@@ -54,11 +54,6 @@ def _stack_ragged(parts):
       jnp.concatenate([p.row_splits for p in parts]))
 
 
-def _local_ragged_view(x: RaggedIds, world: int):
-  """The engine receives per-device [V] values + [B+1] splits blocks."""
-  return x
-
-
 @pytest.mark.parametrize("combiner", ["sum", "mean"])
 def test_distributed_ragged_matches_padded_and_single(combiner):
   rng = np.random.default_rng(0)
