@@ -40,6 +40,7 @@ reference's ~100 lines of Horovod tape patching.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Dict, List, NamedTuple, Optional, Sequence
 
 import jax
@@ -265,10 +266,17 @@ class DistributedLookup:
   """
 
   def __init__(self, plan: DistEmbeddingStrategy, dp_input: bool = True,
-               axis_name: str = "mp", apply_chunk: int = 1 << 22):
+               axis_name: str = "mp", apply_chunk: int = 1 << 22,
+               dense_remat: bool = True):
     self.plan = plan
     self.dp_input = dp_input
     self.axis_name = axis_name
+    # rematerialize the dense-class one-hot staging in the backward
+    # (memory/time tradeoff); DE_TPU_DENSE_REMAT=0/1 overrides, any other
+    # value keeps the constructor argument (same convention as
+    # DE_TPU_PALLAS_APPLY)
+    env = os.environ.get("DE_TPU_DENSE_REMAT", "")
+    self.dense_remat = dense_remat if env not in ("0", "1") else env == "1"
     # occurrences per scatter chunk in apply_sparse (bounds the backward's
     # lane-expansion temporaries; exposed mainly so tests can exercise the
     # multi-chunk path at small sizes)
@@ -844,13 +852,16 @@ class DistributedLookup:
         continue
       table_local = self._squeeze_local(dense_params[class_param_name(*key)])
       bucket = self._find_bucket(key, bk.h, bk.vcap, hotness_of)
-      # remat: don't keep the [G, vcap] one-hot staging alive for the
-      # backward — rebuilding it is a handful of VPU compares (measured
-      # neutral on the DLRM bench, and it saves ~1 GiB live at batch 64k)
-      z_fn = jax.checkpoint(
-          lambda t, i, key=key, bucket=bucket: self._z_dense(
-              key, bucket, t, i))
-      z[bk] = z_fn(table_local, ids)
+      if self.dense_remat:
+        # don't keep the [G, vcap] one-hot staging alive for the backward —
+        # rebuilding it is a few VPU compares, and it saves ~1.5 GiB live
+        # at batch 64k (needed when the chip is near its HBM limit)
+        z_fn = jax.checkpoint(
+            lambda t, i, key=key, bucket=bucket: self._z_dense(
+                key, bucket, t, i))
+        z[bk] = z_fn(table_local, ids)
+      else:
+        z[bk] = self._z_dense(key, bucket, table_local, ids)
     received = self.exchange(z, batch_local)
     return self.assemble(received, hotness_of, mean_counts)
 

@@ -1,0 +1,117 @@
+"""Isolate the narrow-row (rpp>1) fused-gather cost and test alternatives.
+
+The packed layout stores 4 logical 16-wide rows per 128-lane physical row;
+extraction currently one-hots the sub-row index and einsums over a
+[N, rpp, stride] view — whose small minor dims tile-pad badly. Candidate:
+4-way shift-select that stays [N, 128] the whole way.
+
+Usage: python tools/profile_narrow_gather.py
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = 1 << 21          # ~2M occurrences (Tiny's 16-wide class is 2.88M)
+R = 1 << 23          # physical rows
+W = 128              # phys width
+RPP = 4
+STRIDE = 32          # 16 table + 16 acc lanes
+K = 4
+
+
+def timeit(name, fn, *args):
+  step = jax.jit(fn)
+  c = step(*args)
+  jax.block_until_ready(c)
+  float(c)
+
+  def run(n):
+    t0 = time.perf_counter()
+    for _ in range(n):
+      c = step(*args)
+    float(c)
+    return time.perf_counter() - t0
+
+  run(1)
+  t1 = run(K)
+  t2 = run(2 * K)
+  print(f"{name:40s}: {(t2 - t1) / K * 1e3:8.2f} ms", flush=True)
+
+
+def main():
+  key = jax.random.PRNGKey(0)
+  rng = np.random.default_rng(0)
+  buf = jax.random.normal(key, (R, W), jnp.float32)
+  ids = jnp.asarray(rng.integers(0, R * RPP, N), jnp.int32)
+
+  def raw_gather(buf, ids):
+    g = jnp.take(buf, ids // RPP, axis=0, mode="fill", fill_value=0)
+    return jnp.sum(jnp.tanh(g[:, :1]))
+
+  timeit("raw phys-row gather [N,128]", raw_gather, buf, ids)
+
+  def onehot_extract(buf, ids):
+    grp, sub = ids // RPP, ids % RPP
+    g = jnp.take(buf, grp, axis=0, mode="fill", fill_value=0)
+    g = g.reshape(N, RPP, STRIDE)
+    oh = jax.nn.one_hot(sub, RPP, dtype=g.dtype)
+    out = jnp.einsum("nrs,nr->ns", g, oh)
+    return jnp.sum(jnp.tanh(out[:, :1]))
+
+  timeit("gather + one-hot einsum extract", onehot_extract, buf, ids)
+
+  def shift_select(buf, ids):
+    grp, sub = ids // RPP, ids % RPP
+    g = jnp.take(buf, grp, axis=0, mode="fill", fill_value=0)
+    out = jnp.zeros_like(g)
+    for j in range(RPP):
+      shifted = jnp.concatenate(
+          [g[:, j * STRIDE:], jnp.zeros((N, j * STRIDE), g.dtype)], axis=1)
+      out = jnp.where((sub == j)[:, None], shifted, out)
+    return jnp.sum(jnp.tanh(out[:, :1]))
+
+  timeit("gather + 4-way shift-select [N,128]", shift_select, buf, ids)
+
+  def take_along(buf, ids):
+    grp, sub = ids // RPP, ids % RPP
+    g = jnp.take(buf, grp, axis=0, mode="fill", fill_value=0)
+    g = g.reshape(N, RPP, STRIDE)
+    out = jnp.take_along_axis(g, sub[:, None, None], axis=1)[:, 0]
+    return jnp.sum(jnp.tanh(out[:, :1]))
+
+  timeit("gather + take_along_axis extract", take_along, buf, ids)
+
+  def extract_even_ids(buf, ids):
+    # lower bound: extraction with sub statically 0 (pure slice)
+    grp = ids // RPP
+    g = jnp.take(buf, grp, axis=0, mode="fill", fill_value=0)
+    return jnp.sum(jnp.tanh(g[:, :STRIDE][:, :1]))
+
+  timeit("gather + static slice (bound)", extract_even_ids, buf, ids)
+
+  # combine: sum over hotness 10 of [n, 10, 16] vs lane-friendly forms
+  nb = N // 10 * 10
+  rows16 = jax.random.normal(key, (nb // 10, 10, 16), jnp.float32)
+
+  def combine_naive(r):
+    return jnp.sum(jnp.tanh(jnp.sum(r, axis=1)[:, :1]))
+
+  timeit("combine sum [B,10,16] axis=1", combine_naive, rows16)
+
+  rows160 = jax.random.normal(key, (nb // 10, 160), jnp.float32)
+  sel = np.zeros((160, 16), np.float32)
+  for h in range(10):
+    sel[h * 16:(h + 1) * 16, :] = np.eye(16)
+  sel = jnp.asarray(sel)
+
+  def combine_matmul(r):
+    return jnp.sum(jnp.tanh((r @ sel)[:, :1]))
+
+  timeit("combine matmul [B,160]@[160,16]", combine_matmul, rows160)
+
+
+if __name__ == "__main__":
+  main()

@@ -1,0 +1,115 @@
+"""Tiny synthetic model step ablation: route / gather / combine / apply.
+
+Usage: python tools/profile_tiny_parts.py [batch]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributed_embeddings_tpu.layers.planner import DistEmbeddingStrategy
+from distributed_embeddings_tpu.models import (
+    SYNTHETIC_MODELS,
+    SyntheticModel,
+    bce_loss,
+    expand_tables,
+    generate_batch,
+)
+from distributed_embeddings_tpu.ops.packed_table import adagrad_rule
+from distributed_embeddings_tpu.parallel.lookup_engine import DistributedLookup
+from distributed_embeddings_tpu.training import init_sparse_state_direct
+
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+K = 4
+
+
+def main():
+  cfg = SYNTHETIC_MODELS["tiny"]
+  tables, tmap, hotness = expand_tables(cfg)
+  model = SyntheticModel(config=cfg, world_size=1)
+  plan = DistEmbeddingStrategy(tables, 1, "basic", input_table_map=tmap,
+                               dense_row_threshold=model.dense_row_threshold)
+  engine = DistributedLookup(plan)
+  rule = adagrad_rule(0.01)
+  layouts = engine.fused_layouts(rule)
+  numerical, cats, labels = generate_batch(cfg, BATCH, alpha=1.05, seed=0)
+  cats = [np.minimum(c, tables[t].input_dim - 1).astype(np.int32)
+          for c, t in zip(cats, tmap)]
+  cats = [jnp.asarray(c if h > 1 else c[:, 0])
+          for c, h in zip(cats, hotness)]
+  hotness_of = lambda i: hotness[i]  # noqa: E731
+
+  dummy_acts = [jnp.zeros((2, tables[t].output_dim), jnp.float32)
+                for t in tmap]
+  dense_params = model.init(jax.random.PRNGKey(0),
+                            jnp.asarray(numerical[:2]), [c[:2] for c in cats],
+                            emb_acts=dummy_acts)["params"]
+  state = init_sparse_state_direct(plan, rule, dense_params,
+                                   optax.adagrad(0.01), jax.random.PRNGKey(1))
+  fused = state["fused"]
+  jax.block_until_ready(fused)
+
+  def timeit(name, body):
+    step = jax.jit(body)
+    c = step(fused, jnp.zeros((), jnp.float32))
+    float(c)
+
+    def run(n, c):
+      t0 = time.perf_counter()
+      for _ in range(n):
+        c = step(fused, c)
+      float(c)
+      return time.perf_counter() - t0, c
+
+    _, c = run(1, c)
+    t1, c = run(K, c)
+    t2, c = run(2 * K, c)
+    print(f"{name:26s}: {(t2 - t1) / K * 1e3:8.2f} ms", flush=True)
+
+  def dep_cats(carry):
+    bump = (carry * 0).astype(jnp.int32)
+    return [c + bump for c in cats]
+
+  def route_only(fused, carry):
+    ids_all = engine.route_ids(dep_cats(carry), hotness_of)
+    s = sum((v[0] if isinstance(v, tuple) else v).sum()
+            for v in ids_all.values())
+    return carry + s.astype(jnp.float32) * 0
+
+  timeit("route_ids", route_only)
+
+  def gather_only(fused, carry):
+    ids_all = engine.route_ids(dep_cats(carry), hotness_of)
+    z, _ = engine.lookup_sparse_fused(fused, layouts, ids_all)
+    return carry + sum(zb.sum() for zb in z.values()).astype(jnp.float32) * 0
+
+  timeit("route+gather+combine", gather_only)
+
+  def fwd_all(fused, carry):
+    ids_all = engine.route_ids(dep_cats(carry), hotness_of)
+    z, _ = engine.lookup_sparse_fused(fused, layouts, ids_all)
+    acts = engine.finish_forward(z, state["emb_dense"], ids_all, BATCH,
+                                 hotness_of)
+    logits = model.apply({"params": state["dense"]},
+                         jnp.asarray(numerical), cats, emb_acts=acts)
+    return carry + bce_loss(logits, jnp.asarray(labels)) * 0
+
+  timeit("forward(loss)", fwd_all)
+
+  def apply_only(fused, carry):
+    ids_all = engine.route_ids(dep_cats(carry), hotness_of)
+    z, res = engine.lookup_sparse_fused(fused, layouts, ids_all)
+    d_z = {bk: zb * 1e-9 for bk, zb in z.items()}
+    new = engine.apply_sparse(fused, layouts, d_z, res, rule,
+                              jnp.zeros((), jnp.int32))
+    return carry + sum(v[0, 0] for v in new.values()).astype(jnp.float32) * 0
+
+  timeit("route+gather+apply", apply_only)
+
+
+if __name__ == "__main__":
+  main()
