@@ -122,8 +122,12 @@ class DistributedEmbedding(nn.Module):
   # Tables with input_dim <= dense_row_threshold are served by the MXU
   # one-hot path instead of HBM row gathers (see planner); 0 disables.
   dense_row_threshold: int = 0
-  # dp_input=False only: per global input id, its static hotness (must match
-  # what was passed to pack_mp_inputs). None = all one-hot.
+  # Per global input id, its static hotness. Used in BOTH input modes:
+  # the planner weighs it when balancing width-class generations so every
+  # backward scatter stays in XLA's fast regime (None falls back to
+  # inputs-per-table weights — pass it whenever hotness is known up
+  # front). With dp_input=False it is additionally REQUIRED to match what
+  # was passed to pack_mp_inputs. None = all one-hot.
   input_hotness: Optional[Sequence[int]] = None
 
   def __post_init__(self):
@@ -146,7 +150,9 @@ class DistributedEmbedding(nn.Module):
                                if self.input_table_map is not None else None),
               column_slice_threshold=self.column_slice_threshold,
               dense_row_threshold=self.dense_row_threshold,
-              row_slice_threshold=self.row_slice))
+              row_slice_threshold=self.row_slice,
+              input_hotness=(list(self.input_hotness)
+                             if self.input_hotness is not None else None)))
     return self._plan_cache
 
   @nn.compact

@@ -39,11 +39,13 @@ from .embedding import Embedding, TableConfig
 # (width, combiner, kind, gen) — kind is 'sparse' (row-gather path) or
 # 'dense' (small-vocab MXU one-hot path; see
 # DistEmbeddingStrategy.dense_row_threshold). gen splits one width class
-# into multiple fused buffers so each per-rank buffer stays under
-# ``max_class_bytes``: XLA inserts a full copy of any >= 4 GiB buffer on
-# every use (2^32-byte addressing), which would cost two multi-GiB copies
-# per train step under unbounded fusion. Every input's ids statically
-# target exactly one generation, so the split adds no per-index work.
+# into multiple fused buffers, bounded hard by XLA's 2^31-element buffer
+# indexing and soft by ``max_class_bytes``. (Round-3 measurement retired
+# the earlier >=4 GiB copy-on-use fear: a donated 6.0 GB buffer
+# scatter-adds at 20.6 ns/row, identical to small buffers.) Every input's
+# ids statically target exactly one generation, so the split adds no
+# per-index work; generation COMPOSITION is chosen to keep each backward
+# scatter in XLA's fast regime — see _assign_generations.
 ClassKey = Tuple[int, Optional[str], str, int]
 
 
@@ -293,8 +295,9 @@ class DistEmbeddingStrategy:
                input_table_map: Optional[Sequence[int]] = None,
                column_slice_threshold: Optional[int] = None,
                dense_row_threshold: int = 0,
-               max_class_bytes: int = 2 * 1024 ** 3,
-               row_slice_threshold: Optional[int] = None):
+               max_class_bytes: int = 3 * 1024 ** 3,
+               row_slice_threshold: Optional[int] = None,
+               input_hotness: Optional[Sequence[int]] = None):
     if strategy not in ("basic", "memory_balanced", "memory_optimized"):
       raise ValueError(f"Unsupported shard strategy {strategy}")
     self.strategy = "basic" if world_size == 1 else strategy
@@ -312,6 +315,11 @@ class DistEmbeddingStrategy:
       input_table_map = list(range(num_tables))
     self.input_table_map = list(input_table_map)
     self.num_inputs = len(self.input_table_map)
+    if input_hotness is not None and len(input_hotness) != self.num_inputs:
+      raise ValueError(
+          f"input_hotness has {len(input_hotness)} entries for "
+          f"{self.num_inputs} inputs")
+    self.input_hotness = None if input_hotness is None else list(input_hotness)
 
     # ---- column slicing --------------------------------------------------
     self.column_slice_threshold = column_slice_threshold
@@ -417,26 +425,42 @@ class DistEmbeddingStrategy:
                       for shards in self.rank_shards]
 
     # ---- per-rank inputs + width-class fusion ----------------------------
-    # Generation assignment (first-fit per rank): cap each rank's fused
-    # buffer at max_class_bytes of simple-layout f32 (the packed layout
-    # doubles this per optimizer-state slot — one aux slot lands just
-    # under XLA's 4 GiB copy-on-use threshold at the 2 GiB default). A
-    # single shard larger than the cap gets a generation of its own.
+    # Generation assignment. A width class bigger than one TPU buffer can
+    # hold (2^31 elements — XLA's 32-bit buffer indexing) splits into
+    # generations, each a separate buffer with its own gather and backward
+    # scatter. Two measured facts drive the assignment
+    # (tools/profile_scatter_regimes.py, docs/BENCHMARKS.md):
+    #
+    # 1. XLA's scatter-add has two regimes: a fast path at ~16-25 ns/row
+    #    it only picks when the id stream is a large enough fraction of
+    #    the buffer's rows (>= ~0.15 ids/row empirically — raw buffer
+    #    bytes do NOT matter), and a ~75 ns/row serial path otherwise.
+    #    First-fit in table order packed the Tiny model's nine 1-hot
+    #    1M-row tables into a generation of their own: a 590k-id stream
+    #    over 8.25M physical rows (ratio 0.07) ran at 74.7 ns — 44
+    #    ms/step, traced — while a mixed assignment keeps every
+    #    generation's scatter in the fast regime.
+    # 2. Gather cost is flat in buffer size, so fewer+bigger generations
+    #    are otherwise free.
+    #
+    # The assignment therefore MAXIMIZES THE MINIMUM ids/rows ratio over
+    # generations: try every feasible generation count from the capped
+    # minimum up, balance each by expected id traffic (input_hotness when
+    # known, else inputs-per-table), and keep the best. Generations never
+    # exceed max_class_bytes (min'd with the element limit) unless a
+    # single shard alone does.
     self.max_class_bytes = max_class_bytes
+    occ_of = [0.0] * num_tables
+    for i, t in enumerate(self.input_table_map):
+      occ_of[t] += (self.input_hotness[i] if self.input_hotness is not None
+                    else 1)
     for shards in self.rank_shards:
-      gen_rows: Dict[tuple, List[int]] = {}
+      by_base: Dict[tuple, List] = {}
       for sh in shards:
-        base = (sh.width, sh.combiner, self._kind_of(sh))
-        rows_list = gen_rows.setdefault(base, [0])
-        cap_rows = max(1, max_class_bytes // (sh.width * 4))
-        for g, r in enumerate(rows_list):
-          if r == 0 or r + sh.input_dim <= cap_rows:
-            sh.gen = g
-            rows_list[g] += sh.input_dim
-            break
-        else:
-          sh.gen = len(rows_list)
-          rows_list.append(sh.input_dim)
+        by_base.setdefault(
+            (sh.width, sh.combiner, self._kind_of(sh)), []).append(sh)
+      for base, group in by_base.items():
+        self._assign_generations(base[0], group, occ_of)
 
     class_keys: List[ClassKey] = []
     for shards in self.rank_shards:
@@ -543,6 +567,71 @@ class DistEmbeddingStrategy:
     ]
 
   # ---- convenience -------------------------------------------------------
+  def _assign_generations(self, width: int, group: List,
+                          occ_of: Sequence[float]) -> None:
+    """Set ``sh.gen`` for one (width, combiner, kind) shard group.
+
+    Tries every feasible generation count from the capped minimum
+    (``max_class_bytes``, min'd with the 2^31-element buffer limit under a
+    one-aux packed layout) upward; within a count, shards are handed out
+    in descending occurrence-weight order to the generation with the
+    least weight so far (ties: fewest rows). Keeps the assignment
+    maximizing the minimum occurrence-weight / physical-rows ratio — the
+    quantity that decides the backward scatter's regime. See __init__ for
+    the measured rationale."""
+    # per-logical-row element count under a 1-aux packed layout (the common
+    # training case; n_aux is unknown at plan time — assuming 1 is
+    # conservative for SGD and exact for Adagrad)
+    stride = width * 2
+    rpp = max(1, 128 // stride)
+    phys_width = max(128, -(-stride // 128) * 128)
+    elems_per_row = phys_width / rpp
+    rows_hard = max(1, int((2 ** 31) // elems_per_row))
+    cap_rows = min(rows_hard,
+                   max(1, self.max_class_bytes // (width * 4)))
+    total = sum(sh.input_dim for sh in group)
+    largest = max(sh.input_dim for sh in group)
+    n_min = max(1, -(-total // cap_rows))
+    order = sorted(group, key=lambda sh: (-occ_of[sh.table_id],
+                                          -sh.input_dim, sh.table_id))
+
+    def attempt(n_bins):
+      # row target balances bins; a shard over the cap only lands in an
+      # empty bin (its generation may then exceed the cap — unavoidable
+      # without row-slicing the table)
+      rows_cap = min(cap_rows, max(-(-total // n_bins) * 21 // 20, largest))
+      bins = [[0, 0.0] for _ in range(n_bins)]  # [rows, occ]
+      assign = {}
+      for sh in order:
+        cands = [g for g in range(n_bins)
+                 if bins[g][0] + sh.input_dim <= rows_cap or bins[g][0] == 0]
+        if not cands:
+          return None, -1.0
+        best = min(cands, key=lambda g: (bins[g][1], bins[g][0]))
+        assign[id(sh)] = best
+        bins[best][0] += sh.input_dim
+        bins[best][1] += occ_of[sh.table_id]
+      score = min((o / max(1.0, r / rpp) if r else float("inf"))
+                  for r, o in bins)
+      return assign, score
+
+    best_assign, best_score = None, -1.0
+    for n_bins in range(n_min, n_min + 7):
+      assign, score = attempt(n_bins)
+      # strict > : equal-regime ties keep FEWER generations (fewer
+      # gather/scatter launches and routing tensors)
+      if assign is not None and score > best_score:
+        best_assign, best_score = assign, score
+    if best_assign is None:  # pathological: give every shard its own gen
+      for g, sh in enumerate(order):
+        sh.gen = g
+      return
+    # renumber generations densely in first-appearance order (stable names)
+    remap: Dict[int, int] = {}
+    for sh in group:
+      b = best_assign[id(sh)]
+      sh.gen = remap.setdefault(b, len(remap))
+
   def _kind_of(self, shard: Shard) -> str:
     # row shards always take the gather path: the one-hot window trick
     # assumes slot-local ids cover the full table from offset 0

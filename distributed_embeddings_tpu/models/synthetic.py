@@ -221,7 +221,7 @@ class SyntheticModel(nn.Module):
         dp_input=self.dp_input,
         input_table_map=tuple(input_table_map),
         world_size=self.world_size,
-        input_hotness=None if self.dp_input else tuple(self._hotness),
+        input_hotness=tuple(self._hotness),
         dense_row_threshold=self.dense_row_threshold,
         name="embeddings")
     self.mlp = MLP(tuple(self.config.mlp_sizes) + (1,),

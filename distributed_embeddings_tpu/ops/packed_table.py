@@ -32,6 +32,7 @@ drops).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import List, Sequence
 
 import jax
@@ -39,6 +40,11 @@ import jax.numpy as jnp
 import numpy as np
 
 LANES = 128
+
+# Read ONCE at import (baking an os.environ.get into a jitted trace makes
+# later flips silently ineffective — advisor finding, round 2). Override of
+# the narrow-class gather chunk size; 0 = keep the call-site default.
+_GATHER_CHUNK_ENV = int(os.environ.get("DE_TPU_GATHER_CHUNK", "0") or "0")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -255,24 +261,37 @@ def gather_fused(layout: PackedLayout, buf: jax.Array,
   rpp = layout.rows_per_phys
   if rpp == 1:
     return g[..., :layout.stride]
-  g = g[..., :rpp * layout.stride].reshape(ids.shape + (rpp, layout.stride))
-  oh = jax.nn.one_hot(sub, rpp, dtype=g.dtype)
-  return jnp.einsum("...rs,...r->...s", g, oh)
+  # sub-row extraction as unrolled static-lane-window selects: exactly one
+  # window is live per occurrence, so summing the masked windows extracts
+  # it. Pure VPU ops on static lane slices — no one-hot einsum (matmul-
+  # shaped contraction) and no cross-lane reshape (relayout copy).
+  stride = layout.stride
+  out = None
+  for s in range(rpp):
+    part = jnp.where((sub == s)[..., None],
+                     g[..., s * stride:(s + 1) * stride], 0)
+    out = part if out is None else out + part
+  return out
 
 
 def gather_fused_chunked(layout: PackedLayout, buf: jax.Array,
-                         ids: jax.Array, chunk: int = 1 << 20) -> jax.Array:
+                         ids: jax.Array, chunk: int = 1 << 21) -> jax.Array:
   """:func:`gather_fused` with bounded temporaries.
 
   When ``rows_per_phys == 1`` (stride >= 128 lanes — e.g. the width-128
   DLRM tables) a fused gather is a single XLA row gather with no staging
   beyond its own output, so it runs one-shot regardless of size. Narrow
-  rows (``rpp > 1``) stage ``[N, phys_width]`` (512 B per id) plus the
-  sub-row-select einsum chain — several GiB at benchmark batch sizes — so
-  they run as a ``lax.map`` over fixed-size id chunks, which bounds live
-  temporaries to one chunk at identical row-op cost (indexed ops are
-  row-bound, not launch-bound).
+  rows (``rpp > 1``) stage ``[N, phys_width]`` (512 B per id) for the
+  lane-window selects — over a GiB at benchmark batch sizes — so large
+  streams run as a ``lax.map`` over fixed-size id chunks, which bounds
+  live temporaries to one chunk at identical row-op cost (indexed ops are
+  row-bound, not launch-bound). The ``lax.map`` does add a sequential
+  dynamic-update-slice per chunk (~10 ms at Tiny scale, traced), so the
+  default chunk keeps typical per-bucket streams (<= 2M ids) one-shot;
+  ``DE_TPU_GATHER_CHUNK`` overrides.
   """
+  if _GATHER_CHUNK_ENV:
+    chunk = _GATHER_CHUNK_ENV
   flat = ids.reshape(-1)
   n = flat.shape[0]
   if layout.rows_per_phys == 1 or n <= chunk:

@@ -225,7 +225,12 @@ class SparseResiduals:
   the optimizer-state rows that rode along in the forward gather."""
 
   ids_all: Dict[tuple, jax.Array]  # bk -> [n_b, G, h]
-  aux_rows: Dict[tuple, jax.Array]  # bk -> [n_b, G, h, n_aux*w] (may be empty)
+  # bk -> [n_b, G, h, stride]: the RAW fused gather rows (table + aux lanes)
+  # when the rule has aux state, else an empty [..., 0] slice. The apply
+  # slices the aux lanes off inside the delta computation, where the slice
+  # fuses with the rule math instead of costing a per-occurrence relayout
+  # right after the gather (measured ~25 ns/row, tools/profile_tiny_buckets).
+  aux_rows: Dict[tuple, jax.Array]
 
   def tree_flatten(self):
     ik = sorted(self.ids_all)
@@ -619,18 +624,28 @@ class DistributedLookup:
 
   def _z_sparse_fused(self, key, layout: PackedLayout, buf_local: jax.Array,
                       ids_all: jax.Array, rs: bool = False):
-    """Fused gather: returns (z, aux_rows) — optimizer state rides along."""
+    """Fused gather: returns (z, fused_rows) — optimizer state rides along.
+
+    The combine sums the FULL fused stride (table + aux lanes together) and
+    slices the table half at bag granularity; the per-occurrence residual is
+    the raw gather output, whose aux lanes the apply slices off inside the
+    delta computation (where it fuses with the rule math). Per-occurrence
+    lane splits right after the gather measured ~25 ns/row on v5e
+    (`tools/profile_tiny_buckets.py`) — at bag granularity they are ~free."""
+    w = layout.width
     if isinstance(ids_all, tuple):  # ragged value stream
       vals, lens = ids_all
       fused = gather_fused_chunked(layout, buf_local, vals)
-      w = layout.width
-      return (self._combine_ragged(fused[..., :w], vals, lens, key),
-              fused[..., w:])
+      aux = fused if layout.n_aux else fused[..., w:]
+      return self._combine_ragged(fused[..., :w], vals, lens, key), aux
     fused = gather_fused_chunked(layout, buf_local, ids_all)  # [n_b,G,h,stride]
-    w = layout.width
-    rows = fused[..., :w]
-    aux = fused[..., w:]
-    return self._combine(rows, ids_all, key, rs), aux
+    if layout.n_aux == 0:
+      # stride == width: no aux lanes ride along, nothing to defer
+      return self._combine(fused, ids_all, key, rs), fused[..., w:]
+    if ids_all.ndim == 2 or ids_all.shape[-1] == 1:
+      return self._combine(fused[..., :w], ids_all, key, rs), fused
+    zf = self._combine(fused, ids_all, key, rs)  # [n_b, G, stride]
+    return zf[..., :w], fused
 
   # ---- mp -> dp exchange + assembly --------------------------------------
   def exchange(self, z: Dict[tuple, jax.Array], batch_local: int
@@ -886,6 +901,14 @@ class DistributedLookup:
     from ..ops.sparse_grad import dedup_rows
 
     plan = self.plan
+
+    def aux_occ(aux, layout):
+      """Residual fused rows -> per-occurrence aux rows [-1, n_aux, w]."""
+      if aux is None or not rule.n_aux:
+        return None
+      flat = aux.reshape(-1, layout.stride)
+      return flat[:, layout.width:].reshape(-1, rule.n_aux, layout.width)
+
     by_class: Dict[str, list] = {}
     for bk, dzb in d_z.items():
       key, h = bk.class_key, bk.h
@@ -961,8 +984,7 @@ class DistributedLookup:
             if h > 1:
               g = jnp.broadcast_to(g[:, None, :],
                                    (n // h, h, w)).reshape(n, w)
-            aux_r = (aux.reshape(-1, rule.n_aux, w) if aux is not None
-                     else None)
+            aux_r = aux_occ(aux, layout)
             all_ids.append(ids.reshape(-1))
             all_deltas.append(rule.delta(g, aux_r, step))
           ids_cat = (all_ids[0] if len(all_ids) == 1
@@ -988,8 +1010,7 @@ class DistributedLookup:
             n = int(np.prod(ids.shape))
             ids_f = ids.reshape(-1)
             dz_f = dzb.reshape(-1, w)
-            aux_f = (aux.reshape(-1, rule.n_aux, w) if aux is not None
-                     else None)
+            aux_f = aux_occ(aux, layout)
             hh = max(1, h)  # h == 0: ragged parts arrive pre-expanded
             chunk = max(hh, (self.apply_chunk // hh) * hh)
             for c0 in range(0, n, chunk):

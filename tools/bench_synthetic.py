@@ -53,7 +53,8 @@ def main():
     # the scaled run measures a different workload
     thr = max(8, int(thr * SCALE))
   plan = DistEmbeddingStrategy(tables, 1, "basic", input_table_map=tmap,
-                               dense_row_threshold=thr)
+                               dense_row_threshold=thr,
+                               input_hotness=hotness)
 
   batches = []
   for i in range(2):

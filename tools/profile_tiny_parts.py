@@ -32,7 +32,8 @@ def main():
   tables, tmap, hotness = expand_tables(cfg)
   model = SyntheticModel(config=cfg, world_size=1)
   plan = DistEmbeddingStrategy(tables, 1, "basic", input_table_map=tmap,
-                               dense_row_threshold=model.dense_row_threshold)
+                               dense_row_threshold=model.dense_row_threshold,
+                               input_hotness=hotness)
   engine = DistributedLookup(plan)
   rule = adagrad_rule(0.01)
   layouts = engine.fused_layouts(rule)
