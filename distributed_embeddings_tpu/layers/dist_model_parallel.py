@@ -129,6 +129,10 @@ class DistributedEmbedding(nn.Module):
   # front). With dp_input=False it is additionally REQUIRED to match what
   # was passed to pack_mp_inputs. None = all one-hot.
   input_hotness: Optional[Sequence[int]] = None
+  # Expected per-step GLOBAL batch (optional): lets the planner score
+  # generation layouts with its measured scatter-regime cost model instead
+  # of ratio balancing alone (see planner._assign_generations).
+  batch_hint: Optional[int] = None
 
   def __post_init__(self):
     super().__post_init__()
@@ -152,7 +156,8 @@ class DistributedEmbedding(nn.Module):
               dense_row_threshold=self.dense_row_threshold,
               row_slice_threshold=self.row_slice,
               input_hotness=(list(self.input_hotness)
-                             if self.input_hotness is not None else None)))
+                             if self.input_hotness is not None else None),
+              batch_hint=self.batch_hint))
     return self._plan_cache
 
   @nn.compact

@@ -297,7 +297,8 @@ class DistEmbeddingStrategy:
                dense_row_threshold: int = 0,
                max_class_bytes: int = 3 * 1024 ** 3,
                row_slice_threshold: Optional[int] = None,
-               input_hotness: Optional[Sequence[int]] = None):
+               input_hotness: Optional[Sequence[int]] = None,
+               batch_hint: Optional[int] = None):
     if strategy not in ("basic", "memory_balanced", "memory_optimized"):
       raise ValueError(f"Unsupported shard strategy {strategy}")
     self.strategy = "basic" if world_size == 1 else strategy
@@ -320,6 +321,10 @@ class DistEmbeddingStrategy:
           f"input_hotness has {len(input_hotness)} entries for "
           f"{self.num_inputs} inputs")
     self.input_hotness = None if input_hotness is None else list(input_hotness)
+    # expected per-step GLOBAL batch (optional): lets the generation
+    # assignment evaluate the scatter-regime cost model on absolute id
+    # counts instead of only balancing ratios — see _assign_generations
+    self.batch_hint = batch_hint
 
     # ---- column slicing --------------------------------------------------
     self.column_slice_threshold = column_slice_threshold
@@ -615,13 +620,54 @@ class DistEmbeddingStrategy:
                   for r, o in bins)
       return assign, score
 
-    best_assign, best_score = None, -1.0
+    candidates = []  # (assign dict, bins [rows, occ] list)
     for n_bins in range(n_min, n_min + 7):
       assign, score = attempt(n_bins)
-      # strict > : equal-regime ties keep FEWER generations (fewer
+      if assign is not None:
+        candidates.append((assign, score, n_bins))
+
+    if self.batch_hint is None:
+      # no absolute id counts: keep the best-balanced candidate
+      # (strict > : equal-regime ties keep FEWER generations — fewer
       # gather/scatter launches and routing tensors)
-      if assign is not None and score > best_score:
-        best_assign, best_score = assign, score
+      best_assign, best_score = None, -1.0
+      for assign, score, _ in candidates:
+        if score > best_score:
+          best_assign, best_score = assign, score
+    else:
+      # absolute id counts known: score every candidate with the measured
+      # cost model (fast sorted-scatter path at >= ~0.15 ids/physical-row,
+      # else the ~75 ns serial path) and also try a CONCENTRATION layout —
+      # when traffic is scarce (small batch, huge vocabularies) no
+      # balanced split reaches the fast regime, but packing the heavy
+      # multi-hot streams together can carry most ids at fast-path cost
+      # while quarantining low-traffic giants into few slow generations.
+      T, NS_FAST, NS_SLOW = 0.15, 20.0, 75.0
+      b = float(self.batch_hint)
+
+      def cost_of(assign):
+        bins: Dict[int, List[float]] = {}
+        for sh in group:
+          g = assign[id(sh)]
+          bins.setdefault(g, [0.0, 0.0])
+          bins[g][0] += sh.input_dim
+          bins[g][1] += occ_of[sh.table_id]
+        total_ns = 0.0
+        for r, o in bins.values():
+          ids = o * b
+          ratio = ids / max(1.0, r / rpp)
+          total_ns += ids * (NS_FAST if ratio >= T else NS_SLOW)
+        return total_ns
+
+      conc = self._concentrate(group, occ_of, b, rpp, cap_rows, T)
+      if conc is not None:
+        candidates.append((conc, 0.0, -1))
+      best_assign, best_cost = None, float("inf")
+      for assign, _, _ in candidates:
+        c = cost_of(assign)
+        if c < best_cost:
+          best_assign, best_cost = assign, c
+
     if best_assign is None:  # pathological: give every shard its own gen
       for g, sh in enumerate(order):
         sh.gen = g
@@ -629,8 +675,50 @@ class DistEmbeddingStrategy:
     # renumber generations densely in first-appearance order (stable names)
     remap: Dict[int, int] = {}
     for sh in group:
-      b = best_assign[id(sh)]
-      sh.gen = remap.setdefault(b, len(remap))
+      bnum = best_assign[id(sh)]
+      sh.gen = remap.setdefault(bnum, len(remap))
+
+  @staticmethod
+  def _concentrate(group, occ_of, batch, rpp, cap_rows, threshold):
+    """Concentration generation layout: greedy fast-generation packing in
+    traffic-density order, then first-fit-decreasing for the slow pool."""
+    dens = lambda sh: (occ_of[sh.table_id] * batch  # noqa: E731
+                       / max(1.0, sh.input_dim / rpp))
+    order = sorted(group, key=lambda sh: (-dens(sh), sh.table_id))
+    assign = {}
+    bins: List[List[float]] = []  # [rows, ids]
+    cur = None
+    slow = []
+    for sh in order:
+      ids = occ_of[sh.table_id] * batch
+      if cur is not None:
+        r, i = bins[cur]
+        if (r + sh.input_dim <= cap_rows
+            and (i + ids) / ((r + sh.input_dim) / rpp) >= threshold):
+          assign[id(sh)] = cur
+          bins[cur][0] += sh.input_dim
+          bins[cur][1] += ids
+          continue
+      if (sh.input_dim <= cap_rows
+          and ids / max(1.0, sh.input_dim / rpp) >= threshold):
+        cur = len(bins)
+        bins.append([sh.input_dim, ids])
+        assign[id(sh)] = cur
+      else:
+        slow.append(sh)
+    # slow pool: plain FFD by rows (composition cannot change its regime)
+    for sh in sorted(slow, key=lambda s: (-s.input_dim, s.table_id)):
+      placed = False
+      for g in range(len(bins)):
+        if bins[g][1] == -1 and bins[g][0] + sh.input_dim <= cap_rows:
+          assign[id(sh)] = g
+          bins[g][0] += sh.input_dim
+          placed = True
+          break
+      if not placed:
+        assign[id(sh)] = len(bins)
+        bins.append([sh.input_dim, -1])
+    return assign if assign else None
 
   def _kind_of(self, shard: Shard) -> str:
     # row shards always take the gather path: the one-hot window trick

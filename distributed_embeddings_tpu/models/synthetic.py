@@ -210,6 +210,8 @@ class SyntheticModel(nn.Module):
   compute_dtype: Any = jnp.float32
   # small-vocab tables ride the MXU one-hot path (see planner)
   dense_row_threshold: int = 2048
+  # expected global batch (feeds the planner's scatter-regime cost model)
+  batch_hint: Optional[int] = None
 
   def setup(self):
     tables, input_table_map, self._hotness = expand_tables(self.config)
@@ -223,6 +225,7 @@ class SyntheticModel(nn.Module):
         world_size=self.world_size,
         input_hotness=tuple(self._hotness),
         dense_row_threshold=self.dense_row_threshold,
+        batch_hint=self.batch_hint,
         name="embeddings")
     self.mlp = MLP(tuple(self.config.mlp_sizes) + (1,),
                    dtype=self.compute_dtype, name="mlp")

@@ -33,7 +33,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -42,8 +42,9 @@ import numpy as np
 LANES = 128
 
 # Read ONCE at import (baking an os.environ.get into a jitted trace makes
-# later flips silently ineffective — advisor finding, round 2). Override of
-# the narrow-class gather chunk size; 0 = keep the call-site default.
+# later flips silently ineffective — advisor finding, round 2). Overrides
+# gather_fused_chunked's DEFAULT chunk size (never an explicit argument);
+# 0/unset = the built-in default.
 _GATHER_CHUNK_ENV = int(os.environ.get("DE_TPU_GATHER_CHUNK", "0") or "0")
 
 
@@ -275,7 +276,8 @@ def gather_fused(layout: PackedLayout, buf: jax.Array,
 
 
 def gather_fused_chunked(layout: PackedLayout, buf: jax.Array,
-                         ids: jax.Array, chunk: int = 1 << 21) -> jax.Array:
+                         ids: jax.Array,
+                         chunk: Optional[int] = None) -> jax.Array:
   """:func:`gather_fused` with bounded temporaries.
 
   When ``rows_per_phys == 1`` (stride >= 128 lanes — e.g. the width-128
@@ -290,8 +292,8 @@ def gather_fused_chunked(layout: PackedLayout, buf: jax.Array,
   default chunk keeps typical per-bucket streams (<= 2M ids) one-shot;
   ``DE_TPU_GATHER_CHUNK`` overrides.
   """
-  if _GATHER_CHUNK_ENV:
-    chunk = _GATHER_CHUNK_ENV
+  if chunk is None:  # env overrides the DEFAULT only, never an explicit arg
+    chunk = _GATHER_CHUNK_ENV or (1 << 21)
   flat = ids.reshape(-1)
   n = flat.shape[0]
   if layout.rows_per_phys == 1 or n <= chunk:

@@ -1,0 +1,45 @@
+"""Shared microbenchmark harness for the tools/ profilers.
+
+Sync discipline (load-bearing): under the axon TPU tunnel,
+``jax.block_until_ready`` can return before queued work drains (observed:
+0.08 ms "sync", then an 85 s fetch). The only reliable sync is FETCHING a
+scalar, so every timing here ends with a host fetch of one element.
+
+Timing: chained steps at two chain lengths, differenced, so dispatch/RTT
+overheads cancel. With ``donate=True`` the first positional argument is
+donated and the chain carries its successor.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def sync(x):
+  """Reliable device sync: fetch one scalar (see module docstring)."""
+  leaf = jax.tree_util.tree_leaves(x)[0]
+  float(jnp.asarray(leaf).ravel()[0])
+
+
+def timeit(name, fn, first, *args, donate=True, n_norm=None, reps=5):
+  """Time ``fn(first, *args)`` chained; print ms (and ns/elem). Returns the
+  final carry (with donation the input is consumed — keep the carry)."""
+  step = jax.jit(fn, donate_argnums=(0,) if donate else ())
+  carry = step(first, *args)
+  sync(carry)
+
+  def run(n, carry):
+    t0 = time.perf_counter()
+    for _ in range(n):
+      carry = step(carry, *args)
+    sync(carry)
+    return time.perf_counter() - t0, carry
+
+  _, carry = run(1, carry)
+  t1, carry = run(reps, carry)
+  t2, carry = run(2 * reps, carry)
+  dt = (t2 - t1) / reps
+  per = f"  {dt / n_norm * 1e9:6.1f} ns/elem" if n_norm else ""
+  print(f"{name:56s}: {dt * 1e3:8.2f} ms{per}", flush=True)
+  return carry

@@ -54,7 +54,7 @@ def main():
     thr = max(8, int(thr * SCALE))
   plan = DistEmbeddingStrategy(tables, 1, "basic", input_table_map=tmap,
                                dense_row_threshold=thr,
-                               input_hotness=hotness)
+                               input_hotness=hotness, batch_hint=BATCH)
 
   batches = []
   for i in range(2):

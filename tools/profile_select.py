@@ -79,30 +79,14 @@ def main():
     timeit(name, step, jnp.zeros((), jnp.float32), delta32, sub,
            donate=False, n_norm=n)
 
-  if False:
-    run_exp("expand einsum only (today)", exp_einsum)
-    run_exp("expand where-select only", exp_where)
-
   # numerics check
   a = exp_einsum(delta32[:1024], sub[:1024])
   b = exp_where(delta32[:1024], sub[:1024])
   print(f"  expand parity: {float(jnp.max(jnp.abs(a - b))):.2e}")
 
-  # --- expansion + scatter (the real apply tail) -------------------------
-  def apply_einsum(buf, g, s, d):
-    up = exp_einsum(d, s)
-    g2, up = jax.lax.optimization_barrier((g, up))
-    return buf.at[g2].add(up, mode="drop")
-
-  def apply_where(buf, g, s, d):
-    up = exp_where(d, s)
-    g2, up = jax.lax.optimization_barrier((g, up))
-    return buf.at[g2].add(up, mode="drop")
-
-  def apply_where_nobar(buf, g, s, d):
-    return buf.at[g].add(exp_where(d, s), mode="drop")
-
-
+  # (expansion+scatter variants were measured on TPU and recorded in
+  # docs/BENCHMARKS.md: einsum+scatter 22.2 ns/elem vs where+scatter
+  # 25.3 — the einsum form fuses better into the scatter and was kept.)
 
   # --- extraction: gather + sub-row select + 10-hot combine --------------
   buf_g = jnp.zeros((LAYOUT.phys_rows + 1, 128), jnp.float32)

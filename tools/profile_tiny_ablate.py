@@ -9,7 +9,6 @@ tool times the real fused train step with pieces surgically removed:
   no_model      : loss = mean(z_sparse) directly (no dense path/MLP/interact)
   no_gather     : z_sparse/residual aux replaced by zeros (routing + apply
                   with dummy deltas; gather cost removed)
-  no_route      : ids_all built from pre-routed constants fed as inputs
 
 Usage: PYTHONPATH=/root/repo:/root/.axon_site python -u tools/profile_tiny_ablate.py [model] [batch]
 """
@@ -45,7 +44,7 @@ def main():
   model = SyntheticModel(config=cfg, world_size=1)
   plan = DistEmbeddingStrategy(tables, 1, "basic", input_table_map=tmap,
                                dense_row_threshold=model.dense_row_threshold,
-                               input_hotness=hotness)
+                               input_hotness=hotness, batch_hint=BATCH)
   engine = DistributedLookup(plan)
   rule = adagrad_rule(0.01)
   layouts = engine.fused_layouts(rule)

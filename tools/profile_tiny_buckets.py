@@ -68,7 +68,7 @@ def main():
   model = SyntheticModel(config=cfg, world_size=1)
   plan = DistEmbeddingStrategy(tables, 1, "basic", input_table_map=tmap,
                                dense_row_threshold=model.dense_row_threshold,
-                               input_hotness=hotness)
+                               input_hotness=hotness, batch_hint=BATCH)
   engine = DistributedLookup(plan)
   rule = adagrad_rule(0.01)
   layouts = engine.fused_layouts(rule)
