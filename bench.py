@@ -71,7 +71,8 @@ def run(batch_size: int) -> float:
                compute_dtype=jnp.bfloat16 if AMP else jnp.float32)
   plan = DistEmbeddingStrategy(
       [dict(input_dim=v, output_dim=128, combiner=None) for v in vocab],
-      1, "basic", dense_row_threshold=model.dense_row_threshold)
+      1, "basic", dense_row_threshold=model.dense_row_threshold,
+      batch_hint=batch_size)
 
   rng = np.random.default_rng(0)
   numerical = jnp.asarray(rng.standard_normal((batch_size, 13)), jnp.float32)

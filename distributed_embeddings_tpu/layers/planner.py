@@ -656,7 +656,10 @@ class DistEmbeddingStrategy:
         for r, o in bins.values():
           ids = o * b
           ratio = ids / max(1.0, r / rpp)
-          total_ns += ids * (NS_FAST if ratio >= T else NS_SLOW)
+          # ~0.2 ms fixed cost per generation (its own gather + scatter
+          # launch and routing tensors) breaks regime-cost ties toward
+          # fewer, larger generations
+          total_ns += ids * (NS_FAST if ratio >= T else NS_SLOW) + 200_000.0
         return total_ns
 
       conc = self._concentrate(group, occ_of, b, rpp, cap_rows, T)

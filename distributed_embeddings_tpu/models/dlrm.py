@@ -182,6 +182,9 @@ class DLRM(nn.Module):
   # the measured crossover on v5e where the windowed one-hot matmul
   # (fwd + bwd) still beats gather + scatter-apply for a 65k batch
   dense_row_threshold: int = 4096
+  # expected global batch (feeds the planner's scatter-regime cost model);
+  # pass the same value to dlrm_embedding_plan for a matching plan
+  batch_hint: Optional[int] = None
 
   def setup(self):
     if self.bottom_mlp[-1] != self.embedding_dim:
@@ -200,6 +203,7 @@ class DLRM(nn.Module):
         dp_input=self.dp_input,
         world_size=self.world_size,
         dense_row_threshold=self.dense_row_threshold,
+        batch_hint=self.batch_hint,
         name="embeddings")
     self.bottom = MLP(self.bottom_mlp, activate_final=True,
                       dtype=self.compute_dtype, name="bottom_mlp")
@@ -226,7 +230,8 @@ def dlrm_embedding_plan(vocab_sizes, embedding_dim: int = 128,
                         world_size: int = 1, strategy: str = "basic",
                         column_slice_threshold: Optional[int] = None,
                         dense_row_threshold: int = 4096,
-                        row_slice: Optional[int] = None):
+                        row_slice: Optional[int] = None,
+                        batch_hint: Optional[int] = None):
   """The placement plan a :class:`DLRM`'s embeddings use (for
   get_weights/set_weights on the ``embeddings`` param subtree)."""
   from ..layers.planner import DistEmbeddingStrategy
@@ -236,7 +241,8 @@ def dlrm_embedding_plan(vocab_sizes, embedding_dim: int = 128,
   return DistEmbeddingStrategy(tables, world_size, strategy,
                                column_slice_threshold=column_slice_threshold,
                                dense_row_threshold=dense_row_threshold,
-                               row_slice_threshold=row_slice)
+                               row_slice_threshold=row_slice,
+                               batch_hint=batch_hint)
 
 
 def _dlrm_initializer(rows: int):

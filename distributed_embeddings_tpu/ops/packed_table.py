@@ -318,21 +318,21 @@ def _use_pallas_apply() -> bool:
 
 def scatter_add_fused(layout: PackedLayout, buf: jax.Array, ids: jax.Array,
                       fused_delta: jax.Array,
-                      few_duplicates: bool = False) -> jax.Array:
+                      prefer_pallas: bool = False) -> jax.Array:
   """``buf[ids] += fused_delta`` (one indexed RMW for table + all aux).
 
   ``fused_delta``: ``[..., stride]`` additive deltas in gather_fused's lane
   order. Duplicate ids accumulate; OOB ids are dropped. Donate ``buf`` at
   the jit boundary for an in-place update.
 
-  Lowering (measured on v5e, `docs/BENCHMARKS.md`): the two backends win
-  in opposite regimes. XLA's scatter runs ~75 ns/row on near-unique id
-  streams but ~23 ns/row on heavily duplicated (power-law multi-hot) ones;
-  the Pallas RMW cache kernel (`ops/pallas_apply.py`) is ~55 ns/row
-  regardless. Callers that know the stream is near-unique (e.g. one-hot
-  inputs over large vocabularies) pass ``few_duplicates=True`` to pick the
-  Pallas kernel; the default keeps XLA. ``DE_TPU_PALLAS_APPLY=0/1``
-  force-overrides.
+  Lowering (measured on v5e, `docs/BENCHMARKS.md`): XLA's scatter has a
+  fast sorted/locality path at ~16-25 ns/row that it only picks when the
+  id stream is >= ~0.15x the buffer's rows, and a ~75 ns/row serial path
+  otherwise; the Pallas RMW cache kernel (`ops/pallas_apply.py`) is
+  ~47-60 ns/row in every regime. Callers that know the stream sits below
+  XLA's fast-path ratio pass ``prefer_pallas=True`` (the engine computes
+  this statically per class, `lookup_engine.apply_sparse`); the default
+  keeps XLA. ``DE_TPU_PALLAS_APPLY=0/1`` force-overrides.
   """
   grp, sub, valid = _grp_sub(layout, ids)
   fused_delta = jnp.where(valid[..., None], fused_delta, 0)
@@ -363,7 +363,7 @@ def scatter_add_fused(layout: PackedLayout, buf: jax.Array, ids: jax.Array,
   # rpp > 1 packs several logical rows per physical row, so even a unique
   # logical id stream is rpp-fold duplicated at the physical level — the
   # regime where XLA's scatter wins (docs/BENCHMARKS.md)
-  use_pallas = (few_duplicates if forced == "auto" else forced == "1") \
+  use_pallas = (prefer_pallas if forced == "auto" else forced == "1") \
       and rpp == 1 and _use_pallas_apply() and buf.dtype == jnp.float32
   if use_pallas:
     from .pallas_apply import apply_rows_cached

@@ -964,9 +964,11 @@ class DistributedLookup:
         aux = fused_rows[..., w:].reshape(
             ids.shape + (rule.n_aux, w)) if rule.n_aux else None
         delta = rule.delta(g, aux, step)
-        # post-dedup ids are unique: the Pallas RMW kernel's regime
-        buf = scatter_add_fused(layout, buf, ids, delta,
-                                few_duplicates=True)
+        # post-dedup ids are unique; below XLA's fast-path ratio the
+        # Pallas RMW kernel wins (same static rule as the fast path)
+        buf = scatter_add_fused(
+            layout, buf, ids, delta,
+            prefer_pallas=ids.shape[0] / max(1, layout.phys_rows) < 0.15)
       else:
         # fast path: ONE scatter-add for the whole class. Any chain of
         # scatters on the same buffer (lax.scan carry or unrolled
@@ -994,13 +996,15 @@ class DistributedLookup:
           # materialize the updates before the scatter: letting XLA fuse
           # the delta computation into the scatter slows its update loop
           ids_cat, delta_cat = lax.optimization_barrier((ids_cat, delta_cat))
-          # 1-hot classes produce a near-unique id stream (the Pallas RMW
-          # kernel's winning regime); multi-hot power-law streams carry
-          # heavy duplication, where XLA's scatter is faster (measured,
-          # docs/BENCHMARKS.md)
-          buf = scatter_add_fused(
-              layout, buf, ids_cat, delta_cat,
-              few_duplicates=all(h == 1 for _, _, _, h in parts))
+          # Static scatter-regime choice (measured matrix in
+          # docs/BENCHMARKS.md): XLA's fast sorted path (~16-25 ns/row)
+          # only engages when the stream is >= ~0.15x the buffer's
+          # physical rows; below that XLA falls to ~75 ns/row and the
+          # Pallas RMW cache kernel (~47-60 ns in every duplication
+          # regime) wins. Both quantities are static here.
+          ratio = ids_cat.shape[0] / max(1, layout.phys_rows)
+          buf = scatter_add_fused(layout, buf, ids_cat, delta_cat,
+                                  prefer_pallas=ratio < 0.15)
         else:
           # memory escape hatch for extreme occurrence counts (hotness
           # 200-500 models): compute the delta per chunk (never holding
@@ -1020,8 +1024,10 @@ class DistributedLookup:
                 g_c = jnp.broadcast_to(g_c[:, None, :],
                                        (cn // h, h, w)).reshape(cn, w)
               aux_c = None if aux_f is None else aux_f[c0:c0 + cn]
-              buf = scatter_add_fused(layout, buf, ids_f[c0:c0 + cn],
-                                      rule.delta(g_c, aux_c, step))
+              buf = scatter_add_fused(
+                  layout, buf, ids_f[c0:c0 + cn],
+                  rule.delta(g_c, aux_c, step),
+                  prefer_pallas=cn / max(1, layout.phys_rows) < 0.15)
       new_params[name] = buf
     return new_params
 

@@ -23,9 +23,9 @@ from distributed_embeddings_tpu.ops.packed_table import (
 from distributed_embeddings_tpu.ops.pallas_apply import apply_rows_cached
 
 
-@pytest.mark.parametrize("few_duplicates", [False, True])
+@pytest.mark.parametrize("prefer_pallas", [False, True])
 @pytest.mark.parametrize("n_aux", [0, 1])
-def test_scatter_add_fused_regimes_match(few_duplicates, n_aux):
+def test_scatter_add_fused_regimes_match(prefer_pallas, n_aux):
   """Both dispatch regimes must produce the same result (on CPU both lower
   to XLA scatter; on TPU one runs the Pallas kernel — tools/smoke covers
   that equivalence on hardware)."""
@@ -35,8 +35,8 @@ def test_scatter_add_fused_regimes_match(few_duplicates, n_aux):
   ids = jnp.asarray(rng.integers(-2, layout.rows + 2, 200), jnp.int32)
   delta = jnp.asarray(rng.standard_normal((200, layout.stride)), jnp.float32)
   got = scatter_add_fused(layout, buf, ids, delta,
-                          few_duplicates=few_duplicates)
-  want = scatter_add_fused(layout, buf, ids, delta, few_duplicates=False)
+                          prefer_pallas=prefer_pallas)
+  want = scatter_add_fused(layout, buf, ids, delta, prefer_pallas=False)
   np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
 
 
@@ -59,17 +59,17 @@ def test_dispatch_logic(monkeypatch):
   delta = jnp.ones((3, 128), jnp.float32)
   ndelta = jnp.ones((3, narrow.stride), jnp.float32)
 
-  scatter_add_fused(layout, buf, ids, delta, few_duplicates=True)
-  assert len(calls) == 1, "few_duplicates + rpp==1 must take the kernel"
-  scatter_add_fused(layout, buf, ids, delta, few_duplicates=False)
-  assert len(calls) == 1, "duplicated streams must keep XLA scatter"
-  scatter_add_fused(narrow, nbuf, ids, ndelta, few_duplicates=True)
+  scatter_add_fused(layout, buf, ids, delta, prefer_pallas=True)
+  assert len(calls) == 1, "prefer_pallas + rpp==1 must take the kernel"
+  scatter_add_fused(layout, buf, ids, delta, prefer_pallas=False)
+  assert len(calls) == 1, "prefer_pallas=False must keep XLA scatter"
+  scatter_add_fused(narrow, nbuf, ids, ndelta, prefer_pallas=True)
   assert len(calls) == 1, "rpp > 1 must keep XLA scatter"
   monkeypatch.setenv("DE_TPU_PALLAS_APPLY", "1")
-  scatter_add_fused(layout, buf, ids, delta, few_duplicates=False)
+  scatter_add_fused(layout, buf, ids, delta, prefer_pallas=False)
   assert len(calls) == 2, "DE_TPU_PALLAS_APPLY=1 must force the kernel"
   monkeypatch.setenv("DE_TPU_PALLAS_APPLY", "0")
-  out = scatter_add_fused(layout, buf, ids, delta, few_duplicates=True)
+  out = scatter_add_fused(layout, buf, ids, delta, prefer_pallas=True)
   assert len(calls) == 2, "DE_TPU_PALLAS_APPLY=0 must force XLA"
   assert float(out[1, 0]) == 2.0 and float(out[5, 0]) == 1.0
 
