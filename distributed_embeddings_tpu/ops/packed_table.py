@@ -360,11 +360,15 @@ def scatter_add_fused(layout: PackedLayout, buf: jax.Array, ids: jax.Array,
   flat_upd = upd.reshape(-1, layout.phys_width).astype(buf.dtype)
   import os
   forced = os.environ.get("DE_TPU_PALLAS_APPLY", "auto")
-  # rpp > 1 packs several logical rows per physical row, so even a unique
-  # logical id stream is rpp-fold duplicated at the physical level — the
-  # regime where XLA's scatter wins (docs/BENCHMARKS.md)
+  # Narrow classes (rpp > 1) use the SAME kernel at physical-row
+  # granularity: the lane expansion above places each sub-row delta in its
+  # window, two logical rows sharing a physical row accumulate exactly
+  # (disjoint windows add disjointly, same-window duplicates add like any
+  # duplicate), and the kernel's cache is keyed by physical row. The
+  # expansion stays outside the kernel by measurement: fused into either
+  # backend it costs ~1.7 ns/occ (docs/BENCHMARKS.md, profile_select).
   use_pallas = (prefer_pallas if forced == "auto" else forced == "1") \
-      and rpp == 1 and _use_pallas_apply() and buf.dtype == jnp.float32
+      and _use_pallas_apply() and buf.dtype == jnp.float32
   if use_pallas:
     from .pallas_apply import apply_rows_cached
     return apply_rows_cached(buf, flat_grp, flat_upd)

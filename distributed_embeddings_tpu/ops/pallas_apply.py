@@ -28,10 +28,13 @@ HBM accesses to one row are totally ordered, and concurrent in-flight DMA
 only ever touches distinct rows. Additive per-occurrence semantics match
 ``jnp.ndarray.at[].add`` up to f32 summation order.
 
-Used by the lookup engine when a class's physical layout is row-per-
-physical-row (``rows_per_phys == 1``, i.e. stride >= 128 lanes); narrower
-classes fall back to the XLA scatter. Gate with
-``DE_TPU_PALLAS_APPLY=0/1`` (default on for real TPU, off elsewhere).
+Used by the lookup engine for every packed layout: wide classes
+(``rows_per_phys == 1``) pass their updates straight through; narrow
+classes (rpp > 1) pass lane-EXPANDED updates so the kernel works at
+physical-row granularity (disjoint sub-row windows accumulate exactly;
+``packed_table.scatter_add_fused``). Dispatch is the static scatter-regime
+rule in ``lookup_engine.apply_sparse``; ``DE_TPU_PALLAS_APPLY=0/1``
+force-overrides (kernel requires a real TPU).
 """
 
 from __future__ import annotations

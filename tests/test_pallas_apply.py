@@ -64,13 +64,14 @@ def test_dispatch_logic(monkeypatch):
   scatter_add_fused(layout, buf, ids, delta, prefer_pallas=False)
   assert len(calls) == 1, "prefer_pallas=False must keep XLA scatter"
   scatter_add_fused(narrow, nbuf, ids, ndelta, prefer_pallas=True)
-  assert len(calls) == 1, "rpp > 1 must keep XLA scatter"
+  assert len(calls) == 2, ("rpp > 1 takes the kernel too: the lane "
+                           "expansion feeds it physical-row updates")
   monkeypatch.setenv("DE_TPU_PALLAS_APPLY", "1")
   scatter_add_fused(layout, buf, ids, delta, prefer_pallas=False)
-  assert len(calls) == 2, "DE_TPU_PALLAS_APPLY=1 must force the kernel"
+  assert len(calls) == 3, "DE_TPU_PALLAS_APPLY=1 must force the kernel"
   monkeypatch.setenv("DE_TPU_PALLAS_APPLY", "0")
   out = scatter_add_fused(layout, buf, ids, delta, prefer_pallas=True)
-  assert len(calls) == 2, "DE_TPU_PALLAS_APPLY=0 must force XLA"
+  assert len(calls) == 3, "DE_TPU_PALLAS_APPLY=0 must force XLA"
   assert float(out[1, 0]) == 2.0 and float(out[5, 0]) == 1.0
 
 

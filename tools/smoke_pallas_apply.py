@@ -70,6 +70,34 @@ def main():
   if not ok:
     FAILED.append("randomized")
 
+  # narrow-class dispatch: lane-expanded sub-row deltas through the same
+  # kernel at physical-row granularity (scatter_add_fused with rpp > 1)
+  from distributed_embeddings_tpu.ops.packed_table import (
+      PackedLayout, scatter_add_fused)
+  for width, n_aux in ((16, 1), (8, 1), (32, 1), (16, 0)):
+    layout = PackedLayout(rows=4096, width=width, n_aux=n_aux)
+    nids = 2048
+    ids_n = jnp.asarray(rng.integers(-2, layout.rows + 2, nids), jnp.int32)
+    delta_n = jnp.asarray(rng.standard_normal((nids, layout.stride)),
+                          jnp.float32)
+    base_n = jnp.asarray(rng.standard_normal(layout.shape), jnp.float32)
+    import os
+    saved = os.environ.get("DE_TPU_PALLAS_APPLY")
+    os.environ["DE_TPU_PALLAS_APPLY"] = "0"   # force XLA for the reference
+    want = scatter_add_fused(layout, base_n + 0, ids_n, delta_n)
+    os.environ["DE_TPU_PALLAS_APPLY"] = "1"   # force the kernel
+    got = scatter_add_fused(layout, base_n + 0, ids_n, delta_n)
+    if saved is None:
+      del os.environ["DE_TPU_PALLAS_APPLY"]
+    else:
+      os.environ["DE_TPU_PALLAS_APPLY"] = saved
+    err = float(jnp.max(jnp.abs(got - want)))
+    ok = err < 1e-4
+    print(f"{'narrow w%d aux%d kernel vs XLA' % (width, n_aux):34s}: "
+          f"{'OK' if ok else 'FAIL'} (max err {err:.2e})")
+    if not ok:
+      FAILED.append(f"narrow w{width}")
+
   if FAILED:
     print("FAILED:", FAILED)
     sys.exit(1)
