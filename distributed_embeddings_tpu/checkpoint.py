@@ -45,13 +45,30 @@ from .parallel.lookup_engine import DistributedLookup, class_param_name
 FORMAT_VERSION = 1
 
 
+def _to_host(leaf) -> np.ndarray:
+  """Fetch a (replicated) leaf to host, multi-process safe.
+
+  In multi-controller runs even replicated arrays are not fully
+  addressable; the local replica shard carries the full value."""
+  if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+    shard = leaf.addressable_shards[0]
+    data = np.asarray(shard.data)
+    if tuple(data.shape) != tuple(leaf.shape):
+      raise RuntimeError(
+          f"dense leaf of shape {leaf.shape} is sharded across processes "
+          f"(local shard {data.shape}); checkpoint.save expects "
+          "dense/optimizer state replicated (PartitionSpec())")
+    return data
+  return np.asarray(jax.device_get(leaf))
+
+
 def _flatten_with_paths(tree) -> Dict[str, np.ndarray]:
   flat = {}
   for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
     key = "/".join(
         str(getattr(p, "key", getattr(p, "name", getattr(p, "idx", p))))
         for p in path)
-    flat[key] = np.asarray(jax.device_get(leaf))
+    flat[key] = _to_host(leaf)
   return flat
 
 
@@ -104,61 +121,148 @@ def _abbrev(v, limit: int = 200) -> str:
   return s if len(s) <= limit else s[:limit] + f"... (+{len(s) - limit} chars)"
 
 
+def _barrier(tag: str) -> None:
+  if jax.process_count() > 1:
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(tag)
+
+
+def _rank_blocks_addressable(arr: jax.Array, phys_rows: int):
+  """Yield ``(rank, block ndarray)`` for every rank block of a
+  class-stacked array this process can fully address, via
+  addressable_shards — never a global fetch. A local shard may cover
+  several rank blocks (mesh axis smaller than world) or a rank block may
+  span several local shards; both directions are sliced per rank here.
+  Partial local coverage of a rank is rejected (the mesh layouts this
+  engine builds never split one rank's rows across processes)."""
+  from .parallel.mesh import addressable_row_spans
+
+  per_rank: Dict[int, list] = {}
+  for s0, s1, shard in addressable_row_spans(arr):
+    for rank in range(s0 // phys_rows, -(-s1 // phys_rows)):
+      lo, hi = max(s0, rank * phys_rows), min(s1, (rank + 1) * phys_rows)
+      if lo < hi:
+        per_rank.setdefault(rank, []).append((lo, hi, s0, shard))
+  for rank, pieces in sorted(per_rank.items()):
+    pieces.sort()
+    base = rank * phys_rows
+    covered = sum(hi - lo for lo, hi, _, _ in pieces)
+    if covered != phys_rows:
+      raise RuntimeError(
+          f"process {jax.process_index()} holds only {covered} of "
+          f"{phys_rows} rows of rank {rank}'s block — a mesh layout that "
+          "splits one rank's rows across processes is not supported by "
+          "checkpoint.save")
+    block = np.empty((phys_rows, arr.shape[1]), arr.dtype)
+    for lo, hi, s0, shard in pieces:
+      block[lo - base:hi - base] = np.asarray(shard.data)[lo - s0:hi - s0]
+    yield rank, block
+
+
 def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
          state: Dict[str, Any]) -> None:
   """Write the full fused train state under directory ``path``.
 
   Atomicity: everything is written into ``path + '.tmp'`` and renamed at
   the end, so a crash mid-save never corrupts the previous checkpoint.
+
+  Multi-process safe: each process writes ONLY the rank blocks its
+  devices hold (from ``addressable_shards`` — the save path never indexes
+  a global buffer), process 0 writes the replicated dense parts and the
+  manifest, and cross-process barriers order the tmp-dir lifecycle.
+  Requires a filesystem shared by all processes (the standard pod setup;
+  the reference's chunked ``hvd.allgather`` to rank 0,
+  `dist_model_parallel.py:574-664`, solves the same problem with
+  collectives instead).
   """
   engine = DistributedLookup(plan)
   layouts = engine.fused_layouts(rule)
   tmp = path + ".tmp"
-  if os.path.exists(tmp):
-    # a stale .tmp from a crashed save would otherwise merge its files
-    # into this checkpoint via makedirs(exist_ok=True)
-    import shutil
-    shutil.rmtree(tmp)
-  os.makedirs(tmp)
+  p0 = jax.process_index() == 0
+  err: Optional[BaseException] = None
+  if p0:
+    try:
+      if os.path.exists(tmp):
+        # a stale .tmp from a crashed save would otherwise merge its files
+        # into this checkpoint via makedirs(exist_ok=True)
+        import shutil
+        shutil.rmtree(tmp)
+      os.makedirs(tmp)
+    except BaseException as e:  # reach the barrier even on failure
+      err = e
+  _barrier("de_tpu_ckpt_tmp_ready")
+  if err is not None:
+    raise err
+  if not os.path.isdir(tmp):
+    raise RuntimeError(
+        f"checkpoint tmp dir {tmp!r} missing after barrier — process 0 "
+        "failed to create it (its exception has the root cause), or the "
+        "processes do not share a filesystem")
 
-  fused_meta = {}
-  for name, arr in state["fused"].items():
-    layout = layouts[name]
-    for r in range(plan.world_size):
-      # fetch ONE rank block at a time: device_get of the whole fused
-      # array would stage a global (possibly multi-rank x multi-GiB)
-      # buffer on this host, defeating the streaming design the restore
-      # side already has
-      block = np.asarray(
-          jax.device_get(arr[r * layout.phys_rows:(r + 1) * layout.phys_rows]))
-      np.save(os.path.join(tmp, f"fused_{name}_r{r}.npy"), block)
-    fused_meta[name] = {
-        "phys_rows": layout.phys_rows,
-        "phys_width": layout.phys_width,
-        "dtype": str(np.dtype(arr.dtype)),
-    }
+  # Every exception below still reaches the barrier (otherwise the other
+  # processes deadlock inside sync_global_devices) and is advertised via a
+  # marker file so ALL processes abort instead of renaming a bad tmp.
+  try:
+    fused_meta = {}
+    for name, arr in state["fused"].items():
+      layout = layouts[name]
+      if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+        blocks = _rank_blocks_addressable(arr, layout.phys_rows)
+      else:
+        # single-controller: fetch ONE rank block at a time (device_get of
+        # the whole fused array would stage a global multi-GiB buffer)
+        blocks = ((r, np.asarray(jax.device_get(
+            arr[r * layout.phys_rows:(r + 1) * layout.phys_rows])))
+            for r in range(plan.world_size))
+      for r, block in blocks:
+        np.save(os.path.join(tmp, f"fused_{name}_r{r}.npy"), block)
+      fused_meta[name] = {
+          "phys_rows": layout.phys_rows,
+          "phys_width": layout.phys_width,
+          "dtype": str(np.dtype(arr.dtype)),
+      }
 
-  for part in ("dense", "dense_opt", "emb_dense", "emb_dense_opt"):
-    np.savez(os.path.join(tmp, f"{part}.npz"),
-             **_flatten_with_paths(state[part]))
+    if p0:
+      for part in ("dense", "dense_opt", "emb_dense", "emb_dense_opt"):
+        np.savez(os.path.join(tmp, f"{part}.npz"),
+                 **_flatten_with_paths(state[part]))
 
-  manifest = {
-      "format_version": FORMAT_VERSION,
-      "step": int(jax.device_get(state["step"])),
-      "rule": {"name": rule.name, "n_aux": rule.n_aux},
-      "plan": _plan_fingerprint(plan),
-      "fused": fused_meta,
-  }
-  with open(os.path.join(tmp, "manifest.json"), "w") as f:
-    json.dump(manifest, f, indent=1)
+      manifest = {
+          "format_version": FORMAT_VERSION,
+          "step": int(_to_host(state["step"])),
+          "rule": {"name": rule.name, "n_aux": rule.n_aux},
+          "plan": _plan_fingerprint(plan),
+          "fused": fused_meta,
+      }
+      with open(os.path.join(tmp, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1)
+  except BaseException as e:
+    err = e
+    try:
+      with open(os.path.join(
+          tmp, f"FAILED_p{jax.process_index()}"), "w") as f:
+        f.write(repr(e))
+    except OSError:
+      pass  # disk may be the problem; the barrier + local raise still abort
 
-  if os.path.exists(path):
-    backup = path + ".old"
-    if os.path.exists(backup):
-      import shutil
-      shutil.rmtree(backup)
-    os.rename(path, backup)
-  os.rename(tmp, path)
+  _barrier("de_tpu_ckpt_written")
+  if err is not None:
+    raise err
+  import glob as _glob
+  failed = _glob.glob(os.path.join(tmp, "FAILED_p*"))
+  if failed:
+    raise RuntimeError(
+        f"checkpoint save failed on another process: {sorted(failed)} "
+        "(see its exception); the partial tmp dir was left for inspection")
+  if p0:
+    if os.path.exists(path):
+      backup = path + ".old"
+      if os.path.exists(backup):
+        import shutil
+        shutil.rmtree(backup)
+      os.rename(path, backup)
+    os.rename(tmp, path)
+  _barrier("de_tpu_ckpt_renamed")
 
 
 def restore(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
@@ -174,7 +278,12 @@ def restore(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
       names.
     mesh: when given, fused buffers are assembled directly as mesh-sharded
       arrays from memory-mapped per-rank files (each device materializes
-      only its block).
+      only its block). Works in multi-controller runs too: pass the GLOBAL
+      mesh and each process loads only the files its devices own. The
+      dense/optimizer parts come back as host-local arrays — under
+      multi-controller, shard them with
+      ``jax.experimental.multihost_utils.host_local_array_to_global_array``
+      (they are replicated, so every process loads identical values).
   """
   engine = DistributedLookup(plan)
   layouts = engine.fused_layouts(rule)

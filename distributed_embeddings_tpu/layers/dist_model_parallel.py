@@ -205,7 +205,35 @@ class DistributedEmbedding(nn.Module):
 def _fetch_rows(arr, row0: int, n: int, width: int,
                 max_fetch_elements: int) -> np.ndarray:
   """Fetch rows ``[row0, row0+n)`` of a (possibly sharded) device array in
-  bounded host-memory chunks."""
+  bounded host-memory chunks.
+
+  Multi-process safe: when ``arr`` is a jax.Array this process cannot
+  fully address (multi-controller runs), the window is assembled from
+  ``addressable_shards`` instead of global indexing — which works exactly
+  when this process's devices hold the window. A window owned by another
+  process raises with guidance instead of hanging or crashing inside
+  XLA (the reference handles the same situation with chunked
+  ``hvd.allgather``, `dist_model_parallel.py:596-617`; here cross-process
+  windows are served by the per-process checkpoint files instead)."""
+  if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+    from ..parallel.mesh import addressable_row_spans
+    out = np.empty((n, arr.shape[1]) if arr.ndim == 2 else (n,),
+                   arr.dtype)
+    have = np.zeros((n,), bool)
+    for s0, s1, shard in addressable_row_spans(arr):
+      lo, hi = max(s0, row0), min(s1, row0 + n)
+      if lo < hi:
+        data = np.asarray(shard.data)
+        out[lo - row0:hi - row0] = data[lo - s0:hi - s0]
+        have[lo - row0:hi - row0] = True
+    if not have.all():
+      raise RuntimeError(
+          f"rows [{row0}, {row0 + n}) of a non-fully-addressable array are "
+          "not owned by this process. In multi-controller runs, fetch "
+          "global weights from the per-process checkpoint files "
+          "(checkpoint.save writes only locally-addressable rank blocks) "
+          "or restrict get_weights to tables whose shards are local.")
+    return out
   chunk = max(1, max_fetch_elements // max(1, width))
   if n <= chunk:
     return np.asarray(jax.device_get(arr[row0:row0 + n]))

@@ -65,3 +65,22 @@ def batch_sharding(mesh: Mesh, axis_name: str = DEFAULT_AXIS) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
   return NamedSharding(mesh, P())
+
+
+def addressable_row_spans(arr: jax.Array):
+  """Yield ``(row_start, row_stop, shard)`` for this process's addressable
+  shards of a row-sharded 2-D array (replica 0 only, sorted by start).
+
+  The single source of truth for local shard geometry — used by both the
+  checkpoint save path and ``get_weights``'s window fetch so the two can
+  never diverge on index arithmetic."""
+  spans = []
+  for shard in arr.addressable_shards:
+    if shard.replica_id != 0:
+      continue
+    sl = shard.index[0]
+    s0 = sl.start or 0
+    s1 = sl.stop if sl.stop is not None else arr.shape[0]
+    spans.append((s0, s1, shard))
+  spans.sort(key=lambda t: t[0])
+  return spans

@@ -397,6 +397,13 @@ def unpack_sparse_state(plan: DistEmbeddingStrategy, rule: SparseRule,
     if plan.classes[key].kind == "sparse":
       layout = layouts[name]
       buf = state["fused"][name]
+      if isinstance(buf, jax.Array) and not buf.is_fully_addressable:
+        raise RuntimeError(
+            "unpack_sparse_state indexes the global fused buffers and "
+            "requires fully-addressable arrays (single-controller). In "
+            "multi-controller runs use checkpoint.save (per-process rank "
+            "files from addressable shards) or get_weights on locally-"
+            "addressable windows instead.")
 
       def rank_bufs(buf=buf, layout=layout):
         return [buf[r * layout.phys_rows:(r + 1) * layout.phys_rows]
