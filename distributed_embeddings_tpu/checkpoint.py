@@ -138,11 +138,13 @@ def _rank_blocks_addressable(arr: jax.Array, phys_rows: int):
   from .parallel.mesh import addressable_row_spans
 
   per_rank: Dict[int, list] = {}
+  host: Dict[int, np.ndarray] = {}  # one device->host copy per shard
   for s0, s1, shard in addressable_row_spans(arr):
+    host[id(shard)] = np.asarray(shard.data)
     for rank in range(s0 // phys_rows, -(-s1 // phys_rows)):
       lo, hi = max(s0, rank * phys_rows), min(s1, (rank + 1) * phys_rows)
       if lo < hi:
-        per_rank.setdefault(rank, []).append((lo, hi, s0, shard))
+        per_rank.setdefault(rank, []).append((lo, hi, s0, id(shard)))
   for rank, pieces in sorted(per_rank.items()):
     pieces.sort()
     base = rank * phys_rows
@@ -154,8 +156,8 @@ def _rank_blocks_addressable(arr: jax.Array, phys_rows: int):
           "splits one rank's rows across processes is not supported by "
           "checkpoint.save")
     block = np.empty((phys_rows, arr.shape[1]), arr.dtype)
-    for lo, hi, s0, shard in pieces:
-      block[lo - base:hi - base] = np.asarray(shard.data)[lo - s0:hi - s0]
+    for lo, hi, s0, sid in pieces:
+      block[lo - base:hi - base] = host[sid][lo - s0:hi - s0]
     yield rank, block
 
 
@@ -191,29 +193,37 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
     except BaseException as e:  # reach the barrier even on failure
       err = e
   _barrier("de_tpu_ckpt_tmp_ready")
-  if err is not None:
-    raise err
-  if not os.path.isdir(tmp):
-    raise RuntimeError(
-        f"checkpoint tmp dir {tmp!r} missing after barrier — process 0 "
-        "failed to create it (its exception has the root cause), or the "
-        "processes do not share a filesystem")
 
-  # Every exception below still reaches the barrier (otherwise the other
-  # processes deadlock inside sync_global_devices) and is advertised via a
-  # marker file so ALL processes abort instead of renaming a bad tmp.
+  # Every exception below still reaches the written-barrier (otherwise the
+  # other processes deadlock inside sync_global_devices). Success is
+  # advertised POSITIVELY via a DONE marker per process: the rename only
+  # happens when all process_count markers exist, so a process whose
+  # failure could not even write a marker still aborts the save everywhere
+  # (absence-based failure detection would promote it).
+  n_proc = jax.process_count()
   try:
+    if err is not None:
+      raise err  # p0's mkdir failure, re-raised on p0 after the barrier
+    if not os.path.isdir(tmp):
+      raise RuntimeError(
+          f"checkpoint tmp dir {tmp!r} missing after barrier — process 0 "
+          "failed to create it (its exception has the root cause), or the "
+          "processes do not share a filesystem")
     fused_meta = {}
     for name, arr in state["fused"].items():
       layout = layouts[name]
       if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
         blocks = _rank_blocks_addressable(arr, layout.phys_rows)
-      else:
-        # single-controller: fetch ONE rank block at a time (device_get of
-        # the whole fused array would stage a global multi-GiB buffer)
+      elif p0 or n_proc == 1:
+        # fully-addressable buffers are identical on every process: only
+        # process 0 writes them (concurrent np.save to one shared path
+        # would tear). Fetch ONE rank block at a time (device_get of the
+        # whole fused array would stage a global multi-GiB buffer).
         blocks = ((r, np.asarray(jax.device_get(
             arr[r * layout.phys_rows:(r + 1) * layout.phys_rows])))
             for r in range(plan.world_size))
+      else:
+        blocks = ()
       for r, block in blocks:
         np.save(os.path.join(tmp, f"fused_{name}_r{r}.npy"), block)
       fused_meta[name] = {
@@ -236,25 +246,25 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
       }
       with open(os.path.join(tmp, "manifest.json"), "w") as f:
         json.dump(manifest, f, indent=1)
+    with open(os.path.join(
+        tmp, f"DONE_p{jax.process_index()}"), "w") as f:
+      f.write("ok")
   except BaseException as e:
     err = e
-    try:
-      with open(os.path.join(
-          tmp, f"FAILED_p{jax.process_index()}"), "w") as f:
-        f.write(repr(e))
-    except OSError:
-      pass  # disk may be the problem; the barrier + local raise still abort
 
   _barrier("de_tpu_ckpt_written")
   if err is not None:
     raise err
-  import glob as _glob
-  failed = _glob.glob(os.path.join(tmp, "FAILED_p*"))
-  if failed:
+  done = [p for p in range(n_proc)
+          if os.path.exists(os.path.join(tmp, f"DONE_p{p}"))]
+  if len(done) != n_proc:
     raise RuntimeError(
-        f"checkpoint save failed on another process: {sorted(failed)} "
-        "(see its exception); the partial tmp dir was left for inspection")
+        f"checkpoint save incomplete: only processes {done} of {n_proc} "
+        "finished writing (see the failing process's exception); the "
+        "partial tmp dir was left for inspection")
   if p0:
+    for p in range(n_proc):  # markers are transport, not checkpoint data
+      os.remove(os.path.join(tmp, f"DONE_p{p}"))
     if os.path.exists(path):
       backup = path + ".old"
       if os.path.exists(backup):

@@ -321,6 +321,16 @@ class DistEmbeddingStrategy:
           f"input_hotness has {len(input_hotness)} entries for "
           f"{self.num_inputs} inputs")
     self.input_hotness = None if input_hotness is None else list(input_hotness)
+    # A NEGATIVE input_hotness entry declares "input i may be ragged":
+    # its table is kept on the sparse (gather) path regardless of
+    # dense_row_threshold, because the MXU one-hot path has no
+    # value-stream form. |entry| still serves as the occurrence weight
+    # for generation balancing (use -avg_hotness when known, else -1).
+    self._ragged_tables = set()
+    if self.input_hotness is not None:
+      for i, h in enumerate(self.input_hotness):
+        if h < 0:
+          self._ragged_tables.add(self.input_table_map[i])
     # expected per-step GLOBAL batch (optional): lets the generation
     # assignment evaluate the scatter-regime cost model on absolute id
     # counts instead of only balancing ratios — see _assign_generations
@@ -457,8 +467,9 @@ class DistEmbeddingStrategy:
     self.max_class_bytes = max_class_bytes
     occ_of = [0.0] * num_tables
     for i, t in enumerate(self.input_table_map):
-      occ_of[t] += (self.input_hotness[i] if self.input_hotness is not None
-                    else 1)
+      # negative entries are ragged markers; |h| is the occurrence weight
+      occ_of[t] += (abs(self.input_hotness[i])
+                    if self.input_hotness is not None else 1)
     for shards in self.rank_shards:
       by_base: Dict[tuple, List] = {}
       for sh in shards:
@@ -727,6 +738,13 @@ class DistEmbeddingStrategy:
     # row shards always take the gather path: the one-hot window trick
     # assumes slot-local ids cover the full table from offset 0
     if shard.row_sliced:
+      return "sparse"
+    # tables declared ragged-fed (negative input_hotness hint) stay on the
+    # sparse path: the MXU one-hot lookup has no value-stream form, and
+    # demoting at plan time is what lets ragged inputs reach ANY
+    # non-row-sliced table (reference parity: embedding_lookup_ops.py
+    # accepts ragged into any single-process layer)
+    if shard.table_id in self._ragged_tables:
       return "sparse"
     return ("dense" if shard.input_dim <= self.dense_row_threshold
             else "sparse")

@@ -131,13 +131,14 @@ def class_buckets(plan: DistEmbeddingStrategy, key, hotness_of) -> List[Bucket]:
     h = hotness_of(slot.input_id)
     if h < 0:  # ragged value stream
       if dense:
+        # unreachable through the planner when the input was declared
+        # ragged (negative input_hotness demotes the table to sparse);
+        # reachable when raggedness appears only at call time
         raise NotImplementedError(
-            "ragged inputs into a dense-class (MXU one-hot) table are not "
-            "supported in the distributed path; raise dense_row_threshold "
-            "below this table's vocab or pre-pad the input")
-      if slot.shard.row_sliced:
-        raise NotImplementedError(
-            "ragged inputs into a row-sliced table are not supported")
+            "ragged inputs into a dense-class (MXU one-hot) table: declare "
+            "the input ragged up front (negative input_hotness entry) so "
+            "the planner keeps its table on the sparse path, or pre-pad "
+            "the input (ragged_to_padded)")
       if cp.combiner is None:
         raise ValueError("ragged distributed inputs require a combiner "
                          "('sum' or 'mean')")
@@ -407,9 +408,22 @@ class DistributedLookup:
           total = rg.row_splits[-1].astype(jnp.int32)
           live = jnp.arange(cap, dtype=jnp.int32) < total
           sh = slot.shard
-          routed = jnp.where(
-              live & (v >= 0),
-              jnp.clip(v, 0, sh.input_dim - 1) + slot.row_offset, sentinel)
+          if sh.row_sliced:
+            # row shard: serve only values inside this shard's vocab
+            # window (same clamp-first policy as the padded routing so
+            # enabling row_slice never changes numerics); out-of-window
+            # values go to the sentinel and contribute zeros to this
+            # shard's partial sum
+            vocab = self.plan.global_configs[sh.table_id].input_dim
+            clamped = jnp.clip(v, 0, vocab - 1)
+            in_win = live & (v >= 0) & (clamped >= sh.row_start) & (
+                clamped < sh.row_start + sh.input_dim)
+            routed = jnp.where(
+                in_win, clamped - sh.row_start + slot.row_offset, sentinel)
+          else:
+            routed = jnp.where(
+                live & (v >= 0),
+                jnp.clip(v, 0, sh.input_dim - 1) + slot.row_offset, sentinel)
           vals_r.append(routed)
           lens_r.append(rg.row_lengths().astype(jnp.int32))
         else:
@@ -502,7 +516,7 @@ class DistributedLookup:
     if isinstance(ids_all, tuple):  # ragged value stream
       vals, lens = ids_all
       rows = jnp.take(table_local, vals, axis=0, mode="fill", fill_value=0)
-      return self._combine_ragged(rows, vals, lens, key)
+      return self._combine_ragged(rows, vals, lens, key, rs)
     rows = jnp.take(table_local, ids_all, axis=0, mode="fill", fill_value=0)
     return self._combine(rows, ids_all, key, rs)
 
@@ -522,13 +536,16 @@ class DistributedLookup:
     return seg, counts
 
   def _combine_ragged(self, rows: jax.Array, vals: jax.Array,
-                      lens: jax.Array, key) -> jax.Array:
+                      lens: jax.Array, key, rs: bool = False) -> jax.Array:
     """Per-occurrence rows [n_b, world, V, w] + lens [n_b, world, B]
     -> [n_b, G, w] via segment-sum over each source block's CSR structure.
 
     Sentinel-padded tail positions gathered zero rows and clamp to the
     last segment, so they never perturb the sums; the mean combiner
-    divides by the per-sample VALID-id counts."""
+    divides by the per-sample VALID-id counts. Row-sliced buckets
+    (``rs``) defer the division to :meth:`assemble` — this shard's
+    sentinel pattern counts only the ids its vocab window served, the
+    same reasoning as the padded path's rs handling."""
     cp = self.plan.classes[key]
     n_b, world, cap, w = rows.shape
     b = lens.shape[2]
@@ -537,7 +554,7 @@ class DistributedLookup:
         lambda r, s: jax.ops.segment_sum(r, s, num_segments=b))(
             rows.reshape(n_b * world, cap, w), seg)
     summed = summed.reshape(n_b, world * b, w)
-    if cp.combiner == "mean":
+    if cp.combiner == "mean" and not rs:
       counts = counts.reshape(n_b, world * b).astype(summed.dtype)
       summed = summed / jnp.maximum(counts, 1)[..., None]
     return summed
@@ -637,7 +654,7 @@ class DistributedLookup:
       vals, lens = ids_all
       fused = gather_fused_chunked(layout, buf_local, vals)
       aux = fused if layout.n_aux else fused[..., w:]
-      return self._combine_ragged(fused[..., :w], vals, lens, key), aux
+      return self._combine_ragged(fused[..., :w], vals, lens, key, rs), aux
     fused = gather_fused_chunked(layout, buf_local, ids_all)  # [n_b,G,h,stride]
     if layout.n_aux == 0:
       # stride == width: no aux lanes ride along, nothing to defer
@@ -724,7 +741,10 @@ class DistributedLookup:
         out = parts[0] if len(parts) == 1 else sum(parts[1:], parts[0])
         combiner = plan.global_configs[
             plan.input_table_map[input_id]].combiner
-        if combiner == "mean" and hotness_of(input_id) > 1:
+        h_code = hotness_of(input_id)
+        if combiner == "mean" and (h_code > 1 or h_code < 0):
+          # h_code < 0 marks a ragged value stream (variable hotness);
+          # hotness-1 inputs skip the division (mean of one element)
           if mean_counts is None or input_id not in mean_counts:
             raise ValueError(
                 "mean combiner on a row-sliced table needs mean_counts "
@@ -754,9 +774,19 @@ class DistributedLookup:
         continue
       x = _normalize_input(inputs[input_id])
       if isinstance(x, RaggedIds):
-        raise NotImplementedError(
-            "ragged inputs into a row-sliced mean table are not supported")
-      out[input_id] = jnp.sum(x >= 0, axis=1)
+        # per-sample VALID-id count over the value stream: live window
+        # entries that are non-negative (same divisor the padded path's
+        # sum(x >= 0) computes)
+        cap = x.values.shape[0]
+        lens = x.row_lengths().astype(jnp.int32)
+        seg = _seg_ids(lens, cap)
+        live = jnp.arange(cap, dtype=jnp.int32) < \
+            x.row_splits[-1].astype(jnp.int32)
+        valid = (live & (x.values >= 0)).astype(jnp.int32)
+        out[input_id] = jax.ops.segment_sum(valid, seg,
+                                            num_segments=x.nrows)
+      else:
+        out[input_id] = jnp.sum(x >= 0, axis=1)
     return out
 
   # ---- composed forwards -------------------------------------------------
@@ -930,8 +960,10 @@ class DistributedLookup:
         dz_blocks = dzb.reshape(n_b * world, b, w)
         g_occ = jax.vmap(lambda d, s: jnp.take(d, s, axis=0))(
             dz_blocks, seg)  # [n_b*world, V, w]
-        if cp.combiner == "mean":
-          # mirror the forward's valid-count divisor exactly
+        if cp.combiner == "mean" and not bk.rs:
+          # mirror the forward's valid-count divisor exactly (row-sliced
+          # buckets: the division lives in the differentiable assemble,
+          # so d_z arrives pre-divided — same as the padded path)
           cnt = jax.vmap(lambda c, s: jnp.take(c, s))(
               counts, seg).astype(g_occ.dtype)
           g_occ = g_occ / jnp.maximum(cnt, 1)[..., None]

@@ -241,3 +241,211 @@ def test_ragged_rejects_unsupported_combos():
   with pytest.raises(NotImplementedError, match="dense-class"):
     engine2.forward({k: jnp.zeros(s, jnp.float32)
                      for k, s in engine2.param_shapes().items()}, [rg])
+
+
+@pytest.mark.parametrize("combiner", ["sum", "mean"])
+def test_ragged_row_sliced_matches_padded(combiner):
+  """Ragged value-stream inputs into a ROW-SLICED table (round 3): the
+  vocab-window routing must partial-sum across shards exactly like the
+  padded path, with the mean division deferred to assemble."""
+  rng = np.random.default_rng(3)
+  # one big table forced into row slices + a few plain tables
+  tables = [TableConfig(64, 16, combiner=combiner)] + \
+           [TableConfig(24 + i, 16, combiner=combiner) for i in range(7)]
+  plan = DistEmbeddingStrategy(tables, WORLD, "basic",
+                               dense_row_threshold=0,
+                               row_slice_threshold=16 * 16)
+  assert any(sh.row_sliced for shards in plan.rank_shards for sh in shards)
+  engine = DistributedLookup(plan)
+  weights = [rng.standard_normal((c.input_dim, c.output_dim))
+             .astype(np.float32) for c in tables]
+  params = set_weights(plan, weights)
+  params = {k: jnp.asarray(v) for k, v in params.items()}
+
+  b_local, max_hot, cap = 4, 5, 16
+  per_dev = [_make_ragged(rng, b_local, 64, max_hot, cap)
+             for _ in range(WORLD)]
+  ragged_blocks = [p[0] for p in per_dev]
+  global_ragged = _stack_ragged(ragged_blocks)
+  dense_inputs = [jnp.asarray(
+      rng.integers(0, c.input_dim, (WORLD * b_local, 1)), jnp.int32)
+      for c in tables[1:]]
+
+  mesh = create_mesh(WORLD)
+  from jax.sharding import NamedSharding, PartitionSpec as P
+  from jax import shard_map
+
+  def fwd(params, rg_values, rg_splits, *dense):
+    rg = RaggedIds(rg_values, rg_splits)
+    return engine.forward(params, [rg] + list(dense))
+
+  pspec = jax.tree_util.tree_map(lambda _: P("mp", None), params)
+  outs = jax.jit(shard_map(
+      fwd, mesh=mesh,
+      in_specs=(pspec, P("mp"), P("mp")) + (P("mp"),) * len(dense_inputs),
+      out_specs=P("mp")))(
+          shard_params(params, mesh),
+          jax.device_put(global_ragged.values,
+                         NamedSharding(mesh, P("mp"))),
+          jax.device_put(global_ragged.row_splits,
+                         NamedSharding(mesh, P("mp"))),
+          *[jax.device_put(d, NamedSharding(mesh, P("mp")))
+            for d in dense_inputs])
+
+  # single-device reference on the unsliced table
+  want_blocks = [np.asarray(
+      embedding_lookup(jnp.asarray(weights[0]), rg, combiner=combiner))
+      for rg in ragged_blocks]
+  np.testing.assert_allclose(np.asarray(outs[0]),
+                             np.concatenate(want_blocks),
+                             rtol=1e-5, atol=1e-5)
+
+  # padded-path parity
+  padded = jnp.concatenate(
+      [ragged_to_padded(rg, max_hot) for rg in ragged_blocks])
+
+  def fwd_padded(params, x0, *dense):
+    return engine.forward(params, [x0] + list(dense))
+
+  outs_p = jax.jit(shard_map(
+      fwd_padded, mesh=mesh,
+      in_specs=(pspec, P("mp")) + (P("mp"),) * len(dense_inputs),
+      out_specs=P("mp")))(
+          shard_params(params, mesh),
+          jax.device_put(padded, NamedSharding(mesh, P("mp"))),
+          *[jax.device_put(d, NamedSharding(mesh, P("mp")))
+            for d in dense_inputs])
+  np.testing.assert_allclose(np.asarray(outs[0]), np.asarray(outs_p[0]),
+                             rtol=1e-5, atol=1e-5)
+
+
+def test_ragged_into_small_table_demoted_to_sparse():
+  """A small-vocab table that would ride the MXU one-hot path is demoted
+  to the sparse path when its input is declared ragged (negative
+  input_hotness), and the lookup matches the single-device op."""
+  rng = np.random.default_rng(4)
+  tables = [TableConfig(40, 16, combiner="sum")] + \
+           [TableConfig(30 + i, 16, combiner="sum") for i in range(7)]
+  # without the hint, vocab 40 <= threshold 2048 would be dense
+  plan = DistEmbeddingStrategy(tables, WORLD, "basic",
+                               dense_row_threshold=2048,
+                               input_hotness=[-5] + [1] * 7)
+  kinds = {plan.classes[k].kind for k in plan.class_keys
+           if any(s.shard.table_id == 0
+                  for slots in plan.classes[k].slots_per_rank
+                  for s in slots)}
+  assert kinds == {"sparse"}, kinds
+  engine = DistributedLookup(plan)
+  weights = [rng.standard_normal((c.input_dim, c.output_dim))
+             .astype(np.float32) for c in tables]
+  params = set_weights(plan, weights)
+  params = {k: jnp.asarray(v) for k, v in params.items()}
+
+  b_local, max_hot, cap = 4, 5, 16
+  per_dev = [_make_ragged(rng, b_local, 40, max_hot, cap)
+             for _ in range(WORLD)]
+  global_ragged = _stack_ragged([p[0] for p in per_dev])
+  dense_inputs = [jnp.asarray(
+      rng.integers(0, c.input_dim, (WORLD * b_local, 1)), jnp.int32)
+      for c in tables[1:]]
+
+  mesh = create_mesh(WORLD)
+  from jax.sharding import NamedSharding, PartitionSpec as P
+  from jax import shard_map
+
+  def fwd(params, rg_values, rg_splits, *dense):
+    rg = RaggedIds(rg_values, rg_splits)
+    return engine.forward(params, [rg] + list(dense))
+
+  pspec = jax.tree_util.tree_map(lambda _: P("mp", None), params)
+  outs = jax.jit(shard_map(
+      fwd, mesh=mesh,
+      in_specs=(pspec, P("mp"), P("mp")) + (P("mp"),) * len(dense_inputs),
+      out_specs=P("mp")))(
+          shard_params(params, mesh),
+          jax.device_put(global_ragged.values,
+                         NamedSharding(mesh, P("mp"))),
+          jax.device_put(global_ragged.row_splits,
+                         NamedSharding(mesh, P("mp"))),
+          *[jax.device_put(d, NamedSharding(mesh, P("mp")))
+            for d in dense_inputs])
+  want = np.concatenate([np.asarray(
+      embedding_lookup(jnp.asarray(weights[0]), p[0], combiner="sum"))
+      for p in per_dev])
+  np.testing.assert_allclose(np.asarray(outs[0]), want, rtol=1e-5,
+                             atol=1e-5)
+
+
+@pytest.mark.parametrize("combiner", ["sum", "mean"])
+def test_fused_training_ragged_row_sliced_matches_padded(combiner):
+  """Fused train step with ragged cats into a ROW-SLICED table must update
+  the table exactly like the padded-equivalent step (round 3: value-stream
+  routing through vocab windows, mean division in assemble, apply skips
+  the double division)."""
+  from distributed_embeddings_tpu.models import bce_loss
+  from distributed_embeddings_tpu.training import (
+      init_sparse_state_direct, make_sparse_train_step, shard_batch,
+      unpack_sparse_state)
+  import flax.linen as nn
+
+  class TinyModel(nn.Module):
+    @nn.compact
+    def __call__(self, numerical, cats, emb_acts=None):
+      x = jnp.concatenate([numerical] + list(emb_acts), axis=1)
+      return jnp.squeeze(nn.Dense(1)(x), -1)
+
+  rng = np.random.default_rng(5)
+  # 8 tables so every rank owns one; table 0 row-slices across ranks
+  tables = [TableConfig(64, 16, combiner=combiner,
+                        initializer="uniform")] + \
+           [TableConfig(24 + i, 16, combiner=combiner,
+                        initializer="uniform") for i in range(7)]
+  world, b_local, max_hot, cap = WORLD, 2, 4, 8
+  b = world * b_local
+
+  per_dev = [_make_ragged(rng, b_local, 64, max_hot, cap)
+             for _ in range(world)]
+  global_ragged = _stack_ragged([p[0] for p in per_dev])
+  padded = jnp.concatenate(
+      [ragged_to_padded(p[0], max_hot) for p in per_dev])
+  dense_cats = [jnp.asarray(rng.integers(0, c.input_dim, (b, 1)), jnp.int32)
+                for c in tables[1:]]
+
+  def build(cats):
+    plan = DistEmbeddingStrategy(tables, world, "basic",
+                                 dense_row_threshold=0,
+                                 row_slice_threshold=16 * 16)
+    assert any(sh.row_sliced for shards in plan.rank_shards
+               for sh in shards)
+    model = TinyModel()
+    rng2 = np.random.default_rng(6)
+    numerical = jnp.asarray(rng2.standard_normal((b, 4)), jnp.float32)
+    labels = jnp.asarray(rng2.integers(0, 2, b), jnp.float32)
+    rule = sgd_rule(0.5)
+    opt = optax.sgd(0.5)
+    dummy = [jnp.zeros((2, 16), jnp.float32) for _ in tables]
+    dp = model.init(jax.random.PRNGKey(0), numerical[:2],
+                    None, emb_acts=dummy)["params"]
+    mesh = create_mesh(world)
+    state = init_sparse_state_direct(plan, rule, dp, opt,
+                                     jax.random.PRNGKey(1))
+    step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
+                                  state, (numerical, tuple(cats), labels),
+                                  donate=False)
+    batch = shard_batch((numerical, tuple(cats), labels), mesh)
+    from distributed_embeddings_tpu.training import (
+        hybrid_partition_specs)
+    from jax.sharding import NamedSharding
+    sspec = hybrid_partition_specs(state, "mp")
+    state_sh = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        state, sspec)
+    state_sh, loss = step(state_sh, *batch)
+    params, _ = unpack_sparse_state(plan, rule, state_sh)
+    return get_weights(plan, params["embeddings"]), float(loss)
+
+  w_r, loss_r = build([global_ragged] + dense_cats)
+  w_p, loss_p = build([padded] + dense_cats)
+  assert abs(loss_r - loss_p) < 1e-5
+  for a, b_ in zip(w_r, w_p):
+    np.testing.assert_allclose(a, b_, rtol=1e-4, atol=1e-5)
