@@ -262,6 +262,10 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
         f"checkpoint save incomplete: only processes {done} of {n_proc} "
         "finished writing (see the failing process's exception); the "
         "partial tmp dir was left for inspection")
+  # every process verified the full marker set BEFORE p0 may remove the
+  # markers / rename tmp away (without this barrier a slow process could
+  # re-check paths p0 already deleted and fail a successful save)
+  _barrier("de_tpu_ckpt_verified")
   if p0:
     for p in range(n_proc):  # markers are transport, not checkpoint data
       os.remove(os.path.join(tmp, f"DONE_p{p}"))

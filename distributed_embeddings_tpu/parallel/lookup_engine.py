@@ -48,7 +48,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..layers.planner import DistEmbeddingStrategy
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # annotation-only: importing layers here would close the
+  # layers/__init__ -> dist_model_parallel -> parallel.lookup_engine cycle
+  # and make `import distributed_embeddings_tpu.parallel` order-dependent
+  from ..layers.planner import DistEmbeddingStrategy
+
 from ..ops.packed_table import (
     PackedLayout,
     SparseRule,
@@ -1081,6 +1087,12 @@ class DistributedLookup:
           "row-sliced tables are not supported with model-parallel inputs "
           "(dp_input=False): every rank holding a row slice needs the full "
           "id stream, which contradicts the mp-input contract")
+    if hotness is not None and any(h < 0 for h in hotness):
+      raise ValueError(
+          "negative hotness entries (the planner's ragged-input hint) are "
+          "not valid in model-parallel input mode: ragged value streams "
+          "only exist for the dp-input exchange. Convert the input with "
+          "ragged_to_padded and pass its static max hotness instead.")
     hotness_of = (lambda i: 1) if hotness is None else \
         (lambda i: hotness[i])  # noqa: E731
     z = {}
@@ -1145,6 +1157,12 @@ def pack_mp_inputs(plan: DistEmbeddingStrategy,
     raise NotImplementedError(
         "row-sliced tables are not supported with model-parallel inputs: "
         "per-rank id streams cannot cover a table split across ranks")
+  if hotness is not None and any(h < 0 for h in hotness):
+    raise ValueError(
+        "negative hotness entries (the planner's ragged-input hint) are "
+        "not valid for pack_mp_inputs: ragged value streams only exist "
+        "for the dp-input exchange. Convert the input with "
+        "ragged_to_padded and pass its static max hotness instead.")
   hotness_of = (lambda i: 1) if hotness is None else \
       (lambda i: hotness[i])  # noqa: E731
   # resolve each (rank, class, slot) to its normalized local input once

@@ -1,0 +1,94 @@
+"""Shared plan-scale harness for the synthetic zoo configs.
+
+One parameterized recipe — shrink vocab (the plan/trace cost under test
+is per-table, not per-row), clamp the generated ids against the shrunken
+tables, build the plan/model/fused state, run ONE fused train step over a
+mesh, and time the pieces — used by three callers that must not drift:
+``tools/plan_scale_dryrun.py`` (whose numbers docs/BENCHMARKS.md
+records), ``tests/test_plan_scale.py`` (CI bound), and
+``__graft_entry__._dryrun_zoo_plan_scale`` (per-round driver check).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def run_zoo_plan_step(name: str, mesh, world: int, b_local: int = 2,
+                      vocab_cap: int = 2000,
+                      dense_row_threshold: int = 16) -> Dict[str, Any]:
+  """Build the ``name`` zoo config at shrunken vocab and run one fused
+  train step over ``mesh``. Returns timings and the loss."""
+  from ..layers.planner import DistEmbeddingStrategy
+  from ..models import (
+      SYNTHETIC_MODELS,
+      SyntheticModel,
+      bce_loss,
+      expand_tables,
+      generate_batch,
+  )
+  from ..ops.packed_table import adagrad_rule
+  from ..training import (
+      init_sparse_state_direct,
+      make_sparse_train_step,
+      shard_batch,
+      shard_params,
+  )
+
+  cfg = SYNTHETIC_MODELS[name]
+  tables, tmap, hotness = expand_tables(cfg)
+  scale = vocab_cap / max(t.input_dim for t in tables)
+  tables = [dataclasses.replace(t, input_dim=max(8, int(t.input_dim * scale)))
+            for t in tables]
+  batch = b_local * world
+
+  t0 = time.perf_counter()
+  plan = DistEmbeddingStrategy(tables, world, "memory_balanced",
+                               input_table_map=tmap,
+                               dense_row_threshold=dense_row_threshold,
+                               input_hotness=hotness, batch_hint=batch)
+  plan_s = time.perf_counter() - t0
+
+  model = SyntheticModel(config=cfg, world_size=world,
+                         dense_row_threshold=dense_row_threshold)
+  numerical, cats, labels = generate_batch(cfg, batch, alpha=1.05, seed=0)
+  cats = [np.minimum(c, tables[t].input_dim - 1).astype(np.int32)
+          for c, t in zip(cats, tmap)]
+  cats = [jnp.asarray(c if h > 1 else c[:, 0])
+          for c, h in zip(cats, hotness)]
+  numerical = jnp.asarray(numerical)
+  labels = jnp.asarray(labels)
+  dummy = [jnp.zeros((2, tables[t].output_dim), jnp.float32) for t in tmap]
+  t0 = time.perf_counter()
+  dense_params = model.init(jax.random.PRNGKey(0), numerical[:2],
+                            [c[:2] for c in cats], emb_acts=dummy)["params"]
+  init_s = time.perf_counter() - t0
+  rule = adagrad_rule(0.01)
+  opt = optax.adagrad(0.01)
+  state = shard_params(
+      init_sparse_state_direct(plan, rule, dense_params, opt,
+                               jax.random.PRNGKey(1)), mesh)
+  batch_tree = shard_batch((numerical, tuple(cats), labels), mesh)
+  step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
+                                state, batch_tree)
+  t0 = time.perf_counter()
+  state, loss = step(state, *batch_tree)
+  loss = float(jax.block_until_ready(loss))
+  step_s = time.perf_counter() - t0
+  return {
+      "name": name,
+      "tables": len(tables),
+      "inputs": len(cats),
+      "classes": len(plan.class_keys),
+      "plan_s": plan_s,
+      "init_s": init_s,
+      "step_s": step_s,
+      "loss": loss,
+  }

@@ -1,0 +1,31 @@
+"""Plan-scale: the medium zoo config (311 tables) must plan fast and
+trace one fused step in bounded time on the 8-device CPU mesh.
+
+The engine's bucket/slot caches (`lookup_engine._bucket_cache`,
+`_slot_map_cache`) exist exactly so thousand-table models don't trace
+quadratically; this pins the property in CI at the 311-table scale
+(large/jumbo at 612/1022 tables run in tools/plan_scale_dryrun.py:
+plan 0.05/0.11 s, one CPU step 83/119 s — recorded in
+docs/BENCHMARKS.md). Shared recipe: `utils/zoo_bench.run_zoo_plan_step`.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_embeddings_tpu.parallel import create_mesh
+from distributed_embeddings_tpu.utils.zoo_bench import run_zoo_plan_step
+
+WORLD = 8
+
+
+@pytest.mark.slow
+def test_medium_zoo_plan_traces_bounded():
+  mesh = create_mesh(WORLD)
+  r = run_zoo_plan_step("medium", mesh, WORLD, vocab_cap=1000)
+  assert np.isfinite(r["loss"])
+  assert r["tables"] == 311
+  assert r["plan_s"] < 5.0, f"plan took {r['plan_s']:.1f}s for 311 tables"
+  assert r["classes"] < 20
+  # generous CI bound; the point is "minutes, not hours" (quadratic trace
+  # at 311 tables would blow far past this)
+  assert r["step_s"] < 300, f"trace+compile+step took {r['step_s']:.0f}s"

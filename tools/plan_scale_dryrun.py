@@ -1,0 +1,45 @@
+"""Plan-scale dryrun for the big synthetic zoo configs (medium -> jumbo).
+
+Builds each config's plan at shrunken vocab, jits one fused train step
+over an 8-virtual-device CPU mesh, and records plan/trace wall time —
+proof that the engine's bucket/slot caches keep thousand-table models
+tractable (`lookup_engine._bucket_cache`; reference scale claim:
+`config_v3.py`). Shared recipe: `utils/zoo_bench.run_zoo_plan_step`.
+
+Usage: PYTHONPATH=/root/repo python tools/plan_scale_dryrun.py [medium|large|jumbo ...]
+"""
+
+import os
+import sys
+
+if "xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""):
+  os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                             + " --xla_force_host_platform_device_count=8"
+                             ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from distributed_embeddings_tpu.parallel import create_mesh  # noqa: E402
+from distributed_embeddings_tpu.utils.zoo_bench import (  # noqa: E402
+    run_zoo_plan_step,
+)
+
+WORLD = 8
+
+
+if __name__ == "__main__":
+  mesh = create_mesh(WORLD)
+  for name in (sys.argv[1:] or ["medium", "large", "jumbo"]):
+    r = run_zoo_plan_step(name, mesh, WORLD)
+    assert np.isfinite(r["loss"]), r
+    print(f"{r['name']:7s}: {r['tables']:5d} tables {r['inputs']:5d} inputs "
+          f"{r['classes']:3d} classes | plan {r['plan_s']:6.2f}s  "
+          f"model-init {r['init_s']:5.1f}s  "
+          f"trace+compile+step {r['step_s']:6.1f}s  "
+          f"loss {r['loss']:.5f}", flush=True)
