@@ -11,6 +11,7 @@ Usage:
 """
 
 import argparse
+import functools
 import json
 import os
 import sys
@@ -49,6 +50,9 @@ CRITEO_1TB_VOCAB = [
 def parse_args():
   p = argparse.ArgumentParser(description=__doc__)
   p.add_argument("--dataset", choices=["dummy", "criteo"], default="dummy")
+  p.add_argument("--eval_every", type=int, default=0,
+                 help="run the AUC eval every N train steps (0 = only at "
+                      "the end, reference cadence is per-epoch)")
   p.add_argument("--dataset_path", default=None,
                  help="split-binary Criteo dir (model_size.json supported)")
   p.add_argument("--batch_size", type=int, default=8192,
@@ -139,6 +143,7 @@ def main():
                strategy=args.strategy,
                column_slice_threshold=args.column_slice_threshold,
                row_slice=args.row_slice,
+               batch_hint=args.batch_size,
                compute_dtype=jnp.bfloat16 if args.amp else jnp.float32)
 
   local_bs = args.batch_size // world
@@ -157,6 +162,8 @@ def main():
         categorical_features=list(range(len(vocab))),
         categorical_feature_sizes=vocab, world_size=world, valid=True)
 
+  print("building model/state ...", flush=True)
+  _t_setup = time.time()
   numerical, cats, labels = train_data[0]
   batch_example = (jnp.asarray(numerical), [jnp.asarray(c) for c in cats],
                    jnp.asarray(labels))
@@ -165,7 +172,8 @@ def main():
   optimizer = optax.sgd(schedule)
   plan = dlrm_embedding_plan(vocab, args.embedding_dim, world,
                              args.strategy, args.column_slice_threshold,
-                             row_slice=args.row_slice)
+                             row_slice=args.row_slice,
+                             batch_hint=args.batch_size)
 
   if args.sparse:
     # fused sparse path: packed tables with row-sparse SGD, full-state
@@ -174,24 +182,43 @@ def main():
     from distributed_embeddings_tpu import checkpoint as ckpt
     from distributed_embeddings_tpu.ops.packed_table import sgd_rule
     from distributed_embeddings_tpu.training import (
-        init_sparse_state,
+        init_sparse_state_direct,
         make_sparse_train_step,
     )
     rule = sgd_rule(schedule)
-    params = model.init(jax.random.PRNGKey(0), batch_example[0],
-                        batch_example[1])["params"]
-    state = init_sparse_state(plan, params, rule, optimizer)
+    # init the DENSE params only (dummy embedding activations skip the
+    # table creation); the packed class buffers are drawn directly in
+    # their physical layout by init_sparse_state_direct — materializing
+    # simple-layout tables first would transiently need ~2.5x the class
+    # bytes and grinds a near-HBM-sized model to a halt (bench.py:96)
+    dummy_acts = [jnp.zeros((2, args.embedding_dim), jnp.float32)
+                  for _ in vocab]
+    dense_params = model.init(
+        jax.random.PRNGKey(0), batch_example[0][:2],
+        [c[:2] for c in batch_example[1]], emb_acts=dummy_acts)["params"]
+    state = init_sparse_state_direct(plan, rule, dense_params, optimizer,
+                                     jax.random.PRNGKey(1))
     state = shard_params(state, mesh)
     if args.checkpoint_dir and os.path.isdir(args.checkpoint_dir):
       state = ckpt.restore(args.checkpoint_dir, plan, rule, state, mesh=mesh)
       print(f"resumed from {args.checkpoint_dir} at step "
             f"{int(jax.device_get(state['step']))}")
+    print(f"sparse state ready in {time.time() - _t_setup:.1f}s", flush=True)
     sparse_step = make_sparse_train_step(model, plan, bce_loss, optimizer,
-                                         rule, mesh, state, batch_example)
+                                         rule, mesh, state, batch_example,
+                                         donate=False)
 
-    def step_fn(carry, *batch):  # unified: carry -> (carry, loss)
-      st, loss = sparse_step(carry, *batch)
-      return st, loss
+    # One jitted wrapper that takes the cats as a SINGLE [B, n_tables]
+    # matrix and splits it on device: feeding 26 separate feature arrays
+    # pays one host->device dispatch latency EACH per step (measured
+    # ~300 ms/step through this host link vs ~30 ms for 3 arrays), which
+    # would bound the pipeline far below the chip's step rate.
+    n_tables = len(vocab)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step_fn(carry, numerical, cats_mat, labels):
+      cats = [cats_mat[:, i] for i in range(n_tables)]
+      return sparse_step(carry, numerical, cats, labels)
 
     carry = state
   else:
@@ -206,29 +233,80 @@ def main():
       return bce_loss(logits, labels)
 
     dense_step = make_train_step(loss_fn, optimizer, mesh, params,
-                                 opt_state, batch_example)
+                                 opt_state, batch_example, donate=False)
+    n_tables = len(vocab)
 
-    def step_fn(carry, *batch):  # unified: carry -> (carry, loss)
-      params, opt_state, loss = dense_step(*carry, *batch)
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step_fn(carry, numerical, cats_mat, labels):
+      cats = [cats_mat[:, i] for i in range(n_tables)]
+      params, opt_state, loss = dense_step(*carry, numerical, cats, labels)
       return (params, opt_state), loss
 
     carry = (params, opt_state)
 
+  _eval_cache = {}
+
+  def run_eval(carry):
+    """Rank-wise AUC over the eval split (reference main.py:222-243).
+    The jitted eval step is built once and reused across cadenced calls."""
+    if "step" not in _eval_cache:
+      if args.sparse:
+        from distributed_embeddings_tpu.training import make_sparse_eval_step
+        raw_eval = make_sparse_eval_step(model, plan, rule, mesh, carry,
+                                         batch_example[:2])
+        _eval_cache["step"] = lambda st, *xs: jax.nn.sigmoid(
+            raw_eval(st, *xs))
+      else:
+        def pred_fn(params, numerical, cats):
+          return jax.nn.sigmoid(model.apply({"params": params}, numerical,
+                                            cats))
+        dense_eval = make_eval_step(pred_fn, mesh, carry[0],
+                                    batch_example[:2])
+        _eval_cache["step"] = lambda st, *xs: dense_eval(st[0], *xs)
+    eval_fn = _eval_cache["step"]
+    all_scores, all_labels = [], []
+    for numerical, cats, labels in eval_data:
+      sharded = shard_batch(
+          (jnp.asarray(numerical), [jnp.asarray(c) for c in cats]), mesh)
+      all_scores.append(np.asarray(eval_fn(carry, *sharded)))
+      all_labels.append(labels)
+    return auc(np.concatenate(all_labels), np.concatenate(all_scores))
+
+  print(f"setup done in {time.time() - _t_setup:.1f}s; first step "
+        "compiles ...", flush=True)
   t_start, losses = time.time(), []
   steps_done = 0
   for epoch in range(args.epochs):
     for batch in train_data:
       numerical, cats, labels = batch
+      # host->device conversion of batch k+1 overlaps the device compute
+      # of step k because steps dispatch asynchronously — as long as
+      # nothing here blocks. The loss is therefore kept as a DEVICE
+      # scalar and only fetched at log points (fetching every step would
+      # sync every step and serialize transfer behind compute), and the
+      # cats travel as ONE stacked matrix (see step_fn).
+      cats_mat = np.stack([np.asarray(c, np.int32) for c in cats], axis=1)
       sharded = shard_batch(
-          (jnp.asarray(numerical), [jnp.asarray(c) for c in cats],
+          (jnp.asarray(numerical), jnp.asarray(cats_mat),
            jnp.asarray(labels)), mesh)
       carry, loss = step_fn(carry, *sharded)
-      losses.append(float(loss))
+      losses.append(loss)
       steps_done += 1
+      if steps_done == 1:
+        print(f"first step (compile) {time.time() - t_start:.1f}s",
+              flush=True)
       if steps_done % 100 == 0:
+        # ONE stacked fetch (a float() per scalar would pay the host
+        # link's round-trip latency 100 times); trim the list so a long
+        # run doesn't pin an unbounded set of device scalars
+        window = np.asarray(jax.device_get(jnp.stack(losses[-100:])))
+        losses = [float(x) for x in window]
         rate = steps_done * args.batch_size / (time.time() - t_start)
-        print(f"step {steps_done} loss {np.mean(losses[-100:]):.5f} "
+        print(f"step {steps_done} loss {window.mean():.5f} "
               f"{rate:,.0f} samples/sec")
+      if args.eval_every and steps_done % args.eval_every == 0:
+        score = run_eval(carry)
+        print(f"step {steps_done} eval AUC: {score:.5f}")
       if args.sparse and args.checkpoint_dir and args.checkpoint_every \
           and steps_done % args.checkpoint_every == 0:
         ckpt.save(args.checkpoint_dir, plan, rule, carry)
@@ -237,6 +315,11 @@ def main():
         break
     if steps_done >= args.steps:
       break
+  # drain the dispatch queue before reading the clock: the loop above only
+  # DISPATCHES steps (that is what lets transfer overlap compute), so the
+  # throughput number must wait for the last step to actually finish
+  if losses:
+    losses = list(np.asarray(jax.device_get(jnp.stack(losses[-10:]))))
   elapsed = time.time() - t_start
   print(f"trained {steps_done} steps in {elapsed:.1f}s "
         f"({steps_done * args.batch_size / max(elapsed, 1e-9):,.0f} samples/sec)"
@@ -247,30 +330,7 @@ def main():
     print(f"saved full train state -> {args.checkpoint_dir}")
 
   if args.eval:
-    if args.sparse:
-      from distributed_embeddings_tpu.training import make_sparse_eval_step
-
-      raw_eval = make_sparse_eval_step(model, plan, rule, mesh, carry,
-                                       batch_example[:2])
-      eval_step = lambda _, *xs: jax.nn.sigmoid(  # noqa: E731
-          raw_eval(carry, *xs))
-      eval_params = None
-    else:
-      def pred_fn(params, numerical, cats):
-        return jax.nn.sigmoid(model.apply({"params": params}, numerical,
-                                          cats))
-
-      eval_step = make_eval_step(pred_fn, mesh, carry[0],
-                                 batch_example[:2])
-      eval_params = carry[0]
-    all_scores, all_labels = [], []
-    for numerical, cats, labels in eval_data:
-      sharded = shard_batch(
-          (jnp.asarray(numerical), [jnp.asarray(c) for c in cats]), mesh)
-      all_scores.append(np.asarray(eval_step(eval_params, *sharded)))
-      all_labels.append(labels)
-    score = auc(np.concatenate(all_labels), np.concatenate(all_scores))
-    print(f"eval AUC: {score:.5f}")
+    print(f"eval AUC: {run_eval(carry):.5f}")
 
   if args.save_checkpoint:
     # global-view numpy table checkpoint (reference
