@@ -37,6 +37,10 @@ import time
 BASELINE_SPS_PER_CHIP = 9157869.0 / 8  # TF32, 8xA100, global batch 65536
 BASELINE_AMP_SPS_PER_CHIP = 10416232.0 / 8  # AMP, 8xA100
 AMP = os.environ.get("BENCH_AMP", "0") == "1"  # bf16 MLP compute
+# BENCH_EXACT=1: the reference fused backward's deduplicated update
+# semantics (sort + unique + segment-sum) instead of the default
+# per-occurrence applies — for measuring what exactness costs
+EXACT = os.environ.get("BENCH_EXACT", "0") == "1"
 CRITEO_1TB_VOCAB = [
     39884406, 39043, 17289, 7420, 20263, 3, 7120, 1543, 63, 38532951,
     2953546, 403346, 10, 2208, 11938, 155, 4, 976, 14, 39979771,
@@ -97,7 +101,7 @@ def run(batch_size: int) -> float:
       lambda: init_sparse_state_direct(plan, rule, dense_params, dense_opt,
                                        jax.random.PRNGKey(1)))
   step = make_sparse_train_step(model, plan, bce_loss, dense_opt, rule,
-                                None, state_avals, batch)
+                                None, state_avals, batch, exact=EXACT)
   compiled = step.lower(state_avals, *batch).compile()
 
   state = init_sparse_state_direct(plan, rule, dense_params, dense_opt,

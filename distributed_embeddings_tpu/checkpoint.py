@@ -25,6 +25,17 @@ Format: a directory
     fused_<class>_r<rank>.npy      packed [phys_rows, phys_width] blocks
     dense.npz                      path-keyed dense params
     dense_opt.npz / emb_dense.npz / emb_dense_opt.npz
+
+Migration note: the manifest's plan fingerprint pins the PHYSICAL layout,
+so checkpoints fail restore (with a diff) whenever a planner default that
+shapes the layout changes. Known cases: DLRM's ``dense_row_threshold``
+default moved 2048 -> 4096 in round 2, and round 3's generation
+assignment (occurrence-balanced / cost-model, ``batch_hint``) can place
+tables into different generations than round 2's first-fit. To restore a
+checkpoint saved under old defaults, rebuild the plan with the SAVING
+run's explicit arguments (e.g. ``dense_row_threshold=2048``, no
+``batch_hint``/``input_hotness``) — the error message lists exactly which
+fingerprint fields differ.
 """
 
 from __future__ import annotations

@@ -81,8 +81,11 @@ def main():
   state_avals = jax.eval_shape(
       lambda: init_sparse_state_direct(plan, rule, dense_params, dense_opt,
                                        jax.random.PRNGKey(1)))
+  # BENCH_EXACT=1: reference dedup semantics (sort-based exact backward)
+  import os
+  exact = os.environ.get("BENCH_EXACT", "0") == "1"
   step = make_sparse_train_step(model, plan, bce_loss, dense_opt, rule,
-                                None, state_avals, batches[0])
+                                None, state_avals, batches[0], exact=exact)
   compiled = step.lower(state_avals, *batches[0]).compile()
   state = init_sparse_state_direct(plan, rule, dense_params, dense_opt,
                                    jax.random.PRNGKey(1))
