@@ -1,0 +1,141 @@
+"""Multi-controller TRAINING: the fused hybrid step across 2 real processes.
+
+The comm backend's pod-scale claim (SURVEY §2.4 "comm backend") needs more
+than single-controller shard_map: this spawns two JAX processes (localhost
+coordinator, 4 virtual CPU devices each -> one GLOBAL 8-device mesh), runs
+the fused sparse train step — dp->mp all_to_all, fused gather, backward
+all_to_all, psum'd dense grads, scatter apply — as a true multi-controller
+SPMD program, and checks both processes compute the SAME finite loss
+sequence, which matches a single-process run of the identical problem.
+
+The reference reaches the same scale with one NCCL/MPI rank per GPU; here
+one jitted program spans processes and XLA runs the collectives.
+"""
+
+import os
+import re
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os, sys
+proc_id = int(sys.argv[1]); port = sys.argv[2]
+n_local = 8 if port == "single" else 4
+os.environ["XLA_FLAGS"] = (
+    f"--xla_force_host_platform_device_count={n_local}")
+os.environ.pop("JAX_PLATFORMS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+if port != "single":
+    jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                               num_processes=2, process_id=proc_id)
+    assert len(jax.devices()) == 8
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+import flax.linen as nn
+
+from distributed_embeddings_tpu.layers.planner import DistEmbeddingStrategy
+from distributed_embeddings_tpu.layers.embedding import TableConfig
+from distributed_embeddings_tpu.models import bce_loss
+from distributed_embeddings_tpu.ops.packed_table import adagrad_rule
+from distributed_embeddings_tpu.training import (
+    hybrid_partition_specs, init_sparse_state_direct, make_sparse_train_step)
+
+WORLD = 8
+tables = [TableConfig(input_dim=48 + 8 * t, output_dim=16, combiner="sum",
+                      initializer="uniform") for t in range(WORLD)]
+plan = DistEmbeddingStrategy(tables, WORLD, "basic",
+                             input_hotness=[1] * WORLD, batch_hint=32)
+rule = adagrad_rule(0.1)
+opt = optax.adagrad(0.1)
+mesh = Mesh(np.array(jax.devices()), ("mp",))
+
+class Head(nn.Module):
+    @nn.compact
+    def __call__(self, numerical, cats, emb_acts=None):
+        x = jnp.concatenate([numerical] + list(emb_acts), axis=1)
+        return jnp.squeeze(nn.Dense(1, name="d")(x), -1)
+
+rng = np.random.default_rng(7)
+B = 32
+numerical_np = rng.standard_normal((B, 4)).astype(np.float32)
+cats_np = [rng.integers(0, t.input_dim, B).astype(np.int32) for t in tables]
+labels_np = rng.integers(0, 2, B).astype(np.float32)
+
+model = Head()
+dummy = [jnp.zeros((2, 16), jnp.float32) for _ in tables]
+dp = model.init(jax.random.PRNGKey(0), jnp.asarray(numerical_np[:2]), None,
+                emb_acts=dummy)["params"]
+state = init_sparse_state_direct(plan, rule, dp, opt, jax.random.PRNGKey(1))
+sspec = hybrid_partition_specs(state, "mp")
+
+def put(x, spec):
+    # multi-controller-safe: every process holds identical host values, so
+    # a global array is assembled from per-device blocks of the same data
+    sh = NamedSharding(mesh, spec)
+    return jax.make_array_from_callback(
+        x.shape, sh, lambda idx, x=x: np.asarray(x[idx]))
+
+state = jax.tree_util.tree_map(
+    lambda x, s: put(np.asarray(x), s), state, sspec)
+batch = (jnp.asarray(numerical_np), [jnp.asarray(c) for c in cats_np],
+         jnp.asarray(labels_np))
+step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
+                              state, batch)
+batch_g = (put(numerical_np, P("mp")),
+           [put(c, P("mp")) for c in cats_np],
+           put(labels_np, P("mp")))
+losses = []
+for i in range(3):
+    state, loss = step(state, *batch_g)
+    # replicated loss: read the local shard (global fetch needs all procs)
+    losses.append(float(np.asarray(loss.addressable_shards[0].data)))
+print("LOSSES", " ".join(f"{l:.6f}" for l in losses))
+assert all(np.isfinite(l) for l in losses)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_training_matches_single(tmp_path):
+  script = tmp_path / "worker.py"
+  script.write_text(_WORKER)
+  with socket.socket() as s:
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+  env = {k: v for k, v in os.environ.items()
+         if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "PYTHONPATH")}
+  env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+
+  # single-process reference on the same 8-device problem
+  ref = subprocess.run([sys.executable, str(script), "0", "single"],
+                       env=env, capture_output=True, text=True, timeout=300)
+  assert ref.returncode == 0, ref.stdout[-2000:] + ref.stderr[-2000:]
+  ref_losses = re.search(r"LOSSES ([\d. -]+)", ref.stdout).group(1).split()
+
+  procs = [subprocess.Popen(
+      [sys.executable, str(script), str(i), str(port)],
+      env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+      for i in range(2)]
+  outs = []
+  try:
+    for p in procs:
+      out, _ = p.communicate(timeout=300)
+      outs.append(out)
+  finally:
+    for p in procs:
+      if p.poll() is None:
+        p.kill()
+        p.wait()
+  per_proc = []
+  for i, (p, out) in enumerate(zip(procs, outs)):
+    assert p.returncode == 0, f"proc {i} rc={p.returncode}\n{out[-3000:]}"
+    per_proc.append(re.search(r"LOSSES ([\d. -]+)", out).group(1).split())
+  # both processes of ONE program agree, and match the single-process run
+  assert per_proc[0] == per_proc[1], per_proc
+  for a, b in zip(per_proc[0], ref_losses):
+    assert abs(float(a) - float(b)) < 1e-5, (per_proc[0], ref_losses)
