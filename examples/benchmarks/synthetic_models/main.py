@@ -83,6 +83,9 @@ def main():
   model = SyntheticModel(config=cfg, world_size=world,
                          strategy=args.strategy,
                          column_slice_threshold=args.column_slice_threshold,
+                         # the planner's scatter-regime cost model needs
+                         # the expected batch (docs/BENCHMARKS.md)
+                         batch_hint=args.batch_size,
                          compute_dtype=jnp.bfloat16 if args.amp
                          else jnp.float32)
 
