@@ -250,7 +250,7 @@ def _grp_sub(layout: PackedLayout, ids: jax.Array):
 
 
 def gather_fused(layout: PackedLayout, buf: jax.Array,
-                 ids: jax.Array) -> jax.Array:
+                 ids: jax.Array, masked_phys: bool = False) -> jax.Array:
   """Gather fused rows: ``[..., stride]`` = (table row | aux rows).
 
   One row-bound gather serves both the lookup and the optimizer-state read
@@ -260,6 +260,17 @@ def gather_fused(layout: PackedLayout, buf: jax.Array,
   grp, sub, _ = _grp_sub(layout, ids)
   g = jnp.take(buf, grp, axis=0, mode="fill", fill_value=0)
   rpp = layout.rows_per_phys
+  if masked_phys:
+    # window-MASKED physical rows [..., rpp*stride]: every lane outside
+    # the occurrence's sub-row window zeroed (one fused VPU select), no
+    # per-occurrence extraction — callers fold the rpp windows at bag
+    # granularity (the multi-hot fast path, lookup_engine._z_sparse_fused)
+    stride = layout.stride
+    g = g[..., :rpp * stride]
+    if rpp == 1:
+      return g
+    win = jax.lax.broadcasted_iota(jnp.int32, (rpp * stride,), 0) // stride
+    return jnp.where(win == sub[..., None], g, 0)
   if rpp == 1:
     return g[..., :layout.stride]
   # sub-row extraction as unrolled static-lane-window selects: exactly one
@@ -277,7 +288,8 @@ def gather_fused(layout: PackedLayout, buf: jax.Array,
 
 def gather_fused_chunked(layout: PackedLayout, buf: jax.Array,
                          ids: jax.Array,
-                         chunk: Optional[int] = None) -> jax.Array:
+                         chunk: Optional[int] = None,
+                         masked_phys: bool = False) -> jax.Array:
   """:func:`gather_fused` with bounded temporaries.
 
   When ``rows_per_phys == 1`` (stride >= 128 lanes — e.g. the width-128
@@ -297,18 +309,21 @@ def gather_fused_chunked(layout: PackedLayout, buf: jax.Array,
   """
   if chunk is None:  # env overrides the DEFAULT only, never an explicit arg
     chunk = _GATHER_CHUNK_ENV or (1 << 22)
+  width = (layout.rows_per_phys * layout.stride if masked_phys
+           else layout.stride)
   flat = ids.reshape(-1)
   n = flat.shape[0]
-  if layout.rows_per_phys == 1 or n <= chunk:
-    return gather_fused(layout, buf, ids)
+  if (layout.rows_per_phys == 1 and not masked_phys) or n <= chunk:
+    return gather_fused(layout, buf, ids, masked_phys=masked_phys)
   nchunks = -(-n // chunk)
   pad = nchunks * chunk - n
   if pad:
     flat = jnp.concatenate([flat, jnp.full((pad,), -1, flat.dtype)])
-  out = jax.lax.map(lambda c: gather_fused(layout, buf, c),
-                    flat.reshape(nchunks, chunk))
-  out = out.reshape(nchunks * chunk, layout.stride)[:n]
-  return out.reshape(ids.shape + (layout.stride,))
+  out = jax.lax.map(
+      lambda c: gather_fused(layout, buf, c, masked_phys=masked_phys),
+      flat.reshape(nchunks, chunk))
+  out = out.reshape(nchunks * chunk, width)[:n]
+  return out.reshape(ids.shape + (width,))
 
 
 def _use_pallas_apply() -> bool:

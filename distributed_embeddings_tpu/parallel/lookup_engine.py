@@ -232,11 +232,15 @@ class SparseResiduals:
   the optimizer-state rows that rode along in the forward gather."""
 
   ids_all: Dict[tuple, jax.Array]  # bk -> [n_b, G, h]
-  # bk -> [n_b, G, h, stride]: the RAW fused gather rows (table + aux lanes)
-  # when the rule has aux state, else an empty [..., 0] slice. The apply
-  # slices the aux lanes off inside the delta computation, where the slice
-  # fuses with the rule math instead of costing a per-occurrence relayout
-  # right after the gather (measured ~25 ns/row, tools/profile_tiny_buckets).
+  # Per-occurrence rows feeding the apply's aux extraction, in TWO layouts
+  # distinguished by the trailing dim (aux_occ in apply_sparse dispatches
+  # on it): [n_b, G, h, stride] RAW fused gather rows (1-hot and ragged
+  # paths; empty [..., 0] slice when the rule has no aux state), or
+  # [n_b, G, h, rpp*stride] window-MASKED physical rows (the multi-hot
+  # narrow fast path — exactly one sub-row window nonzero, so summing the
+  # windows' aux halves extracts the occurrence's state). Slicing aux
+  # lanes here per occurrence instead would cost a ~25 ns/row relayout
+  # right after the gather (measured, tools/profile_tiny_buckets).
   aux_rows: Dict[tuple, jax.Array]
 
   def tree_flatten(self):
@@ -661,6 +665,31 @@ class DistributedLookup:
       fused = gather_fused_chunked(layout, buf_local, vals)
       aux = fused if layout.n_aux else fused[..., w:]
       return self._combine_ragged(fused[..., :w], vals, lens, key, rs), aux
+    if (layout.rows_per_phys > 1 and layout.n_aux and ids_all.ndim == 3
+        and ids_all.shape[-1] > 1):
+      # Multi-hot narrow class: keep the whole pipeline at PHYSICAL width.
+      # Gathered rows are window-MASKED per occurrence (zero outside the
+      # occurrence's sub-row window — a fused VPU select), the bag combine
+      # sums at 128 lanes, and the rpp windows fold ONCE PER BAG instead
+      # of extracting once per occurrence (the extraction adds measured
+      # ~14 ms/step on Tiny's traces). The residual is the masked
+      # phys-width rows; the apply folds their aux halves per occurrence.
+      masked = gather_fused_chunked(layout, buf_local, ids_all,
+                                    masked_phys=True)
+      cp = self.plan.classes[key]
+      if cp.combiner is None:
+        raise ValueError("combiner=None requires hotness-1 inputs in the "
+                         "distributed path (2-D model-parallel outputs)")
+      bag = jnp.sum(masked, axis=2)  # [n_b, G, rpp*stride]
+      rpp, stride = layout.rows_per_phys, layout.stride
+      folded = jnp.sum(
+          bag.reshape(bag.shape[:-1] + (rpp, stride)), axis=-2)
+      z = folded[..., :w]
+      if cp.combiner == "mean" and not rs:
+        sentinel = padded_rows(self.plan, key)
+        counts = jnp.sum(ids_all < sentinel, axis=2).astype(z.dtype)
+        z = z / jnp.maximum(counts, 1)[..., None]
+      return z, masked
     fused = gather_fused_chunked(layout, buf_local, ids_all)  # [n_b,G,h,stride]
     if layout.n_aux == 0:
       # stride == width: no aux lanes ride along, nothing to defer
@@ -939,11 +968,25 @@ class DistributedLookup:
     plan = self.plan
 
     def aux_occ(aux, layout):
-      """Residual fused rows -> per-occurrence aux rows [-1, n_aux, w]."""
+      """Residual rows -> per-occurrence aux rows [-1, n_aux, w].
+
+      Residuals come in two layouts: stride-width fused rows (1-hot /
+      ragged paths) or window-MASKED phys-width rows (multi-hot narrow
+      path) — for the latter, exactly one sub-row window is nonzero, so
+      summing the rpp windows' aux halves extracts it."""
       if aux is None or not rule.n_aux:
         return None
-      flat = aux.reshape(-1, layout.stride)
-      return flat[:, layout.width:].reshape(-1, rule.n_aux, layout.width)
+      w, stride, rpp = layout.width, layout.stride, layout.rows_per_phys
+      last = aux.shape[-1]
+      flat = aux.reshape(-1, last)
+      if last == stride:
+        lanes = flat[:, w:]
+      else:  # masked phys rows [.., rpp*stride]
+        lanes = None
+        for s in range(rpp):
+          part = flat[:, s * stride + w:(s + 1) * stride]
+          lanes = part if lanes is None else lanes + part
+      return lanes.reshape(-1, rule.n_aux, w)
 
     by_class: Dict[str, list] = {}
     for bk, dzb in d_z.items():

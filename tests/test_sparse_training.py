@@ -30,6 +30,7 @@ from distributed_embeddings_tpu.layers.dist_model_parallel import (
     get_weights,
     set_weights,
 )
+from distributed_embeddings_tpu.layers.embedding import TableConfig
 from distributed_embeddings_tpu.layers.planner import DistEmbeddingStrategy
 from distributed_embeddings_tpu.ops.packed_table import (
     PackedLayout,
@@ -43,6 +44,7 @@ from distributed_embeddings_tpu.ops.sparse_grad import dedup_rows
 from distributed_embeddings_tpu.parallel import create_mesh
 from distributed_embeddings_tpu.training import (
     init_sparse_state,
+    init_sparse_state_direct,
     make_sparse_train_step,
     make_train_step,
     shard_batch,
@@ -517,3 +519,82 @@ def test_shard_batch_rejects_indivisible_global_batch():
   mesh = create_mesh(8)
   with pytest.raises(ValueError, match="not divisible"):
     shard_batch((jnp.zeros((10, 4)),), mesh)
+
+
+@pytest.mark.parametrize("combiner", ["sum"])
+def test_multihot_masked_path_matches_onehot_decomposition(combiner):
+  """The multi-hot narrow fast path (window-masked phys-width residuals,
+  round 3) must produce EXACTLY the updates of the mathematically
+  equivalent decomposition into h shared-table 1-hot inputs (which takes
+  the stride-width residual path): same forward sum, same per-occurrence
+  Adagrad deltas from forward-time state."""
+  import flax.linen as nn
+  from distributed_embeddings_tpu.layers.dist_model_parallel import (
+      get_weights,
+  )
+  from distributed_embeddings_tpu.models import bce_loss
+  from distributed_embeddings_tpu.training import unpack_sparse_state
+
+  h, b, vocab, w = 5, 16, 300, 16  # w16+acc: stride 32, rpp 4 -> masked path
+  rng = np.random.default_rng(11)
+  ids = rng.integers(0, vocab, (b, h)).astype(np.int32)
+  # force duplicates inside bags to exercise the per-occurrence semantics
+  ids[:, 1] = ids[:, 0]
+  numerical = rng.standard_normal((b, 4)).astype(np.float32)
+  labels = rng.integers(0, 2, b).astype(np.float32)
+
+  class HeadMulti(nn.Module):
+    @nn.compact
+    def __call__(self, numerical, cats, emb_acts=None):
+      x = jnp.concatenate([numerical, emb_acts[0]], axis=1)
+      return jnp.squeeze(nn.Dense(1, name="d")(x), -1)
+
+  class HeadSplit(nn.Module):
+    @nn.compact
+    def __call__(self, numerical, cats, emb_acts=None):
+      summed = sum(emb_acts[1:], emb_acts[0])
+      x = jnp.concatenate([numerical, summed], axis=1)
+      return jnp.squeeze(nn.Dense(1, name="d")(x), -1)
+
+  def train(variant):
+    if variant == "multi":
+      tables = [TableConfig(vocab, w, combiner=combiner,
+                            initializer="uniform")]
+      tmap, cats = [0], [jnp.asarray(ids)]
+      model = HeadMulti()
+    else:
+      tables = [TableConfig(vocab, w, combiner=combiner,
+                            initializer="uniform")]
+      tmap = [0] * h
+      cats = [jnp.asarray(ids[:, j]) for j in range(h)]
+      model = HeadSplit()
+    plan = DistEmbeddingStrategy(tables, 1, "basic", input_table_map=tmap,
+                                 dense_row_threshold=0)
+    rule = adagrad_rule(0.5)
+    opt = optax.adagrad(0.5)
+    dummy = [jnp.zeros((2, w), jnp.float32) for _ in tmap]
+    dp = model.init(jax.random.PRNGKey(0), jnp.asarray(numerical[:2]), None,
+                    emb_acts=dummy)["params"]
+    state = init_sparse_state_direct(plan, rule, dp, opt,
+                                     jax.random.PRNGKey(1))
+    step = make_sparse_train_step(model, plan, bce_loss, opt, rule, None,
+                                  state, (jnp.asarray(numerical), cats,
+                                          jnp.asarray(labels)),
+                                  donate=False)
+    for _ in range(2):
+      state, loss = step(state, jnp.asarray(numerical), cats,
+                         jnp.asarray(labels))
+    params, aux = unpack_sparse_state(plan, rule, state, include_aux=True)
+    (table,) = get_weights(plan, params["embeddings"])
+    return table, aux, float(loss)
+
+  t_multi, aux_m, loss_m = train("multi")
+  t_split, aux_s, loss_s = train("split")
+  assert abs(loss_m - loss_s) < 1e-6
+  np.testing.assert_allclose(t_multi, t_split, rtol=1e-5, atol=1e-6)
+  # the Adagrad accumulators (extracted through BOTH residual layouts by
+  # the two variants' applies) must agree too
+  for a_m, a_s in zip(aux_m.values(), aux_s.values()):
+    for x, y in zip(a_m, a_s):
+      np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                 rtol=1e-5, atol=1e-6)
