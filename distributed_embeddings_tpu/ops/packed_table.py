@@ -366,7 +366,12 @@ def scatter_add_fused(layout: PackedLayout, buf: jax.Array, ids: jax.Array,
   else:
     # narrow rows: expand the sub-row delta to the full physical row (the
     # RMW below is per PHYSICAL row either way); duplicates on the same
-    # physical row still accumulate
+    # physical row still accumulate. Keep the one-hot einsum form: its
+    # [.., rpp, stride] output costs a lane-merging relayout copy
+    # (~8 ms/step on Tiny, traced) but a tile+where form fuses the select
+    # INTO the scatter's update loop and de-optimizes it ~40x (5.7 s/step
+    # measured round 3) — the same fusion hazard the apply's
+    # optimization_barrier guards against.
     oh = jax.nn.one_hot(sub, rpp, dtype=fused_delta.dtype)
     upd = jnp.einsum("...s,...r->...rs", fused_delta, oh)
     upd = upd.reshape(ids.shape + (rpp * layout.stride,))
