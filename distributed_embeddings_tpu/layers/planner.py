@@ -607,6 +607,16 @@ class DistEmbeddingStrategy:
                    max(1, self.max_class_bytes // (width * 4)))
     total = sum(sh.input_dim for sh in group)
     largest = max(sh.input_dim for sh in group)
+    if largest > rows_hard:
+      big = max(group, key=lambda sh: sh.input_dim)
+      raise ValueError(
+          f"table {big.table_id}'s shard of {big.input_dim:,} rows x "
+          f"width {width} exceeds one TPU buffer (2^31 elements ~= "
+          f"{rows_hard:,} rows at this width under a packed optimizer "
+          "slot) and a generation cannot split a single shard. Shard it "
+          "finer: more workers, a smaller row_slice threshold (slices are "
+          "capped at min(2^k, world)), or column slicing "
+          "(column_slice_threshold).")
     n_min = max(1, -(-total // cap_rows))
     order = sorted(group, key=lambda sh: (-occ_of[sh.table_id],
                                           -sh.input_dim, sh.table_id))
