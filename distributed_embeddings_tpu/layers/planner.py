@@ -607,16 +607,31 @@ class DistEmbeddingStrategy:
                    max(1, self.max_class_bytes // (width * 4)))
     total = sum(sh.input_dim for sh in group)
     largest = max(sh.input_dim for sh in group)
-    if largest > rows_hard:
+    # The plan doesn't know the optimizer yet, so the hard error uses the
+    # aux-free bound (illegal for ANY rule); the 1-aux estimate only warns.
+    # The exact check (actual n_aux) lives in DistributedLookup.fused_layouts.
+    stride0 = width
+    rpp0 = max(1, 128 // stride0)
+    pw0 = max(128, -(-stride0 // 128) * 128)
+    rows_hard_noaux = max(1, int((2 ** 31) // (pw0 / rpp0)))
+    if largest > rows_hard_noaux:
       big = max(group, key=lambda sh: sh.input_dim)
       raise ValueError(
           f"table {big.table_id}'s shard of {big.input_dim:,} rows x "
           f"width {width} exceeds one TPU buffer (2^31 elements ~= "
-          f"{rows_hard:,} rows at this width under a packed optimizer "
-          "slot) and a generation cannot split a single shard. Shard it "
-          "finer: more workers, a smaller row_slice threshold (slices are "
-          "capped at min(2^k, world)), or column slicing "
-          "(column_slice_threshold).")
+          f"{rows_hard_noaux:,} rows at this width) and a generation "
+          "cannot split a single shard. Shard it finer: more workers, a "
+          "smaller row_slice threshold (slices are capped at "
+          "min(2^k, world)), or column slicing (column_slice_threshold).")
+    if largest > rows_hard:
+      import warnings
+      big = max(group, key=lambda sh: sh.input_dim)
+      warnings.warn(
+          f"table {big.table_id}'s shard of {big.input_dim:,} rows x "
+          f"width {width} fits one TPU buffer only WITHOUT packed "
+          f"optimizer state (> {rows_hard:,} rows at one aux slot); "
+          "training with Adagrad-style rules will fail the exact check "
+          "in DistributedLookup.fused_layouts — shard finer for training.")
     n_min = max(1, -(-total // cap_rows))
     order = sorted(group, key=lambda sh: (-occ_of[sh.table_id],
                                           -sh.input_dim, sh.table_id))

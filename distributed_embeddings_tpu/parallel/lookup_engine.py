@@ -325,8 +325,16 @@ class DistributedLookup:
       cp = self.plan.classes[key]
       if cp.kind != "sparse":
         continue
-      layouts[class_param_name(*key)] = PackedLayout(
+      layout = PackedLayout(
           rows=padded_rows(self.plan, key), width=cp.width, n_aux=rule.n_aux)
+      if layout.phys_rows * layout.phys_width > 2 ** 31:
+        raise ValueError(
+            f"class {class_param_name(*key)}: per-rank packed buffer "
+            f"[{layout.phys_rows:,} x {layout.phys_width}] exceeds XLA's "
+            f"2^31-element indexing under rule {rule.name!r} "
+            f"(n_aux={rule.n_aux}). Shard finer (more workers, smaller "
+            "row/column slice thresholds, or a smaller max_class_bytes).")
+      layouts[class_param_name(*key)] = layout
     return layouts
 
   # ---- dp-side routing ---------------------------------------------------

@@ -41,19 +41,19 @@ def test_colossal_full_scale_plan_with_row_slicing():
   from distributed_embeddings_tpu.layers.planner import DistEmbeddingStrategy
   from distributed_embeddings_tpu.models import SYNTHETIC_MODELS, expand_tables
 
-  import pytest as _pytest
   cfg = SYNTHETIC_MODELS["colossal"]
   tables, tmap, hotness = expand_tables(cfg)
   assert max(t.input_dim for t in tables) == 2_000_000_000
-  # at world 64 the 2B-row width-256 giant CANNOT legally shard (row
-  # slices are capped at `world`, leaving 31M-row x 512-lane shards over
-  # XLA's 2^31-element buffer limit) — the planner must say so up front
-  # instead of failing cryptically inside XLA at runtime
-  with _pytest.raises(ValueError, match="exceeds one TPU buffer"):
+  # at world 64 the 2B-row width-256 giant CANNOT legally shard even at
+  # a tight row_slice threshold: slices are capped at min(2^k, world),
+  # leaving 31.25M-row x 256-lane shards over XLA's 2^31-element buffer
+  # limit — the planner must say so up front instead of failing
+  # cryptically inside XLA at runtime
+  with pytest.raises(ValueError, match="exceeds one TPU buffer"):
     DistEmbeddingStrategy(
         tables, 64, "memory_balanced", input_table_map=tmap,
         dense_row_threshold=4096, input_hotness=hotness, batch_hint=65536,
-        row_slice_threshold=200_000_000 * 256)
+        row_slice_threshold=2_000_000 * 256)
   # at pod scale (1024 workers) it plans legally
   world = 1024
   t0 = time.perf_counter()

@@ -285,8 +285,12 @@ def test_planner_scales_to_colossal_table_counts():
   tables = [dataclasses.replace(t, input_dim=max(8, t.input_dim // 1000))
             for t in tables]
   t0 = time.perf_counter()
+  # threshold 8 preserves the original dense/sparse split under the
+  # scaled vocabs (only the hundred 10-row tables ride the dense path,
+  # exactly as threshold 2048 selected at full vocab) so the test still
+  # times the sparse placement/fusion loops over ~1900 tables
   plan = DistEmbeddingStrategy(tables, 128, "memory_balanced",
                                input_table_map=tmap,
-                               dense_row_threshold=2048)
+                               dense_row_threshold=8)
   assert time.perf_counter() - t0 < 5.0
   assert sum(len(s) for s in plan.rank_shards) >= len(tables)
