@@ -122,8 +122,25 @@ def run(batch_size: int) -> float:
   return max((t2 - t1) / STEPS, 1e-9)
 
 
+def smoke():
+  """Hardware gate: the Pallas RMW apply kernel's directed + randomized
+  cases run on the real chip BEFORE the bench (sequenced — the chip is
+  single-tenant), so a Mosaic regression in the DMA/semaphore path can
+  never ship a silently-wrong bench number. In-process (one TPU client);
+  prints to stderr to keep stdout's one-JSON-line contract. Skipped only
+  by BENCH_SKIP_SMOKE=1 or when re-exec'd for the OOM fallback."""
+  import contextlib
+
+  sys.path.insert(0, os.path.join(os.path.dirname(__file__), "tools"))
+  import smoke_pallas_apply
+  with contextlib.redirect_stdout(sys.stderr):
+    smoke_pallas_apply.main()  # sys.exit(1) inside on any failure
+
+
 def main():
   batch = CUR_BATCH
+  if os.environ.get("BENCH_SKIP_SMOKE", "0") != "1" and batch == BATCH:
+    smoke()
   try:
     sec = run(batch)
   except Exception as e:  # noqa: BLE001 - OOM fallback, report honestly

@@ -28,13 +28,15 @@ Format: a directory
 
 Migration note: the manifest's plan fingerprint pins the PHYSICAL layout,
 so checkpoints fail restore (with a diff) whenever a planner default that
-shapes the layout changes. Known cases: DLRM's ``dense_row_threshold``
-default moved 2048 -> 4096 in round 2, and round 3's generation
-assignment (occurrence-balanced / cost-model, ``batch_hint``) can place
-tables into different generations than round 2's first-fit. To restore a
-checkpoint saved under old defaults, rebuild the plan with the SAVING
-run's explicit arguments (e.g. ``dense_row_threshold=2048``, no
-``batch_hint``/``input_hotness``) — the error message lists exactly which
+shapes the layout changes. Layout-shaping defaults that have moved:
+``dense_row_threshold`` 2048 -> 4096 (round 2), ``max_class_bytes``
+2 GiB -> 3 GiB (round 3), and round 3's generation assignment
+(occurrence-balanced / cost-model) replacing round 2's first-fit. To
+restore a checkpoint saved under old defaults, rebuild the plan with the
+SAVING run's explicit arguments — e.g. ``dense_row_threshold=2048,
+max_class_bytes=2 * 1024**3, gen_assignment='first_fit'`` for a round-2
+checkpoint (``gen_assignment='first_fit'`` reproduces the legacy
+generation layout exactly) — the error message lists exactly which
 fingerprint fields differ.
 """
 
@@ -266,8 +268,20 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
   _barrier("de_tpu_ckpt_written")
   if err is not None:
     raise err
-  done = [p for p in range(n_proc)
-          if os.path.exists(os.path.join(tmp, f"DONE_p{p}"))]
+  # Every process verifies the marker set, POLLING briefly: on NFS-style
+  # shared filesystems with attribute/directory caching another process's
+  # just-written marker can lag visibility for a few seconds, and a
+  # successful save must not be declared incomplete for it. All processes
+  # check (not just p0) so that when one process failed, every survivor
+  # raises instead of hanging at the final barrier.
+  import time
+  deadline = time.monotonic() + 30.0
+  while True:
+    done = [p for p in range(n_proc)
+            if os.path.exists(os.path.join(tmp, f"DONE_p{p}"))]
+    if len(done) == n_proc or time.monotonic() >= deadline:
+      break
+    time.sleep(0.2)
   if len(done) != n_proc:
     raise RuntimeError(
         f"checkpoint save incomplete: only processes {done} of {n_proc} "

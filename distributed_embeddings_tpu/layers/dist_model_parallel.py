@@ -223,8 +223,11 @@ def _fetch_rows(arr, row0: int, n: int, width: int,
     for s0, s1, shard in addressable_row_spans(arr):
       lo, hi = max(s0, row0), min(s1, row0 + n)
       if lo < hi:
-        data = np.asarray(shard.data)
-        out[lo - row0:hi - row0] = data[lo - s0:hi - s0]
+        # slice ON DEVICE before the host copy: a small window over a
+        # multi-GiB local shard must not stage the whole shard on host
+        # (this function's bounded-host-memory contract)
+        out[lo - row0:hi - row0] = np.asarray(
+            shard.data[lo - s0:hi - s0])
         have[lo - row0:hi - row0] = True
     if not have.all():
       raise RuntimeError(

@@ -95,7 +95,8 @@ _NAMED_REGULARIZERS = {
 
 
 def resolve_regularizer(spec) -> Optional[Callable[[jax.Array], jax.Array]]:
-  """``None`` | Keras name ('l1'/'l2'/'l1_l2') | callable -> callable.
+  """``None`` | Keras name ('l1'/'l2'/'l1_l2') | ``{'name': .., 'factor': ..}``
+  | callable -> callable.
 
   The callable maps a weight array to a scalar penalty added to the loss
   (Keras regularizer semantics, defaults matching ``keras.regularizers``)."""
@@ -103,12 +104,39 @@ def resolve_regularizer(spec) -> Optional[Callable[[jax.Array], jax.Array]]:
     return None
   if callable(spec):
     return spec
+  if isinstance(spec, dict):
+    d = {str(k).lower(): v for k, v in spec.items()}
+    name = str(d.get("name", "")).lower()
+    if name in ("l1", "l2"):
+      factor = float(d.get("factor", d.get(name, 0.01)))
+      return (_l1 if name == "l1" else _l2)(factor)
+    if name == "l1_l2":
+      f1 = float(d.get("l1", 0.01))
+      f2 = float(d.get("l2", 0.01))
+      return lambda w: (f1 * jnp.sum(jnp.abs(w))
+                        + f2 * jnp.sum(jnp.square(w)))
+    raise ValueError(f"Unknown regularizer spec {spec!r}")
   if isinstance(spec, str):
     key = spec.lower()
     if key in _NAMED_REGULARIZERS:
       return _NAMED_REGULARIZERS[key]()
     raise ValueError(f"Unknown regularizer {spec!r}")
   raise TypeError(f"Cannot resolve regularizer from {spec!r}")
+
+
+def l2_decay_factor(spec) -> Optional[float]:
+  """λ when ``spec`` is a recognizable PURE-l2 regularizer, else None.
+
+  The fused sparse path can fold exactly this form into its per-occurrence
+  deltas (``SparseRule.weight_decay``); every other regularizer shape
+  (l1, custom callables) has no additive touched-rows form."""
+  if isinstance(spec, str) and spec.lower() == "l2":
+    return 0.01  # keras.regularizers.l2 default
+  if isinstance(spec, dict):
+    d = {str(k).lower(): v for k, v in spec.items()}
+    if str(d.get("name", "")).lower() == "l2":
+      return float(d.get("factor", d.get("l2", 0.01)))
+  return None
 
 
 def _max_norm(max_value=2.0, eps=1e-7):

@@ -298,7 +298,8 @@ class DistEmbeddingStrategy:
                max_class_bytes: int = 3 * 1024 ** 3,
                row_slice_threshold: Optional[int] = None,
                input_hotness: Optional[Sequence[int]] = None,
-               batch_hint: Optional[int] = None):
+               batch_hint: Optional[int] = None,
+               gen_assignment: str = "auto"):
     if strategy not in ("basic", "memory_balanced", "memory_optimized"):
       raise ValueError(f"Unsupported shard strategy {strategy}")
     self.strategy = "basic" if world_size == 1 else strategy
@@ -311,6 +312,19 @@ class DistEmbeddingStrategy:
     # ConcatOneHotEmbedding, `embedding.py:155-180`).
     self.dense_row_threshold = dense_row_threshold
     self.global_configs = _normalize_configs(embeddings)
+    for t, c in enumerate(self.global_configs):
+      # Routing tensors carry GLOBAL ids as int32 (the all_to_all /
+      # gather dtype every measured path uses; the reference registers an
+      # int64 variant, `embedding_lookup_ops.cc:24-88`). A table whose id
+      # space exceeds int32 cannot be represented — fail at plan time
+      # rather than silently folding ids at the engine's cast.
+      if c.input_dim > 2 ** 31 - 1:
+        raise ValueError(
+            f"table {t} has input_dim={c.input_dim:,} > int32 max "
+            f"({2 ** 31 - 1:,}): ids are routed as int32 and cannot "
+            "address this table. Split the id space across several "
+            "tables (an input_table_map entry per split, with a "
+            "host-side id fold), or reduce the vocabulary.")
     num_tables = len(self.global_configs)
     if input_table_map is None:
       input_table_map = list(range(num_tables))
@@ -465,18 +479,58 @@ class DistEmbeddingStrategy:
     # exceed max_class_bytes (min'd with the element limit) unless a
     # single shard alone does.
     self.max_class_bytes = max_class_bytes
+    if gen_assignment not in ("auto", "first_fit"):
+      raise ValueError(
+          f"gen_assignment must be 'auto' or 'first_fit', got "
+          f"{gen_assignment!r}")
+    self.gen_assignment = gen_assignment
     occ_of = [0.0] * num_tables
     for i, t in enumerate(self.input_table_map):
       # negative entries are ragged markers; |h| is the occurrence weight
       occ_of[t] += (abs(self.input_hotness[i])
                     if self.input_hotness is not None else 1)
-    for shards in self.rank_shards:
-      by_base: Dict[tuple, List] = {}
-      for sh in shards:
-        by_base.setdefault(
-            (sh.width, sh.combiner, self._kind_of(sh)), []).append(sh)
-      for base, group in by_base.items():
-        self._assign_generations(base[0], group, occ_of)
+    if gen_assignment == "first_fit":
+      # Legacy (round-2) layout: first-fit in shard order against the byte
+      # cap. Exists so checkpoints written under the old assignment stay
+      # restorable (pass gen_assignment='first_fit' plus the saving run's
+      # max_class_bytes — the checkpoint manifest's layout diff names the
+      # mismatch otherwise). Performance-wise the occurrence-balanced
+      # default dominates it (docs/BENCHMARKS.md, scatter-regime matrix).
+      for shards in self.rank_shards:
+        gen_rows: Dict[tuple, List[int]] = {}
+        for sh in shards:
+          base = (sh.width, sh.combiner, self._kind_of(sh))
+          # same plan-time hard error as the auto mode (a generation
+          # cannot split a shard, and one shard past the 2^31-element
+          # buffer limit is untrainable regardless of assignment)
+          pw0 = max(128, -(-sh.width // 128) * 128)
+          rows_hard = max(1, int((2 ** 31)
+                                 // (pw0 / max(1, 128 // sh.width))))
+          if sh.input_dim > rows_hard:
+            raise ValueError(
+                f"table {sh.table_id}'s shard of {sh.input_dim:,} rows x "
+                f"width {sh.width} exceeds one TPU buffer (2^31 elements "
+                f"~= {rows_hard:,} rows at this width). Shard it finer: "
+                "more workers, a smaller row_slice threshold, or column "
+                "slicing.")
+          rows_list = gen_rows.setdefault(base, [0])
+          cap_rows = max(1, max_class_bytes // (sh.width * 4))
+          for g, r in enumerate(rows_list):
+            if r == 0 or r + sh.input_dim <= cap_rows:
+              sh.gen = g
+              rows_list[g] += sh.input_dim
+              break
+          else:
+            sh.gen = len(rows_list)
+            rows_list.append(sh.input_dim)
+    else:
+      for shards in self.rank_shards:
+        by_base: Dict[tuple, List] = {}
+        for sh in shards:
+          by_base.setdefault(
+              (sh.width, sh.combiner, self._kind_of(sh)), []).append(sh)
+        for base, group in by_base.items():
+          self._assign_generations(base[0], group, occ_of)
 
     class_keys: List[ClassKey] = []
     for shards in self.rank_shards:

@@ -418,12 +418,22 @@ class SparseRule:
   fused op. Exact deduplicated semantics (the reference fused backward,
   `embedding_lookup_kernels.cu:464-633`) are available via the engine's
   ``exact=True`` path.
-  """
+
+  ``weight_decay`` (λ of a Keras-style ``l2(λ)`` penalty, reference
+  `embedding.py:64-70`): when nonzero the engine adds ``2*λ*row`` to each
+  occurrence's cotangent before ``delta`` — l2 decay on TOUCHED rows, per
+  occurrence (under ``exact=True``: once per unique touched row). This is
+  the sparse-path counterpart of the reference's full-table penalty: rows
+  never looked up are not decayed (a dense sweep over terabyte tables is
+  exactly what the sparse path exists to avoid), and the reported loss
+  carries the data term only. Set via ``dataclasses.replace`` or the
+  training builder, which folds a uniform table ``regularizer='l2'`` in."""
 
   name: str
   n_aux: int
   aux_init: Sequence[float]
   delta: callable
+  weight_decay: float = 0.0
 
   def init_aux(self, rows: int, width: int, dtype=jnp.float32) -> List:
     return [np.full((rows, width), v, dtype) for v in self.aux_init]
@@ -463,7 +473,60 @@ def adagrad_rule(learning_rate, initial_accumulator_value: float = 0.1,
   return SparseRule("adagrad", 1, (initial_accumulator_value,), delta)
 
 
-_RULES = {"sgd": sgd_rule, "adagrad": adagrad_rule}
+def momentum_rule(learning_rate, momentum: float = 0.9,
+                  nesterov: bool = False) -> SparseRule:
+  """Row-sparse SGD with momentum matching ``optax.sgd(lr, momentum)``.
+
+  m' = momentum * m + g; table -= lr * m' (nesterov: lr * (g + momentum *
+  m')). The momentum buffer rides in the fused row, so the whole update is
+  one scatter-add of ``[-lr*upd | (momentum-1)*m + g]``. With duplicate
+  ids each occurrence reads the forward-time m (per-occurrence semantics,
+  see :class:`SparseRule`); the reference gets the same rule from TF's
+  ``SGD(momentum=...)`` sparse apply.
+  """
+
+  def delta(g, aux_rows, step):
+    m = aux_rows[..., 0, :]
+    m_new = momentum * m + g
+    upd = (g + momentum * m_new) if nesterov else m_new
+    lr = _lr_at(learning_rate, step)
+    return jnp.concatenate([-lr * upd, m_new - m], axis=-1)
+
+  return SparseRule("momentum", 1, (0.0,), delta)
+
+
+def adam_rule(learning_rate, b1: float = 0.9, b2: float = 0.999,
+              eps: float = 1e-8) -> SparseRule:
+  """Row-sparse Adam matching ``optax.adam``'s update rule.
+
+  m' = b1*m + (1-b1)*g; v' = b2*v + (1-b2)*g^2; bias-corrected with
+  ``t = step + 1``; table -= lr * m_hat / (sqrt(v_hat) + eps). Both
+  moments ride in the fused row (``n_aux=2``), so the whole update is one
+  scatter-add of ``[-lr*upd | dm | dv]``. Note Adam's bias correction
+  uses the GLOBAL step count as t for every row (optax/TF semantics for
+  dense Adam); TF's sparse Adam does the same — rows touched rarely are
+  still corrected by the global t.
+  """
+
+  def delta(g, aux_rows, step):
+    m = aux_rows[..., 0, :]
+    v = aux_rows[..., 1, :]
+    dm = (1.0 - b1) * (g - m)
+    dv = (1.0 - b2) * (g * g - v)
+    m_new = m + dm
+    v_new = v + dv
+    t = (step + 1).astype(jnp.float32)
+    m_hat = m_new / (1.0 - jnp.power(b1, t))
+    v_hat = v_new / (1.0 - jnp.power(b2, t))
+    lr = _lr_at(learning_rate, step)
+    upd = m_hat / (jnp.sqrt(v_hat) + eps)
+    return jnp.concatenate([-lr * upd, dm, dv], axis=-1)
+
+  return SparseRule("adam", 2, (0.0, 0.0), delta)
+
+
+_RULES = {"sgd": sgd_rule, "adagrad": adagrad_rule,
+          "momentum": momentum_rule, "adam": adam_rule}
 
 
 def sparse_rule(name: str, learning_rate, **kwargs) -> SparseRule:

@@ -168,9 +168,88 @@ def sparse_adagrad(learning_rate: ScalarOrSchedule,
   return SparseOptimizer(init, apply)
 
 
+class SparseMomentumState(NamedTuple):
+  trace: jax.Array  # same shape as the table
+  count: jax.Array
+
+
+def sparse_momentum(learning_rate: ScalarOrSchedule, momentum: float = 0.9,
+                    nesterov: bool = False) -> SparseOptimizer:
+  """Row-sparse SGD+momentum matching ``optax.sgd(lr, momentum)``.
+
+  Per live row: ``m[id] = momentum * m[id] + row; table[id] -= lr * m[id]``
+  (nesterov: ``lr * (row + momentum * m[id])``). Only touched rows see HBM
+  traffic (TF's sparse ``SGD(momentum=...)`` apply property). ``grad.ids``
+  must be deduplicated (what :func:`dedup_rows` / the custom-VJP backward
+  always produce) — a momentum decay is not additive across duplicates."""
+
+  def init(table):
+    return SparseMomentumState(trace=jnp.zeros_like(table),
+                               count=jnp.zeros((), jnp.int32))
+
+  def apply(table, state, grad: SparseRows):
+    tr = state.trace
+    g = grad.rows.astype(tr.dtype)
+    m_old = jnp.take(tr, grad.ids, axis=0, mode="fill", fill_value=0.0)
+    m_new = momentum * m_old + g
+    tr = tr.at[grad.ids].add(m_new - m_old, mode="drop")
+    upd = (g + momentum * m_new) if nesterov else m_new
+    lr = _lr_at(learning_rate, state.count).astype(table.dtype)
+    table = table.at[grad.ids].add(-lr * upd.astype(table.dtype),
+                                   mode="drop")
+    return table, SparseMomentumState(trace=tr, count=state.count + 1)
+
+  return SparseOptimizer(init, apply)
+
+
+class SparseAdamState(NamedTuple):
+  mu: jax.Array  # same shape as the table
+  nu: jax.Array
+  count: jax.Array
+
+
+def sparse_adam(learning_rate: ScalarOrSchedule, b1: float = 0.9,
+                b2: float = 0.999, eps: float = 1e-8) -> SparseOptimizer:
+  """Row-sparse Adam matching ``optax.adam`` on touched rows.
+
+  Per live row: moments decay toward the new gradient and the
+  bias-corrected update applies; untouched rows' moments are left alone
+  (TF sparse-Adam ``lazy`` semantics — dense optax would decay every
+  row's moments each step). Bias correction uses the global step count.
+  ``grad.ids`` must be deduplicated (what :func:`dedup_rows` / the
+  custom-VJP backward always produce) — moment decay is not additive
+  across duplicates."""
+
+  def init(table):
+    return SparseAdamState(mu=jnp.zeros_like(table),
+                           nu=jnp.zeros_like(table),
+                           count=jnp.zeros((), jnp.int32))
+
+  def apply(table, state, grad: SparseRows):
+    g = grad.rows.astype(state.mu.dtype)
+    m_old = jnp.take(state.mu, grad.ids, axis=0, mode="fill", fill_value=0.0)
+    v_old = jnp.take(state.nu, grad.ids, axis=0, mode="fill", fill_value=0.0)
+    m_new = b1 * m_old + (1.0 - b1) * g
+    v_new = b2 * v_old + (1.0 - b2) * g * g
+    mu = state.mu.at[grad.ids].add(m_new - m_old, mode="drop")
+    nu = state.nu.at[grad.ids].add(v_new - v_old, mode="drop")
+    t = (state.count + 1).astype(jnp.float32)
+    m_hat = m_new / (1.0 - jnp.power(b1, t))
+    v_hat = v_new / (1.0 - jnp.power(b2, t))
+    lr = _lr_at(learning_rate, state.count).astype(table.dtype)
+    upd = m_hat / (jnp.sqrt(v_hat) + eps)
+    table = table.at[grad.ids].add(-lr * upd.astype(table.dtype),
+                                   mode="drop")
+    return table, SparseAdamState(mu=mu, nu=nu, count=state.count + 1)
+
+  return SparseOptimizer(init, apply)
+
+
 _SPARSE_FACTORIES = {
     "sgd": sparse_sgd,
     "adagrad": sparse_adagrad,
+    "momentum": sparse_momentum,
+    "adam": sparse_adam,
 }
 
 

@@ -658,7 +658,8 @@ class DistributedLookup:
     return z
 
   def _z_sparse_fused(self, key, layout: PackedLayout, buf_local: jax.Array,
-                      ids_all: jax.Array, rs: bool = False):
+                      ids_all: jax.Array, rs: bool = False,
+                      keep_rows: bool = False):
     """Fused gather: returns (z, fused_rows) — optimizer state rides along.
 
     The combine sums the FULL fused stride (table + aux lanes together) and
@@ -671,7 +672,7 @@ class DistributedLookup:
     if isinstance(ids_all, tuple):  # ragged value stream
       vals, lens = ids_all
       fused = gather_fused_chunked(layout, buf_local, vals)
-      aux = fused if layout.n_aux else fused[..., w:]
+      aux = fused if (layout.n_aux or keep_rows) else fused[..., w:]
       return self._combine_ragged(fused[..., :w], vals, lens, key, rs), aux
     if (layout.rows_per_phys > 1 and layout.n_aux and ids_all.ndim == 3
         and ids_all.shape[-1] > 1):
@@ -700,8 +701,10 @@ class DistributedLookup:
       return z, masked
     fused = gather_fused_chunked(layout, buf_local, ids_all)  # [n_b,G,h,stride]
     if layout.n_aux == 0:
-      # stride == width: no aux lanes ride along, nothing to defer
-      return self._combine(fused, ids_all, key, rs), fused[..., w:]
+      # stride == width: no aux lanes ride along; keep_rows saves the full
+      # rows anyway (the weight-decay delta needs the forward-time row)
+      return self._combine(fused, ids_all, key, rs), (
+          fused if keep_rows else fused[..., w:])
     if ids_all.ndim == 2 or ids_all.shape[-1] == 1:
       return self._combine(fused[..., :w], ids_all, key, rs), fused
     zf = self._combine(fused, ids_all, key, rs)  # [n_b, G, stride]
@@ -898,13 +901,16 @@ class DistributedLookup:
   # ---- fused training path -----------------------------------------------
   def lookup_sparse_fused(self, fused_params: Dict[str, jax.Array],
                           layouts: Dict[str, PackedLayout],
-                          ids_all: Dict[tuple, jax.Array]):
+                          ids_all: Dict[tuple, jax.Array],
+                          keep_rows: bool = False):
     """Non-differentiable mp-side fused lookup for all sparse classes.
 
     Returns ``(z_sparse, residuals)``; run *outside* autodiff, then feed
     ``z_sparse`` into the differentiable tail (exchange/assemble/model) and
-    its cotangent into :meth:`apply_sparse`.
-    """
+    its cotangent into :meth:`apply_sparse`. ``keep_rows`` saves the
+    forward-time table rows in the residuals even for aux-free rules
+    (needed by ``rule.weight_decay``; n_aux > 0 residuals carry them
+    already)."""
     z: Dict[tuple, jax.Array] = {}
     aux: Dict[tuple, jax.Array] = {}
     for bk, ids in ids_all.items():
@@ -914,7 +920,7 @@ class DistributedLookup:
       name = class_param_name(*key)
       buf_local = self._squeeze_local(fused_params[name])
       zb, auxb = self._z_sparse_fused(key, layouts[name], buf_local, ids,
-                                      bk.rs)
+                                      bk.rs, keep_rows=keep_rows)
       z[bk] = zb
       aux[bk] = auxb
     return z, SparseResiduals(ids_all=dict(ids_all), aux_rows=aux)
@@ -996,6 +1002,23 @@ class DistributedLookup:
           lanes = part if lanes is None else lanes + part
       return lanes.reshape(-1, rule.n_aux, w)
 
+    def decayed(g, res, layout):
+      """Touched-rows l2: add ``2λ * row`` (forward-time row from the
+      residuals — same layouts as aux_occ) to the occurrence cotangent."""
+      if not rule.weight_decay or res is None:
+        return g
+      w, stride, rpp = layout.width, layout.stride, layout.rows_per_phys
+      last = res.shape[-1]
+      flat = res.reshape(-1, last)
+      if last == stride:
+        row = flat[:, :w]
+      else:  # masked phys rows: exactly one window nonzero per occurrence
+        row = None
+        for s in range(rpp):
+          part = flat[:, s * stride:s * stride + w]
+          row = part if row is None else row + part
+      return g + (2.0 * rule.weight_decay) * row.reshape(g.shape)
+
     by_class: Dict[str, list] = {}
     for bk, dzb in d_z.items():
       key, h = bk.class_key, bk.h
@@ -1005,7 +1028,8 @@ class DistributedLookup:
       name = class_param_name(*key)
       ids = residuals.ids_all[bk]  # [n_b, G, h] | ragged (vals, lens)
       sentinel = padded_rows(plan, key)
-      aux = residuals.aux_rows[bk] if rule.n_aux else None
+      aux = (residuals.aux_rows[bk]
+             if (rule.n_aux or rule.weight_decay) else None)
       if h < 0:
         # ragged: expand the per-sample cotangent to per-occurrence rows
         # (h=0 marks pre-expanded parts downstream: no hotness broadcast)
@@ -1052,6 +1076,10 @@ class DistributedLookup:
         fused_rows = gather_fused(layout, buf, ids)
         aux = fused_rows[..., w:].reshape(
             ids.shape + (rule.n_aux, w)) if rule.n_aux else None
+        if rule.weight_decay:
+          # decay once per unique touched row (dense-penalty semantics
+          # restricted to touched rows)
+          g = g + (2.0 * rule.weight_decay) * fused_rows[..., :w]
         delta = rule.delta(g, aux, step)
         # post-dedup ids are unique; below XLA's fast-path ratio the
         # Pallas RMW kernel wins (same static rule as the fast path)
@@ -1076,6 +1104,7 @@ class DistributedLookup:
               g = jnp.broadcast_to(g[:, None, :],
                                    (n // h, h, w)).reshape(n, w)
             aux_r = aux_occ(aux, layout)
+            g = decayed(g, aux, layout)
             all_ids.append(ids.reshape(-1))
             all_deltas.append(rule.delta(g, aux_r, step))
           ids_cat = (all_ids[0] if len(all_ids) == 1
@@ -1104,6 +1133,8 @@ class DistributedLookup:
             ids_f = ids.reshape(-1)
             dz_f = dzb.reshape(-1, w)
             aux_f = aux_occ(aux, layout)
+            res_f = (aux.reshape(-1, aux.shape[-1])
+                     if rule.weight_decay and aux is not None else None)
             hh = max(1, h)  # h == 0: ragged parts arrive pre-expanded
             chunk = max(hh, (self.apply_chunk // hh) * hh)
             for c0 in range(0, n, chunk):
@@ -1113,6 +1144,8 @@ class DistributedLookup:
                 g_c = jnp.broadcast_to(g_c[:, None, :],
                                        (cn // h, h, w)).reshape(cn, w)
               aux_c = None if aux_f is None else aux_f[c0:c0 + cn]
+              if res_f is not None:
+                g_c = decayed(g_c, res_f[c0:c0 + cn], layout)
               buf = scatter_add_fused(
                   layout, buf, ids_f[c0:c0 + cn],
                   rule.delta(g_c, aux_c, step),

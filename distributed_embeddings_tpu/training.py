@@ -461,33 +461,92 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
   Returns:
     ``step(state, numerical, cats, labels) -> (state, loss)``.
   """
+  # Regularizers / constraints on the fused path (reference honors both on
+  # every path via Keras add_weight, `embedding.py:64-70,96-100`):
+  # - DENSE-kind tables (MXU one-hot, small by definition) get the exact
+  #   full-table treatment: penalty joins the loss, constraint projects
+  #   after the update — same machinery as make_train_step.
+  # - SPARSE-kind tables support a uniform l2 regularizer, folded into the
+  #   per-occurrence deltas as decay on TOUCHED rows
+  #   (``SparseRule.weight_decay``; a dense penalty sweep over terabyte
+  #   tables is exactly what this path exists to avoid). Anything else
+  #   (l1/custom penalties, constraints, per-table λ) still raises with
+  #   guidance to the dense autodiff path.
+  from .layers.embedding import l2_decay_factor
+  table_kind = {}
+  for shards in plan.rank_shards:
+    for sh in shards:
+      table_kind[sh.table_id] = plan._kind_of(sh)
+  lam = None
   for t, c in enumerate(plan.global_configs):
-    if c.regularizer is not None or c.constraint is not None:
+    if table_kind.get(t) != "sparse":
+      continue  # dense-kind: handled exactly via reg_fn/con_fn below
+    if c.constraint is not None:
       raise NotImplementedError(
-          f"table {t} has a regularizer/constraint: the fused sparse path "
-          "applies per-occurrence optimizer deltas and never materializes "
-          "whole tables, so Keras-style full-table penalties/projections "
-          "cannot be honored here. Use make_train_step (dense autodiff "
-          "path, pass plan=...) for models that need them.")
+          f"table {t} has an embeddings_constraint on the fused sparse "
+          "path: per-occurrence deltas never materialize whole tables, so "
+          "a full-table projection cannot be honored here. Use "
+          "make_train_step (dense autodiff path, pass plan=...) or raise "
+          "dense_row_threshold to serve this table on the MXU path.")
+    if c.regularizer is None:
+      continue
+    f = l2_decay_factor(c.regularizer)
+    if f is None:
+      raise NotImplementedError(
+          f"table {t}'s regularizer {c.regularizer!r} is not a pure l2: "
+          "the fused sparse path folds only l2 decay into its "
+          "per-occurrence deltas ('l2' or {'name': 'l2', 'factor': λ}). "
+          "Use make_train_step (dense autodiff path) for other penalties.")
+    if lam is None:
+      lam = f
+    elif lam != f:
+      raise NotImplementedError(
+          "sparse tables carry different l2 factors "
+          f"({lam} vs {f} on table {t}): the fused delta applies one "
+          "uniform decay per rule. Use equal factors or the dense path.")
+  if lam:
+    import dataclasses as _dc
+    rule = _dc.replace(rule, weight_decay=float(lam))
+  dense_reg = any(c.regularizer is not None
+                  for t, c in enumerate(plan.global_configs)
+                  if table_kind.get(t) == "dense")
+  dense_con = any(c.constraint is not None
+                  for t, c in enumerate(plan.global_configs)
+                  if table_kind.get(t) == "dense")
+  # the fns skip class names absent from the param dict, so feeding them
+  # emb_dense covers exactly the dense-kind windows
+  reg_fn = plan_regularizer_fn(plan) if dense_reg else None
+  con_fn = plan_constraint_fn(plan) if dense_con else None
   engine = DistributedLookup(plan, dp_input=True, axis_name=axis_name)
   layouts = engine.fused_layouts(rule)
   emb_opt = emb_dense_optimizer or dense_optimizer
 
   def local_step(state, numerical, cats, labels):
     b = numerical.shape[0]
+    rank = jax.lax.axis_index(axis_name) if mesh is not None else 0
     hotness = [ragged_hotness(c) for c in cats]
     hotness_of = lambda i: hotness[i]  # noqa: E731
     ids_all = engine.route_ids(cats, hotness_of)
     counts = engine.mean_counts(cats)
     z_sparse, residuals = engine.lookup_sparse_fused(
-        state["fused"], layouts, ids_all)
+        state["fused"], layouts, ids_all,
+        # exact=True re-gathers rows at apply time, so saving them in the
+        # residuals would hold dead per-occurrence arrays across the step
+        keep_rows=bool(rule.weight_decay) and not rule.n_aux and not exact)
 
     def loss_with(dense_p, emb_dense, z_sp):
       acts = engine.finish_forward(z_sp, emb_dense, ids_all, b, hotness_of,
                                    counts)
       logits = model.apply({"params": dense_p}, numerical, cats,
                            emb_acts=acts)
-      return loss_fn(logits, labels)
+      loss = loss_fn(logits, labels)
+      if reg_fn is not None:
+        # dense-kind tables' penalty (rank-local windows); scaled by world
+        # to survive the uniform 1/world grad rescale below — same
+        # convention as make_train_step
+        scale = jax.lax.axis_size(axis_name) if mesh is not None else 1
+        loss = loss + scale * reg_fn(emb_dense, rank)
+      return loss
 
     loss, (d_dense, d_emb_dense, d_z) = jax.value_and_grad(
         loss_with, argnums=(0, 1, 2))(state["dense"], state["emb_dense"],
@@ -508,6 +567,8 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
       upd, emb_dense_opt = emb_opt.update(
           d_emb_dense, state["emb_dense_opt"], state["emb_dense"])
       emb_dense = optax.apply_updates(state["emb_dense"], upd)
+      if con_fn is not None:
+        emb_dense = con_fn(emb_dense, rank)
     else:
       emb_dense, emb_dense_opt = state["emb_dense"], state["emb_dense_opt"]
 

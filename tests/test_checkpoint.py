@@ -37,8 +37,12 @@ def build(world, rule_name="adagrad"):
       [dict(input_dim=v, output_dim=16,
             initializer={"name": "uniform", "scale": 0.05}) for v in VOCAB],
       world, "basic", dense_row_threshold=32)
-  rule = (adagrad_rule if rule_name == "adagrad" else sgd_rule)(0.05)
-  opt = optax.adagrad(0.05) if rule_name == "adagrad" else optax.sgd(0.05)
+  from distributed_embeddings_tpu.ops.packed_table import sparse_rule
+  rule = sparse_rule(rule_name, 0.05)
+  opt = {"adagrad": lambda: optax.adagrad(0.05),
+         "sgd": lambda: optax.sgd(0.05),
+         "momentum": lambda: optax.sgd(0.05, momentum=0.9),
+         "adam": lambda: optax.adam(0.05)}[rule_name]()
   return model, plan, rule, opt
 
 
@@ -62,7 +66,8 @@ def init_state(model, plan, rule, opt, batch, mesh=None):
 
 @pytest.mark.parametrize("use_mesh,rule_name",
                          [(False, "adagrad"), (True, "adagrad"),
-                          (True, "sgd")])
+                          (True, "sgd"), (True, "adam"),
+                          (False, "momentum")])
 def test_save_restore_resume_bit_exact(tmp_path, use_mesh, rule_name):
   world = WORLD if use_mesh else 1
   mesh = create_mesh(world) if use_mesh else None

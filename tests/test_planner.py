@@ -211,6 +211,37 @@ def test_class_generation_split_caps_buffer_bytes():
   assert total == sum(sizes)
 
 
+def test_planner_rejects_table_over_int32_id_space():
+  """Ids route as int32; a table whose id space exceeds int32 must fail
+  loudly at plan time (reference registers an int64 op variant instead,
+  `embedding_lookup_ops.cc:24-88`). 2^31 - 1 rows still plans (colossal's
+  2B-row table clears by 7%)."""
+  with pytest.raises(ValueError, match="int32"):
+    DistEmbeddingStrategy([TableConfig((1 << 31), 8)], 128, "basic",
+                          row_slice_threshold=1 << 24)
+  plan = DistEmbeddingStrategy([TableConfig((1 << 31) - 1, 8)], 128,
+                               "basic", row_slice_threshold=1 << 24)
+  assert plan.world_size == 128
+
+
+def test_first_fit_generation_assignment_legacy_layout():
+  """gen_assignment='first_fit' reproduces the round-2 first-fit layout:
+  shards fill generations in shard order against the byte cap (the legacy
+  mode exists so pre-round-3 checkpoints stay restorable)."""
+  sizes = [100, 80, 60, 50, 40, 30]
+  cap = 120 * 8 * 4  # 120 rows per generation at width 8
+  plan = DistEmbeddingStrategy(_configs(sizes), 1, strategy="basic",
+                               max_class_bytes=cap,
+                               gen_assignment="first_fit")
+  # first-fit in shard order: 100 -> g0; 80 -> g1 (100+80 > 120);
+  # 60 -> g2; 50 -> g2? no (60+50=110 <= 120 -> g2); 40 -> g1 (80+40=120);
+  # 30 -> g3 (g0 100+30>120, g1 120+30>120, g2 110+30>120)
+  gen_of = {sh.table_id: sh.gen for sh in _all_slices(plan)}
+  assert gen_of == {0: 0, 1: 1, 2: 2, 3: 2, 4: 1, 5: 3}
+  with pytest.raises(ValueError, match="gen_assignment"):
+    DistEmbeddingStrategy(_configs(sizes), 1, gen_assignment="bogus")
+
+
 def test_class_generation_single_oversized_shard_gets_own_gen():
   sizes = [500, 10]
   cap = 100 * 8 * 4  # smaller than the big table alone

@@ -185,8 +185,96 @@ def test_planner_rejects_unsupported():
         column_slice_threshold=1 << 16)
   from distributed_embeddings_tpu.ops.packed_table import sgd_rule
   from distributed_embeddings_tpu.training import make_sparse_train_step
-  plan = DistEmbeddingStrategy([TableConfig(5000, 16, regularizer="l2")],
+
+  # uniform l2 is SUPPORTED on the fused path (folded into the deltas as
+  # touched-rows decay); the remaining rejections are constraints,
+  # non-l2 penalties, and per-table λ mismatches
+  plan = DistEmbeddingStrategy([TableConfig(5000, 16, constraint="non_neg")],
                                1, "basic")
-  with pytest.raises(NotImplementedError, match="fused sparse"):
+  with pytest.raises(NotImplementedError, match="constraint"):
     make_sparse_train_step(None, plan, None, optax.sgd(0.1), sgd_rule(0.1),
                            None, {}, ())
+  plan = DistEmbeddingStrategy([TableConfig(5000, 16, regularizer="l1")],
+                               1, "basic")
+  with pytest.raises(NotImplementedError, match="pure l2"):
+    make_sparse_train_step(None, plan, None, optax.sgd(0.1), sgd_rule(0.1),
+                           None, {}, ())
+  plan = DistEmbeddingStrategy(
+      [TableConfig(5000, 16, regularizer="l2"),
+       TableConfig(4000, 16,
+                   regularizer={"name": "l2", "factor": 0.5})], 1, "basic")
+  with pytest.raises(NotImplementedError, match="different l2"):
+    make_sparse_train_step(None, plan, None, optax.sgd(0.1), sgd_rule(0.1),
+                           None, {}, ())
+
+
+@pytest.mark.parametrize("opt_name,exact", [
+    ("sgd", True), ("sgd", False), ("adagrad", True), ("adagrad", False),
+])
+def test_fused_l2_decay_matches_dense_path_all_rows_touched(opt_name, exact):
+  """Fused-path uniform l2 == the dense path's full-table penalty when the
+  batch touches every sparse row exactly once (touched-rows decay equals
+  the full sweep there). A dense-kind table rides along: its penalty takes
+  the exact full-table route inside the fused step (reg_fn on emb_dense).
+
+  sgd with exact=False exercises the keep_rows residual plumbing (an
+  aux-free rule needs the forward-time rows saved; exact=True re-gathers
+  at apply time instead); adagrad exercises decay-into-accumulator
+  (g + 2λw enters the g² accumulation on both paths). The sparse ids are
+  a permutation (no duplicates), where per-occurrence and dedup'd decay
+  agree — so every variant must match the dense reference exactly."""
+  from distributed_embeddings_tpu.models import DLRM, bce_loss
+  from distributed_embeddings_tpu.ops.packed_table import sparse_rule
+  from distributed_embeddings_tpu.training import (
+      init_sparse_state,
+      make_sparse_train_step,
+      make_train_step,
+      unpack_sparse_state,
+  )
+
+  vocab = [32, 8]
+  thresh = 16  # table 0 (32 rows) sparse, table 1 (8 rows) MXU dense-kind
+  lam = 0.03
+  reg = {"name": "l2", "factor": lam}
+  plan = DistEmbeddingStrategy(
+      [TableConfig(v, 16, regularizer=reg) for v in vocab],
+      1, "basic", dense_row_threshold=thresh)
+  model = DLRM(vocab_sizes=vocab, embedding_dim=16, bottom_mlp=(32, 16),
+               top_mlp=(32, 1), dense_row_threshold=thresh)
+  rng = np.random.default_rng(3)
+  b = 32
+  numerical = jnp.asarray(rng.standard_normal((b, 13)), jnp.float32)
+  cats = [jnp.asarray(rng.permutation(32), jnp.int32),  # every row once
+          jnp.asarray(rng.integers(0, 8, b), jnp.int32)]
+  labels = jnp.asarray(rng.integers(0, 2, b), jnp.float32)
+  batch = (numerical, cats, labels)
+  params = model.init(jax.random.PRNGKey(0), numerical, cats)["params"]
+
+  opt = optax.sgd(0.1) if opt_name == "sgd" else optax.adagrad(0.1)
+
+  def loss_fn(p, numerical, cats, labels):
+    return bce_loss(model.apply({"params": p}, numerical, cats), labels)
+
+  dstate = opt.init(params)
+  dense_step = make_train_step(loss_fn, opt, None, params, dstate, batch,
+                               plan=plan, donate=False)
+  p_dense, _, loss_dense = dense_step(params, dstate, *batch)
+
+  rule = sparse_rule(opt_name, 0.1)
+  state = init_sparse_state(plan, params, rule, opt)
+  sparse_step = make_sparse_train_step(model, plan, bce_loss, opt, rule,
+                                       None, state, batch, exact=exact,
+                                       donate=False)
+  state2, loss_sparse = sparse_step(state, *batch)
+
+  # loss values: the dense path reports data + full penalty; the fused
+  # path reports data + dense-kind penalty only (sparse decay is folded
+  # into the deltas, documented) — compare the parameters, not the loss
+  p_sparse, _ = unpack_sparse_state(plan, rule, state2)
+  flat_d = jax.tree_util.tree_leaves_with_path(p_dense)
+  flat_s = {jax.tree_util.keystr(k): v
+            for k, v in jax.tree_util.tree_leaves_with_path(p_sparse)}
+  for k, v in flat_d:
+    ks = jax.tree_util.keystr(k)
+    np.testing.assert_allclose(np.asarray(v), np.asarray(flat_s[ks]),
+                               rtol=1e-4, atol=1e-5, err_msg=ks)

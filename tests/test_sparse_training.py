@@ -101,31 +101,46 @@ def test_gather_scatter_fused():
   np.testing.assert_allclose(np.asarray(acc2), want_a, rtol=1e-5, atol=1e-6)
 
 
-@pytest.mark.parametrize("name", ["sgd", "adagrad"])
+def _optax_of(name, lr):
+  return {
+      "sgd": lambda: optax.sgd(lr),
+      "adagrad": lambda: optax.adagrad(lr),
+      "momentum": lambda: optax.sgd(lr, momentum=0.9),
+      "adam": lambda: optax.adam(lr),
+  }[name]()
+
+
+@pytest.mark.parametrize("name", ["sgd", "adagrad", "momentum", "adam"])
 def test_rule_matches_optax_dense(name):
-  """dedup'd rule application == dense optax update on the same grads."""
+  """dedup'd rule application == dense optax update on the same grads,
+  over TWO sequential steps (the second exercises nonzero momentum/moment
+  state and Adam's step-dependent bias correction). Both steps touch the
+  same rows, where lazy sparse semantics and dense optax agree."""
   rng = np.random.default_rng(0)
   table = jnp.asarray(rng.standard_normal((20, 4)), jnp.float32)
   ids = jnp.asarray([2, 5, 5, 11, 2, 19], jnp.int32)
-  rows = jnp.asarray(rng.standard_normal((6, 4)), jnp.float32)
-
-  dense_grad = jnp.zeros_like(table).at[ids].add(rows)
-  opt = optax.sgd(0.1) if name == "sgd" else optax.adagrad(0.1)
-  state = opt.init(table)
-  updates, _ = opt.update(dense_grad, state, table)
-  want = optax.apply_updates(table, updates)
 
   rule = sparse_rule(name, 0.1)
   layout = PackedLayout(rows=20, width=4, n_aux=rule.n_aux)
   aux0 = [jnp.full_like(table, v) for v in rule.aux_init]
   buf = jnp.asarray(layout.pack(table, aux0))
-  sr = dedup_rows(ids, rows, 20)
-  fused_rows = gather_fused(layout, buf, sr.ids)
-  aux = fused_rows[:, 4:].reshape(sr.ids.shape + (rule.n_aux, 4)) \
-      if rule.n_aux else None
-  delta = rule.delta(sr.rows, aux, jnp.zeros((), jnp.int32))
-  buf2 = scatter_add_fused(layout, buf, sr.ids, delta)
-  got, _ = layout.unpack(buf2)
+
+  opt = _optax_of(name, 0.1)
+  state = opt.init(table)
+  want = table
+  for step in range(2):
+    rows = jnp.asarray(rng.standard_normal((6, 4)), jnp.float32)
+    dense_grad = jnp.zeros_like(want).at[ids].add(rows)
+    updates, state = opt.update(dense_grad, state, want)
+    want = optax.apply_updates(want, updates)
+
+    sr = dedup_rows(ids, rows, 20)
+    fused_rows = gather_fused(layout, buf, sr.ids)
+    aux = fused_rows[:, 4:].reshape(sr.ids.shape + (rule.n_aux, 4)) \
+        if rule.n_aux else None
+    delta = rule.delta(sr.rows, aux, jnp.asarray(step, jnp.int32))
+    buf = scatter_add_fused(layout, buf, sr.ids, delta)
+  got, _ = layout.unpack(buf)
   np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                              rtol=1e-5, atol=1e-6)
 
@@ -161,6 +176,7 @@ def _make_batch(rng, vocab, batch):
 
 @pytest.mark.parametrize("opt_name,dense_thresh", [
     ("sgd", 0), ("adagrad", 0), ("adagrad", 32),
+    ("momentum", 0), ("adam", 32),
 ])
 def test_sparse_step_matches_dense_step_single_device(opt_name, dense_thresh):
   vocab = [64, 32, 16, 8]
@@ -171,7 +187,7 @@ def test_sparse_step_matches_dense_step_single_device(opt_name, dense_thresh):
   batch = _make_batch(rng, vocab, 32)
   params = model.init(jax.random.PRNGKey(0), batch[0], batch[1])["params"]
 
-  dense_opt = optax.sgd(0.1) if opt_name == "sgd" else optax.adagrad(0.1)
+  dense_opt = _optax_of(opt_name, 0.1)
 
   def loss_fn(p, numerical, cats, labels):
     return bce_loss(model.apply({"params": p}, numerical, cats), labels)
@@ -478,35 +494,32 @@ def test_apply_sparse_chunked_matches_single_shot():
                              rtol=1e-5, atol=1e-6)
 
 
-@pytest.mark.parametrize("name", ["sgd", "adagrad"])
+@pytest.mark.parametrize("name", ["sgd", "adagrad", "momentum", "adam"])
 def test_sparse_optimizer_apply_matches_optax(name):
   """Standalone SparseOptimizer (IndexedSlices-equivalent apply path,
   reference `embedding_lookup_ops.py:105-122` + TF sparse applies) matches
-  dense optax on deduplicated gradients."""
+  dense optax on deduplicated gradients, over two steps touching the same
+  rows (where lazy sparse state and dense optax state agree)."""
   from distributed_embeddings_tpu.ops.sparse_grad import sparse_optimizer
 
   rng = np.random.default_rng(4)
   table = jnp.asarray(rng.standard_normal((30, 8)), jnp.float32)
   ids = jnp.asarray([1, 7, 7, 29, 1, 3], jnp.int32)
-  rows = jnp.asarray(rng.standard_normal((6, 8)), jnp.float32)
 
-  dense_grad = jnp.zeros_like(table).at[ids].add(rows)
-  opt = optax.sgd(0.2) if name == "sgd" else optax.adagrad(0.2)
-  dstate = opt.init(table)
-  updates, _ = opt.update(dense_grad, dstate, table)
-  want = optax.apply_updates(table, updates)
-
+  opt = _optax_of(name, 0.2)
   sopt = sparse_optimizer(name, 0.2)
+  dstate = opt.init(table)
   sstate = sopt.init(table)
-  sr = dedup_rows(ids, rows, 30)
-  got, sstate2 = jax.jit(sopt.apply)(table, sstate, sr)
-  np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                             rtol=1e-5, atol=1e-6)
-  # second apply keeps matching (accumulator state advanced correctly)
-  if name == "adagrad":
-    updates, _ = opt.update(dense_grad, opt.init(table), table)
-    got2, _ = jax.jit(sopt.apply)(got, sstate2, sr)
-    assert np.isfinite(np.asarray(got2)).all()
+  want = got = table
+  for _ in range(2):
+    rows = jnp.asarray(rng.standard_normal((6, 8)), jnp.float32)
+    dense_grad = jnp.zeros_like(want).at[ids].add(rows)
+    updates, dstate = opt.update(dense_grad, dstate, want)
+    want = optax.apply_updates(want, updates)
+    sr = dedup_rows(ids, rows, 30)
+    got, sstate = jax.jit(sopt.apply)(got, sstate, sr)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
 
 
 def test_shard_batch_rejects_indivisible_global_batch():
@@ -521,13 +534,15 @@ def test_shard_batch_rejects_indivisible_global_batch():
     shard_batch((jnp.zeros((10, 4)),), mesh)
 
 
-@pytest.mark.parametrize("combiner", ["sum"])
-def test_multihot_masked_path_matches_onehot_decomposition(combiner):
+@pytest.mark.parametrize("combiner,reg", [("sum", None), ("sum", "l2")])
+def test_multihot_masked_path_matches_onehot_decomposition(combiner, reg):
   """The multi-hot narrow fast path (window-masked phys-width residuals,
   round 3) must produce EXACTLY the updates of the mathematically
   equivalent decomposition into h shared-table 1-hot inputs (which takes
   the stride-width residual path): same forward sum, same per-occurrence
-  Adagrad deltas from forward-time state."""
+  Adagrad deltas from forward-time state. With ``reg='l2'``, the
+  touched-rows weight decay's forward-time-row extraction must also agree
+  between the masked-phys and stride residual layouts."""
   import flax.linen as nn
   from distributed_embeddings_tpu.layers.dist_model_parallel import (
       get_weights,
@@ -559,12 +574,12 @@ def test_multihot_masked_path_matches_onehot_decomposition(combiner):
   def train(variant):
     if variant == "multi":
       tables = [TableConfig(vocab, w, combiner=combiner,
-                            initializer="uniform")]
+                            initializer="uniform", regularizer=reg)]
       tmap, cats = [0], [jnp.asarray(ids)]
       model = HeadMulti()
     else:
       tables = [TableConfig(vocab, w, combiner=combiner,
-                            initializer="uniform")]
+                            initializer="uniform", regularizer=reg)]
       tmap = [0] * h
       cats = [jnp.asarray(ids[:, j]) for j in range(h)]
       model = HeadSplit()
