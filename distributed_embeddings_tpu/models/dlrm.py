@@ -42,96 +42,73 @@ class MLP(nn.Module):
     return x
 
 
-def _tril_maps(f: int, pack: int, k: int):
-  """Static index maps for the packed interaction.
+@functools.lru_cache(maxsize=None)
+def _tril_select_np(f: int, k: int):
+  """Half-weight symmetric selection tensor ``M [f, f, p]``.
 
-  Returns ``take`` — per pack-group, the flat positions in the
-  ``[pack*f, pack*f]`` product holding each group sample's lower-triangle
-  pairs — and ``inv``, the inverse map used by the backward: for every flat
-  position, which output pair (or the zero sentinel ``pack*P``) it
-  corresponds to, with BOTH (i,j) and (j,i) mapped so the gathered
-  cotangent is already symmetrized (d(F F^T) needs D + D^T)."""
+  ``einsum("bpq,pqn->bn", inter, M)`` extracts the lower-triangle pairs
+  from the full pairwise product: both mirrored cells carry weight 0.5
+  (diagonal pairs 1.0), and ``inter`` is bitwise symmetric (each mirrored
+  pair is the same dot product with the same reduction order), so
+  ``0.5*a + 0.5*a`` reproduces the pair value exactly. The selection is a
+  matmul — MXU work — instead of the flat ``jnp.take`` an index map needs,
+  whose lane-crossing gather + reshape cost ~4 ms of relayout copies per
+  step at F=27, B=64k (traced round 4)."""
   rows, cols = np.tril_indices(f, k=k)
   p = len(rows)
-  gf = pack * f
-  take = np.concatenate(
-      [(s * f + rows) * gf + (s * f + cols) for s in range(pack)])
-  inv = np.full((gf * gf,), pack * p, np.int32)  # sentinel -> zero column
-  scale = np.ones((gf * gf,), np.float32)
-  for s in range(pack):
-    for n, (i, j) in enumerate(zip(rows, cols)):
-      inv[(s * f + i) * gf + (s * f + j)] = s * p + n
-      if i != j:
-        inv[(s * f + j) * gf + (s * f + i)] = s * p + n
-      else:
-        # diagonal pair (self_interaction): d(x.x)/dx = 2x, and the
-        # symmetrizing double-map above can't fire for i == j
-        scale[(s * f + i) * gf + (s * f + j)] = 2.0
-  return (jnp.asarray(take, jnp.int32), jnp.asarray(inv, jnp.int32),
-          jnp.asarray(scale), p)
+  m = np.zeros((f, f, p), np.float32)
+  for n, (i, j) in enumerate(zip(rows, cols)):
+    if i == j:  # self-interaction diagonal: single cell, full weight
+      m[i, j, n] = 1.0
+    else:
+      m[i, j, n] = 0.5
+      m[j, i, n] = 0.5
+  return m, p
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
-def _packed_tril_products(feats: jax.Array, pack: int, k: int) -> jax.Array:
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _tril_products(feats: jax.Array, k: int) -> jax.Array:
   """[B, F, D] -> [B, P] lower-triangle pairwise dot products.
 
-  The hand-written VJP is the point (measured on v5e, F=27, B=64k): XLA's
-  autodiff of ``einsum + take`` runs a slow axis-1 scatter for the take
-  backward plus TWO product einsums (one per operand slot), ~3x the cost of
-  the forward. Here the backward is ONE static gather — ``inv`` maps both
-  (i,j) and (j,i) to the pair cotangent, building the symmetrized
-  ``D + D^T`` directly, with non-pair positions reading an appended zero
-  column — followed by ONE einsum ``(D + D^T) @ feats``.
-
-  ``pack`` reshapes ``pack`` samples into one [pack*F, D] operand before
-  the batched product (bigger MXU tiles at the cost of pack^2 x the
-  product bytes); measured memory-bound at these shapes, so pack=1 wins.
-  """
-  out, _ = _packed_tril_fwd(feats, pack, k)
+  Both directions are pure matmuls (no gathers, no index maps): forward is
+  the pairwise product einsum followed by the ``M``-selection einsum; the
+  hand-written VJP exploits the symmetry of the selection cotangent
+  (``d_sym = einsum(d_acts, M)`` is symmetric by construction) to compute
+  ``d_feats = (G + G^T) @ feats`` as ONE product einsum scaled by 2, where
+  XLA's autodiff would run two. Equivalent of the reference's
+  ``boolean_mask`` interaction (`examples/dlrm/utils.py:92-113`)."""
+  out, _ = _tril_fwd(feats, k)
   return out
 
 
-def _packed_tril_fwd(feats, pack, k):
+def _tril_fwd(feats, k):
   b, f, d = feats.shape
-  take, _, _, p = _tril_maps(f, pack, k)
-  packed = feats.reshape(b // pack, pack * f, d)
-  inter = jnp.einsum("bpd,bqd->bpq", packed, packed,
+  m_np, p = _tril_select_np(f, k)
+  m = jnp.asarray(m_np, feats.dtype)
+  inter = jnp.einsum("bpd,bqd->bpq", feats, feats,
                      preferred_element_type=jnp.float32)
-  # keep the triangle gather OUT of the matmul fusion: letting XLA fuse the
-  # take into the einsum consumer de-tiles the matmul (measured 3.7 + 0.6 ms
-  # separate vs 14.6 ms fused at F=27, B=64k)
-  inter = jax.lax.optimization_barrier(inter)
-  flat = inter.reshape(b // pack, (pack * f) ** 2)
-  acts = jnp.take(flat, take, axis=1).reshape(b, p)
+  acts = jnp.einsum("bpq,pqn->bn", inter.astype(feats.dtype), m,
+                    preferred_element_type=jnp.float32)
   return acts, feats
 
 
-def _packed_tril_bwd(pack, k, feats, d_acts):
+def _tril_bwd(k, feats, d_acts):
   b, f, d = feats.shape
-  _, inv, scale, p = _tril_maps(f, pack, k)
-  # gather (not scatter) the cotangent into the [pack*F, pack*F] layout:
-  # inv maps both (i,j) and (j,i) to the pair's cotangent and everything
-  # else to an appended zero column, so this one static gather builds the
-  # already-symmetrized D + D^T and the backward needs a single einsum
-  dg = d_acts.reshape(b // pack, pack * p)
-  dg = jnp.concatenate([dg, jnp.zeros((b // pack, 1), dg.dtype)], axis=1)
-  d_sym = jnp.take(dg, inv, axis=1)
-  if k == 0:  # self-interaction diagonals carry factor 2 (see _tril_maps)
-    d_sym = d_sym * scale
+  m_np, p = _tril_select_np(f, k)
   # under bf16 compute (AMP) the cotangent is rounded to bf16 before the
-  # grad einsum — the AMP convention (the reference's fp16 backward does
+  # grad einsums — the AMP convention (the reference's fp16 backward does
   # the same); exact-f32 parity with autodiff holds for f32 feats
-  d_sym = d_sym.reshape(b // pack, pack * f, pack * f).astype(feats.dtype)
-  # same fusion hazard as the forward, mirrored: keep the gather-built
-  # cotangent out of the backward einsum's fusion
-  d_sym = jax.lax.optimization_barrier(d_sym)
-  packed = feats.reshape(b // pack, pack * f, d)
-  d_packed = jnp.einsum("bpq,bqd->bpd", d_sym, packed,
-                        preferred_element_type=jnp.float32)
-  return (d_packed.reshape(b, f, d).astype(feats.dtype),)
+  m = jnp.asarray(m_np, feats.dtype)
+  d_sym = jnp.einsum("bn,pqn->bpq", d_acts.astype(feats.dtype), m,
+                     preferred_element_type=jnp.float32)
+  # d(F F^T) needs (G + G^T) @ F; d_sym = (G + G^T)/2 is symmetric by
+  # construction (M weights both mirrored cells), so one einsum x2 does it
+  d_feats = 2.0 * jnp.einsum("bpq,bqd->bpd", d_sym.astype(feats.dtype),
+                             feats, preferred_element_type=jnp.float32)
+  return (d_feats.astype(feats.dtype),)
 
 
-_packed_tril_products.defvjp(_packed_tril_fwd, _packed_tril_bwd)
+_tril_products.defvjp(_tril_fwd, _tril_bwd)
 
 
 def dot_interact(bottom_out: jax.Array, emb_outs: Sequence[jax.Array],
@@ -140,18 +117,29 @@ def dot_interact(bottom_out: jax.Array, emb_outs: Sequence[jax.Array],
   """Pairwise dot-product interaction + bottom-MLP passthrough.
 
   Equivalent of `examples/dlrm/utils.py:92-113`, with the dynamic
-  ``boolean_mask`` replaced by a static lower-triangle gather (XLA-friendly)
-  and the per-sample product MXU-packed (see :func:`_packed_tril_products`).
-  Output: [B, F*(F-1)/2 + D] where F = num embeddings + 1.
+  ``boolean_mask`` replaced by the matmul-form triangle selection
+  (:func:`_tril_products`). Output: [B, F*(F-1)/2 + D] where
+  F = num embeddings + 1.
+
+  ``pack`` is accepted for API compatibility and ignored: the matmul-form
+  selection has no pack concept (the round-2 pack study measured pack=1
+  fastest anyway — the product bytes grow pack^2).
   """
   if pack < 1:
     raise ValueError(f"pack must be >= 1, got {pack}")
-  feats = jnp.stack([bottom_out] + list(emb_outs), axis=1)  # [B, F, D]
-  b = feats.shape[0]
+  # 2-D lane-axis concat, then a row-major (free) reshape: the backward of
+  # this build is F clean [B, D] lane-window slices, where a stack's
+  # backward slices [B, 1, D] pieces in T(1,128) layouts (~3 ms/step of
+  # relayout at F=27, B=64k, traced round 4)
+  parts = [bottom_out] + list(emb_outs)
+  b, d = parts[0].shape
+  bad = [p.shape for p in parts if p.shape != (b, d)]
+  if bad:  # the concat+reshape build would silently scramble lanes
+    raise ValueError(
+        f"dot_interact needs equal [B, D] features; got {bad} vs ({b}, {d})")
+  feats = jnp.concatenate(parts, axis=1).reshape(b, len(parts), d)
   k = 0 if self_interaction else -1
-  while pack > 1 and b % pack:
-    pack //= 2
-  activations = _packed_tril_products(feats, pack, k)
+  activations = _tril_products(feats, k)
   return jnp.concatenate([activations, bottom_out.astype(activations.dtype)],
                          axis=1)
 

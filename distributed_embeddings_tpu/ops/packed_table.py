@@ -336,12 +336,20 @@ def _use_pallas_apply() -> bool:
 
 def scatter_add_fused(layout: PackedLayout, buf: jax.Array, ids: jax.Array,
                       fused_delta: jax.Array,
-                      prefer_pallas: bool = False) -> jax.Array:
+                      prefer_pallas: bool = False,
+                      delta_scale: Optional[jax.Array] = None) -> jax.Array:
   """``buf[ids] += fused_delta`` (one indexed RMW for table + all aux).
 
   ``fused_delta``: ``[..., stride]`` additive deltas in gather_fused's lane
   order. Duplicate ids accumulate; OOB ids are dropped. Donate ``buf`` at
   the jit boundary for an in-place update.
+
+  ``delta_scale``: optional scalar multiplier for the whole delta (the
+  scale-only rule fast path, e.g. SGD's ``-lr``). On the Pallas path the
+  scale is applied in-kernel, so the caller passes raw cotangent rows and
+  no staged delta array ever exists in HBM; on the XLA path the scale is
+  applied (behind an optimization_barrier — fusing elementwise work into
+  the scatter de-optimizes its update loop) before the scatter.
 
   Lowering (measured on v5e, `docs/BENCHMARKS.md`): XLA's scatter has a
   fast sorted/locality path at ~16-25 ns/row that it only picks when the
@@ -394,7 +402,10 @@ def scatter_add_fused(layout: PackedLayout, buf: jax.Array, ids: jax.Array,
       and _use_pallas_apply() and buf.dtype == jnp.float32
   if use_pallas:
     from .pallas_apply import apply_rows_cached
-    return apply_rows_cached(buf, flat_grp, flat_upd)
+    return apply_rows_cached(buf, flat_grp, flat_upd, scale=delta_scale)
+  if delta_scale is not None:
+    flat_upd = jax.lax.optimization_barrier(
+        delta_scale.astype(flat_upd.dtype) * flat_upd)
   return buf.at[flat_grp].add(flat_upd, mode="drop")
 
 
@@ -434,6 +445,11 @@ class SparseRule:
   aux_init: Sequence[float]
   delta: callable
   weight_decay: float = 0.0
+  # for rules whose delta is a pure scalar multiple of the cotangent
+  # (SGD: -lr * g), ``linear_scale(step)`` returns that multiplier; the
+  # engine then skips the delta materialization entirely and the Pallas
+  # RMW kernel applies the scale in-VMEM (`pallas_apply.apply_rows_cached`)
+  linear_scale: Optional[callable] = None
 
   def init_aux(self, rows: int, width: int, dtype=jnp.float32) -> List:
     return [np.full((rows, width), v, dtype) for v in self.aux_init]
@@ -450,7 +466,8 @@ def sgd_rule(learning_rate) -> SparseRule:
     del aux_rows
     return -_lr_at(learning_rate, step) * g
 
-  return SparseRule("sgd", 0, (), delta)
+  return SparseRule("sgd", 0, (), delta,
+                    linear_scale=lambda step: -_lr_at(learning_rate, step))
 
 
 def adagrad_rule(learning_rate, initial_accumulator_value: float = 0.1,

@@ -48,9 +48,17 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _apply_kernel(slots, chunk,
-                  ids_ref, buf_in, delta_ref, buf_out,
-                  tags, wrote, rbuf, wbuf, ebuf, rsem, wsem):
+def _apply_kernel(slots, chunk, scaled,
+                  *refs):
+  if scaled:
+    # delta = scale * g computed in-kernel (the SGD fast path): skips the
+    # HBM materialization of a separate delta array AND the
+    # optimization_barrier staging the XLA path needs
+    (ids_ref, buf_in, delta_ref, scale_ref, buf_out,
+     tags, wrote, rbuf, wbuf, ebuf, rsem, wsem) = refs
+  else:
+    (ids_ref, buf_in, delta_ref, buf_out,
+     tags, wrote, rbuf, wbuf, ebuf, rsem, wsem) = refs
   c = pl.program_id(0)
   nc = pl.num_programs(0)
   rows = buf_in.shape[0]
@@ -63,6 +71,10 @@ def _apply_kernel(slots, chunk,
       return 0
     jax.lax.fori_loop(0, slots, body, 0)
 
+  def row_delta(j):
+    d = delta_ref[pl.ds(j, 1), :]
+    return scale_ref[0] * d if scaled else d
+
   def occurrence(j, _):
     idx = ids_ref[j]
     valid = jnp.logical_and(idx >= 0, idx < rows)
@@ -74,8 +86,7 @@ def _apply_kernel(slots, chunk,
 
     @pl.when(hit)
     def _hit():
-      wbuf[pl.ds(slot, 1), :] = wbuf[pl.ds(slot, 1), :] \
-          + delta_ref[pl.ds(j, 1), :]
+      wbuf[pl.ds(slot, 1), :] = wbuf[pl.ds(slot, 1), :] + row_delta(j)
 
     @pl.when(jnp.logical_and(valid, jnp.logical_not(hit)))
     def _claim():
@@ -103,7 +114,7 @@ def _apply_kernel(slots, chunk,
       pltpu.make_async_copy(
           buf_in.at[pl.ds(idx, 1), :], rbuf.at[pl.ds(slot, 1), :],
           rsem.at[slot]).start()
-      wbuf[pl.ds(slot, 1), :] = delta_ref[pl.ds(j, 1), :]
+      wbuf[pl.ds(slot, 1), :] = row_delta(j)
       tags[slot] = idx
 
     return 0
@@ -152,13 +163,18 @@ def _apply_kernel(slots, chunk,
 
 def apply_rows_cached(buf: jax.Array, ids: jax.Array, delta: jax.Array,
                       slots: int = 128, chunk: Optional[int] = None,
+                      scale: Optional[jax.Array] = None,
                       interpret: bool = False) -> jax.Array:
-  """``buf[ids[i]] += delta[i]`` (rows), exact for duplicates.
+  """``buf[ids[i]] += scale * delta[i]`` (rows), exact for duplicates.
 
   Args:
     buf: [rows, width] f32, width a multiple of 128 lanes. Donated.
     ids: [n] int32 physical row ids; out-of-range ids are dropped.
     delta: [n, width] additive updates.
+    scale: optional scalar multiplier computed in-kernel (``None`` = 1).
+      Lets scale-only update rules (SGD: delta = -lr * g) pass the raw
+      cotangent straight in, skipping the HBM delta materialization and
+      its optimization_barrier staging.
     slots: cache slots (VMEM use = 3 * slots * width * 4 bytes; DMA
       semaphore use = 2 * slots of the chip's ~512-semaphore budget).
     chunk: ids per grid step. Default scales with row width so the
@@ -199,15 +215,21 @@ def apply_rows_cached(buf: jax.Array, ids: jax.Array, delta: jax.Array,
     ids = jnp.concatenate([ids, jnp.full((pad,), -1, ids.dtype)])
     delta = jnp.concatenate(
         [delta, jnp.zeros((pad, w), delta.dtype)])
-  kernel = functools.partial(_apply_kernel, slots, chunk)
+  scaled = scale is not None
+  kernel = functools.partial(_apply_kernel, slots, chunk, scaled)
+  in_specs = [
+      pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.SMEM),
+      pl.BlockSpec(memory_space=pltpu.ANY),  # buf (aliased)
+      pl.BlockSpec((chunk, w), lambda i: (i, 0)),
+  ]
+  operands = [ids, buf, delta]
+  if scaled:
+    in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    operands.append(jnp.reshape(scale, (1,)).astype(jnp.float32))
   return pl.pallas_call(
       kernel,
       grid=((n + pad) // chunk,),
-      in_specs=[
-          pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.SMEM),
-          pl.BlockSpec(memory_space=pltpu.ANY),  # buf (aliased)
-          pl.BlockSpec((chunk, w), lambda i: (i, 0)),
-      ],
+      in_specs=in_specs,
       out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
       out_shape=jax.ShapeDtypeStruct(buf.shape, buf.dtype),
       scratch_shapes=[
@@ -222,4 +244,4 @@ def apply_rows_cached(buf: jax.Array, ids: jax.Array, delta: jax.Array,
       input_output_aliases={1: 0},
       compiler_params=pltpu.CompilerParams(has_side_effects=True),
       interpret=interpret,
-  )(ids, buf, delta)
+  )(*operands)

@@ -1096,6 +1096,11 @@ class DistributedLookup:
         # buckets' ids/deltas are concatenated and applied at once.
         n_total = sum(int(np.prod(ids.shape)) for ids, _, _, _ in parts)
         if n_total <= self.apply_chunk:
+          # scale-only rules (SGD): the fused delta is a scalar multiple of
+          # the cotangent, so pass raw cotangent rows and let the scatter
+          # backend apply the scale (the Pallas kernel does it in-VMEM —
+          # no staged delta array, no optimization_barrier)
+          scale_only = rule.linear_scale is not None
           all_ids, all_deltas = [], []
           for ids, dzb, aux, h in parts:
             n = int(np.prod(ids.shape))
@@ -1106,14 +1111,16 @@ class DistributedLookup:
             aux_r = aux_occ(aux, layout)
             g = decayed(g, aux, layout)
             all_ids.append(ids.reshape(-1))
-            all_deltas.append(rule.delta(g, aux_r, step))
+            all_deltas.append(g if scale_only else rule.delta(g, aux_r, step))
           ids_cat = (all_ids[0] if len(all_ids) == 1
                      else jnp.concatenate(all_ids))
           delta_cat = (all_deltas[0] if len(all_deltas) == 1
                        else jnp.concatenate(all_deltas))
-          # materialize the updates before the scatter: letting XLA fuse
-          # the delta computation into the scatter slows its update loop
-          ids_cat, delta_cat = lax.optimization_barrier((ids_cat, delta_cat))
+          if not scale_only:
+            # materialize the updates before the scatter: letting XLA fuse
+            # the delta computation into the scatter slows its update loop
+            ids_cat, delta_cat = lax.optimization_barrier(
+                (ids_cat, delta_cat))
           # Static scatter-regime choice (measured matrix in
           # docs/BENCHMARKS.md): XLA's fast sorted path (~16-25 ns/row)
           # only engages when the stream is >= ~0.15x the buffer's
@@ -1121,8 +1128,10 @@ class DistributedLookup:
           # Pallas RMW cache kernel (~47-60 ns in every duplication
           # regime) wins. Both quantities are static here.
           ratio = ids_cat.shape[0] / max(1, layout.phys_rows)
-          buf = scatter_add_fused(layout, buf, ids_cat, delta_cat,
-                                  prefer_pallas=ratio < 0.15)
+          buf = scatter_add_fused(
+              layout, buf, ids_cat, delta_cat,
+              prefer_pallas=ratio < 0.15,
+              delta_scale=(rule.linear_scale(step) if scale_only else None))
         else:
           # memory escape hatch for extreme occurrence counts (hotness
           # 200-500 models): compute the delta per chunk (never holding

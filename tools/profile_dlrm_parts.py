@@ -41,7 +41,8 @@ def main():
                compute_dtype=jnp.bfloat16 if AMP else jnp.float32)
   plan = DistEmbeddingStrategy(
       [dict(input_dim=v, output_dim=128, combiner=None) for v in vocab],
-      1, "basic", dense_row_threshold=model.dense_row_threshold)
+      1, "basic", dense_row_threshold=model.dense_row_threshold,
+      batch_hint=BATCH)
   engine = DistributedLookup(plan)
   rule = sgd_rule(24.0)
   layouts = engine.fused_layouts(rule)

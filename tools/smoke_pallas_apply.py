@@ -70,6 +70,17 @@ def main():
   if not ok:
     FAILED.append("randomized")
 
+  # in-kernel delta scale (the SGD fast path: raw cotangents + scale)
+  got_s = apply_rows_cached(base + 0, ids, delta,
+                            scale=jnp.float32(-0.125))
+  want_s = base.at[ids].add(-0.125 * delta)
+  err = float(jnp.max(jnp.abs(got_s - want_s) / (1 + jnp.abs(want_s))))
+  ok = err < 1e-4
+  print(f"{'in-kernel scale vs XLA':34s}: "
+        f"{'OK' if ok else 'FAIL'} (rel err {err:.2e})")
+  if not ok:
+    FAILED.append("scale")
+
   # narrow-class dispatch: lane-expanded sub-row deltas through the same
   # kernel at physical-row granularity (scatter_add_fused with rpp > 1)
   from distributed_embeddings_tpu.ops.packed_table import (

@@ -1,0 +1,115 @@
+"""Device-trace one DLRM bench step and print per-fusion timings.
+
+Captures a jax.profiler device trace of the compiled bench step (exact
+bench config: batch 65536, vocab 1/16, SGD, dense_row_threshold 4096,
+batch_hint) and prints every device op over a duration floor, sorted by
+total time — the ground-truth attribution for where the step's
+milliseconds sit (fusion names carry the originating HLO/op metadata).
+
+Usage: python tools/trace_dlrm.py [batch] [vocab_scale]
+"""
+
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_embeddings_tpu.layers.planner import DistEmbeddingStrategy
+from distributed_embeddings_tpu.models import DLRM, bce_loss
+from distributed_embeddings_tpu.ops.packed_table import sgd_rule
+from distributed_embeddings_tpu.training import (
+    init_sparse_state_direct,
+    make_sparse_train_step,
+)
+
+CRITEO_1TB_VOCAB = [
+    39884406, 39043, 17289, 7420, 20263, 3, 7120, 1543, 63, 38532951,
+    2953546, 403346, 10, 2208, 11938, 155, 4, 976, 14, 39979771,
+    25641295, 39664984, 585935, 12972, 108, 36
+]
+
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+SCALE = float(sys.argv[2]) if len(sys.argv) > 2 else 0.0625
+
+
+def main():
+  vocab = [max(4, int(v * SCALE)) for v in CRITEO_1TB_VOCAB]
+  model = DLRM(vocab_sizes=vocab, embedding_dim=128, world_size=1,
+               dense_row_threshold=4096)
+  plan = DistEmbeddingStrategy(
+      [dict(input_dim=v, output_dim=128, combiner=None) for v in vocab],
+      1, "basic", dense_row_threshold=4096, batch_hint=BATCH)
+
+  rng = np.random.default_rng(0)
+  numerical = jnp.asarray(rng.standard_normal((BATCH, 13)), jnp.float32)
+  cats = [jnp.asarray(rng.integers(0, v, BATCH), jnp.int32) for v in vocab]
+  labels = jnp.asarray(rng.integers(0, 2, BATCH), jnp.float32)
+  batch = (numerical, cats, labels)
+
+  rule = sgd_rule(24.0)
+  dense_opt = optax.sgd(24.0)
+  dummy_acts = [jnp.zeros((2, 128), jnp.float32) for _ in vocab]
+  dense_params = model.init(jax.random.PRNGKey(0), numerical[:2],
+                            [c[:2] for c in cats], emb_acts=dummy_acts)["params"]
+  state_avals = jax.eval_shape(
+      lambda: init_sparse_state_direct(plan, rule, dense_params, dense_opt,
+                                       jax.random.PRNGKey(1)))
+  step = make_sparse_train_step(model, plan, bce_loss, dense_opt, rule,
+                                None, state_avals, batch)
+  compiled = step.lower(state_avals, *batch).compile()
+  state = init_sparse_state_direct(plan, rule, dense_params, dense_opt,
+                                   jax.random.PRNGKey(1))
+  for _ in range(3):
+    state, loss = compiled(state, *batch)
+  float(loss)
+
+  tdir = f"/tmp/dlrm_trace_{int(time.time())}"
+  with jax.profiler.trace(tdir):
+    for _ in range(2):
+      state, loss = compiled(state, *batch)
+    float(loss)
+
+  path = sorted(glob.glob(f"{tdir}/plugins/profile/*/*.trace.json.gz"))[-1]
+  with gzip.open(path) as f:
+    t = json.load(f)
+  names = {}
+  for e in t.get("traceEvents", []):
+    if e.get("ph") == "M" and e.get("name") == "process_name":
+      names[e["pid"]] = e["args"]["name"]
+  dev_pids = {p for p, n in names.items() if "TPU" in n}
+  evs = [e for e in t.get("traceEvents", []) if e.get("ph") == "X"
+         and e.get("pid") in dev_pids]
+  print(f"{len(evs)} device events; trace at {path}")
+  from collections import defaultdict
+  tot = defaultdict(float)
+  cnt = defaultdict(int)
+  args_of = {}
+  for e in evs:
+    nm = e.get("name", "?")
+    tot[nm] += e.get("dur", 0.0)
+    cnt[nm] += 1
+    if e.get("args"):
+      args_of[nm] = e["args"]
+  grand = sum(tot.values())
+  print(f"total device us (2 steps x outer events double-count ok): {grand:.0f}")
+  for nm, us in sorted(tot.items(), key=lambda kv: -kv[1])[:60]:
+    extra = ""
+    a = args_of.get(nm)
+    if a:
+      extra = " | " + " ".join(f"{k}={str(v)[:70]}" for k, v in a.items()
+                               if k in ("long_name", "tf_op", "source",
+                                        "hlo_op", "hlo_module"))
+    print(f"{us/2/1000.0:9.3f} ms x? n={cnt[nm]:3d}  {nm[:70]}{extra[:160]}")
+
+
+if __name__ == "__main__":
+  main()
