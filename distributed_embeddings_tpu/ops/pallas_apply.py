@@ -48,7 +48,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _apply_kernel(slots, chunk, scaled,
+def _apply_kernel(slots, chunk, scaled, warm, unroll,
                   *refs):
   if scaled:
     # delta = scale * g computed in-kernel (the SGD fast path): skips the
@@ -65,11 +65,43 @@ def _apply_kernel(slots, chunk, scaled,
 
   @pl.when(c == 0)
   def _init():
-    def body(s, _):
-      tags[s] = -1
-      wrote[s] = 0
-      return 0
-    jax.lax.fori_loop(0, slots, body, 0)
+    if warm:
+      # pre-claim slot s with physical row s (row s maps to slot s):
+      # every slot then holds a valid tag with a write in flight, so the
+      # steady-state claim path needs NO cold-slot branches — the row is
+      # written back unchanged (wbuf = 0), which is harmless and ordered
+      # with any later update of row s through the same slot
+      def body(s, _):
+        tags[s] = s
+        wrote[s] = 1
+        wbuf[pl.ds(s, 1), :] = jnp.zeros_like(wbuf[pl.ds(s, 1), :])
+        pltpu.make_async_copy(
+            buf_in.at[pl.ds(s, 1), :], rbuf.at[pl.ds(s, 1), :],
+            rsem.at[s]).start()
+        return 0
+      jax.lax.fori_loop(0, slots, body, 0)
+
+      def body2(s, _):
+        pltpu.make_async_copy(
+            buf_in.at[pl.ds(0, 1), :], rbuf.at[pl.ds(s, 1), :],
+            rsem.at[s]).wait()
+        ebuf[pl.ds(s, 1), :] = rbuf[pl.ds(s, 1), :]
+        pltpu.make_async_copy(
+            ebuf.at[pl.ds(s, 1), :], buf_out.at[pl.ds(s, 1), :],
+            wsem.at[s]).start()
+        # leave a fresh read in flight so the steady-state rsem.wait pairs
+        # with exactly one outstanding read per slot
+        pltpu.make_async_copy(
+            buf_in.at[pl.ds(s, 1), :], rbuf.at[pl.ds(s, 1), :],
+            rsem.at[s]).start()
+        return 0
+      jax.lax.fori_loop(0, slots, body2, 0)
+    else:
+      def body(s, _):
+        tags[s] = -1
+        wrote[s] = 0
+        return 0
+      jax.lax.fori_loop(0, slots, body, 0)
 
   def row_delta(j):
     d = delta_ref[pl.ds(j, 1), :]
@@ -90,26 +122,41 @@ def _apply_kernel(slots, chunk, scaled,
 
     @pl.when(jnp.logical_and(valid, jnp.logical_not(hit)))
     def _claim():
-      # previous refill read of this slot must have landed before rbuf is
-      # summed into the eviction staging
-      @pl.when(tag >= 0)
-      def _evict():
+      if warm:
+        # warm slots always hold a valid tag with one read and one write
+        # outstanding — evict unconditionally, no cold branches
         pltpu.make_async_copy(
             buf_in.at[pl.ds(0, 1), :], rbuf.at[pl.ds(slot, 1), :],
             rsem.at[slot]).wait()
-        # the slot's previous eviction write must be done before ebuf is
-        # overwritten (also orders all HBM writes of one row)
-        @pl.when(wrote[slot] == 1)
-        def _():
-          pltpu.make_async_copy(
-              ebuf.at[pl.ds(slot, 1), :], buf_out.at[pl.ds(0, 1), :],
-              wsem.at[slot]).wait()
+        pltpu.make_async_copy(
+            ebuf.at[pl.ds(slot, 1), :], buf_out.at[pl.ds(0, 1), :],
+            wsem.at[slot]).wait()
         ebuf[pl.ds(slot, 1), :] = rbuf[pl.ds(slot, 1), :] \
             + wbuf[pl.ds(slot, 1), :]
         pltpu.make_async_copy(
             ebuf.at[pl.ds(slot, 1), :], buf_out.at[pl.ds(tag, 1), :],
             wsem.at[slot]).start()
-        wrote[slot] = 1
+      else:
+        # previous refill read of this slot must have landed before rbuf
+        # is summed into the eviction staging
+        @pl.when(tag >= 0)
+        def _evict():
+          pltpu.make_async_copy(
+              buf_in.at[pl.ds(0, 1), :], rbuf.at[pl.ds(slot, 1), :],
+              rsem.at[slot]).wait()
+          # the slot's previous eviction write must be done before ebuf is
+          # overwritten (also orders all HBM writes of one row)
+          @pl.when(wrote[slot] == 1)
+          def _():
+            pltpu.make_async_copy(
+                ebuf.at[pl.ds(slot, 1), :], buf_out.at[pl.ds(0, 1), :],
+                wsem.at[slot]).wait()
+          ebuf[pl.ds(slot, 1), :] = rbuf[pl.ds(slot, 1), :] \
+              + wbuf[pl.ds(slot, 1), :]
+          pltpu.make_async_copy(
+              ebuf.at[pl.ds(slot, 1), :], buf_out.at[pl.ds(tag, 1), :],
+              wsem.at[slot]).start()
+          wrote[slot] = 1
 
       pltpu.make_async_copy(
           buf_in.at[pl.ds(idx, 1), :], rbuf.at[pl.ds(slot, 1), :],
@@ -119,12 +166,12 @@ def _apply_kernel(slots, chunk, scaled,
 
     return 0
 
-  def pair(p, _):  # 2x manual unroll halves the fori_loop bookkeeping
-    occurrence(2 * p, 0)
-    occurrence(2 * p + 1, 0)
+  def group(p, _):  # manual unroll cuts the fori_loop bookkeeping
+    for u in range(unroll):
+      occurrence(unroll * p + u, 0)
     return 0
 
-  jax.lax.fori_loop(0, chunk // 2, pair, 0)
+  jax.lax.fori_loop(0, chunk // unroll, group, 0)
 
   @pl.when(c == nc - 1)
   def _flush():
@@ -164,6 +211,8 @@ def _apply_kernel(slots, chunk, scaled,
 def apply_rows_cached(buf: jax.Array, ids: jax.Array, delta: jax.Array,
                       slots: int = 128, chunk: Optional[int] = None,
                       scale: Optional[jax.Array] = None,
+                      warm: Optional[bool] = None,
+                      unroll: int = 8,
                       interpret: bool = False) -> jax.Array:
   """``buf[ids[i]] += scale * delta[i]`` (rows), exact for duplicates.
 
@@ -175,6 +224,12 @@ def apply_rows_cached(buf: jax.Array, ids: jax.Array, delta: jax.Array,
       Lets scale-only update rules (SGD: delta = -lr * g) pass the raw
       cotangent straight in, skipping the HBM delta materialization and
       its optimization_barrier staging.
+    warm: pre-claim every cache slot with its same-numbered physical row
+      at startup, which removes the two cold-slot branches from the
+      steady-state claim path (scalar-core cycles on the per-occurrence
+      critical path). Default: on when the buffer has at least ``slots``
+      rows (the init touches rows ``[0, slots)``), off otherwise.
+    unroll: occurrences per fori_loop body (loop-bookkeeping amortization).
     slots: cache slots (VMEM use = 3 * slots * width * 4 bytes; DMA
       semaphore use = 2 * slots of the chip's ~512-semaphore budget).
     chunk: ids per grid step. Default scales with row width so the
@@ -192,8 +247,8 @@ def apply_rows_cached(buf: jax.Array, ids: jax.Array, delta: jax.Array,
   if slots & (slots - 1):
     raise ValueError(f"slots must be a power of two, got {slots}")
   if chunk is not None and chunk % 128:
-    # multiple of 128 for the SMEM block layout; evenness for the 2x
-    # unrolled pair loop (an odd chunk would silently skip one id/step)
+    # multiple of 128 for the SMEM block layout (unroll divisibility is
+    # checked separately below)
     raise ValueError(f"chunk must be a multiple of 128, got {chunk}")
   if delta.shape != (n, w):
     raise ValueError(f"delta shape {delta.shape} != ({n}, {w})")
@@ -215,8 +270,18 @@ def apply_rows_cached(buf: jax.Array, ids: jax.Array, delta: jax.Array,
     ids = jnp.concatenate([ids, jnp.full((pad,), -1, ids.dtype)])
     delta = jnp.concatenate(
         [delta, jnp.zeros((pad, w), delta.dtype)])
+  if unroll < 1:
+    raise ValueError(f"unroll must be >= 1, got {unroll}")
+  if chunk % unroll:
+    raise ValueError(f"chunk {chunk} not divisible by unroll {unroll}")
+  if warm is None:
+    warm = buf.shape[0] >= slots
+  elif warm and buf.shape[0] < slots:
+    raise ValueError(f"warm init touches rows [0, {slots}) but the buffer "
+                     f"has only {buf.shape[0]} rows")
   scaled = scale is not None
-  kernel = functools.partial(_apply_kernel, slots, chunk, scaled)
+  kernel = functools.partial(_apply_kernel, slots, chunk, scaled, warm,
+                             unroll)
   in_specs = [
       pl.BlockSpec((chunk,), lambda i: (i,), memory_space=pltpu.SMEM),
       pl.BlockSpec(memory_space=pltpu.ANY),  # buf (aliased)

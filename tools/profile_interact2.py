@@ -1,0 +1,105 @@
+"""A/B the interaction's operand forms (fwd+bwd) on the real chip.
+
+Variants build feats from 27 separate [B, 128] parts (the shape the model
+actually has), run the product + triangle-selection + a nonlinear consumer,
+and take grads w.r.t. every part — so the concat/stack build AND its
+backward split are inside the measured region, like the real step.
+
+Usage: python tools/profile_interact2.py [batch]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from distributed_embeddings_tpu.models.dlrm import _tril_select_np
+
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+F, D = 27, 128
+K = 8
+
+
+def timeit(name, fn, parts):
+  step = jax.jit(fn)
+  c = step(parts)
+  jax.block_until_ready(c)
+
+  def run(n):
+    t0 = time.perf_counter()
+    c = None
+    for _ in range(n):
+      c = step(parts)
+    jax.block_until_ready(c)
+    return time.perf_counter() - t0
+
+  t1 = run(K)
+  t2 = run(2 * K)
+  print(f"{name:40s}: {(t2 - t1) / K * 1e3:8.2f} ms", flush=True)
+
+
+def consume(acts):
+  return jnp.sum(jnp.tanh(acts.astype(jnp.float32)))
+
+
+def main():
+  rng = np.random.default_rng(0)
+  parts = [jnp.asarray(rng.standard_normal((BATCH, D)), jnp.float32)
+           for _ in range(F)]
+  m_np, p = _tril_select_np(F, -1)
+  m = jnp.asarray(m_np)
+
+  def v_concat(ps):  # current: lane concat + reshape, custom-vjp math inline
+    def f(ps):
+      feats = jnp.concatenate(ps, axis=1).reshape(BATCH, F, D)
+      inter = jnp.einsum("bpd,bqd->bpq", feats, feats,
+                         preferred_element_type=jnp.float32)
+      return consume(jnp.einsum("bpq,pqn->bn", inter, m,
+                                preferred_element_type=jnp.float32))
+    g = jax.grad(f)(ps)
+    return sum(x[0, 0] for x in g)
+
+  def v_stack0(ps):  # [F, B, D] major-axis build
+    def f(ps):
+      feats = jnp.stack(ps, axis=0)
+      inter = jnp.einsum("pbd,qbd->bpq", feats, feats,
+                         preferred_element_type=jnp.float32)
+      return consume(jnp.einsum("bpq,pqn->bn", inter, m,
+                                preferred_element_type=jnp.float32))
+    g = jax.grad(f)(ps)
+    return sum(x[0, 0] for x in g)
+
+  def v_stack1(ps):  # [B, F, D] via stack axis=1 (round-3 form)
+    def f(ps):
+      feats = jnp.stack(ps, axis=1)
+      inter = jnp.einsum("bpd,bqd->bpq", feats, feats,
+                         preferred_element_type=jnp.float32)
+      return consume(jnp.einsum("bpq,pqn->bn", inter, m,
+                                preferred_element_type=jnp.float32))
+    g = jax.grad(f)(ps)
+    return sum(x[0, 0] for x in g)
+
+  def v_bf16(ps):  # concat form, bf16 operands into both einsums
+    def f(ps):
+      feats = jnp.concatenate(ps, axis=1).reshape(BATCH, F, D)
+      fb = feats.astype(jnp.bfloat16)
+      inter = jnp.einsum("bpd,bqd->bpq", fb, fb,
+                         preferred_element_type=jnp.float32)
+      return consume(jnp.einsum("bpq,pqn->bn", inter.astype(jnp.bfloat16),
+                                m.astype(jnp.bfloat16),
+                                preferred_element_type=jnp.float32))
+    g = jax.grad(f)(ps)
+    return sum(x[0, 0] for x in g)
+
+  timeit("concat axis1 + reshape (current)", v_concat, parts)
+  timeit("stack axis0 [F,B,D]", v_stack0, parts)
+  timeit("stack axis1 [B,F,D] (round-3 build)", v_stack1, parts)
+  timeit("concat + bf16 operands", v_bf16, parts)
+
+
+if __name__ == "__main__":
+  main()
