@@ -81,13 +81,35 @@ def _tril_products(feats: jax.Array, k: int) -> jax.Array:
   return out
 
 
+def _mxu_operand_dtype(dtype):
+  """bf16 on TPU under DEFAULT matmul precision, pass-through elsewhere.
+
+  Under JAX's DEFAULT matmul precision the TPU MXU multiplies f32
+  operands as one bf16 pass anyway, so storing the einsum operands in
+  bf16 changes no product bits on TPU — it only halves the bytes of the
+  relayout copies XLA schedules around the batched product (traced
+  ~2.8 ms/step of f32 copies at F=27, B=64k). The cast is skipped when
+  the user raised ``jax_default_matmul_precision`` (they asked for true
+  f32 passes) and on CPU (tests), where f32 dots are real f32. Keyed on
+  the default backend: a computation explicitly placed off the default
+  TPU (e.g. ``jax.jit(..., backend="cpu")`` on a TPU host) still gets
+  the cast — accepted limitation of trace-time backend detection."""
+  if dtype != jnp.float32 or jax.default_backend() != "tpu":
+    return dtype
+  prec = jax.config.jax_default_matmul_precision
+  if prec not in (None, "default", "bfloat16", "fastest"):
+    return dtype  # user explicitly asked for multi-pass f32 fidelity
+  return jnp.bfloat16
+
+
 def _tril_fwd(feats, k):
   b, f, d = feats.shape
   m_np, p = _tril_select_np(f, k)
-  m = jnp.asarray(m_np, feats.dtype)
-  inter = jnp.einsum("bpd,bqd->bpq", feats, feats,
+  cd = _mxu_operand_dtype(feats.dtype)
+  m = jnp.asarray(m_np, cd)
+  inter = jnp.einsum("bpd,bqd->bpq", feats.astype(cd), feats.astype(cd),
                      preferred_element_type=jnp.float32)
-  acts = jnp.einsum("bpq,pqn->bn", inter.astype(feats.dtype), m,
+  acts = jnp.einsum("bpq,pqn->bn", inter.astype(cd), m,
                     preferred_element_type=jnp.float32)
   return acts, feats
 
@@ -97,14 +119,17 @@ def _tril_bwd(k, feats, d_acts):
   m_np, p = _tril_select_np(f, k)
   # under bf16 compute (AMP) the cotangent is rounded to bf16 before the
   # grad einsums — the AMP convention (the reference's fp16 backward does
-  # the same); exact-f32 parity with autodiff holds for f32 feats
-  m = jnp.asarray(m_np, feats.dtype)
-  d_sym = jnp.einsum("bn,pqn->bpq", d_acts.astype(feats.dtype), m,
+  # the same); on-TPU f32 parity with autodiff holds because DEFAULT MXU
+  # precision rounds einsum operands to bf16 either way (_mxu_operand_dtype)
+  cd = _mxu_operand_dtype(feats.dtype)
+  m = jnp.asarray(m_np, cd)
+  d_sym = jnp.einsum("bn,pqn->bpq", d_acts.astype(cd), m,
                      preferred_element_type=jnp.float32)
   # d(F F^T) needs (G + G^T) @ F; d_sym = (G + G^T)/2 is symmetric by
   # construction (M weights both mirrored cells), so one einsum x2 does it
-  d_feats = 2.0 * jnp.einsum("bpq,bqd->bpd", d_sym.astype(feats.dtype),
-                             feats, preferred_element_type=jnp.float32)
+  d_feats = 2.0 * jnp.einsum("bpq,bqd->bpd", d_sym.astype(cd),
+                             feats.astype(cd),
+                             preferred_element_type=jnp.float32)
   return (d_feats.astype(feats.dtype),)
 
 
@@ -137,7 +162,16 @@ def dot_interact(bottom_out: jax.Array, emb_outs: Sequence[jax.Array],
   if bad:  # the concat+reshape build would silently scramble lanes
     raise ValueError(
         f"dot_interact needs equal [B, D] features; got {bad} vs ({b}, {d})")
-  feats = jnp.concatenate(parts, axis=1).reshape(b, len(parts), d)
+  # cast the einsum operands at the source (see _mxu_operand_dtype: a
+  # numerics no-op for the products on TPU, where DEFAULT MXU precision
+  # rounds operands to bf16 anyway) so the concat, its relayout copies,
+  # and the backward split all move half the bytes. The casts' VJP
+  # returns the feature cotangents in their original dtype; the one real
+  # divergence is a single bf16 rounding of each cotangent value, within
+  # the precision class the TF32 reference computes its backward in.
+  cd = _mxu_operand_dtype(parts[0].dtype)
+  feats = jnp.concatenate(
+      [p.astype(cd) for p in parts], axis=1).reshape(b, len(parts), d)
   k = 0 if self_interaction else -1
   activations = _tril_products(feats, k)
   return jnp.concatenate([activations, bottom_out.astype(activations.dtype)],

@@ -48,6 +48,19 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+# One-shot one-hot staging bound, in [G, vcap] CELLS per bucket: buckets
+# under it run a single windowed MXU matmul; above it, a lax.scan over
+# batch chunks bounds the live staging. The bench swept 1<<25 / 1<<26 /
+# 1<<27 / 1<<28 at 0.909 / 0.912 / 0.925 / 0.982 vs baseline — HIGHER is
+# better (samples/s ratio, round 4): bigger one-shot blocks win
+# consistently (the scan's per-chunk transposes cost ~4 ms/step at
+# batch 64k; the big bf16 staging block is live only across one matmul
+# pair). Default 1<<28 cells (512 MiB bf16) one-shots every Criteo
+# bucket at batch 64k. Env-tunable, read ONCE at import (same convention
+# as DE_TPU_GATHER_CHUNK: 0/unset = built-in default).
+_ONEHOT_ONESHOT_CELLS = (
+    int(os.environ.get("DE_TPU_ONEHOT_CELLS", "0") or "0") or (1 << 28))
+
 from typing import TYPE_CHECKING
 
 if TYPE_CHECKING:  # annotation-only: importing layers here would close the
@@ -629,13 +642,13 @@ class DistributedLookup:
                         preferred_element_type=jnp.float32
                         ).astype(table_local.dtype)
 
-    if n_b * g * h * vcap <= (1 << 25):
+    if n_b * g * h * vcap <= _ONEHOT_ONESHOT_CELLS:
       z = z_of(ids_local)
     else:
       # chunk the batch axis so the one-hot staging stays bounded; remat the
       # body so scan doesn't stack per-iteration one-hot residuals for the
       # backward (rebuilding them is a few VPU compares per element)
-      chunk = max(1, (1 << 25) // max(1, n_b * h * vcap))
+      chunk = max(1, _ONEHOT_ONESHOT_CELLS // max(1, n_b * h * vcap))
       nchunks = -(-g // chunk)
       pad = nchunks * chunk - g
       ids_c = ids_local
