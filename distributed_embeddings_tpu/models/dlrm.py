@@ -26,6 +26,7 @@ import numpy as np
 
 from ..layers.dist_model_parallel import DistributedEmbedding
 from ..layers.embedding import TableConfig
+from ..ops.packed_table import mxu_operand_dtype as _mxu_operand_dtype
 
 
 class MLP(nn.Module):
@@ -79,27 +80,6 @@ def _tril_products(feats: jax.Array, k: int) -> jax.Array:
   ``boolean_mask`` interaction (`examples/dlrm/utils.py:92-113`)."""
   out, _ = _tril_fwd(feats, k)
   return out
-
-
-def _mxu_operand_dtype(dtype):
-  """bf16 on TPU under DEFAULT matmul precision, pass-through elsewhere.
-
-  Under JAX's DEFAULT matmul precision the TPU MXU multiplies f32
-  operands as one bf16 pass anyway, so storing the einsum operands in
-  bf16 changes no product bits on TPU — it only halves the bytes of the
-  relayout copies XLA schedules around the batched product (traced
-  ~2.8 ms/step of f32 copies at F=27, B=64k). The cast is skipped when
-  the user raised ``jax_default_matmul_precision`` (they asked for true
-  f32 passes) and on CPU (tests), where f32 dots are real f32. Keyed on
-  the default backend: a computation explicitly placed off the default
-  TPU (e.g. ``jax.jit(..., backend="cpu")`` on a TPU host) still gets
-  the cast — accepted limitation of trace-time backend detection."""
-  if dtype != jnp.float32 or jax.default_backend() != "tpu":
-    return dtype
-  prec = jax.config.jax_default_matmul_precision
-  if prec not in (None, "default", "bfloat16", "fastest"):
-    return dtype  # user explicitly asked for multi-pass f32 fidelity
-  return jnp.bfloat16
 
 
 def _tril_fwd(feats, k):

@@ -326,6 +326,31 @@ def gather_fused_chunked(layout: PackedLayout, buf: jax.Array,
   return out.reshape(ids.shape + (width,))
 
 
+def mxu_operand_dtype(dtype):
+  """bf16 on TPU under DEFAULT matmul precision, pass-through elsewhere.
+
+  Under JAX's DEFAULT matmul precision the TPU MXU multiplies f32
+  operands as one bf16 pass anyway, so storing a matmul operand in bf16
+  changes no product bits on TPU — it only halves the operand's HBM
+  traffic and any relayout copies XLA schedules around the dot. The cast
+  is skipped when the user raised ``jax_default_matmul_precision`` (they
+  asked for true multi-pass f32) and on CPU (tests), where f32 dots are
+  real f32. Keyed on the default backend: a computation explicitly
+  placed off the default TPU still gets the cast — accepted limitation
+  of trace-time backend detection."""
+  if dtype != jnp.float32:
+    return dtype
+  try:
+    if jax.default_backend() != "tpu":
+      return dtype
+  except RuntimeError:
+    return dtype
+  prec = jax.config.jax_default_matmul_precision
+  if prec not in (None, "default", "bfloat16", "fastest"):
+    return dtype  # user explicitly asked for multi-pass f32 fidelity
+  return jnp.bfloat16
+
+
 def _use_pallas_apply() -> bool:
   """True when the Pallas RMW apply kernel can run (real TPU backend)."""
   try:

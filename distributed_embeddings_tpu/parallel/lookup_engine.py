@@ -40,6 +40,7 @@ reference's ~100 lines of Horovod tape patching.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import os
 from typing import Dict, List, NamedTuple, Optional, Sequence
 
@@ -73,6 +74,7 @@ from ..ops.packed_table import (
     SparseRule,
     gather_fused,
     gather_fused_chunked,
+    mxu_operand_dtype,
     scatter_add_fused,
 )
 from ..ops.ragged import RaggedIds
@@ -272,6 +274,55 @@ class SparseResiduals:
 def _batch_of(inputs) -> int:
   x = inputs[0]
   return x.nrows if isinstance(x, RaggedIds) else x.shape[0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _onehot_window_matmul(two_d: bool, vcap: int, ids_c, wins):
+  """``one_hot(ids) @ wins`` with asymmetric forward/backward precision.
+
+  Forward: bf16 one-hot (exact — values are 0/1) against the f32 window
+  at HIGHEST precision, so the emitted activations are the exact table
+  rows, matching gather semantics (this path replaces a gather; the
+  reference's equivalent is the ``ConcatOneHotEmbedding`` gather,
+  `embedding.py:155-180`).
+
+  Backward: ``d_wins = one_hot^T @ d_z`` rebuilds the one-hot (cheaper
+  than keeping the [G, vcap] block live as a residual) and contracts at
+  the backend's default operand precision (`mxu_operand_dtype`): on TPU
+  the cotangent operand is stored bf16 — the same one-bf16-pass product
+  class a DEFAULT-precision f32 matmul uses — which halves the backward
+  matmul passes vs inheriting the forward's HIGHEST. Unlike the forward
+  (whose output must be bit-exact rows), the backward is a gradient
+  accumulation in the TF32/AMP precision class the reference trains in.
+
+  Being a ``custom_vjp``, this op supports reverse-mode AD only —
+  ``jax.jvp``/``jacfwd`` over a model with dense-path tables raises.
+  """
+  out, _ = _onehot_window_matmul_fwd(two_d, vcap, ids_c, wins)
+  return out
+
+
+def _onehot_window_matmul_fwd(two_d, vcap, ids_c, wins):
+  oh = jax.nn.one_hot(ids_c, vcap, dtype=jnp.bfloat16)
+  eq = "ngv,nvw->ngw" if two_d else "nghv,nvw->ngw"
+  z = jnp.einsum(eq, oh, wins, precision=jax.lax.Precision.HIGHEST,
+                 preferred_element_type=jnp.float32)
+  return z, (ids_c,)
+
+
+def _onehot_window_matmul_bwd(two_d, vcap, res, d_z):
+  (ids_c,) = res
+  oh = jax.nn.one_hot(ids_c, vcap, dtype=jnp.bfloat16)
+  eq = "ngv,ngw->nvw" if two_d else "nghv,ngw->nvw"
+  cd = mxu_operand_dtype(jnp.float32)
+  d_wins = jnp.einsum(eq, oh, d_z.astype(cd),
+                      preferred_element_type=jnp.float32)
+  d_ids = np.zeros(ids_c.shape, dtype=jax.dtypes.float0)
+  return d_ids, d_wins
+
+
+_onehot_window_matmul.defvjp(_onehot_window_matmul_fwd,
+                             _onehot_window_matmul_bwd)
 
 
 class DistributedLookup:
@@ -631,23 +682,16 @@ class DistributedLookup:
 
     wins = jax.vmap(window)(offs)  # [n_b, vcap, w]
 
-    # bf16 one-hot is exact (values are 0/1) and halves the [G, vcap]
-    # staging memory; HIGHEST precision keeps the f32 table values intact
-    # through the MXU (default precision would round them to bf16).
     def z_of(ids_c):  # [n_b, C(, h)] -> [n_b, C, w]
-      oh = jax.nn.one_hot(ids_c, vcap, dtype=jnp.bfloat16)
-      eq = "ngv,nvw->ngw" if two_d else "nghv,nvw->ngw"
-      return jnp.einsum(eq, oh, wins,
-                        precision=jax.lax.Precision.HIGHEST,
-                        preferred_element_type=jnp.float32
-                        ).astype(table_local.dtype)
+      return _onehot_window_matmul(two_d, vcap, ids_c,
+                                   wins).astype(table_local.dtype)
 
     if n_b * g * h * vcap <= _ONEHOT_ONESHOT_CELLS:
       z = z_of(ids_local)
     else:
-      # chunk the batch axis so the one-hot staging stays bounded; remat the
-      # body so scan doesn't stack per-iteration one-hot residuals for the
-      # backward (rebuilding them is a few VPU compares per element)
+      # chunk the batch axis so the one-hot staging stays bounded (the
+      # custom VJP's only residual is ids_c, so the backward rebuilds each
+      # chunk's one-hot rather than stacking it)
       chunk = max(1, _ONEHOT_ONESHOT_CELLS // max(1, n_b * h * vcap))
       nchunks = -(-g // chunk)
       pad = nchunks * chunk - g
@@ -660,8 +704,7 @@ class DistributedLookup:
         xs = ids_c.reshape(n_b, nchunks, chunk).transpose(1, 0, 2)
       else:
         xs = ids_c.reshape(n_b, nchunks, chunk, h).transpose(1, 0, 2, 3)
-      _, zs = lax.scan(
-          jax.checkpoint(lambda c, i: (c, z_of(i))), None, xs)
+      _, zs = lax.scan(lambda c, i: (c, z_of(i)), None, xs)
       z = zs.transpose(1, 0, 2, 3).reshape(n_b, nchunks * chunk, -1)[:, :g]
     cp = self.plan.classes[key]
     if cp.combiner == "mean" and h > 1:
