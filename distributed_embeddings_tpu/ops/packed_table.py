@@ -423,8 +423,14 @@ def scatter_add_fused(layout: PackedLayout, buf: jax.Array, ids: jax.Array,
   # duplicate), and the kernel's cache is keyed by physical row. The
   # expansion stays outside the kernel by measurement: fused into either
   # backend it costs ~1.7 ns/occ (docs/BENCHMARKS.md, profile_select).
+  # Mosaic rejects 1-row dynamic HBM slices of tiled memrefs wider than
+  # one 128-lane tile ("slice along dim 0 must be aligned to (8)" at
+  # phys_width 256 — w128 tables + interleaved aux), so the RMW kernel
+  # serves exactly the 128-lane physical layouts; wider classes keep
+  # XLA's scatter (smoke covers the fallback's correctness).
   use_pallas = (prefer_pallas if forced == "auto" else forced == "1") \
-      and _use_pallas_apply() and buf.dtype == jnp.float32
+      and _use_pallas_apply() and buf.dtype == jnp.float32 \
+      and buf.shape[1] == LANES
   if use_pallas:
     from .pallas_apply import apply_rows_cached
     return apply_rows_cached(buf, flat_grp, flat_upd, scale=delta_scale)

@@ -82,22 +82,45 @@ def main():
     FAILED.append("scale")
 
   # narrow-class dispatch: lane-expanded sub-row deltas through the same
-  # kernel at physical-row granularity (scatter_add_fused with rpp > 1)
+  # kernel at physical-row granularity (scatter_add_fused with rpp > 1).
+  # The (128, 1) case is the 256-lane physical layout Mosaic cannot
+  # serve (1-row dynamic slices of multi-tile rows); scatter_add_fused
+  # must route it to XLA — the case asserts the fallback's correctness
+  # under forced-kernel env (the gate must win over the force).
   from distributed_embeddings_tpu.ops.packed_table import (
       PackedLayout, scatter_add_fused)
-  for width, n_aux in ((16, 1), (8, 1), (32, 1), (16, 0)):
+  for width, n_aux in ((16, 1), (8, 1), (32, 1), (16, 0), (128, 1)):
     layout = PackedLayout(rows=4096, width=width, n_aux=n_aux)
     nids = 2048
     ids_n = jnp.asarray(rng.integers(-2, layout.rows + 2, nids), jnp.int32)
     delta_n = jnp.asarray(rng.standard_normal((nids, layout.stride)),
                           jnp.float32)
     base_n = jnp.asarray(rng.standard_normal(layout.shape), jnp.float32)
+    # independent numpy reference built straight from the layout (for
+    # the 256-lane (128,1) case the kernel gate sends BOTH env settings
+    # to the XLA fallback, so an XLA-vs-XLA comparison would be vacuous)
+    rpp = layout.rows_per_phys
+    want_np = np.asarray(base_n).copy()
+    ids_host = np.asarray(ids_n)
+    delta_host = np.asarray(delta_n)  # ONE device fetch (per-row fetches
+    # would pay the tunnel's ~100 ms RTT 2048 times)
+    for i, lid in enumerate(ids_host):
+      if 0 <= lid < layout.rows:
+        grp, sub = divmod(int(lid), rpp)
+        lo = sub * layout.stride
+        want_np[grp, lo:lo + layout.stride] += delta_host[i]
+    want = jnp.asarray(want_np)
     import os
     saved = os.environ.get("DE_TPU_PALLAS_APPLY")
-    os.environ["DE_TPU_PALLAS_APPLY"] = "0"   # force XLA for the reference
-    want = scatter_add_fused(layout, base_n + 0, ids_n, delta_n)
-    os.environ["DE_TPU_PALLAS_APPLY"] = "1"   # force the kernel
+    os.environ["DE_TPU_PALLAS_APPLY"] = "0"   # the XLA path
+    got_xla = scatter_add_fused(layout, base_n + 0, ids_n, delta_n)
+    os.environ["DE_TPU_PALLAS_APPLY"] = "1"   # the kernel (gated wide)
     got = scatter_add_fused(layout, base_n + 0, ids_n, delta_n)
+    err_xla = float(jnp.max(jnp.abs(got_xla - want)))
+    if err_xla > 1e-4:
+      print(f"{'XLA fallback w%d aux%d' % (width, n_aux):34s}: FAIL "
+            f"(max err {err_xla:.2e})")
+      FAILED.append(f"xla w{width}")
     if saved is None:
       del os.environ["DE_TPU_PALLAS_APPLY"]
     else:
