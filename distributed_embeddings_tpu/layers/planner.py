@@ -313,17 +313,23 @@ class DistEmbeddingStrategy:
     self.dense_row_threshold = dense_row_threshold
     self.global_configs = _normalize_configs(embeddings)
     for t, c in enumerate(self.global_configs):
-      # Routing tensors carry GLOBAL ids as int32 (the all_to_all /
-      # gather dtype every measured path uses; the reference registers an
-      # int64 variant, `embedding_lookup_ops.cc:24-88`). A table whose id
-      # space exceeds int32 cannot be represented — fail at plan time
-      # rather than silently folding ids at the engine's cast.
-      if c.input_dim > 2 ** 31 - 1:
+      # Routing tensors carry LOCALIZED ids as int32 on the wire; GLOBAL
+      # ids for a >int32 table arrive as int64 (the engine keeps int64
+      # inputs wide, `lookup_engine._normalize_input`; the reference
+      # registers the same two widths, `embedding_lookup_ops.cc:24-88`)
+      # and the row-slice window subtraction narrows them. That only
+      # works when every SHARD's window fits int32 — i.e. the table is
+      # row-sliced — so an unsliceable >int32 table still fails at plan
+      # time rather than folding ids at the engine's cast. (The per-rank
+      # 2^31 buffer-element bound in fused_layouts/_buffer_limit already
+      # forces such tables into row slices far below int32 rows.)
+      if c.input_dim > 2 ** 31 - 1 and not row_slice_threshold:
         raise ValueError(
             f"table {t} has input_dim={c.input_dim:,} > int32 max "
-            f"({2 ** 31 - 1:,}): ids are routed as int32 and cannot "
-            "address this table. Split the id space across several "
-            "tables (an input_table_map entry per split, with a "
+            f"({2 ** 31 - 1:,}): global ids need the int64 routing path, "
+            "which localizes them through row-slice windows. Enable row "
+            "slicing (row_slice_threshold), split the id space across "
+            "several tables (an input_table_map entry per split, with a "
             "host-side id fold), or reduce the vocabulary.")
     num_tables = len(self.global_configs)
     if input_table_map is None:
@@ -388,6 +394,23 @@ class DistEmbeddingStrategy:
         if len(self.table_col_ranges[t]) == 1 else [(0, c.input_dim)]
         for t, c in enumerate(self.global_configs)
     ]
+    # int64 routing backstop (completes the __init__ guard, which only
+    # proves row slicing was REQUESTED): every >int32 table must have
+    # actually sliced into int32-sized windows — column slicing or a
+    # too-coarse row threshold can leave a single full-vocab range, and
+    # the engine's post-localization int32 narrowing would then wrap.
+    for t, c in enumerate(self.global_configs):
+      if c.input_dim <= 2 ** 31 - 1:
+        continue
+      windows = self.table_row_ranges[t]
+      worst = max(r1 - r0 for (r0, r1) in windows)
+      if worst > 2 ** 31 - 1:
+        raise ValueError(
+            f"table {t} (input_dim={c.input_dim:,}) did not row-slice "
+            f"into int32-sized windows (largest window {worst:,} rows): "
+            "the int64 routing path localizes ids through row-slice "
+            "windows. Lower row_slice_threshold (and note column "
+            "slicing disables row slicing for a table).")
 
     # ---- placement -------------------------------------------------------
     # one placement unit per (table, column range or row range)

@@ -212,13 +212,21 @@ def ragged_hotness(x) -> int:
 
 
 def _normalize_input(x):
-  """-> [B, H] int32 with PAD_ID for invalid entries, or RaggedIds as-is.
+  """-> [B, H] int32/int64 with PAD_ID for invalid entries, or RaggedIds.
 
   Ragged inputs flow through the engine as their VALUE STREAM (static
   capacity = ``values.shape[0]``) plus per-sample lengths — the TPU
   equivalent of the reference's uneven-split alltoall for true variable
   hotness (`dist_model_parallel.py:407-429`): comm and gather volume scale
-  with the actual number of ids, not ``B x max_hotness``."""
+  with the actual number of ids, not ``B x max_hotness``.
+
+  int64 inputs stay int64 (the reference registers ``Tindices`` for both
+  widths, `embedding_lookup_ops.cc:24-88`): a >2B-row table's GLOBAL ids
+  only fit int64. The routing arithmetic localizes them (clip +
+  ``row_start`` subtraction for row slices), after which every value is
+  a per-rank slot-local id — bounded by the per-rank buffer's 2^31
+  element limit — and ``_build_routing`` narrows the routed tensor to
+  int32 for the wire."""
   if isinstance(x, RaggedIds):
     return x
   x = jnp.asarray(x)
@@ -226,7 +234,25 @@ def _normalize_input(x):
     x = x[:, None]
   if x.ndim != 2:
     raise ValueError(f"Distributed inputs must be 1-D or 2-D, got {x.ndim}-D")
-  return x.astype(jnp.int32)
+  return x.astype(jnp.int64 if x.dtype == jnp.int64 else jnp.int32)
+
+
+def _require_wide_ids(plan, shard, ids):
+  """Refuse int32 ids addressing a >int32 table (silent-fold guard).
+
+  Without x64, ``jnp.asarray`` canonicalizes int64 inputs to int32 with
+  wraparound BEFORE ``_normalize_input`` can see the wide dtype, so the
+  only safe policy is: a table whose id space exceeds int32 must receive
+  int64 ids, which requires ``jax.enable_x64``. Raising here (trace
+  time) turns the silent wrong-rows failure into an actionable error."""
+  vocab = plan.global_configs[shard.table_id].input_dim
+  if vocab > 2 ** 31 - 1 and ids.dtype != jnp.int64:
+    raise ValueError(
+        f"table {shard.table_id} has input_dim={vocab:,} > int32 max but "
+        f"its ids arrived as {ids.dtype} — ids above 2^31 would have "
+        "wrapped already (JAX canonicalizes int64 to int32 when x64 is "
+        "disabled). Enable x64 (jax.enable_x64() / jax_enable_x64) and "
+        "pass int64 ids for this table.")
 
 
 def _seg_ids(lengths: jax.Array, capacity: int) -> jax.Array:
@@ -438,13 +464,17 @@ class DistributedLookup:
           if bucket.h == 1:
             ids = ids[:, 0]
           sh = slot.shard
+          _require_wide_ids(self.plan, sh, ids)
           if sh.row_sliced:
             # row shard: serve only ids inside this shard's vocab window
             # [row_start, row_start + rows); other shards' rows and PAD go
             # to the sentinel and contribute zeros to the partial sum.
             # Out-of-vocab ids clamp to the last table row FIRST so
             # enabling row_slice (a sharding knob) cannot change numerics
-            # vs the unsliced clamp policy.
+            # vs the unsliced clamp policy. Arithmetic runs in the input
+            # dtype (int64 for >2B-row tables); the result is slot-local
+            # (< the per-rank buffer's 2^31 bound), so it narrows to
+            # int32 for the routing tensor.
             vocab = self.plan.global_configs[sh.table_id].input_dim
             clamped = jnp.clip(ids, 0, vocab - 1)
             in_win = (ids >= 0) & (clamped >= sh.row_start) & (
@@ -455,7 +485,7 @@ class DistributedLookup:
             routed = jnp.where(ids < 0, sentinel,
                                jnp.clip(ids, 0, sh.input_dim - 1)
                                + slot.row_offset)
-          per_slot.append(routed)
+          per_slot.append(routed.astype(jnp.int32))
         else:
           per_slot.append(pad_block)
       per_dest.append(jnp.stack(per_slot))
@@ -486,10 +516,12 @@ class DistributedLookup:
         if k < len(idxs):
           slot = cp.slots_per_rank[rank][idxs[k]]
           rg: RaggedIds = inputs[slot.input_id]
-          v = rg.values.astype(jnp.int32)
+          v = rg.values.astype(
+              jnp.int64 if rg.values.dtype == jnp.int64 else jnp.int32)
           total = rg.row_splits[-1].astype(jnp.int32)
           live = jnp.arange(cap, dtype=jnp.int32) < total
           sh = slot.shard
+          _require_wide_ids(self.plan, sh, v)
           if sh.row_sliced:
             # row shard: serve only values inside this shard's vocab
             # window (same clamp-first policy as the padded routing so
@@ -506,7 +538,10 @@ class DistributedLookup:
             routed = jnp.where(
                 live & (v >= 0),
                 jnp.clip(v, 0, sh.input_dim - 1) + slot.row_offset, sentinel)
-          vals_r.append(routed)
+          # localized values fit the per-rank buffer's 2^31 bound: narrow
+          # int64 streams to the int32 wire format (same as the padded
+          # routing)
+          vals_r.append(routed.astype(jnp.int32))
           lens_r.append(rg.row_lengths().astype(jnp.int32))
         else:
           vals_r.append(pad_vals)
@@ -1347,7 +1382,8 @@ def pack_mp_inputs(plan: DistEmbeddingStrategy,
             x = slot_inputs[(key, rank, idxs[k])]
             rows = slot.shard.input_dim
             routed = jnp.where(x < 0, sentinel,
-                               jnp.clip(x, 0, rows - 1) + slot.row_offset)
+                               jnp.clip(x, 0, rows - 1) + slot.row_offset
+                               ).astype(jnp.int32)  # int32 wire format
           else:
             routed = jnp.full((g, bucket.h), sentinel, jnp.int32)
           entries.append(routed)

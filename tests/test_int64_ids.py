@@ -1,0 +1,80 @@
+"""int64 id routing for >int32 tables (reference registers Tindices in
+{int32, int64}, `embedding_lookup_ops.cc:24-88`).
+
+Global ids above 2^31 only exist for row-sliced tables: the engine keeps
+int64 inputs wide through the routing arithmetic and narrows to int32
+after the row-slice window subtraction localizes them
+(`lookup_engine._normalize_input` / `_build_routing`). The planner
+rejects >int32 tables unless row slicing is enabled.
+
+Needs x64 (int64 arrays do not exist otherwise); scoped via the
+jax.enable_x64 context so the rest of the suite keeps default dtypes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_embeddings_tpu.layers import DistEmbeddingStrategy, TableConfig
+from distributed_embeddings_tpu.parallel.lookup_engine import (
+    DistributedLookup,
+    _normalize_input,
+    padded_rows,
+)
+
+BIG = 2_200_000_000  # > 2^31 - 1
+
+
+def test_planner_rejects_big_table_without_row_slice():
+  with pytest.raises(ValueError, match="int64 routing path"):
+    DistEmbeddingStrategy([TableConfig(BIG, 8)], 16, "basic")
+
+
+def test_planner_accepts_big_table_with_row_slice():
+  plan = DistEmbeddingStrategy([TableConfig(BIG, 8)], 16, "basic",
+                               row_slice_threshold=1)
+  shards = [sh for rank in plan.rank_shards for sh in rank]
+  assert all(sh.row_sliced for sh in shards)
+  assert sum(sh.input_dim for sh in shards) >= BIG
+  # every shard's LOCAL id window must fit int32
+  for sh in shards:
+    assert sh.input_dim <= 2 ** 31 - 1
+
+
+def test_int64_routing_localizes_to_int32():
+  plan = DistEmbeddingStrategy([TableConfig(BIG, 8)], 16, "basic",
+                               row_slice_threshold=1)
+  engine = DistributedLookup(plan)
+  key = plan.class_keys[0]
+  (bucket,) = engine._buckets(key, lambda i: 1)
+  sentinel = padded_rows(plan, key)
+
+  with jax.enable_x64(True):
+    ids = jnp.asarray(
+        np.array([0, 7, BIG - 1, 2_000_000_123, -1], np.int64))
+    assert _normalize_input(ids).dtype == jnp.int64
+    routed = engine._build_routing(key, bucket, [ids[:, None]])
+    assert routed.dtype == jnp.int32
+
+  routed = np.asarray(routed)  # [world, n_b, B]
+  world = plan.world_size
+
+  # reconstruct each id's serving shard and check the local id round-trips
+  for col, gid in enumerate([0, 7, BIG - 1, 2_000_000_123]):
+    hits = []
+    for rank in range(world):
+      idxs = bucket.slot_idx_per_rank[rank]
+      for k, idx in enumerate(idxs):
+        slot = plan.classes[key].slots_per_rank[rank][idx]
+        local = routed[rank, k, col]
+        if local != sentinel:
+          sh = slot.shard
+          hits.append(int(local) - slot.row_offset + sh.row_start)
+    # exactly one shard serves the id, and the global id reconstructs
+    assert hits == [gid], (gid, hits)
+
+  # PAD (-1) routes to the sentinel everywhere
+  for rank in range(world):
+    for k in range(len(bucket.slot_idx_per_rank[rank])):
+      assert routed[rank, k, 4] == sentinel

@@ -211,14 +211,19 @@ def test_class_generation_split_caps_buffer_bytes():
   assert total == sum(sizes)
 
 
-def test_planner_rejects_table_over_int32_id_space():
-  """Ids route as int32; a table whose id space exceeds int32 must fail
-  loudly at plan time (reference registers an int64 op variant instead,
-  `embedding_lookup_ops.cc:24-88`). 2^31 - 1 rows still plans (colossal's
-  2B-row table clears by 7%)."""
-  with pytest.raises(ValueError, match="int32"):
-    DistEmbeddingStrategy([TableConfig((1 << 31), 8)], 128, "basic",
-                          row_slice_threshold=1 << 24)
+def test_planner_int32_id_space_contract():
+  """A table whose id space exceeds int32 needs the int64 routing path,
+  which localizes global ids through row-slice windows (round 4;
+  reference registers an int64 op variant, `embedding_lookup_ops.cc:
+  24-88`): without row slicing it must fail loudly at plan time, with it
+  the plan must come out row-sliced into int32-sized windows. 2^31 - 1
+  rows plans either way (colossal's 2B-row table clears by 7%)."""
+  with pytest.raises(ValueError, match="int64 routing path"):
+    DistEmbeddingStrategy([TableConfig((1 << 31), 8)], 128, "basic")
+  plan = DistEmbeddingStrategy([TableConfig((1 << 31), 8)], 128, "basic",
+                               row_slice_threshold=1 << 24)
+  for (r0, r1) in plan.table_row_ranges[0]:
+    assert r1 - r0 <= 2 ** 31 - 1
   plan = DistEmbeddingStrategy([TableConfig((1 << 31) - 1, 8)], 128,
                                "basic", row_slice_threshold=1 << 24)
   assert plan.world_size == 128

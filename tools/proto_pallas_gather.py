@@ -12,8 +12,11 @@ sub-row handling).
 Compares against jnp.take on the same id stream (uniform and the Tiny
 power-law mix).
 
-Measured (round 4, v5e, 1M ids / 1M rows, zipf-1.2 stream): XLA take
-11.7 ns/row, this kernel 11.3 ns/row, bit-exact parity — the scalar
+Measured (round 4, v5e, 1M ids / 1M rows, zipf-1.2 stream; chained
+dependency harness): XLA take 11.9 ns/row, this kernel 13.8 ns/row,
+bit-exact parity (an earlier same-args harness read 11.7 vs 11.3; the
+uniform stream's chained timings are unstable through the relay and are
+not cited) — the scalar
 core sustains ~one row DMA per 11 ns, the same rate XLA's gather
 already streams at, so a DMA-per-row Pallas gather (however batched)
 cannot deliver the 2-3x the zoo's gather share would need. The A100
@@ -102,7 +105,10 @@ def timeit(name, fn, buf, ids):
   # chain: each call's ids depend on the previous output so no caching /
   # reordering layer can collapse repeated executions
   step = jax.jit(lambda b, i, bump: fn(b, (i + bump) % b.shape[0]))
-  out = step(buf, ids, 0)
+  # warm with the SAME operand type the timed loop passes (a weak-typed
+  # Python int would compile a different cache entry and the recompile
+  # would land inside the first timed run)
+  out = step(buf, ids, jnp.zeros((), ids.dtype))
   jax.block_until_ready(out)
 
   def run(k, o):
