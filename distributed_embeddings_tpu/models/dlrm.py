@@ -67,8 +67,8 @@ def _tril_select_np(f: int, k: int):
   return m, p
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def _tril_products(feats: jax.Array, k: int) -> jax.Array:
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _tril_products(flat: jax.Array, f: int, k: int) -> jax.Array:
   """[B, F, D] -> [B, P] lower-triangle pairwise dot products.
 
   Both directions are pure matmuls (no gathers, no index maps): forward is
@@ -78,12 +78,18 @@ def _tril_products(feats: jax.Array, k: int) -> jax.Array:
   ``d_feats = (G + G^T) @ feats`` as ONE product einsum scaled by 2, where
   XLA's autodiff would run two. Equivalent of the reference's
   ``boolean_mask`` interaction (`examples/dlrm/utils.py:92-113`)."""
-  out, _ = _tril_fwd(feats, k)
+  out, _ = _tril_fwd(flat, f, k)
   return out
 
 
-def _tril_fwd(feats, k):
-  b, f, d = feats.shape
+def _tril_fwd(flat, f, k):
+  # the [B, F*D] -> [B, F, D] reshape lives INSIDE the custom-vjp
+  # boundary: placed outside, XLA's layout assignment round-trips the
+  # concat through a {0,1} layout and back (~2.7 ms/step of copies at
+  # F=27, B=64k, traced round 4)
+  b = flat.shape[0]
+  d = flat.shape[1] // f
+  feats = flat.reshape(b, f, d)
   m_np, p = _tril_select_np(f, k)
   cd = _mxu_operand_dtype(feats.dtype)
   m = jnp.asarray(m_np, cd)
@@ -94,8 +100,8 @@ def _tril_fwd(feats, k):
   return acts, feats
 
 
-def _tril_bwd(k, feats, d_acts):
-  b, f, d = feats.shape
+def _tril_bwd(f, k, feats, d_acts):
+  b, _, d = feats.shape
   m_np, p = _tril_select_np(f, k)
   # under bf16 compute (AMP) the cotangent is rounded to bf16 before the
   # grad einsums — the AMP convention (the reference's fp16 backward does
@@ -110,7 +116,7 @@ def _tril_bwd(k, feats, d_acts):
   d_feats = 2.0 * jnp.einsum("bpq,bqd->bpd", d_sym.astype(cd),
                              feats.astype(cd),
                              preferred_element_type=jnp.float32)
-  return (d_feats.astype(feats.dtype),)
+  return (d_feats.astype(feats.dtype).reshape(b, f * d),)
 
 
 _tril_products.defvjp(_tril_fwd, _tril_bwd)
@@ -150,10 +156,9 @@ def dot_interact(bottom_out: jax.Array, emb_outs: Sequence[jax.Array],
   # divergence is a single bf16 rounding of each cotangent value, within
   # the precision class the TF32 reference computes its backward in.
   cd = _mxu_operand_dtype(parts[0].dtype)
-  feats = jnp.concatenate(
-      [p.astype(cd) for p in parts], axis=1).reshape(b, len(parts), d)
+  flat = jnp.concatenate([p.astype(cd) for p in parts], axis=1)
   k = 0 if self_interaction else -1
-  activations = _tril_products(feats, k)
+  activations = _tril_products(flat, len(parts), k)
   return jnp.concatenate([activations, bottom_out.astype(activations.dtype)],
                          axis=1)
 

@@ -39,12 +39,15 @@ CRITEO_1TB_VOCAB = [
 
 BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
 SCALE = float(sys.argv[2]) if len(sys.argv) > 2 else 0.0625
+AMP = (os.environ.get("BENCH_AMP", "0") == "1"
+       or os.environ.get("AMP", "0") == "1")
 
 
 def main():
   vocab = [max(4, int(v * SCALE)) for v in CRITEO_1TB_VOCAB]
   model = DLRM(vocab_sizes=vocab, embedding_dim=128, world_size=1,
-               dense_row_threshold=4096)
+               dense_row_threshold=4096,
+               compute_dtype=jnp.bfloat16 if AMP else jnp.float32)
   plan = DistEmbeddingStrategy(
       [dict(input_dim=v, output_dim=128, combiner=None) for v in vocab],
       1, "basic", dense_row_threshold=4096, batch_hint=BATCH)
