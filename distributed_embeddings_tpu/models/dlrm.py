@@ -69,7 +69,10 @@ def _tril_select_np(f: int, k: int):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
 def _tril_products(flat: jax.Array, f: int, k: int) -> jax.Array:
-  """[B, F, D] -> [B, P] lower-triangle pairwise dot products.
+  """Flat ``[B, F*D]`` features -> [B, P] lower-triangle pairwise dots.
+
+  Takes the lane-concatenated flat array (reshaped to [B, F, D]
+  internally — see _tril_fwd's layout note) with ``f`` static.
 
   Both directions are pure matmuls (no gathers, no index maps): forward is
   the pairwise product einsum followed by the ``M``-selection einsum; the
