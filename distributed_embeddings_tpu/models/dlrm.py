@@ -116,7 +116,7 @@ def _tril_bwd(f, k, feats, d_acts):
                      preferred_element_type=jnp.float32)
   # d(F F^T) needs (G + G^T) @ F; d_sym = (G + G^T)/2 is symmetric by
   # construction (M weights both mirrored cells), so one einsum x2 does it
-  d_feats = 2.0 * jnp.einsum("bpq,bqd->bpd", d_sym.astype(cd),
+  d_feats = 2.0 * jnp.einsum("bqp,bqd->bpd", d_sym.astype(cd),
                              feats.astype(cd),
                              preferred_element_type=jnp.float32)
   return (d_feats.astype(feats.dtype).reshape(b, f * d),)
