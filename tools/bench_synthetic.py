@@ -30,10 +30,10 @@ from distributed_embeddings_tpu.training import (
 )
 
 A100_1X_MS = {"tiny": 24.433, "small": 67.355}  # reference README:71-72
-# medium never fits one GPU; the reference's smallest config is 8xA100 at
-# 63.393 ms (README:73) => one A100's share is 65536/0.063393/8 samples/s,
-# i.e. an equivalent per-chip step time of 8 * 63.393 ms
-A100_PER_CHIP_EQ_MS = {"medium": 8 * 63.393}
+# medium/large never fit one GPU; the reference's smallest configs are
+# 8xA100 at 63.393 ms (README:73) and 32xA100 at 67.57 ms (README:74) =>
+# one A100's share is an equivalent per-chip step time of N * t_N
+A100_PER_CHIP_EQ_MS = {"medium": 8 * 63.393, "large": 32 * 67.57}
 
 MODEL = sys.argv[1] if len(sys.argv) > 1 else "tiny"
 BATCH = int(sys.argv[2]) if len(sys.argv) > 2 else 65536
