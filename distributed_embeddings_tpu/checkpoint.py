@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Any, Dict, Optional
 
 import jax
@@ -274,7 +275,6 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
   # successful save must not be declared incomplete for it. All processes
   # check (not just p0) so that when one process failed, every survivor
   # raises instead of hanging at the final barrier.
-  import time
   deadline = time.monotonic() + 30.0
   while True:
     done = [p for p in range(n_proc)

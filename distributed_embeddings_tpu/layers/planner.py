@@ -276,6 +276,27 @@ def apply_placement(mode: str, world_size: int,
   raise ValueError(f"Unsupported strategy {mode}")
 
 
+
+def _rows_hard_noaux(width: int) -> int:
+  """Max shard rows that fit one 2^31-element TPU buffer with NO packed
+  aux state (the plan-time hard bound; the exact per-rule check lives in
+  DistributedLookup.fused_layouts)."""
+  stride = width
+  rpp = max(1, 128 // stride)
+  pw = max(128, -(-stride // 128) * 128)
+  return max(1, int((2 ** 31) // (pw / rpp)))
+
+
+def _raise_shard_too_big(table_id: int, rows: int, width: int) -> None:
+  raise ValueError(
+      f"table {table_id}'s shard of {rows:,} rows x width {width} "
+      f"exceeds one TPU buffer (2^31 elements ~= "
+      f"{_rows_hard_noaux(width):,} rows at this width) and a generation "
+      "cannot split a single shard. Shard it finer: more workers, a "
+      "smaller row_slice threshold (slices are capped at "
+      "min(2^k, world)), or column slicing (column_slice_threshold).")
+
+
 class DistEmbeddingStrategy:
   """Global-view embedding placement plan (deterministic, collective-free).
 
@@ -526,16 +547,8 @@ class DistEmbeddingStrategy:
           # same plan-time hard error as the auto mode (a generation
           # cannot split a shard, and one shard past the 2^31-element
           # buffer limit is untrainable regardless of assignment)
-          pw0 = max(128, -(-sh.width // 128) * 128)
-          rows_hard = max(1, int((2 ** 31)
-                                 // (pw0 / max(1, 128 // sh.width))))
-          if sh.input_dim > rows_hard:
-            raise ValueError(
-                f"table {sh.table_id}'s shard of {sh.input_dim:,} rows x "
-                f"width {sh.width} exceeds one TPU buffer (2^31 elements "
-                f"~= {rows_hard:,} rows at this width). Shard it finer: "
-                "more workers, a smaller row_slice threshold, or column "
-                "slicing.")
+          if sh.input_dim > _rows_hard_noaux(sh.width):
+            _raise_shard_too_big(sh.table_id, sh.input_dim, sh.width)
           rows_list = gen_rows.setdefault(base, [0])
           cap_rows = max(1, max_class_bytes // (sh.width * 4))
           for g, r in enumerate(rows_list):
@@ -687,19 +700,9 @@ class DistEmbeddingStrategy:
     # The plan doesn't know the optimizer yet, so the hard error uses the
     # aux-free bound (illegal for ANY rule); the 1-aux estimate only warns.
     # The exact check (actual n_aux) lives in DistributedLookup.fused_layouts.
-    stride0 = width
-    rpp0 = max(1, 128 // stride0)
-    pw0 = max(128, -(-stride0 // 128) * 128)
-    rows_hard_noaux = max(1, int((2 ** 31) // (pw0 / rpp0)))
-    if largest > rows_hard_noaux:
+    if largest > _rows_hard_noaux(width):
       big = max(group, key=lambda sh: sh.input_dim)
-      raise ValueError(
-          f"table {big.table_id}'s shard of {big.input_dim:,} rows x "
-          f"width {width} exceeds one TPU buffer (2^31 elements ~= "
-          f"{rows_hard_noaux:,} rows at this width) and a generation "
-          "cannot split a single shard. Shard it finer: more workers, a "
-          "smaller row_slice threshold (slices are capped at "
-          "min(2^k, world)), or column slicing (column_slice_threshold).")
+      _raise_shard_too_big(big.table_id, big.input_dim, width)
     if largest > rows_hard:
       import warnings
       big = max(group, key=lambda sh: sh.input_dim)

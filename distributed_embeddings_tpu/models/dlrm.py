@@ -105,7 +105,7 @@ def _tril_fwd(flat, f, k):
 
 def _tril_bwd(f, k, feats, d_acts):
   b, _, d = feats.shape
-  m_np, p = _tril_select_np(f, k)
+  m_np, _ = _tril_select_np(f, k)
   # under bf16 compute (AMP) the cotangent is rounded to bf16 before the
   # grad einsums — the AMP convention (the reference's fp16 backward does
   # the same); on-TPU f32 parity with autodiff holds because DEFAULT MXU
