@@ -133,8 +133,10 @@ def smoke():
 
   sys.path.insert(0, os.path.join(os.path.dirname(__file__), "tools"))
   import smoke_pallas_apply
+  import smoke_pallas_interact
   with contextlib.redirect_stdout(sys.stderr):
     smoke_pallas_apply.main()  # sys.exit(1) inside on any failure
+    smoke_pallas_interact.main()
 
 
 def main():

@@ -1,0 +1,95 @@
+"""Parity tests for the fused Pallas interaction kernels (round 5).
+
+The kernels only run on real TPU hardware (`use_pallas_interact` gates on
+backend); here they execute in Pallas interpret mode — valid for these
+kernels because they have no input/output aliasing or RMW (unlike
+`pallas_apply`, whose simulator exists for that reason) — and are checked
+against the XLA matmul-form `_tril_products`, which in turn is covered by
+`test_models.py` against the reference semantics
+(`/root/reference/examples/dlrm/utils.py:92-113`).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_embeddings_tpu.models.dlrm import _tril_select_np
+from distributed_embeddings_tpu.ops.pallas_interact import (
+    BWD_BLOCK,
+    FWD_BLOCK,
+    interact_parts_bwd,
+    interact_parts_fwd,
+    use_pallas_interact,
+)
+
+F, D = 9, 128
+B = 2 * FWD_BLOCK
+
+
+def _xla_reference(flat, f, k):
+  """Explicit XLA einsum form (NOT `_tril_products`, which dispatches to
+  the flat-input Pallas kernel on a TPU backend — the reference must
+  never share the code under test)."""
+  b = flat.shape[0]
+  d = flat.shape[1] // f
+  feats = flat.reshape(b, f, d)
+  m_np, _ = _tril_select_np(f, k)
+  m = jnp.asarray(m_np, jnp.bfloat16)
+  inter = jnp.einsum("bpd,bqd->bpq", feats, feats,
+                     preferred_element_type=jnp.float32)
+  return jnp.einsum("bpq,pqn->bn", inter.astype(jnp.bfloat16), m,
+                    preferred_element_type=jnp.float32)
+
+
+def _mk_parts(seed, f=F, b=B):
+  rng = np.random.default_rng(seed)
+  return [jnp.asarray(rng.standard_normal((b, D)) * 0.3, jnp.bfloat16)
+          for _ in range(f)]
+
+
+@pytest.mark.parametrize("k", [-1, 0])
+def test_parts_fwd_matches_xla_form(k):
+  parts = _mk_parts(0)
+  m_np, _ = _tril_select_np(F, k)
+  got = interact_parts_fwd(parts, jnp.asarray(m_np, jnp.bfloat16),
+                           interpret=True)
+  flat = jnp.concatenate(parts, axis=1)
+  want = _xla_reference(flat, F, k)
+  np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                             rtol=2e-2, atol=2e-2)
+
+
+def test_parts_bwd_matches_xla_vjp():
+  k = -1
+  parts = _mk_parts(1)
+  m_np, _ = _tril_select_np(F, k)
+  m3t = jnp.asarray(np.swapaxes(m_np, 1, 2), jnp.bfloat16)
+
+  flat = jnp.concatenate(parts, axis=1)
+  acts, vjp = jax.vjp(lambda x: _xla_reference(x, F, k), flat)
+  rng = np.random.default_rng(2)
+  d_acts = jnp.asarray(rng.standard_normal(acts.shape), jnp.float32)
+  (want_flat,) = vjp(d_acts)
+
+  got = interact_parts_bwd(d_acts, parts, m3t, interpret=True)
+  assert len(got) == F
+  for p in range(F):
+    w = np.asarray(want_flat[:, p * D:(p + 1) * D], np.float32)
+    g = np.asarray(got[p], np.float32)
+    scale = max(np.abs(w).max(), 1e-3)
+    np.testing.assert_allclose(g, w, rtol=0, atol=4e-2 * scale,
+                               err_msg=f"part {p}")
+
+
+def test_gate_logic():
+  bf, f32 = jnp.bfloat16, jnp.float32
+  if jax.default_backend() != "tpu":
+    # non-TPU backends: always off, even for kernel-legal shapes
+    assert not use_pallas_interact(FWD_BLOCK * 4, 27, 128, bf)
+  # dtype/shape guards are backend-independent
+  assert not use_pallas_interact(FWD_BLOCK * 4, 27, 128, f32)
+  assert not use_pallas_interact(FWD_BLOCK * 4, 64, 128, bf)  # f too wide
+  assert not use_pallas_interact(FWD_BLOCK * 4, 27, 64, bf)  # d not lane-mult
+  assert not use_pallas_interact(FWD_BLOCK + 1, 27, 128, bf)  # ragged batch
+  assert B % FWD_BLOCK == 0 and B % BWD_BLOCK == 0

@@ -1,0 +1,106 @@
+"""Quick Mosaic capability probes for the interaction-kernel design.
+
+Each probe compiles a tiny kernel and reports OK / the failure class.
+Usage: python tools/proto_mosaic_probes.py
+"""
+
+import sys
+import traceback
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+S, F, D, N = 256, 27, 128, 351
+
+
+def probe(name, fn):
+  try:
+    out = jax.jit(fn)()
+    jax.block_until_ready(out)
+    print(f"{name:58s}: OK", flush=True)
+    return True
+  except Exception as e:  # noqa: BLE001
+    msg = str(e).split("\n")
+    key = next((ln for ln in msg if "unsupported" in ln.lower()
+                or "not implemented" in ln.lower() or "error" in ln.lower()),
+               msg[0])
+    print(f"{name:58s}: FAIL  {key[:90]}", flush=True)
+    return False
+
+
+def main():
+  f16 = jnp.ones((S, F, D), jnp.bfloat16)
+  da = jnp.ones((S, N), jnp.float32)
+  m3t = jnp.ones((F, N, F), jnp.bfloat16)
+
+  # 1. leading-dim read of a 3D ref -> 2D
+  def k1(m_ref, o_ref):
+    o_ref[...] = jnp.dot(m_ref[0], m_ref[1].T,
+                         preferred_element_type=jnp.float32)
+  probe("read m_ref[p] (3D ref -> 2D)", lambda: pl.pallas_call(
+      k1, out_shape=jax.ShapeDtypeStruct((N, N), jnp.float32))(m3t))
+
+  # 2. leading-dim write of 2D into 3D ref
+  def k2(da_ref, m_ref, o_ref):
+    for p in range(2):
+      o_ref[p] = jnp.dot(da_ref[...].astype(jnp.bfloat16), m_ref[p],
+                         preferred_element_type=jnp.float32)
+  probe("write o_ref[p] = 2D (3D out ref)", lambda: pl.pallas_call(
+      k2, out_shape=jax.ShapeDtypeStruct((2, S, F), jnp.float32))(da, m3t))
+
+  # 3. batched dot, batch dim NOT leading on lhs: [F?,S,F] x [S,F,D]
+  def k3(ds_ref, f_ref, o_ref):
+    o_ref[...] = jax.lax.dot_general(
+        ds_ref[...], f_ref[...], (((2,), (1,)), ((1,), (0,))),
+        preferred_element_type=jnp.float32)
+  probe("dot_general batch mid-dim lhs [F,S,F]x[S,F,D]", lambda: pl.pallas_call(
+      k3, out_shape=jax.ShapeDtypeStruct((S, F, D), jnp.float32))(
+          jnp.ones((F, S, F), jnp.bfloat16), f16))
+
+  # 4. in-kernel transpose [F,S,F] -> [S,F,F]
+  def k4(ds_ref, o_ref):
+    o_ref[...] = jnp.transpose(ds_ref[...], (1, 0, 2))
+  probe("transpose (1,0,2) [F,S,F]->[S,F,F]", lambda: pl.pallas_call(
+      k4, out_shape=jax.ShapeDtypeStruct((S, F, F), jnp.bfloat16))(
+          jnp.ones((F, S, F), jnp.bfloat16)))
+
+  # 5. middle-dim 1-slice write via pl.dslice
+  def k5(da_ref, m_ref, o_ref):
+    v = jnp.dot(da_ref[...].astype(jnp.bfloat16), m_ref[0],
+                preferred_element_type=jnp.float32)
+    o_ref[:, pl.dslice(0, 1), :] = v[:, None, :]
+  probe("write o_ref[:, 0:1, :] = [S,1,F]", lambda: pl.pallas_call(
+      k5, out_shape=jax.ShapeDtypeStruct((S, F, F), jnp.float32))(da, m3t))
+
+  # 6. concatenate 3D pieces along axis 0
+  def k6(da_ref, m_ref, o_ref):
+    pieces = [jnp.dot(da_ref[...].astype(jnp.bfloat16), m_ref[p],
+                      preferred_element_type=jnp.float32)[None]
+              for p in range(2)]
+    o_ref[...] = jnp.concatenate(pieces, axis=0)
+  probe("concat([S,F][None] x2, axis=0)", lambda: pl.pallas_call(
+      k6, out_shape=jax.ShapeDtypeStruct((2, S, F), jnp.float32))(da, m3t))
+
+  # 7. batched dot LEADING batch (known-good in variant B, recheck)
+  def k7(ds_ref, f_ref, o_ref):
+    o_ref[...] = jax.lax.dot_general(
+        ds_ref[...], f_ref[...], (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
+  probe("dot_general batch leading [S,F,F]x[S,F,D]", lambda: pl.pallas_call(
+      k7, out_shape=jax.ShapeDtypeStruct((S, F, D), jnp.float32))(
+          jnp.ones((S, F, F), jnp.bfloat16), f16))
+
+  # 8. dot_general 2D x 3D (no batch): [S,N] x [F,N,F] -> [S,F,F]
+  def k8(da_ref, m_ref, o_ref):
+    o_ref[...] = jax.lax.dot_general(
+        da_ref[...].astype(jnp.bfloat16), m_ref[...],
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+  probe("dot_general [S,N]x[F,N,F] -> [S,F,F]", lambda: pl.pallas_call(
+      k8, out_shape=jax.ShapeDtypeStruct((S, F, F), jnp.float32))(da, m3t))
+
+
+if __name__ == "__main__":
+  main()
