@@ -55,8 +55,8 @@ def use_pallas_interact(b: int, f: int, d: int, dtype) -> bool:
     return False
   if dtype != jnp.bfloat16:
     return False  # jax_default_matmul_precision=float32 keeps the XLA form
-  if f > 32 or d % 128 != 0 or f * d > 4096:
-    return False
+  if f < 2 or f > 32 or d % 128 != 0 or f * d > 4096:
+    return False  # f=1 with k=-1 has zero pairs: XLA handles the empty einsum
   if b % FWD_BLOCK != 0 or b % BWD_BLOCK != 0:
     return False
   try:
@@ -65,8 +65,24 @@ def use_pallas_interact(b: int, f: int, d: int, dtype) -> bool:
     return False
 
 
-def _fwd_kernel(f, npair, m_ref, feats_ref, acts_ref):
-  x = feats_ref[...]  # [S, F, D] bf16
+def xla_reference(flat: jax.Array, m_np, f: int) -> jax.Array:
+  """Explicit XLA einsum form of the interaction — the independent
+  reference for the kernels (used by tests/test_pallas_interact.py and
+  tools/smoke_pallas_interact.py). Deliberately NOT `_tril_products`:
+  that entry dispatches to the flat-input kernel on TPU, and a
+  kernel-vs-kernel comparison would hide a shared miscompile."""
+  b = flat.shape[0]
+  d = flat.shape[1] // f
+  feats = flat.reshape(b, f, d)
+  m = jnp.asarray(m_np, jnp.bfloat16)
+  inter = jnp.einsum("bpd,bqd->bpq", feats, feats,
+                     preferred_element_type=jnp.float32)
+  return jnp.einsum("bpq,pqn->bn", inter.astype(jnp.bfloat16), m,
+                    preferred_element_type=jnp.float32)
+
+
+def _acts_of(x, m_ref, f, npair):
+  """Shared fwd body: [S, F, D] feats -> [S, npair] f32 activations."""
   inter = jax.lax.dot_general(
       x, x, (((2,), (2,)), ((0,), (0,))),
       preferred_element_type=jnp.float32)  # [S, F, F] in VMEM only
@@ -75,18 +91,27 @@ def _fwd_kernel(f, npair, m_ref, feats_ref, acts_ref):
   for p in range(f):
     acc = acc + jnp.dot(i16[:, p, :], m_ref[p],
                         preferred_element_type=jnp.float32)
-  acts_ref[...] = acc
+  return acc
+
+
+def _dfeats_of(da, x, mt_ref, dsym_ref, f):
+  """Shared bwd body: cotangent scatter through the f32 dsym scratch, then
+  one batched MXU dot -> [S, F, D] f32 (caller applies the factor 2)."""
+  for p in range(f):
+    row = jnp.dot(da, mt_ref[p], preferred_element_type=jnp.float32)
+    dsym_ref[:, pl.dslice(p, 1), :] = row[:, None, :]
+  return jax.lax.dot_general(
+      dsym_ref[...].astype(jnp.bfloat16), x, (((2,), (1,)), ((0,), (0,))),
+      preferred_element_type=jnp.float32)
+
+
+def _fwd_kernel(f, npair, m_ref, feats_ref, acts_ref):
+  acts_ref[...] = _acts_of(feats_ref[...], m_ref, f, npair)
 
 
 def _bwd_kernel(f, mt_ref, dacts_ref, feats_ref, dfeats_ref, dsym_ref):
   da = dacts_ref[...].astype(jnp.bfloat16)  # [S, npair]
-  for p in range(f):
-    row = jnp.dot(da, mt_ref[p], preferred_element_type=jnp.float32)
-    dsym_ref[:, pl.dslice(p, 1), :] = row[:, None, :]
-  x = feats_ref[...]  # [S, F, D]
-  d = jax.lax.dot_general(
-      dsym_ref[...].astype(jnp.bfloat16), x, (((2,), (1,)), ((0,), (0,))),
-      preferred_element_type=jnp.float32)
+  d = _dfeats_of(da, feats_ref[...], mt_ref, dsym_ref, f)
   dfeats_ref[...] = (2.0 * d).astype(dfeats_ref.dtype)
 
 
@@ -95,15 +120,7 @@ def _parts_fwd_kernel(f, npair, m_ref, *refs):
   acts_ref = refs[-1]
   x = jnp.concatenate(
       [refs[p][...][:, None, :] for p in range(f)], axis=1)  # [S, F, D]
-  inter = jax.lax.dot_general(
-      x, x, (((2,), (2,)), ((0,), (0,))),
-      preferred_element_type=jnp.float32)
-  i16 = inter.astype(jnp.bfloat16)
-  acc = jnp.zeros((x.shape[0], npair), jnp.float32)
-  for p in range(f):
-    acc = acc + jnp.dot(i16[:, p, :], m_ref[p],
-                        preferred_element_type=jnp.float32)
-  acts_ref[...] = acc
+  acts_ref[...] = _acts_of(x, m_ref, f, npair)
 
 
 def _parts_bwd_kernel(f, mt_ref, dacts_ref, *refs):
@@ -112,14 +129,9 @@ def _parts_bwd_kernel(f, mt_ref, dacts_ref, *refs):
   part_refs = refs[:f]
   out_refs = refs[f:2 * f]
   da = dacts_ref[...].astype(jnp.bfloat16)
-  for p in range(f):
-    row = jnp.dot(da, mt_ref[p], preferred_element_type=jnp.float32)
-    dsym_ref[:, pl.dslice(p, 1), :] = row[:, None, :]
   x = jnp.concatenate(
       [part_refs[p][...][:, None, :] for p in range(f)], axis=1)
-  d = jax.lax.dot_general(
-      dsym_ref[...].astype(jnp.bfloat16), x, (((2,), (1,)), ((0,), (0,))),
-      preferred_element_type=jnp.float32)
+  d = _dfeats_of(da, x, mt_ref, dsym_ref, f)
   for p in range(f):
     out_refs[p][...] = (2.0 * d[:, p, :]).astype(out_refs[p].dtype)
 

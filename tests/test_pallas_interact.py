@@ -21,6 +21,7 @@ from distributed_embeddings_tpu.ops.pallas_interact import (
     interact_parts_bwd,
     interact_parts_fwd,
     use_pallas_interact,
+    xla_reference,
 )
 
 F, D = 9, 128
@@ -28,18 +29,8 @@ B = 2 * FWD_BLOCK
 
 
 def _xla_reference(flat, f, k):
-  """Explicit XLA einsum form (NOT `_tril_products`, which dispatches to
-  the flat-input Pallas kernel on a TPU backend — the reference must
-  never share the code under test)."""
-  b = flat.shape[0]
-  d = flat.shape[1] // f
-  feats = flat.reshape(b, f, d)
   m_np, _ = _tril_select_np(f, k)
-  m = jnp.asarray(m_np, jnp.bfloat16)
-  inter = jnp.einsum("bpd,bqd->bpq", feats, feats,
-                     preferred_element_type=jnp.float32)
-  return jnp.einsum("bpq,pqn->bn", inter.astype(jnp.bfloat16), m,
-                    preferred_element_type=jnp.float32)
+  return xla_reference(flat, m_np, f)
 
 
 def _mk_parts(seed, f=F, b=B):

@@ -20,24 +20,15 @@ from distributed_embeddings_tpu.models.dlrm import _tril_select_np
 from distributed_embeddings_tpu.ops.pallas_interact import (
     interact_parts_bwd,
     interact_parts_fwd,
+    xla_reference,
 )
 
 F, D, B = 27, 128, 1024
 
 
 def _xla_reference(flat, f, k):
-  """The explicit XLA matmul form — NOT `_tril_products`, which itself
-  dispatches to the flat-input Pallas kernel on TPU (a kernel-vs-kernel
-  comparison would hide a shared miscompile; caught in round-5 review)."""
-  b = flat.shape[0]
-  d = flat.shape[1] // f
-  feats = flat.reshape(b, f, d)
   m_np, _ = _tril_select_np(f, k)
-  m = jnp.asarray(m_np, jnp.bfloat16)
-  inter = jnp.einsum("bpd,bqd->bpq", feats, feats,
-                     preferred_element_type=jnp.float32)
-  return jnp.einsum("bpq,pqn->bn", inter.astype(jnp.bfloat16), m,
-                    preferred_element_type=jnp.float32)
+  return xla_reference(flat, m_np, f)
 
 
 def main():
