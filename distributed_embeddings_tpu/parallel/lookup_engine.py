@@ -1050,66 +1050,52 @@ class DistributedLookup:
     received = self.exchange(z, batch_local)
     return self.assemble(received, hotness_of, mean_counts)
 
-  def apply_sparse(self, fused_params: Dict[str, jax.Array],
-                   layouts: Dict[str, PackedLayout],
-                   d_z: Dict[tuple, jax.Array],
-                   residuals: SparseResiduals,
-                   rule: SparseRule, step: jax.Array,
-                   exact: bool = False) -> Dict[str, jax.Array]:
-    """Apply the sparse update: one fused scatter-add per sparse class.
+  @staticmethod
+  def _aux_occ(aux, layout, rule):
+    """Residual rows -> per-occurrence aux rows [-1, n_aux, w].
 
-    The IndexedSlices backward + optimizer apply of the reference
-    (`embedding_lookup_ops.py:105-122` + TF sparse applies) collapsed into a
-    single indexed op per class: per-occurrence cotangent rows are combined
-    with the forward-saved optimizer-state rows by ``rule.delta`` and
-    scatter-added (table delta | state delta) into the packed buffer.
+    Residuals come in two layouts: stride-width fused rows (1-hot /
+    ragged paths) or window-MASKED phys-width rows (multi-hot narrow
+    path) — for the latter, exactly one sub-row window is nonzero, so
+    summing the rpp windows' aux halves extracts it."""
+    if aux is None or not rule.n_aux:
+      return None
+    w, stride, rpp = layout.width, layout.stride, layout.rows_per_phys
+    last = aux.shape[-1]
+    flat = aux.reshape(-1, last)
+    if last == stride:
+      lanes = flat[:, w:]
+    else:  # masked phys rows [.., rpp*stride]
+      lanes = None
+      for s in range(rpp):
+        part = flat[:, s * stride + w:(s + 1) * stride]
+        lanes = part if lanes is None else lanes + part
+    return lanes.reshape(-1, rule.n_aux, w)
 
-    ``exact=True`` reproduces the reference's deduplicated semantics
-    (sort + segment-sum, `embedding_lookup_kernels.cu:464-633`) at the cost
-    of a sort and one extra gather.
-    """
-    from ..ops.sparse_grad import dedup_rows
+  @staticmethod
+  def _decayed(g, res, layout, rule):
+    """Touched-rows l2: add ``2λ * row`` (forward-time row from the
+    residuals — same layouts as _aux_occ) to the occurrence cotangent."""
+    if not rule.weight_decay or res is None:
+      return g
+    w, stride, rpp = layout.width, layout.stride, layout.rows_per_phys
+    last = res.shape[-1]
+    flat = res.reshape(-1, last)
+    if last == stride:
+      row = flat[:, :w]
+    else:  # masked phys rows: exactly one window nonzero per occurrence
+      row = None
+      for s in range(rpp):
+        part = flat[:, s * stride:s * stride + w]
+        row = part if row is None else row + part
+    return g + (2.0 * rule.weight_decay) * row.reshape(g.shape)
 
+  def _sparse_parts_by_class(self, d_z, residuals, rule) -> Dict[str, list]:
+    """Group per-bucket cotangents into per-class ``(ids, dz, aux, h)``
+    parts: ragged buckets expand to per-occurrence rows (h=0 marks them),
+    mean combiners divide by the forward's valid counts. Shared by
+    :meth:`apply_sparse` and :meth:`sparse_delta_streams`."""
     plan = self.plan
-
-    def aux_occ(aux, layout):
-      """Residual rows -> per-occurrence aux rows [-1, n_aux, w].
-
-      Residuals come in two layouts: stride-width fused rows (1-hot /
-      ragged paths) or window-MASKED phys-width rows (multi-hot narrow
-      path) — for the latter, exactly one sub-row window is nonzero, so
-      summing the rpp windows' aux halves extracts it."""
-      if aux is None or not rule.n_aux:
-        return None
-      w, stride, rpp = layout.width, layout.stride, layout.rows_per_phys
-      last = aux.shape[-1]
-      flat = aux.reshape(-1, last)
-      if last == stride:
-        lanes = flat[:, w:]
-      else:  # masked phys rows [.., rpp*stride]
-        lanes = None
-        for s in range(rpp):
-          part = flat[:, s * stride + w:(s + 1) * stride]
-          lanes = part if lanes is None else lanes + part
-      return lanes.reshape(-1, rule.n_aux, w)
-
-    def decayed(g, res, layout):
-      """Touched-rows l2: add ``2λ * row`` (forward-time row from the
-      residuals — same layouts as aux_occ) to the occurrence cotangent."""
-      if not rule.weight_decay or res is None:
-        return g
-      w, stride, rpp = layout.width, layout.stride, layout.rows_per_phys
-      last = res.shape[-1]
-      flat = res.reshape(-1, last)
-      if last == stride:
-        row = flat[:, :w]
-      else:  # masked phys rows: exactly one window nonzero per occurrence
-        row = None
-        for s in range(rpp):
-          part = flat[:, s * stride:s * stride + w]
-          row = part if row is None else row + part
-      return g + (2.0 * rule.weight_decay) * row.reshape(g.shape)
-
     by_class: Dict[str, list] = {}
     for bk, dzb in d_z.items():
       key, h = bk.class_key, bk.h
@@ -1148,6 +1134,96 @@ class DistributedLookup:
         counts = jnp.sum(ids < sentinel, axis=2).astype(dzb.dtype)
         dzb = dzb / jnp.maximum(counts, 1)[..., None]
       by_class.setdefault(name, []).append((ids, dzb, aux, h))
+    return by_class
+
+  def _stream_of_parts(self, layout, parts, rule, step):
+    """Concatenate a class's parts into one occurrence stream.
+
+    Returns ``(ids_cat [n], rows_cat [n, w|stride])`` — raw (decayed)
+    cotangent rows for scale-only rules (the scatter backend applies the
+    scalar), fused ``rule.delta`` rows otherwise. Shared by the one-shot
+    fast path and the deferred micro-batch path so their numerics are the
+    same code."""
+    w = layout.width
+    scale_only = rule.linear_scale is not None
+    all_ids, all_rows = [], []
+    for ids, dzb, aux, h in parts:
+      n = int(np.prod(ids.shape))
+      g = dzb.reshape(-1, w)
+      if h > 1:
+        g = jnp.broadcast_to(g[:, None, :], (n // h, h, w)).reshape(n, w)
+      aux_r = self._aux_occ(aux, layout, rule)
+      g = self._decayed(g, aux, layout, rule)
+      all_ids.append(ids.reshape(-1))
+      all_rows.append(g if scale_only else rule.delta(g, aux_r, step))
+    ids_cat = all_ids[0] if len(all_ids) == 1 else jnp.concatenate(all_ids)
+    rows_cat = (all_rows[0] if len(all_rows) == 1
+                else jnp.concatenate(all_rows))
+    return ids_cat, rows_cat
+
+  def sparse_delta_streams(self, layouts: Dict[str, PackedLayout],
+                           d_z: Dict[tuple, jax.Array],
+                           residuals: SparseResiduals,
+                           rule: SparseRule, step: jax.Array):
+    """Per-class deferred update streams ``name -> (ids, rows)``.
+
+    The micro-batch accumulation path (``make_sparse_train_step(...,
+    micro_batches=n)``) calls this once per micro-batch inside its scan:
+    deltas are computed from the micro-batch's OWN forward-gathered
+    optimizer-state rows (the fused buffers are untouched until the final
+    :meth:`apply_sparse_streams`), so concatenating the streams and
+    scattering once reproduces the one-shot step's numerics exactly —
+    the memory win is that the per-occurrence gather/extract/backward
+    temporaries only ever exist for one micro-batch at a time."""
+    by_class = self._sparse_parts_by_class(d_z, residuals, rule)
+    return {name: self._stream_of_parts(layouts[name], parts, rule, step)
+            for name, parts in by_class.items()}
+
+  def apply_sparse_streams(self, fused_params: Dict[str, jax.Array],
+                           layouts: Dict[str, PackedLayout],
+                           streams, rule: SparseRule,
+                           step: jax.Array) -> Dict[str, jax.Array]:
+    """One regime-dispatched scatter-add per class over prebuilt streams
+    (``name -> (ids [n], rows [n, k])``; flatten any leading micro-batch
+    axes first)."""
+    new_params = dict(fused_params)
+    scale_only = rule.linear_scale is not None
+    for name, (ids_cat, rows_cat) in streams.items():
+      layout = layouts[name]
+      buf = self._squeeze_local(fused_params[name])
+      if not scale_only:
+        # materialize the updates before the scatter: letting XLA fuse
+        # the delta computation into the scatter slows its update loop
+        ids_cat, rows_cat = lax.optimization_barrier((ids_cat, rows_cat))
+      ratio = ids_cat.shape[0] / max(1, layout.phys_rows)
+      new_params[name] = scatter_add_fused(
+          layout, buf, ids_cat, rows_cat,
+          prefer_pallas=ratio < 0.15,
+          delta_scale=(rule.linear_scale(step) if scale_only else None))
+    return new_params
+
+  def apply_sparse(self, fused_params: Dict[str, jax.Array],
+                   layouts: Dict[str, PackedLayout],
+                   d_z: Dict[tuple, jax.Array],
+                   residuals: SparseResiduals,
+                   rule: SparseRule, step: jax.Array,
+                   exact: bool = False) -> Dict[str, jax.Array]:
+    """Apply the sparse update: one fused scatter-add per sparse class.
+
+    The IndexedSlices backward + optimizer apply of the reference
+    (`embedding_lookup_ops.py:105-122` + TF sparse applies) collapsed into a
+    single indexed op per class: per-occurrence cotangent rows are combined
+    with the forward-saved optimizer-state rows by ``rule.delta`` and
+    scatter-added (table delta | state delta) into the packed buffer.
+
+    ``exact=True`` reproduces the reference's deduplicated semantics
+    (sort + segment-sum, `embedding_lookup_kernels.cu:464-633`) at the cost
+    of a sort and one extra gather.
+    """
+    from ..ops.sparse_grad import dedup_rows
+
+    plan = self.plan
+    by_class = self._sparse_parts_by_class(d_z, residuals, rule)
 
     new_params = dict(fused_params)
     for name, parts in by_class.items():
@@ -1187,42 +1263,16 @@ class DistributedLookup:
         # buckets' ids/deltas are concatenated and applied at once.
         n_total = sum(int(np.prod(ids.shape)) for ids, _, _, _ in parts)
         if n_total <= self.apply_chunk:
-          # scale-only rules (SGD): the fused delta is a scalar multiple of
-          # the cotangent, so pass raw cotangent rows and let the scatter
-          # backend apply the scale (the Pallas kernel does it in-VMEM —
-          # no staged delta array, no optimization_barrier)
-          scale_only = rule.linear_scale is not None
-          all_ids, all_deltas = [], []
-          for ids, dzb, aux, h in parts:
-            n = int(np.prod(ids.shape))
-            g = dzb.reshape(-1, w)
-            if h > 1:
-              g = jnp.broadcast_to(g[:, None, :],
-                                   (n // h, h, w)).reshape(n, w)
-            aux_r = aux_occ(aux, layout)
-            g = decayed(g, aux, layout)
-            all_ids.append(ids.reshape(-1))
-            all_deltas.append(g if scale_only else rule.delta(g, aux_r, step))
-          ids_cat = (all_ids[0] if len(all_ids) == 1
-                     else jnp.concatenate(all_ids))
-          delta_cat = (all_deltas[0] if len(all_deltas) == 1
-                       else jnp.concatenate(all_deltas))
-          if not scale_only:
-            # materialize the updates before the scatter: letting XLA fuse
-            # the delta computation into the scatter slows its update loop
-            ids_cat, delta_cat = lax.optimization_barrier(
-                (ids_cat, delta_cat))
-          # Static scatter-regime choice (measured matrix in
-          # docs/BENCHMARKS.md): XLA's fast sorted path (~16-25 ns/row)
-          # only engages when the stream is >= ~0.15x the buffer's
-          # physical rows; below that XLA falls to ~75 ns/row and the
-          # Pallas RMW cache kernel (~47-60 ns in every duplication
-          # regime) wins. Both quantities are static here.
-          ratio = ids_cat.shape[0] / max(1, layout.phys_rows)
-          buf = scatter_add_fused(
-              layout, buf, ids_cat, delta_cat,
-              prefer_pallas=ratio < 0.15,
-              delta_scale=(rule.linear_scale(step) if scale_only else None))
+          # stream build + regime-dispatched scatter: one code path shared
+          # with the micro-batch mode (sparse_delta_streams /
+          # apply_sparse_streams), so retunes of the barrier policy or
+          # the 0.15 regime threshold cannot diverge between them
+          ids_cat, rows_cat = self._stream_of_parts(layout, parts, rule,
+                                                    step)
+          new_params.update(self.apply_sparse_streams(
+              {name: fused_params[name]}, layouts,
+              {name: (ids_cat, rows_cat)}, rule, step))
+          continue
         else:
           # memory escape hatch for extreme occurrence counts (hotness
           # 200-500 models): compute the delta per chunk (never holding
@@ -1232,7 +1282,7 @@ class DistributedLookup:
             n = int(np.prod(ids.shape))
             ids_f = ids.reshape(-1)
             dz_f = dzb.reshape(-1, w)
-            aux_f = aux_occ(aux, layout)
+            aux_f = self._aux_occ(aux, layout, rule)
             res_f = (aux.reshape(-1, aux.shape[-1])
                      if rule.weight_decay and aux is not None else None)
             hh = max(1, h)  # h == 0: ragged parts arrive pre-expanded
@@ -1245,7 +1295,7 @@ class DistributedLookup:
                                        (cn // h, h, w)).reshape(cn, w)
               aux_c = None if aux_f is None else aux_f[c0:c0 + cn]
               if res_f is not None:
-                g_c = decayed(g_c, res_f[c0:c0 + cn], layout)
+                g_c = self._decayed(g_c, res_f[c0:c0 + cn], layout, rule)
               buf = scatter_add_fused(
                   layout, buf, ids_f[c0:c0 + cn],
                   rule.delta(g_c, aux_c, step),

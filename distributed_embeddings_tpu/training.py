@@ -478,7 +478,8 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
                            emb_dense_optimizer: Optional[
                                optax.GradientTransformation] = None,
                            exact: bool = False,
-                           donate: bool = True):
+                           donate: bool = True,
+                           micro_batches: int = 1):
   """Hybrid-parallel train step on the fused sparse state.
 
   One jitted/shard_map'd function per step:
@@ -502,6 +503,19 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
     exact: reproduce the reference's deduplicated backward exactly
       (sort-based; slower). Default False = per-occurrence semantics of
       stock TF sparse optimizer applies.
+    micro_batches: > 1 runs route/gather/model/backward over
+      ``micro_batches`` equal slices of the (per-chip) batch inside a
+      ``lax.scan``, accumulating dense grads and stashing per-class
+      sparse delta streams, then applies ONE scatter per class at the
+      end. Live per-occurrence temporaries (gather outputs, masked rows,
+      backward rematerializations) are capped at 1/micro_batches of the
+      one-shot step — the bounded-memory mode that lets hotness-500
+      models (synthetic Large+) step on a 16 GiB chip. Numerics match
+      the one-shot step (deltas come from each micro-batch's own
+      forward-gathered state rows, and the fused buffers are untouched
+      until the final scatter); only scatter accumulation ORDER differs,
+      an fp-addition reordering. Requires dense (non-ragged) ``cats``
+      and ``exact=False``.
 
   Returns:
     ``step(state, numerical, cats, labels) -> (state, loss)``.
@@ -566,6 +580,121 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
   layouts = engine.fused_layouts(rule)
   emb_opt = emb_dense_optimizer or dense_optimizer
 
+  if micro_batches > 1 and exact:
+    raise NotImplementedError(
+        "micro_batches > 1 with exact=True: cross-micro-batch dedup would "
+        "need the full occurrence stream the mode exists to avoid. Use "
+        "per-occurrence semantics (exact=False) or one-shot exact.")
+
+  def local_step_mb(state, numerical, cats, labels):
+    n_mb = micro_batches
+    b = numerical.shape[0]
+    if b % n_mb:
+      raise ValueError(f"batch {b} not divisible by micro_batches {n_mb}")
+    from .ops.ragged import RaggedIds
+    if any(isinstance(c, RaggedIds) for c in cats):
+      raise NotImplementedError(
+          "micro_batches > 1 needs dense cats (ragged rows cannot be "
+          "batch-sliced statically); pad to dense multi-hot first.")
+    rank = jax.lax.axis_index(axis_name) if mesh is not None else 0
+    hotness = [ragged_hotness(c) for c in cats]
+    hotness_of = lambda i: hotness[i]  # noqa: E731
+    world = jax.lax.axis_size(axis_name) if mesh is not None else 1
+    gscale = 1.0 / (n_mb * world)
+
+    def mb_view(x):
+      return x.reshape((n_mb, b // n_mb) + x.shape[1:])
+
+    keep = bool(rule.weight_decay) and not rule.n_aux
+    # A varying zero (derived from the axis-varying labels): added to the
+    # replicated param trees before differentiating, it makes shard_map
+    # treat the grads as device-local, so the replicated-param psum does
+    # NOT run once per micro-batch inside the scan — ONE psum after the
+    # scan reduces the accumulated local grads. Also the version-portable
+    # varying annotation for the scan carry (jax.lax.pvary only exists on
+    # recent JAX and is already deprecated there). Exactly 0.0, so
+    # numerics are untouched.
+    vz0 = (jnp.sum(labels) * 0).astype(jnp.float32)
+
+    def body(carry, mb):
+      dd_acc, de_acc, loss_acc = carry
+      numerical_i, cats_i, labels_i = mb
+      cats_i = list(cats_i)
+      ids_all = engine.route_ids(cats_i, hotness_of)
+      counts = engine.mean_counts(cats_i)
+      z_sparse, residuals = engine.lookup_sparse_fused(
+          state["fused"], layouts, ids_all, keep_rows=keep)
+
+      def loss_with(dense_p, emb_dense, z_sp):
+        acts = engine.finish_forward(z_sp, emb_dense, ids_all,
+                                     b // n_mb, hotness_of, counts)
+        logits = model.apply({"params": dense_p}, numerical_i, cats_i,
+                             emb_acts=acts)
+        loss = loss_fn(logits, labels_i)
+        if reg_fn is not None:
+          scale = jax.lax.axis_size(axis_name) if mesh is not None else 1
+          loss = loss + scale * reg_fn(emb_dense, rank)
+        return loss
+
+      vz = (jnp.sum(labels_i) * 0).astype(jnp.float32)
+      dense_local, emb_local = jax.tree_util.tree_map(
+          lambda x: x + vz.astype(x.dtype),
+          (state["dense"], state["emb_dense"]))
+      loss_i, (dd, de, dz) = jax.value_and_grad(
+          loss_with, argnums=(0, 1, 2))(dense_local, emb_local, z_sparse)
+      # uniform scale: 1/n_mb turns per-micro-batch means into the global
+      # batch mean (the one-shot cotangent values, needed for non-linear
+      # rule parity), folded with the mesh's 1/world grad rescale
+      dd, de, dz = jax.tree_util.tree_map(
+          lambda g: g * gscale, (dd, de, dz))
+      streams_i = engine.sparse_delta_streams(layouts, dz, residuals,
+                                              rule, state["step"])
+      carry = jax.tree_util.tree_map(
+          jnp.add, (dd_acc, de_acc, loss_acc),
+          (dd, de, loss_i / n_mb))
+      return carry, streams_i
+
+    init = jax.tree_util.tree_map(
+        lambda x: jnp.zeros_like(x) + vz0.astype(x.dtype),
+        (state["dense"], state["emb_dense"])) + (vz0,)
+    mb_batches = (mb_view(numerical), tuple(mb_view(c) for c in cats),
+                  mb_view(labels))
+    (d_dense, d_emb_dense, loss), streams_s = jax.lax.scan(
+        body, init, mb_batches)
+    # flatten the stacked [n_mb, ...] streams and scatter once per class
+    streams = {name: (ids.reshape(-1), rows.reshape(-1, rows.shape[-1]))
+               for name, (ids, rows) in streams_s.items()}
+    if mesh is not None:
+      # the one replicated-param grad reduction for the whole step; the
+      # emb_dense blocks are mp-SHARDED (per-rank windows), so their grads
+      # are already rank-local — summing them across ranks would mix
+      # different tables' windows
+      d_dense = jax.lax.psum(d_dense, axis_name)
+      loss = jax.lax.pmean(loss, axis_name)
+
+    upd, dense_opt = dense_optimizer.update(
+        d_dense, state["dense_opt"], state["dense"])
+    dense = optax.apply_updates(state["dense"], upd)
+    if state["emb_dense"]:
+      upd, emb_dense_opt = emb_opt.update(
+          d_emb_dense, state["emb_dense_opt"], state["emb_dense"])
+      emb_dense = optax.apply_updates(state["emb_dense"], upd)
+      if con_fn is not None:
+        emb_dense = con_fn(emb_dense, rank)
+    else:
+      emb_dense, emb_dense_opt = state["emb_dense"], state["emb_dense_opt"]
+
+    fused = engine.apply_sparse_streams(state["fused"], layouts, streams,
+                                        rule, state["step"])
+    return {
+        "dense": dense,
+        "dense_opt": dense_opt,
+        "emb_dense": emb_dense,
+        "emb_dense_opt": emb_dense_opt,
+        "fused": fused,
+        "step": state["step"] + 1,
+    }, loss
+
   def local_step(state, numerical, cats, labels):
     b = numerical.shape[0]
     rank = jax.lax.axis_index(axis_name) if mesh is not None else 0
@@ -629,14 +758,16 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
     }
     return new_state, loss
 
+  step_fn = local_step_mb if micro_batches > 1 else local_step
+
   if mesh is None:
-    return jax.jit(local_step, donate_argnums=(0,) if donate else ())
+    return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
 
   sspec = hybrid_partition_specs(state, axis_name)
   bspec = jax.tree_util.tree_map(
       lambda _: P(axis_name), tuple(batch_example))
   sharded = shard_map(
-      local_step, mesh=mesh,
+      step_fn, mesh=mesh,
       in_specs=(sspec,) + bspec,
       out_specs=(sspec, P()))
   return jax.jit(sharded, donate_argnums=(0,) if donate else ())

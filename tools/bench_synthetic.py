@@ -5,6 +5,12 @@ Counterpart of the reference's synthetic benchmark
 1xA100 column): one full fused train step (Adagrad) at global batch 65536.
 
 Usage: python tools/bench_synthetic.py [model] [batch] [steps] [vocab_scale]
+                                       [micro_batches]
+
+``micro_batches`` > 1 runs the bounded-memory accumulation mode
+(make_sparse_train_step(micro_batches=n)): per-occurrence temporaries are
+capped at 1/n of the one-shot step, which is what lets Large (6,312
+occurrences/sample) step on the 16 GiB chip at all.
 """
 
 import sys
@@ -41,6 +47,7 @@ STEPS = int(sys.argv[3]) if len(sys.argv) > 3 else 12
 # vocab scale for models that exceed one chip's HBM (same representativeness
 # argument as bench.py: per-step indexed-row cost is vocab-size-insensitive)
 SCALE = float(sys.argv[4]) if len(sys.argv) > 4 else 1.0
+MICRO = int(sys.argv[5]) if len(sys.argv) > 5 else 1
 
 
 def main():
@@ -89,7 +96,8 @@ def main():
   import os
   exact = os.environ.get("BENCH_EXACT", "0") == "1"
   step = make_sparse_train_step(model, plan, bce_loss, dense_opt, rule,
-                                None, state_avals, batches[0], exact=exact)
+                                None, state_avals, batches[0], exact=exact,
+                                micro_batches=MICRO)
   compiled = step.lower(state_avals, *batches[0]).compile()
   state = init_sparse_state_direct(plan, rule, dense_params, dense_opt,
                                    jax.random.PRNGKey(1))
@@ -116,6 +124,7 @@ def main():
   vs = (f"  vs {base_label} {(BATCH / ms) / (65536 / base):.3f}x"
         if base else "")
   scale_tag = f" vocab_scale={SCALE:g}" if SCALE != 1.0 else ""
+  scale_tag += f" micro_batches={MICRO}" if MICRO > 1 else ""
   print(f"{MODEL}{scale_tag} batch={BATCH}: {ms:.2f} ms/step "
         f"({BATCH / ms * 1e3:,.0f} samples/s){vs}")
 
