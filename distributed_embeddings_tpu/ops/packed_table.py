@@ -388,7 +388,11 @@ def scatter_add_fused(layout: PackedLayout, buf: jax.Array, ids: jax.Array,
   grp, sub, valid = _grp_sub(layout, ids)
   fused_delta = jnp.where(valid[..., None], fused_delta, 0)
   rpp = layout.rows_per_phys
-  if rpp == 1:
+  if fused_delta.shape[-1] == layout.phys_width:
+    # pre-expanded physical rows (ops/pallas_delta.py): window placement
+    # and lane padding already done in-kernel
+    upd = fused_delta
+  elif rpp == 1:
     lane_pad = layout.phys_width - layout.stride
     if lane_pad:
       fused_delta = jnp.concatenate(
@@ -483,6 +487,12 @@ class SparseRule:
   # engine then skips the delta materialization entirely and the Pallas
   # RMW kernel applies the scale in-VMEM (`pallas_apply.apply_rows_cached`)
   linear_scale: Optional[callable] = None
+  # flat-lanes twin of ``delta`` for the Pallas delta-build kernel
+  # (`ops/pallas_delta.py`): ``delta_lanes(g, [aux_0, ..], step)`` returns
+  # the delta as a LIST of [..., W] lane groups (table first) — Mosaic
+  # cannot build the [..., n_aux, W] aux view in-kernel. Must compute
+  # exactly what ``delta`` computes (tests/test_pallas_delta.py pins it)
+  delta_lanes: Optional[callable] = None
 
   def init_aux(self, rows: int, width: int, dtype=jnp.float32) -> List:
     return [np.full((rows, width), v, dtype) for v in self.aux_init]
@@ -520,7 +530,16 @@ def adagrad_rule(learning_rate, initial_accumulator_value: float = 0.1,
     lr = _lr_at(learning_rate, step)
     return jnp.concatenate([-lr * scaled, g2], axis=-1)
 
-  return SparseRule("adagrad", 1, (initial_accumulator_value,), delta)
+  def delta_lanes(g, aux_list, step):
+    (acc,) = aux_list
+    g2 = g * g
+    acc_new = acc + g2
+    scaled = jnp.where(acc_new > 0, g * jax.lax.rsqrt(acc_new + eps), 0.0)
+    lr = _lr_at(learning_rate, step)
+    return [-lr * scaled, g2]
+
+  return SparseRule("adagrad", 1, (initial_accumulator_value,), delta,
+                    delta_lanes=delta_lanes)
 
 
 def momentum_rule(learning_rate, momentum: float = 0.9,
@@ -542,7 +561,14 @@ def momentum_rule(learning_rate, momentum: float = 0.9,
     lr = _lr_at(learning_rate, step)
     return jnp.concatenate([-lr * upd, m_new - m], axis=-1)
 
-  return SparseRule("momentum", 1, (0.0,), delta)
+  def delta_lanes(g, aux_list, step):
+    (m,) = aux_list
+    m_new = momentum * m + g
+    upd = (g + momentum * m_new) if nesterov else m_new
+    lr = _lr_at(learning_rate, step)
+    return [-lr * upd, m_new - m]
+
+  return SparseRule("momentum", 1, (0.0,), delta, delta_lanes=delta_lanes)
 
 
 def adam_rule(learning_rate, b1: float = 0.9, b2: float = 0.999,
@@ -572,7 +598,20 @@ def adam_rule(learning_rate, b1: float = 0.9, b2: float = 0.999,
     upd = m_hat / (jnp.sqrt(v_hat) + eps)
     return jnp.concatenate([-lr * upd, dm, dv], axis=-1)
 
-  return SparseRule("adam", 2, (0.0, 0.0), delta)
+  def delta_lanes(g, aux_list, step):
+    m, v = aux_list
+    dm = (1.0 - b1) * (g - m)
+    dv = (1.0 - b2) * (g * g - v)
+    m_new = m + dm
+    v_new = v + dv
+    t = (step + 1).astype(jnp.float32)
+    m_hat = m_new / (1.0 - jnp.power(b1, t))
+    v_hat = v_new / (1.0 - jnp.power(b2, t))
+    lr = _lr_at(learning_rate, step)
+    upd = m_hat / (jnp.sqrt(v_hat) + eps)
+    return [-lr * upd, dm, dv]
+
+  return SparseRule("adam", 2, (0.0, 0.0), delta, delta_lanes=delta_lanes)
 
 
 _RULES = {"sgd": sgd_rule, "adagrad": adagrad_rule,

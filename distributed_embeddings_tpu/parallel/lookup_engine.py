@@ -72,6 +72,7 @@ if TYPE_CHECKING:  # annotation-only: importing layers here would close the
 from ..ops.packed_table import (
     PackedLayout,
     SparseRule,
+    _grp_sub,
     gather_fused,
     gather_fused_chunked,
     mxu_operand_dtype,
@@ -1101,6 +1102,13 @@ class DistributedLookup:
       key, h = bk.class_key, bk.h
       if plan.classes[key].kind != "sparse":
         continue
+      if os.environ.get("DE_TPU_COTANGENT_PIN", "0") == "1":
+        # EXPERIMENT (default off — measured NEUTRAL-to-negative on Tiny:
+        # 162 -> 167 ms): pinning the per-sample cotangent row-major here
+        # does not stick — XLA re-transposes it back to batch-minor for
+        # the h-broadcast materialization downstream (trace round 5)
+        from ..ops.pallas_layout import row_major
+        dzb = row_major(dzb)
       cp = plan.classes[key]
       name = class_param_name(*key)
       ids = residuals.ids_all[bk]  # [n_b, G, h] | ragged (vals, lens)
@@ -1136,6 +1144,53 @@ class DistributedLookup:
       by_class.setdefault(name, []).append((ids, dzb, aux, h))
     return by_class
 
+  def _pallas_delta_rows(self, layout, ids, dzb, aux, h, rule, step):
+    """Gate + dispatch for the Pallas delta-build kernel
+    (`ops/pallas_delta.py`): returns the pre-expanded ``[n, phys]`` update
+    rows, or None to take the XLA chain. TPU-only; needs the rule's
+    ``delta_lanes`` twin, a 128-lane physical layout, f32, and no
+    weight_decay (the decay path needs forward-row extraction the kernel
+    does not carry)."""
+    # Default OFF: measured NET-NEGATIVE on Tiny (178 vs 162 ms wall) —
+    # the kernel runs 16.7 ms where the XLA chain's removable share is
+    # smaller than it traced: h=1 parts pay a whole extra HBM round-trip
+    # the XLA form never materializes (its delta fuses into the scatter's
+    # producer), and the batch-minor copies it targeted partially remain
+    # on the gather side. Kept as measured infrastructure + the
+    # delta_lanes twins (docs/BENCHMARKS.md round-5 staging study).
+    if os.environ.get("DE_TPU_PALLAS_DELTA", "0") != "1":
+      return None
+    if (rule.delta_lanes is None or rule.linear_scale is not None
+        or rule.weight_decay):
+      return None
+    if layout.phys_width != 128 or dzb.dtype != jnp.float32:
+      return None
+    if rule.n_aux and (aux is None or aux.dtype != jnp.float32):
+      return None
+    try:
+      if jax.default_backend() != "tpu":
+        return None
+    except RuntimeError:
+      return None
+    hh = max(1, int(h))  # h == 0: ragged parts arrive pre-expanded per occ
+    n = int(np.prod(ids.shape))
+    if n == 0 or n % hh:
+      return None
+    k = n // hh
+    if k % 8:  # no even VMEM blocking
+      return None
+    if aux is not None and aux.shape[-1] not in (layout.stride,
+                                                 layout.phys_width):
+      return None
+    from ..ops.pallas_delta import build_delta_rows, pick_block
+    if not pick_block(k, hh, aux.shape[-1] if aux is not None else 0):
+      return None  # no VMEM-feasible block (e.g. extreme hotness)
+    _, sub, _ = _grp_sub(layout, ids.reshape(-1))
+    aux_flat = (aux.reshape(n, aux.shape[-1])
+                if aux is not None and rule.n_aux else None)
+    return build_delta_rows(layout, rule, dzb.reshape(k, -1), sub,
+                            aux_flat, hh, step)
+
   def _stream_of_parts(self, layout, parts, rule, step):
     """Concatenate a class's parts into one occurrence stream.
 
@@ -1147,6 +1202,15 @@ class DistributedLookup:
     w = layout.width
     scale_only = rule.linear_scale is not None
     all_ids, all_rows = [], []
+    # all-or-nothing per class: mixing pre-expanded [n, phys] kernel rows
+    # with stride-width XLA rows would break the concat below
+    built_all = [self._pallas_delta_rows(layout, ids, dzb, aux, h, rule,
+                                         step)
+                 for ids, dzb, aux, h in parts]
+    if all(b is not None for b in built_all):
+      return (jnp.concatenate([ids.reshape(-1) for ids, _, _, _ in parts])
+              if len(parts) > 1 else parts[0][0].reshape(-1),
+              jnp.concatenate(built_all) if len(parts) > 1 else built_all[0])
     for ids, dzb, aux, h in parts:
       n = int(np.prod(ids.shape))
       g = dzb.reshape(-1, w)

@@ -1,0 +1,132 @@
+"""Device-trace one synthetic-zoo fused step and print per-fusion timings.
+
+Same harness as tools/trace_dlrm.py but for the zoo models — the
+ground-truth attribution for where each model's milliseconds sit.
+
+Usage: python tools/trace_zoo.py [model] [batch] [vocab_scale] [micro]
+"""
+
+import dataclasses
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_embeddings_tpu.layers.planner import DistEmbeddingStrategy
+from distributed_embeddings_tpu.models import (
+    SYNTHETIC_MODELS,
+    SyntheticModel,
+    bce_loss,
+    expand_tables,
+    generate_batch,
+)
+from distributed_embeddings_tpu.ops.packed_table import adagrad_rule
+from distributed_embeddings_tpu.training import (
+    init_sparse_state_direct,
+    make_sparse_train_step,
+)
+
+MODEL = sys.argv[1] if len(sys.argv) > 1 else "tiny"
+BATCH = int(sys.argv[2]) if len(sys.argv) > 2 else 65536
+SCALE = float(sys.argv[3]) if len(sys.argv) > 3 else 1.0
+MICRO = int(sys.argv[4]) if len(sys.argv) > 4 else 1
+
+
+def main():
+  cfg = SYNTHETIC_MODELS[MODEL]
+  tables, tmap, hotness = expand_tables(cfg)
+  model = SyntheticModel(config=cfg, world_size=1)
+  thr = model.dense_row_threshold
+  if SCALE != 1.0:
+    tables = [dataclasses.replace(t, input_dim=max(8, int(t.input_dim * SCALE)))
+              for t in tables]
+    thr = max(8, int(thr * SCALE))
+  plan = DistEmbeddingStrategy(tables, 1, "basic", input_table_map=tmap,
+                               dense_row_threshold=thr,
+                               input_hotness=hotness, batch_hint=BATCH)
+  numerical, cats, labels = generate_batch(cfg, BATCH, alpha=1.05, seed=0)
+  cats = [(c % tables[t].input_dim if SCALE != 1.0
+           else np.minimum(c, tables[t].input_dim - 1)).astype(np.int32)
+          for c, t in zip(cats, tmap)]
+  cats = [jnp.asarray(c if h > 1 else c[:, 0])
+          for c, h in zip(cats, hotness)]
+  batch = (jnp.asarray(numerical), cats, jnp.asarray(labels))
+
+  dense_opt = optax.adagrad(0.01)
+  rule = adagrad_rule(0.01)
+  dummy_acts = [jnp.zeros((2, tables[t].output_dim), jnp.float32)
+                for t in tmap]
+  dense_params = model.init(jax.random.PRNGKey(0), batch[0][:2],
+                            [c[:2] for c in cats],
+                            emb_acts=dummy_acts)["params"]
+  state_avals = jax.eval_shape(
+      lambda: init_sparse_state_direct(plan, rule, dense_params, dense_opt,
+                                       jax.random.PRNGKey(1)))
+  step = make_sparse_train_step(model, plan, bce_loss, dense_opt, rule,
+                                None, state_avals, batch,
+                                micro_batches=MICRO)
+  compiled = step.lower(state_avals, *batch).compile()
+  state = init_sparse_state_direct(plan, rule, dense_params, dense_opt,
+                                   jax.random.PRNGKey(1))
+  for _ in range(2):
+    state, loss = compiled(state, *batch)
+  float(loss)
+
+  tdir = f"/tmp/zoo_trace_{MODEL}_{int(time.time())}"
+  with jax.profiler.trace(tdir):
+    for _ in range(2):
+      state, loss = compiled(state, *batch)
+    float(loss)
+
+  path = sorted(glob.glob(f"{tdir}/plugins/profile/*/*.trace.json.gz"))[-1]
+  with gzip.open(path) as f:
+    t = json.load(f)
+  names = {}
+  for e in t.get("traceEvents", []):
+    if e.get("ph") == "M" and e.get("name") == "process_name":
+      names[e["pid"]] = e["args"]["name"]
+  dev_pids = {p for p, n in names.items() if "TPU" in n}
+  tot = defaultdict(float)
+  cnt = defaultdict(int)
+  args_of = {}
+  for e in t.get("traceEvents", []):
+    if e.get("ph") != "X" or e.get("pid") not in dev_pids:
+      continue
+    nm = e.get("name", "?")
+    tot[nm] += e.get("dur", 0.0)
+    cnt[nm] += 1
+    if e.get("args"):
+      args_of[nm] = e["args"]
+  # also aggregate by source line for a by-subsystem view
+  by_src = defaultdict(float)
+  for nm, us in tot.items():
+    a = args_of.get(nm) or {}
+    ln = a.get("long_name", "")
+    src = a.get("source", "")
+    if src:
+      by_src[src] += us
+  print("== top ops ==")
+  for nm, us in sorted(tot.items(), key=lambda kv: -kv[1])[:45]:
+    a = args_of.get(nm)
+    extra = ""
+    if a:
+      extra = " | " + " ".join(f"{k}={str(v)[:70]}" for k, v in a.items()
+                               if k in ("long_name", "source"))
+    print(f"{us/2/1000.0:9.3f} ms n={cnt[nm]:4d}  {nm[:46]}{extra[:150]}")
+  print("== by source line ==")
+  for src, us in sorted(by_src.items(), key=lambda kv: -kv[1])[:25]:
+    print(f"{us/2/1000.0:9.3f} ms  {src}")
+
+
+if __name__ == "__main__":
+  main()
