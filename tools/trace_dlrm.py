@@ -41,6 +41,7 @@ BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
 SCALE = float(sys.argv[2]) if len(sys.argv) > 2 else 0.0625
 AMP = (os.environ.get("BENCH_AMP", "0") == "1"
        or os.environ.get("AMP", "0") == "1")
+EXACT = os.environ.get("BENCH_EXACT", "0") == "1"
 
 
 def main():
@@ -67,7 +68,7 @@ def main():
       lambda: init_sparse_state_direct(plan, rule, dense_params, dense_opt,
                                        jax.random.PRNGKey(1)))
   step = make_sparse_train_step(model, plan, bce_loss, dense_opt, rule,
-                                None, state_avals, batch)
+                                None, state_avals, batch, exact=EXACT)
   compiled = step.lower(state_avals, *batch).compile()
   state = init_sparse_state_direct(plan, rule, dense_params, dense_opt,
                                    jax.random.PRNGKey(1))
