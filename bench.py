@@ -119,7 +119,81 @@ def run(batch_size: int) -> float:
 
   t1, state = chain(STEPS, state)
   t2, state = chain(2 * STEPS, state)
+  if os.environ.get("BENCH_BUDGET", "1") == "1" and not AMP and not EXACT:
+    _budget_check(compiled, state, batch)
   return max((t2 - t1) / STEPS, 1e-9)
+
+
+# Step-composition regression pin (round 5, VERDICT item 3): per-phase
+# device-time budgets derived from the round-5 trace (44.1 ms step:
+# applies 15.9, interaction kernels 6.5, fused gathers 4.4; see
+# docs/BENCHMARKS.md). LOOSE bounds — a breach means a structural
+# regression (e.g. a re-introduced relayout copy), not noise. Warnings
+# only (stderr), never a bench failure.
+_PHASE_BUDGETS_MS = {
+    # the interaction kernels' custom-calls attribute to their dlrm.py
+    # call sites, so the two files form one phase
+    ("pallas_apply.py",): 19.0,
+    ("models/dlrm.py", "pallas_interact.py"): 11.0,
+    ("packed_table.py",): 11.0,  # gathers + small-gen scatter + sorts
+    ("lookup_engine.py",): 8.0,  # assembly / routing / dense classes
+}
+_TOTAL_BUDGET_MS = 52.0
+
+
+def _budget_check(compiled, state, batch):
+  """Trace 2 steps, aggregate device time by source file, warn on any
+  phase over its budget."""
+  import glob
+  import gzip
+  import json
+  from collections import defaultdict
+
+  import jax
+  try:
+    tdir = f"/tmp/bench_budget_{int(time.time())}"
+    with jax.profiler.trace(tdir):
+      for _ in range(2):
+        state, loss = compiled(state, *batch)
+      float(loss)
+    path = sorted(glob.glob(f"{tdir}/plugins/profile/*/*.trace.json.gz"))[-1]
+    with gzip.open(path) as f:
+      t = json.load(f)
+    names = {}
+    for e in t.get("traceEvents", []):
+      if e.get("ph") == "M" and e.get("name") == "process_name":
+        names[e["pid"]] = e["args"]["name"]
+    dev_pids = {p for p, n in names.items() if "TPU" in n}
+    by_src = defaultdict(float)
+    total = 0.0
+    for e in t.get("traceEvents", []):
+      if e.get("ph") != "X" or e.get("pid") not in dev_pids:
+        continue
+      nm = e.get("name", "")
+      if nm.startswith("jit_"):
+        total += e.get("dur", 0.0)
+      src = (e.get("args") or {}).get("source", "")
+      if src:
+        by_src[src] += e.get("dur", 0.0)
+    total_ms = total / 2 / 1000.0
+    ok = True
+    for keys, budget in _PHASE_BUDGETS_MS.items():
+      ms = sum(us for src, us in by_src.items()
+               if any(k in src for k in keys)) / 2 / 1000.0
+      if ms > budget:
+        ok = False
+        print(f"# BUDGET WARN: phase {'+'.join(keys)} {ms:.1f} ms > "
+              f"{budget:.1f} ms budget (step-composition regression?)",
+              file=sys.stderr)
+    if total_ms > _TOTAL_BUDGET_MS:
+      ok = False
+      print(f"# BUDGET WARN: device step {total_ms:.1f} ms > "
+            f"{_TOTAL_BUDGET_MS:.1f} ms budget", file=sys.stderr)
+    if ok:
+      print(f"# budget OK: device step {total_ms:.1f} ms, all phases "
+            "within docs/BENCHMARKS.md round-5 budgets", file=sys.stderr)
+  except Exception as e:  # noqa: BLE001 - the pin must never sink the bench
+    print(f"# budget check skipped: {e}", file=sys.stderr)
 
 
 def smoke():
