@@ -76,6 +76,11 @@ def parse_args():
   p.add_argument("--sparse", action="store_true",
                  help="fused sparse training path (packed tables, "
                       "row-sparse SGD; the bench.py path)")
+  p.add_argument("--micro_batches", type=int, default=1,
+                 help="bounded-memory accumulation: run the sparse step "
+                      "over N batch slices in a scan, capping "
+                      "per-occurrence temporaries at 1/N (one-shot "
+                      "numerics preserved; sparse path only)")
   p.add_argument("--checkpoint_dir", default=None,
                  help="full train-state checkpoint dir (sparse path only); "
                       "auto-resumes when it exists")
@@ -206,7 +211,8 @@ def main():
     print(f"sparse state ready in {time.time() - _t_setup:.1f}s", flush=True)
     sparse_step = make_sparse_train_step(model, plan, bce_loss, optimizer,
                                          rule, mesh, state, batch_example,
-                                         donate=False)
+                                         donate=False,
+                                         micro_batches=args.micro_batches)
 
     # One jitted wrapper that takes the cats as a SINGLE [B, n_tables]
     # matrix and splits it on device: feeding 26 separate feature arrays

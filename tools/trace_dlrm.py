@@ -9,9 +9,6 @@ milliseconds sit (fusion names carry the originating HLO/op metadata).
 Usage: python tools/trace_dlrm.py [batch] [vocab_scale]
 """
 
-import glob
-import gzip
-import json
 import os
 import sys
 import time
@@ -82,27 +79,8 @@ def main():
       state, loss = compiled(state, *batch)
     float(loss)
 
-  path = sorted(glob.glob(f"{tdir}/plugins/profile/*/*.trace.json.gz"))[-1]
-  with gzip.open(path) as f:
-    t = json.load(f)
-  names = {}
-  for e in t.get("traceEvents", []):
-    if e.get("ph") == "M" and e.get("name") == "process_name":
-      names[e["pid"]] = e["args"]["name"]
-  dev_pids = {p for p, n in names.items() if "TPU" in n}
-  evs = [e for e in t.get("traceEvents", []) if e.get("ph") == "X"
-         and e.get("pid") in dev_pids]
-  print(f"{len(evs)} device events; trace at {path}")
-  from collections import defaultdict
-  tot = defaultdict(float)
-  cnt = defaultdict(int)
-  args_of = {}
-  for e in evs:
-    nm = e.get("name", "?")
-    tot[nm] += e.get("dur", 0.0)
-    cnt[nm] += 1
-    if e.get("args"):
-      args_of[nm] = e["args"]
+  from _bench_util import parse_device_trace
+  tot, cnt, args_of, _, _ = parse_device_trace(tdir)
   grand = sum(tot.values())
   print(f"total device us (2 steps x outer events double-count ok): {grand:.0f}")
   for nm, us in sorted(tot.items(), key=lambda kv: -kv[1])[:60]:

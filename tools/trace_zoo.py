@@ -7,13 +7,9 @@ Usage: python tools/trace_zoo.py [model] [batch] [vocab_scale] [micro]
 """
 
 import dataclasses
-import glob
-import gzip
-import json
 import os
 import sys
 import time
-from collections import defaultdict
 
 import jax
 import jax.numpy as jnp
@@ -88,33 +84,8 @@ def main():
       state, loss = compiled(state, *batch)
     float(loss)
 
-  path = sorted(glob.glob(f"{tdir}/plugins/profile/*/*.trace.json.gz"))[-1]
-  with gzip.open(path) as f:
-    t = json.load(f)
-  names = {}
-  for e in t.get("traceEvents", []):
-    if e.get("ph") == "M" and e.get("name") == "process_name":
-      names[e["pid"]] = e["args"]["name"]
-  dev_pids = {p for p, n in names.items() if "TPU" in n}
-  tot = defaultdict(float)
-  cnt = defaultdict(int)
-  args_of = {}
-  for e in t.get("traceEvents", []):
-    if e.get("ph") != "X" or e.get("pid") not in dev_pids:
-      continue
-    nm = e.get("name", "?")
-    tot[nm] += e.get("dur", 0.0)
-    cnt[nm] += 1
-    if e.get("args"):
-      args_of[nm] = e["args"]
-  # also aggregate by source line for a by-subsystem view
-  by_src = defaultdict(float)
-  for nm, us in tot.items():
-    a = args_of.get(nm) or {}
-    ln = a.get("long_name", "")
-    src = a.get("source", "")
-    if src:
-      by_src[src] += us
+  from _bench_util import parse_device_trace
+  tot, cnt, args_of, by_src, _ = parse_device_trace(tdir)
   print("== top ops ==")
   for nm, us in sorted(tot.items(), key=lambda kv: -kv[1])[:45]:
     a = args_of.get(nm)
