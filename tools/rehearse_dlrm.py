@@ -1,0 +1,123 @@
+"""On-chip DLRM convergence rehearsal: f32 vs AMP with the Pallas kernels.
+
+The CPU rehearsal (`tests/test_dlrm_convergence.py`) exercises the XLA
+paths only; this runs the same learnable task ON THE REAL CHIP at bench
+shapes, where the fused interaction kernels, the Pallas RMW apply, and
+the bf16 operand storage are all live — the hardware training-outcome
+evidence that the kernel paths learn identically.
+
+Usage: python tools/rehearse_dlrm.py [steps] [batch]
+Prints per-path tail loss + rank-AUC.
+"""
+
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_embeddings_tpu.layers.planner import DistEmbeddingStrategy
+from distributed_embeddings_tpu.models import DLRM, bce_loss
+from distributed_embeddings_tpu.ops.packed_table import sgd_rule
+from distributed_embeddings_tpu.training import (
+    init_sparse_state_direct,
+    make_sparse_eval_step,
+    make_sparse_train_step,
+)
+
+CRITEO_1TB_VOCAB = [
+    39884406, 39043, 17289, 7420, 20263, 3, 7120, 1543, 63, 38532951,
+    2953546, 403346, 10, 2208, 11938, 155, 4, 976, 14, 39979771,
+    25641295, 39664984, 585935, 12972, 108, 36
+]
+
+STEPS = int(sys.argv[1]) if len(sys.argv) > 1 else 300
+BATCH = int(sys.argv[2]) if len(sys.argv) > 2 else 8192
+VOCAB = [max(4, min(v // 256, 32000)) for v in CRITEO_1TB_VOCAB]
+LR = 2.0
+
+
+def _stream(seed):
+  rng = np.random.default_rng(seed)
+  scores = [rng.standard_normal(v).astype(np.float32) * 1.2 for v in VOCAB]
+
+  def batch(step, n=BATCH):
+    r = np.random.default_rng(seed * 100003 + step)
+    cats = [r.integers(0, v, n).astype(np.int32) for v in VOCAB]
+    logit = sum(s[c] for s, c in zip(scores, cats)) / np.sqrt(len(VOCAB))
+    labels = (r.random(n) < 1.0 / (1.0 + np.exp(-logit))).astype(np.float32)
+    numerical = r.standard_normal((n, 13)).astype(np.float32) * 0.1
+    return (jnp.asarray(numerical), [jnp.asarray(c) for c in cats],
+            jnp.asarray(labels))
+
+  return batch
+
+
+def _rank_auc(scores, labels):
+  order = np.argsort(scores)
+  ranks = np.empty_like(order, dtype=np.float64)
+  ranks[order] = np.arange(1, len(scores) + 1)
+  pos = labels > 0.5
+  n_pos, n_neg = pos.sum(), (~pos).sum()
+  if n_pos == 0 or n_neg == 0:
+    return 0.5
+  return (ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+
+
+def run(dtype, tag):
+  stream = _stream(11)
+  numerical, cats, labels = stream(0)
+  rule = sgd_rule(LR)
+  opt = optax.sgd(LR)
+  model = DLRM(vocab_sizes=VOCAB, embedding_dim=128, world_size=1,
+               dense_row_threshold=16, batch_hint=BATCH,
+               compute_dtype=dtype)
+  plan = DistEmbeddingStrategy(
+      [dict(input_dim=v, output_dim=128, combiner=None) for v in VOCAB],
+      1, "basic", dense_row_threshold=16, batch_hint=BATCH)
+  dummy = [jnp.zeros((2, 128), jnp.float32) for _ in VOCAB]
+  dense_params = model.init(jax.random.PRNGKey(0), numerical[:2],
+                            [c[:2] for c in cats],
+                            emb_acts=dummy)["params"]
+  state = init_sparse_state_direct(plan, rule, dense_params, opt,
+                                   jax.random.PRNGKey(1))
+  step = make_sparse_train_step(model, plan, bce_loss, opt, rule, None,
+                                state, (numerical, cats, labels),
+                                donate=False)
+  losses = []
+  t0 = time.time()
+  for i in range(STEPS):
+    n_, c_, l_ = stream(i)
+    state, loss = step(state, n_, c_, l_)
+    if i % 50 == 0 or i >= STEPS - 25:
+      losses.append(float(loss))
+  n_eval = 4 * BATCH
+  ev_num, ev_cats, ev_labels = stream(10_000, n=n_eval)
+  ev = make_sparse_eval_step(model, plan, rule, None, state,
+                             (ev_num, ev_cats, ev_labels))
+  logits = np.asarray(jax.device_get(ev(state, ev_num, ev_cats)))
+  auc = _rank_auc(logits, np.asarray(ev_labels))
+  tail = float(np.mean(losses[-20:]))
+  print(f"{tag:12s}: start {losses[0]:.4f} -> tail {tail:.4f}, "
+        f"AUC {auc:.4f}  ({time.time() - t0:.0f}s)", flush=True)
+  return tail, auc
+
+
+def main():
+  t_f32, a_f32 = run(jnp.float32, "f32")
+  t_amp, a_amp = run(jnp.bfloat16, "amp(bf16)")
+  ok = abs(t_f32 - t_amp) < 0.03 and abs(a_f32 - a_amp) < 0.03 \
+      and min(a_f32, a_amp) > 0.65
+  print(f"parity: tail d={abs(t_f32 - t_amp):.4f}, "
+        f"AUC d={abs(a_f32 - a_amp):.4f} -> {'OK' if ok else 'FAIL'}")
+  if not ok:
+    sys.exit(1)
+
+
+if __name__ == "__main__":
+  main()
