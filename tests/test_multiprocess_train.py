@@ -86,7 +86,9 @@ state = jax.tree_util.tree_map(
 batch = (jnp.asarray(numerical_np), [jnp.asarray(c) for c in cats_np],
          jnp.asarray(labels_np))
 step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
-                              state, batch)
+                              state, batch,
+                              micro_batches=int(
+                                  os.environ.get("TEST_MICRO_BATCHES", "1")))
 batch_g = (put(numerical_np, P("mp")),
            [put(c, P("mp")) for c in cats_np],
            put(labels_np, P("mp")))
@@ -101,7 +103,11 @@ assert all(np.isfinite(l) for l in losses)
 
 
 @pytest.mark.slow
-def test_two_process_training_matches_single(tmp_path):
+@pytest.mark.parametrize("micro_batches", [1, 2])
+def test_two_process_training_matches_single(tmp_path, micro_batches):
+  """micro_batches=2 additionally runs the bounded-memory scan mode as a
+  true multi-controller program (the grads' deferred single psum and the
+  stashed delta streams cross the process boundary)."""
   script = tmp_path / "worker.py"
   script.write_text(_WORKER)
   with socket.socket() as s:
@@ -110,6 +116,7 @@ def test_two_process_training_matches_single(tmp_path):
   env = {k: v for k, v in os.environ.items()
          if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "PYTHONPATH")}
   env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+  env["TEST_MICRO_BATCHES"] = str(micro_batches)
 
   # single-process reference on the same 8-device problem
   ref = subprocess.run([sys.executable, str(script), "0", "single"],
