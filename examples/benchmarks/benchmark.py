@@ -5,6 +5,15 @@ the fused variable-hotness (CSR) lookup against the naive dense-padded
 gather+reduce, forward / backward / SGD-apply, at vocab 1M x width 128,
 batch 16384, hotness <= 500.
 
+On the reference's GPUs the fused CSR kernel wins; on TPU the answer
+INVERTS (measured round 5, v5e: padded-dense forward 11.3 ms = 10.8
+ns/row at the gather floor vs csr_lookup 92.7 ms — XLA's ragged
+segment-sum does not pipeline) — which is why the distributed engine
+serves ragged inputs through sentinel-padded buckets rather than CSR.
+Timing uses chained two-length differencing with value-varying operands
+and a discarded warm chain (the TPU tunnel relay caches byte-identical
+executions and has a multi-second cold start on first chained dispatch).
+
   python examples/benchmarks/benchmark.py [--platform cpu] [--hotness 64]
 """
 
@@ -27,19 +36,48 @@ def parse_args():
   p.add_argument("--batch", type=int, default=16384)
   p.add_argument("--hotness", type=int, default=64,
                  help="max hotness (uniform 1..max per row)")
-  p.add_argument("--steps", type=int, default=20)
+  p.add_argument("--steps", type=int, default=4,
+                 help="chain length (short: the tunnel relay degrades "
+                      "long chains; two lengths are differenced)")
   p.add_argument("--combiner", default="sum", choices=["sum", "mean"])
   p.add_argument("--platform", default=None)
   return p.parse_args()
 
 
-def timeit(fn, *args, steps=20):
-  out = jax.block_until_ready(fn(*args))  # compile
-  t0 = time.perf_counter()
-  for _ in range(steps):
-    out = fn(*args)
-  jax.block_until_ready(out)
-  return (time.perf_counter() - t0) / steps * 1000
+def timeit(fn, params, ids0, vocab, steps=4):
+  """Chained two-length differencing (the bench.py pattern): through the
+  TPU tunnel, identical repeated executions can be served from a relay
+  cache and block_until_ready under-syncs, so every iteration derives its
+  id operand from the previous output (never byte-identical) and one
+  scalar is fetched at the end; chains stay SHORT (the relay degrades
+  >4-step chains) and two lengths are differenced so dispatch/RTT cancel.
+  The (ids+0)%vocab rework costs the same on both sides."""
+  # donated accumulator consumer: every iteration's operands and outputs
+  # are genuinely different device values with a true serial dependency,
+  # so no relay layer can cache, reorder, or collapse the chain
+  # params stays an ARGUMENT (closing over it would ship the 512 MB
+  # table as a jit constant through the tunnel's compile request)
+  acc_step = jax.jit(lambda acc, p, i: acc + fn(p, i), donate_argnums=0)
+  out = fn(params, ids0)
+  acc = jnp.zeros_like(out)
+  acc = acc_step(acc, params, ids0)
+  float(acc.ravel()[0])
+  it = [0]
+
+  def run(k, a):
+    t0 = time.perf_counter()
+    for _ in range(k):
+      it[0] += 1  # value-varying ids as well
+      bump = (a.ravel()[0] * 0).astype(jnp.int32) + it[0]
+      a = acc_step(a, params, (ids0 + bump) % vocab)
+    float(a.ravel()[0])
+    return time.perf_counter() - t0, a
+
+  _, acc = run(steps, acc)  # discard: the first timed chain eats the
+  # relay's cold-start (measured ~5 s on the first chained dispatch)
+  t1, acc = run(steps, acc)
+  t2, acc = run(2 * steps, acc)
+  return max((t2 - t1) / steps, 1e-9) * 1000
 
 
 def main():
@@ -63,35 +101,54 @@ def main():
         f"{jax.devices()[0].platform}")
 
   fused_fwd = jax.jit(
-      lambda p: csr_lookup(p, values, row_splits, args.combiner))
+      lambda p, v: csr_lookup(p, v, row_splits, args.combiner))
   naive_fwd = jax.jit(
-      lambda p: jnp.sum(jnp.take(p, dense_ids, axis=0), axis=1)
+      lambda p, i: jnp.sum(jnp.take(p, i, axis=0), axis=1)
       if args.combiner == "sum"
-      else jnp.mean(jnp.take(p, dense_ids, axis=0), axis=1))
+      else jnp.mean(jnp.take(p, i, axis=0), axis=1))
 
   def grad_of(fwd):
-    return jax.jit(jax.grad(lambda p: jnp.sum(fwd(p) ** 2)))
+    return jax.jit(jax.grad(lambda p, i: jnp.sum(fwd(p, i) ** 2)))
 
   def sgd_of(fwd):
-    g = jax.grad(lambda p: jnp.sum(fwd(p) ** 2))
-    return jax.jit(lambda p: p - 0.01 * g(p), donate_argnums=0)
+    g = jax.grad(lambda p, i: jnp.sum(fwd(p, i) ** 2))
+    return jax.jit(lambda p, i: p - 0.01 * g(p, i), donate_argnums=0)
 
   rows = []
-  for name, fwd in [("fused_csr", fused_fwd), ("padded_dense", naive_fwd)]:
-    t_f = timeit(fwd, params, steps=args.steps)
-    t_g = timeit(grad_of(fwd), params, steps=args.steps)
+  for name, fwd, ids0 in [("fused_csr", fused_fwd, values),
+                          ("padded_dense", naive_fwd, dense_ids)]:
+    t_f = timeit(fwd, params, ids0, args.vocab, steps=args.steps)
+    t_g = timeit(grad_of(fwd), params, ids0, args.vocab, steps=args.steps)
     sgd = sgd_of(fwd)
+
+    it = [0]
+
+    def sgd_chain(k, p0, sgd=sgd, ids0=ids0, it=it):
+      t0 = time.perf_counter()
+      for _ in range(k):
+        it[0] += 1
+        bump = (p0.ravel()[0] * 0).astype(jnp.int32) + it[0]
+        p0 = sgd(p0, (ids0 + bump) % args.vocab)
+      float(p0.ravel()[0])
+      return time.perf_counter() - t0, p0
+
     p = params + 0  # fresh buffer: sgd donates its input
-    t0 = time.perf_counter()
-    for _ in range(args.steps):
-      p = sgd(p)
-    jax.block_until_ready(p)
-    t_s = (time.perf_counter() - t0) / args.steps * 1000
+    _, p = sgd_chain(args.steps, p)  # warm chain (cold-start discard)
+    d1, p = sgd_chain(args.steps, p)
+    d2, p = sgd_chain(2 * args.steps, p)
+    t_s = max((d2 - d1) / args.steps, 1e-9) * 1000
     rows.append((name, t_f, t_g, t_s))
     print(f"{name:>14}: forward {t_f:8.3f} ms  grad {t_g:8.3f} ms  "
           f"sgd-step {t_s:8.3f} ms")
   speedup = rows[1][3] / rows[0][3]
   print(f"fused vs padded sgd-step speedup: {speedup:.2f}x")
+  print("note: on TPU the padded-dense form IS the fast form (gathers "
+        "run ~10 ns/row regardless of padding waste; XLA's ragged "
+        "segment-sum lowering does not pipeline) — the OPPOSITE of the "
+        "reference's CUDA result, and why the distributed engine "
+        "normalizes ragged inputs into sentinel-padded buckets "
+        "internally (docs/ARCHITECTURE.md). csr_lookup is the "
+        "API-parity/correctness form.")
 
 
 if __name__ == "__main__":
