@@ -3,11 +3,23 @@
 # first import, so these are conveniences).
 
 PY ?= python
+SHELL := /bin/bash  # verify uses pipefail/PIPESTATUS
 
-.PHONY: test test-fast native bench dryrun clean
+.PHONY: test test-fast verify native bench dryrun clean
 
 test:
 	$(PY) -m pytest tests/ -q
+
+# the tier-1 gate, exactly as ROADMAP.md specifies it (CPU mesh, no slow
+# tests, collection errors surfaced but not fatal to the log)
+verify:
+	set -o pipefail; rm -f /tmp/_t1.log; \
+	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
+	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+	  -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; \
+	rc=$${PIPESTATUS[0]}; \
+	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
+	exit $$rc
 
 test-fast:
 	$(PY) -m pytest tests/ -q -x -k "not training and not checkpoint"

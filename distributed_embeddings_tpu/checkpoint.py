@@ -119,7 +119,7 @@ def _plan_fingerprint(plan: DistEmbeddingStrategy) -> Dict[str, Any]:
           int(s.shard.row_sliced)]
          for s in slots]
         for slots in cp.slots_per_rank]
-  return {
+  fp = {
       "world_size": plan.world_size,
       "strategy": plan.strategy,
       "tables": [[c.input_dim, c.output_dim, c.combiner]
@@ -128,6 +128,19 @@ def _plan_fingerprint(plan: DistEmbeddingStrategy) -> Dict[str, Any]:
       "class_names": [class_param_name(*k) for k in plan.class_keys],
       "layout": layout,
   }
+  if getattr(plan, "host_row_threshold", None) is not None \
+      and plan.host_tier_class_keys():
+    # tiering is a placement axis: a checkpoint written under a tiered
+    # plan must not restore under an all-device plan of the same tables
+    # (class generations and storage layout differ). Keyed on tiering
+    # actually being IN EFFECT — a threshold no table crosses leaves the
+    # layout identical to an untiered plan (and pre-tiering checkpoints
+    # keep matching). The threshold knob itself is not pinned, only the
+    # resulting per-class tiers: different knobs with the same outcome
+    # restore fine.
+    fp["class_tiers"] = {class_param_name(*k): plan.class_tiers[k]
+                         for k in plan.class_keys}
+  return fp
 
 
 def _abbrev(v, limit: int = 200) -> str:
@@ -176,7 +189,7 @@ def _rank_blocks_addressable(arr: jax.Array, phys_rows: int):
 
 
 def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
-         state: Dict[str, Any]) -> None:
+         state: Dict[str, Any], store=None) -> None:
   """Write the full fused train state under directory ``path``.
 
   Atomicity: everything is written into ``path + '.tmp'`` and renamed at
@@ -190,9 +203,34 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
   the reference's chunked ``hvd.allgather`` to rank 0,
   `dist_model_parallel.py:574-664`, solves the same problem with
   collectives instead).
+
+  Tiered plans (``tiering/``): pass the run's ``HostTierStore`` as
+  ``store``. Resident rows are flushed from the device caches into the
+  host images first, then each host-tier class is written as per-rank
+  COLD-STORE blocks (``cold_<class>_r<rank>.npy`` — the full packed image,
+  the authoritative state) plus the resident sets and observed counts
+  (``tiering.npz``), so a restore resumes with the same hot set and
+  re-ranking signal. The compact device buffers are NOT saved (they are
+  derived). Single-controller only for now: the flush and the images live
+  on one host.
   """
   engine = DistributedLookup(plan)
-  layouts = engine.fused_layouts(rule)
+  tiered_names = frozenset(store.tplan.tier_specs) if store is not None \
+      else frozenset()
+  if store is None and plan.host_tier_class_keys():
+    raise ValueError(
+        "plan has host-tier classes but no HostTierStore was passed: "
+        "saving only the compact device buffers would drop the cold rows "
+        "(the authoritative majority of the weights). Pass the run's "
+        "store via save(..., store=store).")
+  if store is not None and jax.process_count() > 1:
+    raise NotImplementedError(
+        "tiered checkpoint save under multi-controller: the host images "
+        "live on one host; shard the cold store first (ROADMAP open item)")
+  layouts = engine.fused_layouts(
+      rule, rows_overrides=store.tplan.rows_overrides if store else None)
+  if store is not None:
+    store.flush(state["fused"])
   tmp = path + ".tmp"
   p0 = jax.process_index() == 0
   err: Optional[BaseException] = None
@@ -225,6 +263,8 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
           "processes do not share a filesystem")
     fused_meta = {}
     for name, arr in state["fused"].items():
+      if name in tiered_names:
+        continue  # saved as cold-store images below, not device buffers
       layout = layouts[name]
       if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
         blocks = _rank_blocks_addressable(arr, layout.phys_rows)
@@ -246,6 +286,27 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
           "dtype": str(np.dtype(arr.dtype)),
       }
 
+    tiering_meta = None
+    if store is not None:
+      tiering_meta = {"classes": {}}
+      flat = {}
+      for name in sorted(tiered_names):
+        c = store.tplan.by_name(name)
+        lay = c.layout_logical
+        for rank in range(plan.world_size):
+          np.save(os.path.join(tmp, f"cold_{name}_r{rank}.npy"),
+                  store.images[name][rank])
+          flat[f"{name}/r{rank}/resident_grps"] = \
+              store.resident_grps[name][rank]
+          flat[f"{name}/r{rank}/counts"] = store.counts[name][rank]
+        tiering_meta["classes"][name] = {
+            "cache_grps": c.spec.cache_grps,
+            "staging_grps": c.spec.staging_grps,
+            "phys_rows": lay.phys_rows,
+            "phys_width": lay.phys_width,
+        }
+      np.savez(os.path.join(tmp, "tiering.npz"), **flat)
+
     if p0:
       for part in ("dense", "dense_opt", "emb_dense", "emb_dense_opt"):
         np.savez(os.path.join(tmp, f"{part}.npz"),
@@ -258,6 +319,8 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
           "plan": _plan_fingerprint(plan),
           "fused": fused_meta,
       }
+      if tiering_meta is not None:
+        manifest["tiering"] = tiering_meta
       with open(os.path.join(tmp, "manifest.json"), "w") as f:
         json.dump(manifest, f, indent=1)
     with open(os.path.join(
@@ -307,7 +370,7 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
 def restore(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
             state_like: Dict[str, Any],
             mesh: Optional[Mesh] = None,
-            axis_name: str = "mp") -> Dict[str, Any]:
+            axis_name: str = "mp", store=None) -> Dict[str, Any]:
   """Load a checkpoint written by :func:`save` into a new state dict.
 
   Args:
@@ -323,9 +386,18 @@ def restore(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
       multi-controller, shard them with
       ``jax.experimental.multihost_utils.host_local_array_to_global_array``
       (they are replicated, so every process loads identical values).
+    store: the ``HostTierStore`` to restore a TIERED checkpoint into
+      (required iff the manifest has a tiering section, and its
+      ``TieringPlan`` geometry must match the saving run's — validated
+      below). Cold images, resident sets and observed counts are loaded
+      into it, and the host-tier classes' compact device buffers are
+      rebuilt from the restored resident sets.
   """
   engine = DistributedLookup(plan)
-  layouts = engine.fused_layouts(rule)
+  tiered_names = frozenset(store.tplan.tier_specs) if store is not None \
+      else frozenset()
+  layouts = engine.fused_layouts(
+      rule, rows_overrides=store.tplan.rows_overrides if store else None)
   if mesh is not None and mesh.devices.size != plan.world_size:
     raise ValueError(
         f"mesh has {mesh.devices.size} devices but the plan was built for "
@@ -362,11 +434,47 @@ def restore(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
         "checkpoint plan does not match: re-create the DistEmbeddingStrategy "
         f"with the same tables/world/strategy/slicing (differs in {detail})")
 
+  saved_tiering = manifest.get("tiering", {}).get("classes", {})
+  if set(saved_tiering) != set(tiered_names):
+    raise ValueError(
+        f"checkpoint tiering mismatch: saved host-tier classes "
+        f"{sorted(saved_tiering)}, restoring with {sorted(tiered_names)} — "
+        "pass the matching HostTierStore (tiered checkpoint) or none "
+        "(all-device checkpoint)")
+  for name, meta in saved_tiering.items():
+    c = store.tplan.by_name(name)
+    have = {"cache_grps": c.spec.cache_grps,
+            "staging_grps": c.spec.staging_grps,
+            "phys_rows": c.layout_logical.phys_rows,
+            "phys_width": c.layout_logical.phys_width}
+    if meta != have:
+      raise ValueError(
+          f"checkpoint class {name!r} tier geometry {meta} does not match "
+          f"the current TieringPlan {have}: rebuild the TieringConfig with "
+          "the saving run's budget/cache/staging settings")
+  if store is not None:
+    with np.load(os.path.join(path, "tiering.npz")) as z:
+      for name in sorted(tiered_names):
+        for rank in range(plan.world_size):
+          store.set_image(name, rank, np.load(
+              os.path.join(path, f"cold_{name}_r{rank}.npy")))
+          grps = np.asarray(z[f"{name}/r{rank}/resident_grps"], np.int32)
+          rmap = store.resident_map[name][rank]
+          rmap[:] = -1
+          rmap[grps] = np.arange(grps.shape[0], dtype=np.int32)
+          store.resident_grps[name][rank] = grps
+          store.counts[name][rank] = np.asarray(
+              z[f"{name}/r{rank}/counts"], np.int64)
+
   fused = {}
+  if store is not None:
+    fused.update(store.build_fused(mesh, axis_name))
   for key in plan.class_keys:
     if plan.classes[key].kind != "sparse":
       continue
     name = class_param_name(*key)
+    if name in tiered_names:
+      continue
     layout = layouts[name]
     meta = manifest.get("fused", {}).get(name)
     if meta is not None and (meta["phys_rows"] != layout.phys_rows

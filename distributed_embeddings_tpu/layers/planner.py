@@ -320,11 +320,39 @@ class DistEmbeddingStrategy:
                row_slice_threshold: Optional[int] = None,
                input_hotness: Optional[Sequence[int]] = None,
                batch_hint: Optional[int] = None,
-               gen_assignment: str = "auto"):
+               gen_assignment: str = "auto",
+               host_row_threshold: Optional[int] = None,
+               hbm_budget_bytes: Optional[int] = None):
     if strategy not in ("basic", "memory_balanced", "memory_optimized"):
       raise ValueError(f"Unsupported shard strategy {strategy}")
     self.strategy = "basic" if world_size == 1 else strategy
     self.world_size = world_size
+    # ---- third placement tier: host-offloaded cold storage --------------
+    # Tables with input_dim > host_row_threshold are HOST-tier: their rows
+    # live in host RAM (the cold store) and only a frequency-ranked hot
+    # subset is resident on device, plus a per-step staging buffer for the
+    # batch's cold rows (see distributed_embeddings_tpu/tiering/). The
+    # placement/fusion/routing math is unchanged — tiering is a physical
+    # storage attribute of a class, resolved per class after generation
+    # assignment (host-tier tables get their own generations so small
+    # tables fused in the same width class are not dragged to host).
+    # ``hbm_budget_bytes`` (per device) is the accounting input the
+    # tiering planner sizes hot caches against; recorded here for
+    # tier_capacity_report. It is deliberately NOT in the plan
+    # fingerprint — checkpoints pin the RESULTING per-class cache/staging
+    # geometry (manifest tiering section), so a different budget that
+    # yields the same geometry restores fine.
+    if host_row_threshold is not None:
+      if host_row_threshold <= 0:
+        raise ValueError(
+            f"host_row_threshold must be positive, got {host_row_threshold}")
+      if host_row_threshold <= dense_row_threshold:
+        raise ValueError(
+            f"host_row_threshold ({host_row_threshold}) must exceed "
+            f"dense_row_threshold ({dense_row_threshold}): a table cannot "
+            "be both MXU-dense and host-offloaded")
+    self.host_row_threshold = host_row_threshold
+    self.hbm_budget_bytes = hbm_budget_bytes
     # Tables with input_dim <= dense_row_threshold are served by the MXU
     # one-hot-matmul path (zero indexed row ops, dense autodiff grads)
     # instead of HBM row gathers; 0 disables. On v5e every gathered/scattered
@@ -543,11 +571,16 @@ class DistEmbeddingStrategy:
       for shards in self.rank_shards:
         gen_rows: Dict[tuple, List[int]] = {}
         for sh in shards:
-          base = (sh.width, sh.combiner, self._kind_of(sh))
+          base = (sh.width, sh.combiner, self._kind_of(sh),
+                  self.table_tier(sh.table_id))
           # same plan-time hard error as the auto mode (a generation
           # cannot split a shard, and one shard past the 2^31-element
-          # buffer limit is untrainable regardless of assignment)
-          if sh.input_dim > _rows_hard_noaux(sh.width):
+          # buffer limit is untrainable regardless of assignment) —
+          # except host-tier shards, whose device footprint is the
+          # compact cache+staging buffer (TieringPlan enforces ITS 2^31
+          # bound), not the full vocabulary
+          if (base[3] != "host"
+              and sh.input_dim > _rows_hard_noaux(sh.width)):
             _raise_shard_too_big(sh.table_id, sh.input_dim, sh.width)
           rows_list = gen_rows.setdefault(base, [0])
           cap_rows = max(1, max_class_bytes // (sh.width * 4))
@@ -563,10 +596,33 @@ class DistEmbeddingStrategy:
       for shards in self.rank_shards:
         by_base: Dict[tuple, List] = {}
         for sh in shards:
+          # tier joins the grouping key so host-tier tables never share a
+          # generation with device-tier ones — a class (one physical
+          # buffer) must be uniformly device-resident or host-offloaded
           by_base.setdefault(
-              (sh.width, sh.combiner, self._kind_of(sh)), []).append(sh)
+              (sh.width, sh.combiner, self._kind_of(sh),
+               self.table_tier(sh.table_id)), []).append(sh)
         for base, group in by_base.items():
           self._assign_generations(base[0], group, occ_of)
+
+    if host_row_threshold is not None:
+      # Host-tier generations are renumbered after a GLOBAL offset (max
+      # device-tier gen over every rank, per (width, combiner, kind)):
+      # gens are assigned per rank, and a rank-local offset could give the
+      # same generation number a device shard on one rank and a host
+      # shard on another — one class, two tiers, which the storage split
+      # cannot represent.
+      max_dev_gen: Dict[tuple, int] = {}
+      for shards in self.rank_shards:
+        for sh in shards:
+          if self.table_tier(sh.table_id) == "device":
+            k = (sh.width, sh.combiner, self._kind_of(sh))
+            max_dev_gen[k] = max(max_dev_gen.get(k, -1), sh.gen)
+      for shards in self.rank_shards:
+        for sh in shards:
+          if self.table_tier(sh.table_id) == "host":
+            k = (sh.width, sh.combiner, self._kind_of(sh))
+            sh.gen += max_dev_gen.get(k, -1) + 1
 
     class_keys: List[ClassKey] = []
     for shards in self.rank_shards:
@@ -576,6 +632,22 @@ class DistEmbeddingStrategy:
           class_keys.append(key)
     class_keys.sort(key=lambda k: (k[0], str(k[1]), k[2], k[3]))
     self.class_keys = class_keys
+
+    # Per-class storage tier, derived from member tables (uniform by
+    # construction: host-tier tables have disjoint generations). "device"
+    # = the class buffer is fully HBM-resident (the only tier before this
+    # existed); "host" = rows live in the host cold store with a device
+    # hot cache + staging buffer (tiering/ subsystem).
+    self.class_tiers: Dict[ClassKey, str] = {}
+    for shards in self.rank_shards:
+      for sh in shards:
+        key = self.class_key_of(sh)
+        tier = self.table_tier(sh.table_id)
+        prev = self.class_tiers.setdefault(key, tier)
+        if prev != tier:
+          raise AssertionError(
+              f"class {key} mixes storage tiers ({prev} vs {tier}) — "
+              "generation separation failed; this is a planner bug")
 
     self.classes: Dict[ClassKey, WidthClassPlan] = {
         key: WidthClassPlan(width=key[0], combiner=key[1], kind=key[2],
@@ -700,10 +772,15 @@ class DistEmbeddingStrategy:
     # The plan doesn't know the optimizer yet, so the hard error uses the
     # aux-free bound (illegal for ANY rule); the 1-aux estimate only warns.
     # The exact check (actual n_aux) lives in DistributedLookup.fused_layouts.
-    if largest > _rows_hard_noaux(width):
+    # Host-tier groups are exempt from both: their full image lives in host
+    # RAM and only the compact cache+staging buffer (bounded by
+    # TieringPlan's own 2^31 check) ever occupies a device — training
+    # vocabularies past the device buffer limit is the tier's purpose.
+    host_tier = self.table_tier(group[0].table_id) == "host"
+    if largest > _rows_hard_noaux(width) and not host_tier:
       big = max(group, key=lambda sh: sh.input_dim)
       _raise_shard_too_big(big.table_id, big.input_dim, width)
-    if largest > rows_hard:
+    if largest > rows_hard and not host_tier:
       import warnings
       big = max(group, key=lambda sh: sh.input_dim)
       warnings.warn(
@@ -838,6 +915,55 @@ class DistEmbeddingStrategy:
         assign[id(sh)] = len(bins)
         bins.append([sh.input_dim, -1])
     return assign if assign else None
+
+  def table_tier(self, table_id: int) -> str:
+    """Storage tier of one table: 'host' (cold store + hot cache) or
+    'device' (fully HBM-resident)."""
+    if self.host_row_threshold is None:
+      return "device"
+    return ("host"
+            if self.global_configs[table_id].input_dim
+            > self.host_row_threshold else "device")
+
+  def host_tier_class_keys(self) -> List[ClassKey]:
+    """Class keys whose buffers are host-offloaded (in class_keys order)."""
+    return [k for k in self.class_keys if self.class_tiers[k] == "host"]
+
+  def tier_capacity_report(self, n_aux: int = 1) -> Dict[str, object]:
+    """Per-rank storage accounting by tier.
+
+    Sizes each class's packed buffer under ``n_aux`` interleaved
+    optimizer-state slots (1 = Adagrad-style, the conservative default
+    the generation assignment also uses; dense classes have no aux
+    lanes). Dense-class buffers are estimated at ``max_rows`` — the
+    one-hot window tail padding (``lookup_engine.padded_rows``) adds a
+    little on top for small-vocab classes. Host-tier entries report the
+    COLD STORE footprint; the device side of a host-tier class (hot
+    cache + staging + resident map) is chosen by the tiering planner
+    against ``hbm_budget_bytes`` (`tiering/plan.py`)."""
+    from ..ops.packed_table import PackedLayout
+
+    device = host = 0
+    classes = {}
+    for key in self.class_keys:
+      cp = self.classes[key]
+      if cp.kind == "dense":
+        nbytes = cp.max_rows * cp.width * 4
+      else:
+        lay = PackedLayout(rows=cp.max_rows, width=cp.width, n_aux=n_aux)
+        nbytes = lay.phys_rows * lay.phys_width * 4
+      tier = self.class_tiers[key]
+      classes[key] = {"tier": tier, "bytes_per_rank": nbytes}
+      if tier == "host":
+        host += nbytes
+      else:
+        device += nbytes
+    return {
+        "device_bytes_per_rank": device,
+        "host_bytes_per_rank": host,
+        "hbm_budget_bytes": self.hbm_budget_bytes,
+        "classes": classes,
+    }
 
   def _kind_of(self, shard: Shard) -> str:
     # row shards always take the gather path: the one-hot window trick

@@ -447,6 +447,95 @@ def scatter_add_fused(layout: PackedLayout, buf: jax.Array, ids: jax.Array,
 
 
 # ---------------------------------------------------------------------------
+# Host cold-store blocks (tiering subsystem)
+# ---------------------------------------------------------------------------
+#
+# The host tier stores a class's FULL packed image — same physical layout
+# as the device buffer (physical rows of phys_width lanes, optimizer state
+# interleaved) — as one numpy array per rank in host RAM. Moving rows
+# between tiers is therefore a pure block copy at PHYSICAL-row granularity:
+# no repacking, no lane shuffling, and the staging buffer a step uploads is
+# bit-identical to what a fully device-resident run would have held at
+# those rows. All three helpers operate on physical-row ids (``grp`` in
+# gather/scatter terms), the granularity the hot/cold split classifies at.
+
+
+def host_gather_rows(layout: PackedLayout, store: np.ndarray,
+                     grps: np.ndarray) -> np.ndarray:
+  """Cold-block gather: ``store[grps]`` with bounds validation.
+
+  ``store``: the rank's host image ``[phys_rows, phys_width]``;
+  ``grps``: int physical-row ids (must be unique and in range — the
+  prefetcher dedups before gathering, and a silent clamp here would turn
+  a routing bug into wrong training)."""
+  grps = np.asarray(grps)
+  if grps.size and (grps.min() < 0 or grps.max() >= layout.phys_rows):
+    raise IndexError(
+        f"cold gather out of range: grps in [{grps.min()}, {grps.max()}] "
+        f"for a {layout.phys_rows}-physical-row store")
+  if store.shape != (layout.phys_rows, layout.phys_width):
+    raise ValueError(
+        f"host store shape {store.shape} does not match layout "
+        f"{(layout.phys_rows, layout.phys_width)}")
+  return np.ascontiguousarray(store[grps])
+
+
+def host_scatter_rows(layout: PackedLayout, store: np.ndarray,
+                      grps: np.ndarray, rows: np.ndarray) -> None:
+  """Cold-block write-back: ``store[grps] = rows`` in place.
+
+  Overwrite (not add) semantics: the device staging region accumulated
+  every occurrence's scatter-add delta during the step, so its rows ARE
+  the new authoritative values. ``grps`` must be unique — duplicate ids
+  would make the result depend on numpy's assignment order."""
+  grps = np.asarray(grps)
+  if grps.size and (grps.min() < 0 or grps.max() >= layout.phys_rows):
+    raise IndexError(
+        f"cold scatter out of range: grps in [{grps.min()}, {grps.max()}] "
+        f"for a {layout.phys_rows}-physical-row store")
+  if rows.shape != (grps.shape[0], layout.phys_width):
+    raise ValueError(
+        f"cold scatter rows shape {rows.shape}, expected "
+        f"{(grps.shape[0], layout.phys_width)}")
+  store[grps] = rows
+
+
+def init_host_store(layout: PackedLayout, rng: np.random.Generator,
+                    scale_rows: np.ndarray, aux_values: Sequence[float],
+                    dtype=np.float32) -> np.ndarray:
+  """Build one rank's host image directly in the packed physical layout.
+
+  Host-RAM counterpart of :func:`init_packed_uniform`: table lanes get
+  ``uniform(-1, 1) * scale_rows[row]``, aux lanes their init constants
+  (zeroed on dead rows, ``scale_rows == 0``), lane padding zero. numpy
+  RNG (not jax.random) — the host tier exists precisely for tables too
+  big to materialize on device, so the draw must not stage anything
+  there. Not bit-identical to init_packed_uniform's draws; for parity
+  with a device-initialized run, pack that run's initial table instead.
+  """
+  rpp, stride, w = layout.rows_per_phys, layout.stride, layout.width
+  scale_rows = np.asarray(scale_rows, dtype)
+  if scale_rows.shape != (layout.rows,):
+    raise ValueError(
+        f"scale_rows shape {scale_rows.shape}, expected ({layout.rows},)")
+  store = np.zeros((layout.phys_rows, layout.phys_width), dtype)
+  scale_p = np.zeros((layout.phys_rows * rpp,), dtype)
+  scale_p[:layout.rows] = scale_rows
+  # draw per logical row, place into the interleaved lane windows
+  vals = rng.uniform(-1.0, 1.0,
+                     (layout.phys_rows * rpp, w)).astype(dtype)
+  vals *= scale_p[:, None]
+  live = scale_p > 0
+  for j in range(rpp):
+    lo = j * stride
+    store[:, lo:lo + w] = vals[j::rpp]
+    for s, v in enumerate(aux_values):
+      store[:, lo + (1 + s) * w:lo + (2 + s) * w] = np.where(
+          live[j::rpp, None], dtype(v) if np.isscalar(v) else v, 0)
+  return store
+
+
+# ---------------------------------------------------------------------------
 # Sparse update rules (fused-delta form)
 # ---------------------------------------------------------------------------
 

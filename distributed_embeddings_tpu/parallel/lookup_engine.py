@@ -352,6 +352,65 @@ _onehot_window_matmul.defvjp(_onehot_window_matmul_fwd,
                              _onehot_window_matmul_bwd)
 
 
+# Staged-id padding sentinel for the tiering searchsorted: larger than any
+# physical row id (buffers are bounded by 2^31 ELEMENTS of >= 128 lanes, so
+# phys rows stay far below int32 max), keeps padded staging slots sorting
+# after every real id and matching nothing.
+TIER_PAD_GRP = np.int32(2 ** 31 - 1)
+
+
+@dataclasses.dataclass(frozen=True)
+class TierSpec:
+  """Device-side geometry of one host-tiered class (per rank).
+
+  The compact device buffer is ``[(cache_grps + staging_grps) * ...phys]``:
+  physical rows ``[0, cache_grps)`` hold the frequency-ranked resident hot
+  set, rows ``[cache_grps, cache_grps + staging_grps)`` are the per-step
+  staging region for the batch's cold rows. ``rows``/``rpp`` describe the
+  LOGICAL vocabulary the routing tensors address."""
+
+  name: str
+  rows: int          # logical rows (sentinel base; = padded_rows(plan, key))
+  rpp: int           # logical rows per physical row (layout.rows_per_phys)
+  cache_grps: int    # resident physical rows per rank
+  staging_grps: int  # persistent staging physical rows per rank
+
+  @property
+  def compact_rows(self) -> int:
+    """Logical row capacity of the persistent compact buffer."""
+    return (self.cache_grps + self.staging_grps) * self.rpp
+
+
+def _translate_tier(ids: jax.Array, spec: TierSpec, sentinel: int,
+                    resident_local: jax.Array, staged_local: jax.Array):
+  """One routing tensor's logical ids -> compact ids + hit counters.
+
+  ``resident_local``: [phys_rows] int32, cache physical row or -1;
+  ``staged_local``: [S] sorted staged physical-row ids (TIER_PAD_GRP
+  padding). Valid ids resolve hot -> cache slot, cold-staged -> staging
+  slot; anything else (including the routing sentinel) maps to
+  ``sentinel`` — an OOB id the gather zero-fills and the scatter drops."""
+  valid = (ids >= 0) & (ids < spec.rows)
+  safe = jnp.where(valid, ids, 0)
+  grp = safe // spec.rpp
+  sub = safe % spec.rpp
+  cache_slot = jnp.take(resident_local, grp, axis=0, mode="clip")
+  s = staged_local.shape[0]
+  pos = jnp.clip(
+      jnp.searchsorted(staged_local, grp).astype(jnp.int32), 0, max(s - 1, 0))
+  staged_hit = (jnp.take(staged_local, pos, mode="clip") == grp) if s else \
+      jnp.zeros(grp.shape, bool)
+  slot = jnp.where(cache_slot >= 0, cache_slot,
+                   jnp.where(staged_hit, spec.cache_grps + pos, -1))
+  translated = jnp.where(valid & (slot >= 0), slot * spec.rpp + sub,
+                         sentinel).astype(ids.dtype)
+  hot = jnp.sum((valid & (cache_slot >= 0)).astype(jnp.int32))
+  staged = jnp.sum((valid & (cache_slot < 0) & staged_hit).astype(jnp.int32))
+  missed = jnp.sum((valid & (slot < 0)).astype(jnp.int32))
+  total = jnp.sum(valid.astype(jnp.int32))
+  return translated, jnp.stack([hot, staged, missed, total])
+
+
 class DistributedLookup:
   """Functional lookup engine bound to one :class:`DistEmbeddingStrategy`.
 
@@ -409,23 +468,37 @@ class DistributedLookup:
           self.plan.world_size * padded_rows(self.plan, key), cp.width)
     return shapes
 
-  def fused_layouts(self, rule: SparseRule) -> Dict[str, PackedLayout]:
-    """Per sparse-class :class:`PackedLayout` under ``rule`` (n_aux slots)."""
+  def fused_layouts(self, rule: SparseRule,
+                    rows_overrides: Optional[Dict[str, int]] = None
+                    ) -> Dict[str, PackedLayout]:
+    """Per sparse-class :class:`PackedLayout` under ``rule`` (n_aux slots).
+
+    ``rows_overrides`` (class name -> logical rows) substitutes a
+    COMPACT row count for host-tiered classes: their device buffer holds
+    only the hot cache + staging region (`tiering/`), so the 2^31-element
+    indexing bound applies to the compact size, not the logical
+    vocabulary — which is exactly what lets a table bigger than any
+    device buffer train at all."""
     layouts = {}
     for key in self.plan.class_keys:
       cp = self.plan.classes[key]
       if cp.kind != "sparse":
         continue
-      layout = PackedLayout(
-          rows=padded_rows(self.plan, key), width=cp.width, n_aux=rule.n_aux)
+      name = class_param_name(*key)
+      rows = padded_rows(self.plan, key)
+      if rows_overrides and name in rows_overrides:
+        rows = rows_overrides[name]
+      layout = PackedLayout(rows=rows, width=cp.width, n_aux=rule.n_aux)
       if layout.phys_rows * layout.phys_width > 2 ** 31:
         raise ValueError(
-            f"class {class_param_name(*key)}: per-rank packed buffer "
+            f"class {name}: per-rank packed buffer "
             f"[{layout.phys_rows:,} x {layout.phys_width}] exceeds XLA's "
             f"2^31-element indexing under rule {rule.name!r} "
             f"(n_aux={rule.n_aux}). Shard finer (more workers, smaller "
-            "row/column slice thresholds, or a smaller max_class_bytes).")
-      layouts[class_param_name(*key)] = layout
+            "row/column slice thresholds, or a smaller max_class_bytes)"
+            + ("" if rows_overrides and name in rows_overrides else
+               ", or host-offload the class (host_row_threshold)") + ".")
+      layouts[name] = layout
     return layouts
 
   # ---- dp-side routing ---------------------------------------------------
@@ -1366,6 +1439,112 @@ class DistributedLookup:
                   prefer_pallas=cn / max(1, layout.phys_rows) < 0.15)
       new_params[name] = buf
     return new_params
+
+  # ---- tiered storage: hot/cold routing + staging buffers ----------------
+  def translate_tiered_ids(self, ids_all: Dict[tuple, jax.Array],
+                           tier_specs: Dict[str, "TierSpec"],
+                           resident: Dict[str, jax.Array],
+                           staged_grps: Dict[str, jax.Array]):
+    """Rewrite routed LOGICAL ids of host-tiered classes to compact
+    device-buffer ids (hot-cache slot or staging slot).
+
+    The routing tensors stay in the logical vocabulary (so routing,
+    bucketing, sentinel and mean-count semantics are untouched); this
+    pass — run after :meth:`route_ids`, before the fused gather — maps
+    each valid id's physical row through the rank's resident map (cold
+    rows: a searchsorted over this step's sorted staged row ids) and
+    rebuilds the id at the compact slot, preserving the sub-row index so
+    gather/scatter arithmetic is unchanged. Ids in neither tier (a
+    prefetch contract violation) map to the sentinel — counted in the
+    returned metrics, never silently applied wrong.
+
+    Args:
+      tier_specs: class name -> :class:`TierSpec`.
+      resident: class name -> [phys_rows] int32 per-rank map (cache slot
+        or -1), the local block of a ``[world * phys_rows]`` array.
+      staged_grps: class name -> [S] int32 per-rank SORTED staged
+        physical-row ids, padded with ``TIER_PAD_GRP``.
+
+    Returns:
+      ``(ids_out, metrics)``: the translated routing dict, and per class
+      name an int32 ``[4]`` vector ``[hot_hits, staged_hits, missed,
+      valid_total]`` of this rank's occurrence counts.
+    """
+    out: Dict[tuple, jax.Array] = {}
+    metrics: Dict[str, jax.Array] = {}
+    for bk, ids in ids_all.items():
+      name = class_param_name(*bk.class_key)
+      spec = tier_specs.get(name)
+      if spec is None:
+        out[bk] = ids
+        continue
+      sentinel = padded_rows(self.plan, bk.class_key)
+      if isinstance(ids, tuple):  # ragged value stream (vals, lens)
+        vals, lens = ids
+        tv, m = _translate_tier(vals, spec, sentinel, resident[name],
+                                staged_grps[name])
+        out[bk] = (tv, lens)
+      else:
+        out[bk], m = _translate_tier(ids, spec, sentinel, resident[name],
+                                     staged_grps[name])
+      metrics[name] = metrics[name] + m if name in metrics else m
+    return out, metrics
+
+  def install_staging(self, fused_params: Dict[str, jax.Array],
+                      tier_specs: Dict[str, "TierSpec"],
+                      staged_rows: Dict[str, jax.Array]
+                      ) -> Dict[str, jax.Array]:
+    """Write this step's staged cold rows into each tiered buffer's
+    staging region (physical rows ``[cache_grps, cache_grps + S)``).
+
+    A dynamic-update-slice on the donated buffer — in place under XLA
+    aliasing, so the persistent compact buffer doubles as the staging
+    target and the one-scatter-add backward covers both tiers. ``S`` may
+    exceed ``spec.staging_grps`` on spill steps (the step retraces; the
+    effective :class:`PackedLayout` must be built from the same S)."""
+    out = dict(fused_params)
+    for name, spec in tier_specs.items():
+      rows = staged_rows[name]
+      buf = self._squeeze_local(fused_params[name])
+      need = spec.cache_grps + rows.shape[0]
+      if need > buf.shape[0]:
+        # spill step: extend the buffer past its persistent staging
+        # region (a copy — bounded by the spill being rare; the trailing
+        # region is sliced back off by staged_regions)
+        buf = jnp.concatenate(
+            [buf, jnp.zeros((need - buf.shape[0], buf.shape[1]),
+                            buf.dtype)])
+      out[name] = jax.lax.dynamic_update_slice(
+          buf, rows.astype(buf.dtype), (spec.cache_grps, 0))
+    return out
+
+  def staged_regions(self, fused_params: Dict[str, jax.Array],
+                     tier_specs: Dict[str, "TierSpec"],
+                     staged_rows: Dict[str, jax.Array]
+                     ) -> Dict[str, jax.Array]:
+    """Slice the (post-scatter) staging regions back out, sized to this
+    step's staged row count — the rows the host writes back to the cold
+    store."""
+    out = {}
+    for name, spec in tier_specs.items():
+      s = staged_rows[name].shape[0]
+      buf = self._squeeze_local(fused_params[name])
+      out[name] = jax.lax.dynamic_slice(
+          buf, (spec.cache_grps, 0), (s, buf.shape[1]))
+    return out
+
+  def trim_spill(self, fused_params: Dict[str, jax.Array],
+                 tier_specs: Dict[str, "TierSpec"]
+                 ) -> Dict[str, jax.Array]:
+    """Restore each tiered buffer to its persistent compact shape after a
+    spill step extended it (no-op slices are free)."""
+    out = dict(fused_params)
+    for name, spec in tier_specs.items():
+      buf = self._squeeze_local(fused_params[name])
+      keep = spec.cache_grps + spec.staging_grps
+      if buf.shape[0] > keep:
+        out[name] = buf[:keep]
+    return out
 
   # ---- model-parallel input mode -----------------------------------------
   def forward_mp(self, class_params: Dict[str, jax.Array],

@@ -1,0 +1,50 @@
+"""Tiered embedding storage: host-offloaded cold rows + device hot cache.
+
+The paper's premise is embedding tables that exceed one accelerator's
+memory; the reference answers only with more accelerators. Production ads
+stacks (PAPERS.md: "Scalable Machine Learning Training Infrastructure for
+Online Ads Recommendation") instead exploit the extreme skew of
+recommender id traffic with a storage hierarchy. This subsystem adds that
+hierarchy as a third placement tier:
+
+- the planner marks classes of tables above ``host_row_threshold`` as
+  host-tier (`layers/planner.py`);
+- each host-tier class keeps its FULL packed image (table rows with
+  interleaved optimizer-state lanes) in host RAM (:class:`HostTierStore`),
+  while the device holds a compact buffer: a frequency-ranked hot cache
+  plus a fixed staging region (:class:`TieringPlan` sizes both against an
+  HBM budget);
+- per step, a prefetcher dedups the batch's ids, classifies hot/cold,
+  host-gathers the cold rows and uploads them into the staging region
+  (:class:`TieredPrefetcher`); routed ids are translated to compact slots
+  inside the jitted step (`parallel/lookup_engine.translate_tiered_ids`),
+  so the fused gather and the one-scatter-add backward of
+  ``make_sparse_train_step`` cover both tiers unchanged;
+- after the step, updated staging rows are written back to the host
+  image; periodically the resident set is re-ranked by observed counts
+  (promotion/eviction);
+- staging overflow spills deterministically into a power-of-two-bucketed
+  larger staging upload (a second host gather) — updates are never
+  dropped.
+"""
+
+from .plan import TieringConfig, TieringPlan
+from .prefetch import TieredPrefetcher
+from .store import HostTierStore
+from .train import (
+    TieredTrainer,
+    init_tiered_state,
+    init_tiered_state_from_params,
+    unpack_tiered_state,
+)
+
+__all__ = [
+    "TieringConfig",
+    "TieringPlan",
+    "TieredPrefetcher",
+    "HostTierStore",
+    "TieredTrainer",
+    "init_tiered_state",
+    "init_tiered_state_from_params",
+    "unpack_tiered_state",
+]

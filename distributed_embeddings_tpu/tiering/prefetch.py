@@ -1,0 +1,274 @@
+"""Prefetch stage for tiered classes: classify, stage, write back, re-rank.
+
+Runs AHEAD of the jitted train step, host-side. Per step:
+
+1. **classify**: replicate the engine's routing arithmetic
+   (`lookup_engine._build_routing`) in numpy over the global batch to get,
+   per (host-tier class, rank), the deduped requested physical rows, and
+   split them hot/cold against the resident map. Also accumulates the
+   per-row observed counts that drive re-ranking.
+2. **stage**: host-gather the cold rows (with their interleaved
+   optimizer-state lanes) from the class image and ``jax.device_put`` them
+   as this step's staging upload — sorted ids + row block, padded to the
+   staging size. A batch whose deduped cold rows overflow the base region
+   spills deterministically into the next power-of-two bucket (a larger
+   second host gather; the step retraces once per new bucket size and
+   never drops an update).
+3. **write_back**: after the step, fetch the post-scatter staging region
+   and overwrite the staged rows in the host image (they are the new
+   authoritative values).
+4. **rerank** (periodic): promote the highest-count rows into the cache
+   and evict the lowest — value-preserving swaps through the image, then
+   refresh the device resident maps.
+
+The classify step is independent of the previous step's results, so a
+trainer can run it on a worker thread while the device computes
+(`train.TieredTrainer`); the stage gather must wait for the previous
+write-back (a row staged twice in a row needs its updated value).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.packed_table import host_gather_rows, host_scatter_rows
+from ..ops.ragged import RaggedIds
+from ..parallel.lookup_engine import TIER_PAD_GRP
+from .plan import TieringPlan
+from .store import HostTierStore
+
+
+@dataclasses.dataclass
+class StagedBatch:
+  """One step's staging upload + the host-side info to write it back."""
+
+  device: dict                       # step input: {"grps", "rows", "resident"}
+  cold: Dict[str, List[np.ndarray]]  # per class, per rank: staged row ids
+  s_eff: Dict[str, int]              # per class: padded staging size
+  host_gather_bytes: int
+  spilled: bool
+
+
+class TieredPrefetcher:
+  """Host-side prefetch pipeline bound to one plan + store."""
+
+  def __init__(self, tplan: TieringPlan, store: HostTierStore,
+               mesh=None, axis_name: str = "mp"):
+    self.tplan = tplan
+    self.store = store
+    self.plan = tplan.plan
+    self.mesh = mesh
+    self.axis_name = axis_name
+    # routing recipe: class key -> per rank -> [(input_id, row_offset,
+    # row_start, shard_rows, vocab, row_sliced)]
+    self._recipe: Dict[tuple, List[list]] = {}
+    for key in tplan.classes:
+      cp = self.plan.classes[key]
+      per_rank = []
+      for rank in range(self.plan.world_size):
+        slots = []
+        for slot in cp.slots_per_rank[rank]:
+          sh = slot.shard
+          vocab = self.plan.global_configs[sh.table_id].input_dim
+          slots.append((slot.input_id, slot.row_offset, sh.row_start,
+                        sh.input_dim, vocab, sh.row_sliced))
+        per_rank.append(slots)
+      self._recipe[key] = per_rank
+    self._resident_dev = store.resident_arrays(mesh, axis_name)
+    self.steps_since_rerank = 0
+    self.total_host_gather_bytes = 0
+    self.spill_steps = 0
+
+  # ---- classification ----------------------------------------------------
+  @staticmethod
+  def _input_ids_np(x) -> np.ndarray:
+    if isinstance(x, RaggedIds):
+      raise NotImplementedError(
+          "tiered prefetch of RaggedIds inputs: classify over the value "
+          "stream is not wired up yet — pad to dense multi-hot "
+          "(ragged_to_padded) for host-tiered tables")
+    return np.asarray(x).reshape(-1)
+
+  def classify(self, cats: Sequence) -> Dict[str, List[np.ndarray]]:
+    """Global batch -> per class name, per rank, the deduped COLD
+    physical rows; updates the observed counts (occurrences, not dedup
+    presence — re-ranking should weight by traffic)."""
+    cold: Dict[str, List[np.ndarray]] = {}
+    for key, c in self.tplan.classes.items():
+      rpp = c.spec.rpp
+      per_rank = []
+      for rank in range(self.plan.world_size):
+        routed_all = []
+        for (input_id, off, row_start, rows, vocab,
+             rs) in self._recipe[key][rank]:
+          ids = self._input_ids_np(cats[input_id])
+          if rs:
+            clamped = np.clip(ids, 0, vocab - 1)
+            m = (ids >= 0) & (clamped >= row_start) \
+                & (clamped < row_start + rows)
+            routed = clamped[m] - row_start + off
+          else:
+            m = ids >= 0
+            routed = np.clip(ids[m], 0, rows - 1) + off
+          routed_all.append((routed // rpp).astype(np.int64))
+        grps_occ = (np.concatenate(routed_all) if routed_all
+                    else np.zeros((0,), np.int64))
+        # one sort serves both outputs: dedup for the hot/cold split and
+        # occurrence counts for re-ranking (np.add.at over the raw stream
+        # is ~10x slower, and this stage must stay ahead of the device)
+        req, occ = np.unique(grps_occ, return_counts=True)
+        req = req.astype(np.int32)
+        self.store.counts[c.name][rank][req] += occ
+        rmap = self.store.resident_map[c.name][rank]
+        per_rank.append(req[rmap[req] < 0])
+      cold[c.name] = per_rank
+    return cold
+
+  # ---- staging -----------------------------------------------------------
+  def _bucket(self, c, n: int) -> int:
+    """Padded staging size for ``n`` deduped cold rows: the base region,
+    or on overflow the next power-of-two multiple up to
+    ``spill_factor_max``; demand past that pads to exactly ``n`` (no
+    bucket rounding — never-drop beats retrace economy there). Clamped
+    to the hard cap so compact ids stay under the sentinel."""
+    base = c.spec.staging_grps
+    fmax = self.tplan.config.spill_factor_max
+    if n <= base:
+      return base
+    factor = 1
+    while base * factor < n and factor < fmax:
+      factor = min(factor * 2, fmax)
+    s = min(max(base * factor, n), c.spill_cap_grps)
+    if n > s:
+      raise ValueError(
+          f"class {c.name}: batch touches {n:,} distinct cold physical "
+          f"rows but at most {s:,} can stage (cache {c.spec.cache_grps:,}"
+          f" of {c.layout_logical.phys_rows:,} rows). This batch covers "
+          "nearly the whole table — tiering cannot serve it; raise "
+          "host_row_threshold or enlarge the cache/staging budget.")
+    return s
+
+  def stage(self, cold: Dict[str, List[np.ndarray]]) -> StagedBatch:
+    """Host-gather the cold rows and upload the staging inputs."""
+    grps_dev, rows_dev, s_eff = {}, {}, {}
+    nbytes = 0
+    spilled = False
+    for c in self.tplan.classes.values():
+      per_rank_cold = cold[c.name]
+      lay = c.layout_logical
+      s = max(self._bucket(c, len(g)) for g in per_rank_cold)
+      spilled |= s > c.spec.staging_grps
+      g_blocks, r_blocks = [], []
+      for rank, g in enumerate(per_rank_cold):
+        pad = s - g.shape[0]
+        g_blocks.append(np.concatenate(
+            [g, np.full((pad,), TIER_PAD_GRP, np.int32)]))
+        rows = host_gather_rows(lay, self.store.images[c.name][rank], g)
+        nbytes += rows.nbytes
+        r_blocks.append(np.concatenate(
+            [rows, np.zeros((pad, lay.phys_width), np.float32)]))
+      grps_dev[c.name] = self.store._put(
+          np.concatenate(g_blocks), self.mesh, self.axis_name)
+      rows_dev[c.name] = self.store._put(
+          np.concatenate(r_blocks), self.mesh, self.axis_name)
+      s_eff[c.name] = s
+    self.total_host_gather_bytes += nbytes
+    self.spill_steps += int(spilled)
+    return StagedBatch(
+        device={"grps": grps_dev, "rows": rows_dev,
+                "resident": self._resident_dev},
+        cold=cold, s_eff=s_eff, host_gather_bytes=nbytes, spilled=spilled)
+
+  def prepare(self, cats: Sequence) -> StagedBatch:
+    """classify + stage in one call (the synchronous path)."""
+    return self.stage(self.classify(cats))
+
+  # ---- write-back --------------------------------------------------------
+  def write_back(self, staged: StagedBatch,
+                 staged_out: Dict[str, jax.Array]) -> None:
+    """Overwrite the staged rows in the host images with the
+    post-scatter device values."""
+    for c in self.tplan.classes.values():
+      s = staged.s_eff[c.name]
+      out_np = np.asarray(staged_out[c.name])
+      for rank, g in enumerate(staged.cold[c.name]):
+        if not g.shape[0]:
+          continue
+        host_scatter_rows(c.layout_logical, self.store.images[c.name][rank],
+                          g, out_np[rank * s:rank * s + g.shape[0]])
+
+  # ---- promotion / eviction ----------------------------------------------
+  def maybe_rerank(self, fused: Dict[str, jax.Array], decay: bool = True
+                   ) -> Dict[str, jax.Array]:
+    """Re-rank the resident set by observed counts when the configured
+    interval elapsed; otherwise a no-op. Returns the (possibly updated)
+    fused buffers."""
+    interval = self.tplan.config.rerank_interval
+    self.steps_since_rerank += 1
+    if not interval or self.steps_since_rerank < interval:
+      return fused
+    self.steps_since_rerank = 0
+    return self.rerank(fused, decay=decay)
+
+  def rerank(self, fused: Dict[str, jax.Array], decay: bool = True
+             ) -> Dict[str, jax.Array]:
+    """Promote the top-count rows into the cache, evicting the rest.
+
+    Value-preserving: evicted rows' device values go to the image, the
+    promoted rows' image values go to the vacated cache slots, and the
+    resident maps (host + device) are refreshed. ``decay`` halves the
+    counts afterward so the ranking tracks traffic drift instead of
+    accumulating forever."""
+    fused = dict(fused)
+    for c in self.tplan.classes.values():
+      spec, lay = c.spec, c.layout_logical
+      per = spec.cache_grps + spec.staging_grps
+      name = c.name
+      all_idx, all_rows = [], []
+      for rank in range(self.plan.world_size):
+        counts = self.store.counts[name][rank]
+        # top-K by count desc, ties broken row-id asc — O(n) partition
+        # instead of a full lexsort (counts spans the whole vocabulary):
+        # rows above the K-th count are in outright, rows AT it fill the
+        # remainder lowest-id-first (np.where returns ascending ids)
+        k = spec.cache_grps
+        cand = np.argpartition(-counts, k - 1)[:k]
+        cstar = counts[cand].min()
+        sure = np.where(counts > cstar)[0]
+        ties = np.where(counts == cstar)[0][:k - sure.shape[0]]
+        top = np.sort(np.concatenate([sure, ties]).astype(np.int32))
+        current = self.store.resident_grps[name][rank]
+        leaving_mask = ~np.isin(current, top)
+        entering = np.setdiff1d(top, current)
+        slots = np.where(leaving_mask)[0].astype(np.int32)
+        k = min(slots.shape[0], entering.shape[0])
+        if not k:
+          continue
+        slots, entering = slots[:k], entering[:k]
+        gidx = rank * per + slots
+        # evict: device values -> image
+        host_scatter_rows(lay, self.store.images[name][rank],
+                          current[slots], np.asarray(fused[name][gidx]))
+        # promote: image values -> vacated slots
+        all_idx.append(gidx)
+        all_rows.append(host_gather_rows(
+            lay, self.store.images[name][rank], entering))
+        rmap = self.store.resident_map[name][rank]
+        rmap[current[slots]] = -1
+        rmap[entering] = slots
+        current[slots] = entering
+      if all_idx:
+        idx = jnp.asarray(np.concatenate(all_idx))
+        rows = jnp.asarray(np.concatenate(all_rows))
+        fused[name] = fused[name].at[idx].set(rows)
+      if decay:
+        for rank in range(self.plan.world_size):
+          self.store.counts[name][rank] >>= 1
+    self._resident_dev = self.store.resident_arrays(self.mesh,
+                                                    self.axis_name)
+    return fused

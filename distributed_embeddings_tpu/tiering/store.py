@@ -1,0 +1,164 @@
+"""Host-RAM cold store for tiered classes + resident-set bookkeeping.
+
+One :class:`HostTierStore` holds, per host-tier class and per rank:
+
+- ``images``: the FULL packed class image ``[phys_rows, phys_width]`` in
+  host memory — same physical layout as a device buffer (optimizer-state
+  lanes interleaved), so tier moves are pure block copies
+  (`ops/packed_table.host_gather_rows` / ``host_scatter_rows``);
+- ``resident_map``: int32 ``[phys_rows]``, the physical row's hot-cache
+  slot or -1 (host mirror of the device-side translation map);
+- ``resident_grps``: int32 ``[cache_grps]``, the inverse map (slot ->
+  physical row);
+- ``counts``: int64 ``[phys_rows]`` observed lookup counts, the
+  re-ranking signal.
+
+Authority convention: rows resident in the device cache have their
+authoritative values ON DEVICE (the image copy goes stale between
+flushes); cold rows are authoritative in the image (the prefetcher writes
+staged rows back every step). ``flush`` reconciles before checkpointing.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.packed_table import host_scatter_rows, init_host_store
+from .plan import TieringPlan
+
+
+class HostTierStore:
+  """Cold-store images + resident-set state for one :class:`TieringPlan`."""
+
+  def __init__(self, tplan: TieringPlan):
+    self.tplan = tplan
+    self.plan = tplan.plan
+    world = self.plan.world_size
+    self.images: Dict[str, List[np.ndarray]] = {}
+    self.resident_map: Dict[str, List[np.ndarray]] = {}
+    self.resident_grps: Dict[str, List[np.ndarray]] = {}
+    self.counts: Dict[str, List[np.ndarray]] = {}
+    for c in tplan.classes.values():
+      lay = c.layout_logical
+      self.images[c.name] = [
+          np.zeros((lay.phys_rows, lay.phys_width), np.float32)
+          for _ in range(world)]
+      self.resident_map[c.name] = [
+          np.full((lay.phys_rows,), -1, np.int32) for _ in range(world)]
+      self.resident_grps[c.name] = [
+          np.zeros((c.spec.cache_grps,), np.int32) for _ in range(world)]
+      self.counts[c.name] = [
+          np.zeros((lay.phys_rows,), np.int64) for _ in range(world)]
+    self.warm_start()
+
+  # ---- initialization ----------------------------------------------------
+  def _scale_rows(self, key, rank) -> np.ndarray:
+    """Per-logical-row uniform-init scale for one rank's class block
+    (numpy materialization of ``training.init_scale_spans``)."""
+    from ..training import init_scale_spans
+
+    lay = self.tplan.classes[key].layout_logical
+    scale = np.zeros((lay.rows,), np.float32)
+    for off, n, s in init_scale_spans(self.plan, key, rank):
+      scale[off:off + n] = s
+    return scale
+
+  def init_uniform(self, seed: int = 0) -> None:
+    """Draw every image in place (host RAM only; nothing touches a
+    device). Deterministic in ``seed``/class/rank."""
+    for ki, (key, c) in enumerate(sorted(
+        self.tplan.classes.items(), key=lambda kv: kv[1].name)):
+      for rank in range(self.plan.world_size):
+        rng = np.random.default_rng((seed, ki, rank))
+        self.images[c.name][rank] = init_host_store(
+            c.layout_logical, rng, self._scale_rows(key, rank),
+            self.tplan.rule.aux_init)
+
+  def set_image(self, name: str, rank: int, image: np.ndarray) -> None:
+    """Install an explicit packed image (e.g. packed from a reference
+    run's initial table, or a checkpoint block)."""
+    lay = self.tplan.by_name(name).layout_logical
+    if image.shape != (lay.phys_rows, lay.phys_width):
+      raise ValueError(f"image shape {image.shape}, expected "
+                       f"{(lay.phys_rows, lay.phys_width)}")
+    self.images[name][rank] = np.asarray(image, np.float32).copy()
+
+  def warm_start(self, ranking: Optional[Dict[str, List[np.ndarray]]] = None
+                 ) -> None:
+    """Choose the initial resident set.
+
+    ``ranking[name][rank]``: physical rows in descending priority (e.g.
+    restored counts, or profiled hotness). Default: the lowest row ids —
+    for the id-sorted-by-frequency vocabularies recommender pipelines
+    emit (and the synthetic power-law streams), that IS the hot set; the
+    periodic re-rank repairs any other distribution."""
+    for name, maps in self.resident_map.items():
+      cache = self.tplan.by_name(name).spec.cache_grps
+      for rank in range(self.plan.world_size):
+        if ranking is not None and name in ranking:
+          grps = np.asarray(ranking[name][rank][:cache], np.int32)
+          if grps.shape[0] < cache:
+            # fill the remaining slots with the lowest unranked rows
+            rest = np.setdiff1d(
+                np.arange(maps[rank].shape[0], dtype=np.int32), grps,
+                assume_unique=False)[:cache - grps.shape[0]]
+            grps = np.concatenate([grps, rest])
+        else:
+          grps = np.arange(cache, dtype=np.int32)
+        maps[rank][:] = -1
+        maps[rank][grps] = np.arange(cache, dtype=np.int32)
+        self.resident_grps[name][rank] = grps.copy()
+
+  # ---- device-state construction ----------------------------------------
+  def _put(self, arr: np.ndarray, mesh, axis_name: str):
+    if mesh is None:
+      return jnp.asarray(arr)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    spec = P(axis_name) if arr.ndim == 1 else P(axis_name, None)
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+  def build_fused(self, mesh=None, axis_name: str = "mp"
+                  ) -> Dict[str, jax.Array]:
+    """Compact device buffers ``[world * (cache + staging), phys_width]``:
+    cache rows gathered from the images at the resident set, staging
+    region zeroed."""
+    out = {}
+    for name, c in ((c.name, c) for c in self.tplan.classes.values()):
+      spec = c.spec
+      blocks = []
+      for rank in range(self.plan.world_size):
+        cache_rows = self.images[name][rank][self.resident_grps[name][rank]]
+        blocks.append(np.concatenate([
+            cache_rows,
+            np.zeros((spec.staging_grps, c.layout_logical.phys_width),
+                     np.float32)]))
+      out[name] = self._put(np.concatenate(blocks), mesh, axis_name)
+    return out
+
+  def resident_arrays(self, mesh=None, axis_name: str = "mp"
+                      ) -> Dict[str, jax.Array]:
+    """Device translation maps ``[world * phys_rows]`` int32."""
+    return {name: self._put(np.concatenate(maps), mesh, axis_name)
+            for name, maps in self.resident_map.items()}
+
+  # ---- device -> host reconciliation -------------------------------------
+  def _rank_cache_rows(self, fused: Dict[str, jax.Array], name: str,
+                       rank: int) -> np.ndarray:
+    spec = self.tplan.by_name(name).spec
+    per = spec.cache_grps + spec.staging_grps
+    return np.asarray(fused[name][rank * per:rank * per + spec.cache_grps])
+
+  def flush(self, fused: Dict[str, jax.Array]) -> None:
+    """Copy every resident row's device value back into the host image
+    (cold rows are already authoritative there) — call before
+    checkpointing or unpacking a global view."""
+    for name in self.images:
+      lay = self.tplan.by_name(name).layout_logical
+      for rank in range(self.plan.world_size):
+        host_scatter_rows(lay, self.images[name][rank],
+                          self.resident_grps[name][rank],
+                          self._rank_cache_rows(fused, name, rank))

@@ -1,0 +1,338 @@
+"""Tiered state construction + the host-side training loop.
+
+:func:`init_tiered_state` is ``training.init_sparse_state_direct`` with a
+third placement kind: host-tier classes draw their FULL packed image in
+host RAM (:class:`HostTierStore`) and put only the compact hot-cache +
+staging buffer on device; device-tier sparse classes and MXU dense
+classes are unchanged.
+
+:class:`TieredTrainer` owns the per-step protocol around
+``training.make_tiered_train_step``:
+
+    classify (host)  ->  stage (host gather + upload)  ->  device step
+    ->  write back (staging region -> host image)  ->  periodic re-rank
+
+:meth:`TieredTrainer.run` overlaps the NEXT batch's classification with
+the device step (jax dispatch is asynchronous; the classify needs only
+the resident map, not the step's results), which is the prefetch-ahead
+stage of the paper's production pattern. The stage gather itself must
+wait for the previous write-back — a row staged twice in a row needs its
+updated value — so the overlap depth is one classify, not a full stage.
+On a re-rank step the look-ahead classify is deferred until after the
+re-rank (classifying against a resident map the re-rank is about to
+replace could mark a just-evicted row hot and silently drop its update).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..ops.packed_table import SparseRule
+from ..parallel.lookup_engine import (
+    DistributedLookup,
+    class_param_name,
+    padded_rows,
+)
+from ..training import make_tiered_train_step, shard_batch
+from .plan import TieringPlan
+from .prefetch import TieredPrefetcher
+from .store import HostTierStore
+
+
+def init_tiered_state(tplan: TieringPlan, store: HostTierStore,
+                      rule: SparseRule,
+                      dense_params: Any,
+                      dense_optimizer: optax.GradientTransformation,
+                      rng: jax.Array,
+                      emb_dense_optimizer: Optional[
+                          optax.GradientTransformation] = None,
+                      mesh=None,
+                      axis_name: str = "mp",
+                      image_seed: Optional[int] = 0,
+                      dtype=jnp.float32) -> Dict[str, Any]:
+  """Build the fused train state for a tiered plan.
+
+  Host-tier classes: the full packed image is drawn (or kept, see
+  ``image_seed``) in ``store``'s host RAM, and the device gets the
+  compact ``[cache + staging]`` buffer seeded from the resident set
+  (``HostTierStore.build_fused``). Device-tier sparse classes are drawn
+  directly in packed layout; dense classes in the simple layout — both
+  exactly as ``init_sparse_state_direct``.
+
+  Args:
+    image_seed: seed for drawing the host images (numpy RNG — nothing of
+      a host-tier class ever stages on device). ``None`` keeps the
+      store's current images (caller installed them via ``set_image``,
+      e.g. packed from a reference run or a checkpoint).
+  """
+  from ..layers.dist_model_parallel import make_class_initializer
+  from ..training import draw_packed_class
+
+  plan = tplan.plan
+  if image_seed is not None:
+    store.init_uniform(image_seed)
+  engine = DistributedLookup(plan, axis_name=axis_name)
+  layouts = engine.fused_layouts(rule, rows_overrides=tplan.rows_overrides)
+  tiered_fused = store.build_fused(mesh, axis_name)
+
+  fused = {}
+  emb_dense = {}
+  for ki, key in enumerate(plan.class_keys):
+    name = class_param_name(*key)
+    cp = plan.classes[key]
+    sub = jax.random.fold_in(rng, ki)
+    if name in tplan.tier_specs:
+      fused[name] = tiered_fused[name]
+    elif cp.kind == "sparse":
+      fused[name] = draw_packed_class(plan, key, layouts[name], rule, sub,
+                                      dtype)
+    else:
+      shape = (plan.world_size * padded_rows(plan, key), cp.width)
+      emb_dense[name] = make_class_initializer(plan, key)(sub, shape, dtype)
+
+  opt = emb_dense_optimizer or dense_optimizer
+  return {
+      "dense": dense_params,
+      "dense_opt": dense_optimizer.init(dense_params),
+      "emb_dense": emb_dense,
+      "emb_dense_opt": opt.init(emb_dense),
+      "fused": fused,
+      "step": jnp.zeros((), jnp.int32),
+  }
+
+
+def init_tiered_state_from_params(tplan: TieringPlan, store: HostTierStore,
+                                  rule: SparseRule,
+                                  params: Any,
+                                  dense_optimizer:
+                                  optax.GradientTransformation,
+                                  emb_dense_optimizer: Optional[
+                                      optax.GradientTransformation] = None,
+                                  mesh=None,
+                                  axis_name: str = "mp",
+                                  emb_collection: str = "embeddings"
+                                  ) -> Dict[str, Any]:
+  """Build the tiered train state from fully-initialized simple-layout
+  params (``training.init_sparse_state``'s tiered counterpart).
+
+  Host-tier classes are packed HOST-SIDE into the store's images (numpy;
+  the class never materializes on device — which is the point), then the
+  compact device buffers are gathered from the resident set. Mainly for
+  parity tests and for migrating an existing run onto tiering; fresh
+  training should use :func:`init_tiered_state` (direct draws).
+  """
+  plan = tplan.plan
+  engine = DistributedLookup(plan, axis_name=axis_name)
+  layouts = engine.fused_layouts(rule, rows_overrides=tplan.rows_overrides)
+  tables = params[emb_collection]
+  dense = {k: v for k, v in params.items() if k != emb_collection}
+
+  fused = {}
+  emb_dense = {}
+  for key in plan.class_keys:
+    name = class_param_name(*key)
+    cp = plan.classes[key]
+    arr = tables[name]
+    if name in tplan.tier_specs:
+      lay = tplan.by_name(name).layout_logical
+      arr_np = np.asarray(jax.device_get(arr))
+      for rank in range(plan.world_size):
+        block = arr_np[rank * lay.rows:(rank + 1) * lay.rows]
+        store.set_image(name, rank, lay.pack(
+            block, rule.init_aux(lay.rows, lay.width, np.float32)))
+    elif cp.kind == "sparse":
+      layout = layouts[name]
+
+      def pack_all(a, layout=layout):
+        rows = a.shape[0] // plan.world_size
+        return jnp.concatenate(
+            [layout.pack_chunked(a[r * rows:(r + 1) * rows], rule.aux_init)
+             for r in range(plan.world_size)])
+
+      fused[name] = jax.jit(pack_all)(arr)
+    else:
+      emb_dense[name] = arr
+  fused.update(store.build_fused(mesh, axis_name))
+
+  opt = emb_dense_optimizer or dense_optimizer
+  return {
+      "dense": dense,
+      "dense_opt": dense_optimizer.init(dense),
+      "emb_dense": emb_dense,
+      "emb_dense_opt": opt.init(emb_dense),
+      "fused": fused,
+      "step": jnp.zeros((), jnp.int32),
+  }
+
+
+def unpack_tiered_state(tplan: TieringPlan, store: HostTierStore,
+                        rule: SparseRule, state: Dict[str, Any],
+                        emb_collection: str = "embeddings",
+                        axis_name: str = "mp"):
+  """Tiered state -> simple-layout params (checkpoint / get_weights view).
+
+  The caller must reconcile first (``TieredTrainer.flush`` /
+  ``HostTierStore.flush``): host-tier tables are read from the host
+  images, which are only authoritative for resident rows after a flush.
+  """
+  plan = tplan.plan
+  engine = DistributedLookup(plan, axis_name=axis_name)
+  layouts = engine.fused_layouts(rule, rows_overrides=tplan.rows_overrides)
+  tables = {}
+  for key in plan.class_keys:
+    name = class_param_name(*key)
+    cp = plan.classes[key]
+    if name in tplan.tier_specs:
+      # unpack HOST-side (PackedLayout.unpack is numpy-generic): the
+      # image may not fit any device buffer — that being possible is the
+      # tier's whole point
+      lay = tplan.by_name(name).layout_logical
+      tables[name] = np.concatenate(
+          [lay.unpack(img)[0] for img in store.images[name]])
+    elif cp.kind == "sparse":
+      layout = layouts[name]
+      buf = state["fused"][name]
+      tables[name] = jnp.concatenate(
+          [layout.unpack_table_chunked(
+              buf[r * layout.phys_rows:(r + 1) * layout.phys_rows])
+           for r in range(plan.world_size)])
+    else:
+      tables[name] = state["emb_dense"][name]
+  return {**state["dense"], emb_collection: tables}
+
+
+class TieredTrainer:
+  """Drives tiered training: prefetch, device step, write-back, re-rank.
+
+  Owns the mutable pieces — the train ``state`` pytree, the host
+  :class:`HostTierStore`, and the cumulative hit-rate counters. One call
+  to :meth:`step` is the synchronous protocol; :meth:`run` pipelines the
+  classify stage ahead of the device step.
+
+  Counters (occurrence counts over all steps, summed across ranks):
+  ``hits[name] = [hot_hits, staged_hits, missed, valid_total]``. A
+  nonzero ``missed`` raises — it means an id was neither resident nor
+  staged, its update went to the sentinel, and training silently
+  diverged from the all-device semantics (prefetch contract violation,
+  e.g. a re-rank raced the classify).
+  """
+
+  def __init__(self, model, tplan: TieringPlan, store: HostTierStore,
+               loss_fn: Callable,
+               dense_optimizer: optax.GradientTransformation,
+               rule: SparseRule,
+               mesh,
+               state: Dict[str, Any],
+               batch_example: Any,
+               axis_name: str = "mp",
+               emb_dense_optimizer: Optional[
+                   optax.GradientTransformation] = None,
+               exact: bool = False,
+               donate: bool = True):
+    self.tplan = tplan
+    self.store = store
+    self.mesh = mesh
+    self.axis_name = axis_name
+    self.state = state
+    self.prefetcher = TieredPrefetcher(tplan, store, mesh, axis_name)
+    self._step_fn = make_tiered_train_step(
+        model, tplan, loss_fn, dense_optimizer, rule, mesh, state,
+        batch_example, axis_name=axis_name,
+        emb_dense_optimizer=emb_dense_optimizer, exact=exact, donate=donate)
+    self.hits: Dict[str, np.ndarray] = {
+        name: np.zeros((4,), np.int64) for name in tplan.tier_specs}
+    self.steps = 0
+
+  # ---- metrics -----------------------------------------------------------
+  def _account(self, metrics: Dict[str, jax.Array]) -> None:
+    for name, m in metrics.items():
+      m = np.asarray(m, np.int64)
+      self.hits[name] += m
+      if m[2]:
+        raise RuntimeError(
+            f"class {name}: {int(m[2])} of {int(m[3])} lookups hit neither "
+            "the hot cache nor the staging buffer this step — their "
+            "updates were dropped at the sentinel. The prefetch contract "
+            "is broken (classify ran against a stale resident map?).")
+    self.steps += 1
+
+  def hit_rate(self, name: Optional[str] = None) -> float:
+    """Hot-tier hit rate (cache hits / valid lookups), cumulative; over
+    all tiered classes when ``name`` is None."""
+    ms = [self.hits[name]] if name else list(self.hits.values())
+    total = sum(int(m[3]) for m in ms)
+    return sum(int(m[0]) for m in ms) / total if total else 0.0
+
+  def metrics_summary(self) -> Dict[str, Any]:
+    return {
+        "steps": self.steps,
+        "hit_rate": self.hit_rate(),
+        "per_class": {
+            name: {"hot": int(m[0]), "staged": int(m[1]),
+                   "missed": int(m[2]), "total": int(m[3]),
+                   "hit_rate": int(m[0]) / int(m[3]) if m[3] else 0.0}
+            for name, m in self.hits.items()},
+        "host_gather_bytes": self.prefetcher.total_host_gather_bytes,
+        "spill_steps": self.prefetcher.spill_steps,
+    }
+
+  # ---- stepping ----------------------------------------------------------
+  def _device_batch(self, numerical, cats, labels):
+    return shard_batch((jnp.asarray(numerical), [jnp.asarray(c) for c in cats],
+                        jnp.asarray(labels)), self.mesh, self.axis_name)
+
+  def _dispatch(self, staged, numerical, cats, labels):
+    batch = self._device_batch(numerical, cats, labels)
+    self.state, staged_out, metrics, loss = self._step_fn(
+        self.state, staged.device, *batch)
+    return staged_out, metrics, loss
+
+  def _finish(self, staged, staged_out, metrics):
+    self.prefetcher.write_back(staged, staged_out)  # syncs on the device
+    self._account(metrics)
+    self.state["fused"] = self.prefetcher.maybe_rerank(self.state["fused"])
+
+  def step(self, numerical, cats, labels) -> float:
+    """One synchronous train step on a GLOBAL host batch."""
+    staged = self.prefetcher.prepare(cats)
+    staged_out, metrics, loss = self._dispatch(staged, numerical, cats,
+                                               labels)
+    self._finish(staged, staged_out, metrics)
+    return float(loss)
+
+  def run(self, batches: Iterable) -> list:
+    """Train over ``batches`` of ``(numerical, cats, labels)`` with the
+    classify stage prefetched one batch ahead of the device step."""
+    losses = []
+    it = iter(batches)
+    nxt = next(it, None)
+    cold = None
+    interval = self.tplan.config.rerank_interval
+    while nxt is not None:
+      numerical, cats, labels = nxt
+      if cold is None:
+        cold = self.prefetcher.classify(cats)
+      staged = self.prefetcher.stage(cold)
+      staged_out, metrics, loss = self._dispatch(staged, numerical, cats,
+                                                 labels)
+      nxt = next(it, None)
+      # look-ahead classify overlaps the device step — except when this
+      # step re-ranks (the classification must see the new resident map)
+      will_rerank = bool(interval) and (
+          self.prefetcher.steps_since_rerank + 1 >= interval)
+      cold = (self.prefetcher.classify(nxt[1])
+              if nxt is not None and not will_rerank else None)
+      self._finish(staged, staged_out, metrics)
+      losses.append(float(loss))
+    return losses
+
+  # ---- reconciliation ----------------------------------------------------
+  def flush(self) -> None:
+    """Reconcile resident rows' device values into the host images (call
+    before checkpointing or reading a global weight view)."""
+    self.store.flush(self.state["fused"])

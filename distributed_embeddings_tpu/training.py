@@ -32,15 +32,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .compat import axis_size, psum_replicated_grads, shard_map
 
 from .layers.dist_model_parallel import (
     DistributedOptimizer,
     hybrid_partition_specs,
 )
 from .layers.planner import DistEmbeddingStrategy
-from .ops.packed_table import SparseRule
+from .ops.packed_table import PackedLayout, SparseRule
 from .parallel.lookup_engine import (
     DistributedLookup,
     class_param_name,
@@ -253,7 +254,7 @@ def make_train_step(loss_fn: Callable,
         # so the psum shard_map autodiff applies to replicated... the
         # term is rank-local; scale by world to survive the uniform
         # 1/world grad rescale of DistributedOptimizer
-        scale = jax.lax.axis_size(axis_name) if mesh is not None else 1
+        scale = axis_size(axis_name) if mesh is not None else 1
         loss = loss + scale * reg_fn(params[emb_collection], rank)
       return loss
 
@@ -343,6 +344,51 @@ def init_sparse_state(plan: DistEmbeddingStrategy,
   }
 
 
+def init_scale_spans(plan: DistEmbeddingStrategy, key, rank: int):
+  """Per-shard ``(row_offset, rows, uniform-init scale)`` spans of one
+  rank's class block — the recipe every direct packed draw (device
+  buffers AND host-tier images) builds its per-row scales from. Raises
+  for initializers without a ``.scale``: those must pack an explicitly
+  initialized table instead (``init_sparse_state`` /
+  ``HostTierStore.set_image``)."""
+  from .layers.embedding import resolve_initializer
+  cp = plan.classes[key]
+  spans = []
+  for sh, off in zip(cp.shards_per_rank[rank],
+                     cp.row_offsets_per_rank[rank]):
+    scale = getattr(resolve_initializer(sh.initializer), "scale", None)
+    if scale is None:
+      raise NotImplementedError(
+          f"table {sh.table_id} initializer has no .scale; pack an "
+          "explicitly initialized table instead (init_sparse_state / "
+          "HostTierStore.set_image)")
+    spans.append((off, sh.input_dim, float(scale)))
+  return spans
+
+
+def draw_packed_class(plan: DistEmbeddingStrategy, key, layout,
+                      rule: SparseRule, sub: jax.Array,
+                      dtype=jnp.float32) -> jax.Array:
+  """Draw one sparse class's fused buffer (all ranks stacked) directly in
+  packed physical layout — device-side, deterministic in ``sub``."""
+  from .ops.packed_table import init_packed_uniform
+  blocks = []
+  for r in range(plan.world_size):
+    spans = init_scale_spans(plan, key, r)
+
+    def build(k, spans=tuple(spans), layout=layout):
+      r_idx = jnp.arange(layout.rows, dtype=jnp.int32)
+      scale_rows = jnp.zeros((layout.rows,), dtype)
+      for off, n, sc in spans:
+        scale_rows = jnp.where((r_idx >= off) & (r_idx < off + n), sc,
+                               scale_rows)
+      return init_packed_uniform(layout, k, scale_rows, rule.aux_init,
+                                 dtype)
+
+    blocks.append(jax.jit(build)(jax.random.fold_in(sub, r)))
+  return jnp.concatenate(blocks) if len(blocks) > 1 else blocks[0]
+
+
 def init_sparse_state_direct(plan: DistEmbeddingStrategy,
                              rule: SparseRule,
                              dense_params: Any,
@@ -370,8 +416,6 @@ def init_sparse_state_direct(plan: DistEmbeddingStrategy,
       embedding param creation entirely).
   """
   from .layers.dist_model_parallel import make_class_initializer
-  from .layers.embedding import resolve_initializer
-  from .ops.packed_table import init_packed_uniform
 
   engine = DistributedLookup(plan, axis_name=axis_name)
   layouts = engine.fused_layouts(rule)
@@ -382,31 +426,8 @@ def init_sparse_state_direct(plan: DistEmbeddingStrategy,
     cp = plan.classes[key]
     sub = jax.random.fold_in(rng, ki)
     if cp.kind == "sparse":
-      layout = layouts[name]
-      blocks = []
-      for r in range(plan.world_size):
-        spans = []
-        for sh, off in zip(cp.shards_per_rank[r],
-                           cp.row_offsets_per_rank[r]):
-          scale = getattr(resolve_initializer(sh.initializer), "scale", None)
-          if scale is None:
-            raise NotImplementedError(
-                f"table {sh.table_id} initializer has no .scale; use "
-                "init_sparse_state (generic packing) for this model")
-          spans.append((off, sh.input_dim, float(scale)))
-
-        def build(k, spans=tuple(spans), layout=layout):
-          r_idx = jnp.arange(layout.rows, dtype=jnp.int32)
-          scale_rows = jnp.zeros((layout.rows,), dtype)
-          for off, n, sc in spans:
-            scale_rows = jnp.where((r_idx >= off) & (r_idx < off + n), sc,
-                                   scale_rows)
-          return init_packed_uniform(layout, k, scale_rows, rule.aux_init,
-                                     dtype)
-
-        blocks.append(jax.jit(build)(jax.random.fold_in(sub, r)))
-      fused[name] = (jnp.concatenate(blocks) if len(blocks) > 1
-                     else blocks[0])
+      fused[name] = draw_packed_class(plan, key, layouts[name], rule, sub,
+                                      dtype)
     else:
       shape = (plan.world_size * padded_rows(plan, key), cp.width)
       emb_dense[name] = make_class_initializer(plan, key)(sub, shape, dtype)
@@ -466,6 +487,107 @@ def unpack_sparse_state(plan: DistEmbeddingStrategy, rule: SparseRule,
   return params, aux_out
 
 
+def _fused_rule_and_penalties(plan: DistEmbeddingStrategy, rule: SparseRule):
+  """Validate regularizers/constraints for the fused sparse path; returns
+  ``(rule, reg_fn, con_fn)`` with any uniform l2 folded into the rule.
+
+  Regularizers / constraints on the fused path (reference honors both on
+  every path via Keras add_weight, `embedding.py:64-70,96-100`):
+
+  - DENSE-kind tables (MXU one-hot, small by definition) get the exact
+    full-table treatment: penalty joins the loss (``reg_fn``), constraint
+    projects after the update (``con_fn``) — same machinery as
+    make_train_step.
+  - SPARSE-kind tables support a uniform l2 regularizer, folded into the
+    per-occurrence deltas as decay on TOUCHED rows
+    (``SparseRule.weight_decay``; a dense penalty sweep over terabyte
+    tables is exactly what this path exists to avoid). Anything else
+    (l1/custom penalties, constraints, per-table λ) raises with guidance
+    to the dense autodiff path.
+  """
+  from .layers.embedding import l2_decay_factor
+  table_kind = {}
+  for shards in plan.rank_shards:
+    for sh in shards:
+      table_kind[sh.table_id] = plan._kind_of(sh)
+  lam = None
+  for t, c in enumerate(plan.global_configs):
+    if table_kind.get(t) != "sparse":
+      continue  # dense-kind: handled exactly via reg_fn/con_fn below
+    if c.constraint is not None:
+      raise NotImplementedError(
+          f"table {t} has an embeddings_constraint on the fused sparse "
+          "path: per-occurrence deltas never materialize whole tables, so "
+          "a full-table projection cannot be honored here. Use "
+          "make_train_step (dense autodiff path, pass plan=...) or raise "
+          "dense_row_threshold to serve this table on the MXU path.")
+    if c.regularizer is None:
+      continue
+    f = l2_decay_factor(c.regularizer)
+    if f is None:
+      raise NotImplementedError(
+          f"table {t}'s regularizer {c.regularizer!r} is not a pure l2: "
+          "the fused sparse path folds only l2 decay into its "
+          "per-occurrence deltas ('l2' or {'name': 'l2', 'factor': λ}). "
+          "Use make_train_step (dense autodiff path) for other penalties.")
+    if lam is None:
+      lam = f
+    elif lam != f:
+      raise NotImplementedError(
+          "sparse tables carry different l2 factors "
+          f"({lam} vs {f} on table {t}): the fused delta applies one "
+          "uniform decay per rule. Use equal factors or the dense path.")
+  if lam:
+    import dataclasses as _dc
+    rule = _dc.replace(rule, weight_decay=float(lam))
+  dense_reg = any(c.regularizer is not None
+                  for t, c in enumerate(plan.global_configs)
+                  if table_kind.get(t) == "dense")
+  dense_con = any(c.constraint is not None
+                  for t, c in enumerate(plan.global_configs)
+                  if table_kind.get(t) == "dense")
+  # the fns skip class names absent from the param dict, so feeding them
+  # emb_dense covers exactly the dense-kind windows
+  reg_fn = plan_regularizer_fn(plan) if dense_reg else None
+  con_fn = plan_constraint_fn(plan) if dense_con else None
+  return rule, reg_fn, con_fn
+
+
+def _reduce_and_apply_dense(state, loss, d_dense, d_emb_dense, d_z, rank,
+                            mesh, axis_name, dense_optimizer, emb_opt,
+                            con_fn):
+  """Shared tail of the one-shot fused train steps (all-device and
+  tiered): cross-device grad reduction + dense/emb_dense optimizer
+  application. Returns ``(loss, dense, dense_opt, emb_dense,
+  emb_dense_opt, d_z)`` — ``d_z`` rescaled for the caller's scatter."""
+  if mesh is not None:
+    # replicated-param grads must be summed across devices exactly once:
+    # newer shard_map's autodiff does it implicitly, 0.4.x needs the
+    # explicit psum (compat.psum_replicated_grads is a no-op in the
+    # former case). A uniform 1/world rescale (dense grads AND sparse
+    # cotangents) then restores exact global-batch-mean semantics (see
+    # finalize_hybrid_grads). emb_dense blocks are mp-SHARDED per-rank
+    # windows — never summed.
+    d_dense = psum_replicated_grads(d_dense, axis_name)
+    scale = 1.0 / axis_size(axis_name)
+    d_dense, d_emb_dense, d_z = jax.tree_util.tree_map(
+        lambda g: g * scale, (d_dense, d_emb_dense, d_z))
+    loss = jax.lax.pmean(loss, axis_name)
+
+  upd, dense_opt = dense_optimizer.update(
+      d_dense, state["dense_opt"], state["dense"])
+  dense = optax.apply_updates(state["dense"], upd)
+  if state["emb_dense"]:
+    upd, emb_dense_opt = emb_opt.update(
+        d_emb_dense, state["emb_dense_opt"], state["emb_dense"])
+    emb_dense = optax.apply_updates(state["emb_dense"], upd)
+    if con_fn is not None:
+      emb_dense = con_fn(emb_dense, rank)
+  else:
+    emb_dense, emb_dense_opt = state["emb_dense"], state["emb_dense_opt"]
+  return loss, dense, dense_opt, emb_dense, emb_dense_opt, d_z
+
+
 def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
                            loss_fn: Callable,
                            dense_optimizer: optax.GradientTransformation,
@@ -520,62 +642,7 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
   Returns:
     ``step(state, numerical, cats, labels) -> (state, loss)``.
   """
-  # Regularizers / constraints on the fused path (reference honors both on
-  # every path via Keras add_weight, `embedding.py:64-70,96-100`):
-  # - DENSE-kind tables (MXU one-hot, small by definition) get the exact
-  #   full-table treatment: penalty joins the loss, constraint projects
-  #   after the update — same machinery as make_train_step.
-  # - SPARSE-kind tables support a uniform l2 regularizer, folded into the
-  #   per-occurrence deltas as decay on TOUCHED rows
-  #   (``SparseRule.weight_decay``; a dense penalty sweep over terabyte
-  #   tables is exactly what this path exists to avoid). Anything else
-  #   (l1/custom penalties, constraints, per-table λ) still raises with
-  #   guidance to the dense autodiff path.
-  from .layers.embedding import l2_decay_factor
-  table_kind = {}
-  for shards in plan.rank_shards:
-    for sh in shards:
-      table_kind[sh.table_id] = plan._kind_of(sh)
-  lam = None
-  for t, c in enumerate(plan.global_configs):
-    if table_kind.get(t) != "sparse":
-      continue  # dense-kind: handled exactly via reg_fn/con_fn below
-    if c.constraint is not None:
-      raise NotImplementedError(
-          f"table {t} has an embeddings_constraint on the fused sparse "
-          "path: per-occurrence deltas never materialize whole tables, so "
-          "a full-table projection cannot be honored here. Use "
-          "make_train_step (dense autodiff path, pass plan=...) or raise "
-          "dense_row_threshold to serve this table on the MXU path.")
-    if c.regularizer is None:
-      continue
-    f = l2_decay_factor(c.regularizer)
-    if f is None:
-      raise NotImplementedError(
-          f"table {t}'s regularizer {c.regularizer!r} is not a pure l2: "
-          "the fused sparse path folds only l2 decay into its "
-          "per-occurrence deltas ('l2' or {'name': 'l2', 'factor': λ}). "
-          "Use make_train_step (dense autodiff path) for other penalties.")
-    if lam is None:
-      lam = f
-    elif lam != f:
-      raise NotImplementedError(
-          "sparse tables carry different l2 factors "
-          f"({lam} vs {f} on table {t}): the fused delta applies one "
-          "uniform decay per rule. Use equal factors or the dense path.")
-  if lam:
-    import dataclasses as _dc
-    rule = _dc.replace(rule, weight_decay=float(lam))
-  dense_reg = any(c.regularizer is not None
-                  for t, c in enumerate(plan.global_configs)
-                  if table_kind.get(t) == "dense")
-  dense_con = any(c.constraint is not None
-                  for t, c in enumerate(plan.global_configs)
-                  if table_kind.get(t) == "dense")
-  # the fns skip class names absent from the param dict, so feeding them
-  # emb_dense covers exactly the dense-kind windows
-  reg_fn = plan_regularizer_fn(plan) if dense_reg else None
-  con_fn = plan_constraint_fn(plan) if dense_con else None
+  rule, reg_fn, con_fn = _fused_rule_and_penalties(plan, rule)
   engine = DistributedLookup(plan, dp_input=True, axis_name=axis_name)
   layouts = engine.fused_layouts(rule)
   emb_opt = emb_dense_optimizer or dense_optimizer
@@ -599,7 +666,7 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
     rank = jax.lax.axis_index(axis_name) if mesh is not None else 0
     hotness = [ragged_hotness(c) for c in cats]
     hotness_of = lambda i: hotness[i]  # noqa: E731
-    world = jax.lax.axis_size(axis_name) if mesh is not None else 1
+    world = axis_size(axis_name) if mesh is not None else 1
     gscale = 1.0 / (n_mb * world)
 
     def mb_view(x):
@@ -632,7 +699,7 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
                              emb_acts=acts)
         loss = loss_fn(logits, labels_i)
         if reg_fn is not None:
-          scale = jax.lax.axis_size(axis_name) if mesh is not None else 1
+          scale = axis_size(axis_name) if mesh is not None else 1
           loss = loss + scale * reg_fn(emb_dense, rank)
         return loss
 
@@ -665,11 +732,14 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
     streams = {name: (ids.reshape(-1), rows.reshape(-1, rows.shape[-1]))
                for name, (ids, rows) in streams_s.items()}
     if mesh is not None:
-      # the one replicated-param grad reduction for the whole step; the
-      # emb_dense blocks are mp-SHARDED (per-rank windows), so their grads
-      # are already rank-local — summing them across ranks would mix
-      # different tables' windows
-      d_dense = jax.lax.psum(d_dense, axis_name)
+      # the one replicated-param grad reduction for the whole step (on
+      # newer shard_map the body's autodiff already psummed each
+      # micro-batch's grads, so the shim is a no-op — an unconditional
+      # psum would double-count there); the emb_dense blocks are
+      # mp-SHARDED (per-rank windows), so their grads are already
+      # rank-local — summing them across ranks would mix different
+      # tables' windows
+      d_dense = psum_replicated_grads(d_dense, axis_name)
       loss = jax.lax.pmean(loss, axis_name)
 
     upd, dense_opt = dense_optimizer.update(
@@ -718,33 +788,17 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
         # dense-kind tables' penalty (rank-local windows); scaled by world
         # to survive the uniform 1/world grad rescale below — same
         # convention as make_train_step
-        scale = jax.lax.axis_size(axis_name) if mesh is not None else 1
+        scale = axis_size(axis_name) if mesh is not None else 1
         loss = loss + scale * reg_fn(emb_dense, rank)
       return loss
 
     loss, (d_dense, d_emb_dense, d_z) = jax.value_and_grad(
         loss_with, argnums=(0, 1, 2))(state["dense"], state["emb_dense"],
                                       z_sparse)
-    if mesh is not None:
-      # shard_map autodiff psums replicated-param grads; a uniform 1/world
-      # rescale (dense grads AND sparse cotangents) restores exact
-      # global-batch-mean semantics (see finalize_hybrid_grads).
-      scale = 1.0 / jax.lax.axis_size(axis_name)
-      d_dense, d_emb_dense, d_z = jax.tree_util.tree_map(
-          lambda g: g * scale, (d_dense, d_emb_dense, d_z))
-      loss = jax.lax.pmean(loss, axis_name)
-
-    upd, dense_opt = dense_optimizer.update(
-        d_dense, state["dense_opt"], state["dense"])
-    dense = optax.apply_updates(state["dense"], upd)
-    if state["emb_dense"]:
-      upd, emb_dense_opt = emb_opt.update(
-          d_emb_dense, state["emb_dense_opt"], state["emb_dense"])
-      emb_dense = optax.apply_updates(state["emb_dense"], upd)
-      if con_fn is not None:
-        emb_dense = con_fn(emb_dense, rank)
-    else:
-      emb_dense, emb_dense_opt = state["emb_dense"], state["emb_dense_opt"]
+    loss, dense, dense_opt, emb_dense, emb_dense_opt, d_z = \
+        _reduce_and_apply_dense(state, loss, d_dense, d_emb_dense, d_z,
+                                rank, mesh, axis_name, dense_optimizer,
+                                emb_opt, con_fn)
 
     fused = engine.apply_sparse(state["fused"], layouts, d_z, residuals,
                                 rule, state["step"], exact=exact)
@@ -770,6 +824,144 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
       step_fn, mesh=mesh,
       in_specs=(sspec,) + bspec,
       out_specs=(sspec, P()))
+  return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_tiered_train_step(model, tplan, loss_fn: Callable,
+                           dense_optimizer: optax.GradientTransformation,
+                           rule: SparseRule,
+                           mesh: Optional[Mesh],
+                           state: Dict[str, Any],
+                           batch_example: Any,
+                           axis_name: str = "mp",
+                           emb_dense_optimizer: Optional[
+                               optax.GradientTransformation] = None,
+                           exact: bool = False,
+                           donate: bool = True):
+  """Train step over tiered storage: host-tier classes hold only a hot
+  cache + staging region on device (`tiering/`), fed by a host-side
+  prefetch stage that runs AHEAD of this step.
+
+  Per call the step consumes, besides the batch, the prefetcher's staging
+  upload ``staged = {'grps', 'rows', 'resident'}`` (built by
+  ``tiering.TieredPrefetcher.stage``; all three are per-rank blocks
+  stacked on axis 0):
+
+  - routed LOGICAL ids of host-tier classes are rewritten to compact
+    cache/staging slots (``DistributedLookup.translate_tiered_ids``) —
+    routing, bucketing and sentinel semantics are untouched;
+  - the staged cold rows are written into each compact buffer's staging
+    region (``install_staging``), so the fused gather and the ONE
+    scatter-add backward of :func:`make_sparse_train_step` cover both
+    tiers unchanged;
+  - after the update the (post-scatter) staging regions are sliced back
+    out and returned for the host write-back, along with per-class
+    hit-rate counters ``[hot_hits, staged_hits, missed, valid_total]``
+    (global occurrence counts; ``missed`` > 0 means the prefetch contract
+    was violated and those updates were dropped at the sentinel).
+
+  A spill step (prefetcher staged more than ``staging_grps`` rows) changes
+  the staging shapes and RETRACES this function — once per power-of-two
+  bucket, bounded by ``TieringConfig.spill_factor_max``.
+
+  Args:
+    tplan: a ``tiering.TieringPlan`` (per-class TierSpec geometry).
+
+  Returns:
+    ``step(state, staged, numerical, cats, labels) ->
+    (state, staged_out, metrics, loss)`` where ``staged_out`` maps class
+    name to the post-update staging rows (host write-back input) and
+    ``metrics`` maps class name to the int32 ``[4]`` counter vector.
+  """
+  plan = tplan.plan
+  tier_specs = tplan.tier_specs
+  # same penalty limits as make_sparse_train_step's fused path (and for
+  # host-tier tables there is no dense-autodiff fallback at all)
+  rule, reg_fn, con_fn = _fused_rule_and_penalties(plan, rule)
+  engine = DistributedLookup(plan, dp_input=True, axis_name=axis_name)
+  base_layouts = engine.fused_layouts(rule,
+                                      rows_overrides=tplan.rows_overrides)
+  emb_opt = emb_dense_optimizer or dense_optimizer
+
+  def local_step(state, staged, numerical, cats, labels):
+    b = numerical.shape[0]
+    rank = jax.lax.axis_index(axis_name) if mesh is not None else 0
+    hotness = [ragged_hotness(c) for c in cats]
+    hotness_of = lambda i: hotness[i]  # noqa: E731
+
+    # effective layouts from THIS step's staging shapes: a spill step
+    # stages S > staging_grps rows, so the compact buffer (and the 2^31
+    # bound) grows with it — shapes are static per trace, so this is
+    # plain Python and each spill bucket compiles once
+    layouts = dict(base_layouts)
+    for name, spec in tier_specs.items():
+      s = staged["grps"][name].shape[0]
+      layouts[name] = PackedLayout(
+          rows=(spec.cache_grps + s) * spec.rpp,
+          width=base_layouts[name].width, n_aux=rule.n_aux)
+
+    ids_all = engine.route_ids(cats, hotness_of)
+    counts = engine.mean_counts(cats)
+    ids_all, tier_metrics = engine.translate_tiered_ids(
+        ids_all, tier_specs, staged["resident"], staged["grps"])
+    fused_in = engine.install_staging(state["fused"], tier_specs,
+                                     staged["rows"])
+    z_sparse, residuals = engine.lookup_sparse_fused(
+        fused_in, layouts, ids_all,
+        keep_rows=bool(rule.weight_decay) and not rule.n_aux and not exact)
+
+    def loss_with(dense_p, emb_dense, z_sp):
+      acts = engine.finish_forward(z_sp, emb_dense, ids_all, b, hotness_of,
+                                   counts)
+      logits = model.apply({"params": dense_p}, numerical, cats,
+                           emb_acts=acts)
+      loss = loss_fn(logits, labels)
+      if reg_fn is not None:
+        scale = axis_size(axis_name) if mesh is not None else 1
+        loss = loss + scale * reg_fn(emb_dense, rank)
+      return loss
+
+    loss, (d_dense, d_emb_dense, d_z) = jax.value_and_grad(
+        loss_with, argnums=(0, 1, 2))(state["dense"], state["emb_dense"],
+                                      z_sparse)
+    loss, dense, dense_opt, emb_dense, emb_dense_opt, d_z = \
+        _reduce_and_apply_dense(state, loss, d_dense, d_emb_dense, d_z,
+                                rank, mesh, axis_name, dense_optimizer,
+                                emb_opt, con_fn)
+
+    fused = engine.apply_sparse(fused_in, layouts, d_z, residuals,
+                                rule, state["step"], exact=exact)
+    staged_out = engine.staged_regions(fused, tier_specs, staged["grps"])
+    fused = engine.trim_spill(fused, tier_specs)
+    if mesh is not None:
+      tier_metrics = {name: jax.lax.psum(m, axis_name)
+                      for name, m in tier_metrics.items()}
+    new_state = {
+        "dense": dense,
+        "dense_opt": dense_opt,
+        "emb_dense": emb_dense,
+        "emb_dense_opt": emb_dense_opt,
+        "fused": fused,
+        "step": state["step"] + 1,
+    }
+    return new_state, staged_out, tier_metrics, loss
+
+  if mesh is None:
+    return jax.jit(local_step, donate_argnums=(0,) if donate else ())
+
+  sspec = hybrid_partition_specs(state, axis_name)
+  staged_specs = {
+      "grps": {n: P(axis_name) for n in tier_specs},
+      "resident": {n: P(axis_name) for n in tier_specs},
+      "rows": {n: P(axis_name, None) for n in tier_specs},
+  }
+  bspec = jax.tree_util.tree_map(
+      lambda _: P(axis_name), tuple(batch_example))
+  sharded = shard_map(
+      local_step, mesh=mesh,
+      in_specs=(sspec, staged_specs) + bspec,
+      out_specs=(sspec, {n: P(axis_name, None) for n in tier_specs},
+                 {n: P() for n in tier_specs}, P()))
   return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 

@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 import optax
 import pytest
-from jax import shard_map
+from distributed_embeddings_tpu.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_embeddings_tpu.layers import (

@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from distributed_embeddings_tpu.compat import shard_map
 
 from distributed_embeddings_tpu.layers import TableConfig
 from distributed_embeddings_tpu.layers.dist_model_parallel import (

@@ -8,7 +8,9 @@ after the row-slice window subtraction localizes them
 rejects >int32 tables unless row slicing is enabled.
 
 Needs x64 (int64 arrays do not exist otherwise); scoped via the
-jax.enable_x64 context so the rest of the suite keeps default dtypes.
+compat.enable_x64 context (jax.enable_x64 was removed; the supported
+spelling is jax.experimental.enable_x64) so the rest of the suite keeps
+default dtypes.
 """
 
 import jax
@@ -16,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from distributed_embeddings_tpu.compat import enable_x64
 from distributed_embeddings_tpu.layers import DistEmbeddingStrategy, TableConfig
 from distributed_embeddings_tpu.parallel.lookup_engine import (
     DistributedLookup,
@@ -50,7 +53,7 @@ def test_int64_routing_localizes_to_int32():
   (bucket,) = engine._buckets(key, lambda i: 1)
   sentinel = padded_rows(plan, key)
 
-  with jax.enable_x64(True):
+  with enable_x64(True):
     ids = jnp.asarray(
         np.array([0, 7, BIG - 1, 2_000_000_123, -1], np.int64))
     assert _normalize_input(ids).dtype == jnp.int64

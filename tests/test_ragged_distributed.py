@@ -81,7 +81,7 @@ def test_distributed_ragged_matches_padded_and_single(combiner):
 
   mesh = create_mesh(WORLD)
   from jax.sharding import NamedSharding, PartitionSpec as P
-  from jax import shard_map
+  from distributed_embeddings_tpu.compat import shard_map
 
   def fwd(params, rg_values, rg_splits, *dense):
     rg = RaggedIds(rg_values, rg_splits)
@@ -273,7 +273,7 @@ def test_ragged_row_sliced_matches_padded(combiner):
 
   mesh = create_mesh(WORLD)
   from jax.sharding import NamedSharding, PartitionSpec as P
-  from jax import shard_map
+  from distributed_embeddings_tpu.compat import shard_map
 
   def fwd(params, rg_values, rg_splits, *dense):
     rg = RaggedIds(rg_values, rg_splits)
@@ -351,7 +351,7 @@ def test_ragged_into_small_table_demoted_to_sparse():
 
   mesh = create_mesh(WORLD)
   from jax.sharding import NamedSharding, PartitionSpec as P
-  from jax import shard_map
+  from distributed_embeddings_tpu.compat import shard_map
 
   def fwd(params, rg_values, rg_splits, *dense):
     rg = RaggedIds(rg_values, rg_splits)

@@ -12,7 +12,7 @@ import numpy as np
 import optax
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from distributed_embeddings_tpu.compat import shard_map
 
 from distributed_embeddings_tpu.layers import TableConfig
 from distributed_embeddings_tpu.layers.dist_model_parallel import (

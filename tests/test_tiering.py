@@ -1,0 +1,433 @@
+"""Tiered embedding storage tests (`distributed_embeddings_tpu/tiering/`).
+
+The contract under test: host-offloading a class (cold rows in host RAM,
+a frequency-ranked hot cache + per-step staging buffer on device) is a
+pure STORAGE decision — the training math is the all-device fused path
+unchanged. So every test here is a parity test at heart:
+
+- hot/cold routing parity: a tiered run over a fixed skewed id stream
+  produces the same losses and the same final weights as the all-device
+  run it shadows (bit-identical losses; fp32 tolerance on weights after
+  the pack/unpack round trips);
+- the acceptance scenario: a DLRM whose table bytes exceed the
+  configured per-device HBM budget trains end-to-end on the CPU mesh
+  simulator with > 80% hot-tier hit rate;
+- staging-buffer overflow takes the deterministic spill path (bigger
+  host gather, retrace) and never drops an update;
+- periodic re-ranking (promotion/eviction) is value-preserving;
+- checkpoint save -> restore of a tiered plan resumes bit-identically,
+  and geometry / tier mismatches fail loudly instead of corrupting.
+"""
+
+import os
+import shutil
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_embeddings_tpu import checkpoint
+from distributed_embeddings_tpu.layers.dist_model_parallel import (
+    get_weights,
+    set_weights,
+)
+from distributed_embeddings_tpu.layers.embedding import TableConfig
+from distributed_embeddings_tpu.layers.planner import DistEmbeddingStrategy
+from distributed_embeddings_tpu.models import DLRM, bce_loss
+from distributed_embeddings_tpu.models.dlrm import _dlrm_initializer
+from distributed_embeddings_tpu.models.synthetic import power_law_ids
+from distributed_embeddings_tpu.ops.packed_table import sparse_rule
+from distributed_embeddings_tpu.parallel import create_mesh
+from distributed_embeddings_tpu.tiering import (
+    HostTierStore,
+    TieredTrainer,
+    TieringConfig,
+    TieringPlan,
+    init_tiered_state,
+    init_tiered_state_from_params,
+    unpack_tiered_state,
+)
+from distributed_embeddings_tpu.training import (
+    init_sparse_state,
+    make_sparse_train_step,
+    shard_batch,
+    shard_params,
+    unpack_sparse_state,
+)
+
+WORLD = 4
+VOCAB = [5000, 300, 40]
+WIDTH = 16
+
+
+def _tables(vocab=VOCAB):
+  return [TableConfig(input_dim=v, output_dim=WIDTH,
+                      initializer=_dlrm_initializer(v)) for v in vocab]
+
+
+def _plan(host_thr, vocab=VOCAB, **kw):
+  return DistEmbeddingStrategy(_tables(vocab), WORLD, "memory_balanced",
+                               dense_row_threshold=0,
+                               host_row_threshold=host_thr, **kw)
+
+
+def _model(vocab=VOCAB):
+  return DLRM(vocab_sizes=vocab, embedding_dim=WIDTH, bottom_mlp=(32, WIDTH),
+              top_mlp=(32, 1), world_size=WORLD, strategy="memory_balanced",
+              dense_row_threshold=0)
+
+
+def _batch(seed, vocab=VOCAB, batch=32, alpha=1.05):
+  r = np.random.default_rng(seed)
+  numerical = r.standard_normal((batch, 13)).astype(np.float32)
+  cats = [power_law_ids(r, batch, 1, v, alpha).astype(np.int32)[:, 0]
+          for v in vocab]
+  labels = r.integers(0, 2, batch).astype(np.float32)
+  return numerical, cats, labels
+
+
+def _paired_runs(cfg, n_steps=6, vocab=VOCAB, alpha=1.05, batch=32):
+  """Train the all-device baseline and the tiered run from identical
+  params on an identical skewed stream; return (losses_b, losses_t,
+  weights_b, weights_t, trainer)."""
+  plan_b = _plan(None, vocab)
+  plan_t = _plan(1000, vocab)
+  model = _model(vocab)
+  mesh = create_mesh(WORLD)
+  rule = sparse_rule("adagrad", 0.05)
+  opt = optax.adam(1e-3)
+  batch0 = _batch(100, vocab, batch, alpha)
+
+  params_b = model.init(jax.random.PRNGKey(0), batch0[0], batch0[1])["params"]
+  tables_t = set_weights(plan_t, get_weights(plan_b, params_b["embeddings"]))
+  params_t = {k: v for k, v in params_b.items() if k != "embeddings"}
+  params_t["embeddings"] = {k: jnp.asarray(v) for k, v in tables_t.items()}
+
+  state_b = shard_params(init_sparse_state(plan_b, params_b, rule, opt), mesh)
+  step_b = make_sparse_train_step(model, plan_b, bce_loss, opt, rule, mesh,
+                                  state_b, batch0, donate=False)
+
+  tplan = TieringPlan(plan_t, rule, cfg)
+  store = HostTierStore(tplan)
+  state_t = shard_params(
+      init_tiered_state_from_params(tplan, store, rule, params_t, opt,
+                                    mesh=mesh), mesh)
+  trainer = TieredTrainer(model, tplan, store, bce_loss, opt, rule, mesh,
+                          state_t, batch0, donate=False)
+
+  batches = [_batch(100 + i, vocab, batch, alpha) for i in range(n_steps)]
+  losses_b = []
+  for b in batches:
+    sb = shard_batch(b, mesh)
+    state_b, lb = step_b(state_b, *sb)
+    losses_b.append(float(lb))
+  losses_t = trainer.run(batches)
+
+  trainer.flush()
+  p_b, _ = unpack_sparse_state(plan_b, rule, jax.device_get(state_b))
+  p_t = unpack_tiered_state(tplan, store, rule, trainer.state)
+  w_b = get_weights(plan_b, p_b["embeddings"])
+  w_t = get_weights(plan_t, p_t["embeddings"])
+  return losses_b, losses_t, w_b, w_t, trainer
+
+
+def _assert_parity(losses_b, losses_t, w_b, w_t):
+  np.testing.assert_allclose(losses_b, losses_t, rtol=1e-5, atol=1e-6)
+  for t, (a, b) in enumerate(zip(w_b, w_t)):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                               atol=1e-5, err_msg=f"table {t}")
+
+
+# ---------------------------------------------------------------------------
+# planner: the third placement tier
+# ---------------------------------------------------------------------------
+
+def test_planner_host_tier_classes():
+  plan = _plan(1000)
+  # table 0 (5000 rows) is host-tier; tables 1-2 stay on device, and the
+  # host-tier table must land in its own class (generation separation)
+  assert plan.table_tier(0) == "host"
+  assert plan.table_tier(1) == plan.table_tier(2) == "device"
+  host_keys = plan.host_tier_class_keys()
+  assert len(host_keys) == 1
+  tiers = set(plan.class_tiers.values())
+  assert tiers == {"host", "device"}
+  for key in host_keys:
+    for shards in plan.classes[key].shards_per_rank:
+      assert all(sh.table_id == 0 for sh in shards)
+
+  report = plan.tier_capacity_report(n_aux=1)
+  assert report["host_bytes_per_rank"] > 0
+  assert report["device_bytes_per_rank"] > 0
+  assert report["classes"][host_keys[0]]["tier"] == "host"
+
+
+def test_planner_host_threshold_validation():
+  with pytest.raises(ValueError, match="must be positive"):
+    _plan(0)
+  with pytest.raises(ValueError, match="must exceed"):
+    DistEmbeddingStrategy(_tables(), WORLD, "memory_balanced",
+                          dense_row_threshold=50, host_row_threshold=50)
+
+
+def test_plan_fingerprint_pins_tiering():
+  from distributed_embeddings_tpu.checkpoint import _plan_fingerprint
+  fp_dev = _plan_fingerprint(_plan(None))
+  fp_host = _plan_fingerprint(_plan(1000))
+  assert "class_tiers" not in fp_dev  # pre-tiering checkpoints unaffected
+  assert "host" in fp_host["class_tiers"].values()
+  assert fp_dev != fp_host
+  # a threshold no table crosses leaves the layout untiered: the
+  # fingerprint (and so checkpoint compatibility) must match the
+  # untiered plan exactly
+  assert _plan_fingerprint(_plan(1_000_000)) == fp_dev
+
+
+def test_tiering_plan_geometry():
+  rule = sparse_rule("adagrad", 0.05)
+  plan = _plan(1000)
+  tplan = TieringPlan(plan, rule, TieringConfig(cache_fraction=0.3,
+                                                staging_grps=64))
+  (c,) = tplan.classes.values()
+  lay = c.layout_logical
+  # compact buffer strictly smaller than the vocabulary, ids under sentinel
+  assert c.spec.compact_rows < lay.rows
+  assert c.spec.cache_grps == int(lay.phys_rows * 0.3)
+  assert c.spec.staging_grps == 64
+  assert tplan.device_bytes_per_rank() < (
+      lay.phys_rows * lay.phys_width * 4)
+  assert tplan.host_bytes_per_rank() == lay.phys_rows * lay.phys_width * 4
+
+  with pytest.raises(ValueError, match="no host-tier classes"):
+    TieringPlan(_plan(None), rule, TieringConfig())
+
+
+def test_planner_accepts_overlimit_host_table():
+  # width 512: one packed device buffer caps at ~4.19M rows, so a 5M-row
+  # table is untrainable all-device — host-offloading it is the whole
+  # point of the tier, and the plan-time 2^31 check must not reject it
+  big = [TableConfig(input_dim=5_000_000, output_dim=512,
+                     initializer=_dlrm_initializer(5_000_000))]
+  with pytest.raises(ValueError, match="exceeds one TPU buffer"):
+    DistEmbeddingStrategy(big, 1, "basic", dense_row_threshold=0)
+  plan = DistEmbeddingStrategy(big, 1, "basic", dense_row_threshold=0,
+                               host_row_threshold=1_000_000)
+  rule = sparse_rule("adagrad", 0.05)
+  tplan = TieringPlan(plan, rule, TieringConfig(cache_fraction=0.01,
+                                                staging_grps=256))
+  (c,) = tplan.classes.values()
+  # the device side (compact buffer) stays under the element limit
+  assert (c.layout_compact.phys_rows * c.layout_compact.phys_width
+          <= 2 ** 31)
+
+
+def test_save_tiered_plan_requires_store(tmp_path):
+  # forgetting the store must refuse, not silently drop the cold rows
+  with pytest.raises(ValueError, match="HostTierStore"):
+    checkpoint.save(str(tmp_path / "ck"), _plan(1000),
+                    sparse_rule("adagrad", 0.05), {"fused": {}})
+
+
+def test_tiering_plan_budget_sizing():
+  rule = sparse_rule("adagrad", 0.05)
+  plan = _plan(1000)
+  report = plan.tier_capacity_report(rule.n_aux)
+  # a budget below the fixed device-tier footprint cannot host any cache
+  with pytest.raises(ValueError, match="leaves no room"):
+    TieringPlan(plan, rule, TieringConfig(
+        hbm_budget_bytes=report["device_bytes_per_rank"], staging_grps=16))
+  # a budget between fixed and fixed+cold sizes a partial cache
+  cold = report["host_bytes_per_rank"]
+  budget = report["device_bytes_per_rank"] + cold // 2
+  tplan = TieringPlan(plan, rule, TieringConfig(hbm_budget_bytes=budget,
+                                                staging_grps=16))
+  (c,) = tplan.classes.values()
+  assert 0 < c.spec.cache_grps < c.layout_logical.phys_rows
+  assert tplan.device_bytes_per_rank() + (
+      report["device_bytes_per_rank"]) <= budget + c.layout_logical.phys_rows * 4
+
+
+# ---------------------------------------------------------------------------
+# hot/cold routing parity + the acceptance scenario
+# ---------------------------------------------------------------------------
+
+def test_tiered_parity_vs_all_device():
+  cfg = TieringConfig(cache_fraction=0.3, staging_grps=64, rerank_interval=3)
+  losses_b, losses_t, w_b, w_t, trainer = _paired_runs(cfg)
+  _assert_parity(losses_b, losses_t, w_b, w_t)
+  m = trainer.metrics_summary()
+  assert m["steps"] == 6
+  assert all(v["missed"] == 0 for v in m["per_class"].values())
+  assert m["host_gather_bytes"] > 0
+
+
+def test_hbm_budget_end_to_end():
+  """The acceptance scenario: total table bytes exceed the per-device HBM
+  budget, yet the model trains on the CPU mesh simulator, matches the
+  all-device baseline, and the hot tier serves > 80% of lookups."""
+  rule = sparse_rule("adagrad", 0.05)
+  plan = _plan(1000)
+  report = plan.tier_capacity_report(rule.n_aux)
+  total = report["device_bytes_per_rank"] + report["host_bytes_per_rank"]
+  budget = report["device_bytes_per_rank"] + report["host_bytes_per_rank"] // 2
+  assert total > budget  # the tables do NOT fit the device budget
+  cfg = TieringConfig(hbm_budget_bytes=budget, staging_grps=64,
+                      rerank_interval=3)
+  losses_b, losses_t, w_b, w_t, trainer = _paired_runs(cfg, n_steps=6)
+  _assert_parity(losses_b, losses_t, w_b, w_t)
+  assert trainer.tplan.device_bytes_per_rank() + \
+      report["device_bytes_per_rank"] <= budget + 4 * max(
+          c.layout_logical.phys_rows
+          for c in trainer.tplan.classes.values())
+  assert trainer.hit_rate() > 0.8, trainer.metrics_summary()
+
+
+# ---------------------------------------------------------------------------
+# staging overflow: the spill path
+# ---------------------------------------------------------------------------
+
+def test_staging_overflow_spills_without_dropping():
+  # staging_grps=2 is far below the per-step deduped cold rows, so every
+  # step spills into a power-of-two bucket — parity must still hold
+  cfg = TieringConfig(cache_fraction=0.3, staging_grps=2)
+  losses_b, losses_t, w_b, w_t, trainer = _paired_runs(cfg)
+  _assert_parity(losses_b, losses_t, w_b, w_t)
+  m = trainer.metrics_summary()
+  # most steps overflow the 2-row region (a fully-warmed step may not)
+  assert m["spill_steps"] >= m["steps"] - 1 > 0
+  assert all(v["missed"] == 0 for v in m["per_class"].values())
+
+
+def test_spill_past_hard_cap_raises():
+  # By construction a real batch always fits: the spill cap equals the
+  # worst-case cold-row count (hard_cap - cache >= phys_rows - cache). To
+  # exercise the never-drop guard, fake the impossible case — every row
+  # cold while the cache claims most of the capacity.
+  rule = sparse_rule("adagrad", 0.05)
+  plan = _plan(1000)
+  cfg = TieringConfig(cache_fraction=0.9, staging_grps=1, spill_factor_max=1)
+  tplan = TieringPlan(plan, rule, cfg)
+  store = HostTierStore(tplan)
+  from distributed_embeddings_tpu.tiering import TieredPrefetcher
+  pf = TieredPrefetcher(tplan, store)
+  (c,) = tplan.classes.values()
+  for r in range(WORLD):
+    store.resident_map[c.name][r][:] = -1  # nothing resident
+  cats = [np.arange(v, dtype=np.int32) for v in VOCAB]
+  with pytest.raises(ValueError, match="cannot serve"):
+    pf.stage(pf.classify(cats))
+
+
+# ---------------------------------------------------------------------------
+# promotion / eviction
+# ---------------------------------------------------------------------------
+
+def test_rerank_is_value_preserving():
+  rule = sparse_rule("adagrad", 0.05)
+  plan = _plan(1000)
+  tplan = TieringPlan(plan, rule, TieringConfig(cache_fraction=0.2,
+                                                staging_grps=8))
+  store = HostTierStore(tplan)
+  store.init_uniform(3)
+  fused = store.build_fused()
+  from distributed_embeddings_tpu.tiering import TieredPrefetcher
+  pf = TieredPrefetcher(tplan, store)
+  (c,) = tplan.classes.values()
+  name = c.name
+  before = {r: store.images[name][r].copy() for r in range(WORLD)}
+  store.flush(fused)  # resident rows device values == image values here
+  # rig the counts so the top of the table moves: high rows get traffic
+  for r in range(WORLD):
+    store.counts[name][r][-c.spec.cache_grps:] = 1000
+  old_resident = [store.resident_grps[name][r].copy() for r in range(WORLD)]
+  fused2 = pf.rerank(dict({name: fused[name]}), decay=False)
+  moved = any(not np.array_equal(old_resident[r],
+                                 store.resident_grps[name][r])
+              for r in range(WORLD))
+  assert moved
+  # the global view (image ∪ cache) is unchanged by the re-rank
+  store.flush(fused2)
+  for r in range(WORLD):
+    np.testing.assert_array_equal(store.images[name][r], before[r])
+  # resident maps are consistent inverses
+  for r in range(WORLD):
+    rmap = store.resident_map[name][r]
+    grps = store.resident_grps[name][r]
+    assert np.array_equal(np.where(rmap >= 0)[0], np.sort(grps))
+
+
+# ---------------------------------------------------------------------------
+# checkpoint: save -> restore of a tiered plan
+# ---------------------------------------------------------------------------
+
+def test_tiered_checkpoint_roundtrip():
+  vocab = VOCAB
+  plan = _plan(1000)
+  model = _model()
+  mesh = create_mesh(WORLD)
+  rule = sparse_rule("adagrad", 0.05)
+  opt = optax.adam(1e-3)
+  cfg = TieringConfig(cache_fraction=0.3, staging_grps=64, rerank_interval=3)
+  batch0 = _batch(100)
+
+  def fresh(seed):
+    tplan = TieringPlan(plan, rule, cfg)
+    store = HostTierStore(tplan)
+    params = model.init(jax.random.PRNGKey(0), batch0[0],
+                        batch0[1])["params"]
+    dense = {k: v for k, v in params.items() if k != "embeddings"}
+    state = shard_params(
+        init_tiered_state(tplan, store, rule, dense, opt,
+                          jax.random.PRNGKey(seed), mesh=mesh), mesh)
+    return tplan, store, TieredTrainer(model, tplan, store, bce_loss, opt,
+                                       rule, mesh, state, batch0,
+                                       donate=False)
+
+  batches = [_batch(100 + i) for i in range(8)]
+  _, _, tr_ref = fresh(7)
+  losses_ref = tr_ref.run(batches)
+
+  _, store_b, tr_b = fresh(7)
+  losses_head = tr_b.run(batches[:4])
+  tr_b.flush()
+  ckpt = os.path.join(tempfile.mkdtemp(), "ck")
+  try:
+    checkpoint.save(ckpt, plan, rule, tr_b.state, store=store_b)
+    files = set(os.listdir(ckpt))
+    assert "tiering.npz" in files
+    assert any(f.startswith("cold_") for f in files)
+    # the tiered class's compact buffer must NOT be saved as a fused blob
+    tiered = set(store_b.tplan.tier_specs)
+    assert not any(f.startswith("fused_" + name) for name in tiered
+                   for f in files)
+
+    tplan_c = TieringPlan(plan, rule, cfg)
+    store_c = HostTierStore(tplan_c)
+    params = model.init(jax.random.PRNGKey(0), batch0[0],
+                        batch0[1])["params"]
+    dense = {k: v for k, v in params.items() if k != "embeddings"}
+    state_like = init_tiered_state(tplan_c, store_c, rule, dense, opt,
+                                   jax.random.PRNGKey(99), mesh=mesh)
+    state_c = shard_params(
+        checkpoint.restore(ckpt, plan, rule, state_like, mesh=mesh,
+                           store=store_c), mesh)
+    tr_c = TieredTrainer(model, tplan_c, store_c, bce_loss, opt, rule, mesh,
+                         state_c, batch0, donate=False)
+    losses_tail = tr_c.run(batches[4:])
+    np.testing.assert_allclose(losses_ref, losses_head + losses_tail,
+                               rtol=0, atol=0)
+
+    # geometry mismatch (different cache sizing) must fail loudly
+    bad = TieringPlan(plan, rule, TieringConfig(cache_fraction=0.2,
+                                                staging_grps=64))
+    with pytest.raises(ValueError, match="tier geometry"):
+      checkpoint.restore(ckpt, plan, rule, state_like, mesh=mesh,
+                         store=HostTierStore(bad))
+    # restoring a tiered checkpoint without its store must fail loudly
+    with pytest.raises(ValueError, match="tiering mismatch"):
+      checkpoint.restore(ckpt, plan, rule, state_like, mesh=mesh)
+  finally:
+    shutil.rmtree(os.path.dirname(ckpt))

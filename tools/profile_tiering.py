@@ -1,0 +1,159 @@
+"""Microbenchmark of the tiered-storage path (`tiering/`) on the CPU mesh.
+
+Trains a small DLRM whose big table is host-offloaded on a zipfian id
+stream (the `utils/data.py` SyntheticDataset batch shape with
+`models/synthetic.power_law_ids` categoricals — the uniform generator
+would defeat the cache) and reports, per (alpha, cache_fraction) point:
+
+  - hot-tier cache hit rate (cumulative over the run)
+  - host-gather bytes/step (the staging upload the cold tier costs)
+  - spill steps (batches whose deduped cold rows overflowed staging)
+  - wall-clock step time, tiered vs. the all-device baseline
+
+CPU-mesh numbers size the PROTOCOL (hit rate, bytes, spills are platform
+independent); real-TPU host-gather bandwidth is a ROADMAP open item.
+
+Usage: PYTHONPATH=/root/repo python tools/profile_tiering.py
+"""
+
+import os
+import time
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+  os.environ["XLA_FLAGS"] = (
+      flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from distributed_embeddings_tpu.layers.dist_model_parallel import (  # noqa: E402
+    get_weights,
+    set_weights,
+)
+from distributed_embeddings_tpu.layers.embedding import TableConfig  # noqa: E402
+from distributed_embeddings_tpu.layers.planner import (  # noqa: E402
+    DistEmbeddingStrategy,
+)
+from distributed_embeddings_tpu.models import DLRM, bce_loss  # noqa: E402
+from distributed_embeddings_tpu.models.dlrm import _dlrm_initializer  # noqa: E402
+from distributed_embeddings_tpu.models.synthetic import power_law_ids  # noqa: E402
+from distributed_embeddings_tpu.ops.packed_table import sparse_rule  # noqa: E402
+from distributed_embeddings_tpu.parallel import create_mesh  # noqa: E402
+from distributed_embeddings_tpu.tiering import (  # noqa: E402
+    HostTierStore,
+    TieredTrainer,
+    TieringConfig,
+    TieringPlan,
+    init_tiered_state_from_params,
+)
+from distributed_embeddings_tpu.training import (  # noqa: E402
+    init_sparse_state,
+    make_sparse_train_step,
+    shard_batch,
+    shard_params,
+)
+
+WORLD = 4
+VOCAB = [200_000, 20_000, 300]
+WIDTH = 16
+BATCH = 512
+STEPS = 24
+WARM = 4
+STAGING = 2048
+
+
+def make_batches(alpha, n):
+  r = np.random.default_rng(7)
+  out = []
+  for _ in range(n):
+    numerical = r.standard_normal((BATCH, 13)).astype(np.float32)
+    cats = [power_law_ids(r, BATCH, 1, v, alpha).astype(np.int32)[:, 0]
+            for v in VOCAB]
+    labels = r.integers(0, 2, BATCH).astype(np.float32)
+    out.append((numerical, cats, labels))
+  return out
+
+
+def build(host_thr):
+  tables = [TableConfig(input_dim=v, output_dim=WIDTH,
+                        initializer=_dlrm_initializer(v)) for v in VOCAB]
+  return DistEmbeddingStrategy(tables, WORLD, "memory_balanced",
+                               dense_row_threshold=0,
+                               host_row_threshold=host_thr)
+
+
+def main():
+  model = DLRM(vocab_sizes=VOCAB, embedding_dim=WIDTH,
+               bottom_mlp=(64, WIDTH), top_mlp=(64, 1), world_size=WORLD,
+               strategy="memory_balanced", dense_row_threshold=0)
+  mesh = create_mesh(WORLD)
+  rule = sparse_rule("adagrad", 0.05)
+  opt = optax.adam(1e-3)
+  plan_b = build(None)
+  plan_t = build(50_000)
+  report = plan_t.tier_capacity_report(rule.n_aux)
+  print(f"tables {VOCAB} width {WIDTH} world {WORLD} batch {BATCH}: "
+        f"device-tier {report['device_bytes_per_rank']:,} B/rank, "
+        f"cold store {report['host_bytes_per_rank']:,} B/rank")
+
+  batches0 = make_batches(1.05, 1)
+  params_b = model.init(jax.random.PRNGKey(0), batches0[0][0],
+                        batches0[0][1])["params"]
+  tables_t = set_weights(plan_t, get_weights(plan_b, params_b["embeddings"]))
+  params_t = {k: v for k, v in params_b.items() if k != "embeddings"}
+  params_t["embeddings"] = {k: jnp.asarray(v) for k, v in tables_t.items()}
+
+  # ---- all-device baseline step time ------------------------------------
+  state_b = shard_params(init_sparse_state(plan_b, params_b, rule, opt),
+                         mesh)
+  step_b = make_sparse_train_step(model, plan_b, bce_loss, opt, rule, mesh,
+                                  state_b, batches0[0], donate=False)
+  batches = make_batches(1.05, STEPS)
+  for b in batches[:WARM]:
+    state_b, loss = step_b(state_b, *shard_batch(b, mesh))
+  jax.block_until_ready(loss)
+  t0 = time.perf_counter()
+  for b in batches[WARM:]:
+    state_b, loss = step_b(state_b, *shard_batch(b, mesh))
+  jax.block_until_ready(loss)
+  base_ms = (time.perf_counter() - t0) / (STEPS - WARM) * 1e3
+  print(f"all-device baseline: {base_ms:7.2f} ms/step")
+
+  hdr = (f"{'alpha':>5} {'cache%':>6} | {'hit%':>6} {'gatherB/step':>12} "
+         f"{'spills':>6} {'ms/step':>8}")
+  print(hdr)
+  print("-" * len(hdr))
+  for alpha in (1.05, 1.2):
+    batches = make_batches(alpha, STEPS)
+    for frac in (0.05, 0.15, 0.30):
+      cfg = TieringConfig(cache_fraction=frac, staging_grps=STAGING,
+                          rerank_interval=6)
+      tplan = TieringPlan(plan_t, rule, cfg)
+      store = HostTierStore(tplan)
+      state = shard_params(
+          init_tiered_state_from_params(tplan, store, rule, params_t, opt,
+                                        mesh=mesh), mesh)
+      trainer = TieredTrainer(model, tplan, store, bce_loss, opt, rule,
+                              mesh, state, batches[0], donate=False)
+      trainer.run(batches[:WARM])
+      # reset counters so warmup compiles/fills don't skew the report
+      for m in trainer.hits.values():
+        m[:] = 0
+      trainer.steps = 0
+      trainer.prefetcher.total_host_gather_bytes = 0
+      trainer.prefetcher.spill_steps = 0
+      t0 = time.perf_counter()
+      trainer.run(batches[WARM:])
+      dt = (time.perf_counter() - t0) / (STEPS - WARM)
+      m = trainer.metrics_summary()
+      print(f"{alpha:5.2f} {frac * 100:5.0f}% | {m['hit_rate'] * 100:5.1f}% "
+            f"{m['host_gather_bytes'] // m['steps']:12,} "
+            f"{m['spill_steps']:6d} {dt * 1e3:8.2f}")
+
+
+if __name__ == "__main__":
+  main()
