@@ -5,7 +5,7 @@
 PY ?= python
 SHELL := /bin/bash  # verify uses pipefail/PIPESTATUS
 
-.PHONY: test test-fast verify native bench dryrun clean
+.PHONY: test test-fast verify native bench dryrun chaos clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -41,6 +41,14 @@ bench:
 # don't run while a bench/profile process holds the tunnel)
 tpu-smoke:
 	PYTHONPATH=$(CURDIR):$$PYTHONPATH $(PY) tools/smoke_pallas_apply.py
+
+# resilience chaos run on the virtual CPU mesh: injected NaN batches, a
+# transient checkpoint-write fault, and a kill mid-save — must skip,
+# retry, auto-resume, converge, and match the uninterrupted trajectory
+# bit-for-bit (tools/chaos_train.py; longer variant is the
+# @pytest.mark.slow test in tests/test_resilience.py)
+chaos:
+	$(PY) tools/chaos_train.py
 
 # multi-chip compile/execute validation on 8 virtual CPU devices
 dryrun:

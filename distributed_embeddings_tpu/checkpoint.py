@@ -26,6 +26,17 @@ Format: a directory
     dense.npz                      path-keyed dense params
     dense_opt.npz / emb_dense.npz / emb_dense_opt.npz
 
+Durability (resilience subsystem): every data file is fsynced, the
+manifest carries a per-file crc32+size table and is written LAST, and
+the tmp -> live rename is atomic — a crash at any point leaves either a
+``.tmp`` dir (manifest-less and detectably incomplete, except in the
+narrow window between the manifest fsync and the rename; either way
+checkpoint discovery never scans ``.tmp`` names) or a complete
+checkpoint.
+``verify`` checks a directory's integrity without loading it; ``restore``
+verifies by default and names the bad file. ``resilience.durable`` adds
+rotation of the last K checkpoints and newest-valid fallback on top.
+
 Migration note: the manifest's plan fingerprint pins the PHYSICAL layout,
 so checkpoints fail restore (with a diff) whenever a planner default that
 shapes the layout changes. Layout-shaping defaults that have moved:
@@ -45,7 +56,8 @@ from __future__ import annotations
 import json
 import os
 import time
-from typing import Any, Dict, Optional
+import zlib
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -55,8 +67,114 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .layers.planner import DistEmbeddingStrategy
 from .ops.packed_table import SparseRule
 from .parallel.lookup_engine import DistributedLookup, class_param_name
+from .resilience import faultinject
 
 FORMAT_VERSION = 1
+
+
+# ---------------------------------------------------------------------------
+# Durability primitives (resilience subsystem)
+# ---------------------------------------------------------------------------
+#
+# The durability protocol: every data file is written into the tmp dir and
+# fsynced; the manifest — which now carries a per-file crc32+size table —
+# is written LAST (after every process's files exist), fsynced, then the
+# tmp dir is atomically renamed into place and the parent directory
+# fsynced. A crash at ANY point therefore leaves either (a) a tmp dir
+# without a manifest (detectably incomplete), or (b) a fully-published
+# checkpoint. restore()/verify() check the checksums, so truncation and
+# bit flips that happen AFTER publication are also detected instead of
+# being memory-mapped into the train state.
+
+
+def _crc32_file(path: str, chunk: int = 1 << 22) -> Dict[str, int]:
+  """Streaming crc32 + size of one file (never holds the file in RAM)."""
+  crc = 0
+  size = 0
+  with open(path, "rb") as f:
+    while True:
+      block = f.read(chunk)
+      if not block:
+        break
+      crc = zlib.crc32(block, crc)
+      size += len(block)
+  return {"crc32": crc & 0xFFFFFFFF, "size": size}
+
+
+def _fsync_path(path: str) -> None:
+  fd = os.open(path, os.O_RDONLY)
+  try:
+    os.fsync(fd)
+  finally:
+    os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+  # directory fsync publishes the rename/creat entries themselves; not
+  # every filesystem supports it (raises EINVAL on some), which is fine —
+  # the data-file fsyncs above are the load-bearing ones
+  try:
+    _fsync_path(path)
+  except OSError:
+    pass
+
+
+def verify(path: str) -> List[str]:
+  """Validate a checkpoint directory; returns a list of problems
+  (empty == valid).
+
+  Checks: the manifest exists and parses; when it carries a
+  ``checksums`` table (every checkpoint written since the resilience
+  subsystem), each listed file exists with the recorded size and crc32.
+  Pre-resilience checkpoints (no table) fall back to an existence check
+  of the file set derivable from the manifest. Used by ``restore`` (to
+  fail with the bad file named) and by ``resilience.durable`` (to fall
+  back to the newest VALID checkpoint)."""
+  mpath = os.path.join(path, "manifest.json")
+  if not os.path.isfile(mpath):
+    return [f"missing manifest: {mpath}"]
+  try:
+    with open(mpath) as f:
+      manifest = json.load(f)
+  except (json.JSONDecodeError, OSError) as e:
+    return [f"unreadable manifest {mpath}: {e}"]
+  problems = []
+  checksums = manifest.get("checksums")
+  if checksums is not None:
+    for fname, want in sorted(checksums.items()):
+      fpath = os.path.join(path, fname)
+      if not os.path.isfile(fpath):
+        problems.append(f"missing file: {fpath}")
+        continue
+      size = os.path.getsize(fpath)
+      if size != want["size"]:
+        problems.append(
+            f"truncated file: {fpath} is {size} bytes, manifest says "
+            f"{want['size']}")
+        continue
+      got = _crc32_file(fpath)["crc32"]
+      if got != want["crc32"]:
+        problems.append(
+            f"corrupted file: {fpath} crc32 {got:#010x} != manifest "
+            f"{want['crc32']:#010x} (bit flip or torn write)")
+    return problems
+  # legacy checkpoint: existence checks only (no integrity data recorded)
+  world = manifest.get("plan", {}).get("world_size", 1)
+  for name in manifest.get("fused", {}):
+    for r in range(world):
+      fpath = os.path.join(path, f"fused_{name}_r{r}.npy")
+      if not os.path.isfile(fpath):
+        problems.append(f"missing file: {fpath}")
+  for part in ("dense", "dense_opt", "emb_dense", "emb_dense_opt"):
+    fpath = os.path.join(path, f"{part}.npz")
+    if not os.path.isfile(fpath):
+      problems.append(f"missing file: {fpath}")
+  for name in manifest.get("tiering", {}).get("classes", {}):
+    for r in range(world):
+      fpath = os.path.join(path, f"cold_{name}_r{r}.npy")
+      if not os.path.isfile(fpath):
+        problems.append(f"missing file: {fpath}")
+  return problems
 
 
 def _to_host(leaf) -> np.ndarray:
@@ -188,8 +306,15 @@ def _rank_blocks_addressable(arr: jax.Array, phys_rows: int):
     yield rank, block
 
 
+def read_manifest(path: str) -> Dict[str, Any]:
+  """Load a checkpoint's manifest (e.g. to read ``extra`` metadata)."""
+  with open(os.path.join(path, "manifest.json")) as f:
+    return json.load(f)
+
+
 def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
-         state: Dict[str, Any], store=None) -> None:
+         state: Dict[str, Any], store=None,
+         extra: Optional[Dict[str, Any]] = None) -> None:
   """Write the full fused train state under directory ``path``.
 
   Atomicity: everything is written into ``path + '.tmp'`` and renamed at
@@ -253,6 +378,18 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
   # failure could not even write a marker still aborts the save everywhere
   # (absence-based failure detection would promote it).
   n_proc = jax.process_count()
+  # Per-file crc32+size, computed by THE PROCESS THAT WROTE each file
+  # right after its fsync (a page-cache-hot local read, not a second
+  # disk pass) and published to p0 through the DONE marker — so building
+  # the manifest never re-reads checkpoint data, which for multi-GiB
+  # rank blocks on a shared filesystem would double the save cost.
+  local_crcs: Dict[str, Dict[str, int]] = {}
+
+  def _seal(fpath: str) -> None:
+    _fsync_path(fpath)
+    faultinject.fire("ckpt_write", path=fpath)
+    local_crcs[os.path.basename(fpath)] = _crc32_file(fpath)
+
   try:
     if err is not None:
       raise err  # p0's mkdir failure, re-raised on p0 after the barrier
@@ -279,7 +416,9 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
       else:
         blocks = ()
       for r, block in blocks:
-        np.save(os.path.join(tmp, f"fused_{name}_r{r}.npy"), block)
+        fpath = os.path.join(tmp, f"fused_{name}_r{r}.npy")
+        np.save(fpath, block)
+        _seal(fpath)
       fused_meta[name] = {
           "phys_rows": layout.phys_rows,
           "phys_width": layout.phys_width,
@@ -294,8 +433,9 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
         c = store.tplan.by_name(name)
         lay = c.layout_logical
         for rank in range(plan.world_size):
-          np.save(os.path.join(tmp, f"cold_{name}_r{rank}.npy"),
-                  store.images[name][rank])
+          fpath = os.path.join(tmp, f"cold_{name}_r{rank}.npy")
+          np.save(fpath, store.images[name][rank])
+          _seal(fpath)
           flat[f"{name}/r{rank}/resident_grps"] = \
               store.resident_grps[name][rank]
           flat[f"{name}/r{rank}/counts"] = store.counts[name][rank]
@@ -305,27 +445,18 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
             "phys_rows": lay.phys_rows,
             "phys_width": lay.phys_width,
         }
-      np.savez(os.path.join(tmp, "tiering.npz"), **flat)
+      fpath = os.path.join(tmp, "tiering.npz")
+      np.savez(fpath, **flat)
+      _seal(fpath)
 
     if p0:
       for part in ("dense", "dense_opt", "emb_dense", "emb_dense_opt"):
-        np.savez(os.path.join(tmp, f"{part}.npz"),
-                 **_flatten_with_paths(state[part]))
-
-      manifest = {
-          "format_version": FORMAT_VERSION,
-          "step": int(_to_host(state["step"])),
-          "rule": {"name": rule.name, "n_aux": rule.n_aux},
-          "plan": _plan_fingerprint(plan),
-          "fused": fused_meta,
-      }
-      if tiering_meta is not None:
-        manifest["tiering"] = tiering_meta
-      with open(os.path.join(tmp, "manifest.json"), "w") as f:
-        json.dump(manifest, f, indent=1)
+        fpath = os.path.join(tmp, f"{part}.npz")
+        np.savez(fpath, **_flatten_with_paths(state[part]))
+        _seal(fpath)
     with open(os.path.join(
         tmp, f"DONE_p{jax.process_index()}"), "w") as f:
-      f.write("ok")
+      json.dump(local_crcs, f)  # the marker carries this writer's crcs
   except BaseException as e:
     err = e
 
@@ -354,9 +485,49 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
   # markers / rename tmp away (without this barrier a slow process could
   # re-check paths p0 already deleted and fail a successful save)
   _barrier("de_tpu_ckpt_verified")
-  if p0:
-    for p in range(n_proc):  # markers are transport, not checkpoint data
-      os.remove(os.path.join(tmp, f"DONE_p{p}"))
+  # The publication block below must reach the renamed-barrier on EVERY
+  # exception — same invariant as the write phase — or processes 1..n-1
+  # hang in the collective while p0 unwinds.
+  def _publish() -> None:
+    # The manifest is the publication record and is written LAST — after
+    # every process's data files exist and are fsynced — carrying a
+    # per-file crc32+size table. A crash before this point leaves a tmp
+    # dir without a manifest: detectably incomplete, never restorable.
+    # Each writer checksummed its own files at write time and shipped the
+    # table in its DONE marker (transport, not checkpoint data): merging
+    # them here costs no re-read of checkpoint bytes.
+    checksums: Dict[str, Dict[str, int]] = {}
+    for p in range(n_proc):
+      mk = os.path.join(tmp, f"DONE_p{p}")
+      with open(mk) as f:
+        checksums.update(json.load(f))
+      os.remove(mk)
+    for fname in sorted(os.listdir(tmp)):
+      if fname not in checksums:  # defensive: a file no writer claimed
+        checksums[fname] = _crc32_file(os.path.join(tmp, fname))
+    manifest = {
+        "format_version": FORMAT_VERSION,
+        "step": int(_to_host(state["step"])),
+        "rule": {"name": rule.name, "n_aux": rule.n_aux},
+        "plan": _plan_fingerprint(plan),
+        "fused": fused_meta,
+        "checksums": checksums,
+    }
+    if extra is not None:
+      # caller metadata riding the atomic manifest write (e.g. the
+      # ResilientTrainer's consumed-batch counter, which differs from
+      # the step counter by the number of guard-skipped batches and is
+      # what exact stream resumption needs). JSON-serializable only.
+      manifest["extra"] = extra
+    if tiering_meta is not None:
+      manifest["tiering"] = tiering_meta
+    mpath = os.path.join(tmp, "manifest.json")
+    with open(mpath, "w") as f:
+      json.dump(manifest, f, indent=1)
+      f.flush()
+      os.fsync(f.fileno())
+    _fsync_dir(tmp)
+    faultinject.fire("ckpt_rename", path=path)
     if os.path.exists(path):
       backup = path + ".old"
       if os.path.exists(backup):
@@ -364,13 +535,40 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
         shutil.rmtree(backup)
       os.rename(path, backup)
     os.rename(tmp, path)
+    _fsync_dir(os.path.dirname(os.path.abspath(path)))
+
+  # The publication must reach the renamed-barrier on EVERY exception —
+  # same invariant as the write phase above — or processes 1..n-1 hang
+  # in the collective while p0 unwinds.
+  err = None
+  if p0:
+    try:
+      _publish()
+    except BaseException as e:
+      err = e
   _barrier("de_tpu_ckpt_renamed")
+  if err is not None:
+    raise err
+  if not p0:
+    # The rename IS publication, and tmp vanishing is the only success
+    # signal the other processes can observe (p0's exception is not
+    # visible here). Poll briefly for shared-filesystem attribute-cache
+    # lag, exactly as with the DONE markers.
+    deadline = time.monotonic() + 30.0
+    while os.path.exists(tmp) and time.monotonic() < deadline:
+      time.sleep(0.2)
+    if os.path.exists(tmp):
+      raise RuntimeError(
+          f"checkpoint publication failed: tmp dir {tmp!r} still present "
+          "after the rename barrier — process 0 raised mid-publication "
+          "(its exception has the root cause)")
 
 
 def restore(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
             state_like: Dict[str, Any],
             mesh: Optional[Mesh] = None,
-            axis_name: str = "mp", store=None) -> Dict[str, Any]:
+            axis_name: str = "mp", store=None,
+            verify_integrity: bool = True) -> Dict[str, Any]:
   """Load a checkpoint written by :func:`save` into a new state dict.
 
   Args:
@@ -408,6 +606,43 @@ def restore(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
     # a crash between save()'s two renames leaves only the backup; fall
     # back to it rather than silently restarting training from scratch
     path = path + ".old"
+  if verify_integrity:
+    # per-file crc32 verification BEFORE anything is opened or
+    # memory-mapped: a missing manifest, truncated block, or bit flip
+    # must fail loudly with the file named, never load wrong rows into a
+    # resuming run. Callers that cannot afford the read pass (terabyte
+    # stores on slow disks) opt out; resilience.durable.latest_valid
+    # verifies during its scan, so its restore skips the duplicate pass.
+    # Process 0 only: the pass streams EVERY rank's blocks, so running
+    # it on all processes would multiply restore I/O by process_count
+    # over the shared filesystem. The verdict is BROADCAST (which also
+    # synchronizes, like save()'s barriers): every process must refuse a
+    # checkpoint p0 found corrupt — a bare barrier would let processes
+    # 1..n-1 restore the bad blocks while p0 unwinds.
+    verr: Optional[BaseException] = None
+    if jax.process_index() == 0:
+      try:
+        problems = verify(path)
+        if problems:
+          raise ValueError(
+              f"checkpoint {path!r} failed integrity verification: "
+              + "; ".join(problems)
+              + ". Restore the previous valid checkpoint "
+              "(resilience.durable.restore_latest falls back "
+              "automatically), or pass verify_integrity=False to load it "
+              "anyway.")
+      except BaseException as e:
+        verr = e
+    if jax.process_count() > 1:
+      from jax.experimental import multihost_utils
+      ok = int(multihost_utils.broadcast_one_to_all(
+          np.int32(0 if verr is not None else 1)))
+      if verr is None and not ok:
+        raise ValueError(
+            f"checkpoint {path!r} failed integrity verification on "
+            "process 0 (its exception names the bad file)")
+    if verr is not None:
+      raise verr
   with open(os.path.join(path, "manifest.json")) as f:
     manifest = json.load(f)
   if manifest["format_version"] != FORMAT_VERSION:

@@ -322,9 +322,23 @@ class DistEmbeddingStrategy:
                batch_hint: Optional[int] = None,
                gen_assignment: str = "auto",
                host_row_threshold: Optional[int] = None,
-               hbm_budget_bytes: Optional[int] = None):
+               hbm_budget_bytes: Optional[int] = None,
+               oov: str = "clip"):
     if strategy not in ("basic", "memory_balanced", "memory_optimized"):
       raise ValueError(f"Unsupported shard strategy {strategy}")
+    # Out-of-vocabulary id POLICY (plan-level — one id pipeline feeds all
+    # tables, so the policy is a property of the plan, not a lookup-call
+    # flag). "clip": ids >= input_dim clamp to the last row (reference
+    # numeric semantics, unchanged) but are COUNTED — guarded train steps
+    # surface a per-class OOV counter in their metrics so clipping is
+    # observable instead of silent. "error": a nonzero counter raises —
+    # eagerly at routing time for concrete (non-traced) inputs, host-side
+    # from step metrics under jit (resilience.guards.check_oov) — for
+    # debugging id pipelines where a clip would bury the bug. Not part of
+    # the plan fingerprint: the policy changes no layout and no numerics.
+    if oov not in ("clip", "error"):
+      raise ValueError(f"oov policy must be 'clip' or 'error', got {oov!r}")
+    self.oov = oov
     self.strategy = "basic" if world_size == 1 else strategy
     self.world_size = world_size
     # ---- third placement tier: host-offloaded cold storage --------------

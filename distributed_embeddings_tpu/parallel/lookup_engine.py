@@ -556,6 +556,9 @@ class DistributedLookup:
             routed = jnp.where(
                 in_win, clamped - sh.row_start + slot.row_offset, sentinel)
           else:
+            # OOV clamp to the last row — COUNTED, not silent: the plan's
+            # oov policy governs it (oov_counts feeds the guarded step's
+            # per-class metrics; oov='error' raises in route_ids)
             routed = jnp.where(ids < 0, sentinel,
                                jnp.clip(ids, 0, sh.input_dim - 1)
                                + slot.row_offset)
@@ -631,6 +634,14 @@ class DistributedLookup:
     Returns ``bk -> [n_b, G, h]`` (bk = (class_key, h, vcap)); G = world * B.
     The all_to_all here is the reference's first Horovod exchange
     (`dist_model_parallel.py:414-423`) with splits made uniform by padding.
+
+    Out-of-vocabulary ids: the routing clamps ``ids >= input_dim`` to the
+    table's last row (reference numeric semantics) under the plan's
+    ``oov`` POLICY — ``"clip"`` keeps the clamp but guarded train steps
+    count it per class (:meth:`oov_counts`); ``"error"`` additionally
+    raises here for concrete (non-traced) inputs, naming the offending
+    id (jitted callers enforce the policy host-side from the metrics,
+    ``resilience.guards.check_oov``).
     """
     plan = self.plan
     world = plan.world_size
@@ -643,6 +654,8 @@ class DistributedLookup:
       if nrows != b:
         raise ValueError("All inputs need the same batch size "
                          f"(got {nrows} vs {b}).")
+    if getattr(plan, "oov", "clip") == "error":
+      self._oov_error_eager(inputs)
     if hotness_of is None:
       hotness_of = lambda i: ragged_hotness(inputs[i])  # noqa: E731
 
@@ -999,6 +1012,72 @@ class DistributedLookup:
       else:
         out[input_id] = jnp.sum(x >= 0, axis=1)
     return out
+
+  # ---- OOV observability -------------------------------------------------
+  def _input_vocab(self, input_id: int) -> int:
+    return self.plan.global_configs[
+        self.plan.input_table_map[input_id]].input_dim
+
+  def oov_counts(self, inputs: Sequence[jax.Array]) -> Dict[str, jax.Array]:
+    """Per-class out-of-vocabulary OCCURRENCE counts for one batch.
+
+    An occurrence is OOV when its id ``>= input_dim`` of the table the
+    input feeds (negative ids are hotness PADDING by the engine contract,
+    not OOV). Counts are per width class — the granularity the train
+    step's params and metrics use — with shared/sliced tables counted
+    once per class. jit-safe (one compare+reduce per input, fused into
+    the step); the guarded train step psums these across devices and
+    surfaces them in its metrics dict, which is what makes the ``clip``
+    policy observable instead of silent.
+
+    Returns class name -> int32 scalar (this device's local batch
+    shard)."""
+    plan = self.plan
+    out = {class_param_name(*k): jnp.zeros((), jnp.int32)
+           for k in plan.class_keys}
+    for input_id, pieces in enumerate(plan.output_pieces):
+      x = _normalize_input(inputs[input_id])
+      vocab = self._input_vocab(input_id)
+      vals = x.values if isinstance(x, RaggedIds) else x
+      if vocab > np.iinfo(np.dtype(vals.dtype)).max:
+        continue  # ids of this dtype cannot reach the vocab bound
+      if isinstance(x, RaggedIds):
+        cap = vals.shape[0]
+        live = jnp.arange(cap, dtype=jnp.int32) < \
+            x.row_splits[-1].astype(jnp.int32)
+        n = jnp.sum((live & (vals >= vocab)).astype(jnp.int32))
+      else:
+        n = jnp.sum((vals >= vocab).astype(jnp.int32))
+      for ck in sorted({p.class_key for p in pieces}):
+        name = class_param_name(*ck)
+        out[name] = out[name] + n
+    return out
+
+  def _oov_error_eager(self, inputs: Sequence[jax.Array]) -> None:
+    """``oov='error'`` enforcement for CONCRETE inputs: raise naming the
+    input, table, first offending id, and vocab. Traced inputs are
+    skipped — under jit the policy is enforced host-side from the
+    guarded step's metrics (``resilience.guards.check_oov``)."""
+    from jax import core as jax_core
+    for input_id, x in enumerate(inputs):
+      vals = x.values if isinstance(x, RaggedIds) else x
+      lens = x.row_splits if isinstance(x, RaggedIds) else None
+      if isinstance(vals, jax_core.Tracer) or \
+          isinstance(lens, jax_core.Tracer):
+        continue
+      vocab = self._input_vocab(input_id)
+      arr = np.asarray(vals).reshape(-1)
+      if lens is not None:
+        arr = arr[:int(np.asarray(lens)[-1])]
+      bad = arr[arr >= vocab]
+      if bad.size:
+        table = self.plan.input_table_map[input_id]
+        raise ValueError(
+            f"OOV policy 'error': input {input_id} carries {bad.size} id(s)"
+            f" outside table {table}'s vocabulary [0, {vocab}) — first "
+            f"offender {int(bad[0])}. The 'clip' policy would have "
+            "silently mapped these to the last row; fix the id pipeline "
+            "or construct the plan with oov='clip'.")
 
   # ---- composed forwards -------------------------------------------------
   def forward(self, class_params: Dict[str, jax.Array],

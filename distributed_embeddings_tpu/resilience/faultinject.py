@@ -1,0 +1,180 @@
+"""Deterministic fault injection for resilience testing.
+
+Production embedding training dies in ways unit tests never exercise:
+preemption mid-checkpoint, a cosmic-ray bit flip in a multi-GiB ``.npy``
+block, an NFS server hiccup during a host-tier gather, a NaN batch from
+an upstream feature pipeline. This module is the ONE mechanism the
+resilience tests (and future chaos tooling) drive all of them through —
+every fault is counter-based and therefore exactly reproducible.
+
+Instrumented sites consult the active injector by name via :func:`fire`:
+
+- ``"ckpt_write"``: after each checkpoint data file is written
+  (``checkpoint.save``) — ``crash_after`` simulates preemption mid-save.
+- ``"ckpt_rename"``: before the final tmp -> live rename — simulates a
+  crash after a complete write but before publication.
+- ``"host_gather"``: inside ``HostTierStore.gather`` — ``fail_first``
+  simulates transient cold-store read errors the retry layer must absorb.
+
+With no injector installed :func:`fire` is a dict lookup + None check:
+the hooks cost nothing in production.
+
+File-corruption helpers (:func:`truncate_file`, :func:`bitflip_file`) and
+the NaN-batch stream wrapper (:func:`nan_batches`) round out the fault
+menu; they act directly rather than through ``fire`` because they corrupt
+state at rest, not an operation in flight.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+
+class InjectedCrash(RuntimeError):
+  """A simulated hard crash (preemption / SIGKILL stand-in).
+
+  Deliberately NOT an ``OSError``: the retry layer must treat it as fatal
+  (a preempted process does not get to retry), so tests that inject a
+  crash see it propagate exactly as a real preemption would."""
+
+
+class TransientIOError(OSError):
+  """A simulated transient I/O failure (the retry layer's food)."""
+
+
+class FaultInjector:
+  """Counter-based fault rules, keyed by instrumented site name.
+
+  Rules are evaluated per :func:`fire` call in the order installed;
+  counters make every run bit-reproducible. Thread-safe (the tiered
+  trainer may classify on a worker thread)."""
+
+  def __init__(self):
+    self._lock = threading.Lock()
+    self._counts: Dict[str, int] = {}
+    self._crash_at: Dict[str, int] = {}
+    self._fail_until: Dict[str, Tuple[int, type]] = {}
+
+  # ---- rule installation -------------------------------------------------
+  def crash_after(self, site: str, n: int) -> "FaultInjector":
+    """Raise :class:`InjectedCrash` on the ``n``-th event at ``site``
+    (0-indexed: ``n=0`` crashes the first event)."""
+    self._crash_at[site] = n
+    return self
+
+  def fail_first(self, site: str, k: int,
+                 exc: type = TransientIOError) -> "FaultInjector":
+    """Raise ``exc`` for the first ``k`` events at ``site``, then let
+    every later event through — the canonical transient fault."""
+    self._fail_until[site] = (k, exc)
+    return self
+
+  # ---- observation -------------------------------------------------------
+  def count(self, site: str) -> int:
+    """Events observed at ``site`` so far (including failed ones)."""
+    with self._lock:
+      return self._counts.get(site, 0)
+
+  # ---- the hook ----------------------------------------------------------
+  def fire(self, site: str, **info) -> None:
+    with self._lock:
+      n = self._counts.get(site, 0)
+      self._counts[site] = n + 1
+    crash = self._crash_at.get(site)
+    if crash is not None and n == crash:
+      raise InjectedCrash(
+          f"injected crash at site {site!r} event #{n} ({info or 'no info'})")
+    rule = self._fail_until.get(site)
+    if rule is not None and n < rule[0]:
+      raise rule[1](
+          f"injected transient failure at site {site!r} event #{n} "
+          f"({n + 1} of {rule[0]}; {info or 'no info'})")
+
+
+_active: Optional[FaultInjector] = None
+
+
+def install(injector: Optional[FaultInjector]) -> None:
+  """Install ``injector`` globally (None deactivates)."""
+  global _active
+  _active = injector
+
+
+def active() -> Optional[FaultInjector]:
+  return _active
+
+
+@contextlib.contextmanager
+def injected(injector: FaultInjector):
+  """Scope an injector to a ``with`` block (always deactivates on exit,
+  including when the injected fault propagates)."""
+  prev = _active
+  install(injector)
+  try:
+    yield injector
+  finally:
+    install(prev)
+
+
+def fire(site: str, **info) -> None:
+  """Instrumentation hook: no-op unless an injector is installed."""
+  if _active is not None:
+    _active.fire(site, **info)
+
+
+# ---------------------------------------------------------------------------
+# State-at-rest corruption (checkpoint files)
+# ---------------------------------------------------------------------------
+
+
+def truncate_file(path: str, keep_bytes: Optional[int] = None) -> None:
+  """Truncate ``path`` (default: to half its size) — a torn write."""
+  import os
+  size = os.path.getsize(path)
+  keep = size // 2 if keep_bytes is None else keep_bytes
+  with open(path, "r+b") as f:
+    f.truncate(keep)
+
+
+def bitflip_file(path: str, offset: Optional[int] = None,
+                 bit: int = 0) -> None:
+  """Flip one bit of ``path`` (default: the middle byte) — silent media
+  corruption a size check cannot see."""
+  import os
+  size = os.path.getsize(path)
+  if not size:
+    raise ValueError(f"cannot bit-flip empty file {path!r}")
+  off = size // 2 if offset is None else offset
+  with open(path, "r+b") as f:
+    f.seek(off)
+    b = f.read(1)
+    f.seek(off)
+    f.write(bytes([b[0] ^ (1 << bit)]))
+
+
+# ---------------------------------------------------------------------------
+# Bad-batch injection
+# ---------------------------------------------------------------------------
+
+
+def nan_batches(batches: Iterable, at_steps, field: int = 0):
+  """Yield ``batches`` with NaN poison injected at the given step indices.
+
+  ``field`` selects which element of each batch tuple to poison (default
+  0: the dense ``numerical`` features — NaNs there reach the loss and
+  every gradient, the way a broken upstream feature pipeline does).
+  Non-destructive: poisoned batches are copies."""
+  bad = frozenset(int(s) for s in at_steps)
+  for i, batch in enumerate(batches):
+    if i in bad:
+      batch = list(batch)
+      x = np.array(np.asarray(batch[field]), np.float32, copy=True)
+      x[...] = np.nan
+      batch[field] = x
+      yield tuple(batch)
+    else:
+      yield batch

@@ -1,0 +1,130 @@
+"""Non-finite and out-of-vocabulary guards for the fused train step.
+
+A single NaN batch is the worst failure mode this system has: the fused
+scatter-add commits ``NaN`` into every touched row of every packed class
+buffer — table lanes AND interleaved optimizer state — and from there it
+spreads through the hot rows of a multi-day run with nothing logged. The
+guard closes that hole at the only safe point: AFTER the backward
+produces the loss and all gradients, BEFORE anything is committed.
+
+:func:`all_finite` is the detection primitive (jit-safe, cheap — one
+``isfinite`` reduction per float leaf, fused by XLA into the backward's
+epilogue). ``training.make_sparse_train_step(guard=True)`` wires it in:
+a bad step zeroes the sparse delta streams (a scatter-add of zeros is an
+exact no-op on the packed buffers), discards the dense/optimizer updates
+via scalar selects, and leaves the step counter unchanged, so a guarded
+run that skips a poisoned batch is bit-identical to a run that never saw
+it. The step's metrics report the skip; :class:`~.trainer.ResilientTrainer`
+counts consecutive skips and aborts-with-rollback past a threshold
+(a persistently-NaN run signals diverged state, not one bad batch).
+
+OOV policy: ids outside a table's vocabulary have historically been
+silently clipped to the last row (reference semantics). The plan-level
+``oov`` policy keeps ``"clip"`` as the numeric default but makes it
+observable — per-class OOV counters ride the guarded step's metrics —
+and ``oov="error"`` escalates a nonzero counter to a host-side error
+(:func:`check_oov`), for debugging id-pipeline bugs that clipping would
+bury.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def all_finite(tree: Any) -> jax.Array:
+  """Scalar bool: every float leaf of ``tree`` is finite.
+
+  Integer/bool leaves are skipped (``isfinite`` is undefined there and
+  ids/counters cannot be non-finite). An empty tree is vacuously finite.
+  """
+  ok = jnp.asarray(True)
+  for leaf in jax.tree_util.tree_leaves(tree):
+    if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+      ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(leaf)))
+  return ok
+
+
+def select_tree(ok: jax.Array, new: Any, old: Any) -> Any:
+  """Per-leaf ``where(ok, new, old)`` — commit or discard an update.
+
+  Only for SMALL pytrees (dense params, optax state, emb_dense tables):
+  a select materializes both operands, so gating a multi-GiB fused
+  buffer this way would copy it every step. The fused buffers are gated
+  upstream instead, by zeroing their delta streams (see
+  ``make_sparse_train_step``)."""
+  return jax.tree_util.tree_map(lambda n, o: jnp.where(ok, n, o), new, old)
+
+
+def check_oov(plan, oov_counts: Dict[str, Any],
+              where: str = "train step") -> Dict[str, int]:
+  """Host-side enforcement of the plan's OOV policy on step metrics.
+
+  Args:
+    plan: the ``DistEmbeddingStrategy`` (its ``oov`` attribute is the
+      policy; plans predating the attribute default to ``"clip"``).
+    oov_counts: class name -> clipped-occurrence count (the ``"oov"``
+      entry of a guarded step's metrics; device scalars or ints).
+
+  Returns the counts as a plain ``{name: int}`` dict. With
+  ``oov="error"`` a nonzero count raises — naming every offending class,
+  its count, and its tables' vocabularies — instead of letting clipped
+  ids train the last row of each table. The guarded step upholds that
+  claim by folding the OOV count into its commit gate under the
+  ``"error"`` policy: the offending batch commits nothing, so this raise
+  always fires with the state bit-identical to before the batch.
+  """
+  counts = {name: int(np.asarray(jax.device_get(v)))
+            for name, v in oov_counts.items()}
+  if getattr(plan, "oov", "clip") != "error":
+    return counts
+  bad = {name: n for name, n in counts.items() if n}
+  if bad:
+    from ..parallel.lookup_engine import class_param_name
+    vocab_of = {}
+    for key in plan.class_keys:
+      name = class_param_name(*key)
+      tables = sorted({s.shard.table_id
+                       for slots in plan.classes[key].slots_per_rank
+                       for s in slots})
+      vocab_of[name] = {t: plan.global_configs[t].input_dim for t in tables}
+    detail = "; ".join(
+        f"{name}: {n} id(s) out of range (table vocabs "
+        f"{vocab_of.get(name, {})})" for name, n in sorted(bad.items()))
+    raise ValueError(
+        f"OOV policy 'error': {where} observed out-of-vocabulary ids that "
+        f"the clip policy would have silently mapped to each table's last "
+        f"row — {detail}. Fix the id pipeline, or set oov='clip' on the "
+        "DistEmbeddingStrategy to accept clipping.")
+  return counts
+
+
+class BadStepCounter:
+  """Host-side consecutive-bad-step accounting for a guarded loop.
+
+  ``update(bad_step)`` returns True while training may continue; once
+  ``max_consecutive`` bad steps arrive in a row it returns False — the
+  caller should roll back to the last durable checkpoint and abort (the
+  :class:`~.trainer.ResilientTrainer` contract). ``None`` disables the
+  abort (count forever)."""
+
+  def __init__(self, max_consecutive: Optional[int] = 3):
+    if max_consecutive is not None and max_consecutive < 1:
+      raise ValueError(
+          f"max_consecutive must be >= 1 or None, got {max_consecutive}")
+    self.max_consecutive = max_consecutive
+    self.skipped = 0
+    self.consecutive = 0
+
+  def update(self, bad_step) -> bool:
+    if int(np.asarray(jax.device_get(bad_step))):
+      self.skipped += 1
+      self.consecutive += 1
+      return (self.max_consecutive is None
+              or self.consecutive < self.max_consecutive)
+    self.consecutive = 0
+    return True

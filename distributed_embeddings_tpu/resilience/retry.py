@@ -1,0 +1,107 @@
+"""Bounded retry with exponential backoff for host-side I/O.
+
+Two operations in a long-running embedding run touch storage a transient
+fault can break without anything being *wrong* with the run: host-tier
+cold-store gathers (`tiering/`) and checkpoint I/O. Both are pure reads
+or idempotent whole-directory writes, so the correct response to an
+``OSError`` is to try again, not to kill a multi-day job.
+
+Policy notes:
+
+- Only exceptions in ``retry_on`` (default ``OSError`` — which covers
+  :class:`faultinject.TransientIOError`) are retried; anything else —
+  including :class:`faultinject.InjectedCrash` and real ``IndexError``
+  bounds violations — propagates immediately. A retry loop that eats a
+  correctness error turns a crash into silent data corruption.
+- Backoff is deterministic (no jitter): ``backoff * 2**attempt`` seconds.
+  These are single-controller host-side calls, not a thundering herd of
+  clients against one service; determinism buys reproducible tests.
+- When retries are exhausted the LAST exception is re-raised with the
+  attempt count noted, so the root cause is never swallowed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional, Tuple, Type
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+  """How many times to retry and how long to wait between attempts."""
+
+  retries: int = 3            # retry attempts AFTER the first call
+  backoff: float = 0.05      # base sleep seconds; doubles per attempt
+  max_backoff: float = 2.0
+  retry_on: Tuple[Type[BaseException], ...] = (OSError,)
+
+  def sleep_for(self, attempt: int) -> float:
+    return min(self.backoff * (2 ** attempt), self.max_backoff)
+
+
+DEFAULT_POLICY = RetryPolicy()
+
+
+def retry_call(fn: Callable, *args,
+               policy: RetryPolicy = DEFAULT_POLICY,
+               on_retry: Optional[Callable[[int, BaseException], None]] = None,
+               sleep: Callable[[float], None] = time.sleep,
+               **kwargs):
+  """Call ``fn(*args, **kwargs)``, retrying per ``policy``.
+
+  ``on_retry(attempt, exc)`` is invoked before each sleep (metrics /
+  logging hook); ``sleep`` is injectable so tests don't wait wall-clock.
+  """
+  attempt = 0
+  while True:
+    try:
+      return fn(*args, **kwargs)
+    except policy.retry_on as e:
+      if attempt >= policy.retries:
+        raise _exhausted(e, attempt + 1) from e
+      if on_retry is not None:
+        on_retry(attempt, e)
+      sleep(policy.sleep_for(attempt))
+      attempt += 1
+
+
+def _exhausted(e: BaseException, attempts: int) -> BaseException:
+  """The terminal exception: same type with the attempt count appended.
+
+  Rebuilding with a single message string would lose OSError's
+  errno/strerror/filename (callers branch on e.errno, e.g. ENOSPC) and
+  would TypeError for exception classes whose constructors need other
+  arguments — so those attributes are copied over, and any failure to
+  reconstruct falls back to the ORIGINAL exception unmodified (the root
+  cause must never be masked by the wrapper)."""
+  note = f"(failed after {attempts} attempts, retries exhausted)"
+  try:
+    wrapped = type(e)(f"{e} {note}")
+  except Exception:
+    return e
+  if isinstance(e, OSError):
+    # Copy only attributes that are actually set: assigning None to
+    # OSError.filename stores a real Py_None in the C slot, which flips
+    # OSError.__str__ into its "[Errno ...] ...: filename" branch and
+    # discards the message entirely.
+    for attr in ("errno", "filename", "filename2"):
+      val = getattr(e, attr, None)
+      if val is not None:
+        setattr(wrapped, attr, val)
+    strerror = getattr(e, "strerror", None)
+    if strerror is not None:
+      # an errno-carrying OSError prints "[Errno e] strerror[: file]"
+      # and ignores args[0], so the note must ride strerror to be seen
+      wrapped.strerror = f"{strerror} {note}"
+  return wrapped
+
+
+def retrying(fn: Callable, policy: RetryPolicy = DEFAULT_POLICY,
+             on_retry: Optional[Callable[[int, BaseException], None]] = None,
+             sleep: Callable[[float], None] = time.sleep) -> Callable:
+  """Bind ``fn`` to a policy: returns a callable with ``fn``'s signature."""
+  def wrapped(*args, **kwargs):
+    return retry_call(fn, *args, policy=policy, on_retry=on_retry,
+                      sleep=sleep, **kwargs)
+  return wrapped

@@ -1,0 +1,242 @@
+"""ResilientTrainer: snapshot / guard / auto-resume around the fused step.
+
+The training loop a preemptible multi-day run actually needs, as a thin
+host-side wrapper over ``training.make_sparse_train_step(guard=True)``:
+
+- **periodic durable snapshots** (``durable.save_rotating``: fsync +
+  checksummed-manifest-last + atomic rename + rotation, with
+  retry/backoff around the I/O);
+- **auto-resume**: construction restores the newest VALID checkpoint
+  under the checkpoint root (corrupted latest falls back), so restarting
+  the same script after a kill continues the run — the caller only has
+  to skip the already-committed batches (``trainer.step_count`` says how
+  many);
+- **non-finite guard accounting**: the guarded step skips a bad batch
+  on-device (nothing commits, the step counter holds); this loop counts
+  the skips and aborts-with-rollback after ``max_consecutive_bad``
+  consecutive skips — one NaN batch is an upstream data bug, K in a row
+  means the run itself has diverged and retrying batches cannot fix it;
+- **OOV policy enforcement**: per-class out-of-vocabulary counters from
+  the step metrics accumulate here, and ``plan.oov == "error"`` turns a
+  nonzero count into an immediate host-side error.
+
+Skipped-batch semantics: a skipped batch is as if it never arrived — the
+committed state and step counter are bit-identical to a run fed the same
+stream without that batch (pinned by tests/test_resilience.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional
+
+import jax
+import numpy as np
+
+from . import durable, guards, retry
+
+
+class TooManyBadSteps(RuntimeError):
+  """Raised after ``max_consecutive_bad`` consecutive non-finite steps.
+
+  The trainer's state has already been ROLLED BACK to the newest valid
+  checkpoint when this raises (or left at the initial state when no
+  checkpoint exists yet), so a supervising process may inspect, adjust
+  (e.g. lower the learning rate), and resume from a known-good point."""
+
+  def __init__(self, msg: str, resumed_step: Optional[int]):
+    super().__init__(msg)
+    self.resumed_step = resumed_step
+
+
+class ResilientTrainer:
+  """Owns the train state and the durability/guard protocol around it.
+
+  Args:
+    step_fn: a GUARDED fused train step — built by
+      ``training.make_sparse_train_step(..., guard=True)`` — returning
+      ``(state, loss, metrics)`` with ``metrics = {'bad_step', 'oov'}``.
+    state: the initial train state (replaced by the checkpointed state
+      when ``resume=True`` finds one).
+    plan / rule: the placement plan and sparse rule (checkpoint identity).
+    ckpt_root: directory of rotated ``ckpt_<step>`` checkpoints.
+    snapshot_every: durable snapshot every N COMMITTED steps (0 = only
+      explicit :meth:`snapshot` calls).
+    keep: checkpoint rotation depth.
+    max_consecutive_bad: abort-with-rollback threshold (None = never
+      abort, count forever).
+    resume: restore the newest valid checkpoint at construction.
+    store: ``HostTierStore`` for tiered plans (forwarded to
+      checkpoint save/restore).
+    retry_policy: backoff policy for checkpoint I/O.
+  """
+
+  def __init__(self, step_fn, state: Dict[str, Any], plan, rule,
+               ckpt_root: str,
+               mesh=None, axis_name: str = "mp",
+               snapshot_every: int = 0, keep: int = 3,
+               max_consecutive_bad: Optional[int] = 3,
+               resume: bool = True, store=None,
+               retry_policy: retry.RetryPolicy = retry.DEFAULT_POLICY):
+    self._step_fn = step_fn
+    self.state = state
+    self.plan = plan
+    self.rule = rule
+    self.ckpt_root = ckpt_root
+    self.mesh = mesh
+    self.axis_name = axis_name
+    self.snapshot_every = snapshot_every
+    self.keep = keep
+    self.store = store
+    self.retry_policy = retry_policy
+    self._bad = guards.BadStepCounter(max_consecutive_bad)
+    self.oov_totals: Dict[str, int] = {}
+    self.resumed_from: Optional[str] = None
+    # Stream position: batches CONSUMED (committed + skipped). Differs
+    # from the state's step counter by the number of guard-skipped
+    # batches, and is what exact stream resumption needs — resuming at
+    # stream[step_count:] would re-apply a committed batch for every
+    # skip that preceded the snapshot. Persisted in each checkpoint's
+    # manifest (``extra``) and restored with it.
+    self.consumed = 0
+    self._last_snapshot = self.step_count if not resume else None
+    if resume:
+      self.maybe_resume()
+      if self._last_snapshot is None:
+        self._last_snapshot = self.step_count
+
+  # ---- resume / snapshot -------------------------------------------------
+  @property
+  def step_count(self) -> int:
+    """Committed steps so far (the state's step counter)."""
+    return int(np.asarray(jax.device_get(self.state["step"])))
+
+  @property
+  def skipped_steps(self) -> int:
+    """Skips in the logical run: a fresh process resuming a checkpoint
+    adopts its persisted count (so ``consumed == step_count +
+    skipped_steps`` survives restarts), then counts what it observes. A
+    mid-run rollback does NOT rewind it — the skips happened."""
+    return self._bad.skipped
+
+  def maybe_resume(self) -> bool:
+    """Restore the newest valid checkpoint under ``ckpt_root`` into
+    ``self.state``; False when none exists (fresh start)."""
+    got = durable.restore_latest(self.ckpt_root, self.plan, self.rule,
+                                 self.state, mesh=self.mesh,
+                                 axis_name=self.axis_name, store=self.store)
+    if got is None:
+      return False
+    from .. import checkpoint
+    first_resume = self.consumed == 0
+    self.state, step, path = got
+    self.resumed_from = path
+    self._last_snapshot = step
+    extra = checkpoint.read_manifest(path).get("extra", {})
+    # checkpoints written outside this trainer carry no consumed count;
+    # step is then the best (and with no skips, exact) stream position
+    self.consumed = int(extra.get("consumed", step))
+    if first_resume:
+      # A process that has consumed nothing yet adopts the run's
+      # persisted skip/OOV accounting along with its stream position.
+      # A mid-run rollback (abort path) keeps the counts this process
+      # observed: those skips and clipped ids really happened, and the
+      # snapshot's stale counters would erase them.
+      self._bad.skipped = int(extra.get("skipped", 0))
+      self.oov_totals = {str(k): int(v)
+                         for k, v in extra.get("oov", {}).items()}
+    return True
+
+  def snapshot(self) -> str:
+    """Durably checkpoint the current state (rotating, with retry).
+
+    Tiered runs need no explicit flush here: ``checkpoint.save`` flushes
+    the store's resident rows itself when one is passed."""
+    path = durable.save_rotating(self.ckpt_root, self.plan, self.rule,
+                                 self.state, store=self.store,
+                                 keep=self.keep, policy=self.retry_policy,
+                                 extra={"consumed": self.consumed,
+                                        "skipped": self.skipped_steps,
+                                        "oov": dict(self.oov_totals)})
+    self._last_snapshot = self.step_count
+    return path
+
+  # ---- stepping ----------------------------------------------------------
+  def _account(self, metrics) -> None:
+    # Account FIRST, enforce second: the oov='error' raise below must
+    # leave every counter consistent with the already-incremented
+    # consumed count — a supervisor that catches the documented error
+    # and snapshots would otherwise persist a stream position whose
+    # rejected batch appears in no counter, breaking
+    # consumed == step_count + skipped_steps across the resume.
+    counts = {name: int(np.asarray(jax.device_get(v)))
+              for name, v in metrics["oov"].items()}
+    for name, n in counts.items():
+      self.oov_totals[name] = self.oov_totals.get(name, 0) + n
+    may_continue = self._bad.update(metrics["bad_step"])
+    guards.check_oov(self.plan, counts, where="guarded step")
+    if not may_continue:
+      limit = self._bad.max_consecutive
+      resumed = None
+      if self.maybe_resume():
+        resumed = self.step_count
+      # the abort consumed this bad streak: a supervisor that catches the
+      # exception and resumes gets the full K-consecutive allowance
+      # again, not an instant re-abort on the next single bad step
+      self._bad.consecutive = 0
+      raise TooManyBadSteps(
+          f"{limit} consecutive non-finite steps: the run has diverged "
+          "(skipping more batches cannot recover it). "
+          + (f"State rolled back to checkpoint step {resumed} "
+             f"({self.resumed_from})."
+             if resumed is not None else
+             "No valid checkpoint exists yet, so NO rollback happened — "
+             "the state is the last committed (possibly diverged) one; "
+             "do not resume from it without inspection."), resumed)
+
+  def step(self, *batch) -> float:
+    """One guarded step on an already-sharded device batch; returns the
+    loss (NaN on a skipped step — the skip is counted, nothing commits)."""
+    self.state, loss, metrics = self._step_fn(self.state, *batch)
+    self.consumed += 1
+    # ONE host transfer for everything the accounting reads. Fetching
+    # the loss, bad_step, each per-class OOV counter, and the step
+    # counter separately would cost a blocking device round-trip apiece
+    # — dozens per step on wide models, serializing dispatch.
+    loss, metrics, stepped = jax.device_get(
+        (loss, metrics, self.state["step"]))
+    self._account(metrics)
+    loss = float(np.asarray(loss))
+    if self.snapshot_every and \
+        int(stepped) - self._last_snapshot >= self.snapshot_every:
+      self.snapshot()
+    return loss
+
+  def run(self, batches: Iterable, snapshot_final: bool = False
+          ) -> List[float]:
+    """Train over host batches of ``(numerical, cats, labels)``.
+
+    Batches are mesh-sharded here (``training.shard_batch``). To resume
+    an interrupted stream, feed the SAME stream minus the first
+    ``trainer.consumed`` batches — the checkpointed stream position,
+    which counts committed AND skipped batches (``step_count`` alone
+    would replay one committed batch per skip that preceded the
+    snapshot)."""
+    from ..training import shard_batch
+
+    losses = []
+    for batch in batches:
+      sb = shard_batch(tuple(batch), self.mesh, self.axis_name)
+      losses.append(self.step(*sb))
+    if snapshot_final:
+      self.snapshot()
+    return losses
+
+  def metrics_summary(self) -> Dict[str, Any]:
+    return {
+        "steps": self.step_count,
+        "consumed": self.consumed,
+        "skipped": self.skipped_steps,
+        "consecutive_bad": self._bad.consecutive,
+        "oov": dict(self.oov_totals),
+        "resumed_from": self.resumed_from,
+    }

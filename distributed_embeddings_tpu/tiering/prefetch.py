@@ -36,9 +36,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.packed_table import host_gather_rows, host_scatter_rows
 from ..ops.ragged import RaggedIds
 from ..parallel.lookup_engine import TIER_PAD_GRP
+from ..resilience import retry as _retry
 from .plan import TieringPlan
 from .store import HostTierStore
 
@@ -58,12 +58,26 @@ class TieredPrefetcher:
   """Host-side prefetch pipeline bound to one plan + store."""
 
   def __init__(self, tplan: TieringPlan, store: HostTierStore,
-               mesh=None, axis_name: str = "mp"):
+               mesh=None, axis_name: str = "mp",
+               retry_policy: _retry.RetryPolicy = _retry.DEFAULT_POLICY):
     self.tplan = tplan
     self.store = store
     self.plan = tplan.plan
     self.mesh = mesh
     self.axis_name = axis_name
+    # Host gathers are the one step-critical operation here that touches
+    # storage outside our control (host RAM today, NFS/disk-backed
+    # stores tomorrow — and the fault injector either way): a transient
+    # OSError retries with exponential backoff instead of killing the
+    # run. Retries are counted for metrics_summary; non-OSError failures
+    # (e.g. the store's bounds IndexError) propagate immediately.
+    self.host_gather_retries = 0
+
+    def _count_retry(attempt, exc):
+      self.host_gather_retries += 1
+
+    self._gather = _retry.retrying(store.gather, policy=retry_policy,
+                                   on_retry=_count_retry)
     # routing recipe: class key -> per rank -> [(input_id, row_offset,
     # row_start, shard_rows, vocab, row_sliced)]
     self._recipe: Dict[tuple, List[list]] = {}
@@ -122,7 +136,9 @@ class TieredPrefetcher:
         # occurrence counts for re-ranking (np.add.at over the raw stream
         # is ~10x slower, and this stage must stay ahead of the device)
         req, occ = np.unique(grps_occ, return_counts=True)
-        req = req.astype(np.int32)
+        # batch-derived indices: bounds-check against the image before
+        # any fancy indexing (descriptive error instead of numpy's)
+        req = self.store.check_rows(c.name, rank, req.astype(np.int32))
         self.store.counts[c.name][rank][req] += occ
         rmap = self.store.resident_map[c.name][rank]
         per_rank.append(req[rmap[req] < 0])
@@ -168,7 +184,7 @@ class TieredPrefetcher:
         pad = s - g.shape[0]
         g_blocks.append(np.concatenate(
             [g, np.full((pad,), TIER_PAD_GRP, np.int32)]))
-        rows = host_gather_rows(lay, self.store.images[c.name][rank], g)
+        rows = self._gather(c.name, rank, g)  # bounds-checked, retried
         nbytes += rows.nbytes
         r_blocks.append(np.concatenate(
             [rows, np.zeros((pad, lay.phys_width), np.float32)]))
@@ -199,8 +215,8 @@ class TieredPrefetcher:
       for rank, g in enumerate(staged.cold[c.name]):
         if not g.shape[0]:
           continue
-        host_scatter_rows(c.layout_logical, self.store.images[c.name][rank],
-                          g, out_np[rank * s:rank * s + g.shape[0]])
+        self.store.scatter(c.name, rank, g,
+                           out_np[rank * s:rank * s + g.shape[0]])
 
   # ---- promotion / eviction ----------------------------------------------
   def maybe_rerank(self, fused: Dict[str, jax.Array], decay: bool = True
@@ -252,12 +268,11 @@ class TieredPrefetcher:
         slots, entering = slots[:k], entering[:k]
         gidx = rank * per + slots
         # evict: device values -> image
-        host_scatter_rows(lay, self.store.images[name][rank],
-                          current[slots], np.asarray(fused[name][gidx]))
+        self.store.scatter(name, rank, current[slots],
+                           np.asarray(fused[name][gidx]))
         # promote: image values -> vacated slots
         all_idx.append(gidx)
-        all_rows.append(host_gather_rows(
-            lay, self.store.images[name][rank], entering))
+        all_rows.append(self._gather(name, rank, entering))
         rmap = self.store.resident_map[name][rank]
         rmap[current[slots]] = -1
         rmap[entering] = slots

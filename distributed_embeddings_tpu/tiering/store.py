@@ -27,7 +27,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.packed_table import host_scatter_rows, init_host_store
+from ..ops.packed_table import (
+    host_gather_rows,
+    host_scatter_rows,
+    init_host_store,
+)
+from ..resilience import faultinject
 from .plan import TieringPlan
 
 
@@ -112,6 +117,53 @@ class HostTierStore:
         maps[rank][:] = -1
         maps[rank][grps] = np.arange(cache, dtype=np.int32)
         self.resident_grps[name][rank] = grps.copy()
+
+  # ---- bounds-checked image access ---------------------------------------
+  def check_rows(self, name: str, rank: int, grps: np.ndarray) -> np.ndarray:
+    """Validate physical-row indices against a class's host image.
+
+    Every index the prefetch pipeline derives from BATCH DATA passes
+    through here before it touches an image: a routing-arithmetic bug or
+    a corrupt id stream must fail with the class named and the offending
+    index shown, not as a bare numpy fancy-index ``IndexError`` three
+    frames deep (or — worse, for negative indices — as a silent
+    wrap-around read of the wrong rows)."""
+    grps = np.asarray(grps)
+    if not grps.size:
+      return grps
+    c = self.tplan.by_name(name)
+    lay = c.layout_logical
+    lo, hi = int(grps.min()), int(grps.max())
+    if lo < 0 or hi >= lay.phys_rows:
+      bad = int(grps[(grps < 0) | (grps >= lay.phys_rows)][0])
+      raise IndexError(
+          f"class {name!r} rank {rank}: physical-row index {bad} is "
+          f"outside this rank's host image [0, {lay.phys_rows}) "
+          f"(= {lay.rows} logical vocab rows at {lay.rows_per_phys}/"
+          "physical row). The ids came from the batch's routing "
+          "arithmetic — this is a routing/classify bug or a corrupt id "
+          "stream, not a capacity problem.")
+    return grps
+
+  def gather(self, name: str, rank: int, grps: np.ndarray) -> np.ndarray:
+    """Bounds-checked cold-row gather from one rank's host image.
+
+    The ``host_gather`` fault-injection site lives here (simulated
+    transient read errors); the prefetcher wraps this call in
+    retry/backoff, so a blip in host/NFS-backed storage costs
+    milliseconds, not the run."""
+    faultinject.fire("host_gather", clazz=name, rank=rank,
+                     rows=int(np.asarray(grps).size))
+    grps = self.check_rows(name, rank, grps)
+    return host_gather_rows(self.tplan.by_name(name).layout_logical,
+                            self.images[name][rank], grps)
+
+  def scatter(self, name: str, rank: int, grps: np.ndarray,
+              rows: np.ndarray) -> None:
+    """Bounds-checked write-back into one rank's host image."""
+    grps = self.check_rows(name, rank, grps)
+    host_scatter_rows(self.tplan.by_name(name).layout_logical,
+                      self.images[name][rank], grps, rows)
 
   # ---- device-state construction ----------------------------------------
   def _put(self, arr: np.ndarray, mesh, axis_name: str):

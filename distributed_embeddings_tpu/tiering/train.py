@@ -279,6 +279,7 @@ class TieredTrainer:
             for name, m in self.hits.items()},
         "host_gather_bytes": self.prefetcher.total_host_gather_bytes,
         "spill_steps": self.prefetcher.spill_steps,
+        "host_gather_retries": self.prefetcher.host_gather_retries,
     }
 
   # ---- stepping ----------------------------------------------------------

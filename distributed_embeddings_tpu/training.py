@@ -239,6 +239,13 @@ def make_train_step(loss_fn: Callable,
   Returns:
     ``step(params, opt_state, *batch) -> (params, opt_state, loss)``.
   """
+  if plan is not None and getattr(plan, "oov", "clip") == "error":
+    raise NotImplementedError(
+        "plan.oov='error' is only enforced by "
+        "make_sparse_train_step(guard=True); this dense-autodiff builder "
+        "has no OOV metrics, so out-of-range ids would be silently "
+        "clipped — the policy's failure mode. Use the guarded sparse "
+        "step, or oov='clip'.")
   dist_opt = DistributedOptimizer(optimizer, axis_name=axis_name) if mesh \
       else optimizer
   reg_fn = plan_regularizer_fn(plan) if plan is not None else None
@@ -601,7 +608,8 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
                                optax.GradientTransformation] = None,
                            exact: bool = False,
                            donate: bool = True,
-                           micro_batches: int = 1):
+                           micro_batches: int = 1,
+                           guard: bool = False):
   """Hybrid-parallel train step on the fused sparse state.
 
   One jitted/shard_map'd function per step:
@@ -638,9 +646,29 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
       until the final scatter); only scatter accumulation ORDER differs,
       an fp-addition reordering. Requires dense (non-ragged) ``cats``
       and ``exact=False``.
+    guard: harden the step against poison batches
+      (``resilience.guards``). After the backward — BEFORE anything
+      commits — the step checks every gradient and the loss for
+      non-finite values (one NaN batch would otherwise scatter NaN into
+      every touched row of every packed buffer, table AND optimizer
+      lanes). A bad step commits NOTHING: the sparse delta streams are
+      zeroed (a scatter-add of zeros is an exact no-op, so the multi-GiB
+      buffers are never copied), the dense/optimizer updates are
+      discarded by scalar selects, and the step counter holds — the
+      committed state is bit-identical to a run that never saw the
+      batch. The step then returns ``(state, loss, metrics)`` with
+      ``metrics = {'bad_step': int32 0/1, 'oov': {class: int32 count}}``
+      (OOV counters per the plan's ``oov`` policy, psum'd across
+      devices; loss is the observed — possibly NaN — value). With
+      ``plan.oov='error'`` a batch carrying out-of-range ids is gated
+      the same way — it commits NOTHING — so the host-side
+      ``check_oov`` raise fires with the state uncontaminated.
+      Incompatible with ``exact=True`` (the guard gates the prebuilt
+      delta streams; the exact path re-gathers inside the apply).
 
   Returns:
-    ``step(state, numerical, cats, labels) -> (state, loss)``.
+    ``step(state, numerical, cats, labels) -> (state, loss)``; with
+    ``guard``, ``-> (state, loss, metrics)``.
   """
   rule, reg_fn, con_fn = _fused_rule_and_penalties(plan, rule)
   engine = DistributedLookup(plan, dp_input=True, axis_name=axis_name)
@@ -652,6 +680,62 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
         "micro_batches > 1 with exact=True: cross-micro-batch dedup would "
         "need the full occurrence stream the mode exists to avoid. Use "
         "per-occurrence semantics (exact=False) or one-shot exact.")
+  if guard and exact:
+    raise NotImplementedError(
+        "guard=True with exact=True: the non-finite guard gates the "
+        "prebuilt per-class delta streams before the scatter, but the "
+        "exact path re-gathers rows and builds its deltas inside the "
+        "apply. Use per-occurrence semantics (exact=False) with the "
+        "guard.")
+  oov_is_error = getattr(plan, "oov", "clip") == "error"
+  if oov_is_error and not guard:
+    raise ValueError(
+        "plan.oov='error' requires make_sparse_train_step(guard=True): "
+        "under jit the ids are traced, so the unguarded step cannot see "
+        "them — out-of-range ids would be silently clipped to each "
+        "table's last row, exactly what oov='error' exists to forbid. "
+        "Enforcement rides the guarded step's OOV metrics "
+        "(resilience.guards.check_oov) plus a commit gate on the "
+        "offending batch; build with guard=True or use oov='clip'.")
+  from .resilience import guards as _guards
+
+  def _guard_gate(loss, grads, streams, oov_ok=None):
+    """Shared guard epilogue: global ok flag + gated delta streams.
+
+    Finiteness is checked on the loss, the dense-side grads, and the
+    BUILT delta streams (NaN/inf cotangents propagate through every
+    rule's delta math, so checking the streams covers d_z). ``ok`` must
+    agree on every device — a skip must be collective; one device
+    committing while another skips would fork the replicated state — so
+    the local verdict is AND-reduced (pmin) across the mesh. Bad-step
+    streams are ZEROED rather than select-gating the buffers: a
+    scatter-add of zeros is an exact no-op, so the multi-GiB packed
+    buffers are never copied. ``oov_ok`` is the oov='error' commit gate
+    (None under 'clip'), folded in so the offending batch skips too."""
+    ok = _guards.all_finite((loss, grads, streams))
+    if oov_ok is not None:
+      ok = jnp.logical_and(ok, oov_ok)
+    if mesh is not None:
+      ok = jax.lax.pmin(ok.astype(jnp.int32), axis_name).astype(bool)
+    streams = {name: (ids, jnp.where(ok, rows, jnp.zeros_like(rows)))
+               for name, (ids, rows) in streams.items()}
+    return ok, streams
+
+  def _oov_ok(oov):
+    """oov='error' commit gate: a batch carrying ANY out-of-range id
+    commits nothing, so when the host-side ``check_oov`` raise fires the
+    state is still bit-identical to before the batch. Under 'clip' the
+    step commits as always — the counters alone make clipping
+    observable — so this returns None (no gate)."""
+    if not oov_is_error or not oov:
+      return None
+    total = sum(jnp.asarray(c, jnp.int32) for c in oov.values())
+    return total == 0
+
+  def _guard_metrics(ok, oov):
+    if mesh is not None:
+      oov = {n: jax.lax.psum(c, axis_name) for n, c in oov.items()}
+    return {"bad_step": 1 - ok.astype(jnp.int32), "oov": oov}
 
   def local_step_mb(state, numerical, cats, labels):
     n_mb = micro_batches
@@ -742,6 +826,13 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
       d_dense = psum_replicated_grads(d_dense, axis_name)
       loss = jax.lax.pmean(loss, axis_name)
 
+    if guard:
+      # the guard sees the ACCUMULATED streams/grads: NaN from any
+      # micro-batch survives the sums, so one check covers the scan
+      oov = engine.oov_counts(cats)
+      ok, streams = _guard_gate(loss, (d_dense, d_emb_dense), streams,
+                                _oov_ok(oov))
+
     upd, dense_opt = dense_optimizer.update(
         d_dense, state["dense_opt"], state["dense"])
     dense = optax.apply_updates(state["dense"], upd)
@@ -754,16 +845,25 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
     else:
       emb_dense, emb_dense_opt = state["emb_dense"], state["emb_dense_opt"]
 
+    if guard:
+      dense, dense_opt, emb_dense, emb_dense_opt = _guards.select_tree(
+          ok, (dense, dense_opt, emb_dense, emb_dense_opt),
+          (state["dense"], state["dense_opt"], state["emb_dense"],
+           state["emb_dense_opt"]))
+
     fused = engine.apply_sparse_streams(state["fused"], layouts, streams,
                                         rule, state["step"])
-    return {
+    new_state = {
         "dense": dense,
         "dense_opt": dense_opt,
         "emb_dense": emb_dense,
         "emb_dense_opt": emb_dense_opt,
         "fused": fused,
-        "step": state["step"] + 1,
-    }, loss
+        "step": state["step"] + (ok.astype(jnp.int32) if guard else 1),
+    }
+    if guard:
+      return new_state, loss, _guard_metrics(ok, oov)
+    return new_state, loss
 
   def local_step(state, numerical, cats, labels):
     b = numerical.shape[0]
@@ -795,10 +895,38 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
     loss, (d_dense, d_emb_dense, d_z) = jax.value_and_grad(
         loss_with, argnums=(0, 1, 2))(state["dense"], state["emb_dense"],
                                       z_sparse)
+    # checked pre-optimizer: a caller's optax chain could mask NaN grads
+    # into finite params (e.g. zero_nans), which must still count as a
+    # bad step — the sparse tiers saw the same poison
+    grads_chk = (d_dense, d_emb_dense) if guard else None
     loss, dense, dense_opt, emb_dense, emb_dense_opt, d_z = \
         _reduce_and_apply_dense(state, loss, d_dense, d_emb_dense, d_z,
                                 rank, mesh, axis_name, dense_optimizer,
                                 emb_opt, con_fn)
+
+    if guard:
+      oov = engine.oov_counts(cats)
+      streams = engine.sparse_delta_streams(layouts, d_z, residuals, rule,
+                                            state["step"])
+      ok, streams = _guard_gate(loss, grads_chk, streams, _oov_ok(oov))
+      dense, dense_opt, emb_dense, emb_dense_opt = _guards.select_tree(
+          ok, (dense, dense_opt, emb_dense, emb_dense_opt),
+          (state["dense"], state["dense_opt"], state["emb_dense"],
+           state["emb_dense_opt"]))
+      fused = engine.apply_sparse_streams(state["fused"], layouts, streams,
+                                          rule, state["step"])
+      new_state = {
+          "dense": dense,
+          "dense_opt": dense_opt,
+          "emb_dense": emb_dense,
+          "emb_dense_opt": emb_dense_opt,
+          "fused": fused,
+          # the counter only advances on COMMITTED steps: schedules
+          # (rule.linear_scale) and resume offsets must see the same
+          # step sequence as a run that never met the poison batch
+          "step": state["step"] + ok.astype(jnp.int32),
+      }
+      return new_state, loss, _guard_metrics(ok, oov)
 
     fused = engine.apply_sparse(state["fused"], layouts, d_z, residuals,
                                 rule, state["step"], exact=exact)
@@ -820,10 +948,17 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
   sspec = hybrid_partition_specs(state, axis_name)
   bspec = jax.tree_util.tree_map(
       lambda _: P(axis_name), tuple(batch_example))
+  out_specs = (sspec, P())
+  if guard:
+    # metrics are replicated scalars (bad_step after the pmin, oov after
+    # the psum)
+    out_specs = (sspec, P(), {
+        "bad_step": P(),
+        "oov": {class_param_name(*k): P() for k in plan.class_keys}})
   sharded = shard_map(
       step_fn, mesh=mesh,
       in_specs=(sspec,) + bspec,
-      out_specs=(sspec, P()))
+      out_specs=out_specs)
   return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
@@ -875,6 +1010,13 @@ def make_tiered_train_step(model, tplan, loss_fn: Callable,
   """
   plan = tplan.plan
   tier_specs = tplan.tier_specs
+  if getattr(plan, "oov", "clip") == "error":
+    raise NotImplementedError(
+        "plan.oov='error' is only enforced by "
+        "make_sparse_train_step(guard=True); the tiered step has no "
+        "guard mode yet (ROADMAP), so out-of-range ids would be "
+        "silently clipped — the policy's failure mode. Use oov='clip' "
+        "with tiered storage for now.")
   # same penalty limits as make_sparse_train_step's fused path (and for
   # host-tier tables there is no dense-autodiff fallback at all)
   rule, reg_fn, con_fn = _fused_rule_and_penalties(plan, rule)

@@ -1,0 +1,544 @@
+"""Resilience subsystem tests: durability, guards, retry, fault injection.
+
+The two acceptance properties (ISSUE 2):
+
+- a run killed mid-save resumes from the last durable checkpoint with a
+  loss trajectory BIT-FOR-BIT identical to an uninterrupted run
+  (``test_killed_mid_save_resumes_bit_exact``);
+- a run fed injected NaN batches completes with the expected
+  skipped-step count — and the committed state is bit-identical to a run
+  that never saw the poison (``test_nan_batches_skip_*``).
+
+Every fault here goes through the deterministic injector
+(`resilience/faultinject.py`): crash-mid-save is a counted exception at
+the ``ckpt_write`` site, corruption is an explicit truncate/bit-flip of
+a published file, transient host-store read errors are counted raises at
+the ``host_gather`` site. Nothing is timing- or luck-dependent.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_embeddings_tpu import checkpoint
+from distributed_embeddings_tpu.layers.planner import DistEmbeddingStrategy
+from distributed_embeddings_tpu.models import DLRM, bce_loss
+from distributed_embeddings_tpu.ops.packed_table import sparse_rule
+from distributed_embeddings_tpu.parallel import create_mesh
+from distributed_embeddings_tpu.parallel.lookup_engine import DistributedLookup
+from distributed_embeddings_tpu.resilience import (
+    FaultInjector,
+    InjectedCrash,
+    RetryPolicy,
+    TransientIOError,
+    durable,
+    faultinject,
+    guards,
+)
+from distributed_embeddings_tpu.resilience.trainer import (
+    ResilientTrainer,
+    TooManyBadSteps,
+)
+from distributed_embeddings_tpu.training import (
+    init_sparse_state,
+    make_sparse_train_step,
+    shard_batch,
+    shard_params,
+)
+
+WORLD = 4
+VOCAB = [300, 200, 150, 20]
+
+
+def build(world, oov="clip"):
+  model = DLRM(vocab_sizes=VOCAB, embedding_dim=16, bottom_mlp=(32, 16),
+               top_mlp=(32, 1), world_size=world, dense_row_threshold=32)
+  plan = DistEmbeddingStrategy(
+      [dict(input_dim=v, output_dim=16,
+            initializer={"name": "uniform", "scale": 0.05}) for v in VOCAB],
+      world, "basic", dense_row_threshold=32, oov=oov)
+  rule = sparse_rule("adagrad", 0.05)
+  opt = optax.adagrad(0.05)
+  return model, plan, rule, opt
+
+
+def make_batch(world, seed=0):
+  rng = np.random.default_rng(seed)
+  b = 4 * world
+  numerical = rng.standard_normal((b, 13)).astype(np.float32)
+  cats = [rng.integers(0, v, b).astype(np.int32) for v in VOCAB]
+  labels = rng.integers(0, 2, b).astype(np.float32)
+  return numerical, cats, labels
+
+
+def init_state(model, plan, rule, opt, batch, mesh=None):
+  numerical, cats, _ = batch
+  params = model.init(jax.random.PRNGKey(0), jnp.asarray(numerical),
+                      [jnp.asarray(c) for c in cats])["params"]
+  state = init_sparse_state(plan, params, rule, opt)
+  return shard_params(state, mesh) if mesh is not None else state
+
+
+def assert_trees_equal(a, b):
+  fa, fb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+  assert len(fa) == len(fb)
+  for x, y in zip(fa, fb):
+    np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------------------
+# Non-finite guard: NaN batches skip bit-exactly
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("use_mesh", [False, True])
+def test_nan_batches_skip_bit_exact(use_mesh):
+  """A guarded run fed poison batches commits the SAME state as a run
+  that never saw them — and counts exactly the injected skips."""
+  world = WORLD if use_mesh else 1
+  mesh = create_mesh(world) if use_mesh else None
+  model, plan, rule, opt = build(world)
+  batches = [make_batch(world, seed) for seed in range(5)]
+  state = init_state(model, plan, rule, opt, batches[0], mesh)
+  step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
+                                state, batches[0], donate=False, guard=True)
+
+  poisoned = list(faultinject.nan_batches(batches, at_steps={1, 3}))
+  assert np.isnan(poisoned[1][0]).all() and np.isnan(poisoned[3][0]).all()
+
+  s = state
+  bad_total = 0
+  for batch in poisoned:
+    s, loss, m = step(s, *shard_batch(batch, mesh))
+    bad_total += int(m["bad_step"])
+  assert bad_total == 2
+  assert int(jax.device_get(s["step"])) == 3
+
+  clean = state
+  for i in (0, 2, 4):
+    clean, _, _ = step(clean, *shard_batch(batches[i], mesh))
+  assert_trees_equal(jax.device_get(s), jax.device_get(clean))
+
+
+def test_nan_batch_skip_micro_batches():
+  """The guard covers the micro-batch accumulation path too."""
+  model, plan, rule, opt = build(1)
+  batches = [make_batch(1, seed) for seed in range(3)]
+  state = init_state(model, plan, rule, opt, batches[0])
+  step = make_sparse_train_step(model, plan, bce_loss, opt, rule, None,
+                                state, batches[0], donate=False, guard=True,
+                                micro_batches=2)
+  poisoned = list(faultinject.nan_batches(batches, at_steps={1}))
+  s = state
+  bad = 0
+  for batch in poisoned:
+    s, _, m = step(s, *shard_batch(batch, None))
+    bad += int(m["bad_step"])
+  assert bad == 1
+  clean = state
+  for i in (0, 2):
+    clean, _, _ = step(clean, *shard_batch(batches[i], None))
+  assert_trees_equal(jax.device_get(s), jax.device_get(clean))
+
+
+def test_guard_rejects_exact():
+  model, plan, rule, opt = build(1)
+  batch = make_batch(1)
+  state = init_state(model, plan, rule, opt, batch)
+  with pytest.raises(NotImplementedError, match="guard"):
+    make_sparse_train_step(model, plan, bce_loss, opt, rule, None, state,
+                           batch, guard=True, exact=True)
+
+
+# ---------------------------------------------------------------------------
+# ResilientTrainer: auto-resume, skip accounting, abort-with-rollback
+# ---------------------------------------------------------------------------
+
+
+def _trainer_fixture(tmp_path, mesh, snapshot_every=2,
+                     max_consecutive_bad=3, subdir="ckpts"):
+  model, plan, rule, opt = build(WORLD)
+  batches = [make_batch(WORLD, seed) for seed in range(8)]
+  state = init_state(model, plan, rule, opt, batches[0], mesh)
+  step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
+                                state, batches[0], donate=False, guard=True)
+
+  def fresh_trainer(root):
+    # state re-derived from the same seeds: a restarted process
+    return ResilientTrainer(
+        step, init_state(model, plan, rule, opt, batches[0], mesh),
+        plan, rule, os.path.join(tmp_path, root), mesh=mesh,
+        snapshot_every=snapshot_every,
+        max_consecutive_bad=max_consecutive_bad)
+
+  return batches, fresh_trainer
+
+
+def test_killed_mid_save_resumes_bit_exact(tmp_path):
+  """ACCEPTANCE: kill a run mid-checkpoint-save; the restarted run
+  resumes from the last durable checkpoint and its loss trajectory is
+  bit-for-bit the uninterrupted run's."""
+  mesh = create_mesh(WORLD)
+  batches, fresh_trainer = _trainer_fixture(tmp_path, mesh)
+
+  ref = fresh_trainer("ref")
+  losses_ref = ref.run(batches)
+  final_ref = jax.device_get(ref.state)
+  assert ref.step_count == 8
+
+  crashed = fresh_trainer("crash")
+  losses_crash = []
+  # the 2nd snapshot (after committed step 4) dies mid-save: the first
+  # save consumed ckpt_write events 0..7 (4 fused rank files + 4 npz),
+  # so event 9 lands two files into the second save, leaving a
+  # manifest-less .tmp
+  inj = FaultInjector().crash_after("ckpt_write", 9)
+  with pytest.raises(InjectedCrash):
+    with faultinject.injected(inj):
+      for batch in batches:
+        losses_crash.append(crashed.step(*shard_batch(batch, mesh)))
+  assert crashed.step_count == 4  # step 4 committed; its snapshot died
+  root = os.path.join(tmp_path, "crash")
+  assert any(d.endswith(".tmp") for d in os.listdir(root))
+  assert durable.latest_valid(root)[0] == 2  # the crashed save is invalid
+
+  resumed = fresh_trainer("crash")  # same root: auto-resume
+  assert resumed.step_count == 2
+  assert resumed.consumed == 2  # no skips: stream position == step
+  assert resumed.resumed_from.endswith("ckpt_0000000002")
+  losses_resumed = resumed.run(batches[resumed.consumed:])
+
+  # bit-for-bit trajectory identity, both sides of the kill
+  assert losses_crash == losses_ref[:len(losses_crash)]
+  assert losses_resumed == losses_ref[2:]
+  assert_trees_equal(jax.device_get(resumed.state), final_ref)
+
+
+def test_nan_batches_skip_count_via_trainer(tmp_path):
+  """ACCEPTANCE: a run fed injected NaN batches completes with the
+  expected skipped-step count."""
+  mesh = create_mesh(WORLD)
+  batches, fresh_trainer = _trainer_fixture(tmp_path, mesh,
+                                            snapshot_every=0)
+  t = fresh_trainer("nan")
+  losses = t.run(faultinject.nan_batches(batches[:6], at_steps={1, 4}))
+  assert len(losses) == 6
+  assert t.skipped_steps == 2
+  assert t.step_count == 4
+  assert np.isnan(losses[1]) and np.isnan(losses[4])
+  assert all(np.isfinite(l) for i, l in enumerate(losses) if i not in (1, 4))
+
+
+def test_resume_position_counts_skipped_batches(tmp_path):
+  """A skip before the snapshot shifts the stream position off the step
+  counter; resumption must use the checkpointed CONSUMED count, or a
+  committed batch would be applied twice."""
+  mesh = create_mesh(WORLD)
+  batches, fresh_trainer = _trainer_fixture(tmp_path, mesh,
+                                            snapshot_every=0)
+  t = fresh_trainer("skewed")
+  stream = list(faultinject.nan_batches(batches[:4], at_steps={1}))
+  t.run(stream)                      # b0 commit, b1 skip, b2+b3 commit
+  assert (t.step_count, t.consumed) == (3, 4)
+  t.snapshot()
+
+  resumed = fresh_trainer("skewed")
+  assert resumed.step_count == 3 and resumed.consumed == 4
+  # the fresh process adopts the persisted skip count, keeping
+  # consumed == step_count + skipped_steps across the restart
+  assert resumed.skipped_steps == 1
+  resumed.run(batches[resumed.consumed:6])   # b4, b5
+
+  clean = fresh_trainer("clean")
+  clean.run([batches[i] for i in (0, 2, 3, 4, 5)])
+  assert_trees_equal(jax.device_get(resumed.state),
+                     jax.device_get(clean.state))
+
+
+def test_abort_with_rollback_after_consecutive_bad(tmp_path):
+  mesh = create_mesh(WORLD)
+  batches, fresh_trainer = _trainer_fixture(tmp_path, mesh,
+                                            snapshot_every=0,
+                                            max_consecutive_bad=2)
+  t = fresh_trainer("abort")
+  t.run(batches[:2])
+  t.snapshot()
+  assert t.step_count == 2
+  poison = faultinject.nan_batches(batches[2:6], at_steps={0, 1, 2, 3})
+  with pytest.raises(TooManyBadSteps) as ei:
+    t.run(poison)
+  # rolled back to the snapshot before raising
+  assert ei.value.resumed_step == 2
+  assert t.step_count == 2
+  assert t.skipped_steps == 2
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint corruption: every failure restores previous-valid or names
+# the bad file
+# ---------------------------------------------------------------------------
+
+
+def _two_snapshots(tmp_path):
+  model, plan, rule, opt = build(1)
+  batch = make_batch(1)
+  state = init_state(model, plan, rule, opt, batch)
+  step = make_sparse_train_step(model, plan, bce_loss, opt, rule, None,
+                                state, batch, donate=False)
+  root = os.path.join(tmp_path, "ckpts")
+  s = state
+  for _ in range(2):
+    s, _ = step(s, *shard_batch(batch, None))
+    durable.save_rotating(root, plan, rule, s, keep=3)
+  return root, plan, rule, s, step, batch
+
+
+@pytest.mark.parametrize("mode", ["truncated", "bitflip", "no_manifest",
+                                  "crash_mid_save"])
+def test_corruption_falls_back_to_previous_valid(tmp_path, mode):
+  root, plan, rule, s, step, batch = _two_snapshots(tmp_path)
+  latest = durable.step_dir(root, 2)
+
+  if mode == "truncated":
+    fname = next(f for f in sorted(os.listdir(latest))
+                 if f.startswith("fused_") and f.endswith("_r0.npy"))
+    faultinject.truncate_file(os.path.join(latest, fname))
+    expect = "truncated file"
+  elif mode == "bitflip":
+    fname = next(f for f in sorted(os.listdir(latest))
+                 if f.startswith("fused_") and f.endswith("_r0.npy"))
+    faultinject.bitflip_file(os.path.join(latest, fname))
+    expect = "corrupted file"
+  elif mode == "no_manifest":
+    fname = "manifest.json"
+    os.remove(os.path.join(latest, fname))
+    expect = "missing manifest"
+  else:  # crash_mid_save: the step-3 save dies; steps 1,2 stay valid
+    s3, _ = step(s, *shard_batch(batch, None))
+    with pytest.raises(InjectedCrash):
+      with faultinject.injected(FaultInjector().crash_after("ckpt_write", 1)):
+        durable.save_rotating(root, plan, rule, s3, keep=3)
+    assert os.path.isdir(durable.step_dir(root, 3) + ".tmp")
+    assert durable.latest_valid(root)[0] == 2
+    return
+
+  # the corrupted latest is detected and skipped...
+  problems = checkpoint.verify(latest)
+  assert problems and expect in problems[0] and fname in problems[0]
+  assert durable.latest_valid(root)[0] == 1
+  # ...restore of the bad dir names the bad file...
+  with pytest.raises(ValueError, match="integrity"):
+    checkpoint.restore(latest, plan, rule, s)
+  try:
+    checkpoint.restore(latest, plan, rule, s)
+  except ValueError as e:
+    assert fname in str(e)
+  # ...and the auto-resume path lands on the previous valid checkpoint
+  got = durable.restore_latest(root, plan, rule, s)
+  assert got is not None and got[1] == 1
+  assert int(jax.device_get(got[0]["step"])) == 1
+
+
+def test_rotation_prunes_and_ignores_foreign_entries(tmp_path):
+  root, plan, rule, s, step, batch = _two_snapshots(tmp_path)
+  os.makedirs(os.path.join(root, "not_a_ckpt"))
+  open(os.path.join(root, "ckpt_notanumber"), "w").close()
+  for _ in range(3):
+    s, _ = step(s, *shard_batch(batch, None))
+    durable.save_rotating(root, plan, rule, s, keep=2)
+  steps = [st for st, _ in durable.list_checkpoints(root)]
+  assert steps == [4, 5]
+  assert durable.latest_valid(root)[0] == 5
+
+
+def test_checkpoint_io_retries_transient_errors(tmp_path):
+  """A transient OSError inside save is retried by save_rotating (the
+  partial tmp of the failed attempt is replaced by the retry)."""
+  root, plan, rule, s, step, batch = _two_snapshots(tmp_path)
+  s, _ = step(s, *shard_batch(batch, None))
+  inj = FaultInjector().fail_first("ckpt_write", 1)
+  with faultinject.injected(inj):
+    path = durable.save_rotating(root, plan, rule, s, keep=3,
+                                 policy=RetryPolicy(retries=2, backoff=0.0))
+  assert not checkpoint.verify(path)
+  assert durable.latest_valid(root)[0] == 3
+
+
+# ---------------------------------------------------------------------------
+# OOV policy
+# ---------------------------------------------------------------------------
+
+
+def test_oov_counted_and_clip_numerics_unchanged():
+  mesh = create_mesh(WORLD)
+  model, plan, rule, opt = build(WORLD)
+  batch = make_batch(WORLD)
+  state = init_state(model, plan, rule, opt, batch, mesh)
+  step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
+                                state, batch, donate=False, guard=True)
+  numerical, cats, labels = batch
+  oov_cats = [c.copy() for c in cats]
+  oov_cats[0][:3] = VOCAB[0] + 7   # 3 OOV occurrences on input 0
+  oov_cats[1][0] = 10 ** 8         # 1 on input 1
+  s1, _, m = step(state, *shard_batch((numerical, oov_cats, labels), mesh))
+  assert sum(int(v) for v in m["oov"].values()) == 4
+  assert int(m["bad_step"]) == 0
+  # clip semantics: identical to pre-clamped ids
+  clamped = [np.clip(c, 0, v - 1) for c, v in zip(oov_cats, VOCAB)]
+  s2, _, m2 = step(state, *shard_batch((numerical, clamped, labels), mesh))
+  assert sum(int(v) for v in m2["oov"].values()) == 0
+  assert_trees_equal(jax.device_get(s1), jax.device_get(s2))
+
+
+def test_oov_error_policy_raises_eagerly():
+  _, plan, _, _ = build(1, oov="error")
+  engine = DistributedLookup(plan)
+  cats = [np.zeros((4,), np.int32) for _ in VOCAB]
+  cats[2][1] = VOCAB[2] + 5
+  with pytest.raises(ValueError, match="OOV policy 'error'"):
+    engine.route_ids([jnp.asarray(c) for c in cats])
+
+
+def test_oov_error_policy_raises_from_metrics(tmp_path):
+  mesh = create_mesh(WORLD)
+  model, plan, rule, opt = build(WORLD, oov="error")
+  batch = make_batch(WORLD)
+  state = init_state(model, plan, rule, opt, batch, mesh)
+  step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
+                                state, batch, donate=False, guard=True)
+  t = ResilientTrainer(step, state, plan, rule,
+                       os.path.join(tmp_path, "oov"), mesh=mesh,
+                       snapshot_every=0)
+  numerical, cats, labels = batch
+  t.step(*shard_batch(batch, mesh))  # clean batch passes
+  before = jax.device_get(t.state)
+  bad_cats = [c.copy() for c in cats]
+  bad_cats[0][0] = VOCAB[0] + 1
+  with pytest.raises(ValueError, match="OOV policy 'error'"):
+    t.step(*shard_batch((numerical, bad_cats, labels), mesh))
+  # the offending batch is commit-gated: the raise fires with the state
+  # bit-identical to before the batch (nothing trained the clipped row)
+  assert_trees_equal(before, jax.device_get(t.state))
+  # ...but the batch IS fully accounted before the raise, so a
+  # supervisor that catches it can snapshot a consistent position
+  assert t.consumed == t.step_count + t.skipped_steps == 2
+  assert sum(t.oov_totals.values()) == 1
+
+
+def test_oov_error_policy_requires_guard():
+  model, plan, rule, opt = build(1, oov="error")
+  batch = make_batch(1)
+  state = init_state(model, plan, rule, opt, batch)
+  with pytest.raises(ValueError, match="requires make_sparse_train_step"):
+    make_sparse_train_step(model, plan, bce_loss, opt, rule, None,
+                           state, batch, donate=False, guard=False)
+
+
+# ---------------------------------------------------------------------------
+# guards unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_all_finite_and_bad_step_counter():
+  assert bool(guards.all_finite({"a": jnp.ones(3),
+                                 "i": jnp.arange(3)}))
+  assert not bool(guards.all_finite((jnp.ones(2),
+                                     jnp.array([1.0, np.nan]))))
+  assert not bool(guards.all_finite(jnp.array([np.inf])))
+  c = guards.BadStepCounter(2)
+  assert c.update(0) and c.update(1)
+  assert not c.update(1)          # second consecutive: abort
+  assert c.skipped == 2
+  c2 = guards.BadStepCounter(None)
+  assert all(c2.update(1) for _ in range(10))
+
+
+# ---------------------------------------------------------------------------
+# Retry + host-tier store bounds (tiering surgery)
+# ---------------------------------------------------------------------------
+
+
+def _tiered_fixture():
+  from distributed_embeddings_tpu.layers.embedding import TableConfig
+  from distributed_embeddings_tpu.models.dlrm import _dlrm_initializer
+  from distributed_embeddings_tpu.tiering import (
+      HostTierStore,
+      TieringConfig,
+      TieringPlan,
+  )
+  vocab = [4096, 64]
+  plan = DistEmbeddingStrategy(
+      [TableConfig(input_dim=v, output_dim=16,
+                   initializer=_dlrm_initializer(v)) for v in vocab],
+      WORLD, "memory_balanced", dense_row_threshold=0,
+      host_row_threshold=1000)
+  rule = sparse_rule("adagrad", 0.05)
+  tplan = TieringPlan(plan, rule, TieringConfig(cache_fraction=0.25,
+                                                staging_grps=64))
+  store = HostTierStore(tplan)
+  store.init_uniform(0)
+  return plan, tplan, store
+
+
+def test_store_bounds_check_names_class_and_index():
+  _, tplan, store = _tiered_fixture()
+  name = next(iter(tplan.tier_specs))
+  phys = tplan.by_name(name).layout_logical.phys_rows
+  with pytest.raises(IndexError) as ei:
+    store.gather(name, 0, np.array([0, phys + 3], np.int64))
+  msg = str(ei.value)
+  assert name in msg and str(phys + 3) in msg and str(phys) in msg
+  with pytest.raises(IndexError, match="-1"):
+    store.scatter(name, 1, np.array([-1], np.int64),
+                  np.zeros((1, tplan.by_name(name).layout_logical.phys_width),
+                           np.float32))
+  # in-range passes
+  rows = store.gather(name, 0, np.array([0, 1], np.int32))
+  assert rows.shape[0] == 2
+
+
+def test_host_gather_transient_errors_are_retried():
+  from distributed_embeddings_tpu.tiering import TieredPrefetcher
+  plan, tplan, store = _tiered_fixture()
+  pf = TieredPrefetcher(tplan, store, mesh=None,
+                        retry_policy=RetryPolicy(retries=3, backoff=0.0))
+  rng = np.random.default_rng(0)
+  cats = [rng.integers(0, v, 8 * WORLD).astype(np.int32)
+          for v in (4096, 64)]
+  with faultinject.injected(FaultInjector().fail_first("host_gather", 2)):
+    staged = pf.stage(pf.classify(cats))
+  assert pf.host_gather_retries == 2
+  assert staged.device["rows"]  # staging upload produced
+
+
+def test_host_gather_retries_exhausted_raises():
+  from distributed_embeddings_tpu.tiering import TieredPrefetcher
+  plan, tplan, store = _tiered_fixture()
+  pf = TieredPrefetcher(tplan, store, mesh=None,
+                        retry_policy=RetryPolicy(retries=1, backoff=0.0))
+  rng = np.random.default_rng(0)
+  cats = [rng.integers(0, v, 8 * WORLD).astype(np.int32)
+          for v in (4096, 64)]
+  with faultinject.injected(FaultInjector().fail_first("host_gather", 10)):
+    with pytest.raises(TransientIOError, match="retries exhausted"):
+      pf.stage(pf.classify(cats))
+
+
+# ---------------------------------------------------------------------------
+# Chaos harness (tools/chaos_train.py): long variant is slow-marked so
+# tier-1 stays fast; `make chaos` runs the short standalone form
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_long():
+  import sys
+  sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+  import chaos_train
+  res = chaos_train.run_chaos(steps=48, nan_every=5, snapshot_every=4,
+                              crash_at_write_event=50, verbose=False)
+  assert res["ok"], res

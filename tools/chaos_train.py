@@ -1,0 +1,188 @@
+"""Chaos training run: injected faults on a CPU mesh, must skip/resume/converge.
+
+The CI-facing proof that the resilience subsystem composes: one short
+DLRM training run on the virtual CPU mesh is hit with — in one process,
+deterministically —
+
+1. **NaN batches** (an upstream feature-pipeline failure): the guarded
+   step must skip each one bit-exactly and count it;
+2. **a transient checkpoint-write error**: the durable save must retry
+   and still publish a valid checkpoint;
+3. **a kill mid-checkpoint-save** (preemption): the run dies with a
+   manifest-less ``.tmp``; a fresh trainer must auto-resume from the
+   last durable checkpoint;
+4. after resume, the completed run's loss trajectory must be
+   BIT-FOR-BIT identical to an uninterrupted reference run over the same
+   stream, the skipped-step count must match the injected NaN count, and
+   the post-warmup loss must have improved (the run converges despite
+   the chaos).
+
+Run directly (``make chaos``) — prints a JSON verdict, exit code 0/1 —
+or through the ``@pytest.mark.slow`` wrapper in
+``tests/test_resilience.py`` with a longer schedule.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+if __name__ == "__main__":  # standalone: build the virtual CPU mesh
+  flags = os.environ.get("XLA_FLAGS", "")
+  if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+  os.environ.setdefault("JAX_PLATFORMS", "cpu")
+  sys.path.insert(0, os.path.dirname(os.path.dirname(
+      os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from distributed_embeddings_tpu.layers.planner import (  # noqa: E402
+    DistEmbeddingStrategy,
+)
+from distributed_embeddings_tpu.models import DLRM, bce_loss  # noqa: E402
+from distributed_embeddings_tpu.ops.packed_table import sparse_rule  # noqa: E402
+from distributed_embeddings_tpu.parallel import create_mesh  # noqa: E402
+from distributed_embeddings_tpu.resilience import (  # noqa: E402
+    FaultInjector,
+    InjectedCrash,
+    durable,
+    faultinject,
+)
+from distributed_embeddings_tpu.resilience.trainer import (  # noqa: E402
+    ResilientTrainer,
+)
+from distributed_embeddings_tpu.training import (  # noqa: E402
+    init_sparse_state,
+    make_sparse_train_step,
+    shard_params,
+)
+
+WORLD = 4
+VOCAB = [500, 300, 150, 20]
+
+
+def _batches(n, world, seed=7, n_unique=6):
+  """A cycled set of ``n_unique`` labeled batches: repetition makes the
+  loss drop reliably within a short chaos run (the check is "training
+  still learns through the chaos", not generalization)."""
+  rng = np.random.default_rng(seed)
+  b = 8 * world
+  out = []
+  for _ in range(n_unique):
+    numerical = rng.standard_normal((b, 13)).astype(np.float32)
+    cats = [rng.integers(0, v, b).astype(np.int32) for v in VOCAB]
+    labels = (numerical[:, 0] > 0).astype(np.float32)
+    out.append((numerical, cats, labels))
+  return [out[i % n_unique] for i in range(n)]
+
+
+def _traj_equal(a, b):
+  """Bit-for-bit loss-trajectory equality; skipped steps' NaN losses
+  compare equal to each other (NaN != NaN under ==)."""
+  return len(a) == len(b) and all(
+      (np.isnan(x) and np.isnan(y)) or x == y for x, y in zip(a, b))
+
+
+def run_chaos(steps: int = 24, nan_every: int = 7, snapshot_every: int = 4,
+              crash_at_write_event: int = 30, verbose: bool = True) -> dict:
+  """Run the chaos scenario; returns a result dict with ``ok``."""
+  mesh = create_mesh(WORLD)
+  model = DLRM(vocab_sizes=VOCAB, embedding_dim=16, bottom_mlp=(32, 16),
+               top_mlp=(32, 1), world_size=WORLD, dense_row_threshold=32)
+  plan = DistEmbeddingStrategy(
+      [dict(input_dim=v, output_dim=16,
+            initializer={"name": "uniform", "scale": 0.05}) for v in VOCAB],
+      WORLD, "basic", dense_row_threshold=32)
+  rule = sparse_rule("adagrad", 0.05)
+  opt = optax.adagrad(0.05)
+  batches = _batches(steps, WORLD)
+  nan_steps = set(range(nan_every - 1, steps, nan_every))
+  stream = list(faultinject.nan_batches(batches, at_steps=nan_steps))
+
+  def fresh_state():
+    numerical, cats, _ = batches[0]
+    params = model.init(jax.random.PRNGKey(0), numerical,
+                        [np.asarray(c) for c in cats])["params"]
+    return shard_params(init_sparse_state(plan, params, rule, opt), mesh)
+
+  state0 = fresh_state()
+  step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
+                                state0, batches[0], donate=False, guard=True)
+
+  root_ref = tempfile.mkdtemp(prefix="chaos_ref_")
+  root = tempfile.mkdtemp(prefix="chaos_")
+
+  # ---- uninterrupted reference ------------------------------------------
+  ref = ResilientTrainer(step, fresh_state(), plan, rule, root_ref,
+                         mesh=mesh, snapshot_every=snapshot_every)
+  losses_ref = ref.run(stream)
+
+  # ---- chaos run: transient write fault + crash mid-save ----------------
+  inj = (FaultInjector()
+         .fail_first("ckpt_write", 1)            # retried by save_rotating
+         .crash_after("ckpt_write", crash_at_write_event))
+  t = ResilientTrainer(step, fresh_state(), plan, rule, root, mesh=mesh,
+                       snapshot_every=snapshot_every)
+  losses = []
+  crashed = False
+  from distributed_embeddings_tpu.training import shard_batch
+  try:
+    with faultinject.injected(inj):
+      for batch in stream:
+        losses.append(t.step(*shard_batch(batch, mesh)))
+  except InjectedCrash:
+    crashed = True
+  committed_at_crash = t.step_count
+
+  # ---- restart: fresh process stand-in, auto-resume ---------------------
+  t2 = ResilientTrainer(step, fresh_state(), plan, rule, root, mesh=mesh,
+                        snapshot_every=snapshot_every)
+  resumed_at = t2.consumed  # checkpointed STREAM position (commits + skips)
+  losses_resumed = t2.run(stream[resumed_at:]) if crashed else []
+  trajectory = losses[:resumed_at] + losses_resumed
+
+  finite_ref = [l for l in losses_ref if np.isfinite(l)]
+  k = max(1, len(finite_ref) // 4)
+  loss_head = float(np.mean(finite_ref[:k]))
+  loss_tail = float(np.mean(finite_ref[-k:]))
+  result = {
+      "steps": steps,
+      "crashed": crashed,
+      "committed_at_crash": committed_at_crash,
+      "resumed_at_batch": resumed_at,
+      "resumed_from": t2.resumed_from,
+      # the resumed trainer adopts the checkpoint's persisted skip count
+      # and re-skips the replayed poison, so its total covers the WHOLE
+      # logical run — every injected NaN batch, counted exactly once
+      "skipped_total": t2.skipped_steps,
+      "expected_skips": len(nan_steps),
+      "final_step": t2.step_count if crashed else t.step_count,
+      "trajectory_bit_exact": _traj_equal(trajectory, losses_ref),
+      "loss_head_mean": loss_head,
+      "loss_tail_mean": loss_tail,
+      "checkpoints": [s for s, _ in durable.list_checkpoints(root)],
+      # injection CONFIG, not telemetry: the first ckpt write raises a
+      # TransientIOError that save_rotating must retry through — the run
+      # only reaches a resumable checkpoint (checked above) if it did
+      "ckpt_write_faults_injected": 1,
+  }
+  expected_committed = steps - len(nan_steps)
+  result["ok"] = bool(
+      crashed
+      and result["trajectory_bit_exact"]
+      and t2.skipped_steps == result["expected_skips"]
+      and result["final_step"] == expected_committed
+      and loss_tail < loss_head)
+  if verbose:
+    print(json.dumps(result, indent=1))
+  return result
+
+
+if __name__ == "__main__":
+  res = run_chaos()
+  print("CHAOS:", "PASS" if res["ok"] else "FAIL")
+  sys.exit(0 if res["ok"] else 1)
