@@ -5,14 +5,22 @@
 PY ?= python
 SHELL := /bin/bash  # verify uses pipefail/PIPESTATUS
 
-.PHONY: test test-fast verify native bench dryrun chaos clean
+.PHONY: test test-fast verify lint native bench dryrun chaos clean
 
 test:
 	$(PY) -m pytest tests/ -q
 
+# repo-invariant linter: AST rules (GL1xx) + trace-time jaxpr audit of
+# the step builders against committed fingerprints (tests/data/).
+# Regenerate fingerprints after an INTENTIONAL structural change with
+#   $(PY) tools/graftlint.py --update-fingerprints
+lint:
+	$(PY) tools/graftlint.py
+
 # the tier-1 gate, exactly as ROADMAP.md specifies it (CPU mesh, no slow
-# tests, collection errors surfaced but not fatal to the log)
-verify:
+# tests, collection errors surfaced but not fatal to the log); lint runs
+# first so invariant violations fail fast
+verify: lint
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

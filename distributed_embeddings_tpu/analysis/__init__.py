@@ -1,0 +1,33 @@
+"""Static analysis: repo-invariant linting and trace-time jaxpr auditing.
+
+Three invariant-heavy subsystems — the fused packed lookups, tiered
+host/device storage, and durable/guarded training — have correctness
+rules that no unit test states directly: one scatter-add per table class,
+no host sync inside jitted step code, fsync before rename in every
+durable write, deterministic manifests. PAPERS.md's ads-infrastructure
+paper attributes production reliability to exactly this kind of
+automated invariant checking around the training loop. This package
+makes the rules machine-checked:
+
+- :mod:`astlint`: an AST lint pass over the repo's Python sources with a
+  rule registry (`GL1xx` rules, error/warning severity, line-level
+  ``# graftlint: disable=RULE`` suppressions).
+- :mod:`jaxpr_audit`: abstractly traces the REAL step builders
+  (``make_sparse_train_step`` guarded and not, ``make_tiered_train_step``,
+  the fused eval step) on a virtual CPU mesh via ``jax.make_jaxpr`` and
+  asserts structural invariants of the traced program, plus a persisted
+  per-artifact "jaxpr fingerprint" (op-class counts) so regressions diff
+  loudly.
+
+``tools/graftlint.py`` (``make lint``) runs both; ``make verify`` runs
+lint before the tier-1 tests.
+"""
+
+from .astlint import (  # noqa: F401
+    Finding,
+    RULES,
+    lint_paths,
+    lint_source,
+)
+
+__all__ = ["Finding", "RULES", "lint_paths", "lint_source"]

@@ -1,0 +1,541 @@
+"""AST lint pass enforcing repo invariants (stdlib-only, no jax import).
+
+Rules live in a registry (:data:`RULES`); each carries a stable ID, a
+severity (``error`` fails the lint, ``warning`` reports), and a one-line
+contract. A finding on a line carrying ``# graftlint: disable=<ID>``
+(comma-separated IDs, or ``all``) is suppressed — the comment is the
+reviewed-and-intentional marker, so every suppression should say why on
+the same line or the one above.
+
+The rule catalog (see `docs/ARCHITECTURE.md` §12 for the long form):
+
+==========  =========  =====================================================
+ID          severity   invariant
+==========  =========  =====================================================
+GL101       error      no host sync (``jax.device_get`` /
+                       ``.block_until_ready()`` / ``.item()``) inside
+                       trace-reachable step-builder code
+GL102       error      no ``np.*`` / ``numpy.*`` calls on traced values
+                       inside trace-reachable step-builder code
+GL103       error      no bare ``except:`` anywhere
+GL104       error      durable paths never ``os.rename``/``os.replace``
+                       without an fsync earlier in the same function
+GL105       error      no wall clock / RNG in durable (checkpoint /
+                       manifest) modules — manifests must be deterministic
+GL106       error      int32 casts of index ARITHMETIC (overflow at vocab
+                       scale) — widen to int64, bound, then narrow a value
+GL107       error      every ``pytest.mark.<name>`` is registered in
+                       ``pyproject.toml`` (a typo'd marker silently
+                       deselects)
+GL108       error      fault-injection site literals must be registered in
+                       ``resilience.faultinject.SITES``
+==========  =========  =====================================================
+
+Trace-reachable scope (GL101/GL102) is structural: any function nested —
+at any depth — inside a module-level builder whose name matches
+``make_*step*`` / ``make_*eval*`` (``local_step``, ``body``,
+``loss_with``, the guard closures, ...) is traced by ``jax.jit`` /
+``shard_map`` when the built step runs. Host syncs there either silently
+serialize the device pipeline or break tracing outright; host-side code
+(trainers, checkpoint I/O, the builders' own plan-time setup) is
+unrestricted. The lookup engine's methods are not statically reachable
+this way — the jaxpr audit (:mod:`.jaxpr_audit`) covers them dynamically
+end to end.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+STEP_BUILDER_RE = re.compile(r"^make_\w*(step|eval)\w*$")
+DURABLE_PATH_RE = re.compile(r"(checkpoint|durable)")
+SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+# pytest's own marks — always registered
+BUILTIN_MARKS = frozenset({
+    "parametrize", "skip", "skipif", "xfail", "usefixtures",
+    "filterwarnings", "tryfirst", "trylast",
+})
+
+HOST_SYNC_ATTRS = frozenset({"block_until_ready", "item"})
+HOST_SYNC_JAX_FUNCS = frozenset({"device_get"})
+WALLCLOCK_CALLS = frozenset({
+    ("time", "time"), ("time", "time_ns"),
+    ("datetime", "now"), ("datetime", "utcnow"), ("datetime", "today"),
+})
+RENAME_FUNCS = frozenset({("os", "rename"), ("os", "replace"),
+                          ("shutil", "move")})
+INT32_NAMES = frozenset({"int32", "uint32"})
+FAULT_RULE_METHODS = frozenset({"crash_after", "fail_first"})
+
+
+@dataclass(frozen=True)
+class Finding:
+  rule: str
+  severity: str
+  path: str
+  line: int
+  message: str
+
+  def render(self) -> str:
+    return (f"{self.path}:{self.line}: {self.severity} {self.rule}: "
+            f"{self.message}")
+
+
+@dataclass
+class Rule:
+  id: str
+  severity: str
+  title: str
+  check: Callable[["ParsedModule"], List[Finding]]
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def _rule(rule_id: str, severity: str, title: str):
+  def deco(fn):
+    RULES[rule_id] = Rule(rule_id, severity, title, fn)
+    return fn
+  return deco
+
+
+@dataclass
+class LintContext:
+  """Repo-level facts rules consult (parsed once per lint run)."""
+  registered_markers: frozenset = frozenset()
+  fault_sites: Optional[frozenset] = None  # None: registry not found
+
+  @classmethod
+  def for_repo(cls, root: str) -> "LintContext":
+    return cls(registered_markers=_parse_markers(root),
+               fault_sites=_parse_fault_sites(root))
+
+
+@dataclass
+class ParsedModule:
+  path: str
+  source: str
+  tree: ast.Module
+  ctx: LintContext
+  lines: List[str] = field(init=False)
+
+  def __post_init__(self):
+    self.lines = self.source.splitlines()
+
+  def finding(self, rule_id: str, node: ast.AST, msg: str) -> Finding:
+    return Finding(rule_id, RULES[rule_id].severity, self.path,
+                   getattr(node, "lineno", 0), msg)
+
+  def suppressed(self, f: Finding) -> bool:
+    if not (1 <= f.line <= len(self.lines)):
+      return False
+    m = SUPPRESS_RE.search(self.lines[f.line - 1])
+    if not m:
+      return False
+    ids = {s.strip() for s in m.group(1).split(",")}
+    return f.rule in ids or "all" in ids
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+  """``a.b.c`` attribute/name chain as a string, else None."""
+  parts = []
+  while isinstance(node, ast.Attribute):
+    parts.append(node.attr)
+    node = node.value
+  if isinstance(node, ast.Name):
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+  return None
+
+
+def _call_pair(call: ast.Call):
+  """(module_root, func_name) of a call. The name is the final attribute
+  (``x.y.astype`` -> ``astype``, even when the chain roots in another
+  call); the root is the leading Name when the chain has one."""
+  d = _dotted(call.func)
+  if d and "." in d:
+    parts = d.split(".")
+    return parts[0], parts[-1]
+  if isinstance(call.func, ast.Attribute):
+    return None, call.func.attr
+  return None, d
+
+
+def _traced_functions(tree: ast.Module) -> List[ast.AST]:
+  """Function bodies that are traced when a built step runs: every
+  function nested inside a ``make_*step*``/``make_*eval*`` builder."""
+  out = []
+
+  class V(ast.NodeVisitor):
+    def _visit_fn(self, node):
+      if STEP_BUILDER_RE.match(node.name):
+        for sub in ast.walk(node):
+          if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)) and sub is not node:
+            out.append(sub)
+      else:
+        self.generic_visit(node)
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+  V().visit(tree)
+  return out
+
+
+def _is_const_expr(node: ast.AST) -> bool:
+  if isinstance(node, ast.Constant):
+    return True
+  if isinstance(node, ast.BinOp):
+    return _is_const_expr(node.left) and _is_const_expr(node.right)
+  if isinstance(node, ast.UnaryOp):
+    return _is_const_expr(node.operand)
+  return False
+
+
+def _is_durable_module(path: str) -> bool:
+  """GL104/GL105 scope: library modules on the checkpoint/durable write
+  path. Test files are exempt (they corrupt files and draw RNG batches
+  on purpose)."""
+  base = os.path.basename(path)
+  return bool(DURABLE_PATH_RE.search(base)) and not base.startswith("test_")
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+
+@_rule("GL101", "error",
+       "no host sync inside trace-reachable step-builder code")
+def _check_host_sync(mod: ParsedModule) -> List[Finding]:
+  out = []
+  for fn in _traced_functions(mod.tree):
+    for node in ast.walk(fn):
+      if not isinstance(node, ast.Call):
+        continue
+      root, name = _call_pair(node)
+      if name in HOST_SYNC_ATTRS and isinstance(node.func, ast.Attribute):
+        out.append(mod.finding(
+            "GL101", node,
+            f".{name}() inside trace-reachable step code: a host sync "
+            "here serializes the device pipeline (or breaks tracing). "
+            "Sync on the host side of the step boundary instead."))
+      elif name in HOST_SYNC_JAX_FUNCS and root in ("jax", None):
+        out.append(mod.finding(
+            "GL101", node,
+            f"jax.{name}() inside trace-reachable step code — fetch "
+            "values on the host after the step returns."))
+  return out
+
+
+@_rule("GL102", "error",
+       "no numpy calls on traced values inside step-builder code")
+def _check_numpy_in_trace(mod: ParsedModule) -> List[Finding]:
+  out = []
+  for fn in _traced_functions(mod.tree):
+    for node in ast.walk(fn):
+      if isinstance(node, ast.Call):
+        root, name = _call_pair(node)
+        if root in ("np", "numpy"):
+          out.append(mod.finding(
+              "GL102", node,
+              f"{root}.{name}(...) inside trace-reachable step code: "
+              "numpy forces concretization of traced values (silent "
+              "host round-trip or a TracerError). Use jnp, or hoist the "
+              "constant computation to build time."))
+  return out
+
+
+@_rule("GL103", "error", "no bare except")
+def _check_bare_except(mod: ParsedModule) -> List[Finding]:
+  out = []
+  for node in ast.walk(mod.tree):
+    if isinstance(node, ast.ExceptHandler) and node.type is None:
+      out.append(mod.finding(
+          "GL103", node,
+          "bare 'except:' swallows KeyboardInterrupt/SystemExit and every "
+          "injected fault — name the exception types (the resilience "
+          "layer depends on faults propagating)."))
+  return out
+
+
+@_rule("GL104", "error",
+       "durable paths must fsync before rename/replace")
+def _check_unfsynced_rename(mod: ParsedModule) -> List[Finding]:
+  if not _is_durable_module(mod.path):
+    return []
+  out = []
+  for node in ast.walk(mod.tree):
+    if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+      continue
+    renames, fsync_lines = [], []
+    for sub in ast.walk(node):
+      if isinstance(sub, ast.Call):
+        root, name = _call_pair(sub)
+        if (root, name) in RENAME_FUNCS:
+          renames.append(sub)
+        elif name and "fsync" in name:
+          fsync_lines.append(sub.lineno)
+    for rn in renames:
+      if not any(line < rn.lineno for line in fsync_lines):
+        out.append(mod.finding(
+            "GL104", rn,
+            f"{_dotted(rn.func)}() with no fsync earlier in "
+            f"'{node.name}': a rename published before the data is "
+            "synced can survive a crash as a complete-looking, "
+            "torn checkpoint. fsync every written file (and the tmp "
+            "dir) first."))
+  return out
+
+
+@_rule("GL105", "error",
+       "no wall clock / RNG in durable (manifest-writing) modules")
+def _check_wallclock_in_durable(mod: ParsedModule) -> List[Finding]:
+  if not _is_durable_module(mod.path):
+    return []
+  out = []
+  for node in ast.walk(mod.tree):
+    if not isinstance(node, ast.Call):
+      continue
+    root, name = _call_pair(node)
+    dotted = _dotted(node.func) or ""
+    if (root, name) in WALLCLOCK_CALLS or dotted.startswith("np.random.") \
+        or dotted.startswith("numpy.random.") \
+        or dotted.startswith("random."):
+      out.append(mod.finding(
+          "GL105", node,
+          f"{dotted}() in a durable module: checkpoint contents and "
+          "manifests must be deterministic functions of the train state "
+          "(bit-exact resume, content-addressed verification). Derive "
+          "ordering/ids from the step counter or file contents."))
+  return out
+
+
+@_rule("GL106", "error",
+       "int32 casts of index arithmetic (vocab-scale overflow)")
+def _check_int32_narrowing(mod: ParsedModule) -> List[Finding]:
+  out = []
+
+  # The arithmetic must be on the VALUE path of the cast: a `*`/`+` in an
+  # opaque call's arguments (an RNG bound, a shape) is not index math
+  # being narrowed. Element-wise value-propagating calls are followed.
+  value_prop = frozenset({
+      "minimum", "maximum", "clip", "where", "concatenate", "stack",
+      "reshape", "ravel", "cumsum", "sum", "prod", "mod", "abs",
+      "floor_divide", "add", "multiply", "subtract",
+  })
+
+  def is_zero_mult(node: ast.BinOp) -> bool:
+    # `x * 0` — the varying-zero dependency idiom; the value is 0
+    return isinstance(node.op, ast.Mult) and any(
+        isinstance(s, ast.Constant) and s.value == 0
+        for s in (node.left, node.right))
+
+  def has_arith(node: ast.AST) -> bool:
+    if isinstance(node, ast.BinOp):
+      if isinstance(node.op, (ast.Mult, ast.Add, ast.LShift, ast.Pow)) \
+          and not _is_const_expr(node) and not is_zero_mult(node):
+        return True
+      return has_arith(node.left) or has_arith(node.right)
+    if isinstance(node, ast.Call):
+      _, name = _call_pair(node)
+      if name in value_prop:
+        return any(has_arith(a) for a in node.args)
+      return False
+    if isinstance(node, (ast.Tuple, ast.List)):
+      return any(has_arith(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp):
+      return has_arith(node.operand)
+    return False
+
+  def is_int32_ref(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and node.value in INT32_NAMES:
+      return True
+    d = _dotted(node)
+    return bool(d) and d.split(".")[-1] in INT32_NAMES
+
+  for node in ast.walk(mod.tree):
+    if not isinstance(node, ast.Call):
+      continue
+    root, name = _call_pair(node)
+    target = None
+    if name in INT32_NAMES and node.args:           # np.int32(expr)
+      target = node.args[0]
+    elif name == "astype" and isinstance(node.func, ast.Attribute) \
+        and node.args and is_int32_ref(node.args[0]):
+      target = node.func.value                       # expr.astype(int32)
+    elif name in ("asarray", "array") and len(node.args) >= 2 \
+        and is_int32_ref(node.args[1]):
+      target = node.args[0]                          # asarray(expr, int32)
+    elif name in ("asarray", "array") and node.args:
+      for kw in node.keywords:
+        if kw.arg == "dtype" and is_int32_ref(kw.value):
+          target = node.args[0]
+    if target is not None and has_arith(target):
+      out.append(mod.finding(
+          "GL106", node,
+          "int32 cast of an arithmetic expression: products/sums of "
+          "vocab-sized ints overflow 2^31 at the scales the planner "
+          "targets. Compute in int64 (numpy's default), bound the "
+          "result, then narrow the VALUE — or suppress with a comment "
+          "stating the proven bound."))
+  return out
+
+
+@_rule("GL107", "error", "every pytest.mark must be registered")
+def _check_markers(mod: ParsedModule) -> List[Finding]:
+  out = []
+  registered = mod.ctx.registered_markers | BUILTIN_MARKS
+  for node in ast.walk(mod.tree):
+    if isinstance(node, ast.Attribute):
+      d = _dotted(node)
+      if d and d.startswith("pytest.mark."):
+        mark = d.split(".")[2]
+        if mark not in registered:
+          out.append(mod.finding(
+              "GL107", node,
+              f"pytest.mark.{mark} is not registered in pyproject.toml "
+              "[tool.pytest.ini_options].markers — under "
+              "--strict-markers collection fails; without it a typo'd "
+              "marker silently deselects the test."))
+  return out
+
+
+@_rule("GL108", "error", "fault-injection sites must be registered")
+def _check_fault_sites(mod: ParsedModule) -> List[Finding]:
+  # the registry module itself defines the sites
+  if os.path.basename(mod.path) == "faultinject.py":
+    return []
+  sites = mod.ctx.fault_sites
+  out = []
+  for node in ast.walk(mod.tree):
+    if not isinstance(node, ast.Call):
+      continue
+    _, name = _call_pair(node)
+    if name == "fire" or name in FAULT_RULE_METHODS:
+      if not node.args or not isinstance(node.args[0], ast.Constant) \
+          or not isinstance(node.args[0].value, str):
+        continue
+      site = node.args[0].value
+      if sites is None:
+        out.append(mod.finding(
+            "GL108", node,
+            "faultinject.SITES registry not found — cannot validate "
+            f"site {site!r} (was the registry removed?)."))
+      elif site not in sites:
+        out.append(mod.finding(
+            "GL108", node,
+            f"unknown fault-injection site {site!r}: not in "
+            f"faultinject.SITES {sorted(sites)}. A typo'd site never "
+            "fires, so the test silently stops testing the fault."))
+  return out
+
+
+# ---------------------------------------------------------------------------
+# repo-context parsing (no imports of the target package)
+# ---------------------------------------------------------------------------
+
+
+def _parse_markers(root: str) -> frozenset:
+  """Marker names from pyproject [tool.pytest.ini_options].markers."""
+  pyproject = os.path.join(root, "pyproject.toml")
+  if not os.path.exists(pyproject):
+    return frozenset()
+  with open(pyproject) as f:
+    text = f.read()
+  try:
+    import tomllib
+    data = tomllib.loads(text)
+    markers = (data.get("tool", {}).get("pytest", {})
+               .get("ini_options", {}).get("markers", []))
+  except ModuleNotFoundError:  # py3.10: no tomllib; scrape the list
+    m = re.search(r"markers\s*=\s*\[(.*?)\]", text, re.S)
+    markers = re.findall(r"[\"']([^\"':]+):?[^\"']*[\"']",
+                         m.group(1)) if m else []
+  return frozenset(m.split(":")[0].strip() for m in markers)
+
+
+def _parse_fault_sites(root: str) -> Optional[frozenset]:
+  """The ``SITES`` literal from resilience/faultinject.py, by AST."""
+  path = os.path.join(root, "distributed_embeddings_tpu", "resilience",
+                      "faultinject.py")
+  if not os.path.exists(path):
+    return None
+  with open(path) as f:
+    tree = ast.parse(f.read())
+  for node in ast.walk(tree):
+    if isinstance(node, ast.Assign) and any(
+        isinstance(t, ast.Name) and t.id == "SITES" for t in node.targets):
+      consts = [s.value for s in ast.walk(node.value)
+                if isinstance(s, ast.Constant) and isinstance(s.value, str)]
+      if consts:
+        return frozenset(consts)
+  return None
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+
+def lint_source(source: str, path: str,
+                ctx: Optional[LintContext] = None,
+                rules: Optional[Iterable[str]] = None) -> List[Finding]:
+  """Lint one source string; returns unsuppressed findings."""
+  mod = ParsedModule(path, source, ast.parse(source), ctx or LintContext())
+  out = []
+  for rule_id in sorted(rules or RULES):
+    for f in RULES[rule_id].check(mod):
+      if not mod.suppressed(f):
+        out.append(f)
+  return sorted(out, key=lambda f: (f.path, f.line, f.rule))
+
+
+def _iter_py_files(paths: Sequence[str]):
+  for p in paths:
+    if os.path.isfile(p):
+      if p.endswith(".py"):
+        yield p
+    else:
+      for dirpath, dirnames, filenames in os.walk(p):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git", "dist")]
+        for fn in sorted(filenames):
+          if fn.endswith(".py"):
+            yield os.path.join(dirpath, fn)
+
+
+def lint_paths(paths: Sequence[str],
+               root: Optional[str] = None,
+               rules: Optional[Iterable[str]] = None) -> List[Finding]:
+  """Lint files/directories; ``root`` anchors the repo-context parse
+  (pyproject markers, fault-site registry). Defaults to the common
+  parent of ``paths``."""
+  if root is None:
+    root = os.path.commonpath([os.path.abspath(p) for p in paths]) \
+        if paths else os.getcwd()
+    while root != os.path.dirname(root) and not os.path.exists(
+        os.path.join(root, "pyproject.toml")):
+      root = os.path.dirname(root)
+  ctx = LintContext.for_repo(root)
+  out = []
+  for path in _iter_py_files(paths):
+    with open(path) as f:
+      source = f.read()
+    try:
+      out.extend(lint_source(source, path, ctx, rules))
+    except SyntaxError as e:
+      out.append(Finding("GL000", "error", path, e.lineno or 0,
+                         f"syntax error: {e.msg}"))
+  return out

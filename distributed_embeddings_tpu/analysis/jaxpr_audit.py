@@ -1,0 +1,399 @@
+"""Trace-time structural audit of the real step builders.
+
+``jax.make_jaxpr`` traces the ACTUAL artifacts — the fused sparse train
+step (guarded and not), the tiered train step, and the fused eval step —
+on a small virtual-CPU-mesh fixture, and this module asserts the
+invariants the whole performance/correctness story rests on, directly on
+the traced program:
+
+- **Exactly one scatter-add per fused table class** in the backward
+  (attributed by operand shape: each sparse class's local packed buffer
+  shape must receive exactly one ``scatter-add``). A second scatter on a
+  class buffer defeats XLA's input/output aliasing and copies the
+  multi-GiB buffer every step (ARCHITECTURE.md §3.2); zero scatters
+  means the class silently stopped training. The eval step must contain
+  NONE (a forward that writes is a bug).
+- **Collective hygiene**: every collective's axis names ⊆ the mesh's
+  axis names, and the guard's ``pmin`` (the collective bad-step verdict)
+  is present exactly once iff ``guard=True`` — a guarded step without
+  the pmin can fork replicated state across devices on a poison batch.
+- **No f64 leaks**: no equation produces a float64 value (CPU tracing
+  would hide what TPU lowering rejects; an f64 constant also doubles a
+  buffer).
+- **No host callbacks / infeed in the hot path**: ``pure_callback``,
+  ``io_callback``, ``debug_callback`` etc. serialize the device pipeline
+  per step.
+- **Jaxpr fingerprints**: per-artifact op-class counts persisted in
+  ``tests/data/jaxpr_fingerprints.json``. Any structural drift — an
+  extra collective, a vanished scatter, a new transfer — diffs loudly in
+  lint; intentional changes regenerate via
+  ``tools/graftlint.py --update-fingerprints``.
+
+The fixture is deliberately tiny (3 tables, width 16, world 4) so the
+audit traces in seconds; the invariants checked are scale-free.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+FINGERPRINT_PATH = os.path.join("tests", "data", "jaxpr_fingerprints.json")
+
+CALLBACK_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "python_callback",
+    "callback", "outside_call", "host_callback", "infeed", "outfeed",
+})
+
+
+def _jaxpr_types():
+  try:
+    from jax.core import ClosedJaxpr, Jaxpr
+  except ImportError:  # newer jax: moved to jax.extend
+    from jax.extend.core import ClosedJaxpr, Jaxpr
+  return ClosedJaxpr, Jaxpr
+
+
+def _subjaxprs(v) -> List[Any]:
+  ClosedJaxpr, Jaxpr = _jaxpr_types()
+  if isinstance(v, ClosedJaxpr):
+    return [v.jaxpr]
+  if isinstance(v, Jaxpr):
+    return [v]
+  if isinstance(v, (list, tuple)):
+    out = []
+    for x in v:
+      out.extend(_subjaxprs(x))
+    return out
+  return []
+
+
+def walk_eqns(jaxpr, _seen=None):
+  """Yield every equation across nested jaxprs, visiting each distinct
+  inner jaxpr once (pjit/custom_jvp params can alias the same jaxpr
+  under several keys — naive walks double-count)."""
+  if _seen is None:
+    _seen = set()
+  if id(jaxpr) in _seen:
+    return
+  _seen.add(id(jaxpr))
+  for eqn in jaxpr.eqns:
+    yield eqn
+    for v in eqn.params.values():
+      for sub in _subjaxprs(v):
+        yield from walk_eqns(sub, _seen)
+
+
+@dataclass
+class JaxprSummary:
+  """Everything the invariant checks need, extracted in one walk."""
+  counts: Counter = field(default_factory=Counter)
+  scatter_shapes: List[Tuple[int, ...]] = field(default_factory=list)
+  collective_axes: List[Tuple[str, Tuple[str, ...]]] = field(
+      default_factory=list)
+  f64_prims: List[str] = field(default_factory=list)
+  callback_prims: List[str] = field(default_factory=list)
+
+
+_COLLECTIVES = frozenset({
+    "psum", "psum2", "pmin", "pmax", "pmean", "all_to_all", "all_gather",
+    "ppermute", "pbroadcast", "reduce_scatter", "axis_index",
+})
+
+
+def summarize(jaxpr) -> JaxprSummary:
+  s = JaxprSummary()
+  for eqn in walk_eqns(jaxpr):
+    name = eqn.primitive.name
+    s.counts[name] += 1
+    if name.startswith("scatter"):
+      s.scatter_shapes.append(tuple(eqn.invars[0].aval.shape))
+    if name in _COLLECTIVES:
+      axes = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+      if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+      s.collective_axes.append(
+          (name, tuple(str(a) for a in axes)))
+    if name in CALLBACK_PRIMS or "callback" in name:
+      s.callback_prims.append(name)
+    for v in list(eqn.invars) + list(eqn.outvars):
+      aval = getattr(v, "aval", None)
+      dtype = getattr(aval, "dtype", None)
+      if dtype is not None and str(dtype) == "float64":
+        s.f64_prims.append(name)
+  return s
+
+
+def fingerprint(summary: JaxprSummary) -> Dict[str, int]:
+  """Stable op-class counts (the persisted regression signature)."""
+  return {k: int(v) for k, v in sorted(summary.counts.items())}
+
+
+@dataclass
+class Expectation:
+  """Structural invariants one artifact's jaxpr must satisfy."""
+  # sparse class name -> local packed buffer shape; each must receive
+  # exactly `scatters_per_class` scatter-adds (0 for eval)
+  class_shapes: Dict[str, Tuple[int, ...]]
+  mesh_axes: Tuple[str, ...]
+  guard: bool = False
+  scatters_per_class: int = 1
+
+
+def audit_summary(name: str, s: JaxprSummary, expect: Expectation
+                  ) -> List[str]:
+  """Check one artifact's summary; returns human-readable violations."""
+  out = []
+  for cname, shape in sorted(expect.class_shapes.items()):
+    n = sum(1 for sh in s.scatter_shapes if sh == tuple(shape))
+    if n != expect.scatters_per_class:
+      out.append(
+          f"{name}: class {cname} (local buffer {tuple(shape)}) receives "
+          f"{n} scatter-adds, expected {expect.scatters_per_class} — "
+          + ("a scatter chain copies the buffer every step"
+             if n > expect.scatters_per_class else
+             "the class is not being updated (or eval writes)"))
+  for prim, axes in s.collective_axes:
+    bad = [a for a in axes if a not in expect.mesh_axes]
+    if bad:
+      out.append(
+          f"{name}: collective {prim} over unknown axis names {bad} "
+          f"(mesh axes: {list(expect.mesh_axes)})")
+  pmin = s.counts.get("pmin", 0)
+  if expect.guard and pmin != 1:
+    out.append(
+        f"{name}: guard=True but {pmin} pmin collectives (expected "
+        "exactly 1) — without the AND-reduced verdict a poison batch "
+        "can commit on some devices and skip on others, forking the "
+        "replicated state")
+  if not expect.guard and pmin:
+    out.append(
+        f"{name}: guard=False but found {pmin} pmin collective(s) — an "
+        "unguarded step has no business reducing a verdict")
+  if s.f64_prims:
+    out.append(
+        f"{name}: float64 values produced by {sorted(set(s.f64_prims))} "
+        "— f64 leaks double buffer bytes and fail TPU lowering")
+  if s.callback_prims:
+    out.append(
+        f"{name}: host callback primitives in the hot path: "
+        f"{sorted(set(s.callback_prims))}")
+  return out
+
+
+# ---------------------------------------------------------------------------
+# the traced fixture: tiny real artifacts on a virtual CPU mesh
+# ---------------------------------------------------------------------------
+
+WORLD = 4
+VOCAB = (5000, 300, 40)   # host-tier / device-sparse / MXU-dense at the
+WIDTH = 16                # thresholds used below
+BATCH = 16
+
+
+def _require_cpu_devices():
+  import jax
+  if len(jax.devices()) < WORLD:
+    raise RuntimeError(
+        f"jaxpr audit needs >= {WORLD} devices (virtual CPU mesh); set "
+        "XLA_FLAGS=--xla_force_host_platform_device_count=8 and "
+        "JAX_PLATFORMS=cpu BEFORE importing jax (tools/graftlint.py and "
+        "tests/conftest.py both do).")
+
+
+def build_artifacts() -> Dict[str, Tuple[Any, Expectation]]:
+  """Build and abstractly trace the audited artifacts.
+
+  Returns ``{artifact_name: (jaxpr, Expectation)}`` for:
+
+  - ``sparse_step``:        ``make_sparse_train_step(guard=False)``
+  - ``sparse_step_guard``:  ``make_sparse_train_step(guard=True)``
+  - ``tiered_step``:        ``make_tiered_train_step`` (host-tier class)
+  - ``eval_step``:          ``make_sparse_eval_step`` (zero scatters)
+  """
+  _require_cpu_devices()
+  import jax
+  import jax.numpy as jnp
+  import numpy as np
+  import optax
+
+  from ..layers.embedding import TableConfig
+  from ..layers.planner import DistEmbeddingStrategy
+  from ..models import DLRM, bce_loss
+  from ..models.dlrm import _dlrm_initializer
+  from ..ops.packed_table import sparse_rule
+  from ..parallel import create_mesh
+  from ..parallel.lookup_engine import DistributedLookup, class_param_name
+  from ..tiering import HostTierStore, TieredPrefetcher, TieringConfig, \
+      TieringPlan
+  from ..tiering.train import init_tiered_state
+  from ..training import (
+      init_sparse_state_direct,
+      make_sparse_eval_step,
+      make_sparse_train_step,
+      make_tiered_train_step,
+      shard_batch,
+      shard_params,
+  )
+
+  mesh = create_mesh(WORLD)
+  mesh_axes = tuple(str(a) for a in mesh.axis_names)
+  rule = sparse_rule("adagrad", 0.05)
+  opt = optax.adam(1e-3)
+  model = DLRM(vocab_sizes=list(VOCAB), embedding_dim=WIDTH,
+               bottom_mlp=(32, WIDTH), top_mlp=(32, 1), world_size=WORLD,
+               strategy="memory_balanced", dense_row_threshold=60)
+
+  r = np.random.default_rng(0)
+  numerical = r.standard_normal((BATCH, 13)).astype(np.float32)
+  cats = [r.integers(0, v, BATCH, dtype=np.int32) for v in VOCAB]
+  labels = r.integers(0, 2, BATCH).astype(np.float32)
+  batch0 = (numerical, cats, labels)
+  dummy = [jnp.zeros((2, WIDTH), jnp.float32) for _ in VOCAB]
+  dense_params = model.init(
+      jax.random.PRNGKey(0), numerical[:2], [c[:2] for c in cats],
+      emb_acts=dummy)["params"]
+
+  def class_shapes(plan, layouts):
+    out = {}
+    for key in plan.class_keys:
+      if plan.classes[key].kind == "sparse":
+        name = class_param_name(*key)
+        lay = layouts[name]
+        out[name] = (lay.phys_rows, lay.phys_width)
+    return out
+
+  artifacts: Dict[str, Tuple[Any, Expectation]] = {}
+
+  # ---- all-device sparse step (guarded and not) + eval -------------------
+  plan = DistEmbeddingStrategy(
+      [TableConfig(input_dim=v, output_dim=WIDTH,
+                   initializer=_dlrm_initializer(v)) for v in VOCAB],
+      WORLD, "memory_balanced", dense_row_threshold=60)
+  engine = DistributedLookup(plan, dp_input=True)
+  shapes = class_shapes(plan, engine.fused_layouts(rule))
+  state = shard_params(
+      init_sparse_state_direct(plan, rule, dense_params, opt,
+                               jax.random.PRNGKey(1)), mesh)
+  bt = shard_batch(batch0, mesh)
+  for guard in (False, True):
+    step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
+                                  state, batch0, donate=False, guard=guard)
+    jx = jax.make_jaxpr(step)(state, *bt)
+    artifacts["sparse_step_guard" if guard else "sparse_step"] = (
+        jx.jaxpr, Expectation(shapes, mesh_axes, guard=guard))
+
+  ev = make_sparse_eval_step(model, plan, rule, mesh, state, batch0)
+  jx = jax.make_jaxpr(ev)(state, *bt[:2])
+  artifacts["eval_step"] = (
+      jx.jaxpr,
+      Expectation(shapes, mesh_axes, guard=False, scatters_per_class=0))
+
+  # ---- tiered step (host-tier class + device tiers) ----------------------
+  plan_t = DistEmbeddingStrategy(
+      [TableConfig(input_dim=v, output_dim=WIDTH,
+                   initializer=_dlrm_initializer(v)) for v in VOCAB],
+      WORLD, "memory_balanced", dense_row_threshold=60,
+      host_row_threshold=1000)
+  tplan = TieringPlan(plan_t, rule, TieringConfig(cache_fraction=0.3,
+                                                  staging_grps=64))
+  store = HostTierStore(tplan)
+  state_t = shard_params(
+      init_tiered_state(tplan, store, rule, dense_params, opt,
+                        jax.random.PRNGKey(2), mesh=mesh), mesh)
+  prefetcher = TieredPrefetcher(tplan, store, mesh)
+  staged = prefetcher.prepare(cats)
+  step_t = make_tiered_train_step(model, tplan, bce_loss, opt, rule, mesh,
+                                  state_t, batch0, donate=False)
+  # effective layouts: tiered classes' compact buffers grow by this
+  # step's staging shapes (see make_tiered_train_step)
+  engine_t = DistributedLookup(plan_t, dp_input=True)
+  layouts_t = dict(engine_t.fused_layouts(
+      rule, rows_overrides=tplan.rows_overrides))
+  from ..ops.packed_table import PackedLayout
+  for name, spec in tplan.tier_specs.items():
+    s = staged.s_eff[name]  # padded per-rank staging rows this step
+    layouts_t[name] = PackedLayout(
+        rows=(spec.cache_grps + s) * spec.rpp,
+        width=layouts_t[name].width, n_aux=rule.n_aux)
+  shapes_t = class_shapes(plan_t, layouts_t)
+  jx = jax.make_jaxpr(step_t)(state_t, staged.device, *bt)
+  artifacts["tiered_step"] = (
+      jx.jaxpr, Expectation(shapes_t, mesh_axes, guard=False))
+  return artifacts
+
+
+# ---------------------------------------------------------------------------
+# audit + fingerprint persistence
+# ---------------------------------------------------------------------------
+
+
+def run_audit(update_fingerprints: bool = False,
+              fingerprint_path: Optional[str] = None,
+              log: Callable[[str], None] = lambda s: None
+              ) -> Tuple[List[str], Dict[str, Dict[str, int]]]:
+  """Trace, audit, and diff fingerprints for every artifact.
+
+  Returns ``(violations, fingerprints)``. With ``update_fingerprints``
+  the persisted baselines are rewritten instead of diffed (structural
+  violations still report)."""
+  path = fingerprint_path or FINGERPRINT_PATH
+  violations: List[str] = []
+  prints: Dict[str, Dict[str, int]] = {}
+  artifacts = build_artifacts()
+  for name, (jaxpr, expect) in artifacts.items():
+    log(f"auditing {name} ...")
+    s = summarize(jaxpr)
+    violations.extend(audit_summary(name, s, expect))
+    prints[name] = fingerprint(s)
+
+  if update_fingerprints:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+      json.dump(prints, f, indent=1, sort_keys=True)
+      f.write("\n")
+    log(f"wrote {path}")
+    return violations, prints
+
+  if not os.path.exists(path):
+    violations.append(
+        f"no fingerprint baseline at {path} — run "
+        "`python tools/graftlint.py --update-fingerprints` and commit it")
+    return violations, prints
+  with open(path) as f:
+    baseline = json.load(f)
+  violations.extend(diff_fingerprints(baseline, prints))
+  return violations, prints
+
+
+def diff_fingerprints(baseline: Dict[str, Dict[str, int]],
+                      prints: Dict[str, Dict[str, int]]) -> List[str]:
+  """Loud per-op-class diff of traced fingerprints vs the committed
+  baseline (empty when identical)."""
+  out = []
+  for name, fp in prints.items():
+    base = baseline.get(name)
+    if base is None:
+      out.append(
+          f"{name}: no baseline fingerprint — regenerate with "
+          "--update-fingerprints")
+      continue
+    if base != fp:
+      drift = []
+      for k in sorted(set(base) | set(fp)):
+        a, b = base.get(k, 0), fp.get(k, 0)
+        if a != b:
+          drift.append(f"{k}: {a} -> {b}")
+      out.append(
+          f"{name}: jaxpr fingerprint drift ({'; '.join(drift)}). If "
+          "intentional, regenerate with "
+          "`python tools/graftlint.py --update-fingerprints`.")
+  for name in baseline:
+    if name not in prints:
+      out.append(
+          f"{name}: baseline fingerprint exists but artifact is no "
+          "longer audited — regenerate with --update-fingerprints")
+  return out

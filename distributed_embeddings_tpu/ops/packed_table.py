@@ -227,7 +227,10 @@ def init_packed_uniform(layout: PackedLayout, key: jax.Array,
   # overlap-safe starts: the tail chunk re-draws a few rows with a different
   # subkey, which keeps every row's scale mapping exact without a copy
   nchunks = -(-pr // cp)
-  starts = np.minimum(np.arange(nchunks) * cp, pr - cp).astype(np.int32)
+  # int64 product (numpy default), clamped to pr - cp < 2^31 (planner's
+  # per-buffer element cap) before the narrowing
+  starts = np.minimum(np.arange(nchunks) * cp,  # graftlint: disable=GL106
+                      pr - cp).astype(np.int32)
   buf = jnp.zeros((pr, layout.phys_width), dtype)
 
   def body(b, xs):

@@ -1753,9 +1753,11 @@ def pack_mp_inputs(plan: DistEmbeddingStrategy,
             slot = cp.slots_per_rank[rank][idxs[k]]
             x = slot_inputs[(key, rank, idxs[k])]
             rows = slot.shard.input_dim
-            routed = jnp.where(x < 0, sentinel,
+            # int32 wire format: bounded by clip to row_offset + rows <=
+            # padded class rows, planner-capped under 2^31
+            routed = jnp.where(x < 0, sentinel,  # graftlint: disable=GL106
                                jnp.clip(x, 0, rows - 1) + slot.row_offset
-                               ).astype(jnp.int32)  # int32 wire format
+                               ).astype(jnp.int32)
           else:
             routed = jnp.full((g, bucket.h), sentinel, jnp.int32)
           entries.append(routed)

@@ -34,6 +34,28 @@ from typing import Dict, Iterable, Optional, Tuple
 import numpy as np
 
 
+# The registry of instrumented sites. A rule installed for a name not in
+# this set can NEVER fire — historically such typos were silently ignored
+# and the test went on "passing" while testing nothing — so the injector
+# validates at rule-installation time, and the graftlint GL108 rule
+# cross-checks every site literal in the tree against this set (parsed
+# from the AST: keep it a literal).
+SITES = frozenset({"ckpt_write", "ckpt_rename", "host_gather"})
+
+_extra_sites = set()
+
+
+def register_site(site: str) -> str:
+  """Register an additional instrumented site name (for downstream /
+  experimental hooks). Returns ``site`` so it can be used inline."""
+  _extra_sites.add(site)
+  return site
+
+
+def known_sites() -> frozenset:
+  return SITES | frozenset(_extra_sites)
+
+
 class InjectedCrash(RuntimeError):
   """A simulated hard crash (preemption / SIGKILL stand-in).
 
@@ -60,17 +82,28 @@ class FaultInjector:
     self._fail_until: Dict[str, Tuple[int, type]] = {}
 
   # ---- rule installation -------------------------------------------------
+  @staticmethod
+  def _check_site(site: str) -> str:
+    if site not in known_sites():
+      raise ValueError(
+          f"unknown fault-injection site {site!r}: no instrumented code "
+          f"path consults it, so this rule would never fire and the test "
+          f"would silently test nothing. Valid sites: "
+          f"{sorted(known_sites())} (extend via "
+          "faultinject.register_site).")
+    return site
+
   def crash_after(self, site: str, n: int) -> "FaultInjector":
     """Raise :class:`InjectedCrash` on the ``n``-th event at ``site``
     (0-indexed: ``n=0`` crashes the first event)."""
-    self._crash_at[site] = n
+    self._crash_at[self._check_site(site)] = n
     return self
 
   def fail_first(self, site: str, k: int,
                  exc: type = TransientIOError) -> "FaultInjector":
     """Raise ``exc`` for the first ``k`` events at ``site``, then let
     every later event through — the canonical transient fault."""
-    self._fail_until[site] = (k, exc)
+    self._fail_until[self._check_site(site)] = (k, exc)
     return self
 
   # ---- observation -------------------------------------------------------

@@ -27,7 +27,5 @@ jax.config.update("jax_platforms", "cpu")
 assert len(jax.devices()) == 8, (
     f"expected 8 virtual CPU devices, got {jax.devices()}")
 
-
-def pytest_configure(config):
-  config.addinivalue_line(
-      "markers", "slow: long-running test (multi-process spawns, convergence)")
+# markers are registered in pyproject.toml [tool.pytest.ini_options]
+# (with --strict-markers, so an unregistered marker fails collection)

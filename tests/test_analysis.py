@@ -1,0 +1,390 @@
+"""Static-analysis suite: AST lint rules + trace-time jaxpr audit.
+
+Pins the three contracts `make lint` rests on:
+
+- every GL1xx rule FIRES on a seeded violation and is SILENCED by a
+  ``# graftlint: disable=...`` suppression;
+- the repo at HEAD is clean (so lint failures always mean a regression,
+  never noise);
+- the jaxpr audit proves the structural invariants on the REAL step
+  builders — exactly one scatter-add per fused class (sparse and
+  tiered), guard ``pmin`` present iff guarded, eval writes nothing —
+  and its fingerprints are stable across traces and match the committed
+  baseline in ``tests/data/``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_embeddings_tpu.analysis import astlint
+from distributed_embeddings_tpu.analysis import jaxpr_audit
+from distributed_embeddings_tpu.analysis.astlint import (
+    LintContext,
+    lint_paths,
+    lint_source,
+)
+from distributed_embeddings_tpu.analysis.jaxpr_audit import (
+    Expectation,
+    audit_summary,
+    diff_fingerprints,
+    fingerprint,
+    summarize,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CTX = LintContext(registered_markers=frozenset({"slow"}),
+                  fault_sites=frozenset({"ckpt_write", "host_gather"}))
+
+
+def _rules(findings):
+  return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# AST rules: seeded violations fire; suppressions silence
+# ---------------------------------------------------------------------------
+
+
+def test_gl101_host_sync_in_step_builder():
+  src = """
+def make_train_step(opt):
+  def local_step(state, batch):
+    loss = jax.device_get(state)
+    state.block_until_ready()
+    return loss
+  return local_step
+"""
+  assert _rules(lint_source(src, "m.py", CTX, ["GL101"])) == [
+      "GL101", "GL101"]
+
+
+def test_gl101_ignores_host_side_code():
+  src = """
+def trainer_loop(step, state):
+  return jax.device_get(step(state))
+
+def make_train_step(opt):
+  setup = jax.device_get(opt)  # builder body itself runs at build time?
+  def local_step(state):
+    return state
+  return local_step
+"""
+  # only functions NESTED in a builder are traced scope; the trainer
+  # and the builder's own top-level body are host-side
+  findings = lint_source(src, "m.py", CTX, ["GL101"])
+  assert findings == []
+
+
+def test_gl101_suppression():
+  src = """
+def make_eval_step(opt):
+  def local_eval(state):
+    return jax.device_get(state)  # graftlint: disable=GL101
+  return local_eval
+"""
+  assert lint_source(src, "m.py", CTX, ["GL101"]) == []
+
+
+def test_gl102_numpy_in_traced_scope():
+  src = """
+def make_sparse_train_step(plan):
+  def body(carry, mb):
+    return np.asarray(carry), None
+  return body
+"""
+  assert _rules(lint_source(src, "m.py", CTX, ["GL102"])) == ["GL102"]
+  ok = """
+def build_plan(plan):
+  return np.zeros((4, 4))
+"""
+  assert lint_source(ok, "m.py", CTX, ["GL102"]) == []
+
+
+def test_gl103_bare_except():
+  src = """
+def load(path):
+  try:
+    return open(path)
+  except:
+    return None
+"""
+  assert _rules(lint_source(src, "m.py", CTX, ["GL103"])) == ["GL103"]
+  assert lint_source(src.replace("except:", "except OSError:"),
+                     "m.py", CTX, ["GL103"]) == []
+
+
+def test_gl104_unfsynced_rename_in_durable_module():
+  bad = """
+import os
+def publish(tmp, live):
+  with open(tmp, 'w') as f:
+    f.write('data')
+  os.rename(tmp, live)
+"""
+  assert _rules(lint_source(bad, "checkpoint.py", CTX, ["GL104"])) == [
+      "GL104"]
+  # same code outside a durable module: out of scope
+  assert lint_source(bad, "loader.py", CTX, ["GL104"]) == []
+  good = """
+import os
+def publish(tmp, live):
+  with open(tmp, 'w') as f:
+    f.write('data')
+    os.fsync(f.fileno())
+  os.rename(tmp, live)
+"""
+  assert lint_source(good, "checkpoint.py", CTX, ["GL104"]) == []
+
+
+def test_gl105_wallclock_in_durable_module():
+  src = """
+import time
+def build_manifest(files):
+  return {"written_at": time.time(), "files": files}
+"""
+  assert _rules(lint_source(src, "durable.py", CTX, ["GL105"])) == [
+      "GL105"]
+  assert lint_source(src, "trainer.py", CTX, ["GL105"]) == []
+
+
+def test_gl106_int32_narrowing():
+  bad = """
+def row_offset(rank, rows):
+  return np.int32(rank * rows)
+"""
+  assert _rules(lint_source(bad, "m.py", CTX, ["GL106"])) == ["GL106"]
+  # astype flavor, through a value-propagating call
+  bad2 = """
+def starts(n, cp, pr):
+  return np.minimum(np.arange(n) * cp, pr - cp).astype(np.int32)
+"""
+  assert _rules(lint_source(bad2, "m.py", CTX, ["GL106"])) == ["GL106"]
+  # a narrowed VALUE (no arithmetic) and the varying-zero idiom are fine
+  ok = """
+def f(ids, carry):
+  a = ids.astype(jnp.int32)
+  b = (carry * 0).astype(jnp.int32)
+  c = jnp.asarray(rng.integers(0, rows + 2, 16), jnp.int32)
+  return a, b, c
+"""
+  assert lint_source(ok, "m.py", CTX, ["GL106"]) == []
+  sup = """
+def row_offset(rank, rows):
+  return np.int32(rank * rows)  # graftlint: disable=GL106
+"""
+  assert lint_source(sup, "m.py", CTX, ["GL106"]) == []
+
+
+def test_gl107_unregistered_marker():
+  src = """
+import pytest
+@pytest.mark.sloow
+def test_x():
+  pass
+"""
+  assert _rules(lint_source(src, "test_m.py", CTX, ["GL107"])) == ["GL107"]
+  assert lint_source(src.replace("sloow", "slow"), "test_m.py", CTX,
+                     ["GL107"]) == []
+  # builtin marks are always registered
+  assert lint_source(src.replace("sloow", "parametrize"), "test_m.py",
+                     CTX, ["GL107"]) == []
+
+
+def test_gl108_unknown_fault_site():
+  src = """
+def chaos(inj):
+  inj.crash_after("ckpt_writ", 3)
+  fire("host_gather", rank=0)
+"""
+  out = lint_source(src, "test_m.py", CTX, ["GL108"])
+  assert _rules(out) == ["GL108"]
+  assert "ckpt_writ" in out[0].message
+  assert lint_source(src.replace("ckpt_writ", "ckpt_write"), "test_m.py",
+                     CTX, ["GL108"]) == []
+
+
+# ---------------------------------------------------------------------------
+# repo-context parsing + HEAD cleanliness
+# ---------------------------------------------------------------------------
+
+
+def test_repo_context_parses_markers_and_sites():
+  ctx = LintContext.for_repo(REPO)
+  assert "slow" in ctx.registered_markers
+  assert ctx.fault_sites == frozenset(
+      {"ckpt_write", "ckpt_rename", "host_gather"})
+
+
+def test_repo_is_lint_clean_at_head():
+  paths = [os.path.join(REPO, p) for p in
+           ("distributed_embeddings_tpu", "tests", "tools", "examples")]
+  findings = lint_paths(paths, root=REPO)
+  assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_cli_exit_codes(tmp_path):
+  bad = tmp_path / "m.py"
+  bad.write_text("def f():\n  try:\n    pass\n  except:\n    pass\n")
+  env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+  r = subprocess.run(
+      [sys.executable, os.path.join(REPO, "tools", "graftlint.py"),
+       "--ast-only", str(bad)], env=env, capture_output=True, text=True)
+  assert r.returncode == 1, r.stdout + r.stderr
+  assert "GL103" in r.stdout
+  r = subprocess.run(
+      [sys.executable, os.path.join(REPO, "tools", "graftlint.py"),
+       "--ast-only"], env=env, capture_output=True, text=True)
+  assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ---------------------------------------------------------------------------
+# jaxpr audit: structural invariants on the REAL artifacts
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def artifacts():
+  return jaxpr_audit.build_artifacts()
+
+
+def test_sparse_step_exactly_one_scatter_per_class(artifacts):
+  for name in ("sparse_step", "sparse_step_guard", "tiered_step"):
+    jaxpr, expect = artifacts[name]
+    s = summarize(jaxpr)
+    assert expect.class_shapes, name
+    assert audit_summary(name, s, expect) == []
+    # each class's local packed buffer shape receives exactly ONE scatter
+    for cname, shape in expect.class_shapes.items():
+      hits = [sh for sh in s.scatter_shapes if sh == tuple(shape)]
+      assert len(hits) == 1, (name, cname, s.scatter_shapes)
+
+
+def test_guard_pmin_present_iff_guarded(artifacts):
+  s_plain = summarize(artifacts["sparse_step"][0])
+  s_guard = summarize(artifacts["sparse_step_guard"][0])
+  assert s_plain.counts.get("pmin", 0) == 0
+  assert s_guard.counts.get("pmin", 0) == 1
+  assert s_guard.counts.get("is_finite", 0) > 0
+
+
+def test_eval_step_writes_nothing(artifacts):
+  s = summarize(artifacts["eval_step"][0])
+  assert s.scatter_shapes == []
+  assert audit_summary("eval_step", s, artifacts["eval_step"][1]) == []
+
+
+def test_collectives_ride_mesh_axes_only(artifacts):
+  for name, (jaxpr, expect) in artifacts.items():
+    s = summarize(jaxpr)
+    for prim, axes in s.collective_axes:
+      assert set(axes) <= set(expect.mesh_axes), (name, prim, axes)
+    assert s.f64_prims == [], name
+    assert s.callback_prims == [], name
+
+
+def test_fingerprints_match_committed_baseline(artifacts):
+  path = os.path.join(REPO, jaxpr_audit.FINGERPRINT_PATH)
+  assert os.path.exists(path), (
+      "run `python tools/graftlint.py --update-fingerprints` and commit")
+  with open(path) as f:
+    baseline = json.load(f)
+  prints = {name: fingerprint(summarize(jaxpr))
+            for name, (jaxpr, _) in artifacts.items()}
+  drift = diff_fingerprints(baseline, prints)
+  assert drift == [], "\n".join(drift)
+
+
+def test_fingerprint_stable_across_two_traces(artifacts):
+  fresh = jaxpr_audit.build_artifacts()
+  for name, (jaxpr, _) in artifacts.items():
+    a = fingerprint(summarize(jaxpr))
+    b = fingerprint(summarize(fresh[name][0]))
+    assert a == b, name
+
+
+# ---------------------------------------------------------------------------
+# jaxpr audit: seeded violations are detected
+# ---------------------------------------------------------------------------
+
+
+def test_audit_flags_scatter_chain():
+  def chained(buf, ids, upd):
+    return buf.at[ids].add(upd).at[ids].add(upd)
+
+  jx = jax.make_jaxpr(chained)(
+      jnp.zeros((8, 4)), jnp.arange(3), jnp.ones((3, 4)))
+  s = summarize(jx.jaxpr)
+  out = audit_summary("seed", s, Expectation({"c": (8, 4)}, ("mp",)))
+  assert len(out) == 1 and "2 scatter-adds" in out[0]
+
+
+def test_audit_flags_missing_update():
+  def nothing(buf):
+    return buf * 2.0
+
+  jx = jax.make_jaxpr(nothing)(jnp.zeros((8, 4)))
+  out = audit_summary("seed", summarize(jx.jaxpr),
+                      Expectation({"c": (8, 4)}, ("mp",)))
+  assert len(out) == 1 and "0 scatter-adds" in out[0]
+
+
+def test_audit_flags_missing_guard_pmin():
+  def no_pmin(x):
+    return x + 1
+
+  jx = jax.make_jaxpr(no_pmin)(jnp.zeros(()))
+  out = audit_summary("seed", summarize(jx.jaxpr),
+                      Expectation({}, ("mp",), guard=True))
+  assert len(out) == 1 and "pmin" in out[0]
+
+
+def test_audit_flags_foreign_collective_axis():
+  from distributed_embeddings_tpu.compat import shard_map
+  from distributed_embeddings_tpu.parallel import create_mesh
+  from jax.sharding import PartitionSpec as P
+
+  mesh = create_mesh(4)
+  f = shard_map(lambda x: jax.lax.psum(x, "mp"), mesh=mesh,
+                in_specs=(P("mp"),), out_specs=P())
+  jx = jax.make_jaxpr(f)(jnp.ones(4))
+  out = audit_summary("seed", summarize(jx.jaxpr),
+                      Expectation({}, ("other_axis",)))
+  assert out and "unknown axis" in out[0]
+
+
+def test_audit_flags_f64_leak():
+  from distributed_embeddings_tpu.compat import enable_x64
+  with enable_x64():
+    jx = jax.make_jaxpr(lambda x: x * 2.0)(jnp.zeros((2,), jnp.float64))
+  out = audit_summary("seed", summarize(jx.jaxpr), Expectation({}, ("mp",)))
+  assert len(out) == 1 and "float64" in out[0]
+
+
+def test_audit_flags_host_callback():
+  def cb(x):
+    return jax.pure_callback(
+        lambda v: np.asarray(v) * 2, jax.ShapeDtypeStruct((2,), jnp.float32),
+        x)
+
+  jx = jax.make_jaxpr(cb)(jnp.ones(2, jnp.float32))
+  out = audit_summary("seed", summarize(jx.jaxpr), Expectation({}, ("mp",)))
+  assert len(out) == 1 and "callback" in out[0]
+
+
+def test_fingerprint_drift_detected():
+  base = {"sparse_step": {"scatter-add": 3, "all_to_all": 9}}
+  cur = {"sparse_step": {"scatter-add": 4, "all_to_all": 9}}
+  out = diff_fingerprints(base, cur)
+  assert len(out) == 1 and "scatter-add: 3 -> 4" in out[0]
+  assert diff_fingerprints(base, dict(base)) == []
+  # vanished artifact and missing baseline both report
+  assert diff_fingerprints(base, {}) != []
+  assert diff_fingerprints({}, cur) != []

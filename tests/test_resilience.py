@@ -515,6 +515,22 @@ def test_host_gather_transient_errors_are_retried():
   assert staged.device["rows"]  # staging upload produced
 
 
+def test_unknown_fault_site_rejected_at_construction():
+  """A typo'd site name used to install a rule that could never fire —
+  the test went on 'passing' while injecting nothing. Rules now validate
+  against the registered site set and name the valid ones."""
+  with pytest.raises(ValueError, match="ckpt_write"):
+    FaultInjector().crash_after("ckpt_wrte", 0)  # graftlint: disable=GL108
+  with pytest.raises(ValueError, match="host_gather"):
+    FaultInjector().fail_first("host_gathr", 2)  # graftlint: disable=GL108
+  # registered extensions are accepted (and feed graftlint's GL108 set)
+  site = faultinject.register_site("test_extension_site")
+  try:
+    FaultInjector().crash_after(site, 0)
+  finally:
+    faultinject._extra_sites.discard(site)
+
+
 def test_host_gather_retries_exhausted_raises():
   from distributed_embeddings_tpu.tiering import TieredPrefetcher
   plan, tplan, store = _tiered_fixture()

@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""graftlint: the repo-invariant linter (`make lint`).
+
+Two passes (both on by default):
+
+1. AST lint (``distributed_embeddings_tpu.analysis.astlint``): the GL1xx
+   rule registry over every Python source in the tree — host syncs in
+   step-builder code, bare excepts, un-fsynced renames in durable paths,
+   wall clock/RNG in manifests, int32 index-arithmetic narrowing,
+   unregistered pytest marks, unknown fault-injection sites. Line-level
+   ``# graftlint: disable=GLnnn`` suppresses.
+2. Jaxpr audit (``...analysis.jaxpr_audit``): traces the real step
+   builders on a virtual CPU mesh and asserts structural invariants
+   (exactly one scatter-add per fused class, collective axis hygiene,
+   guard pmin iff guarded, no f64, no host callbacks), then diffs each
+   artifact's op-class fingerprint against ``tests/data/
+   jaxpr_fingerprints.json``.
+
+Exit status 1 on any error-severity finding, audit violation, or
+fingerprint drift; 0 otherwise.
+
+Usage:
+  python tools/graftlint.py                  # both passes, whole tree
+  python tools/graftlint.py --ast-only [PATH ...]
+  python tools/graftlint.py --jaxpr-only
+  python tools/graftlint.py --update-fingerprints
+  python tools/graftlint.py --list-rules
+"""
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_PATHS = [
+    "distributed_embeddings_tpu", "tests", "tools", "examples",
+    "bench.py", "__graft_entry__.py",
+]
+
+
+def _setup_cpu_mesh_env():
+  """Virtual CPU devices for the jaxpr audit — must precede jax import
+  (same dance as tests/conftest.py; this environment pins a real-TPU
+  backend that the audit must never touch)."""
+  flags = os.environ.get("XLA_FLAGS", "")
+  if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+  os.environ["JAX_PLATFORMS"] = "cpu"
+  import jax
+  jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv=None) -> int:
+  ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+  ap.add_argument("paths", nargs="*", help="files/dirs for the AST pass "
+                  f"(default: {' '.join(DEFAULT_PATHS)})")
+  ap.add_argument("--ast-only", action="store_true",
+                  help="skip the jaxpr audit (no jax import)")
+  ap.add_argument("--jaxpr-only", action="store_true",
+                  help="skip the AST pass")
+  ap.add_argument("--update-fingerprints", action="store_true",
+                  help="rewrite tests/data/jaxpr_fingerprints.json from "
+                  "the current trace instead of diffing against it")
+  ap.add_argument("--list-rules", action="store_true")
+  ap.add_argument("-q", "--quiet", action="store_true")
+  args = ap.parse_args(argv)
+  if args.update_fingerprints and args.ast_only:
+    ap.error("--update-fingerprints needs the jaxpr pass; drop --ast-only")
+
+  from distributed_embeddings_tpu.analysis import astlint
+
+  if args.list_rules:
+    for rid, rule in sorted(astlint.RULES.items()):
+      print(f"{rid}  {rule.severity:<7}  {rule.title}")
+    return 0
+
+  say = (lambda *_: None) if args.quiet else print
+  errors = 0
+
+  if not args.jaxpr_only:
+    paths = args.paths or [os.path.join(REPO, p) for p in DEFAULT_PATHS]
+    findings = astlint.lint_paths(paths, root=REPO)
+    for f in findings:
+      print(f.render())
+      errors += f.severity == "error"
+    say(f"graftlint ast: {len(findings)} finding(s) over "
+        f"{len(list(astlint._iter_py_files(paths)))} file(s)")
+
+  if not args.ast_only:
+    _setup_cpu_mesh_env()
+    from distributed_embeddings_tpu.analysis import jaxpr_audit
+    violations, prints = jaxpr_audit.run_audit(
+        update_fingerprints=args.update_fingerprints,
+        fingerprint_path=os.path.join(REPO, jaxpr_audit.FINGERPRINT_PATH),
+        log=say)
+    for v in violations:
+      print(f"jaxpr-audit: {v}")
+    errors += len(violations)
+    say(f"graftlint jaxpr: {len(prints)} artifact(s), "
+        f"{len(violations)} violation(s)")
+
+  if errors:
+    print(f"graftlint: FAILED ({errors} error(s))")
+    return 1
+  say("graftlint: OK")
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())

@@ -286,7 +286,9 @@ def main():
   #    both the gather-side extraction einsum and the apply-side expansion.
   stride = LAYOUT.stride  # 32
   grp_all = jnp.asarray(grp_np)
-  lane = jnp.asarray(((ids_np % rpp) * stride).astype(np.int32))
+  # (id % rpp) * stride < 128 lanes of one physical row
+  lane = jnp.asarray(((ids_np % rpp) * stride)  # graftlint: disable=GL106
+                     .astype(np.int32))
   starts = jnp.stack([grp_all, lane], axis=1)  # [n, 2]
   bufw = jnp.zeros((LAYOUT.phys_rows + 1, 128), jnp.float32)
 

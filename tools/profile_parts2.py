@@ -88,7 +88,9 @@ def main():
   # buffer) -- mimics per-bucket chunking
   offs = np.cumsum([0] + sparse_vocab[:-1])
   ids_tbl = jnp.stack([
-      jnp.asarray(rng.integers(0, v, BATCH) + o, jnp.int32)
+      # id + table offset <= sum(sparse_vocab), < 2^31 at bench scale
+      jnp.asarray(rng.integers(0, v, BATCH) + o,  # graftlint: disable=GL106
+                  jnp.int32)
       for v, o in zip(sparse_vocab, offs)])
 
   # ---- 2. MLPs + interaction fwd / fwd+bwd ----

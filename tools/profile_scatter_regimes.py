@@ -58,8 +58,9 @@ def main():
     return np.concatenate(parts).astype(np.int32)
 
   def stream_10hot(vocab, off):
-    return (power_law_ids(rng, B, 10, vocab, 1.05).ravel() // 4
-            + off).astype(np.int32)
+    # id + offset <= sum of profiled vocabs, < 2^31 at bench scale
+    return (power_law_ids(rng, B, 10, vocab, 1.05)  # graftlint: disable=GL106
+            .ravel() // 4 + off).astype(np.int32)
 
   cases = []
   for phys_rows, label in ((1_000_000, "0.5GB"), (4_150_000, "2.1GB"),

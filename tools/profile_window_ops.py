@@ -58,7 +58,8 @@ def main():
   rpp = LAYOUT.rows_per_phys
   stride = LAYOUT.stride
   grp_np = (ids_np // rpp).astype(np.int32)
-  lane_np = ((ids_np % rpp) * stride).astype(np.int32)
+  # (id % rpp) * stride < 128 lanes of one physical row
+  lane_np = ((ids_np % rpp) * stride).astype(np.int32)  # graftlint: disable=GL106
   starts = jnp.stack(
       [jnp.asarray(grp_np), jnp.asarray(lane_np)], axis=1)  # [n, 2]
   print(f"n={n} rpp={rpp} stride={stride} phys_rows={LAYOUT.phys_rows}")
