@@ -29,6 +29,10 @@ GL107       error      every ``pytest.mark.<name>`` is registered in
                        deselects)
 GL108       error      fault-injection site literals must be registered in
                        ``resilience.faultinject.SITES``
+GL109       error      no raw ``lax.all_to_all`` outside ``parallel/wire.py``
+                       (library-package modules: everywhere; elsewhere:
+                       trace-reachable step-builder code) — a raw f32
+                       exchange bypasses the plan's wire contract
 ==========  =========  =====================================================
 
 Trace-reachable scope (GL101/GL102) is structural: any function nested —
@@ -409,6 +413,44 @@ def _check_markers(mod: ParsedModule) -> List[Finding]:
               "[tool.pytest.ini_options].markers — under "
               "--strict-markers collection fails; without it a typo'd "
               "marker silently deselects the test."))
+  return out
+
+
+@_rule("GL109", "error",
+       "no raw all_to_all outside the sanctioned wire module")
+def _check_raw_all_to_all(mod: ParsedModule) -> List[Finding]:
+  # parallel/wire.py (that exact path — not any file named wire.py) is
+  # the one sanctioned home of the exchange primitives; the rule exists
+  # so a new exchange cannot silently bypass the plan's wire knobs (bf16
+  # narrowing, dedup'd payloads). Scope: trace-reachable step-builder
+  # closures ANYWHERE, plus every function of library-package modules —
+  # the lookup engine's methods are where the real exchanges live and
+  # are not statically step-builder-reachable; tests/tools stay free to
+  # build raw audit fixtures.
+  norm = mod.path.replace(os.sep, "/")
+  if norm.endswith("parallel/wire.py"):
+    return []
+  if "distributed_embeddings_tpu/" in norm:
+    nodes = ast.walk(mod.tree)
+  else:
+    nodes = (n for fn in _traced_functions(mod.tree)
+             for n in ast.walk(fn))
+  out = []
+  seen = set()
+  for node in nodes:
+    if not isinstance(node, ast.Call):
+      continue
+    _, name = _call_pair(node)
+    if name == "all_to_all" and node.lineno not in seen:
+      seen.add(node.lineno)  # nested traced fns overlap in their walks
+      out.append(mod.finding(
+          "GL109", node,
+          "raw lax.all_to_all outside parallel/wire.py: exchanges "
+          "must ride the wire module (wire.exchange_ids for integer "
+          "payloads, wire.float_all_to_all for activations/cotangents) "
+          "so the plan's wire_dtype/dedup_exchange contract holds — a "
+          "raw exchange ships f32 payloads the audit layer then "
+          "cannot account for."))
   return out
 
 

@@ -17,6 +17,13 @@ the traced program:
   axis names, and the guard's ``pmin`` (the collective bad-step verdict)
   is present exactly once iff ``guard=True`` — a guarded step without
   the pmin can fork replicated state across devices on a poison batch.
+- **Wire contract**: per artifact, the ``all_to_all`` COUNT is pinned
+  (3 per padded bucket in a train step — ids, activations, reverse
+  cotangents; 2 in eval) and every FLOAT payload's element dtype must
+  match the plan's ``wire_dtype`` (f32 identity wire, or bf16 narrowed
+  in flight by ``parallel.wire``). A stray f32 exchange under a bf16
+  plan doubles wire bytes silently; an extra exchange is traffic the
+  round-6 exchange budget does not account for.
 - **No f64 leaks**: no equation produces a float64 value (CPU tracing
   would hide what TPU lowering rejects; an f64 constant also doubles a
   buffer).
@@ -96,6 +103,9 @@ class JaxprSummary:
       default_factory=list)
   f64_prims: List[str] = field(default_factory=list)
   callback_prims: List[str] = field(default_factory=list)
+  # element dtype of every all_to_all payload (first operand), in walk
+  # order — the wire-contract evidence
+  a2a_dtypes: List[str] = field(default_factory=list)
 
 
 _COLLECTIVES = frozenset({
@@ -111,6 +121,8 @@ def summarize(jaxpr) -> JaxprSummary:
     s.counts[name] += 1
     if name.startswith("scatter"):
       s.scatter_shapes.append(tuple(eqn.invars[0].aval.shape))
+    if name == "all_to_all":
+      s.a2a_dtypes.append(str(eqn.invars[0].aval.dtype))
     if name in _COLLECTIVES:
       axes = eqn.params.get("axes", eqn.params.get("axis_name", ()))
       if not isinstance(axes, (tuple, list)):
@@ -141,6 +153,13 @@ class Expectation:
   mesh_axes: Tuple[str, ...]
   guard: bool = False
   scatters_per_class: int = 1
+  # exact all_to_all count (None: not checked). Train steps exchange 3x
+  # per padded bucket (ids dp->mp, activations mp->dp, reverse
+  # cotangents), eval 2x; ragged buckets add one (separate lengths wire).
+  a2a_count: Optional[int] = None
+  # required element dtype of every FLOAT all_to_all payload (None: not
+  # checked) — the plan's wire_dtype contract ('float32' | 'bfloat16')
+  wire_float_dtype: Optional[str] = None
 
 
 def audit_summary(name: str, s: JaxprSummary, expect: Expectation
@@ -173,6 +192,22 @@ def audit_summary(name: str, s: JaxprSummary, expect: Expectation
     out.append(
         f"{name}: guard=False but found {pmin} pmin collective(s) — an "
         "unguarded step has no business reducing a verdict")
+  n_a2a = s.counts.get("all_to_all", 0)
+  if expect.a2a_count is not None and n_a2a != expect.a2a_count:
+    out.append(
+        f"{name}: {n_a2a} all_to_all exchange(s), expected "
+        f"{expect.a2a_count} — an extra exchange is wire traffic the "
+        "exchange budget does not account for; a missing one means a "
+        "payload stopped crossing the mesh")
+  if expect.wire_float_dtype is not None:
+    bad = sorted({d for d in s.a2a_dtypes
+                  if "float" in d and d != expect.wire_float_dtype})
+    if bad:
+      out.append(
+          f"{name}: float all_to_all payload(s) travel {bad}, expected "
+          f"{expect.wire_float_dtype} — the plan's wire_dtype contract "
+          "is broken (an f32 payload under a bf16 wire doubles exchange "
+          "bytes; a bf16 one under f32 silently loses precision)")
   if s.f64_prims:
     out.append(
         f"{name}: float64 values produced by {sorted(set(s.f64_prims))} "
@@ -211,6 +246,8 @@ def build_artifacts() -> Dict[str, Tuple[Any, Expectation]]:
 
   - ``sparse_step``:        ``make_sparse_train_step(guard=False)``
   - ``sparse_step_guard``:  ``make_sparse_train_step(guard=True)``
+  - ``sparse_step_wire``:   same step on a ``wire_dtype='bf16',
+    dedup_exchange=True`` plan (every float exchange must be bf16)
   - ``tiered_step``:        ``make_tiered_train_step`` (host-tier class)
   - ``eval_step``:          ``make_sparse_eval_step`` (zero scatters)
   """
@@ -266,6 +303,13 @@ def build_artifacts() -> Dict[str, Tuple[Any, Expectation]]:
         out[name] = (lay.phys_rows, lay.phys_width)
     return out
 
+  def n_padded_buckets(plan):
+    # the fixture's inputs are all hotness-1 and dense, so every bucket
+    # is a padded bucket: a train step exchanges 3x per bucket (ids,
+    # activations, reverse cotangents), eval 2x
+    eng = DistributedLookup(plan, dp_input=True)
+    return sum(len(eng._buckets(k, lambda i: 1)) for k in plan.class_keys)
+
   artifacts: Dict[str, Tuple[Any, Expectation]] = {}
 
   # ---- all-device sparse step (guarded and not) + eval -------------------
@@ -279,18 +323,39 @@ def build_artifacts() -> Dict[str, Tuple[Any, Expectation]]:
       init_sparse_state_direct(plan, rule, dense_params, opt,
                                jax.random.PRNGKey(1)), mesh)
   bt = shard_batch(batch0, mesh)
+  nb = n_padded_buckets(plan)
   for guard in (False, True):
     step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
                                   state, batch0, donate=False, guard=guard)
     jx = jax.make_jaxpr(step)(state, *bt)
     artifacts["sparse_step_guard" if guard else "sparse_step"] = (
-        jx.jaxpr, Expectation(shapes, mesh_axes, guard=guard))
+        jx.jaxpr, Expectation(shapes, mesh_axes, guard=guard,
+                              a2a_count=3 * nb,
+                              wire_float_dtype="float32"))
 
   ev = make_sparse_eval_step(model, plan, rule, mesh, state, batch0)
   jx = jax.make_jaxpr(ev)(state, *bt[:2])
   artifacts["eval_step"] = (
       jx.jaxpr,
-      Expectation(shapes, mesh_axes, guard=False, scatters_per_class=0))
+      Expectation(shapes, mesh_axes, guard=False, scatters_per_class=0,
+                  a2a_count=2 * nb, wire_float_dtype="float32"))
+
+  # ---- compressed-wire sparse step (bf16 wire + dedup'd exchange) --------
+  # identical table layout, so the f32 state and batch reuse verbatim;
+  # only the exchange payloads change — which is exactly the contract
+  # the dtype invariant pins
+  plan_w = DistEmbeddingStrategy(
+      [TableConfig(input_dim=v, output_dim=WIDTH,
+                   initializer=_dlrm_initializer(v)) for v in VOCAB],
+      WORLD, "memory_balanced", dense_row_threshold=60,
+      wire_dtype="bf16", dedup_exchange=True)
+  step_w = make_sparse_train_step(model, plan_w, bce_loss, opt, rule, mesh,
+                                  state, batch0, donate=False)
+  jx = jax.make_jaxpr(step_w)(state, *bt)
+  artifacts["sparse_step_wire"] = (
+      jx.jaxpr, Expectation(shapes, mesh_axes, guard=False,
+                            a2a_count=3 * n_padded_buckets(plan_w),
+                            wire_float_dtype="bfloat16"))
 
   # ---- tiered step (host-tier class + device tiers) ----------------------
   plan_t = DistEmbeddingStrategy(
@@ -322,7 +387,9 @@ def build_artifacts() -> Dict[str, Tuple[Any, Expectation]]:
   shapes_t = class_shapes(plan_t, layouts_t)
   jx = jax.make_jaxpr(step_t)(state_t, staged.device, *bt)
   artifacts["tiered_step"] = (
-      jx.jaxpr, Expectation(shapes_t, mesh_axes, guard=False))
+      jx.jaxpr, Expectation(shapes_t, mesh_axes, guard=False,
+                            a2a_count=3 * n_padded_buckets(plan_t),
+                            wire_float_dtype="float32"))
   return artifacts
 
 

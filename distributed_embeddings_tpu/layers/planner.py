@@ -323,9 +323,33 @@ class DistEmbeddingStrategy:
                gen_assignment: str = "auto",
                host_row_threshold: Optional[int] = None,
                hbm_budget_bytes: Optional[int] = None,
-               oov: str = "clip"):
+               oov: str = "clip",
+               wire_dtype: str = "f32",
+               dedup_exchange: bool = False):
     if strategy not in ("basic", "memory_balanced", "memory_optimized"):
       raise ValueError(f"Unsupported shard strategy {strategy}")
+    # ---- wire format of the dp<->mp exchanges ---------------------------
+    # Plan-level because the wire is a contract between routing, combine,
+    # backward and audit — one lookup call flipping it per-site would
+    # desynchronize the reverse (autodiff-inserted) exchange from the
+    # forward one. "wire_dtype": float payloads (activations + reverse
+    # cotangents) travel 'f32' (identity, the pre-knob program) or 'bf16'
+    # (half the float wire bytes; tables, combiners and the
+    # one-scatter-add backward stay f32 master precision — the narrowing
+    # exists only in flight). "dedup_exchange": per (source, dest, bucket)
+    # block, ship the sorted-unique id set and one activation/cotangent
+    # row per unique id instead of one per occurrence/sample
+    # (lookup_engine.DedupRouted; sparse-kind padded buckets only — dense
+    # MXU classes and ragged value streams keep the raw exchange).
+    # Neither knob changes any buffer layout, so checkpoints restore
+    # across knob changes; training step builders reject exact=True with
+    # a bf16 wire (the exact path's bit-for-bit claim cannot survive a
+    # narrowed cotangent exchange).
+    if wire_dtype not in ("f32", "bf16"):
+      raise ValueError(
+          f"wire_dtype must be 'f32' or 'bf16', got {wire_dtype!r}")
+    self.wire_dtype = wire_dtype
+    self.dedup_exchange = bool(dedup_exchange)
     # Out-of-vocabulary id POLICY (plan-level — one id pipeline feeds all
     # tables, so the policy is a property of the plan, not a lookup-call
     # flag). "clip": ids >= input_dim clamp to the last row (reference
@@ -976,6 +1000,35 @@ class DistEmbeddingStrategy:
         "device_bytes_per_rank": device,
         "host_bytes_per_rank": host,
         "hbm_budget_bytes": self.hbm_budget_bytes,
+        "classes": classes,
+    }
+
+  def exchange_report(self) -> Dict[str, object]:
+    """Wire-format summary of the dp<->mp exchange path.
+
+    Per class: its kind and whether the deduplicated exchange applies to
+    its padded buckets (sparse-kind classes only — dense MXU classes have
+    no row gather to dedup, and ragged value streams already scale with
+    the true id count, so both keep the raw exchange; a class serving a
+    call-time-ragged input routes that bucket raw even when ``dedup``
+    reports True here). ``float_wire_bytes_per_value`` is the in-flight
+    element size of activation/cotangent payloads under ``wire_dtype``.
+    """
+    from ..parallel.lookup_engine import class_param_name
+    classes = {}
+    for key in self.class_keys:
+      cp = self.classes[key]
+      classes[class_param_name(*key)] = {
+          "kind": cp.kind,
+          "width": cp.width,
+          "dedup": bool(self.dedup_exchange and cp.kind == "sparse"
+                        and self.world_size > 1),
+      }
+    return {
+        "wire_dtype": self.wire_dtype,
+        "dedup_exchange": self.dedup_exchange,
+        "float_wire_bytes_per_value": 2 if self.wire_dtype == "bf16" else 4,
+        "world_size": self.world_size,
         "classes": classes,
     }
 

@@ -89,6 +89,59 @@ def dedup_rows(ids: jax.Array, rows: jax.Array, sentinel: int) -> SparseRows:
   return SparseRows(unique_ids, unique_rows)
 
 
+def unique_ids_map(ids: jax.Array, sentinel: int,
+                   capacity: int) -> tuple:
+  """Sort + unique with a STATIC capacity and an inverse map.
+
+  The :func:`dedup_rows` machinery (stable sort, run-start segmentation)
+  applied to ids alone — the dp-side half of the deduplicated exchange
+  (``lookup_engine.DedupRouted``): instead of shipping every duplicated
+  occurrence, the wire carries the sorted-unique id block and the
+  receiver gathers each row once; the sender keeps ``inv`` locally to
+  re-expand the returned rows.
+
+  Args:
+    ids: [m] int ids in ``[0, sentinel]`` (``sentinel`` marks padding;
+      anything outside the range is clamped to it).
+    sentinel: the padding id (= the class buffer's row count).
+    capacity: static unique-slot count. Safe iff ``capacity >=
+      min(m, sentinel + 1)`` — the value range bounds the distinct count,
+      so that choice can never overflow; a smaller capacity would
+      silently alias distinct ids and is the caller's bug.
+
+  Returns:
+    ``(uniq [capacity] int32, inv [m] int32)`` with ``uniq[inv] == ids``
+    (after clamping); ``uniq`` is ascending with sentinel padding at the
+    tail, so padded slots gather zero rows exactly like padded
+    occurrences did.
+  """
+  m = ids.shape[0]
+  clean = jnp.where((ids < 0) | (ids > sentinel), sentinel,
+                    ids).astype(jnp.int32)
+  sorted_ids, perm = lax.sort_key_val(clean, jnp.arange(m, dtype=jnp.int32))
+  is_start = jnp.concatenate(
+      [jnp.ones((1,), bool), sorted_ids[1:] != sorted_ids[:-1]])
+  seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1
+  seg = jnp.minimum(seg, capacity - 1)  # no-op under the safe capacity
+  uniq = jnp.full((capacity,), sentinel, jnp.int32)
+  uniq = uniq.at[seg].min(sorted_ids, mode="drop")
+  inv = jnp.zeros((m,), jnp.int32).at[perm].set(seg, mode="drop")
+  return uniq, inv
+
+
+def expand_unique_rows(u_rows: jax.Array, inv: jax.Array) -> jax.Array:
+  """Per-unique rows ``[K, w]`` -> per-occurrence rows ``[m, w]``.
+
+  The dp-side re-expansion of a deduplicated exchange. Differentiable on
+  purpose: its transpose is a scatter-add of the per-occurrence
+  cotangents into ``[K, w]`` — i.e. duplicate ids' cotangents are
+  segment-summed (in the cotangent's own f32 precision) BEFORE the
+  reverse all_to_all, which is what shrinks the gradient exchange to one
+  row per unique id and hands the mp-side apply an already-combined
+  cotangent per unique occurrence."""
+  return jnp.take(u_rows, inv, axis=0)
+
+
 class SparseOptimizer(NamedTuple):
   """Sparse counterpart of ``optax.GradientTransformation``.
 

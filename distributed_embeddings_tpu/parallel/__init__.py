@@ -1,7 +1,9 @@
 """Mesh, collectives, and the distributed lookup engine."""
 
+from . import wire
 from .lookup_engine import (
     Bucket,
+    DedupRouted,
     DistributedLookup,
     class_buckets,
     class_param_name,
@@ -20,7 +22,9 @@ from .mesh import (
 
 __all__ = [
     "Bucket",
+    "DedupRouted",
     "DistributedLookup",
+    "wire",
     "class_buckets",
     "class_param_name",
     "pack_mp_inputs",

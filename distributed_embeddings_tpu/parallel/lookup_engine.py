@@ -35,6 +35,15 @@ by hotness; padded entries carry a sentinel id and contribute nothing in
 either direction. All shapes static, fully jit/grad compatible; ``shard_map``
 differentiates through ``all_to_all`` natively, which is what replaces the
 reference's ~100 lines of Horovod tape patching.
+
+Every exchange rides :mod:`parallel.wire` (the sanctioned all_to_all home,
+graftlint GL109): two plan knobs compress the wire without touching the f32
+master state — ``wire_dtype='bf16'`` narrows float payloads (activations +
+reverse cotangents) in flight only, and ``dedup_exchange=True`` ships each
+destination block's sorted-unique ids and ONE activation/cotangent row per
+unique id (:class:`DedupRouted`; the dp side keeps the inverse map, expands
+and combines locally, and the expansion's transpose segment-sums duplicate
+cotangents before the reverse exchange). See ARCHITECTURE.md §13.
 """
 
 from __future__ import annotations
@@ -79,6 +88,8 @@ from ..ops.packed_table import (
     scatter_add_fused,
 )
 from ..ops.ragged import RaggedIds
+from ..ops.sparse_grad import expand_unique_rows, unique_ids_map
+from . import wire
 
 PAD_ID = -1  # marks hotness padding in dense-padded ragged inputs
 
@@ -265,6 +276,41 @@ def _seg_ids(lengths: jax.Array, capacity: int) -> jax.Array:
   return jnp.clip(
       jnp.searchsorted(splits, pos, side="right").astype(jnp.int32) - 1,
       0, lengths.shape[0] - 1)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class DedupRouted:
+  """Deduplicated exchange bundle for one padded sparse bucket.
+
+  Built by :meth:`DistributedLookup.route_ids` when the plan sets
+  ``dedup_exchange=True`` (sparse-kind classes, world > 1): per
+  destination rank, the routing block's ids are sorted and uniqued
+  dp-side (static capacity ``K = min(block occurrences, sentinel + 1)``
+  — the value range bounds the distinct count, so the capacity can never
+  overflow) and only the unique block crosses the wire. The receiving
+  (mp) side gathers ONE fused row per unique id and returns ``[K, w]``
+  rows; the dp side re-expands them through its locally-kept inverse map
+  and runs the combiner there. On the backward, the expansion's
+  transpose segment-sums duplicate ids' cotangents (f32) BEFORE the
+  reverse exchange, so the grad wire shrinks identically.
+
+  A deliberately NOT-a-tuple pytree: routed ragged buckets travel as
+  plain ``(vals, lens)`` tuples and several consumers dispatch on
+  ``isinstance(ids, tuple)``.
+  """
+
+  uniq: jax.Array        # [world_src, K] mp-side unique ids (post-exchange)
+  inv: jax.Array         # [world_dst, n_b, B(, h)] dp-LOCAL inverse map
+  uniq_local: jax.Array  # [world_dst, K] dp-LOCAL unique blocks (pre-exchange)
+
+  def tree_flatten(self):
+    return (self.uniq, self.inv, self.uniq_local), None
+
+  @classmethod
+  def tree_unflatten(cls, aux, children):
+    del aux
+    return cls(*children)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -666,22 +712,49 @@ class DistributedLookup:
         if bucket.h < 0:  # ragged: (vals [world,n_b,V], lens [world,n_b,B])
           vals, lens = x
           if world > 1:
-            vals = lax.all_to_all(vals, self.axis_name, split_axis=0,
-                                  concat_axis=0)
-            lens = lax.all_to_all(lens, self.axis_name, split_axis=0,
-                                  concat_axis=0)
+            vals = wire.exchange_ids(vals, self.axis_name)
+            lens = wire.exchange_ids(lens, self.axis_name)
           # -> (vals [n_b, world, V], lens [n_b, world, B]); the world
           # (source-rank) axis stays explicit because each source block
           # has its own CSR segmentation
           routed = (jnp.transpose(vals, (1, 0, 2)),
                     jnp.transpose(lens, (1, 0, 2)))
+        elif world > 1 and self._dedup_class(key):
+          routed = self._dedup_route(key, x)
         elif world > 1:
-          y = lax.all_to_all(x, self.axis_name, split_axis=0, concat_axis=0)
+          y = wire.exchange_ids(x, self.axis_name)
           routed = self._reshape_routed(y, bucket, world, b)
         else:
           routed = self._reshape_routed(x, bucket, world, b)
         ids_all[bucket_key(key, bucket.h, bucket.vcap, bucket.rs)] = routed
     return ids_all
+
+  def _dedup_class(self, key) -> bool:
+    """Dedup'd exchange applies: sparse-kind padded buckets only. Dense
+    MXU classes have no row gather to dedup; ragged value streams (which
+    never reach here — ``h < 0`` routes first) already scale with the
+    true id count."""
+    return (wire.plan_dedup_exchange(self.plan)
+            and self.plan.classes[key].kind == "sparse")
+
+  def _dedup_route(self, key, x) -> "DedupRouted":
+    """Unique-then-exchange id routing for one padded bucket.
+
+    ``x [world, n_b, B(, h)]`` is the dest-major routing tensor. Each
+    destination block is sorted+uniqued dp-side to the static capacity
+    ``K = min(occurrences, sentinel + 1)`` (the block's values live in
+    ``[0, sentinel]``, so K can never overflow) and only the unique
+    blocks cross the wire; the inverse maps stay local for the return
+    expansion (:meth:`_exchange_dedup`)."""
+    world = self.plan.world_size
+    sentinel = padded_rows(self.plan, key)
+    m = int(np.prod(x.shape[1:]))
+    cap = min(m, sentinel + 1)
+    uniq_local, inv = jax.vmap(
+        lambda ids: unique_ids_map(ids, sentinel, cap))(x.reshape(world, m))
+    uniq = wire.exchange_ids(uniq_local, self.axis_name)  # [world_src, K]
+    return DedupRouted(uniq=uniq, inv=inv.reshape(x.shape),
+                       uniq_local=uniq_local)
 
   @staticmethod
   def _reshape_routed(y, bucket, world, b):
@@ -717,6 +790,11 @@ class DistributedLookup:
   def _z_sparse_simple(self, key, table_local: jax.Array,
                        ids_all: jax.Array, rs: bool = False) -> jax.Array:
     """Differentiable gather path on the simple [rows, w] buffer."""
+    if isinstance(ids_all, DedupRouted):
+      # one row per unique id; the combiner runs dp-side after the return
+      # exchange re-expands (_exchange_dedup)
+      return jnp.take(table_local, ids_all.uniq, axis=0, mode="fill",
+                      fill_value=0)
     if isinstance(ids_all, tuple):  # ragged value stream
       vals, lens = ids_all
       rows = jnp.take(table_local, vals, axis=0, mode="fill", fill_value=0)
@@ -847,6 +925,15 @@ class DistributedLookup:
     lane splits right after the gather measured ~25 ns/row on v5e
     (`tools/profile_tiny_buckets.py`) — at bag granularity they are ~free."""
     w = layout.width
+    if isinstance(ids_all, DedupRouted):
+      # dedup'd exchange: gather each unique id's fused row ONCE — the
+      # duplicate-heavy gather work and the return-exchange payload both
+      # shrink to the unique count. No combine here: the dp side expands
+      # via its inverse map and combines there (_exchange_dedup), so the
+      # cotangent arriving in the backward is already per unique id.
+      fused = gather_fused_chunked(layout, buf_local, ids_all.uniq)
+      aux = fused if (layout.n_aux or keep_rows) else fused[..., w:]
+      return fused[..., :w], aux
     if isinstance(ids_all, tuple):  # ragged value stream
       vals, lens = ids_all
       fused = gather_fused_chunked(layout, buf_local, vals)
@@ -889,23 +976,81 @@ class DistributedLookup:
     return zf[..., :w], fused
 
   # ---- mp -> dp exchange + assembly --------------------------------------
-  def exchange(self, z: Dict[tuple, jax.Array], batch_local: int
+  def exchange(self, z: Dict[tuple, jax.Array], batch_local: int,
+               ids_all: Optional[Dict[tuple, jax.Array]] = None
                ) -> Dict[tuple, jax.Array]:
     """mp->dp activation exchange (reference `dist_model_parallel.py:449-459`).
 
     z: bk -> [n_b, G, w]; returns bk -> [world_owner, n_b, B_local, w].
     Differentiable — autodiff inserts the reverse all_to_all, which is how
     the backward routes output cotangents to the owning shard without any of
-    the reference's tape patching."""
+    the reference's tape patching. Float payloads ride the plan's wire
+    dtype (``parallel.wire``): under ``wire_dtype='bf16'`` activations
+    are narrowed in flight and widened on arrival, and the reverse
+    cotangent exchange narrows identically — compute on both sides stays
+    at the payload's own (f32) precision.
+
+    ``ids_all`` (the :meth:`route_ids` dict) is required when the plan
+    dedups the exchange: buckets routed as :class:`DedupRouted` carry
+    ``z[bk] = [world_src, K, w]`` unique rows and return through
+    :meth:`_exchange_dedup` (exchange one row per unique id, expand via
+    the dp-local inverse map, combine dp-side)."""
     world = self.plan.world_size
+    wd = wire.plan_wire_dtype(self.plan)
     received = {}
     for bk, zb in z.items():
+      dr = ids_all.get(bk) if ids_all is not None else None
+      if isinstance(dr, DedupRouted):
+        received[bk] = self._exchange_dedup(bk, zb, dr)
+        continue
       n_b = zb.shape[0]
       zb = zb.reshape(n_b, world, batch_local, -1).transpose(1, 0, 2, 3)
       if world > 1:
-        zb = lax.all_to_all(zb, self.axis_name, split_axis=0, concat_axis=0)
+        zb = wire.float_all_to_all(zb, self.axis_name, wd)
       received[bk] = zb
     return received
+
+  def _exchange_dedup(self, bk, z_u: jax.Array, dr: DedupRouted
+                      ) -> jax.Array:
+    """Dedup'd mp->dp return: ``z_u [world_src, K, w]`` unique rows ->
+    ``[world_owner, n_b, B_local, w]`` combined activations.
+
+    The exchange ships one row per unique id (narrowed to the wire dtype
+    in flight); the dp side re-expands through its locally-kept inverse
+    map and runs the combiner HERE — differentiably, so the backward's
+    per-occurrence cotangents are segment-summed per unique id (f32, the
+    transpose of :func:`expand_unique_rows`) before the reverse exchange
+    narrows and ships them. Sentinel-padded unique slots gathered zero
+    rows, so expansion reproduces the raw path's rows bit-for-bit; the
+    h-axis sum and the mean divisor run over the same values in the same
+    order as the raw path's mp-side combine, and row-sliced buckets
+    defer their mean division to :meth:`assemble` exactly as before."""
+    key = bk.class_key
+    world = self.plan.world_size
+    w = z_u.shape[-1]
+    ret = wire.float_all_to_all(z_u, self.axis_name,
+                                wire.plan_wire_dtype(self.plan))
+    inv_shape = dr.inv.shape  # [world, n_b, B] | [world, n_b, B, h]
+    m = int(np.prod(inv_shape[1:]))
+    expanded = jax.vmap(expand_unique_rows)(ret, dr.inv.reshape(world, m))
+    expanded = expanded.reshape(inv_shape + (w,))
+    # run the ONE shared combiner (:meth:`_combine` — the bit-exact
+    # parity contract rides its h-sum/mean-divisor code being the same
+    # code): fold [world, n_b] into the leading axis it expects. Hot-1
+    # buckets pass 2-D ids through untouched, so they skip the id
+    # reconstruction; multi-hot buckets rebuild the ORIGINAL logical ids
+    # (uniq_local[inv]) so the combiner sees exactly the sentinel
+    # pattern the raw path's mp-side combine saw.
+    n_b = inv_shape[1]
+    rows = expanded.reshape((world * n_b,) + expanded.shape[2:])
+    if len(inv_shape) == 3:  # hotness-1: ids only carry the ndim==2 tag
+      ids_f = dr.inv.reshape((world * n_b,) + inv_shape[2:])
+    else:
+      ids_f = jax.vmap(lambda u, iv: jnp.take(u, iv, axis=0))(
+          dr.uniq_local, dr.inv.reshape(world, m)).reshape(
+              (world * n_b,) + inv_shape[2:])
+    out = self._combine(rows, ids_f, key, bk.rs)
+    return out.reshape((world, n_b) + out.shape[1:])
 
   def _hot_sig(self, key, hotness_of) -> tuple:
     cp = self.plan.classes[key]
@@ -1113,7 +1258,7 @@ class DistributedLookup:
         z[bk] = self._z_dense(key, bucket, table_local, ids)
       else:
         z[bk] = self._z_sparse_simple(key, table_local, ids, bk.rs)
-    received = self.exchange(z, b)
+    received = self.exchange(z, b, ids_all)
     outs = self.assemble(received, hotness_of, counts)
     if return_residuals:
       return outs, ids_all
@@ -1200,7 +1345,7 @@ class DistributedLookup:
         z[bk] = z_fn(table_local, ids)
       else:
         z[bk] = self._z_dense(key, bucket, table_local, ids)
-    received = self.exchange(z, batch_local)
+    received = self.exchange(z, batch_local, ids_all)
     return self.assemble(received, hotness_of, mean_counts)
 
   @staticmethod
@@ -1263,10 +1408,22 @@ class DistributedLookup:
         dzb = row_major(dzb)
       cp = plan.classes[key]
       name = class_param_name(*key)
-      ids = residuals.ids_all[bk]  # [n_b, G, h] | ragged (vals, lens)
+      ids = residuals.ids_all[bk]  # [n_b, G, h] | ragged | DedupRouted
       sentinel = padded_rows(plan, key)
       aux = (residuals.aux_rows[bk]
              if (rule.n_aux or rule.weight_decay) else None)
+      if isinstance(ids, DedupRouted):
+        # dedup'd bucket: the cotangent arrives per UNIQUE id — duplicate
+        # occurrences' cotangents were segment-summed by the dp-side
+        # expansion's transpose (before the reverse exchange), and the
+        # mean division lives in the differentiable dp-side combine — so
+        # parts are pre-expanded (h=0: no hotness broadcast, no divisor).
+        # rule.delta consequently applies ONCE per unique id per source
+        # block (the exact=True-style dedup semantics, restricted to one
+        # exchange block; exact=True still merges across blocks).
+        by_class.setdefault(name, []).append(
+            (ids.uniq.reshape(-1), dzb.reshape(-1, cp.width), aux, 0))
+        continue
       if h < 0:
         # ragged: expand the per-sample cotangent to per-occurrence rows
         # (h=0 marks pre-expanded parts downstream: no hotness broadcast)
@@ -1558,7 +1715,19 @@ class DistributedLookup:
         out[bk] = ids
         continue
       sentinel = padded_rows(self.plan, bk.class_key)
-      if isinstance(ids, tuple):  # ragged value stream (vals, lens)
+      if isinstance(ids, DedupRouted):
+        # dedup'd bucket: translate the unique blocks (the only ids the
+        # gather sees); the dp-side inverse map and local unique blocks
+        # stay in the LOGICAL vocabulary — sentinel counting for the
+        # mean combiner must not see compact slots. Hit counters then
+        # count UNIQUE ids per (source, dest) block, not occurrences
+        # (a miss still means dropped updates, so the trainer's
+        # missed>0 contract is unchanged).
+        tv, m = _translate_tier(ids.uniq, spec, sentinel, resident[name],
+                                staged_grps[name])
+        out[bk] = DedupRouted(uniq=tv, inv=ids.inv,
+                              uniq_local=ids.uniq_local)
+      elif isinstance(ids, tuple):  # ragged value stream (vals, lens)
         vals, lens = ids
         tv, m = _translate_tier(vals, spec, sentinel, resident[name],
                                 staged_grps[name])

@@ -1,0 +1,114 @@
+"""The sanctioned wire module: every dp<->mp ``all_to_all`` rides here.
+
+The exchange payloads of the distributed lookup path (routed ids dp->mp,
+activations mp->dp, and the autodiff-inserted reverse cotangent exchange)
+are a cross-cutting contract: the routing layer, the combiner, the
+backward apply, and the jaxpr audit all assume one wire format. This
+module is that format's single home — graftlint GL109 flags a raw
+``lax.all_to_all`` in trace-reachable step-builder code anywhere else, so
+a new exchange cannot silently bypass the plan's wire knobs.
+
+Two plan knobs (``DistEmbeddingStrategy``) govern the format:
+
+- ``wire_dtype='f32' | 'bf16'``: float payloads (activations and their
+  reverse cotangents) travel the wire in this dtype. With ``'bf16'`` the
+  payload is narrowed immediately before the exchange and widened right
+  after on the receiving side — tables, combiners, the optimizer rules,
+  and the one-scatter-add backward all stay f32 master precision; only
+  the bytes in flight halve. Integer payloads (ids, lengths, inverse
+  maps) always travel int32. The narrowing is wrapped in a
+  ``jax.custom_vjp`` so the REVERSE exchange (the cotangent all_to_all
+  autodiff inserts) is narrowed the same way: cotangents are computed
+  (and, under ``dedup_exchange``, segment-summed per unique id) in f32,
+  then narrowed for the wire, then widened on the owning side.
+- ``dedup_exchange=True``: see ``lookup_engine.DedupRouted`` — the id
+  exchange ships sorted-unique id blocks and the float exchanges ship one
+  row per unique id instead of one per sample/occurrence.
+
+With ``world_size == 1`` there is no wire: nothing is exchanged, nothing
+is narrowed, and both knobs are inert (numerics stay bit-identical to the
+single-device f32 path).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# plan knob value -> payload dtype for FLOAT exchanges. f32 is the
+# identity wire (no casts are inserted at all, so the traced program is
+# unchanged from the pre-knob build).
+WIRE_DTYPES = {
+    "f32": jnp.float32,
+    "bf16": jnp.bfloat16,
+}
+
+
+def plan_wire_dtype(plan):
+  """The plan's wire dtype (``None`` = f32 identity wire).
+
+  Reads ``plan.wire_dtype`` leniently (plans pickled before the knob
+  existed default to f32)."""
+  name = getattr(plan, "wire_dtype", "f32")
+  if name not in WIRE_DTYPES:
+    raise ValueError(
+        f"unknown wire_dtype {name!r}; have {sorted(WIRE_DTYPES)}")
+  return None if name == "f32" else WIRE_DTYPES[name]
+
+
+def plan_dedup_exchange(plan) -> bool:
+  """The plan's ``dedup_exchange`` knob (default False for old plans)."""
+  return bool(getattr(plan, "dedup_exchange", False))
+
+
+def exchange_ids(x: jax.Array, axis_name: str) -> jax.Array:
+  """Integer payload exchange (routed ids / unique blocks / ragged
+  lengths). Always travels at the payload's integer dtype — the routing
+  layer has already narrowed localized ids to int32 for the wire."""
+  return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0)
+
+
+def float_all_to_all(x: jax.Array, axis_name: str,
+                     wire_dtype=None) -> jax.Array:
+  """Float payload exchange under the plan's wire dtype.
+
+  ``wire_dtype=None`` (or equal to ``x.dtype``) is the identity wire: a
+  plain differentiable ``all_to_all`` whose reverse exchange autodiff
+  inserts natively. Otherwise the payload is narrowed to ``wire_dtype``
+  for the flight and widened back to ``x.dtype`` on arrival, in BOTH
+  directions (the reverse cotangent exchange is narrowed identically via
+  the ``custom_vjp`` below)."""
+  if wire_dtype is None or jnp.dtype(wire_dtype) == x.dtype:
+    return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0)
+  return _wire_all_to_all(axis_name, str(jnp.dtype(wire_dtype)),
+                          str(x.dtype), x)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _wire_all_to_all(axis_name: str, wire_dtype: str, compute_dtype: str,
+                     x: jax.Array) -> jax.Array:
+  out, _ = _wire_fwd(axis_name, wire_dtype, compute_dtype, x)
+  return out
+
+
+def _wire_fwd(axis_name, wire_dtype, compute_dtype, x):
+  y = lax.all_to_all(x.astype(wire_dtype), axis_name,
+                     split_axis=0, concat_axis=0)
+  return y.astype(compute_dtype), None
+
+
+def _wire_bwd(axis_name, wire_dtype, compute_dtype, res, ct):
+  # The split0/concat0 block permutation is an involution, so the reverse
+  # exchange is the same all_to_all; the cotangent (already reduced in
+  # f32 by the producer — e.g. the dedup path's per-unique segment-sum)
+  # is narrowed for the flight exactly like the forward payload.
+  del res
+  g = lax.all_to_all(ct.astype(wire_dtype), axis_name,
+                     split_axis=0, concat_axis=0)
+  return (g.astype(compute_dtype),)
+
+
+_wire_all_to_all.defvjp(_wire_fwd, _wire_bwd)

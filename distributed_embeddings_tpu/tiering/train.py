@@ -220,6 +220,15 @@ class TieredTrainer:
   staged, its update went to the sentinel, and training silently
   diverged from the all-device semantics (prefetch contract violation,
   e.g. a re-rank raced the classify).
+
+  Plans built with ``dedup_exchange=True`` compose transparently (the
+  tiered id translation rewrites the deduplicated unique blocks; the
+  staged wire inherits the plan's ``wire_dtype`` like every other
+  exchange), with one accounting caveat: the counters then count UNIQUE
+  ids per (source rank, dest rank, bucket) block rather than
+  occurrences — hit *rates* shift toward the cold tail (each hot id
+  counts once per block, not once per duplicate), while the
+  ``missed > 0`` abort contract is unchanged.
   """
 
   def __init__(self, model, tplan: TieringPlan, store: HostTierStore,

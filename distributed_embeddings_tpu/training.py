@@ -614,13 +614,17 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
 
   One jitted/shard_map'd function per step:
 
-  1. route ids dp->mp (``all_to_all``; ints, outside autodiff);
+  1. route ids dp->mp (``all_to_all``; ints, outside autodiff — under
+     ``plan.dedup_exchange`` each destination block ships its
+     sorted-unique ids instead of every occurrence);
   2. fused gather per sparse class — activations + optimizer-state rows in
-     one row-bound op;
+     one row-bound op (one row per UNIQUE id under dedup);
   3. differentiable tail (dense-class MXU lookups, mp->dp exchange, output
      assembly, the user model, the loss) — ``jax.value_and_grad`` w.r.t.
      (dense params, dense-class tables, sparse activations): autodiff
-     routes output cotangents back through the reverse ``all_to_all``;
+     routes output cotangents back through the reverse ``all_to_all``
+     (both float exchanges travel ``plan.wire_dtype`` — bf16 narrows
+     payloads in flight only, compute stays f32);
   4. optax on dense params and dense-class tables; ONE fused scatter-add
      per sparse class applies ``rule`` (:meth:`DistributedLookup.apply_sparse`).
 
@@ -687,6 +691,14 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
         "exact path re-gathers rows and builds its deltas inside the "
         "apply. Use per-occurrence semantics (exact=False) with the "
         "guard.")
+  if exact and getattr(plan, "wire_dtype", "f32") != "f32":
+    raise ValueError(
+        "exact=True requires wire_dtype='f32': the exact path reproduces "
+        "the reference's deduplicated backward bit-for-bit, and a "
+        "bf16-narrowed cotangent exchange breaks that claim before the "
+        "sort ever runs. Build the plan with wire_dtype='f32' (the "
+        "dedup_exchange knob composes with exact fine — it only changes "
+        "which ids reach the mp side, and they arrive f32-backed).")
   oov_is_error = getattr(plan, "oov", "clip") == "error"
   if oov_is_error and not guard:
     raise ValueError(
@@ -1017,6 +1029,12 @@ def make_tiered_train_step(model, tplan, loss_fn: Callable,
         "guard mode yet (ROADMAP), so out-of-range ids would be "
         "silently clipped — the policy's failure mode. Use oov='clip' "
         "with tiered storage for now.")
+  if exact and getattr(plan, "wire_dtype", "f32") != "f32":
+    raise ValueError(
+        "exact=True requires wire_dtype='f32' (same contract as "
+        "make_sparse_train_step): the deduplicated backward's bit-for-bit "
+        "claim cannot survive a bf16-narrowed cotangent exchange. Build "
+        "the plan with wire_dtype='f32'.")
   # same penalty limits as make_sparse_train_step's fused path (and for
   # host-tier tables there is no dense-autodiff fallback at all)
   rule, reg_fn, con_fn = _fused_rule_and_penalties(plan, rule)
@@ -1112,13 +1130,21 @@ def make_sparse_eval_step(model, plan: DistEmbeddingStrategy,
                           mesh: Optional[Mesh],
                           state: Dict[str, Any],
                           batch_example: Any,
-                          axis_name: str = "mp"):
-  """Jitted distributed forward on the fused state (predictions only).
+                          axis_name: str = "mp",
+                          with_metrics: bool = False):
+  """Jitted distributed forward on the fused state.
 
   Per-device predictions come back batch-sharded (``P(axis_name)``);
   reading the returned global array gives all predictions — the
   single-controller equivalent of the reference's ``hvd.allgather`` of eval
-  outputs (`examples/dlrm/main.py:222-243`)."""
+  outputs (`examples/dlrm/main.py:222-243`).
+
+  ``with_metrics=True`` returns ``(preds, metrics)`` with ``metrics =
+  {'oov': {class_name: int32 count}}`` — the per-class out-of-vocabulary
+  occurrence counters the guarded TRAIN step already surfaces, now on the
+  serving/eval path too (the plan's ``oov='clip'`` policy stays silent
+  without them). Counters are global (psum'd across the mesh) replicated
+  scalars; one compare+reduce per input, fused into the step."""
   engine = DistributedLookup(plan, dp_input=True, axis_name=axis_name)
   layouts = engine.fused_layouts(rule)
 
@@ -1131,18 +1157,28 @@ def make_sparse_eval_step(model, plan: DistEmbeddingStrategy,
     z_sparse, _ = engine.lookup_sparse_fused(state["fused"], layouts, ids_all)
     acts = engine.finish_forward(z_sparse, state["emb_dense"], ids_all, b,
                                  hotness_of, counts)
-    return model.apply({"params": state["dense"]}, numerical, cats,
-                       emb_acts=acts)
+    preds = model.apply({"params": state["dense"]}, numerical, cats,
+                        emb_acts=acts)
+    if not with_metrics:
+      return preds
+    oov = engine.oov_counts(cats)
+    if mesh is not None:
+      oov = {n: jax.lax.psum(c, axis_name) for n, c in oov.items()}
+    return preds, {"oov": oov}
 
   if mesh is None:
     return jax.jit(local_eval)
   sspec = hybrid_partition_specs(state, axis_name)
   bspec = jax.tree_util.tree_map(
       lambda _: P(axis_name), tuple(batch_example[:2]))
+  out_specs = P(axis_name)
+  if with_metrics:
+    out_specs = (P(axis_name), {
+        "oov": {class_param_name(*k): P() for k in plan.class_keys}})
   return jax.jit(shard_map(
       local_eval, mesh=mesh,
       in_specs=(sspec,) + bspec,
-      out_specs=P(axis_name)))
+      out_specs=out_specs))
 
 
 def make_eval_step(pred_fn: Callable, mesh: Optional[Mesh],

@@ -198,6 +198,46 @@ def test_x():
                      CTX, ["GL107"]) == []
 
 
+def test_gl109_raw_all_to_all_in_step_builder():
+  src = """
+def make_sparse_train_step(plan):
+  def local_step(state, batch):
+    y = lax.all_to_all(batch, "mp", split_axis=0, concat_axis=0)
+    return y
+  return local_step
+"""
+  out = lint_source(src, "m.py", CTX, ["GL109"])
+  assert _rules(out) == ["GL109"]
+  assert "wire module" in out[0].message
+  # the sanctioned wire module itself is exempt — by its REAL path only
+  # (an unrelated wire.py elsewhere gets no blanket pass)
+  wire_path = "distributed_embeddings_tpu/parallel/wire.py"
+  assert lint_source(src, wire_path, CTX, ["GL109"]) == []
+  assert _rules(lint_source(src, "serving/wire.py", CTX,
+                            ["GL109"])) == ["GL109"]
+  # host-side (non-step-builder) code outside the library is out of
+  # scope... but INSIDE the library package every function is covered —
+  # the engine's methods are where the real exchanges live
+  host = """
+def pack_inputs(x):
+  return lax.all_to_all(x, "mp", split_axis=0, concat_axis=0)
+"""
+  assert lint_source(host, "m.py", CTX, ["GL109"]) == []
+  assert _rules(lint_source(
+      host, "distributed_embeddings_tpu/parallel/lookup_engine.py", CTX,
+      ["GL109"])) == ["GL109"]
+
+
+def test_gl109_suppression():
+  src = """
+def make_eval_step(plan):
+  def local_eval(state, batch):
+    return lax.all_to_all(batch, "mp", split_axis=0, concat_axis=0)  # graftlint: disable=GL109
+  return local_eval
+"""
+  assert lint_source(src, "m.py", CTX, ["GL109"]) == []
+
+
 def test_gl108_unknown_fault_site():
   src = """
 def chaos(inj):
@@ -288,6 +328,60 @@ def test_collectives_ride_mesh_axes_only(artifacts):
       assert set(axes) <= set(expect.mesh_axes), (name, prim, axes)
     assert s.f64_prims == [], name
     assert s.callback_prims == [], name
+
+
+def test_wire_dtype_per_mode(artifacts):
+  """Round-6 wire invariants: float all_to_all payloads travel f32 on
+  default plans and bf16 (every one of them) on the bf16-wire artifact;
+  integer (id) payloads stay int32 everywhere."""
+  for name in ("sparse_step", "sparse_step_guard", "eval_step",
+               "tiered_step"):
+    s = summarize(artifacts[name][0])
+    floats = [d for d in s.a2a_dtypes if "float" in d]
+    assert floats and set(floats) == {"float32"}, (name, s.a2a_dtypes)
+  s = summarize(artifacts["sparse_step_wire"][0])
+  floats = [d for d in s.a2a_dtypes if "float" in d]
+  assert floats and set(floats) == {"bfloat16"}, s.a2a_dtypes
+  assert all(d == "int32" for d in s.a2a_dtypes if "int" in d)
+
+
+def test_all_to_all_count_per_mode(artifacts):
+  """Exchange counts are pinned per mode: a train step exchanges exactly
+  3x per padded bucket (ids dp->mp, activations mp->dp, the reverse
+  cotangent exchange), eval 2x — and the dedup'd wire adds NO extra
+  exchange (the inverse maps never cross)."""
+  for name, (jaxpr, expect) in artifacts.items():
+    assert expect.a2a_count is not None, name
+    s = summarize(jaxpr)
+    assert s.counts.get("all_to_all", 0) == expect.a2a_count, name
+  n_plain = summarize(artifacts["sparse_step"][0]).counts["all_to_all"]
+  n_wire = summarize(artifacts["sparse_step_wire"][0]).counts["all_to_all"]
+  assert n_plain == n_wire
+
+
+def test_audit_flags_wire_violations():
+  import jax.numpy as _jnp
+  from distributed_embeddings_tpu.compat import shard_map
+  from distributed_embeddings_tpu.parallel import create_mesh
+  from jax.sharding import PartitionSpec as P
+
+  mesh = create_mesh(4)
+  f = shard_map(
+      lambda x: jax.lax.all_to_all(x, "mp", split_axis=0, concat_axis=0),
+      mesh=mesh, in_specs=(P("mp"),), out_specs=P("mp"))
+  jx = jax.make_jaxpr(f)(jnp.ones((16, 2), jnp.float32))
+  s = summarize(jx.jaxpr)
+  # f32 payload under a bf16-wire expectation
+  out = audit_summary("seed", s, Expectation({}, ("mp",),
+                                             wire_float_dtype="bfloat16"))
+  assert len(out) == 1 and "wire_dtype contract" in out[0]
+  # count drift (expected 2 exchanges, traced 1)
+  out = audit_summary("seed", s, Expectation({}, ("mp",), a2a_count=2))
+  assert len(out) == 1 and "all_to_all" in out[0]
+  # clean under the matching expectation
+  assert audit_summary("seed", s, Expectation(
+      {}, ("mp",), a2a_count=1, wire_float_dtype="float32")) == []
+  del _jnp
 
 
 def test_fingerprints_match_committed_baseline(artifacts):

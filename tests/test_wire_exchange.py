@@ -1,0 +1,463 @@
+"""Exchange parity suite for the round-6 wire knobs.
+
+``DistEmbeddingStrategy(wire_dtype=..., dedup_exchange=...)`` compresses
+the dp<->mp exchanges; this file pins what each knob may and may not
+change:
+
+- ``wire_dtype='f32', dedup_exchange=True`` is BIT-EXACT against the
+  seed exchange on the forward/eval path — the unique-then-gather
+  rerouting ships different tensors but must reproduce the raw path's
+  activations to the bit (expansion re-gathers identical rows; the
+  h-sum and mean divisor run over the same values in the same order).
+  Covered across 1-hot, multi-hot sum/mean with PAD_ID holes, shared
+  tables, row-sliced shards, ragged inputs (which ride the raw value
+  stream even under dedup), micro-batch and guarded steps.
+- Training under dedup is IDENTICAL IN VALUE but not in summation
+  order: duplicate ids' cotangents are segment-summed per unique id
+  before the scatter instead of inside it, an fp-associativity
+  reordering — trajectories are pinned to a 1e-6 absolute bound (the
+  observed drift is last-ulp, ~1e-8 after 3 steps; nonlinear rules add
+  the documented per-unique delta semantics, the exact=True semantics
+  restricted to one exchange block).
+- ``wire_dtype='bf16'`` is tolerance-bounded: one exchange round-trip
+  rounds each activation row once to bf16 (8 mantissa bits, half-ulp
+  2^-9), so per output element ``|err| <= h * 2^-9 * max|row|`` before
+  fp-sum slack; the tests assert a 2x margin (``h * 2^-8 * max|row|``).
+- ``exact=True`` demands the f32 wire at build time (sparse AND tiered
+  builders), and knob validation/reporting behaves.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_embeddings_tpu.compat import shard_map
+from distributed_embeddings_tpu.layers import (
+    DistEmbeddingStrategy,
+    TableConfig,
+)
+from distributed_embeddings_tpu.layers.dist_model_parallel import set_weights
+from distributed_embeddings_tpu.models import bce_loss
+from distributed_embeddings_tpu.models.synthetic import (
+    EmbeddingGroup,
+    SyntheticModel,
+    SyntheticModelConfig,
+    expand_tables,
+    generate_batch,
+)
+from distributed_embeddings_tpu.ops.packed_table import sparse_rule
+from distributed_embeddings_tpu.ops.ragged import RaggedIds
+from distributed_embeddings_tpu.parallel import create_mesh
+from distributed_embeddings_tpu.parallel.lookup_engine import (
+    PAD_ID,
+    DedupRouted,
+    DistributedLookup,
+)
+from distributed_embeddings_tpu.training import (
+    init_sparse_state_direct,
+    make_sparse_eval_step,
+    make_sparse_train_step,
+    shard_batch,
+    shard_params,
+    unpack_sparse_state,
+)
+
+WORLD = 4
+
+CFG = SyntheticModelConfig(
+    name="wiretest", embedding_groups=(
+        EmbeddingGroup(2, (1, 5), 131, 8, True),   # shared multi-hot
+        EmbeddingGroup(3, (1,), 97, 8, False),
+        EmbeddingGroup(2, (3,), 53, 16, False),    # multi-hot narrow
+    ),
+    mlp_sizes=(32, 16), num_numerical_features=4, interact_stride=None)
+
+
+# ---------------------------------------------------------------------------
+# simple-path forward parity (engine.forward under shard_map)
+# ---------------------------------------------------------------------------
+
+
+def _forward_outs(plan, params, inputs, in_specs=None):
+  engine = DistributedLookup(plan)
+  mesh = create_mesh(WORLD)
+  pspecs = {n: P("mp", None) for n in params}
+
+  def fwd(params, *xs):
+    return tuple(engine.forward(params, list(xs)))
+
+  if in_specs is None:
+    in_specs = tuple(P("mp") for _ in inputs)
+  outs = jax.jit(shard_map(
+      fwd, mesh=mesh, in_specs=(pspecs,) + in_specs,
+      out_specs=tuple(P("mp") for _ in inputs)))(params, *inputs)
+  return [np.asarray(o) for o in outs]
+
+
+def _mixed_fixture(combiner, rng, **plan_kw):
+  sizes = [50, 80, 23, 31, 47, 19, 27, 35, 41]
+  tables = [TableConfig(s, 16, combiner=combiner) for s in sizes]
+  plan = DistEmbeddingStrategy(tables, WORLD, "memory_balanced",
+                               dense_row_threshold=0, **plan_kw)
+  weights = [rng.standard_normal((s, 16)).astype(np.float32) for s in sizes]
+  params = {k: jnp.asarray(v)
+            for k, v in set_weights(plan, weights).items()}
+  b = 4 * WORLD
+  ids = [rng.integers(0, s, (b, 3)).astype(np.int32) for s in sizes]
+  for x in ids:  # PAD holes exercise the sentinel/valid-count handling
+    x[rng.random(x.shape) < 0.25] = PAD_ID
+  inputs = [jnp.asarray(x) for x in ids]
+  return plan, params, inputs
+
+
+@pytest.mark.parametrize("combiner", ["sum", "mean"])
+def test_forward_bitexact_f32_dedup(combiner):
+  rng = np.random.default_rng(0)
+  plan_a, params, inputs = _mixed_fixture(combiner, rng)
+  rng = np.random.default_rng(0)
+  plan_b, params_b, inputs_b = _mixed_fixture(combiner, rng,
+                                              dedup_exchange=True)
+  # the dedup'd plan really routes DedupRouted buckets
+  assert all(c["dedup"] for c in plan_b.exchange_report()["classes"].values())
+  out_a = _forward_outs(plan_a, params, inputs)
+  out_b = _forward_outs(plan_b, params_b, inputs_b)
+  for t, (a, b) in enumerate(zip(out_a, out_b)):
+    np.testing.assert_array_equal(a, b, err_msg=f"table {t}")
+
+
+def test_forward_bitexact_f32_dedup_row_sliced():
+  rng = np.random.default_rng(1)
+  sizes = [96, 64, 48, 40, 88, 56, 72, 104]
+  tables = [TableConfig(s, 8, combiner="mean") for s in sizes]
+
+  def build(**kw):
+    plan = DistEmbeddingStrategy(tables, WORLD, "basic",
+                                 row_slice_threshold=16 * 8, **kw)
+    assert any(sh.row_sliced for shards in plan.rank_shards
+               for sh in shards)
+    params = {k: jnp.asarray(v) for k, v in set_weights(
+        plan, [rng.standard_normal((s, 8)).astype(np.float32)
+               for s in sizes]).items()}
+    return plan, params
+
+  rng = np.random.default_rng(1)
+  plan_a, params_a = build()
+  rng = np.random.default_rng(1)
+  plan_b, params_b = build(dedup_exchange=True)
+  b = 2 * WORLD
+  ids = [rng.integers(0, s, (b, 3)).astype(np.int32) for s in sizes]
+  for x in ids:
+    x[rng.random(x.shape) < 0.2] = PAD_ID
+  inputs = [jnp.asarray(x) for x in ids]
+  out_a = _forward_outs(plan_a, params_a, inputs)
+  out_b = _forward_outs(plan_b, params_b, inputs)
+  for t, (a, b_) in enumerate(zip(out_a, out_b)):
+    np.testing.assert_array_equal(a, b_, err_msg=f"table {t}")
+
+
+def test_forward_bitexact_f32_dedup_ragged():
+  """A ragged input rides the raw value-stream exchange even under
+  ``dedup_exchange=True`` (there is nothing padded to dedup), while the
+  plan's other (padded) buckets dedup — the mix must be bit-exact."""
+  rng = np.random.default_rng(2)
+  tables = [TableConfig(60, 8, combiner="sum"),
+            TableConfig(40, 8, combiner="sum")]
+
+  def build(**kw):
+    plan = DistEmbeddingStrategy(tables, WORLD, "basic",
+                                 input_hotness=[-8, 2],
+                                 dense_row_threshold=0, **kw)
+    params = {k: jnp.asarray(v) for k, v in set_weights(
+        plan, [rng.standard_normal((c.input_dim, 8)).astype(np.float32)
+               for c in tables]).items()}
+    return plan, params
+
+  rng = np.random.default_rng(2)
+  plan_a, params_a = build()
+  rng = np.random.default_rng(2)
+  plan_b, params_b = build(dedup_exchange=True)
+
+  b_local, cap = 4, 16
+  values = rng.integers(0, 60, WORLD * cap).astype(np.int32)
+  lengths = rng.integers(0, 5, (WORLD, b_local))
+  lengths = np.minimum(lengths, cap // b_local)  # fit each block's cap
+  splits = np.concatenate([np.concatenate([[0], np.cumsum(l)]) + 0
+                           for l in lengths])
+  dense = jnp.asarray(
+      rng.integers(0, 40, (WORLD * b_local, 2)).astype(np.int32))
+
+  def run(plan, params):
+    engine = DistributedLookup(plan)
+    mesh = create_mesh(WORLD)
+    pspec = {n: P("mp", None) for n in params}
+
+    def fwd(params, v, s, d):
+      return tuple(engine.forward(params, [RaggedIds(v, s), d]))
+
+    return [np.asarray(o) for o in jax.jit(shard_map(
+        fwd, mesh=mesh, in_specs=(pspec, P("mp"), P("mp"), P("mp")),
+        out_specs=(P("mp"), P("mp"))))(
+            params, jnp.asarray(values),
+            jnp.asarray(splits.astype(np.int32)), dense)]
+
+  out_a = run(plan_a, params_a)
+  out_b = run(plan_b, params_b)
+  for t, (a, b_) in enumerate(zip(out_a, out_b)):
+    np.testing.assert_array_equal(a, b_, err_msg=f"table {t}")
+
+
+def test_forward_bf16_wire_tolerance_bound():
+  """The documented bf16 bound: one exchange round-trip rounds each row
+  once to bf16 (half-ulp 2^-9), so ``|err| <= h * 2^-9 * max|row|`` per
+  output element; asserted here with a 2x margin."""
+  rng = np.random.default_rng(3)
+  plan_a, params, inputs = _mixed_fixture("sum", rng)
+  rng = np.random.default_rng(3)
+  plan_b, params_b, inputs_b = _mixed_fixture("sum", rng,
+                                              wire_dtype="bf16")
+  out_a = _forward_outs(plan_a, params, inputs)
+  out_b = _forward_outs(plan_b, params_b, inputs_b)
+  h = 3
+  for t, (a, b) in enumerate(zip(out_a, out_b)):
+    bound = h * 2.0 ** -8 * np.abs(a).max() + 1e-6
+    assert np.abs(a - b).max() <= bound, (t, np.abs(a - b).max(), bound)
+    assert np.abs(a - b).max() > 0  # the wire really narrowed something
+
+
+# ---------------------------------------------------------------------------
+# fused path: eval bit-exactness, training trajectories, guard, micro-batch
+# ---------------------------------------------------------------------------
+
+
+def _fused_setup(rule_name, batch=32, **plan_kw):
+  tables, tmap, hotness = expand_tables(CFG)
+  model = SyntheticModel(CFG)
+  numerical, cats, labels = generate_batch(CFG, batch, alpha=1.1, seed=8)
+  cats = [np.minimum(c, tables[t].input_dim - 1).astype(np.int32)
+          for c, t in zip(cats, tmap)]
+  cats = [jnp.asarray(c if h > 1 else c[:, 0])
+          for c, h in zip(cats, hotness)]
+  batch_tree = (jnp.asarray(numerical), cats, jnp.asarray(labels))
+  plan = DistEmbeddingStrategy(
+      tables, WORLD, "memory_balanced", input_table_map=tmap,
+      input_hotness=hotness, dense_row_threshold=60, batch_hint=batch,
+      **plan_kw)
+  rule = sparse_rule(rule_name, 0.005)
+  opt = optax.adagrad(0.005)
+  dummy = [jnp.zeros((2, t.output_dim), jnp.float32)
+           for t in (tables[i] for i in tmap)]
+  dense_params = model.init(jax.random.PRNGKey(0), batch_tree[0][:2],
+                            [c[:2] for c in cats], emb_acts=dummy)["params"]
+  state = init_sparse_state_direct(plan, rule, dense_params, opt,
+                                   jax.random.PRNGKey(1))
+  mesh = create_mesh(WORLD)
+  state = shard_params(state, mesh)
+  batch_tree = shard_batch(batch_tree, mesh)
+  return model, plan, rule, opt, state, batch_tree, mesh
+
+
+def _run_steps(rule_name, steps=3, step_kw=None, **plan_kw):
+  model, plan, rule, opt, state, bt, mesh = _fused_setup(rule_name,
+                                                         **plan_kw)
+  step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
+                                state, bt, donate=False, **(step_kw or {}))
+  losses = []
+  for _ in range(steps):
+    out = step(state, *bt)
+    state, loss = out[0], out[1]
+    losses.append(float(loss))
+  ev = make_sparse_eval_step(model, plan, rule, mesh, state, bt)
+  preds = np.asarray(ev(state, *bt[:2]))
+  params, _ = unpack_sparse_state(plan, rule, jax.device_get(state))
+  return losses, preds, params
+
+
+def test_eval_bitexact_f32_dedup():
+  """Same state, same batch: the dedup'd exchange must reproduce the raw
+  exchange's predictions to the bit."""
+  model, plan_a, rule, opt, state, bt, mesh = _fused_setup("adagrad")
+  _, plan_b, *_ = _fused_setup("adagrad", dedup_exchange=True)
+  ev_a = make_sparse_eval_step(model, plan_a, rule, mesh, state, bt)
+  ev_b = make_sparse_eval_step(model, plan_b, rule, mesh, state, bt)
+  np.testing.assert_array_equal(np.asarray(ev_a(state, *bt[:2])),
+                                np.asarray(ev_b(state, *bt[:2])))
+
+
+def test_train_f32_dedup_trajectory():
+  """sgd (a linear rule) under dedup applies the mathematically identical
+  update — only duplicate-summation associativity differs (segment-sum
+  before the scatter vs inside it), so the trajectory is pinned at 1e-6
+  absolute; the first step's loss (pure forward) is bit-exact."""
+  la, pa, para = _run_steps("sgd")
+  lb, pb, parb = _run_steps("sgd", dedup_exchange=True)
+  assert la[0] == lb[0]
+  np.testing.assert_allclose(la, lb, rtol=0, atol=1e-6)
+  for k in para["embeddings"]:
+    np.testing.assert_allclose(np.asarray(para["embeddings"][k]),
+                               np.asarray(parb["embeddings"][k]),
+                               rtol=0, atol=1e-6, err_msg=k)
+
+
+def test_train_adagrad_dedup_semantics_close():
+  """Nonlinear rules under dedup get per-UNIQUE delta semantics within
+  each exchange block (the exact=True semantics restricted to one
+  block): same gradient mass, second-order (lr * g^2-scale) deviation
+  from the per-occurrence seed path."""
+  la, pa, _ = _run_steps("adagrad")
+  lb, pb, _ = _run_steps("adagrad", dedup_exchange=True)
+  assert la[0] == lb[0]
+  np.testing.assert_allclose(la, lb, rtol=0, atol=1e-5)
+  np.testing.assert_allclose(pa, pb, rtol=0, atol=1e-4)
+
+
+def test_train_bf16_dedup_converges_close():
+  la, _, _ = _run_steps("sgd")
+  lb, _, _ = _run_steps("sgd", dedup_exchange=True, wire_dtype="bf16")
+  np.testing.assert_allclose(la, lb, rtol=0, atol=5e-3)
+
+
+def test_micro_batch_with_dedup():
+  la, pa, para = _run_steps("adagrad", step_kw={"micro_batches": 2})
+  lb, pb, parb = _run_steps("adagrad", step_kw={"micro_batches": 2},
+                            dedup_exchange=True)
+  assert la[0] == lb[0]  # forward (and the scanned loss sum) is exact
+  np.testing.assert_allclose(la, lb, rtol=0, atol=1e-5)
+  np.testing.assert_allclose(pa, pb, rtol=0, atol=1e-4)
+
+
+def test_guarded_step_with_dedup_skips_poison_batch():
+  model, plan, rule, opt, state, bt, mesh = _fused_setup(
+      "adagrad", dedup_exchange=True, wire_dtype="bf16")
+  step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
+                                state, bt, donate=False, guard=True)
+  state1, loss, metrics = step(state, *bt)
+  assert int(metrics["bad_step"]) == 0
+  # poison labels -> NaN loss: the guarded step must commit NOTHING
+  bad_labels = jnp.full_like(bt[2], jnp.nan)
+  state2, loss2, metrics2 = step(state1, bt[0], bt[1], bad_labels)
+  assert int(metrics2["bad_step"]) == 1
+  before = jax.device_get(state1)
+  after = jax.device_get(state2)
+  for name in before["fused"]:
+    np.testing.assert_array_equal(np.asarray(before["fused"][name]),
+                                  np.asarray(after["fused"][name]))
+  assert int(after["step"]) == int(before["step"])
+
+
+def test_eval_metrics_oov_counts():
+  """The eval path surfaces the per-class OOV counters (with_metrics) —
+  the serving-side observability the ROADMAP resilience follow-on asked
+  for; counters are global (psum'd) occurrence counts."""
+  model, plan, rule, opt, state, bt, mesh = _fused_setup(
+      "adagrad", dedup_exchange=True)
+  ev = make_sparse_eval_step(model, plan, rule, mesh, state, bt,
+                             with_metrics=True)
+  preds, metrics = ev(state, *bt[:2])
+  assert all(int(v) == 0 for v in metrics["oov"].values())
+  # drive input 0 (97-row table, sparse class) out of vocabulary
+  cats = list(bt[1])
+  oov_ids = jnp.full_like(cats[2], 10_000)
+  cats[2] = oov_ids
+  preds2, metrics2 = ev(state, bt[0], cats)
+  total = sum(int(v) for v in metrics2["oov"].values())
+  assert total == int(np.prod(np.asarray(oov_ids).shape))
+
+
+# ---------------------------------------------------------------------------
+# knob validation / build-time contracts
+# ---------------------------------------------------------------------------
+
+
+def test_wire_dtype_validation():
+  with pytest.raises(ValueError, match="wire_dtype"):
+    DistEmbeddingStrategy([TableConfig(8, 4)], 1, wire_dtype="f16")
+
+
+def test_exact_rejects_bf16_wire_sparse_and_tiered():
+  model, plan, rule, opt, state, bt, mesh = _fused_setup(
+      "adagrad", wire_dtype="bf16")
+  with pytest.raises(ValueError, match="wire_dtype='f32'"):
+    make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh, state,
+                           bt, donate=False, exact=True)
+  from distributed_embeddings_tpu.models.dlrm import _dlrm_initializer
+  from distributed_embeddings_tpu.tiering import TieringConfig, TieringPlan
+  from distributed_embeddings_tpu.training import make_tiered_train_step
+  plan_t = DistEmbeddingStrategy(
+      [TableConfig(5000, 16, initializer=_dlrm_initializer(5000)),
+       TableConfig(300, 16, initializer=_dlrm_initializer(300))],
+      WORLD, "memory_balanced", host_row_threshold=1000,
+      wire_dtype="bf16")
+  tplan = TieringPlan(plan_t, rule, TieringConfig(cache_fraction=0.3,
+                                                  staging_grps=64))
+  with pytest.raises(ValueError, match="wire_dtype='f32'"):
+    make_tiered_train_step(model, tplan, bce_loss, opt, rule, mesh,
+                           state, bt, donate=False, exact=True)
+
+
+def test_exact_composes_with_dedup_f32():
+  model, plan, rule, opt, state, bt, mesh = _fused_setup(
+      "adagrad", dedup_exchange=True)
+  step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
+                                state, bt, donate=False, exact=True)
+  _, loss = step(state, *bt)
+  assert np.isfinite(float(loss))
+
+
+def test_exchange_report():
+  tables, tmap, hotness = expand_tables(CFG)
+  plan = DistEmbeddingStrategy(
+      tables, WORLD, "memory_balanced", input_table_map=tmap,
+      input_hotness=hotness, dense_row_threshold=60,
+      wire_dtype="bf16", dedup_exchange=True)
+  rep = plan.exchange_report()
+  assert rep["wire_dtype"] == "bf16"
+  assert rep["float_wire_bytes_per_value"] == 2
+  assert rep["dedup_exchange"] is True
+  kinds = {c["kind"] for c in rep["classes"].values()}
+  assert kinds == {"sparse", "dense"}
+  for c in rep["classes"].values():
+    assert c["dedup"] == (c["kind"] == "sparse")
+  # world 1: no wire, nothing to dedup
+  rep1 = DistEmbeddingStrategy([TableConfig(100, 8)], 1,
+                               dedup_exchange=True).exchange_report()
+  assert not any(c["dedup"] for c in rep1["classes"].values())
+
+
+def test_route_ids_emits_dedup_routed():
+  tables, tmap, hotness = expand_tables(CFG)
+  plan = DistEmbeddingStrategy(
+      tables, WORLD, "memory_balanced", input_table_map=tmap,
+      input_hotness=hotness, dense_row_threshold=60,
+      dedup_exchange=True)
+  engine = DistributedLookup(plan)
+  mesh = create_mesh(WORLD)
+  _, cats, _ = generate_batch(CFG, 4 * WORLD, alpha=1.1, seed=9)
+  cats = [jnp.asarray(np.minimum(c, tables[t].input_dim - 1)
+                      .astype(np.int32))
+          for c, t in zip(cats, tmap)]
+
+  kinds = {}
+
+  def probe(*xs):
+    ids_all = engine.route_ids(list(xs))
+    for bk, ids in ids_all.items():
+      kinds[bk] = type(ids).__name__
+      if isinstance(ids, DedupRouted):
+        # capacity bound: K = min(block occurrences, sentinel + 1)
+        assert ids.uniq.shape == ids.uniq_local.shape
+        assert ids.uniq.shape[0] == WORLD
+    return xs[0]
+
+  jax.jit(shard_map(probe, mesh=mesh,
+                    in_specs=tuple(P("mp") for _ in cats),
+                    out_specs=P("mp")))(*cats)
+  by_kind = {plan.classes[bk.class_key].kind for bk in kinds}
+  assert by_kind == {"sparse", "dense"}
+  for bk, tname in kinds.items():
+    want = ("DedupRouted"
+            if plan.classes[bk.class_key].kind == "sparse" else "ndarray")
+    got = tname if tname == "DedupRouted" else "ndarray"
+    assert got == want, (bk, tname)

@@ -1,0 +1,155 @@
+"""Exchange-path budget: wire bytes + step time across the wire knobs.
+
+Measures the dp<->mp exchange traffic of the fused sparse train step on
+the power-law synthetic workload, across the 2x2 of the round-6 plan
+knobs ``wire_dtype`` x ``dedup_exchange``:
+
+- **exchanged bytes / device-step**: summed from the traced jaxpr — every
+  ``all_to_all`` equation's payload size (the per-device block inside
+  ``shard_map``), forward AND the autodiff-inserted reverse exchange.
+  Static-shape accounting, so these are the bytes actually on the wire
+  (the dedup'd path's win is its static unique capacity
+  ``K = min(occurrences, rows + 1)`` per destination block — power-law
+  duplication is what makes the vocab bound bite).
+- **step time**: wall clock over compiled steps on the CPU mesh. CPU-mesh
+  all_to_alls are memcpys, so the BYTES column is the transferable
+  result; the time column mostly prices the dedup sort and the smaller
+  gather (real-TPU ICI time is a ROADMAP follow-on).
+
+The workload: 8 tables of 1024 rows x width 32, hotness 8, zipf(1.05)
+ids, global batch 16384 over an 8-way mesh — per destination block
+131072 routed occurrences against a 1025-entry unique capacity, the
+"same hot ids exchanged thousands of times" regime of Criteo-style
+inputs (PAPERS.md, Dissecting Embedding Bag Performance).
+
+The recorded budget lives in docs/BENCHMARKS.md ("Round 6: the
+compressed exchange"); the acceptance bar is >= 40% byte reduction for
+``dedup_exchange=True, wire_dtype='bf16'`` vs the seed exchange.
+
+Usage: PYTHONPATH=/root/repo python tools/profile_exchange.py
+"""
+
+import os
+import time
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+  os.environ["XLA_FLAGS"] = (
+      flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from distributed_embeddings_tpu.analysis.jaxpr_audit import (  # noqa: E402
+    walk_eqns,
+)
+from distributed_embeddings_tpu.layers.embedding import TableConfig  # noqa: E402
+from distributed_embeddings_tpu.layers.planner import (  # noqa: E402
+    DistEmbeddingStrategy,
+)
+from distributed_embeddings_tpu.models import bce_loss  # noqa: E402
+from distributed_embeddings_tpu.models.synthetic import (  # noqa: E402
+    EmbeddingGroup,
+    SyntheticModel,
+    SyntheticModelConfig,
+    expand_tables,
+    generate_batch,
+)
+from distributed_embeddings_tpu.ops.packed_table import sparse_rule  # noqa: E402
+from distributed_embeddings_tpu.parallel import create_mesh  # noqa: E402
+from distributed_embeddings_tpu.training import (  # noqa: E402
+    init_sparse_state_direct,
+    make_sparse_train_step,
+    shard_batch,
+    shard_params,
+)
+
+WORLD = 8
+GLOBAL_BATCH = 16384
+ALPHA = 1.05
+STEPS = 3
+
+CFG = SyntheticModelConfig(
+    name="exchange-powerlaw",
+    embedding_groups=(EmbeddingGroup(8, (8,), 1024, 32, False),),
+    mlp_sizes=(64, 32), num_numerical_features=8, interact_stride=None)
+
+
+def a2a_bytes(jaxpr) -> int:
+  """Per-device wire bytes of one step: sum of all_to_all payloads."""
+  total = 0
+  for eqn in walk_eqns(jaxpr):
+    if eqn.primitive.name == "all_to_all":
+      aval = eqn.invars[0].aval
+      total += int(np.prod(aval.shape)) * aval.dtype.itemsize
+  return total
+
+
+def build(mesh, wire_dtype, dedup):
+  tables, tmap, hotness = expand_tables(CFG)
+  model = SyntheticModel(CFG)
+  plan = DistEmbeddingStrategy(
+      tables, WORLD, "memory_balanced", input_table_map=tmap,
+      input_hotness=hotness, batch_hint=GLOBAL_BATCH,
+      wire_dtype=wire_dtype, dedup_exchange=dedup)
+  rule = sparse_rule("sgd", 0.01)
+  opt = optax.sgd(0.01)
+  numerical, cats, labels = generate_batch(CFG, GLOBAL_BATCH, alpha=ALPHA,
+                                           seed=3)
+  cats = [jnp.asarray(np.minimum(c, tables[t].input_dim - 1))
+          for c, t in zip(cats, tmap)]
+  batch = (jnp.asarray(numerical), cats, jnp.asarray(labels))
+  dummy = [jnp.zeros((2, tables[t].output_dim), jnp.float32) for t in tmap]
+  dense_params = model.init(jax.random.PRNGKey(0), batch[0][:2],
+                            [c[:2] for c in cats], emb_acts=dummy)["params"]
+  state = shard_params(
+      init_sparse_state_direct(plan, rule, dense_params, opt,
+                               jax.random.PRNGKey(1)), mesh)
+  bt = shard_batch(batch, mesh)
+  step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
+                                state, batch, donate=False)
+  return step, state, bt
+
+
+def measure(mesh, wire_dtype, dedup):
+  step, state, bt = build(mesh, wire_dtype, dedup)
+  nbytes = a2a_bytes(jax.make_jaxpr(step)(state, *bt).jaxpr)
+  state2, loss = step(state, *bt)  # compile + warm
+  jax.block_until_ready(loss)
+  t0 = time.perf_counter()
+  for _ in range(STEPS):
+    state2, loss = step(state2, *bt)
+  jax.block_until_ready(loss)
+  dt = (time.perf_counter() - t0) / STEPS
+  return nbytes, dt, float(loss)
+
+
+def main():
+  mesh = create_mesh(WORLD)
+  print(f"exchange budget: world={WORLD} batch={GLOBAL_BATCH} "
+        f"tables=8x(1024 rows, w32, h8) zipf({ALPHA})")
+  results = {}
+  for wire in ("f32", "bf16"):
+    for dedup in (False, True):
+      nbytes, dt, loss = measure(mesh, wire, dedup)
+      results[(wire, dedup)] = (nbytes, dt)
+      print(f"  wire={wire:<4} dedup={int(dedup)}  "
+            f"exchanged {nbytes / 1024:9.1f} KiB/device-step  "
+            f"step {dt * 1e3:7.1f} ms  loss {loss:.5f}")
+  base = results[("f32", False)][0]
+  for mode in (("f32", True), ("bf16", False), ("bf16", True)):
+    red = 1.0 - results[mode][0] / base
+    print(f"  reduction vs seed exchange: wire={mode[0]} "
+          f"dedup={int(mode[1])}: {red * 100:.1f}%")
+  red = 1.0 - results[("bf16", True)][0] / base
+  ok = red >= 0.40
+  print(f"acceptance (>= 40% with dedup+bf16): "
+        f"{'OK' if ok else 'FAIL'} ({red * 100:.1f}%)")
+  return 0 if ok else 1
+
+
+if __name__ == "__main__":
+  raise SystemExit(main())
