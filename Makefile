@@ -5,7 +5,7 @@
 PY ?= python
 SHELL := /bin/bash  # verify uses pipefail/PIPESTATUS
 
-.PHONY: test test-fast verify lint native bench dryrun chaos clean
+.PHONY: test test-fast verify lint native bench dryrun chaos chaos-kill clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -57,6 +57,15 @@ tpu-smoke:
 # @pytest.mark.slow test in tests/test_resilience.py)
 chaos:
 	$(PY) tools/chaos_train.py
+
+# cross-run SIGKILL chaos: a REAL worker subprocess is SIGKILLed
+# mid-save / between steps and relaunched — at the same world and
+# RESIZED (elastic restore) — and the stitched trajectory must match an
+# unkilled reference with consumed == steps + skipped across lifetimes
+# (tools/chaos_kill.py; the multi-cycle variant is @pytest.mark.slow in
+# tests/test_elastic.py)
+chaos-kill:
+	$(PY) tools/chaos_kill.py
 
 # multi-chip compile/execute validation on 8 virtual CPU devices
 dryrun:

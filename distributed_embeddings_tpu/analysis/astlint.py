@@ -33,6 +33,10 @@ GL109       error      no raw ``lax.all_to_all`` outside ``parallel/wire.py``
                        (library-package modules: everywhere; elsewhere:
                        trace-reachable step-builder code) — a raw f32
                        exchange bypasses the plan's wire contract
+GL110       error      no ``jax.process_count()``/``process_index()``
+                       compared against hardcoded world constants (!= 0/1)
+                       in durable modules — elastic pods resize the world
+                       between runs; derive shapes from the plan/manifest
 ==========  =========  =====================================================
 
 Trace-reachable scope (GL101/GL102) is structural: any function nested —
@@ -454,6 +458,43 @@ def _check_raw_all_to_all(mod: ParsedModule) -> List[Finding]:
   return out
 
 
+@_rule("GL110", "error",
+       "no hardcoded world constants vs process_count/index in durable code")
+def _check_world_constants(mod: ParsedModule) -> List[Finding]:
+  # Elastic pods resize the world between runs: a checkpoint written at
+  # world N restores at world M, so durable (checkpoint/manifest) code
+  # comparing jax.process_count() / jax.process_index() against a baked-in
+  # integer encodes one world shape into exactly the layer that must
+  # survive a resize. 0 and 1 are exempt — `process_index() == 0` (the
+  # controller check) and `process_count() > 1` (the multi-controller
+  # check) are world-shape-free idioms.
+  if not _is_durable_module(mod.path):
+    return []
+  proc_calls = frozenset({"process_count", "process_index"})
+  out = []
+  for node in ast.walk(mod.tree):
+    if not isinstance(node, ast.Compare):
+      continue
+    sides = [node.left] + list(node.comparators)
+    if not any(isinstance(s, ast.Call) and _call_pair(s)[1] in proc_calls
+               for s in sides):
+      continue
+    for s in sides:
+      if isinstance(s, ast.Constant) and isinstance(s.value, int) \
+          and not isinstance(s.value, bool) and s.value not in (0, 1):
+        out.append(mod.finding(
+            "GL110", node,
+            f"jax.process_count()/process_index() compared against the "
+            f"hardcoded constant {s.value}: durable code must stay "
+            "world-shape-portable (a checkpoint written at world N "
+            "restores at world M). Derive world facts from the plan "
+            "(plan.world_size) or the manifest's 'world' section; only "
+            "0/1 (controller / multi-controller idioms) are "
+            "shape-free."))
+        break
+  return out
+
+
 @_rule("GL108", "error", "fault-injection sites must be registered")
 def _check_fault_sites(mod: ParsedModule) -> List[Finding]:
   # the registry module itself defines the sites
@@ -508,22 +549,43 @@ def _parse_markers(root: str) -> frozenset:
   return frozenset(m.split(":")[0].strip() for m in markers)
 
 
+_REGISTER_SITE_RE = re.compile(
+    r"register_site\(\s*[\"']([A-Za-z0-9_]+)[\"']")
+
+
 def _parse_fault_sites(root: str) -> Optional[frozenset]:
-  """The ``SITES`` literal from resilience/faultinject.py, by AST."""
+  """The known fault-site set: the ``SITES`` literal from
+  resilience/faultinject.py (by AST) plus every string-literal
+  ``register_site`` call in the library package and tools/ (the
+  sanctioned extension mechanism — a registered site is known by
+  definition, so rules installed on it must lint clean)."""
   path = os.path.join(root, "distributed_embeddings_tpu", "resilience",
                       "faultinject.py")
   if not os.path.exists(path):
     return None
   with open(path) as f:
     tree = ast.parse(f.read())
+  sites = None
   for node in ast.walk(tree):
     if isinstance(node, ast.Assign) and any(
         isinstance(t, ast.Name) and t.id == "SITES" for t in node.targets):
       consts = [s.value for s in ast.walk(node.value)
                 if isinstance(s, ast.Constant) and isinstance(s.value, str)]
       if consts:
-        return frozenset(consts)
-  return None
+        sites = set(consts)
+  if sites is None:
+    return None
+  for base in ("distributed_embeddings_tpu", "tools"):
+    top = os.path.join(root, base)
+    if not os.path.isdir(top):
+      continue
+    for dirpath, dirnames, filenames in os.walk(top):
+      dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+      for fn in sorted(filenames):
+        if fn.endswith(".py"):
+          with open(os.path.join(dirpath, fn)) as f:
+            sites.update(_REGISTER_SITE_RE.findall(f.read()))
+  return frozenset(sites)
 
 
 # ---------------------------------------------------------------------------

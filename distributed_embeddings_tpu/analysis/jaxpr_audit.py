@@ -249,6 +249,9 @@ def build_artifacts() -> Dict[str, Tuple[Any, Expectation]]:
   - ``sparse_step_wire``:   same step on a ``wire_dtype='bf16',
     dedup_exchange=True`` plan (every float exchange must be bf16)
   - ``tiered_step``:        ``make_tiered_train_step`` (host-tier class)
+  - ``tiered_step_guard``:  ``make_tiered_train_step(guard=True)`` —
+    the commit gate's pmin must appear exactly once here too, so a
+    poison batch cannot fork the tiers
   - ``eval_step``:          ``make_sparse_eval_step`` (zero scatters)
   """
   _require_cpu_devices()
@@ -388,6 +391,19 @@ def build_artifacts() -> Dict[str, Tuple[Any, Expectation]]:
   jx = jax.make_jaxpr(step_t)(state_t, staged.device, *bt)
   artifacts["tiered_step"] = (
       jx.jaxpr, Expectation(shapes_t, mesh_axes, guard=False,
+                            a2a_count=3 * n_padded_buckets(plan_t),
+                            wire_float_dtype="float32"))
+
+  # ---- guarded tiered step (PR 2 carried follow-on) -----------------------
+  # same plan/state/staging; the guard adds exactly one pmin (the
+  # collective commit gate now also covering the staged write-back) and
+  # the psum'd OOV counters — both pinned by Expectation + fingerprint
+  step_tg = make_tiered_train_step(model, tplan, bce_loss, opt, rule, mesh,
+                                   state_t, batch0, donate=False,
+                                   guard=True)
+  jx = jax.make_jaxpr(step_tg)(state_t, staged.device, *bt)
+  artifacts["tiered_step_guard"] = (
+      jx.jaxpr, Expectation(shapes_t, mesh_axes, guard=True,
                             a2a_count=3 * n_padded_buckets(plan_t),
                             wire_float_dtype="float32"))
   return artifacts

@@ -37,18 +37,25 @@ checkpoint.
 verifies by default and names the bad file. ``resilience.durable`` adds
 rotation of the last K checkpoints and newest-valid fallback on top.
 
-Migration note: the manifest's plan fingerprint pins the PHYSICAL layout,
-so checkpoints fail restore (with a diff) whenever a planner default that
-shapes the layout changes. Layout-shaping defaults that have moved:
-``dense_row_threshold`` 2048 -> 4096 (round 2), ``max_class_bytes``
-2 GiB -> 3 GiB (round 3), and round 3's generation assignment
-(occurrence-balanced / cost-model) replacing round 2's first-fit. To
-restore a checkpoint saved under old defaults, rebuild the plan with the
-SAVING run's explicit arguments — e.g. ``dense_row_threshold=2048,
-max_class_bytes=2 * 1024**3, gen_assignment='first_fit'`` for a round-2
-checkpoint (``gen_assignment='first_fit'`` reproduces the legacy
-generation layout exactly) — the error message lists exactly which
-fingerprint fields differ.
+Elasticity (round 10): the manifest carries a ``world`` section (rank
+count, per-class kind/tier/rows) alongside the fingerprint's per-slot
+``layout``, which together describe where every logical table row lives
+in the rank files. ``restore`` therefore treats a plan mismatch that is
+ONLY placement — world size, strategy, slicing thresholds, generation
+assignment — as an elastic RE-SHARD: rank blocks are re-sliced at
+logical-row granularity (optimizer lanes ride along, f32 bit-exact),
+host-tier cold images re-shard by the same windows, and resident sets
+re-derive from the new ``TieringPlan``. Only differences that change
+what the rows ARE (different tables, an input->table remap, a table
+switching storage tier or sparse/dense kind) still refuse, with the
+reason named. This also subsumes most of the old migration story for
+layout-shaping planner defaults (``max_class_bytes`` 2 -> 3 GiB,
+first-fit -> cost-model generations, and ``dense_row_threshold`` moves
+that flip no table's kind): such checkpoints now re-shard instead of
+demanding the saving run's explicit arguments. A threshold change that
+DOES flip a table between the packed-sparse and MXU-dense formats, and
+pre-layout-fingerprint checkpoints, still need the saving run's
+arguments.
 """
 
 from __future__ import annotations
@@ -65,8 +72,12 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .layers.planner import DistEmbeddingStrategy
-from .ops.packed_table import SparseRule
-from .parallel.lookup_engine import DistributedLookup, class_param_name
+from .ops.packed_table import PackedLayout, SparseRule
+from .parallel.lookup_engine import (
+    DistributedLookup,
+    class_param_name,
+    padded_rows,
+)
 from .resilience import faultinject
 
 FORMAT_VERSION = 1
@@ -261,6 +272,288 @@ def _plan_fingerprint(plan: DistEmbeddingStrategy) -> Dict[str, Any]:
   return fp
 
 
+def _world_section(plan: DistEmbeddingStrategy) -> Dict[str, Any]:
+  """The manifest's ``world`` section: everything an ELASTIC restore
+  needs to interpret the per-rank files without rebuilding the saving
+  run's plan — rank count and, per class, its kind/tier and per-rank
+  LOGICAL row count (the packed physical geometry follows from
+  ``PackedLayout(rows, width, rule.n_aux)``, and the rule is pinned
+  separately). Combined with the plan fingerprint's ``layout`` (per-slot
+  table row/col windows) this makes a world-shape mismatch a re-shard,
+  not a refusal."""
+  classes = {}
+  for key in plan.class_keys:
+    cp = plan.classes[key]
+    classes[class_param_name(*key)] = {
+        "kind": cp.kind,
+        "tier": plan.class_tiers.get(key, "device"),
+        "rows": padded_rows(plan, key),
+        "width": cp.width,
+    }
+  return {"ranks": plan.world_size, "classes": classes}
+
+
+def _elastic_reason(manifest: Dict[str, Any], want: Dict[str, Any],
+                    plan: DistEmbeddingStrategy) -> Optional[str]:
+  """None when a plan-fingerprint mismatch is ONLY a world-shape /
+  placement difference an elastic re-shard can bridge, else the reason
+  it cannot. Bridgeable: world size, strategy, slicing thresholds,
+  generation assignment — anything that moves logical rows between rank
+  blocks without changing WHAT the rows are. Not bridgeable: different
+  tables, a different input->table map, a table changing storage tier
+  (host <-> device is a format conversion, not a re-shard), or a
+  checkpoint predating the layout/world manifest sections."""
+  saved = manifest["plan"]
+  if "layout" not in saved or "world" not in manifest:
+    return ("the checkpoint predates the elastic manifest format "
+            "(no plan.layout / world section), so its rank blocks "
+            "cannot be re-sliced")
+  if saved.get("tables") != want.get("tables"):
+    return "the logical tables differ (vocab/width/combiner)"
+  if saved.get("input_table_map") != want.get("input_table_map"):
+    return "the input->table map differs"
+  src_tier: Dict[int, str] = {}
+  src_kind: Dict[int, str] = {}
+  for cname, meta in manifest["world"]["classes"].items():
+    for rank_slots in saved["layout"].get(cname, []):
+      for slot in rank_slots:
+        src_tier[int(slot[0])] = meta["tier"]
+        src_kind[int(slot[0])] = meta["kind"]
+  new_kind: Dict[int, str] = {}
+  for key in plan.class_keys:
+    cp = plan.classes[key]
+    for slots in cp.slots_per_rank:
+      for s in slots:
+        new_kind[s.shard.table_id] = cp.kind
+  for t, tier in sorted(src_tier.items()):
+    if plan.table_tier(t) != tier:
+      return (f"table {t} was saved on the {tier!r} tier but the current "
+              f"plan places it on {plan.table_tier(t)!r} — cross-tier "
+              "moves need a format conversion, not an elastic re-shard "
+              "(adjust host_row_threshold to match the saving run)")
+    if new_kind.get(t) != src_kind[t]:
+      # a dense_row_threshold change can flip a table between the packed
+      # sparse format (fused files, interleaved aux lanes) and the
+      # simple MXU-dense format (emb_dense npz, optax state) — a format
+      # conversion, not a row move
+      return (f"table {t} was saved as a {src_kind[t]!r}-kind class but "
+              f"the current plan serves it {new_kind.get(t)!r}-kind — "
+              "the sparse<->dense storage formats differ (packed aux "
+              "lanes vs optax state); match the saving run's "
+              "dense_row_threshold")
+  return None
+
+
+def _restore_elastic(path: str, manifest: Dict[str, Any],
+                     plan: DistEmbeddingStrategy, rule: SparseRule,
+                     state_like: Dict[str, Any],
+                     mesh: Optional[Mesh], axis_name: str,
+                     store) -> Dict[str, Any]:
+  """Load a world-N checkpoint onto a world-M plan by re-slicing rank
+  blocks at LOGICAL-row granularity.
+
+  Per target rank block, each slot's logical row/column windows are
+  pulled from the saved per-rank packed blocks (device-tier ``fused_*``
+  files and host-tier ``cold_*`` images alike) via memory-mapped
+  physical-row slices, unpacked (a pure reshape — the interleaved
+  optimizer lanes ride along untouched), and re-packed into the NEW
+  plan's block; pack/unpack are exact inverses, so every logical row
+  (table AND optimizer lanes) is f32 bit-exact across the move.
+  Dense-kind (MXU) class blocks and their per-row optimizer-state
+  leaves re-shard by the same table windows in the simple layout.
+  Host-tier resident sets, observed counts, and staging geometry are
+  RE-DERIVED from the new ``TieringPlan`` (the hot set is a cache
+  policy keyed to the new world's row blocks, not state); padding rows
+  re-initialize to zero.
+
+  Peak host memory for the sparse majority is ONE target rank block
+  plus one source window at a time — the streaming matters because the
+  rank-owner-sharded cold store exists precisely for states no single
+  host holds. (Dense-kind classes sit below ``dense_row_threshold`` by
+  definition; their npz regrouping materializes those small tables.)
+  """
+  saved = manifest["plan"]
+  world_meta = manifest["world"]
+  n_src = int(world_meta["ranks"])
+  src_classes = world_meta["classes"]
+  src_layout = saved["layout"]
+  n_aux = rule.n_aux
+  cfgs = plan.global_configs
+
+  tiered_names = frozenset(store.tplan.tier_specs) if store is not None \
+      else frozenset()
+  new_host = {class_param_name(*k) for k in plan.host_tier_class_keys()}
+  if new_host and store is None:
+    raise ValueError(
+        "elastic restore onto a plan with host-tier classes requires the "
+        "new world's HostTierStore (restore(..., store=store)): the "
+        "re-sharded cold images have nowhere to live otherwise.")
+  if store is not None and set(tiered_names) != new_host:
+    raise ValueError(
+        f"store geometry {sorted(tiered_names)} does not cover the plan's "
+        f"host-tier classes {sorted(new_host)}: build the HostTierStore "
+        "from a TieringPlan of THIS plan")
+
+  # ---- source index: where each sparse table's rows/cols live -------------
+  # table id -> {(file, layout, row_offset, row_start, rows, c0, c1)};
+  # a set because shared tables list the same shard once per feeding slot
+  src_slots: Dict[int, set] = {}
+  for cname in sorted(src_classes):
+    meta = src_classes[cname]
+    if meta["kind"] != "sparse":
+      continue
+    lay = PackedLayout(rows=int(meta["rows"]), width=int(meta["width"]),
+                       n_aux=n_aux)
+    prefix = "cold" if meta["tier"] == "host" else "fused"
+    for rank in range(n_src):
+      fname = f"{prefix}_{cname}_r{rank}.npy"
+      for slot in src_layout[cname][rank]:
+        t, off, rs0, nrows, c0, c1, _rs = (int(v) for v in slot)
+        src_slots.setdefault(t, set()).add(
+            (fname, lay, off, rs0, nrows, c0, c1))
+
+  def read_rows(fname, lay, lo, hi) -> np.ndarray:
+    """Logical rows ``[lo, hi)`` of one packed rank file as
+    ``[1 + n_aux, hi - lo, width]`` — memory-mapped: only the covering
+    PHYSICAL rows are materialized, never the block."""
+    faultinject.fire("reshard_gather", file=fname, rows=hi - lo)
+    blk = np.load(os.path.join(path, fname), mmap_mode="r")
+    if blk.shape != (lay.phys_rows, lay.phys_width):
+      raise ValueError(
+          f"elastic restore: {fname} has shape {blk.shape}, but the "
+          f"manifest's world section implies "
+          f"{(lay.phys_rows, lay.phys_width)} — manifest and files "
+          "disagree (corrupt or hand-edited checkpoint)")
+    rpp = lay.rows_per_phys
+    p0, p1 = lo // rpp, -(-hi // rpp)
+    sub = np.asarray(blk[p0:p1])
+    sublay = PackedLayout(rows=(p1 - p0) * rpp, width=lay.width,
+                          n_aux=n_aux)
+    tbl, aux = sublay.unpack(sub)
+    skip = lo - p0 * rpp
+    return np.stack([tbl] + list(aux))[:, skip:skip + (hi - lo)]
+
+  # ---- target: packed rank blocks for the NEW plan, window-streamed -------
+  def rank_block(key, lay_log, rank) -> np.ndarray:
+    cp = plan.classes[key]
+    parts = np.zeros((1 + n_aux, lay_log.rows, cp.width), np.float32)
+    for s in cp.slots_per_rank[rank]:
+      sh = s.shard
+      # the saved slots of this table partition its rows x cols, so the
+      # 2-D overlaps below jointly cover the target window exactly —
+      # whatever the two worlds' row/column slicings were
+      for (fname, lay, off_s, rs0_s, n_s, c0_s, c1_s) \
+          in sorted(src_slots[sh.table_id]):
+        r0 = max(sh.row_start, rs0_s)
+        r1 = min(sh.row_start + sh.input_dim, rs0_s + n_s)
+        ca = max(sh.col_start, c0_s)
+        cb = min(sh.col_end, c1_s)
+        if r0 >= r1 or ca >= cb:
+          continue
+        win = read_rows(fname, lay, off_s + (r0 - rs0_s),
+                        off_s + (r1 - rs0_s))
+        parts[:, s.row_offset + (r0 - sh.row_start):
+              s.row_offset + (r1 - sh.row_start),
+              ca - sh.col_start:cb - sh.col_start] = \
+            win[:, :, ca - c0_s:cb - c0_s]
+    return np.asarray(
+        lay_log.pack(parts[0], [parts[1 + j] for j in range(n_aux)]),
+        np.float32)
+
+  fused: Dict[str, Any] = {}
+  for key in plan.class_keys:
+    cp = plan.classes[key]
+    if cp.kind != "sparse":
+      continue
+    name = class_param_name(*key)
+    lay_log = PackedLayout(rows=padded_rows(plan, key), width=cp.width,
+                           n_aux=n_aux)
+    if name in tiered_names:
+      for rank in store.owned_ranks:
+        store.set_image(name, rank, rank_block(key, lay_log, rank))
+      continue
+    shape = (plan.world_size * lay_log.phys_rows, lay_log.phys_width)
+    if mesh is None:
+      fused[name] = jnp.asarray(np.concatenate(
+          [rank_block(key, lay_log, r) for r in range(plan.world_size)]))
+    else:
+      sharding = NamedSharding(mesh, P(axis_name, None))
+
+      def cb(index, key=key, lay_log=lay_log):
+        rank = (index[0].start or 0) // lay_log.phys_rows
+        return rank_block(key, lay_log, rank)
+
+      fused[name] = jax.make_array_from_callback(shape, sharding, cb)
+
+  if store is not None and tiered_names:
+    # resident sets / counts / staging geometry re-derived from the new
+    # TieringPlan (see docstring); images above are already authoritative
+    for name in store.counts:
+      for rank in store.owned_ranks:
+        store.counts[name][rank][:] = 0
+    store.warm_start()
+    fused.update(store.build_fused(mesh, axis_name))
+
+  # ---- dense-kind (MXU) classes: emb_dense + its optimizer leaves --------
+  src_dense = {n: m for n, m in src_classes.items() if m["kind"] == "dense"}
+
+  def regroup(flat_src: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Re-shard class-block-shaped leaves of a flat (path-keyed) dict
+    onto the new plan; other leaves (optax scalars etc.) pass through."""
+    per_prefix: Dict[str, Dict[int, np.ndarray]] = {}
+    out: Dict[str, np.ndarray] = {}
+    for key_str, arr in flat_src.items():
+      head, _, last = key_str.rpartition("/")
+      meta = src_dense.get(last)
+      if meta is None or getattr(arr, "ndim", 0) != 2 \
+          or arr.shape[0] != n_src * int(meta["rows"]):
+        out[key_str] = arr
+        continue
+      rows_src = int(meta["rows"])
+      per_t = per_prefix.setdefault(head, {})
+      for rank in range(n_src):
+        for slot in src_layout[last][rank]:
+          t, off, rs0, nrows, c0, c1, _rs = (int(v) for v in slot)
+          dstt = per_t.get(t)
+          if dstt is None:
+            dstt = per_t[t] = np.zeros(
+                (cfgs[t].input_dim, cfgs[t].output_dim), arr.dtype)
+          base = rank * rows_src + off
+          dstt[rs0:rs0 + nrows, c0:c1] = arr[base:base + nrows]
+    for head, per_t in per_prefix.items():
+      for key in plan.class_keys:
+        cp = plan.classes[key]
+        if cp.kind == "sparse":
+          continue
+        name = class_param_name(*key)
+        rows_dst = padded_rows(plan, key)
+        dtype = next(iter(per_t.values())).dtype
+        block = np.zeros((plan.world_size * rows_dst, cp.width), dtype)
+        for rank in range(plan.world_size):
+          for s in cp.slots_per_rank[rank]:
+            sh = s.shard
+            base = rank * rows_dst + s.row_offset
+            block[base:base + sh.input_dim] = \
+                per_t[sh.table_id][sh.row_start:sh.row_start + sh.input_dim,
+                                   sh.col_start:sh.col_end]
+        out[(head + "/" + name) if head else name] = block
+    return out
+
+  parts = {}
+  for part in ("dense", "dense_opt", "emb_dense", "emb_dense_opt"):
+    with np.load(os.path.join(path, f"{part}.npz")) as z:
+      flat = dict(z)
+    if part in ("emb_dense", "emb_dense_opt"):
+      flat = regroup(flat)
+    parts[part] = _unflatten_like(state_like[part], flat)
+
+  return {
+      **parts,
+      "fused": fused,
+      "step": jnp.asarray(manifest["step"], jnp.int32),
+  }
+
+
 def _abbrev(v, limit: int = 200) -> str:
   s = repr(v)
   return s if len(s) <= limit else s[:limit] + f"... (+{len(s) - limit} chars)"
@@ -306,6 +599,34 @@ def _rank_blocks_addressable(arr: jax.Array, phys_rows: int):
     yield rank, block
 
 
+def _write_tier_blocks(tmp: str, store, seal) -> None:
+  """Write one OWNER's share of a tiered checkpoint into ``tmp``.
+
+  Per owned rank of each host-tier class: the cold-store image as
+  ``cold_<class>_r<rank>.npy`` (the authoritative full packed block),
+  plus one tier-state npz carrying the owned ranks' resident sets and
+  observed counts — ``tiering.npz`` from a fully-owned store, or
+  ``tiering_p<process>.npz`` from a rank-owner-sharded one (disjoint
+  owners write disjoint files; restore merges them). Every file goes
+  through ``seal`` (fsync + crc32 for the DONE-marker manifest merge);
+  the ``ckpt_owner_write`` fault site fires per cold block."""
+  tiered_names = frozenset(store.tplan.tier_specs)
+  flat = {}
+  for name in sorted(tiered_names):
+    for rank in store.owned_ranks:
+      fpath = os.path.join(tmp, f"cold_{name}_r{rank}.npy")
+      np.save(fpath, store.images[name][rank])
+      faultinject.fire("ckpt_owner_write", clazz=name, rank=rank)
+      seal(fpath)
+      flat[f"{name}/r{rank}/resident_grps"] = \
+          store.resident_grps[name][rank]
+      flat[f"{name}/r{rank}/counts"] = store.counts[name][rank]
+  fpath = os.path.join(tmp, "tiering.npz" if store.owns_all
+                       else f"tiering_p{jax.process_index()}.npz")
+  np.savez(fpath, **flat)
+  seal(fpath)
+
+
 def read_manifest(path: str) -> Dict[str, Any]:
   """Load a checkpoint's manifest (e.g. to read ``extra`` metadata)."""
   with open(os.path.join(path, "manifest.json")) as f:
@@ -334,10 +655,14 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
   host images first, then each host-tier class is written as per-rank
   COLD-STORE blocks (``cold_<class>_r<rank>.npy`` — the full packed image,
   the authoritative state) plus the resident sets and observed counts
-  (``tiering.npz``), so a restore resumes with the same hot set and
-  re-ranking signal. The compact device buffers are NOT saved (they are
-  derived). Single-controller only for now: the flush and the images live
-  on one host.
+  (``tiering.npz``; a SHARDED store writes ``tiering_p<proc>.npz`` per
+  owner), so a restore resumes with the same hot set and re-ranking
+  signal. The compact device buffers are NOT saved (they are derived).
+  Multi-controller: each process passes ITS rank-owner-sharded store
+  (``HostTierStore(tplan, owned_ranks=...)``) and writes only its ranks'
+  cold blocks — sealed into the shared crc32 manifest through the same
+  per-process DONE-marker protocol as the fused blocks, so a save is
+  published only when every owner's blocks landed.
   """
   engine = DistributedLookup(plan)
   tiered_names = frozenset(store.tplan.tier_specs) if store is not None \
@@ -348,10 +673,6 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
         "saving only the compact device buffers would drop the cold rows "
         "(the authoritative majority of the weights). Pass the run's "
         "store via save(..., store=store).")
-  if store is not None and jax.process_count() > 1:
-    raise NotImplementedError(
-        "tiered checkpoint save under multi-controller: the host images "
-        "live on one host; shard the cold store first (ROADMAP open item)")
   layouts = engine.fused_layouts(
       rule, rows_overrides=store.tplan.rows_overrides if store else None)
   if store is not None:
@@ -427,27 +748,8 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
 
     tiering_meta = None
     if store is not None:
-      tiering_meta = {"classes": {}}
-      flat = {}
-      for name in sorted(tiered_names):
-        c = store.tplan.by_name(name)
-        lay = c.layout_logical
-        for rank in range(plan.world_size):
-          fpath = os.path.join(tmp, f"cold_{name}_r{rank}.npy")
-          np.save(fpath, store.images[name][rank])
-          _seal(fpath)
-          flat[f"{name}/r{rank}/resident_grps"] = \
-              store.resident_grps[name][rank]
-          flat[f"{name}/r{rank}/counts"] = store.counts[name][rank]
-        tiering_meta["classes"][name] = {
-            "cache_grps": c.spec.cache_grps,
-            "staging_grps": c.spec.staging_grps,
-            "phys_rows": lay.phys_rows,
-            "phys_width": lay.phys_width,
-        }
-      fpath = os.path.join(tmp, "tiering.npz")
-      np.savez(fpath, **flat)
-      _seal(fpath)
+      tiering_meta = {"classes": store.tplan.geometry()}
+      _write_tier_blocks(tmp, store, _seal)
 
     if p0:
       for part in ("dense", "dense_opt", "emb_dense", "emb_dense_opt"):
@@ -510,6 +812,7 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
         "step": int(_to_host(state["step"])),
         "rule": {"name": rule.name, "n_aux": rule.n_aux},
         "plan": _plan_fingerprint(plan),
+        "world": _world_section(plan),
         "fused": fused_meta,
         "checksums": checksums,
     }
@@ -588,8 +891,21 @@ def restore(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
       (required iff the manifest has a tiering section, and its
       ``TieringPlan`` geometry must match the saving run's — validated
       below). Cold images, resident sets and observed counts are loaded
-      into it, and the host-tier classes' compact device buffers are
-      rebuilt from the restored resident sets.
+      into it (a rank-owner-sharded store loads only its ranks), and the
+      host-tier classes' compact device buffers are rebuilt from the
+      restored resident sets.
+
+  Elastic (world-shape-portable) restore: when ``plan`` differs from
+  the saving run's ONLY in placement — world size, strategy, slicing
+  thresholds, generation assignment — the checkpoint is re-sharded at
+  load instead of refused: per-rank packed class blocks are re-sliced
+  at logical-row granularity (interleaved optimizer lanes ride along),
+  host-tier cold images re-shard the same way, and resident sets /
+  staging geometry are re-derived from the new ``TieringPlan``. Every
+  logical row is f32 bit-exact across the move (``tests/test_elastic.py``
+  pins N -> M -> N round trips). Mismatches an elastic re-shard cannot
+  bridge (different tables, a table changing tier) still refuse with the
+  reason named.
   """
   engine = DistributedLookup(plan)
   tiered_names = frozenset(store.tplan.tier_specs) if store is not None \
@@ -660,14 +976,23 @@ def restore(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
     # below still guards phys shapes)
     want = {k: v for k, v in want.items() if k != "layout"}
   if manifest["plan"] != want:
+    # world-shape portability: a mismatch whose only differences are
+    # placement (world size, strategy, slicing, generations) is a
+    # RE-SHARD, not a refusal — the manifest's layout + world sections
+    # say where every logical row lives, so the rank blocks re-slice
+    reason = _elastic_reason(manifest, want, plan)
+    if reason is None:
+      return _restore_elastic(path, manifest, plan, rule, state_like,
+                              mesh, axis_name, store)
     diff_keys = sorted(k for k in set(manifest["plan"]) | set(want)
                        if manifest["plan"].get(k) != want.get(k))
     detail = "; ".join(
         f"{k}: saved={_abbrev(manifest['plan'].get(k))} "
         f"have={_abbrev(want.get(k))}" for k in diff_keys)
     raise ValueError(
-        "checkpoint plan does not match: re-create the DistEmbeddingStrategy "
-        f"with the same tables/world/strategy/slicing (differs in {detail})")
+        "checkpoint plan does not match and cannot be elastically "
+        f"re-sharded ({reason}): re-create the DistEmbeddingStrategy "
+        f"with the same tables (differs in {detail})")
 
   saved_tiering = manifest.get("tiering", {}).get("classes", {})
   if set(saved_tiering) != set(tiered_names):
@@ -676,30 +1001,35 @@ def restore(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
         f"{sorted(saved_tiering)}, restoring with {sorted(tiered_names)} — "
         "pass the matching HostTierStore (tiered checkpoint) or none "
         "(all-device checkpoint)")
-  for name, meta in saved_tiering.items():
-    c = store.tplan.by_name(name)
-    have = {"cache_grps": c.spec.cache_grps,
-            "staging_grps": c.spec.staging_grps,
-            "phys_rows": c.layout_logical.phys_rows,
-            "phys_width": c.layout_logical.phys_width}
-    if meta != have:
-      raise ValueError(
-          f"checkpoint class {name!r} tier geometry {meta} does not match "
-          f"the current TieringPlan {have}: rebuild the TieringConfig with "
-          "the saving run's budget/cache/staging settings")
   if store is not None:
-    with np.load(os.path.join(path, "tiering.npz")) as z:
-      for name in sorted(tiered_names):
-        for rank in range(plan.world_size):
-          store.set_image(name, rank, np.load(
-              os.path.join(path, f"cold_{name}_r{rank}.npy")))
-          grps = np.asarray(z[f"{name}/r{rank}/resident_grps"], np.int32)
-          rmap = store.resident_map[name][rank]
-          rmap[:] = -1
-          rmap[grps] = np.arange(grps.shape[0], dtype=np.int32)
-          store.resident_grps[name][rank] = grps
-          store.counts[name][rank] = np.asarray(
-              z[f"{name}/r{rank}/counts"], np.int64)
+    geometry = store.tplan.geometry()
+    for name, meta in saved_tiering.items():
+      if meta != geometry[name]:
+        raise ValueError(
+            f"checkpoint class {name!r} tier geometry {meta} does not "
+            f"match the current TieringPlan {geometry[name]}: rebuild the "
+            "TieringConfig with the saving run's budget/cache/staging "
+            "settings")
+    # tier state: one 'tiering.npz' from a fully-owned save, or per-owner
+    # 'tiering_p<k>.npz' files from a sharded one — merge whatever exists
+    # (only this store's ranks are read either way)
+    flat: Dict[str, np.ndarray] = {}
+    for fn in sorted(os.listdir(path)):
+      if fn == "tiering.npz" or (fn.startswith("tiering_p")
+                                 and fn.endswith(".npz")):
+        with np.load(os.path.join(path, fn)) as z:
+          flat.update({k: np.asarray(v) for k, v in z.items()})
+    for name in sorted(tiered_names):
+      for rank in store.owned_ranks:
+        store.set_image(name, rank, np.load(
+            os.path.join(path, f"cold_{name}_r{rank}.npy")))
+        grps = np.asarray(flat[f"{name}/r{rank}/resident_grps"], np.int32)
+        rmap = store.resident_map[name][rank]
+        rmap[:] = -1
+        rmap[grps] = np.arange(grps.shape[0], dtype=np.int32)
+        store.resident_grps[name][rank] = grps
+        store.counts[name][rank] = np.asarray(
+            flat[f"{name}/r{rank}/counts"], np.int64)
 
   fused = {}
   if store is not None:

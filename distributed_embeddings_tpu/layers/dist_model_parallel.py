@@ -193,9 +193,40 @@ class DistributedEmbedding(nn.Module):
               for cfg in (plan.global_configs[t] for t in plan.input_table_map)]
 
     if self.dp_input:
+      self._sow_oov_metrics(engine, inputs)
       return engine.forward(class_params, inputs)
     return engine.forward_mp(class_params, inputs,
                              hotness=self.input_hotness)
+
+  def _sow_oov_metrics(self, engine, inputs) -> None:
+    """Per-class OOV occurrence counters via the ``'metrics'`` variable
+    collection — the module-forward counterpart of the counters the
+    guarded train step and ``make_sparse_eval_step(with_metrics=True)``
+    already return. DP-INPUT forwards only: the packed-mp path
+    (``dp_input=False``) receives pre-routed tensors whose per-input id
+    view no longer exists here — its ids were clipped/validated at
+    ``pack_mp_inputs`` time on the host, where the policy is already
+    enforceable eagerly.
+
+    Opt-in by mutability: ``module.apply(vars, x, mutable=['metrics'])``
+    returns ``(out, {'metrics': {'oov_<class>': count}})``; a plain
+    apply (serving) neither computes nor carries the counters, and init
+    never records them (the collection would otherwise pollute the
+    variables tree every caller threads around). Counters are int32
+    scalars, psum'd across the mesh under ``world_size > 1`` (the
+    forward already runs inside shard_map there) — matching the train
+    step's global-count convention."""
+    if self.is_initializing() or not self.is_mutable_collection("metrics"):
+      return
+    oov = engine.oov_counts(inputs)
+    if self.world_size > 1:
+      oov = {n: jax.lax.psum(c, self.axis_name) for n, c in oov.items()}
+    for name, c in oov.items():
+      # reduce_fn accumulates across calls within one apply (a module
+      # invoked twice sums, like the step metrics would)
+      self.sow("metrics", f"oov_{name}", c,
+               init_fn=lambda: jnp.zeros((), jnp.int32),
+               reduce_fn=lambda a, b: a + b)
 
 
 # ---------------------------------------------------------------------------

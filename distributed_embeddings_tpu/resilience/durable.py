@@ -126,7 +126,13 @@ def restore_latest(root: str, plan, rule, state_like: Dict[str, Any],
   Returns ``(state, step, path)``, or None when no usable checkpoint
   exists (the caller starts fresh). The candidate already passed
   ``checkpoint.verify`` during the scan, so the restore itself skips the
-  duplicate checksum pass."""
+  duplicate checksum pass.
+
+  Elastic pods: ``plan`` need not match the world shape that WROTE the
+  checkpoint — a relaunched job resized from N to M workers resumes
+  here through ``checkpoint.restore``'s elastic re-shard (rank blocks
+  re-sliced at logical-row granularity), so preemption + resize is one
+  auto-resume, not a migration step."""
   import jax
   from .. import checkpoint
 

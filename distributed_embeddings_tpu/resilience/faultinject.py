@@ -15,6 +15,17 @@ Instrumented sites consult the active injector by name via :func:`fire`:
   crash after a complete write but before publication.
 - ``"host_gather"``: inside ``HostTierStore.gather`` — ``fail_first``
   simulates transient cold-store read errors the retry layer must absorb.
+- ``"ckpt_owner_write"``: after each per-OWNER cold-store block write in
+  a (possibly multi-controller) tiered save — the sharded-cold-store
+  counterpart of ``ckpt_write``, so chaos can die between one owner's
+  blocks and another's.
+- ``"sigkill"``: fired by trainers/drivers at step boundaries as a kill
+  MARKER — carries no library behavior of its own; the cross-run chaos
+  driver (``tools/chaos_kill.py``) installs a :meth:`FaultInjector.kill_at`
+  rule on it to SIGKILL a real worker process mid-run.
+- ``"reshard_gather"``: per source block read during an elastic
+  (world-N save -> world-M restore) re-shard in ``checkpoint.restore`` —
+  lets chaos interrupt the re-shard itself.
 
 With no injector installed :func:`fire` is a dict lookup + None check:
 the hooks cost nothing in production.
@@ -40,16 +51,30 @@ import numpy as np
 # validates at rule-installation time, and the graftlint GL108 rule
 # cross-checks every site literal in the tree against this set (parsed
 # from the AST: keep it a literal).
-SITES = frozenset({"ckpt_write", "ckpt_rename", "host_gather"})
+SITES = frozenset({"ckpt_write", "ckpt_rename", "host_gather",
+                   "ckpt_owner_write", "reshard_gather"})
 
 _extra_sites = set()
 
 
 def register_site(site: str) -> str:
   """Register an additional instrumented site name (for downstream /
-  experimental hooks). Returns ``site`` so it can be used inline."""
+  experimental hooks). Returns ``site`` so it can be used inline.
+
+  String-literal ``register_site`` calls in the library package and
+  tools/ are ALSO parsed by graftlint (GL108 context), so a registered
+  extension site lints the same as a ``SITES`` member — typos in rule
+  installs still fail."""
   _extra_sites.add(site)
   return site
+
+
+# The cross-run chaos driver's kill marker: NOT a library-instrumented
+# site (no library code path consults it) — trainers and drivers fire it
+# at step boundaries so a `kill_at` rule can SIGKILL a real process
+# there. Registered here so every process (worker subprocesses included)
+# knows it without import-order coupling to the driver.
+SIGKILL_SITE = register_site("sigkill")
 
 
 def known_sites() -> frozenset:
@@ -80,6 +105,8 @@ class FaultInjector:
     self._counts: Dict[str, int] = {}
     self._crash_at: Dict[str, int] = {}
     self._fail_until: Dict[str, Tuple[int, type]] = {}
+    self._kill_at: Dict[str, int] = {}
+    self._delay: Dict[str, float] = {}
 
   # ---- rule installation -------------------------------------------------
   @staticmethod
@@ -106,6 +133,27 @@ class FaultInjector:
     self._fail_until[self._check_site(site)] = (k, exc)
     return self
 
+  def kill_at(self, site: str, n: int) -> "FaultInjector":
+    """SIGKILL **this process** on the ``n``-th event at ``site``.
+
+    Unlike :meth:`crash_after` (a catchable Python exception), this is a
+    real, uncatchable kill: no ``finally`` blocks run, no buffers flush,
+    no barriers release — exactly what preemption looks like to a
+    training process. Only the cross-run chaos harness
+    (``tools/chaos_kill.py``), which relaunches and inspects from a
+    SEPARATE driver process, should install it."""
+    self._kill_at[self._check_site(site)] = n
+    return self
+
+  def delay_each(self, site: str, seconds: float) -> "FaultInjector":
+    """Sleep ``seconds`` at every event at ``site`` — a deterministic
+    slow-storage stand-in (e.g. stretch ``ckpt_write`` so an async
+    snapshot observably overlaps training steps)."""
+    if seconds < 0:
+      raise ValueError(f"delay must be >= 0, got {seconds}")
+    self._delay[self._check_site(site)] = float(seconds)
+    return self
+
   # ---- observation -------------------------------------------------------
   def count(self, site: str) -> int:
     """Events observed at ``site`` so far (including failed ones)."""
@@ -117,6 +165,15 @@ class FaultInjector:
     with self._lock:
       n = self._counts.get(site, 0)
       self._counts[site] = n + 1
+    delay = self._delay.get(site)
+    if delay:
+      import time
+      time.sleep(delay)
+    kill = self._kill_at.get(site)
+    if kill is not None and n == kill:
+      import os
+      import signal
+      os.kill(os.getpid(), signal.SIGKILL)  # real preemption: no unwind
     crash = self._crash_at.get(site)
     if crash is not None and n == crash:
       raise InjectedCrash(

@@ -27,6 +27,7 @@ stream without that batch (pinned by tests/test_resilience.py).
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, Iterable, List, Optional
 
 import jax
@@ -68,6 +69,9 @@ class ResilientTrainer:
     store: ``HostTierStore`` for tiered plans (forwarded to
       checkpoint save/restore).
     retry_policy: backoff policy for checkpoint I/O.
+    async_snapshots: periodic snapshots hand the host-side file writes
+      to a background writer thread (see :meth:`snapshot`), so training
+      steps proceed while the checkpoint lands on disk.
   """
 
   def __init__(self, step_fn, state: Dict[str, Any], plan, rule,
@@ -76,7 +80,8 @@ class ResilientTrainer:
                snapshot_every: int = 0, keep: int = 3,
                max_consecutive_bad: Optional[int] = 3,
                resume: bool = True, store=None,
-               retry_policy: retry.RetryPolicy = retry.DEFAULT_POLICY):
+               retry_policy: retry.RetryPolicy = retry.DEFAULT_POLICY,
+               async_snapshots: bool = False):
     self._step_fn = step_fn
     self.state = state
     self.plan = plan
@@ -91,6 +96,9 @@ class ResilientTrainer:
     self._bad = guards.BadStepCounter(max_consecutive_bad)
     self.oov_totals: Dict[str, int] = {}
     self.resumed_from: Optional[str] = None
+    self.async_snapshots = async_snapshots
+    self._writer: Optional[threading.Thread] = None
+    self._writer_err: Optional[BaseException] = None
     # Stream position: batches CONSUMED (committed + skipped). Differs
     # from the state's step counter by the number of guard-skipped
     # batches, and is what exact stream resumption needs — resuming at
@@ -118,9 +126,34 @@ class ResilientTrainer:
     mid-run rollback does NOT rewind it — the skips happened."""
     return self._bad.skipped
 
+  @property
+  def writer_active(self) -> bool:
+    """True while a background snapshot writer is still flushing."""
+    return self._writer is not None and self._writer.is_alive()
+
+  def join_writer(self) -> None:
+    """Wait for an in-flight async snapshot and re-raise its failure.
+
+    Called automatically before the next snapshot (so at most one writer
+    ever runs, preserving the crc32-manifest-last / rotate-after-publish
+    ordering) and before a rollback resume; call it explicitly before
+    process exit — a snapshot still buffered when the process dies was
+    never durable."""
+    w, self._writer = self._writer, None
+    if w is not None:
+      w.join()
+    if self._writer_err is not None:
+      err, self._writer_err = self._writer_err, None
+      raise err
+
+  def close(self) -> None:
+    """Flush pending async work (alias for :meth:`join_writer`)."""
+    self.join_writer()
+
   def maybe_resume(self) -> bool:
     """Restore the newest valid checkpoint under ``ckpt_root`` into
     ``self.state``; False when none exists (fresh start)."""
+    self.join_writer()  # never scan the root under a concurrent save
     got = durable.restore_latest(self.ckpt_root, self.plan, self.rule,
                                  self.state, mesh=self.mesh,
                                  axis_name=self.axis_name, store=self.store)
@@ -146,19 +179,66 @@ class ResilientTrainer:
                          for k, v in extra.get("oov", {}).items()}
     return True
 
-  def snapshot(self) -> str:
+  def snapshot(self, async_: bool = False) -> str:
     """Durably checkpoint the current state (rotating, with retry).
 
     Tiered runs need no explicit flush here: ``checkpoint.save`` flushes
-    the store's resident rows itself when one is passed."""
-    path = durable.save_rotating(self.ckpt_root, self.plan, self.rule,
-                                 self.state, store=self.store,
-                                 keep=self.keep, policy=self.retry_policy,
-                                 extra={"consumed": self.consumed,
-                                        "skipped": self.skipped_steps,
-                                        "oov": dict(self.oov_totals)})
-    self._last_snapshot = self.step_count
-    return path
+    the store's resident rows itself when one is passed.
+
+    ``async_=True`` fetches the state to host SYNCHRONOUSLY (a
+    consistent snapshot no later step can mutate — jax buffers are
+    immutable, but donated ones are invalidated by the next step) and
+    hands the file writes, manifest sealing, and pruning to a background
+    thread, so training proceeds while the bytes land. The previous
+    writer is always joined first — with its error re-raised — so at
+    most one snapshot is in flight and the rotate-after-publish
+    invariant holds; :meth:`join_writer` flushes before exit.
+    Single-controller, store-less runs only: the save's cross-process
+    barriers must run on every main thread, and a ``HostTierStore``'s
+    images are live mutable host state a background save would tear
+    (both limits raise below)."""
+    self.join_writer()
+    extra = {"consumed": self.consumed,
+             "skipped": self.skipped_steps,
+             "oov": dict(self.oov_totals)}
+    if not async_:
+      path = durable.save_rotating(self.ckpt_root, self.plan, self.rule,
+                                   self.state, store=self.store,
+                                   keep=self.keep, policy=self.retry_policy,
+                                   extra=extra)
+      self._last_snapshot = self.step_count
+      return path
+    if jax.process_count() > 1:
+      raise NotImplementedError(
+          "snapshot(async_=True) under multi-controller: the save's "
+          "publication barriers are collective and must run on every "
+          "process's main thread. Use synchronous snapshots there.")
+    if self.store is not None:
+      raise NotImplementedError(
+          "snapshot(async_=True) with a HostTierStore: checkpoint.save "
+          "both reads the store's images (cold-block serialization) and "
+          "writes them (the resident-row flush), and a tiered trainer "
+          "mutates the same images every step's write-back — a "
+          "background save would tear the blocks it checksums and could "
+          "clobber newer write-backs with snapshot-time rows. Snapshot "
+          "tiered runs synchronously (the store has no immutable "
+          "device-side copy to hand a writer thread).")
+    state_host = jax.device_get(self.state)
+    step_now = int(np.asarray(state_host["step"]))
+
+    def _write():
+      try:
+        durable.save_rotating(self.ckpt_root, self.plan, self.rule,
+                              state_host, store=self.store, keep=self.keep,
+                              policy=self.retry_policy, extra=extra)
+      except BaseException as e:  # surfaced at the next join_writer
+        self._writer_err = e
+
+    self._writer = threading.Thread(target=_write, daemon=True,
+                                    name=f"ckpt-writer-{step_now}")
+    self._writer.start()
+    self._last_snapshot = step_now
+    return durable.step_dir(self.ckpt_root, step_now)
 
   # ---- stepping ----------------------------------------------------------
   def _account(self, metrics) -> None:
@@ -208,7 +288,7 @@ class ResilientTrainer:
     loss = float(np.asarray(loss))
     if self.snapshot_every and \
         int(stepped) - self._last_snapshot >= self.snapshot_every:
-      self.snapshot()
+      self.snapshot(async_=self.async_snapshots)
     return loss
 
   def run(self, batches: Iterable, snapshot_final: bool = False
@@ -227,6 +307,7 @@ class ResilientTrainer:
     for batch in batches:
       sb = shard_batch(tuple(batch), self.mesh, self.axis_name)
       losses.append(self.step(*sb))
+    self.join_writer()  # a run's last periodic snapshot must be durable
     if snapshot_final:
       self.snapshot()
     return losses

@@ -163,6 +163,18 @@ class TieringPlan:
     self.rows_overrides: Dict[str, int] = {
         c.name: c.spec.compact_rows for c in self.classes.values()}
 
+  def geometry(self) -> Dict[str, Dict[str, int]]:
+    """Per-class tier geometry as plain ints — the checkpoint manifest's
+    ``tiering.classes`` section. A same-world restore validates its
+    store's plan against the saved copy; an ELASTIC restore re-derives
+    resident sets and staging geometry from the new plan instead (the
+    cold images re-shard, the hot set is a cache policy, not state)."""
+    return {c.name: {"cache_grps": c.spec.cache_grps,
+                     "staging_grps": c.spec.staging_grps,
+                     "phys_rows": c.layout_logical.phys_rows,
+                     "phys_width": c.layout_logical.phys_width}
+            for c in self.classes.values()}
+
   def by_name(self, name: str) -> TieredClassPlan:
     for c in self.classes.values():
       if c.name == name:

@@ -17,11 +17,23 @@ Authority convention: rows resident in the device cache have their
 authoritative values ON DEVICE (the image copy goes stale between
 flushes); cold rows are authoritative in the image (the prefetcher writes
 staged rows back every step). ``flush`` reconciles before checkpointing.
+
+Rank-owner sharding (elastic pods): under multi-controller each process
+constructs its store with ``owned_ranks`` = the mesh ranks its devices
+hold, and materializes ONLY those ranks' images/resident state — the
+cold store's bytes shard across hosts exactly like the device buffers
+shard across chips. Accessing an un-owned rank raises (it names the
+owner contract); ``checkpoint.save`` writes per-owner
+``cold_*_r<rank>.npy`` blocks and seals them through the DONE-marker
+protocol, and ``build_fused``/``resident_arrays`` assemble the global
+device arrays via ``jax.make_array_from_callback`` so each process
+uploads only its blocks. The single-controller default
+(``owned_ranks=None``) owns every rank and behaves as before.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, Iterable, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -39,26 +51,58 @@ from .plan import TieringPlan
 class HostTierStore:
   """Cold-store images + resident-set state for one :class:`TieringPlan`."""
 
-  def __init__(self, tplan: TieringPlan):
+  def __init__(self, tplan: TieringPlan,
+               owned_ranks: Optional[Iterable[int]] = None):
     self.tplan = tplan
     self.plan = tplan.plan
     world = self.plan.world_size
-    self.images: Dict[str, List[np.ndarray]] = {}
-    self.resident_map: Dict[str, List[np.ndarray]] = {}
-    self.resident_grps: Dict[str, List[np.ndarray]] = {}
-    self.counts: Dict[str, List[np.ndarray]] = {}
+    if owned_ranks is None:
+      self.owned_ranks = tuple(range(world))
+    else:
+      self.owned_ranks = tuple(sorted(set(int(r) for r in owned_ranks)))
+      if not self.owned_ranks:
+        raise ValueError("owned_ranks must name at least one rank")
+      if self.owned_ranks[0] < 0 or self.owned_ranks[-1] >= world:
+        raise ValueError(
+            f"owned_ranks {self.owned_ranks} outside [0, {world}) — the "
+            "store shards by MESH rank, not process index")
+    owned = frozenset(self.owned_ranks)
+    self.images: Dict[str, List[Optional[np.ndarray]]] = {}
+    self.resident_map: Dict[str, List[Optional[np.ndarray]]] = {}
+    self.resident_grps: Dict[str, List[Optional[np.ndarray]]] = {}
+    self.counts: Dict[str, List[Optional[np.ndarray]]] = {}
     for c in tplan.classes.values():
       lay = c.layout_logical
       self.images[c.name] = [
           np.zeros((lay.phys_rows, lay.phys_width), np.float32)
-          for _ in range(world)]
+          if r in owned else None for r in range(world)]
       self.resident_map[c.name] = [
-          np.full((lay.phys_rows,), -1, np.int32) for _ in range(world)]
+          np.full((lay.phys_rows,), -1, np.int32)
+          if r in owned else None for r in range(world)]
       self.resident_grps[c.name] = [
-          np.zeros((c.spec.cache_grps,), np.int32) for _ in range(world)]
+          np.zeros((c.spec.cache_grps,), np.int32)
+          if r in owned else None for r in range(world)]
       self.counts[c.name] = [
-          np.zeros((lay.phys_rows,), np.int64) for _ in range(world)]
+          np.zeros((lay.phys_rows,), np.int64)
+          if r in owned else None for r in range(world)]
     self.warm_start()
+
+  @property
+  def owns_all(self) -> bool:
+    return len(self.owned_ranks) == self.plan.world_size
+
+  def _own(self, name: str, rank: int) -> int:
+    """Validate that this store holds ``rank``'s block of ``name``."""
+    rank = int(rank)
+    if rank < 0 or rank >= self.plan.world_size \
+        or self.images[name][rank] is None:
+      raise ValueError(
+          f"class {name!r} rank {rank} is not owned by this store "
+          f"(owned_ranks={self.owned_ranks}): in a rank-owner-sharded "
+          "cold store each process holds only its mesh ranks' blocks — "
+          "route the access to the owning process (checkpoint.save / "
+          "restore already do).")
+    return rank
 
   # ---- initialization ----------------------------------------------------
   def _scale_rows(self, key, rank) -> np.ndarray:
@@ -73,11 +117,12 @@ class HostTierStore:
     return scale
 
   def init_uniform(self, seed: int = 0) -> None:
-    """Draw every image in place (host RAM only; nothing touches a
-    device). Deterministic in ``seed``/class/rank."""
+    """Draw every OWNED image in place (host RAM only; nothing touches a
+    device). Deterministic in ``seed``/class/rank — a sharded store's
+    processes draw disjoint ranks of the same global initialization."""
     for ki, (key, c) in enumerate(sorted(
         self.tplan.classes.items(), key=lambda kv: kv[1].name)):
-      for rank in range(self.plan.world_size):
+      for rank in self.owned_ranks:
         rng = np.random.default_rng((seed, ki, rank))
         self.images[c.name][rank] = init_host_store(
             c.layout_logical, rng, self._scale_rows(key, rank),
@@ -86,6 +131,7 @@ class HostTierStore:
   def set_image(self, name: str, rank: int, image: np.ndarray) -> None:
     """Install an explicit packed image (e.g. packed from a reference
     run's initial table, or a checkpoint block)."""
+    rank = self._own(name, rank)
     lay = self.tplan.by_name(name).layout_logical
     if image.shape != (lay.phys_rows, lay.phys_width):
       raise ValueError(f"image shape {image.shape}, expected "
@@ -103,7 +149,7 @@ class HostTierStore:
     periodic re-rank repairs any other distribution."""
     for name, maps in self.resident_map.items():
       cache = self.tplan.by_name(name).spec.cache_grps
-      for rank in range(self.plan.world_size):
+      for rank in self.owned_ranks:
         if ranking is not None and name in ranking:
           grps = np.asarray(ranking[name][rank][:cache], np.int32)
           if grps.shape[0] < cache:
@@ -128,6 +174,7 @@ class HostTierStore:
     index shown, not as a bare numpy fancy-index ``IndexError`` three
     frames deep (or — worse, for negative indices — as a silent
     wrap-around read of the wrong rows)."""
+    self._own(name, rank)
     grps = np.asarray(grps)
     if not grps.size:
       return grps
@@ -173,44 +220,106 @@ class HostTierStore:
     spec = P(axis_name) if arr.ndim == 1 else P(axis_name, None)
     return jax.device_put(arr, NamedSharding(mesh, spec))
 
+  def _rank_block(self, name: str, rank: int) -> np.ndarray:
+    """One rank's compact device block: cache rows gathered from the
+    image at the resident set, staging region zeroed."""
+    c = self.tplan.by_name(name)
+    rank = self._own(name, rank)
+    cache_rows = self.images[name][rank][self.resident_grps[name][rank]]
+    return np.concatenate([
+        cache_rows,
+        np.zeros((c.spec.staging_grps, c.layout_logical.phys_width),
+                 np.float32)])
+
+  def _global_or_callback(self, name: str, per_rank_rows: int, width,
+                          block_of, mesh, axis_name: str):
+    """Assemble a ``[world * per_rank_rows, ...]`` device array from
+    per-rank host blocks. Fully-owned stores concatenate and device_put;
+    a SHARDED store builds via ``jax.make_array_from_callback`` so each
+    process materializes exactly its owned ranks' blocks (asking it for
+    an un-owned block would raise — by construction the callback only
+    runs for this process's addressable shards)."""
+    world = self.plan.world_size
+    if self.owns_all:
+      blocks = [block_of(r) for r in range(world)]
+      return self._put(np.concatenate(blocks), mesh, axis_name)
+    if mesh is None:
+      raise ValueError(
+          "a rank-owner-sharded HostTierStore (owned_ranks="
+          f"{self.owned_ranks}) needs the global mesh to build device "
+          "arrays: without it this process would have to materialize "
+          "ranks it does not own")
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    shape = (world * per_rank_rows,) + ((width,) if width else ())
+    spec = P(axis_name, None) if width else P(axis_name)
+    sharding = NamedSharding(mesh, spec)
+
+    def cb(index):
+      rank = (index[0].start or 0) // per_rank_rows
+      return block_of(rank)
+
+    return jax.make_array_from_callback(shape, sharding, cb)
+
   def build_fused(self, mesh=None, axis_name: str = "mp"
                   ) -> Dict[str, jax.Array]:
     """Compact device buffers ``[world * (cache + staging), phys_width]``:
     cache rows gathered from the images at the resident set, staging
     region zeroed."""
     out = {}
-    for name, c in ((c.name, c) for c in self.tplan.classes.values()):
-      spec = c.spec
-      blocks = []
-      for rank in range(self.plan.world_size):
-        cache_rows = self.images[name][rank][self.resident_grps[name][rank]]
-        blocks.append(np.concatenate([
-            cache_rows,
-            np.zeros((spec.staging_grps, c.layout_logical.phys_width),
-                     np.float32)]))
-      out[name] = self._put(np.concatenate(blocks), mesh, axis_name)
+    for c in self.tplan.classes.values():
+      per = c.spec.cache_grps + c.spec.staging_grps
+      out[c.name] = self._global_or_callback(
+          c.name, per, c.layout_logical.phys_width,
+          lambda r, name=c.name: self._rank_block(name, r),
+          mesh, axis_name)
     return out
 
   def resident_arrays(self, mesh=None, axis_name: str = "mp"
                       ) -> Dict[str, jax.Array]:
     """Device translation maps ``[world * phys_rows]`` int32."""
-    return {name: self._put(np.concatenate(maps), mesh, axis_name)
-            for name, maps in self.resident_map.items()}
+    out = {}
+    for c in self.tplan.classes.values():
+      out[c.name] = self._global_or_callback(
+          c.name, c.layout_logical.phys_rows, None,
+          lambda r, name=c.name: self.resident_map[name][self._own(name, r)],
+          mesh, axis_name)
+    return out
 
   # ---- device -> host reconciliation -------------------------------------
   def _rank_cache_rows(self, fused: Dict[str, jax.Array], name: str,
                        rank: int) -> np.ndarray:
     spec = self.tplan.by_name(name).spec
     per = spec.cache_grps + spec.staging_grps
-    return np.asarray(fused[name][rank * per:rank * per + spec.cache_grps])
+    arr = fused[name]
+    lo, hi = rank * per, rank * per + spec.cache_grps
+    if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+      # multi-controller: read the window from this process's shards
+      # (global indexing of a non-addressable array is an error); the
+      # owner contract guarantees the window is local
+      from ..parallel.mesh import addressable_row_spans
+      out = np.empty((spec.cache_grps, arr.shape[1]), arr.dtype)
+      have = 0
+      for s0, s1, shard in addressable_row_spans(arr):
+        a, b = max(s0, lo), min(s1, hi)
+        if a < b:
+          out[a - lo:b - lo] = np.asarray(shard.data[a - s0:b - s0])
+          have += b - a
+      if have != spec.cache_grps:
+        raise RuntimeError(
+            f"rank {rank}'s cache window of class {name!r} is not fully "
+            "addressable by this process — flush each rank on its owner")
+      return out
+    return np.asarray(arr[lo:hi])
 
   def flush(self, fused: Dict[str, jax.Array]) -> None:
-    """Copy every resident row's device value back into the host image
-    (cold rows are already authoritative there) — call before
-    checkpointing or unpacking a global view."""
+    """Copy every OWNED resident row's device value back into the host
+    image (cold rows are already authoritative there) — call before
+    checkpointing or unpacking a global view. A sharded store flushes
+    its ranks only; every process flushing its own store covers the
+    world."""
     for name in self.images:
       lay = self.tplan.by_name(name).layout_logical
-      for rank in range(self.plan.world_size):
+      for rank in self.owned_ranks:
         host_scatter_rows(lay, self.images[name][rank],
                           self.resident_grps[name][rank],
                           self._rank_cache_rows(fused, name, rank))

@@ -221,6 +221,14 @@ class TieredTrainer:
   diverged from the all-device semantics (prefetch contract violation,
   e.g. a re-rank raced the classify).
 
+  ``guard=True`` builds the hardened step
+  (``make_tiered_train_step(guard=True)``): a non-finite batch commits
+  nothing — dense params, packed buffers, AND the host-tier images stay
+  bit-identical (the staging write-back rewrites unchanged rows) — and
+  the trainer counts the skips (``bad_steps``) and OOV occurrences
+  (``oov_totals``; ``plan.oov='error'`` raises host-side with the state
+  untouched, exactly like the sparse ResilientTrainer path).
+
   Plans built with ``dedup_exchange=True`` compose transparently (the
   tiered id translation rewrites the deduplicated unique blocks; the
   staged wire inherits the plan's ``wire_dtype`` like every other
@@ -242,24 +250,32 @@ class TieredTrainer:
                emb_dense_optimizer: Optional[
                    optax.GradientTransformation] = None,
                exact: bool = False,
-               donate: bool = True):
+               donate: bool = True,
+               guard: bool = False):
     self.tplan = tplan
     self.store = store
     self.mesh = mesh
     self.axis_name = axis_name
     self.state = state
+    self.guard = guard
     self.prefetcher = TieredPrefetcher(tplan, store, mesh, axis_name)
     self._step_fn = make_tiered_train_step(
         model, tplan, loss_fn, dense_optimizer, rule, mesh, state,
         batch_example, axis_name=axis_name,
-        emb_dense_optimizer=emb_dense_optimizer, exact=exact, donate=donate)
+        emb_dense_optimizer=emb_dense_optimizer, exact=exact, donate=donate,
+        guard=guard)
     self.hits: Dict[str, np.ndarray] = {
         name: np.zeros((4,), np.int64) for name in tplan.tier_specs}
     self.steps = 0
+    self.bad_steps = 0
+    self.oov_totals: Dict[str, int] = {}
 
   # ---- metrics -----------------------------------------------------------
   def _account(self, metrics: Dict[str, jax.Array]) -> None:
-    for name, m in metrics.items():
+    # guarded steps nest the tier counters under 'tier' and add the
+    # guard verdict + OOV counters (make_tiered_train_step(guard=True))
+    tier = metrics["tier"] if self.guard else metrics
+    for name, m in tier.items():
       m = np.asarray(m, np.int64)
       self.hits[name] += m
       if m[2]:
@@ -268,6 +284,18 @@ class TieredTrainer:
             "the hot cache nor the staging buffer this step — their "
             "updates were dropped at the sentinel. The prefetch contract "
             "is broken (classify ran against a stale resident map?).")
+    if self.guard:
+      self.bad_steps += int(np.asarray(metrics["bad_step"]))
+      # account FIRST, enforce second (ResilientTrainer convention): the
+      # oov='error' raise below must leave the totals covering the
+      # rejected batch — which committed nothing, its gate held
+      counts = {name: int(np.asarray(v))
+                for name, v in metrics["oov"].items()}
+      for name, n in counts.items():
+        self.oov_totals[name] = self.oov_totals.get(name, 0) + n
+      from ..resilience import guards as _guards
+      _guards.check_oov(self.tplan.plan, counts,
+                        where="guarded tiered step")
     self.steps += 1
 
   def hit_rate(self, name: Optional[str] = None) -> float:
@@ -278,7 +306,7 @@ class TieredTrainer:
     return sum(int(m[0]) for m in ms) / total if total else 0.0
 
   def metrics_summary(self) -> Dict[str, Any]:
-    return {
+    out = {
         "steps": self.steps,
         "hit_rate": self.hit_rate(),
         "per_class": {
@@ -290,6 +318,10 @@ class TieredTrainer:
         "spill_steps": self.prefetcher.spill_steps,
         "host_gather_retries": self.prefetcher.host_gather_retries,
     }
+    if self.guard:
+      out["bad_steps"] = self.bad_steps
+      out["oov"] = dict(self.oov_totals)
+    return out
 
   # ---- stepping ----------------------------------------------------------
   def _device_batch(self, numerical, cats, labels):

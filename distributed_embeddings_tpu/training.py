@@ -595,6 +595,58 @@ def _reduce_and_apply_dense(state, loss, d_dense, d_emb_dense, d_z, rank,
   return loss, dense, dense_opt, emb_dense, emb_dense_opt, d_z
 
 
+def _make_guard_helpers(plan: DistEmbeddingStrategy, mesh, axis_name: str):
+  """The non-finite/OOV guard epilogue, shared by the all-device and
+  tiered step builders (``resilience.guards`` wiring).
+
+  Returns ``(guard_gate, oov_ok, guard_metrics)``:
+
+  - ``guard_gate(loss, grads, streams, oov_ok)``: global ok flag + gated
+    delta streams. Finiteness is checked on the loss, the dense-side
+    grads, and the BUILT delta streams (NaN/inf cotangents propagate
+    through every rule's delta math, so checking the streams covers
+    d_z). ``ok`` must agree on every device — a skip must be collective;
+    one device committing while another skips would fork the replicated
+    state — so the local verdict is AND-reduced (pmin) across the mesh.
+    Bad-step streams are ZEROED rather than select-gating the buffers: a
+    scatter-add of zeros is an exact no-op, so the multi-GiB packed
+    buffers are never copied (and on the tiered path the staging regions
+    come back unchanged, leaving the host-tier images untouched on
+    write-back).
+  - ``oov_ok(oov)``: the oov='error' commit gate (None under 'clip') — a
+    batch carrying ANY out-of-range id commits nothing, so the host-side
+    ``check_oov`` raise fires with the state bit-identical to before the
+    batch.
+  - ``guard_metrics(ok, oov)``: the replicated ``{'bad_step', 'oov'}``
+    metrics dict (counters psum'd across the mesh).
+  """
+  from .resilience import guards as _guards
+  oov_is_error = getattr(plan, "oov", "clip") == "error"
+
+  def guard_gate(loss, grads, streams, oov_ok=None):
+    ok = _guards.all_finite((loss, grads, streams))
+    if oov_ok is not None:
+      ok = jnp.logical_and(ok, oov_ok)
+    if mesh is not None:
+      ok = jax.lax.pmin(ok.astype(jnp.int32), axis_name).astype(bool)
+    streams = {name: (ids, jnp.where(ok, rows, jnp.zeros_like(rows)))
+               for name, (ids, rows) in streams.items()}
+    return ok, streams
+
+  def oov_ok(oov):
+    if not oov_is_error or not oov:
+      return None
+    total = sum(jnp.asarray(c, jnp.int32) for c in oov.values())
+    return total == 0
+
+  def guard_metrics(ok, oov):
+    if mesh is not None:
+      oov = {n: jax.lax.psum(c, axis_name) for n, c in oov.items()}
+    return {"bad_step": 1 - ok.astype(jnp.int32), "oov": oov}
+
+  return guard_gate, oov_ok, guard_metrics
+
+
 def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
                            loss_fn: Callable,
                            dense_optimizer: optax.GradientTransformation,
@@ -710,44 +762,8 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
         "(resilience.guards.check_oov) plus a commit gate on the "
         "offending batch; build with guard=True or use oov='clip'.")
   from .resilience import guards as _guards
-
-  def _guard_gate(loss, grads, streams, oov_ok=None):
-    """Shared guard epilogue: global ok flag + gated delta streams.
-
-    Finiteness is checked on the loss, the dense-side grads, and the
-    BUILT delta streams (NaN/inf cotangents propagate through every
-    rule's delta math, so checking the streams covers d_z). ``ok`` must
-    agree on every device — a skip must be collective; one device
-    committing while another skips would fork the replicated state — so
-    the local verdict is AND-reduced (pmin) across the mesh. Bad-step
-    streams are ZEROED rather than select-gating the buffers: a
-    scatter-add of zeros is an exact no-op, so the multi-GiB packed
-    buffers are never copied. ``oov_ok`` is the oov='error' commit gate
-    (None under 'clip'), folded in so the offending batch skips too."""
-    ok = _guards.all_finite((loss, grads, streams))
-    if oov_ok is not None:
-      ok = jnp.logical_and(ok, oov_ok)
-    if mesh is not None:
-      ok = jax.lax.pmin(ok.astype(jnp.int32), axis_name).astype(bool)
-    streams = {name: (ids, jnp.where(ok, rows, jnp.zeros_like(rows)))
-               for name, (ids, rows) in streams.items()}
-    return ok, streams
-
-  def _oov_ok(oov):
-    """oov='error' commit gate: a batch carrying ANY out-of-range id
-    commits nothing, so when the host-side ``check_oov`` raise fires the
-    state is still bit-identical to before the batch. Under 'clip' the
-    step commits as always — the counters alone make clipping
-    observable — so this returns None (no gate)."""
-    if not oov_is_error or not oov:
-      return None
-    total = sum(jnp.asarray(c, jnp.int32) for c in oov.values())
-    return total == 0
-
-  def _guard_metrics(ok, oov):
-    if mesh is not None:
-      oov = {n: jax.lax.psum(c, axis_name) for n, c in oov.items()}
-    return {"bad_step": 1 - ok.astype(jnp.int32), "oov": oov}
+  _guard_gate, _oov_ok, _guard_metrics = _make_guard_helpers(
+      plan, mesh, axis_name)
 
   def local_step_mb(state, numerical, cats, labels):
     n_mb = micro_batches
@@ -984,7 +1000,8 @@ def make_tiered_train_step(model, tplan, loss_fn: Callable,
                            emb_dense_optimizer: Optional[
                                optax.GradientTransformation] = None,
                            exact: bool = False,
-                           donate: bool = True):
+                           donate: bool = True,
+                           guard: bool = False):
   """Train step over tiered storage: host-tier classes hold only a hot
   cache + staging region on device (`tiering/`), fed by a host-side
   prefetch stage that runs AHEAD of this step.
@@ -1013,22 +1030,42 @@ def make_tiered_train_step(model, tplan, loss_fn: Callable,
 
   Args:
     tplan: a ``tiering.TieringPlan`` (per-class TierSpec geometry).
+    guard: same non-finite/OOV hardening as
+      ``make_sparse_train_step(guard=True)``, extended to the third
+      tier: a bad batch zeroes the per-class delta streams BEFORE the
+      scatter, so the staging regions come back holding exactly the rows
+      that were staged in — the host write-back then rewrites unchanged
+      values and the host-tier images stay bit-identical too. The
+      verdict is the same collective pmin gate; the step counter holds;
+      dense/optimizer updates are discarded by scalar selects.
+      Incompatible with ``exact=True`` (as on the sparse step).
 
   Returns:
     ``step(state, staged, numerical, cats, labels) ->
     (state, staged_out, metrics, loss)`` where ``staged_out`` maps class
     name to the post-update staging rows (host write-back input) and
     ``metrics`` maps class name to the int32 ``[4]`` counter vector.
+    With ``guard``, ``metrics`` becomes ``{'tier': {class: [4]},
+    'bad_step': int32 0/1, 'oov': {class: int32 count}}``.
   """
   plan = tplan.plan
   tier_specs = tplan.tier_specs
-  if getattr(plan, "oov", "clip") == "error":
+  if getattr(plan, "oov", "clip") == "error" and not guard:
+    raise ValueError(
+        "plan.oov='error' requires make_tiered_train_step(guard=True): "
+        "under jit the ids are traced, so the unguarded step cannot see "
+        "them — out-of-range ids would be silently clipped to each "
+        "table's last row, exactly what oov='error' exists to forbid. "
+        "Enforcement rides the guarded step's OOV metrics plus a commit "
+        "gate on the offending batch; build with guard=True or use "
+        "oov='clip'.")
+  if guard and exact:
     raise NotImplementedError(
-        "plan.oov='error' is only enforced by "
-        "make_sparse_train_step(guard=True); the tiered step has no "
-        "guard mode yet (ROADMAP), so out-of-range ids would be "
-        "silently clipped — the policy's failure mode. Use oov='clip' "
-        "with tiered storage for now.")
+        "guard=True with exact=True: the non-finite guard gates the "
+        "prebuilt per-class delta streams before the scatter, but the "
+        "exact path re-gathers rows and builds its deltas inside the "
+        "apply. Use per-occurrence semantics (exact=False) with the "
+        "guard.")
   if exact and getattr(plan, "wire_dtype", "f32") != "f32":
     raise ValueError(
         "exact=True requires wire_dtype='f32' (same contract as "
@@ -1042,6 +1079,9 @@ def make_tiered_train_step(model, tplan, loss_fn: Callable,
   base_layouts = engine.fused_layouts(rule,
                                       rows_overrides=tplan.rows_overrides)
   emb_opt = emb_dense_optimizer or dense_optimizer
+  from .resilience import guards as _guards
+  _guard_gate, _oov_ok, _guard_metrics = _make_guard_helpers(
+      plan, mesh, axis_name)
 
   def local_step(state, staged, numerical, cats, labels):
     b = numerical.shape[0]
@@ -1084,13 +1124,31 @@ def make_tiered_train_step(model, tplan, loss_fn: Callable,
     loss, (d_dense, d_emb_dense, d_z) = jax.value_and_grad(
         loss_with, argnums=(0, 1, 2))(state["dense"], state["emb_dense"],
                                       z_sparse)
+    # checked pre-optimizer, like the sparse step: a caller's optax chain
+    # could mask NaN grads into finite params, which must still skip
+    grads_chk = (d_dense, d_emb_dense) if guard else None
     loss, dense, dense_opt, emb_dense, emb_dense_opt, d_z = \
         _reduce_and_apply_dense(state, loss, d_dense, d_emb_dense, d_z,
                                 rank, mesh, axis_name, dense_optimizer,
                                 emb_opt, con_fn)
 
-    fused = engine.apply_sparse(fused_in, layouts, d_z, residuals,
-                                rule, state["step"], exact=exact)
+    if guard:
+      oov = engine.oov_counts(cats)
+      streams = engine.sparse_delta_streams(layouts, d_z, residuals, rule,
+                                            state["step"])
+      ok, streams = _guard_gate(loss, grads_chk, streams, _oov_ok(oov))
+      dense, dense_opt, emb_dense, emb_dense_opt = _guards.select_tree(
+          ok, (dense, dense_opt, emb_dense, emb_dense_opt),
+          (state["dense"], state["dense_opt"], state["emb_dense"],
+           state["emb_dense_opt"]))
+      # zeroed streams scatter-add nothing: the cache region AND the
+      # staging region come back bit-identical, so the write-back below
+      # re-writes the staged rows' unchanged values into the host images
+      fused = engine.apply_sparse_streams(fused_in, layouts, streams,
+                                          rule, state["step"])
+    else:
+      fused = engine.apply_sparse(fused_in, layouts, d_z, residuals,
+                                  rule, state["step"], exact=exact)
     staged_out = engine.staged_regions(fused, tier_specs, staged["grps"])
     fused = engine.trim_spill(fused, tier_specs)
     if mesh is not None:
@@ -1102,8 +1160,11 @@ def make_tiered_train_step(model, tplan, loss_fn: Callable,
         "emb_dense": emb_dense,
         "emb_dense_opt": emb_dense_opt,
         "fused": fused,
-        "step": state["step"] + 1,
+        "step": state["step"] + (ok.astype(jnp.int32) if guard else 1),
     }
+    if guard:
+      metrics = {"tier": tier_metrics, **_guard_metrics(ok, oov)}
+      return new_state, staged_out, metrics, loss
     return new_state, staged_out, tier_metrics, loss
 
   if mesh is None:
@@ -1117,11 +1178,17 @@ def make_tiered_train_step(model, tplan, loss_fn: Callable,
   }
   bspec = jax.tree_util.tree_map(
       lambda _: P(axis_name), tuple(batch_example))
+  metrics_spec = {n: P() for n in tier_specs}
+  if guard:
+    metrics_spec = {
+        "tier": metrics_spec,
+        "bad_step": P(),
+        "oov": {class_param_name(*k): P() for k in plan.class_keys}}
   sharded = shard_map(
       local_step, mesh=mesh,
       in_specs=(sspec, staged_specs) + bspec,
       out_specs=(sspec, {n: P(axis_name, None) for n in tier_specs},
-                 {n: P() for n in tier_specs}, P()))
+                 metrics_spec, P()))
   return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 

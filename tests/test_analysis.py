@@ -251,6 +251,44 @@ def chaos(inj):
                      CTX, ["GL108"]) == []
 
 
+def test_gl110_world_constant_in_durable_module():
+  bad = """
+import jax
+def pick():
+  if jax.process_count() == 4:
+    return "the benchmark pod"
+  if 2 < jax.process_index():
+    return "tail"
+"""
+  out = lint_source(bad, "checkpoint.py", CTX, ["GL110"])
+  assert _rules(out) == ["GL110", "GL110"]
+  assert "hardcoded constant 4" in out[0].message
+  # the world-shape-free idioms stay legal: controller check, multi-
+  # controller check, and world facts derived from the plan
+  ok = """
+import jax
+def pick(plan):
+  if jax.process_index() == 0:
+    pass
+  if jax.process_count() > 1:
+    pass
+  if jax.process_count() == plan.world_size:
+    pass
+"""
+  assert lint_source(ok, "durable.py", CTX, ["GL110"]) == []
+  # scope: durable modules only; trainers may pin worlds for tests
+  assert lint_source(bad, "trainer.py", CTX, ["GL110"]) == []
+
+
+def test_gl110_suppression():
+  src = """
+import jax
+def f():
+  return jax.process_count() == 4  # graftlint: disable=GL110
+"""
+  assert lint_source(src, "checkpoint.py", CTX, ["GL110"]) == []
+
+
 # ---------------------------------------------------------------------------
 # repo-context parsing + HEAD cleanliness
 # ---------------------------------------------------------------------------
@@ -259,8 +297,28 @@ def chaos(inj):
 def test_repo_context_parses_markers_and_sites():
   ctx = LintContext.for_repo(REPO)
   assert "slow" in ctx.registered_markers
+  # SITES literal members plus register_site-registered extensions
+  # ("sigkill", registered at module level in faultinject.py) — test
+  # files' ad-hoc registrations are deliberately NOT scanned
   assert ctx.fault_sites == frozenset(
-      {"ckpt_write", "ckpt_rename", "host_gather"})
+      {"ckpt_write", "ckpt_rename", "host_gather", "ckpt_owner_write",
+       "reshard_gather", "sigkill"})
+  assert "test_extension_site" not in ctx.fault_sites
+
+
+def test_gl108_accepts_register_site_extensions():
+  """A site registered through register_site (parsed from the repo)
+  lints clean; a near-miss typo of it still fails."""
+  ctx = LintContext.for_repo(REPO)
+  src = """
+from distributed_embeddings_tpu.resilience import faultinject
+def marker():
+  faultinject.fire("sigkill", batch=0)
+"""
+  assert lint_source(src, "tools/x.py", ctx, ["GL108"]) == []
+  out = lint_source(src.replace('"sigkill"', '"sigkil"'), "tools/x.py",
+                    ctx, ["GL108"])
+  assert _rules(out) == ["GL108"]
 
 
 def test_repo_is_lint_clean_at_head():

@@ -151,6 +151,63 @@ def test_world_one_module_is_plain_layer():
                              weights[1][np.asarray(inputs[1])], rtol=1e-6)
 
 
+def test_metrics_collection_carries_oov_counters():
+  """The flax-forward path surfaces the per-class OOV counters the
+  guarded step already returns — via a mutable ``'metrics'`` collection,
+  absent entirely in apply-only / init (PR 2 API follow-on)."""
+  rng = np.random.default_rng(0)
+  configs = tuple(TableConfig(input_dim=50, output_dim=8) for _ in range(3))
+  dmp = DistributedEmbedding(embeddings=configs, world_size=1)
+  inputs = [jnp.asarray(rng.integers(0, 50, 8), jnp.int32) for _ in configs]
+  variables = dmp.init(jax.random.PRNGKey(0), inputs)
+  assert "metrics" not in variables  # init never records counters
+  bad = [i.copy() for i in inputs]
+  bad[0] = bad[0].at[:3].set(99)     # 3 OOV occurrences on input 0
+  bad[2] = bad[2].at[0].set(10 ** 6)  # 1 on input 2
+  outs, mut = dmp.apply(variables, bad, mutable=["metrics"])
+  counts = {k: int(np.asarray(jax.tree_util.tree_leaves(v)[0]))
+            for k, v in mut["metrics"].items()}
+  assert all(k.startswith("oov_mp_table_") for k in counts)
+  assert sum(counts.values()) == 4
+  # numerics identical to the metric-less apply (clip semantics)
+  outs_plain = dmp.apply(variables, bad)
+  for a, b in zip(outs, outs_plain):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+  # clean batch: counters present but zero
+  _, mut0 = dmp.apply(variables, inputs, mutable=["metrics"])
+  assert sum(int(np.asarray(jax.tree_util.tree_leaves(v)[0]))
+             for v in mut0["metrics"].values()) == 0
+
+
+def test_metrics_collection_psums_across_mesh():
+  rng = np.random.default_rng(1)
+  configs = tuple(TableConfig(input_dim=50, output_dim=8) for _ in range(3))
+  dmp = DistributedEmbedding(embeddings=configs, world_size=WORLD)
+  inputs = [jnp.asarray(rng.integers(0, 50, 2 * WORLD), jnp.int32)
+            for _ in configs]
+  variables = dmp.init(jax.random.PRNGKey(0), inputs)
+  names = list(variables["params"].keys())
+  bad = [i.copy() for i in inputs]
+  bad[1] = bad[1].at[:5].set(77)  # spread across devices' batch shards
+  mesh = make_mesh()
+  pspecs = {"params": {n: P("mp", None) for n in names}}
+
+  def fwd(variables, *inputs):
+    outs, mut = dmp.apply(variables, list(inputs), mutable=["metrics"])
+    flat = {k: jax.tree_util.tree_leaves(v)[0]
+            for k, v in mut["metrics"].items()}
+    return tuple(outs), flat
+
+  metric_keys = [f"oov_{n}" for n in names]
+  _, flat = jax.jit(shard_map(
+      fwd, mesh=mesh,
+      in_specs=(pspecs,) + tuple(P("mp") for _ in inputs),
+      out_specs=(tuple(P("mp") for _ in inputs),
+                 {k: P() for k in metric_keys})))(variables, *bad)
+  # psum'd global counts, replicated — same convention as the train step
+  assert sum(int(np.asarray(v)) for v in flat.values()) == 5
+
+
 def test_hybrid_partition_specs_for_adagrad_state():
   from distributed_embeddings_tpu.layers import hybrid_partition_specs
   import optax

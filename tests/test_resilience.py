@@ -278,6 +278,85 @@ def test_abort_with_rollback_after_consecutive_bad(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Async snapshots: background writes, joined with error propagation
+# ---------------------------------------------------------------------------
+
+
+def test_async_snapshots_match_sync_and_overlap(tmp_path):
+  """Async snapshots publish the same checkpoints as sync ones (restore
+  bit-identical), with training steps observably proceeding while the
+  writer thread flushes (slow storage injected for determinism)."""
+  mesh = create_mesh(WORLD)
+  model, plan, rule, opt = build(WORLD)
+  batches = [make_batch(WORLD, seed) for seed in range(8)]
+
+  def fresh(root, async_snapshots):
+    state = init_state(model, plan, rule, opt, batches[0], mesh)
+    step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
+                                  state, batches[0], donate=False,
+                                  guard=True)
+    return ResilientTrainer(step, state, plan, rule,
+                            os.path.join(tmp_path, root), mesh=mesh,
+                            snapshot_every=2,
+                            async_snapshots=async_snapshots)
+
+  t_sync = fresh("sync", False)
+  losses_sync = t_sync.run(batches)
+
+  t_async = fresh("async", True)
+  overlap = 0
+  losses_async = []
+  with faultinject.injected(
+      FaultInjector().delay_each("ckpt_write", 0.05)):
+    for b in batches:
+      losses_async.append(t_async.step(*shard_batch(b, mesh)))
+      overlap += int(t_async.writer_active)
+    t_async.close()
+  assert losses_sync == losses_async
+  assert overlap > 0  # steps ran while a snapshot was flushing
+  steps_sync = [s for s, _ in
+                durable.list_checkpoints(os.path.join(tmp_path, "sync"))]
+  steps_async = [s for s, _ in
+                 durable.list_checkpoints(os.path.join(tmp_path, "async"))]
+  assert steps_sync == steps_async
+  ra = fresh("async", False)  # auto-resume from the async-written root
+  rs = fresh("sync", False)
+  assert_trees_equal(jax.device_get(ra.state), jax.device_get(rs.state))
+
+
+def test_async_snapshot_failure_propagates_at_join(tmp_path):
+  """A background writer's failure must surface — at the next snapshot
+  or the explicit join — not vanish with the thread."""
+  mesh = create_mesh(WORLD)
+  batches, fresh_trainer = _trainer_fixture(tmp_path, mesh,
+                                            snapshot_every=0)
+  t = fresh_trainer("async_err")
+  t.retry_policy = RetryPolicy(retries=1, backoff=0.0)
+  t.step(*shard_batch(batches[0], mesh))
+  with faultinject.injected(FaultInjector().fail_first("ckpt_write", 10)):
+    t.snapshot(async_=True)
+    with pytest.raises(TransientIOError):
+      t.join_writer()
+  # the error is consumed by the raise; the trainer keeps working
+  t.step(*shard_batch(batches[1], mesh))
+  path = t.snapshot()
+  assert not checkpoint.verify(path)
+
+
+def test_async_snapshot_rejects_live_store(tmp_path):
+  """A HostTierStore's images are live mutable host state — checkpoint
+  .save both reads and writes them, so a background save would tear the
+  blocks it checksums. Rejected up front."""
+  mesh = create_mesh(WORLD)
+  batches, fresh_trainer = _trainer_fixture(tmp_path, mesh,
+                                            snapshot_every=0)
+  t = fresh_trainer("async_store")
+  t.store = object()  # stand-in: presence alone must refuse
+  with pytest.raises(NotImplementedError, match="HostTierStore"):
+    t.snapshot(async_=True)
+
+
+# ---------------------------------------------------------------------------
 # Checkpoint corruption: every failure restores previous-valid or names
 # the bad file
 # ---------------------------------------------------------------------------

@@ -1,0 +1,395 @@
+"""Cross-run SIGKILL chaos: kill a REAL trainer process, relaunch, verify.
+
+`tools/chaos_train.py` injects faults *inside one process lifetime* — a
+crash there is a Python exception the same process observes. Production
+preemption is nothing like that: the kernel SIGKILLs the trainer, no
+``finally`` runs, no buffers flush, and a NEW process (possibly on a
+different worker set) must pick the run back up. This driver closes that
+gap:
+
+1. **reference**: one uninterrupted worker subprocess trains a fixed
+   stream to completion, logging ``(consumed index, loss)`` per step;
+2. **kill cycles**: a fresh worker is launched with a
+   ``FaultInjector.kill_at`` rule — a real ``SIGKILL`` of itself at a
+   deterministic fault-site event: mid-checkpoint-save (``ckpt_write`` /
+   ``ckpt_rename``, leaving a torn ``.tmp``) or between steps (the
+   ``sigkill`` marker the worker fires per batch). The driver asserts
+   the process died by SIGKILL, then relaunches — **optionally at a
+   different world size**: the relaunch auto-resumes through
+   ``checkpoint.restore``'s elastic re-shard;
+3. **verdict**: the stitched trajectory (run 1's committed prefix +
+   the relaunch) must match the unkilled reference step-for-step —
+   bit-for-bit at the same world, within an fp-associativity bound
+   across a resize (the restored STATE is bit-exact; a different mesh
+   reduces in a different order from the first post-resume step) — and
+   the resumed accounting must satisfy ``consumed == steps + skipped``
+   (the PR-2 stream-position invariant) with every injected NaN batch
+   skipped exactly once across both process lifetimes;
+4. **async snapshots**: one cycle runs with
+   ``ResilientTrainer(async_snapshots=True)`` under an injected
+   slow-storage delay and must log steps completing WHILE the writer
+   thread is flushing, with an unchanged trajectory.
+
+Run ``make chaos-kill`` (JSON verdict, exit 0/1); the longer multi-cycle
+variant is ``@pytest.mark.slow`` in ``tests/test_elastic.py``.
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+if __name__ == "__main__":  # standalone: build the virtual CPU mesh
+  flags = os.environ.get("XLA_FLAGS", "")
+  if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+  os.environ.setdefault("JAX_PLATFORMS", "cpu")
+  sys.path.insert(0, _REPO)
+
+VOCAB = [500, 300, 150, 20]
+GLOBAL_BATCH = 32  # divisible by every world size the cycles use
+
+
+def _batches(n, seed=7, n_unique=6):
+  """World-independent cycled batch stream (same recipe as chaos_train:
+  repetition makes the short run's loss drop reliably)."""
+  import numpy as np
+  rng = np.random.default_rng(seed)
+  out = []
+  for _ in range(n_unique):
+    numerical = rng.standard_normal((GLOBAL_BATCH, 13)).astype(np.float32)
+    cats = [rng.integers(0, v, GLOBAL_BATCH).astype(np.int32)
+            for v in VOCAB]
+    labels = (numerical[:, 0] > 0).astype(np.float32)
+    out.append((numerical, cats, labels))
+  return [out[i % n_unique] for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# worker: one trainer process lifetime
+# ---------------------------------------------------------------------------
+
+
+def run_worker(root: str, log_path: str, world: int, steps: int,
+               nan_every: int = 6, snapshot_every: int = 4,
+               kill_site: str = "", kill_event: int = -1,
+               async_snapshots: bool = False,
+               slow_writes: float = 0.0) -> dict:
+  """Train the fixed stream from wherever the checkpoint root says the
+  last lifetime stopped; append ``{"i", "loss"}`` JSONL per step."""
+  import jax
+  import numpy as np
+  import optax
+
+  from distributed_embeddings_tpu.layers.planner import DistEmbeddingStrategy
+  from distributed_embeddings_tpu.models import DLRM, bce_loss
+  from distributed_embeddings_tpu.ops.packed_table import sparse_rule
+  from distributed_embeddings_tpu.parallel import create_mesh
+  from distributed_embeddings_tpu.resilience import FaultInjector, faultinject
+  from distributed_embeddings_tpu.resilience.trainer import ResilientTrainer
+  from distributed_embeddings_tpu.training import (
+      init_sparse_state,
+      make_sparse_train_step,
+      shard_batch,
+      shard_params,
+  )
+
+  mesh = create_mesh(world)
+  model = DLRM(vocab_sizes=VOCAB, embedding_dim=16, bottom_mlp=(32, 16),
+               top_mlp=(32, 1), world_size=world, dense_row_threshold=32)
+  plan = DistEmbeddingStrategy(
+      [dict(input_dim=v, output_dim=16,
+            initializer={"name": "uniform", "scale": 0.05}) for v in VOCAB],
+      world, "basic", dense_row_threshold=32)
+  rule = sparse_rule("adagrad", 0.05)
+  opt = optax.adagrad(0.05)
+  batches = _batches(steps)
+  nan_steps = set(range(nan_every - 1, steps, nan_every)) if nan_every \
+      else set()
+  stream = list(faultinject.nan_batches(batches, at_steps=nan_steps))
+
+  numerical, cats, _ = batches[0]
+  params = model.init(jax.random.PRNGKey(0), numerical,
+                      [np.asarray(c) for c in cats])["params"]
+  state = shard_params(init_sparse_state(plan, params, rule, opt), mesh)
+  step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
+                                state, batches[0], donate=False, guard=True)
+  # auto-resume: a world != the saving lifetime's goes through the
+  # elastic re-shard inside checkpoint.restore
+  t = ResilientTrainer(step, state, plan, rule, root, mesh=mesh,
+                       snapshot_every=snapshot_every,
+                       async_snapshots=async_snapshots)
+
+  inj = FaultInjector()
+  if kill_site:
+    inj.kill_at(kill_site, kill_event)
+  if slow_writes:
+    inj.delay_each("ckpt_write", slow_writes)
+  overlap = 0
+  with faultinject.injected(inj), open(log_path, "a") as log:
+    for i in range(t.consumed, steps):
+      # the between-steps kill marker: a kill_at('sigkill', k) rule dies
+      # here, k steps after this lifetime's resume point
+      faultinject.fire(faultinject.SIGKILL_SITE, batch=i)
+      loss = t.step(*shard_batch(stream[i], mesh))
+      if t.writer_active:
+        overlap += 1
+      log.write(json.dumps({"i": i, "loss": loss}) + "\n")
+      log.flush()
+    t.close()  # join an in-flight async snapshot before claiming success
+  summary = {
+      "world": world,
+      "steps": t.step_count,
+      "consumed": t.consumed,
+      "skipped": t.skipped_steps,
+      "expected_skips": len(nan_steps),
+      "invariant_ok": t.consumed == t.step_count + t.skipped_steps,
+      "overlap_steps": overlap,
+      "resumed_from": t.resumed_from,
+  }
+  with open(log_path + ".summary", "w") as f:
+    json.dump(summary, f)
+  return summary
+
+
+# ---------------------------------------------------------------------------
+# driver: launch / kill / relaunch across real process lifetimes
+# ---------------------------------------------------------------------------
+
+
+def _spawn(root, log, world, steps, kill_site="", kill_event=-1,
+           async_snapshots=False, slow_writes=0.0) -> int:
+  env = dict(os.environ)
+  env.setdefault("JAX_PLATFORMS", "cpu")
+  flags = env.get("XLA_FLAGS", "")
+  if "xla_force_host_platform_device_count" not in flags:
+    env["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+  cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+         "--root", root, "--log", log, "--world", str(world),
+         "--steps", str(steps)]
+  if kill_site:
+    cmd += ["--kill-site", kill_site, "--kill-event", str(kill_event)]
+  if async_snapshots:
+    cmd += ["--async-snapshots"]
+  if slow_writes:
+    cmd += ["--slow-writes", str(slow_writes)]
+  return subprocess.run(cmd, cwd=_REPO, env=env).returncode
+
+
+def _read_log(log) -> list:
+  """Ordered ``(i, loss)`` records; the file appends across lifetimes,
+  so a relaunch's records are exactly the tail past the kill point."""
+  out = []
+  if os.path.exists(log):
+    with open(log) as f:
+      for line in f:
+        rec = json.loads(line)
+        out.append((rec["i"], rec["loss"]))
+  return out
+
+
+def _read_summary(log):
+  p = log + ".summary"
+  if not os.path.exists(p):
+    return None
+  with open(p) as f:
+    return json.load(f)
+
+
+def _stitch(records) -> list:
+  """Latest loss per consumed index across lifetimes, in stream order.
+
+  Overlapping indices (a committed-but-then-replayed tail between the
+  last snapshot and the kill) are resolved in favor of the LATER
+  lifetime — the values training actually resumed from."""
+  merged = {}
+  for i, loss in records:
+    merged[i] = loss
+  return [merged[i] for i in sorted(merged)]
+
+
+def _traj_equal(a, b) -> bool:
+  import numpy as np
+  return len(a) == len(b) and all(
+      (np.isnan(x) and np.isnan(y)) or x == y for x, y in zip(a, b))
+
+
+def _traj_close(a, b, resumed_at, rtol=5e-4, atol=1e-5) -> bool:
+  """Exact before the resume point, fp-associativity bound after (the
+  resized mesh reduces grads/losses in a different order — the restored
+  state itself is bit-exact, pinned separately by tests/test_elastic)."""
+  import numpy as np
+  if len(a) != len(b):
+    return False
+  for i, (x, y) in enumerate(zip(a, b)):
+    if np.isnan(x) or np.isnan(y):
+      if not (np.isnan(x) and np.isnan(y)):
+        return False
+    elif i < resumed_at:
+      if x != y:
+        return False
+    elif not np.isclose(x, y, rtol=rtol, atol=atol):
+      return False
+  return True
+
+
+def run_chaos_kill(steps: int = 16, resize_world: int = 2,
+                   verbose: bool = True, extra_cycles: bool = False) -> dict:
+  """The full driver scenario; returns a verdict dict with ``ok``.
+
+  Cycles: (A) SIGKILL mid-save, relaunch at the same world — stitched
+  trajectory bit-exact vs the reference; (B) SIGKILL between steps,
+  relaunch RESIZED to ``resize_world`` — elastic resume, trajectory
+  exact before / allclose after the resume point, skip accounting exact
+  across lifetimes; (C) async snapshots under slow storage — steps
+  overlap the writer, trajectory unchanged. ``extra_cycles`` adds a
+  kill at ``ckpt_rename`` (torn publication) and a resize BACK to the
+  original world (N -> M -> N across lifetimes).
+  """
+  work = tempfile.mkdtemp(prefix="chaos_kill_")
+  result = {"steps": steps, "cycles": {}}
+
+  def cycle(name):
+    root = os.path.join(work, name, "ckpts")
+    log = os.path.join(work, name, "losses.jsonl")
+    os.makedirs(os.path.dirname(log), exist_ok=True)
+    return root, log
+
+  # ---- reference: one uninterrupted lifetime at world 4 ------------------
+  root, log = cycle("ref")
+  rc = _spawn(root, log, 4, steps)
+  ref_summary = _read_summary(log)
+  ref = _stitch(_read_log(log))
+  result["cycles"]["ref"] = {
+      "rc": rc, "summary": ref_summary,
+      "ok": rc == 0 and len(ref) == steps and bool(
+          ref_summary and ref_summary["invariant_ok"])}
+
+  # ---- cycle A: SIGKILL mid-save, same-world relaunch ---------------------
+  # the first snapshot consumes ckpt_write events 0..7 (4 fused rank
+  # files + 4 npz at world 4); event 9 dies two data files into the
+  # SECOND save, leaving a manifest-less .tmp the relaunch must ignore
+  root, log = cycle("mid_save")
+  rc1 = _spawn(root, log, 4, steps, kill_site="ckpt_write", kill_event=9)
+  torn = any(d.endswith(".tmp") for d in os.listdir(root))
+  rc2 = _spawn(root, log, 4, steps)
+  summary = _read_summary(log)
+  traj = _stitch(_read_log(log))
+  result["cycles"]["mid_save"] = {
+      "killed_rc": rc1, "relaunch_rc": rc2, "torn_tmp_present": torn,
+      "summary": summary,
+      "trajectory_bit_exact": _traj_equal(traj, ref),
+      "ok": rc1 == -signal.SIGKILL and rc2 == 0 and torn
+            and _traj_equal(traj, ref)
+            and bool(summary and summary["invariant_ok"]
+                     and summary["skipped"] == summary["expected_skips"])}
+
+  # ---- cycle B: SIGKILL between steps, RESIZED relaunch -------------------
+  # killed at marker event 8 (after a NaN skip at stream index 5 has
+  # been consumed), relaunched at a different world: the resume is an
+  # elastic re-shard and the skip accounting must span both lifetimes
+  root, log = cycle("resize")
+  rc1 = _spawn(root, log, 4, steps, kill_site="sigkill", kill_event=8)
+  n1 = len(_read_log(log))
+  rc2 = _spawn(root, log, resize_world, steps)
+  summary = _read_summary(log)
+  records = _read_log(log)
+  # the relaunch's records are the appended tail; its first index is the
+  # REPLAY start (last snapshot's consumed position), and everything it
+  # produced — replayed overlap included — is world-resized fp
+  resumed_at = records[n1][0] if len(records) > n1 else steps
+  traj = _stitch(records)
+  result["cycles"]["resize"] = {
+      "killed_rc": rc1, "relaunch_rc": rc2, "resumed_at": resumed_at,
+      "summary": summary,
+      "trajectory_matches": _traj_close(traj, ref, resumed_at),
+      "ok": rc1 == -signal.SIGKILL and rc2 == 0
+            and _traj_close(traj, ref, resumed_at)
+            and bool(summary and summary["world"] == resize_world
+                     and summary["invariant_ok"]
+                     and summary["skipped"] == summary["expected_skips"])}
+
+  # ---- cycle C: async snapshots overlap training --------------------------
+  root, log = cycle("async")
+  rc = _spawn(root, log, 4, steps, async_snapshots=True, slow_writes=0.05)
+  summary = _read_summary(log)
+  traj = _stitch(_read_log(log))
+  result["cycles"]["async"] = {
+      "rc": rc, "summary": summary,
+      "trajectory_bit_exact": _traj_equal(traj, ref),
+      "ok": rc == 0 and _traj_equal(traj, ref)
+            and bool(summary and summary["overlap_steps"] > 0
+                     and summary["invariant_ok"])}
+
+  if extra_cycles:
+    # torn publication: die between the manifest fsync and the rename
+    root, log = cycle("mid_rename")
+    rc1 = _spawn(root, log, 4, steps, kill_site="ckpt_rename",
+                 kill_event=1)
+    rc2 = _spawn(root, log, 4, steps)
+    summary = _read_summary(log)
+    traj = _stitch(_read_log(log))
+    result["cycles"]["mid_rename"] = {
+        "killed_rc": rc1, "relaunch_rc": rc2, "summary": summary,
+        "ok": rc1 == -signal.SIGKILL and rc2 == 0
+              and _traj_equal(traj, ref)
+              and bool(summary and summary["invariant_ok"])}
+    # N -> M -> N: kill the resized run too, come back at the original
+    root, log = cycle("resize_back")
+    rc1 = _spawn(root, log, 4, steps, kill_site="sigkill", kill_event=5)
+    n1 = len(_read_log(log))
+    rc2 = _spawn(root, log, resize_world, steps,
+                 kill_site="sigkill", kill_event=4)
+    rc3 = _spawn(root, log, 4, steps)
+    summary = _read_summary(log)
+    records = _read_log(log)
+    resumed_at = records[n1][0] if len(records) > n1 else steps
+    traj = _stitch(records)
+    result["cycles"]["resize_back"] = {
+        "rcs": [rc1, rc2, rc3], "summary": summary,
+        "ok": rc1 == rc2 == -signal.SIGKILL and rc3 == 0
+              and _traj_close(traj, ref, resumed_at)
+              and bool(summary and summary["invariant_ok"]
+                       and summary["skipped"] == summary["expected_skips"])}
+
+  result["ok"] = all(c["ok"] for c in result["cycles"].values())
+  if verbose:
+    print(json.dumps(result, indent=1))
+  return result
+
+
+def main(argv=None) -> int:
+  p = argparse.ArgumentParser(description=__doc__)
+  p.add_argument("--worker", action="store_true")
+  p.add_argument("--root", default="")
+  p.add_argument("--log", default="")
+  p.add_argument("--world", type=int, default=4)
+  p.add_argument("--steps", type=int, default=16)
+  p.add_argument("--kill-site", default="")
+  p.add_argument("--kill-event", type=int, default=-1)
+  p.add_argument("--async-snapshots", action="store_true")
+  p.add_argument("--slow-writes", type=float, default=0.0)
+  p.add_argument("--resize-world", type=int, default=2)
+  p.add_argument("--extra-cycles", action="store_true")
+  args = p.parse_args(argv)
+  if args.worker:
+    run_worker(args.root, args.log, args.world, args.steps,
+               kill_site=args.kill_site, kill_event=args.kill_event,
+               async_snapshots=args.async_snapshots,
+               slow_writes=args.slow_writes)
+    return 0
+  res = run_chaos_kill(steps=args.steps, resize_world=args.resize_world,
+                       extra_cycles=args.extra_cycles)
+  print("CHAOS-KILL:", "PASS" if res["ok"] else "FAIL")
+  return 0 if res["ok"] else 1
+
+
+if __name__ == "__main__":
+  sys.exit(main())
