@@ -29,10 +29,11 @@ GL107       error      every ``pytest.mark.<name>`` is registered in
                        deselects)
 GL108       error      fault-injection site literals must be registered in
                        ``resilience.faultinject.SITES``
-GL109       error      no raw ``lax.all_to_all`` outside ``parallel/wire.py``
-                       (library-package modules: everywhere; elsewhere:
-                       trace-reachable step-builder code) — a raw f32
-                       exchange bypasses the plan's wire contract
+GL109       error      no raw ``lax.all_to_all`` or ``lax.ppermute`` outside
+                       ``parallel/wire.py`` (library-package modules:
+                       everywhere; elsewhere: trace-reachable step-builder
+                       code) — a raw exchange bypasses the plan's wire
+                       contract and the audit's pinned round counts
 GL110       error      no ``jax.process_count()``/``process_index()``
                        compared against hardcoded world constants (!= 0/1)
                        in durable modules — elastic pods resize the world
@@ -421,16 +422,20 @@ def _check_markers(mod: ParsedModule) -> List[Finding]:
 
 
 @_rule("GL109", "error",
-       "no raw all_to_all outside the sanctioned wire module")
+       "no raw all_to_all / ppermute outside the sanctioned wire module")
 def _check_raw_all_to_all(mod: ParsedModule) -> List[Finding]:
   # parallel/wire.py (that exact path — not any file named wire.py) is
   # the one sanctioned home of the exchange primitives; the rule exists
   # so a new exchange cannot silently bypass the plan's wire knobs (bf16
-  # narrowing, dedup'd payloads). Scope: trace-reachable step-builder
-  # closures ANYWHERE, plus every function of library-package modules —
-  # the lookup engine's methods are where the real exchanges live and
-  # are not statically step-builder-reachable; tests/tools stay free to
-  # build raw audit fixtures.
+  # /fp8 narrowing, dedup'd payloads, the chunked ppermute pipeline).
+  # ppermute joined the guarded set with the pipelined wire: a raw
+  # ppermute round in step code would fly f32 outside the audit's
+  # (world-1) x chunks round pins exactly like a raw all_to_all. Scope:
+  # trace-reachable step-builder closures ANYWHERE, plus every function
+  # of library-package modules — the lookup engine's methods are where
+  # the real exchanges live and are not statically
+  # step-builder-reachable; tests/tools stay free to build raw audit
+  # fixtures.
   norm = mod.path.replace(os.sep, "/")
   if norm.endswith("parallel/wire.py"):
     return []
@@ -445,16 +450,18 @@ def _check_raw_all_to_all(mod: ParsedModule) -> List[Finding]:
     if not isinstance(node, ast.Call):
       continue
     _, name = _call_pair(node)
-    if name == "all_to_all" and node.lineno not in seen:
+    if name in ("all_to_all", "ppermute") and node.lineno not in seen:
       seen.add(node.lineno)  # nested traced fns overlap in their walks
       out.append(mod.finding(
           "GL109", node,
-          "raw lax.all_to_all outside parallel/wire.py: exchanges "
-          "must ride the wire module (wire.exchange_ids for integer "
-          "payloads, wire.float_all_to_all for activations/cotangents) "
-          "so the plan's wire_dtype/dedup_exchange contract holds — a "
-          "raw exchange ships f32 payloads the audit layer then "
-          "cannot account for."))
+          f"raw lax.{name} outside parallel/wire.py: exchanges "
+          "must ride the wire module (wire.exchange_ids / "
+          "wire.pipelined_exchange_ids for integer payloads, "
+          "wire.float_all_to_all / wire.pipelined_float_exchange for "
+          "activations/cotangents) so the plan's wire_dtype / "
+          "dedup_exchange / overlap contract holds — a raw exchange "
+          "ships f32 payloads outside the round counts the audit "
+          "layer pins."))
   return out
 
 

@@ -20,10 +20,14 @@ the traced program:
 - **Wire contract**: per artifact, the ``all_to_all`` COUNT is pinned
   (3 per padded bucket in a train step — ids, activations, reverse
   cotangents; 2 in eval) and every FLOAT payload's element dtype must
-  match the plan's ``wire_dtype`` (f32 identity wire, or bf16 narrowed
-  in flight by ``parallel.wire``). A stray f32 exchange under a bf16
-  plan doubles wire bytes silently; an extra exchange is traffic the
-  round-6 exchange budget does not account for.
+  match the plan's ``wire_dtype`` (f32 identity wire, or bf16/fp8
+  narrowed in flight by ``parallel.wire``). A stray f32 exchange under
+  a narrowed plan multiplies wire bytes silently; an extra exchange is
+  traffic the exchange budget does not account for. Plans with
+  ``overlap='pipelined'`` additionally pin the ``ppermute`` ROUND count
+  — exactly ``(world - 1) * exchange_chunks`` rounds per exchange, zero
+  ``all_to_all``s — and the float dtype check covers the ppermute
+  payloads (the fp8 wire's blocks must actually fly as float8_e4m3).
 - **No f64 leaks**: no equation produces a float64 value (CPU tracing
   would hide what TPU lowering rejects; an f64 constant also doubles a
   buffer).
@@ -106,6 +110,8 @@ class JaxprSummary:
   # element dtype of every all_to_all payload (first operand), in walk
   # order — the wire-contract evidence
   a2a_dtypes: List[str] = field(default_factory=list)
+  # same for ppermute payloads (the pipelined wire's rounds)
+  ppermute_dtypes: List[str] = field(default_factory=list)
 
 
 _COLLECTIVES = frozenset({
@@ -123,6 +129,8 @@ def summarize(jaxpr) -> JaxprSummary:
       s.scatter_shapes.append(tuple(eqn.invars[0].aval.shape))
     if name == "all_to_all":
       s.a2a_dtypes.append(str(eqn.invars[0].aval.dtype))
+    if name == "ppermute":
+      s.ppermute_dtypes.append(str(eqn.invars[0].aval.dtype))
     if name in _COLLECTIVES:
       axes = eqn.params.get("axes", eqn.params.get("axis_name", ()))
       if not isinstance(axes, (tuple, list)):
@@ -157,9 +165,16 @@ class Expectation:
   # per padded bucket (ids dp->mp, activations mp->dp, reverse
   # cotangents), eval 2x; ragged buckets add one (separate lengths wire).
   a2a_count: Optional[int] = None
-  # required element dtype of every FLOAT all_to_all payload (None: not
-  # checked) — the plan's wire_dtype contract ('float32' | 'bfloat16')
+  # required element dtype of every FLOAT all_to_all AND ppermute
+  # payload (None: not checked) — the plan's wire_dtype contract
+  # ('float32' | 'bfloat16' | 'float8_e4m3fn')
   wire_float_dtype: Optional[str] = None
+  # exact ppermute round count (None: not checked). Pipelined plans fly
+  # (world - 1) * exchange_chunks rounds per exchange, so a train step
+  # carries 3 * buckets * (world - 1) * chunks of them and ZERO
+  # all_to_alls; a drifting count means a chunk (or a whole exchange)
+  # silently fell out of — or was added to — the schedule.
+  ppermute_count: Optional[int] = None
 
 
 def audit_summary(name: str, s: JaxprSummary, expect: Expectation
@@ -199,15 +214,24 @@ def audit_summary(name: str, s: JaxprSummary, expect: Expectation
         f"{expect.a2a_count} — an extra exchange is wire traffic the "
         "exchange budget does not account for; a missing one means a "
         "payload stopped crossing the mesh")
+  n_pp = s.counts.get("ppermute", 0)
+  if expect.ppermute_count is not None and n_pp != expect.ppermute_count:
+    out.append(
+        f"{name}: {n_pp} ppermute round(s), expected "
+        f"{expect.ppermute_count} (= exchanges x (world-1) x chunks) — "
+        "the pipelined schedule drifted: a missing round strands a "
+        "chunk's blocks on their source ranks, an extra one is wire "
+        "traffic the budget does not account for")
   if expect.wire_float_dtype is not None:
-    bad = sorted({d for d in s.a2a_dtypes
+    bad = sorted({d for d in s.a2a_dtypes + s.ppermute_dtypes
                   if "float" in d and d != expect.wire_float_dtype})
     if bad:
       out.append(
-          f"{name}: float all_to_all payload(s) travel {bad}, expected "
+          f"{name}: float exchange payload(s) travel {bad}, expected "
           f"{expect.wire_float_dtype} — the plan's wire_dtype contract "
-          "is broken (an f32 payload under a bf16 wire doubles exchange "
-          "bytes; a bf16 one under f32 silently loses precision)")
+          "is broken (an f32 payload under a narrowed wire multiplies "
+          "exchange bytes; a narrowed one under f32 silently loses "
+          "precision)")
   if s.f64_prims:
     out.append(
         f"{name}: float64 values produced by {sorted(set(s.f64_prims))} "
@@ -248,6 +272,11 @@ def build_artifacts() -> Dict[str, Tuple[Any, Expectation]]:
   - ``sparse_step_guard``:  ``make_sparse_train_step(guard=True)``
   - ``sparse_step_wire``:   same step on a ``wire_dtype='bf16',
     dedup_exchange=True`` plan (every float exchange must be bf16)
+  - ``sparse_step_pipe_f32`` / ``..._bf16`` / ``..._fp8``: the same
+    step on ``overlap='pipelined', exchange_chunks=2`` plans — zero
+    all_to_alls, exactly ``3 buckets x (world-1) x chunks`` ppermute
+    rounds, float payloads in the mode's wire dtype (the fp8 artifact
+    also dedups, pinning the pipelined x dedup composition)
   - ``tiered_step``:        ``make_tiered_train_step`` (host-tier class)
   - ``tiered_step_guard``:  ``make_tiered_train_step(guard=True)`` —
     the commit gate's pmin must appear exactly once here too, so a
@@ -333,7 +362,7 @@ def build_artifacts() -> Dict[str, Tuple[Any, Expectation]]:
     jx = jax.make_jaxpr(step)(state, *bt)
     artifacts["sparse_step_guard" if guard else "sparse_step"] = (
         jx.jaxpr, Expectation(shapes, mesh_axes, guard=guard,
-                              a2a_count=3 * nb,
+                              a2a_count=3 * nb, ppermute_count=0,
                               wire_float_dtype="float32"))
 
   ev = make_sparse_eval_step(model, plan, rule, mesh, state, batch0)
@@ -341,7 +370,8 @@ def build_artifacts() -> Dict[str, Tuple[Any, Expectation]]:
   artifacts["eval_step"] = (
       jx.jaxpr,
       Expectation(shapes, mesh_axes, guard=False, scatters_per_class=0,
-                  a2a_count=2 * nb, wire_float_dtype="float32"))
+                  a2a_count=2 * nb, ppermute_count=0,
+                  wire_float_dtype="float32"))
 
   # ---- compressed-wire sparse step (bf16 wire + dedup'd exchange) --------
   # identical table layout, so the f32 state and batch reuse verbatim;
@@ -358,7 +388,34 @@ def build_artifacts() -> Dict[str, Tuple[Any, Expectation]]:
   artifacts["sparse_step_wire"] = (
       jx.jaxpr, Expectation(shapes, mesh_axes, guard=False,
                             a2a_count=3 * n_padded_buckets(plan_w),
+                            ppermute_count=0,
                             wire_float_dtype="bfloat16"))
+
+  # ---- pipelined exchange steps (chunked ppermute schedule) --------------
+  # same table layout again (the overlap knobs change no buffer); each
+  # pins ZERO all_to_alls and exactly 3 exchanges x (world-1) rounds x
+  # chunks ppermutes, plus the mode's in-flight float dtype. The fp8
+  # artifact also dedups — pinning that the pipelined schedule composes
+  # with the unique-block exchange (the ISSUE's chunked dedup path).
+  CHUNKS = 2
+  for wname, dedup in (("f32", False), ("bf16", False), ("fp8", True)):
+    plan_p = DistEmbeddingStrategy(
+        [TableConfig(input_dim=v, output_dim=WIDTH,
+                     initializer=_dlrm_initializer(v)) for v in VOCAB],
+        WORLD, "memory_balanced", dense_row_threshold=60,
+        wire_dtype=wname, dedup_exchange=dedup,
+        overlap="pipelined", exchange_chunks=CHUNKS)
+    step_p = make_sparse_train_step(model, plan_p, bce_loss, opt, rule,
+                                    mesh, state, batch0, donate=False)
+    jx = jax.make_jaxpr(step_p)(state, *bt)
+    nb_p = n_padded_buckets(plan_p)
+    artifacts[f"sparse_step_pipe_{wname}"] = (
+        jx.jaxpr,
+        Expectation(shapes, mesh_axes, guard=False, a2a_count=0,
+                    ppermute_count=3 * nb_p * (WORLD - 1) * CHUNKS,
+                    wire_float_dtype={
+                        "f32": "float32", "bf16": "bfloat16",
+                        "fp8": "float8_e4m3fn"}[wname]))
 
   # ---- tiered step (host-tier class + device tiers) ----------------------
   plan_t = DistEmbeddingStrategy(
@@ -392,6 +449,7 @@ def build_artifacts() -> Dict[str, Tuple[Any, Expectation]]:
   artifacts["tiered_step"] = (
       jx.jaxpr, Expectation(shapes_t, mesh_axes, guard=False,
                             a2a_count=3 * n_padded_buckets(plan_t),
+                            ppermute_count=0,
                             wire_float_dtype="float32"))
 
   # ---- guarded tiered step (PR 2 carried follow-on) -----------------------
@@ -405,6 +463,7 @@ def build_artifacts() -> Dict[str, Tuple[Any, Expectation]]:
   artifacts["tiered_step_guard"] = (
       jx.jaxpr, Expectation(shapes_t, mesh_axes, guard=True,
                             a2a_count=3 * n_padded_buckets(plan_t),
+                            ppermute_count=0,
                             wire_float_dtype="float32"))
   return artifacts
 

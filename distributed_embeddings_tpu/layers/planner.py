@@ -325,7 +325,10 @@ class DistEmbeddingStrategy:
                hbm_budget_bytes: Optional[int] = None,
                oov: str = "clip",
                wire_dtype: str = "f32",
-               dedup_exchange: bool = False):
+               dedup_exchange: bool = False,
+               overlap: str = "none",
+               exchange_chunks: int = 1,
+               dedup_capacity: Optional[int] = None):
     if strategy not in ("basic", "memory_balanced", "memory_optimized"):
       raise ValueError(f"Unsupported shard strategy {strategy}")
     # ---- wire format of the dp<->mp exchanges ---------------------------
@@ -333,23 +336,62 @@ class DistEmbeddingStrategy:
     # backward and audit — one lookup call flipping it per-site would
     # desynchronize the reverse (autodiff-inserted) exchange from the
     # forward one. "wire_dtype": float payloads (activations + reverse
-    # cotangents) travel 'f32' (identity, the pre-knob program) or 'bf16'
-    # (half the float wire bytes; tables, combiners and the
-    # one-scatter-add backward stay f32 master precision — the narrowing
-    # exists only in flight). "dedup_exchange": per (source, dest, bucket)
-    # block, ship the sorted-unique id set and one activation/cotangent
-    # row per unique id instead of one per occurrence/sample
-    # (lookup_engine.DedupRouted; sparse-kind padded buckets only — dense
-    # MXU classes and ragged value streams keep the raw exchange).
-    # Neither knob changes any buffer layout, so checkpoints restore
-    # across knob changes; training step builders reject exact=True with
-    # a bf16 wire (the exact path's bit-for-bit claim cannot survive a
-    # narrowed cotangent exchange).
-    if wire_dtype not in ("f32", "bf16"):
+    # cotangents) travel 'f32' (identity, the pre-knob program), 'bf16'
+    # (half the float wire bytes), or 'fp8' (quarter: float8_e4m3 payload
+    # with one f32 amax scale shipped per destination block/chunk —
+    # tables, combiners and the one-scatter-add backward stay f32 master
+    # precision in every mode; the narrowing exists only in flight).
+    # "dedup_exchange": per (source, dest, bucket) block, ship the
+    # sorted-unique id set and one activation/cotangent row per unique
+    # id instead of one per occurrence/sample (lookup_engine.DedupRouted;
+    # sparse-kind padded buckets only — dense MXU classes and ragged
+    # value streams keep the raw exchange). "overlap='pipelined'":
+    # rewrite each monolithic all_to_all as (world - 1) ppermute rounds
+    # per chunk, the payload split into "exchange_chunks" chunks, so the
+    # receiving side's gather/combine of chunk k overlaps chunk k+1's
+    # flight (wire.pipelined_float_exchange / pipelined_exchange_ids;
+    # f32 pipelined is bit-exact vs monolithic — pure data movement).
+    # None of these knobs changes any buffer layout, so checkpoints
+    # restore across knob changes; training step builders reject
+    # exact=True with a narrowed (bf16/fp8) wire (the exact path's
+    # bit-for-bit claim cannot survive a narrowed cotangent exchange).
+    if wire_dtype not in ("f32", "bf16", "fp8"):
       raise ValueError(
-          f"wire_dtype must be 'f32' or 'bf16', got {wire_dtype!r}")
+          f"wire_dtype must be 'f32', 'bf16' or 'fp8', got {wire_dtype!r}")
     self.wire_dtype = wire_dtype
     self.dedup_exchange = bool(dedup_exchange)
+    if overlap not in ("none", "pipelined"):
+      raise ValueError(
+          f"overlap must be 'none' or 'pipelined', got {overlap!r}")
+    if not isinstance(exchange_chunks, int) or exchange_chunks < 1:
+      raise ValueError(
+          f"exchange_chunks must be a positive int, got {exchange_chunks!r}")
+    if exchange_chunks > 1 and overlap != "pipelined":
+      raise ValueError(
+          f"exchange_chunks={exchange_chunks} without overlap='pipelined' "
+          "would be silently ignored: the monolithic all_to_all has no "
+          "chunk axis. Set overlap='pipelined' (or exchange_chunks=1).")
+    self.overlap = overlap
+    self.exchange_chunks = exchange_chunks
+    # "dedup_capacity": override the dedup'd exchange's per-block unique
+    # capacity K (default min(block occurrences, sentinel + 1) — the
+    # value-range bound, which can never overflow). A SMALLER cap shrinks
+    # the unique wire further but creates an overflow path: distinct ids
+    # beyond the cap alias onto the last slot and gather the wrong row.
+    # The knob is therefore only legal alongside the counter that makes
+    # that observable — guarded train steps and with_metrics eval surface
+    # a psum'd per-class 'dedup_overflow' count, and the unguarded step
+    # builders REFUSE a capped plan at build time.
+    if dedup_capacity is not None:
+      if not dedup_exchange:
+        raise ValueError(
+            "dedup_capacity requires dedup_exchange=True: the capacity "
+            "caps the dedup'd exchange's unique blocks, which a raw "
+            "exchange does not have.")
+      if not isinstance(dedup_capacity, int) or dedup_capacity < 1:
+        raise ValueError(
+            f"dedup_capacity must be a positive int, got {dedup_capacity!r}")
+    self.dedup_capacity = dedup_capacity
     # Out-of-vocabulary id POLICY (plan-level — one id pipeline feeds all
     # tables, so the policy is a property of the plan, not a lookup-call
     # flag). "clip": ids >= input_dim clamp to the last row (reference
@@ -1013,6 +1055,10 @@ class DistEmbeddingStrategy:
     call-time-ragged input routes that bucket raw even when ``dedup``
     reports True here). ``float_wire_bytes_per_value`` is the in-flight
     element size of activation/cotangent payloads under ``wire_dtype``.
+    ``rounds_per_exchange`` is the pipelined schedule's collective count
+    per exchange: ``(world - 1) * exchange_chunks`` ppermute rounds
+    under ``overlap='pipelined'`` (the jaxpr audit pins exactly this per
+    artifact), 1 monolithic all_to_all otherwise.
     """
     from ..parallel.lookup_engine import class_param_name
     classes = {}
@@ -1024,10 +1070,18 @@ class DistEmbeddingStrategy:
           "dedup": bool(self.dedup_exchange and cp.kind == "sparse"
                         and self.world_size > 1),
       }
+    pipelined = self.overlap == "pipelined" and self.world_size > 1
     return {
         "wire_dtype": self.wire_dtype,
         "dedup_exchange": self.dedup_exchange,
-        "float_wire_bytes_per_value": 2 if self.wire_dtype == "bf16" else 4,
+        "dedup_capacity": self.dedup_capacity,
+        "float_wire_bytes_per_value": {"f32": 4, "bf16": 2,
+                                       "fp8": 1}[self.wire_dtype],
+        "overlap": self.overlap,
+        "exchange_chunks": self.exchange_chunks,
+        "rounds_per_exchange": ((self.world_size - 1) * self.exchange_chunks
+                                if pipelined else
+                                (1 if self.world_size > 1 else 0)),
         "world_size": self.world_size,
         "classes": classes,
     }

@@ -90,7 +90,7 @@ def dedup_rows(ids: jax.Array, rows: jax.Array, sentinel: int) -> SparseRows:
 
 
 def unique_ids_map(ids: jax.Array, sentinel: int,
-                   capacity: int) -> tuple:
+                   capacity: int, with_count: bool = False) -> tuple:
   """Sort + unique with a STATIC capacity and an inverse map.
 
   The :func:`dedup_rows` machinery (stable sort, run-start segmentation)
@@ -106,14 +106,19 @@ def unique_ids_map(ids: jax.Array, sentinel: int,
     sentinel: the padding id (= the class buffer's row count).
     capacity: static unique-slot count. Safe iff ``capacity >=
       min(m, sentinel + 1)`` — the value range bounds the distinct count,
-      so that choice can never overflow; a smaller capacity would
-      silently alias distinct ids and is the caller's bug.
+      so that choice can never overflow. A smaller capacity (the
+      ``dedup_capacity`` plan override) ALIASES the distinct values past
+      it onto the last slot; callers taking that trade must surface the
+      overflow count (``with_count``) — a silent smaller cap is a bug.
+    with_count: also return the block's distinct-value count (run count
+      BEFORE the capacity clamp, sentinel run included), from which the
+      overflow is ``max(0, n_distinct - capacity)``.
 
   Returns:
     ``(uniq [capacity] int32, inv [m] int32)`` with ``uniq[inv] == ids``
     (after clamping); ``uniq`` is ascending with sentinel padding at the
     tail, so padded slots gather zero rows exactly like padded
-    occurrences did.
+    occurrences did. With ``with_count``, ``(uniq, inv, n_distinct)``.
   """
   m = ids.shape[0]
   clean = jnp.where((ids < 0) | (ids > sentinel), sentinel,
@@ -122,10 +127,15 @@ def unique_ids_map(ids: jax.Array, sentinel: int,
   is_start = jnp.concatenate(
       [jnp.ones((1,), bool), sorted_ids[1:] != sorted_ids[:-1]])
   seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1
+  # count BEFORE the clamp; only traced when asked for (an uncapped
+  # plan's jaxpr must stay byte-identical to the pre-knob build)
+  n_distinct = (seg[-1] + 1) if with_count else None
   seg = jnp.minimum(seg, capacity - 1)  # no-op under the safe capacity
   uniq = jnp.full((capacity,), sentinel, jnp.int32)
   uniq = uniq.at[seg].min(sorted_ids, mode="drop")
   inv = jnp.zeros((m,), jnp.int32).at[perm].set(seg, mode="drop")
+  if with_count:
+    return uniq, inv, n_distinct
   return uniq, inv
 
 

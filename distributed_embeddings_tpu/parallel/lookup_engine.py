@@ -36,14 +36,18 @@ either direction. All shapes static, fully jit/grad compatible; ``shard_map``
 differentiates through ``all_to_all`` natively, which is what replaces the
 reference's ~100 lines of Horovod tape patching.
 
-Every exchange rides :mod:`parallel.wire` (the sanctioned all_to_all home,
-graftlint GL109): two plan knobs compress the wire without touching the f32
-master state — ``wire_dtype='bf16'`` narrows float payloads (activations +
-reverse cotangents) in flight only, and ``dedup_exchange=True`` ships each
-destination block's sorted-unique ids and ONE activation/cotangent row per
-unique id (:class:`DedupRouted`; the dp side keeps the inverse map, expands
-and combines locally, and the expansion's transpose segment-sums duplicate
-cotangents before the reverse exchange). See ARCHITECTURE.md §13.
+Every exchange rides :mod:`parallel.wire` (the sanctioned all_to_all /
+ppermute home, graftlint GL109): the plan knobs compress and hide the wire
+without touching the f32 master state — ``wire_dtype='bf16' | 'fp8'``
+narrows float payloads (activations + reverse cotangents) in flight only
+(fp8 ships a per-block amax scale inside the block), ``dedup_exchange=True``
+ships each destination block's sorted-unique ids and ONE
+activation/cotangent row per unique id (:class:`DedupRouted`; the dp side
+keeps the inverse map, expands and combines locally, and the expansion's
+transpose segment-sums duplicate cotangents before the reverse exchange),
+and ``overlap='pipelined'`` replaces each monolithic exchange with
+``(world - 1) * exchange_chunks`` ppermute rounds so consumption of chunk k
+overlaps chunk k+1's flight. See ARCHITECTURE.md §13 and §15.
 """
 
 from __future__ import annotations
@@ -298,14 +302,23 @@ class DedupRouted:
   A deliberately NOT-a-tuple pytree: routed ragged buckets travel as
   plain ``(vals, lens)`` tuples and several consumers dispatch on
   ``isinstance(ids, tuple)``.
+
+  ``overflow`` is only present (non-None) when the plan caps the unique
+  capacity below its safe bound (``dedup_capacity``): this device's
+  count of distinct ids that did NOT get their own slot, summed over the
+  bucket's destination blocks — each one aliased onto the cap's last
+  slot and gathered the wrong row. The guarded step psums it into the
+  ``dedup_overflow`` metric; uncapped plans trace no counter at all (the
+  pre-knob jaxpr is preserved byte-for-byte).
   """
 
   uniq: jax.Array        # [world_src, K] mp-side unique ids (post-exchange)
   inv: jax.Array         # [world_dst, n_b, B(, h)] dp-LOCAL inverse map
   uniq_local: jax.Array  # [world_dst, K] dp-LOCAL unique blocks (pre-exchange)
+  overflow: Optional[jax.Array] = None  # scalar int32 iff dedup_capacity set
 
   def tree_flatten(self):
-    return (self.uniq, self.inv, self.uniq_local), None
+    return (self.uniq, self.inv, self.uniq_local, self.overflow), None
 
   @classmethod
   def tree_unflatten(cls, aux, children):
@@ -553,6 +566,30 @@ class DistributedLookup:
       return 0
     return lax.axis_index(self.axis_name)
 
+  # ---- the plan's wire, in one place -------------------------------------
+  def _pipelined_wire(self) -> bool:
+    """The plan asked for the chunked ppermute pipeline (inert at world
+    1 — there is no wire to pipeline)."""
+    return (wire.plan_overlap(self.plan) == "pipelined"
+            and self.plan.world_size > 1)
+
+  def _wire_exchange_ids(self, x: jax.Array) -> jax.Array:
+    """Integer payload exchange under the plan's overlap knob."""
+    if self._pipelined_wire():
+      return wire.pipelined_exchange_ids(
+          x, self.axis_name, wire.plan_exchange_chunks(self.plan))
+    return wire.exchange_ids(x, self.axis_name)
+
+  def _wire_exchange_float(self, x: jax.Array) -> jax.Array:
+    """Float payload exchange under the plan's wire_dtype AND overlap
+    knobs (the reverse cotangent exchange mirrors whichever path is
+    taken, through each path's custom_vjp)."""
+    wd = wire.plan_wire_dtype(self.plan)
+    if self._pipelined_wire():
+      return wire.pipelined_float_exchange(
+          x, self.axis_name, wd, wire.plan_exchange_chunks(self.plan))
+    return wire.float_all_to_all(x, self.axis_name, wd)
+
   def _build_routing(self, key, bucket: Bucket,
                      inputs: Sequence[jax.Array]) -> jax.Array:
     """[world, n_b, B_local, h] routing tensor for one bucket (h == 1
@@ -712,8 +749,8 @@ class DistributedLookup:
         if bucket.h < 0:  # ragged: (vals [world,n_b,V], lens [world,n_b,B])
           vals, lens = x
           if world > 1:
-            vals = wire.exchange_ids(vals, self.axis_name)
-            lens = wire.exchange_ids(lens, self.axis_name)
+            vals = self._wire_exchange_ids(vals)
+            lens = self._wire_exchange_ids(lens)
           # -> (vals [n_b, world, V], lens [n_b, world, B]); the world
           # (source-rank) axis stays explicit because each source block
           # has its own CSR segmentation
@@ -722,7 +759,7 @@ class DistributedLookup:
         elif world > 1 and self._dedup_class(key):
           routed = self._dedup_route(key, x)
         elif world > 1:
-          y = wire.exchange_ids(x, self.axis_name)
+          y = self._wire_exchange_ids(x)
           routed = self._reshape_routed(y, bucket, world, b)
         else:
           routed = self._reshape_routed(x, bucket, world, b)
@@ -745,16 +782,32 @@ class DistributedLookup:
     ``K = min(occurrences, sentinel + 1)`` (the block's values live in
     ``[0, sentinel]``, so K can never overflow) and only the unique
     blocks cross the wire; the inverse maps stay local for the return
-    expansion (:meth:`_exchange_dedup`)."""
+    expansion (:meth:`_exchange_dedup`).
+
+    ``plan.dedup_capacity`` caps K below the safe bound: the wire
+    shrinks further, but distinct ids past the cap ALIAS onto its last
+    slot — so the capped path additionally counts the per-block distinct
+    overflow into ``DedupRouted.overflow`` (the guarded step's psum'd
+    ``dedup_overflow`` metric; the step builders refuse a capped plan
+    without that counter path)."""
     world = self.plan.world_size
     sentinel = padded_rows(self.plan, key)
     m = int(np.prod(x.shape[1:]))
     cap = min(m, sentinel + 1)
-    uniq_local, inv = jax.vmap(
-        lambda ids: unique_ids_map(ids, sentinel, cap))(x.reshape(world, m))
-    uniq = wire.exchange_ids(uniq_local, self.axis_name)  # [world_src, K]
+    cap_knob = getattr(self.plan, "dedup_capacity", None)
+    overflow = None
+    if cap_knob is not None and cap_knob < cap:
+      cap = cap_knob
+      uniq_local, inv, n_distinct = jax.vmap(
+          lambda ids: unique_ids_map(ids, sentinel, cap, with_count=True)
+      )(x.reshape(world, m))
+      overflow = jnp.sum(jnp.maximum(n_distinct - cap, 0))
+    else:
+      uniq_local, inv = jax.vmap(
+          lambda ids: unique_ids_map(ids, sentinel, cap))(x.reshape(world, m))
+    uniq = self._wire_exchange_ids(uniq_local)  # [world_src, K]
     return DedupRouted(uniq=uniq, inv=inv.reshape(x.shape),
-                       uniq_local=uniq_local)
+                       uniq_local=uniq_local, overflow=overflow)
 
   @staticmethod
   def _reshape_routed(y, bucket, world, b):
@@ -996,7 +1049,6 @@ class DistributedLookup:
     :meth:`_exchange_dedup` (exchange one row per unique id, expand via
     the dp-local inverse map, combine dp-side)."""
     world = self.plan.world_size
-    wd = wire.plan_wire_dtype(self.plan)
     received = {}
     for bk, zb in z.items():
       dr = ids_all.get(bk) if ids_all is not None else None
@@ -1006,7 +1058,7 @@ class DistributedLookup:
       n_b = zb.shape[0]
       zb = zb.reshape(n_b, world, batch_local, -1).transpose(1, 0, 2, 3)
       if world > 1:
-        zb = wire.float_all_to_all(zb, self.axis_name, wd)
+        zb = self._wire_exchange_float(zb)
       received[bk] = zb
     return received
 
@@ -1028,8 +1080,7 @@ class DistributedLookup:
     key = bk.class_key
     world = self.plan.world_size
     w = z_u.shape[-1]
-    ret = wire.float_all_to_all(z_u, self.axis_name,
-                                wire.plan_wire_dtype(self.plan))
+    ret = self._wire_exchange_float(z_u)
     inv_shape = dr.inv.shape  # [world, n_b, B] | [world, n_b, B, h]
     m = int(np.prod(inv_shape[1:]))
     expanded = jax.vmap(expand_unique_rows)(ret, dr.inv.reshape(world, m))
@@ -1196,6 +1247,29 @@ class DistributedLookup:
       for ck in sorted({p.class_key for p in pieces}):
         name = class_param_name(*ck)
         out[name] = out[name] + n
+    return out
+
+  def dedup_overflow_counts(self, ids_all: Dict[tuple, jax.Array]
+                            ) -> Dict[str, jax.Array]:
+    """Per-class dedup-capacity overflow counts for one routed batch.
+
+    Only meaningful on plans with ``dedup_capacity`` set: each
+    :class:`DedupRouted` bucket routed under a capped capacity carries
+    the count of distinct ids that aliased past the cap
+    (``DedupRouted.overflow``); this sums them per width class — the
+    same granularity as :meth:`oov_counts` — so the guarded train step
+    and the with-metrics eval step can psum and surface them. Classes
+    with no capped buckets report 0. A nonzero count means those ids
+    gathered (and in training, updated) the WRONG rows; the counter is
+    what keeps the smaller cap observable instead of silent.
+
+    Returns class name -> int32 scalar (this device's local counts)."""
+    out = {class_param_name(*k): jnp.zeros((), jnp.int32)
+           for k in self.plan.class_keys}
+    for bk, ids in ids_all.items():
+      if isinstance(ids, DedupRouted) and ids.overflow is not None:
+        name = class_param_name(*bk.class_key)
+        out[name] = out[name] + ids.overflow.astype(jnp.int32)
     return out
 
   def _oov_error_eager(self, inputs: Sequence[jax.Array]) -> None:
@@ -1726,7 +1800,8 @@ class DistributedLookup:
         tv, m = _translate_tier(ids.uniq, spec, sentinel, resident[name],
                                 staged_grps[name])
         out[bk] = DedupRouted(uniq=tv, inv=ids.inv,
-                              uniq_local=ids.uniq_local)
+                              uniq_local=ids.uniq_local,
+                              overflow=ids.overflow)
       elif isinstance(ids, tuple):  # ragged value stream (vals, lens)
         vals, lens = ids
         tv, m = _translate_tier(vals, spec, sentinel, resident[name],

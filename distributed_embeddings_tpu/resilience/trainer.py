@@ -72,6 +72,21 @@ class ResilientTrainer:
     async_snapshots: periodic snapshots hand the host-side file writes
       to a background writer thread (see :meth:`snapshot`), so training
       steps proceed while the checkpoint lands on disk.
+    tiered: a GUARDED ``tiering.TieredTrainer`` — the trainer then
+      drives TIERED steps (the ROADMAP carried follow-on): each
+      :meth:`step` call runs the full tiered protocol (classify/stage,
+      device step, staging write-back, periodic re-rank) through the
+      TieredTrainer while THIS trainer owns the durability/guard
+      accounting — ``bad_step``/``oov`` from the tiered step's nested
+      metrics dict are accounted exactly like the sparse step's
+      (consecutive-bad abort, rollback, oov='error' enforcement,
+      consumed-stream position), snapshots flush the store's resident
+      rows first and checkpoint it (``store`` defaults to the
+      TieredTrainer's), and resume/rollback restores the host images and
+      refreshes the prefetcher's resident maps. ``step_fn``/``state``
+      are then taken from the TieredTrainer (pass ``None``); batches are
+      HOST batches (the classify stage needs the global ids before any
+      sharding).
   """
 
   def __init__(self, step_fn, state: Dict[str, Any], plan, rule,
@@ -81,7 +96,29 @@ class ResilientTrainer:
                max_consecutive_bad: Optional[int] = 3,
                resume: bool = True, store=None,
                retry_policy: retry.RetryPolicy = retry.DEFAULT_POLICY,
-               async_snapshots: bool = False):
+               async_snapshots: bool = False,
+               tiered=None):
+    self.tiered = tiered
+    if tiered is not None:
+      if not getattr(tiered, "guard", False):
+        raise ValueError(
+            "ResilientTrainer(tiered=...) needs a TieredTrainer built "
+            "with guard=True: the resilience accounting reads the "
+            "guarded step's {'bad_step', 'oov'} metrics, and an "
+            "unguarded tiered step surfaces neither (a poison batch "
+            "would commit into the host images).")
+      if step_fn is not None:
+        raise ValueError(
+            "ResilientTrainer(tiered=...) drives the TieredTrainer's own "
+            "step; pass step_fn=None (the two would race on the state).")
+      if async_snapshots:
+        raise NotImplementedError(
+            "async_snapshots with a tiered trainer: checkpoint.save "
+            "reads AND writes the store's live host images, which the "
+            "per-step write-back mutates — a background save would tear "
+            "them (same limit as snapshot(async_=True) with a store).")
+      state = tiered.state if state is None else state
+      store = tiered.store if store is None else store
     self._step_fn = step_fn
     self.state = state
     self.plan = plan
@@ -95,6 +132,10 @@ class ResilientTrainer:
     self.retry_policy = retry_policy
     self._bad = guards.BadStepCounter(max_consecutive_bad)
     self.oov_totals: Dict[str, int] = {}
+    # per-class dedup-capacity overflow totals (plans with dedup_capacity
+    # set — the counter that keeps the smaller cap observable; empty and
+    # absent from snapshots otherwise)
+    self.dedup_overflow_totals: Dict[str, int] = {}
     self.resumed_from: Optional[str] = None
     self.async_snapshots = async_snapshots
     self._writer: Optional[threading.Thread] = None
@@ -162,6 +203,14 @@ class ResilientTrainer:
     from .. import checkpoint
     first_resume = self.consumed == 0
     self.state, step, path = got
+    if self.tiered is not None:
+      # the restore rewrote the store's host images and resident sets
+      # alongside the state: re-point the TieredTrainer at the restored
+      # state and re-derive the prefetcher's device resident maps —
+      # classifying against the pre-restore maps would stage the wrong
+      # cold rows and trip the missed>0 contract
+      self.tiered.state = self.state
+      self.tiered.prefetcher.refresh_resident()
     self.resumed_from = path
     self._last_snapshot = step
     extra = checkpoint.read_manifest(path).get("extra", {})
@@ -170,13 +219,16 @@ class ResilientTrainer:
     self.consumed = int(extra.get("consumed", step))
     if first_resume:
       # A process that has consumed nothing yet adopts the run's
-      # persisted skip/OOV accounting along with its stream position.
-      # A mid-run rollback (abort path) keeps the counts this process
-      # observed: those skips and clipped ids really happened, and the
-      # snapshot's stale counters would erase them.
+      # persisted skip/OOV/overflow accounting along with its stream
+      # position. A mid-run rollback (abort path) keeps the counts this
+      # process observed: those skips and clipped/aliased ids really
+      # happened, and the snapshot's stale counters would erase them.
       self._bad.skipped = int(extra.get("skipped", 0))
       self.oov_totals = {str(k): int(v)
                          for k, v in extra.get("oov", {}).items()}
+      self.dedup_overflow_totals = {
+          str(k): int(v)
+          for k, v in extra.get("dedup_overflow", {}).items()}
     return True
 
   def snapshot(self, async_: bool = False) -> str:
@@ -201,6 +253,8 @@ class ResilientTrainer:
     extra = {"consumed": self.consumed,
              "skipped": self.skipped_steps,
              "oov": dict(self.oov_totals)}
+    if self.dedup_overflow_totals:
+      extra["dedup_overflow"] = dict(self.dedup_overflow_totals)
     if not async_:
       path = durable.save_rotating(self.ckpt_root, self.plan, self.rule,
                                    self.state, store=self.store,
@@ -252,6 +306,14 @@ class ResilientTrainer:
               for name, v in metrics["oov"].items()}
     for name, n in counts.items():
       self.oov_totals[name] = self.oov_totals.get(name, 0) + n
+    # dedup_capacity overflow: the counter is the whole point of the
+    # knob being legal (aliased ids must be observable), so it gets the
+    # same treatment as oov — accumulated, summarized, persisted
+    for name, v in metrics.get("dedup_overflow", {}).items():
+      n = int(np.asarray(jax.device_get(v)))
+      if n:
+        self.dedup_overflow_totals[name] = \
+            self.dedup_overflow_totals.get(name, 0) + n
     may_continue = self._bad.update(metrics["bad_step"])
     guards.check_oov(self.plan, counts, where="guarded step")
     if not may_continue:
@@ -274,8 +336,15 @@ class ResilientTrainer:
              "do not resume from it without inspection."), resumed)
 
   def step(self, *batch) -> float:
-    """One guarded step on an already-sharded device batch; returns the
-    loss (NaN on a skipped step — the skip is counted, nothing commits)."""
+    """One guarded step; returns the loss (NaN on a skipped step — the
+    skip is counted, nothing commits).
+
+    Sparse mode: ``batch`` is an already-sharded device batch. Tiered
+    mode (``tiered=``): ``batch`` is the HOST ``(numerical, cats,
+    labels)`` — the classify stage routes the global ids before the
+    device ever sees them."""
+    if self.tiered is not None:
+      return self._step_tiered(*batch)
     self.state, loss, metrics = self._step_fn(self.state, *batch)
     self.consumed += 1
     # ONE host transfer for everything the accounting reads. Fetching
@@ -291,20 +360,67 @@ class ResilientTrainer:
       self.snapshot(async_=self.async_snapshots)
     return loss
 
+  def _step_tiered(self, numerical, cats, labels) -> float:
+    """One guarded TIERED step: the TieredTrainer's prefetch/dispatch/
+    write-back/re-rank protocol with THIS trainer's guard accounting.
+
+    The tiered step returns ``(state, staged_out, metrics, loss)`` with
+    the guard verdict nested next to the tier counters (``metrics =
+    {'tier', 'bad_step', 'oov'[, 'dedup_overflow']}``); ``bad_step`` and
+    ``oov`` are accounted through exactly the same :meth:`_account` path
+    as the sparse step's metrics — same skip counting, same
+    consecutive-bad abort-with-rollback, same ``oov='error'``
+    enforcement. Tier hit bookkeeping (and the ``missed > 0`` prefetch
+    contract) stays with the TieredTrainer (``account_tier``)."""
+    t = self.tiered
+    t.state = self.state
+    staged = t.prefetcher.prepare(cats)
+    staged_out, metrics, loss = t._dispatch(staged, numerical, cats,
+                                            labels)
+    self.consumed += 1
+    loss, metrics, stepped = jax.device_get(
+        (loss, metrics, t.state["step"]))
+
+    def account(m):
+      # tier bookkeeping (hits + missed>0 contract) stays with the
+      # TieredTrainer; the guard verdict/OOV/overflow counters feed THIS
+      # trainer's accounting — same skip counting, consecutive-bad
+      # abort-with-rollback, and oov='error' enforcement as the sparse
+      # path. A skipped tiered batch also left the host images
+      # bit-identical (the guarded step's write-back rewrote unchanged
+      # staging rows), so rollback semantics carry over; on the abort
+      # path _account -> maybe_resume restores the store and refreshes
+      # the prefetcher before raising.
+      t.account_tier(m["tier"])
+      t.steps += 1
+      self._account(m)
+
+    t._finish(staged, staged_out, metrics, account=account)
+    self.state = t.state
+    loss = float(np.asarray(loss))
+    if self.snapshot_every and \
+        int(stepped) - self._last_snapshot >= self.snapshot_every:
+      self.snapshot()
+    return loss
+
   def run(self, batches: Iterable, snapshot_final: bool = False
           ) -> List[float]:
     """Train over host batches of ``(numerical, cats, labels)``.
 
-    Batches are mesh-sharded here (``training.shard_batch``). To resume
-    an interrupted stream, feed the SAME stream minus the first
-    ``trainer.consumed`` batches — the checkpointed stream position,
-    which counts committed AND skipped batches (``step_count`` alone
-    would replay one committed batch per skip that preceded the
-    snapshot)."""
+    Sparse mode shards each batch here (``training.shard_batch``);
+    tiered mode hands the HOST batch to the prefetch protocol, which
+    shards after classification. To resume an interrupted stream, feed
+    the SAME stream minus the first ``trainer.consumed`` batches — the
+    checkpointed stream position, which counts committed AND skipped
+    batches (``step_count`` alone would replay one committed batch per
+    skip that preceded the snapshot)."""
     from ..training import shard_batch
 
     losses = []
     for batch in batches:
+      if self.tiered is not None:
+        losses.append(self.step(*batch))
+        continue
       sb = shard_batch(tuple(batch), self.mesh, self.axis_name)
       losses.append(self.step(*sb))
     self.join_writer()  # a run's last periodic snapshot must be durable
@@ -313,7 +429,7 @@ class ResilientTrainer:
     return losses
 
   def metrics_summary(self) -> Dict[str, Any]:
-    return {
+    out = {
         "steps": self.step_count,
         "consumed": self.consumed,
         "skipped": self.skipped_steps,
@@ -321,3 +437,6 @@ class ResilientTrainer:
         "oov": dict(self.oov_totals),
         "resumed_from": self.resumed_from,
     }
+    if self.dedup_overflow_totals:
+      out["dedup_overflow"] = dict(self.dedup_overflow_totals)
+    return out

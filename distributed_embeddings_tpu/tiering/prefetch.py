@@ -98,6 +98,16 @@ class TieredPrefetcher:
     self.total_host_gather_bytes = 0
     self.spill_steps = 0
 
+  def refresh_resident(self) -> None:
+    """Re-derive the device resident maps from the store.
+
+    Call after anything rewrites the store's resident state OUTSIDE the
+    prefetcher's own re-rank — e.g. a checkpoint restore (auto-resume /
+    rollback): classifying against the pre-restore maps would stage the
+    wrong cold rows and trip the ``missed > 0`` contract."""
+    self._resident_dev = self.store.resident_arrays(self.mesh,
+                                                    self.axis_name)
+
   # ---- classification ----------------------------------------------------
   @staticmethod
   def _input_ids_np(x) -> np.ndarray:

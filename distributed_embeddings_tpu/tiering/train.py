@@ -269,12 +269,14 @@ class TieredTrainer:
     self.steps = 0
     self.bad_steps = 0
     self.oov_totals: Dict[str, int] = {}
+    self.dedup_overflow_totals: Dict[str, int] = {}
 
   # ---- metrics -----------------------------------------------------------
-  def _account(self, metrics: Dict[str, jax.Array]) -> None:
-    # guarded steps nest the tier counters under 'tier' and add the
-    # guard verdict + OOV counters (make_tiered_train_step(guard=True))
-    tier = metrics["tier"] if self.guard else metrics
+  def account_tier(self, tier: Dict[str, jax.Array]) -> None:
+    """Accumulate one step's per-class hit counters and enforce the
+    ``missed > 0`` prefetch contract. Split out of :meth:`_account` so a
+    wrapping trainer (``resilience.ResilientTrainer(tiered=...)``) can
+    own the guard accounting while the tier bookkeeping stays here."""
     for name, m in tier.items():
       m = np.asarray(m, np.int64)
       self.hits[name] += m
@@ -284,6 +286,11 @@ class TieredTrainer:
             "the hot cache nor the staging buffer this step — their "
             "updates were dropped at the sentinel. The prefetch contract "
             "is broken (classify ran against a stale resident map?).")
+
+  def _account(self, metrics: Dict[str, jax.Array]) -> None:
+    # guarded steps nest the tier counters under 'tier' and add the
+    # guard verdict + OOV counters (make_tiered_train_step(guard=True))
+    self.account_tier(metrics["tier"] if self.guard else metrics)
     if self.guard:
       self.bad_steps += int(np.asarray(metrics["bad_step"]))
       # account FIRST, enforce second (ResilientTrainer convention): the
@@ -293,6 +300,13 @@ class TieredTrainer:
                 for name, v in metrics["oov"].items()}
       for name, n in counts.items():
         self.oov_totals[name] = self.oov_totals.get(name, 0) + n
+      # dedup_capacity plans ride their overflow counter here too — the
+      # counter existing is what makes the smaller cap legal at all
+      for name, v in metrics.get("dedup_overflow", {}).items():
+        n = int(np.asarray(v))
+        if n:
+          self.dedup_overflow_totals[name] = \
+              self.dedup_overflow_totals.get(name, 0) + n
       from ..resilience import guards as _guards
       _guards.check_oov(self.tplan.plan, counts,
                         where="guarded tiered step")
@@ -321,6 +335,8 @@ class TieredTrainer:
     if self.guard:
       out["bad_steps"] = self.bad_steps
       out["oov"] = dict(self.oov_totals)
+      if self.dedup_overflow_totals:
+        out["dedup_overflow"] = dict(self.dedup_overflow_totals)
     return out
 
   # ---- stepping ----------------------------------------------------------
@@ -334,9 +350,15 @@ class TieredTrainer:
         self.state, staged.device, *batch)
     return staged_out, metrics, loss
 
-  def _finish(self, staged, staged_out, metrics):
+  def _finish(self, staged, staged_out, metrics, account=None):
+    """The post-dispatch protocol tail: write-back, accounting, re-rank
+    — in that order (the accounting may raise, e.g. oov='error', and
+    must do so with the write-back landed but before the re-rank).
+    ``account`` overrides the accounting stage so a wrapping trainer
+    (``resilience.ResilientTrainer(tiered=...)``) can own the guard
+    bookkeeping without duplicating this sequence."""
     self.prefetcher.write_back(staged, staged_out)  # syncs on the device
-    self._account(metrics)
+    (account or self._account)(metrics)
     self.state["fused"] = self.prefetcher.maybe_rerank(self.state["fused"])
 
   def step(self, numerical, cats, labels) -> float:

@@ -246,6 +246,14 @@ def make_train_step(loss_fn: Callable,
         "has no OOV metrics, so out-of-range ids would be silently "
         "clipped — the policy's failure mode. Use the guarded sparse "
         "step, or oov='clip'.")
+  if plan is not None and getattr(plan, "dedup_capacity", None) is not None:
+    raise NotImplementedError(
+        "plan.dedup_capacity caps the dedup'd exchange's unique blocks "
+        "below their safe bound, which is only legal next to the overflow "
+        "counter that makes aliasing observable — this dense-autodiff "
+        "builder has no metrics path. Use "
+        "make_sparse_train_step(guard=True) (psum'd 'dedup_overflow' "
+        "metric) or drop the capacity override.")
   dist_opt = DistributedOptimizer(optimizer, axis_name=axis_name) if mesh \
       else optimizer
   reg_fn = plan_regularizer_fn(plan) if plan is not None else None
@@ -617,8 +625,11 @@ def _make_guard_helpers(plan: DistEmbeddingStrategy, mesh, axis_name: str):
     batch carrying ANY out-of-range id commits nothing, so the host-side
     ``check_oov`` raise fires with the state bit-identical to before the
     batch.
-  - ``guard_metrics(ok, oov)``: the replicated ``{'bad_step', 'oov'}``
-    metrics dict (counters psum'd across the mesh).
+  - ``guard_metrics(ok, oov, overflow=None)``: the replicated
+    ``{'bad_step', 'oov'}`` metrics dict (counters psum'd across the
+    mesh); with ``overflow`` (per-class dedup-capacity overflow counts —
+    plans with ``dedup_capacity`` set) a psum'd ``'dedup_overflow'``
+    entry joins it.
   """
   from .resilience import guards as _guards
   oov_is_error = getattr(plan, "oov", "clip") == "error"
@@ -639,10 +650,16 @@ def _make_guard_helpers(plan: DistEmbeddingStrategy, mesh, axis_name: str):
     total = sum(jnp.asarray(c, jnp.int32) for c in oov.values())
     return total == 0
 
-  def guard_metrics(ok, oov):
+  def guard_metrics(ok, oov, overflow=None):
     if mesh is not None:
       oov = {n: jax.lax.psum(c, axis_name) for n, c in oov.items()}
-    return {"bad_step": 1 - ok.astype(jnp.int32), "oov": oov}
+      if overflow is not None:
+        overflow = {n: jax.lax.psum(c, axis_name)
+                    for n, c in overflow.items()}
+    out = {"bad_step": 1 - ok.astype(jnp.int32), "oov": oov}
+    if overflow is not None:
+      out["dedup_overflow"] = overflow
+    return out
 
   return guard_gate, oov_ok, guard_metrics
 
@@ -747,10 +764,20 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
     raise ValueError(
         "exact=True requires wire_dtype='f32': the exact path reproduces "
         "the reference's deduplicated backward bit-for-bit, and a "
-        "bf16-narrowed cotangent exchange breaks that claim before the "
-        "sort ever runs. Build the plan with wire_dtype='f32' (the "
-        "dedup_exchange knob composes with exact fine — it only changes "
-        "which ids reach the mp side, and they arrive f32-backed).")
+        "bf16/fp8-narrowed cotangent exchange breaks that claim before "
+        "the sort ever runs. Build the plan with wire_dtype='f32' (the "
+        "dedup_exchange and overlap='pipelined' knobs compose with exact "
+        "fine — dedup only changes which ids reach the mp side, and the "
+        "pipelined f32 wire is bit-exact pure data movement).")
+  has_dedup_cap = getattr(plan, "dedup_capacity", None) is not None
+  if has_dedup_cap and not guard:
+    raise ValueError(
+        "plan.dedup_capacity requires make_sparse_train_step(guard=True): "
+        "a capacity below the safe bound aliases distinct ids onto the "
+        "cap's last slot — those occurrences gather and UPDATE the wrong "
+        "rows — and only the guarded step surfaces the psum'd "
+        "'dedup_overflow' counter that makes that observable. Build with "
+        "guard=True or drop the capacity override.")
   oov_is_error = getattr(plan, "oov", "clip") == "error"
   if oov_is_error and not guard:
     raise ValueError(
@@ -831,6 +858,10 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
       carry = jax.tree_util.tree_map(
           jnp.add, (dd_acc, de_acc, loss_acc),
           (dd, de, loss_i / n_mb))
+      if has_dedup_cap:
+        # per-micro-batch overflow counts ride the scan outputs and sum
+        # below (each micro-batch routes its own capped unique blocks)
+        return carry, (streams_i, engine.dedup_overflow_counts(ids_all))
       return carry, streams_i
 
     init = jax.tree_util.tree_map(
@@ -838,8 +869,13 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
         (state["dense"], state["emb_dense"])) + (vz0,)
     mb_batches = (mb_view(numerical), tuple(mb_view(c) for c in cats),
                   mb_view(labels))
-    (d_dense, d_emb_dense, loss), streams_s = jax.lax.scan(
+    (d_dense, d_emb_dense, loss), scan_out = jax.lax.scan(
         body, init, mb_batches)
+    if has_dedup_cap:
+      streams_s, ovf_s = scan_out
+      ovf = {n: jnp.sum(v).astype(jnp.int32) for n, v in ovf_s.items()}
+    else:
+      streams_s, ovf = scan_out, None
     # flatten the stacked [n_mb, ...] streams and scatter once per class
     streams = {name: (ids.reshape(-1), rows.reshape(-1, rows.shape[-1]))
                for name, (ids, rows) in streams_s.items()}
@@ -890,7 +926,7 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
         "step": state["step"] + (ok.astype(jnp.int32) if guard else 1),
     }
     if guard:
-      return new_state, loss, _guard_metrics(ok, oov)
+      return new_state, loss, _guard_metrics(ok, oov, ovf)
     return new_state, loss
 
   def local_step(state, numerical, cats, labels):
@@ -934,6 +970,7 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
 
     if guard:
       oov = engine.oov_counts(cats)
+      ovf = engine.dedup_overflow_counts(ids_all) if has_dedup_cap else None
       streams = engine.sparse_delta_streams(layouts, d_z, residuals, rule,
                                             state["step"])
       ok, streams = _guard_gate(loss, grads_chk, streams, _oov_ok(oov))
@@ -954,7 +991,7 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
           # step sequence as a run that never met the poison batch
           "step": state["step"] + ok.astype(jnp.int32),
       }
-      return new_state, loss, _guard_metrics(ok, oov)
+      return new_state, loss, _guard_metrics(ok, oov, ovf)
 
     fused = engine.apply_sparse(state["fused"], layouts, d_z, residuals,
                                 rule, state["step"], exact=exact)
@@ -978,11 +1015,15 @@ def make_sparse_train_step(model, plan: DistEmbeddingStrategy,
       lambda _: P(axis_name), tuple(batch_example))
   out_specs = (sspec, P())
   if guard:
-    # metrics are replicated scalars (bad_step after the pmin, oov after
-    # the psum)
-    out_specs = (sspec, P(), {
+    # metrics are replicated scalars (bad_step after the pmin, oov and
+    # dedup_overflow after their psums)
+    mspec = {
         "bad_step": P(),
-        "oov": {class_param_name(*k): P() for k in plan.class_keys}})
+        "oov": {class_param_name(*k): P() for k in plan.class_keys}}
+    if has_dedup_cap:
+      mspec["dedup_overflow"] = {
+          class_param_name(*k): P() for k in plan.class_keys}
+    out_specs = (sspec, P(), mspec)
   sharded = shard_map(
       step_fn, mesh=mesh,
       in_specs=(sspec,) + bspec,
@@ -1070,8 +1111,17 @@ def make_tiered_train_step(model, tplan, loss_fn: Callable,
     raise ValueError(
         "exact=True requires wire_dtype='f32' (same contract as "
         "make_sparse_train_step): the deduplicated backward's bit-for-bit "
-        "claim cannot survive a bf16-narrowed cotangent exchange. Build "
-        "the plan with wire_dtype='f32'.")
+        "claim cannot survive a bf16/fp8-narrowed cotangent exchange. "
+        "Build the plan with wire_dtype='f32'.")
+  has_dedup_cap = getattr(plan, "dedup_capacity", None) is not None
+  if has_dedup_cap and not guard:
+    raise ValueError(
+        "plan.dedup_capacity requires make_tiered_train_step(guard=True): "
+        "a capacity below the safe bound aliases distinct ids onto the "
+        "cap's last slot — those occurrences gather and UPDATE the wrong "
+        "rows — and only the guarded step surfaces the psum'd "
+        "'dedup_overflow' counter that makes that observable. Build with "
+        "guard=True or drop the capacity override.")
   # same penalty limits as make_sparse_train_step's fused path (and for
   # host-tier tables there is no dense-autodiff fallback at all)
   rule, reg_fn, con_fn = _fused_rule_and_penalties(plan, rule)
@@ -1134,6 +1184,7 @@ def make_tiered_train_step(model, tplan, loss_fn: Callable,
 
     if guard:
       oov = engine.oov_counts(cats)
+      ovf = engine.dedup_overflow_counts(ids_all) if has_dedup_cap else None
       streams = engine.sparse_delta_streams(layouts, d_z, residuals, rule,
                                             state["step"])
       ok, streams = _guard_gate(loss, grads_chk, streams, _oov_ok(oov))
@@ -1163,7 +1214,7 @@ def make_tiered_train_step(model, tplan, loss_fn: Callable,
         "step": state["step"] + (ok.astype(jnp.int32) if guard else 1),
     }
     if guard:
-      metrics = {"tier": tier_metrics, **_guard_metrics(ok, oov)}
+      metrics = {"tier": tier_metrics, **_guard_metrics(ok, oov, ovf)}
       return new_state, staged_out, metrics, loss
     return new_state, staged_out, tier_metrics, loss
 
@@ -1184,6 +1235,9 @@ def make_tiered_train_step(model, tplan, loss_fn: Callable,
         "tier": metrics_spec,
         "bad_step": P(),
         "oov": {class_param_name(*k): P() for k in plan.class_keys}}
+    if has_dedup_cap:
+      metrics_spec["dedup_overflow"] = {
+          class_param_name(*k): P() for k in plan.class_keys}
   sharded = shard_map(
       local_step, mesh=mesh,
       in_specs=(sspec, staged_specs) + bspec,
@@ -1210,8 +1264,20 @@ def make_sparse_eval_step(model, plan: DistEmbeddingStrategy,
   {'oov': {class_name: int32 count}}`` — the per-class out-of-vocabulary
   occurrence counters the guarded TRAIN step already surfaces, now on the
   serving/eval path too (the plan's ``oov='clip'`` policy stays silent
-  without them). Counters are global (psum'd across the mesh) replicated
+  without them). Plans with ``dedup_capacity`` set add a
+  ``'dedup_overflow'`` dict (distinct ids aliased past the capped unique
+  capacity — those predictions read the wrong rows) and REQUIRE
+  ``with_metrics`` here, for the same reason the train builders require
+  the guard. Counters are global (psum'd across the mesh) replicated
   scalars; one compare+reduce per input, fused into the step."""
+  has_dedup_cap = getattr(plan, "dedup_capacity", None) is not None
+  if has_dedup_cap and not with_metrics:
+    raise ValueError(
+        "plan.dedup_capacity requires make_sparse_eval_step("
+        "with_metrics=True): a capacity below the safe bound aliases "
+        "distinct ids onto the cap's last slot — those predictions read "
+        "the WRONG rows — and only the metrics path surfaces the psum'd "
+        "'dedup_overflow' counter that makes that observable.")
   engine = DistributedLookup(plan, dp_input=True, axis_name=axis_name)
   layouts = engine.fused_layouts(rule)
 
@@ -1231,7 +1297,13 @@ def make_sparse_eval_step(model, plan: DistEmbeddingStrategy,
     oov = engine.oov_counts(cats)
     if mesh is not None:
       oov = {n: jax.lax.psum(c, axis_name) for n, c in oov.items()}
-    return preds, {"oov": oov}
+    metrics = {"oov": oov}
+    if has_dedup_cap:
+      ovf = engine.dedup_overflow_counts(ids_all)
+      if mesh is not None:
+        ovf = {n: jax.lax.psum(c, axis_name) for n, c in ovf.items()}
+      metrics["dedup_overflow"] = ovf
+    return preds, metrics
 
   if mesh is None:
     return jax.jit(local_eval)
@@ -1240,8 +1312,11 @@ def make_sparse_eval_step(model, plan: DistEmbeddingStrategy,
       lambda _: P(axis_name), tuple(batch_example[:2]))
   out_specs = P(axis_name)
   if with_metrics:
-    out_specs = (P(axis_name), {
-        "oov": {class_param_name(*k): P() for k in plan.class_keys}})
+    mspec = {"oov": {class_param_name(*k): P() for k in plan.class_keys}}
+    if has_dedup_cap:
+      mspec["dedup_overflow"] = {
+          class_param_name(*k): P() for k in plan.class_keys}
+    out_specs = (P(axis_name), mspec)
   return jax.jit(shard_map(
       local_eval, mesh=mesh,
       in_specs=(sspec,) + bspec,

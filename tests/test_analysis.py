@@ -238,6 +238,40 @@ def make_eval_step(plan):
   assert lint_source(src, "m.py", CTX, ["GL109"]) == []
 
 
+def test_gl109_raw_ppermute_in_step_builder():
+  """The round-7 extension: ppermute joined the guarded exchange set —
+  a raw round in step code bypasses the wire knobs and the audit's
+  (world-1) x chunks pins exactly like a raw all_to_all."""
+  src = """
+def make_sparse_train_step(plan):
+  def local_step(state, batch):
+    return lax.ppermute(batch, "mp", [(0, 1), (1, 0)])
+  return local_step
+"""
+  out = lint_source(src, "m.py", CTX, ["GL109"])
+  assert _rules(out) == ["GL109"]
+  assert "ppermute" in out[0].message
+  # the sanctioned wire module stays exempt; library modules covered
+  wire_path = "distributed_embeddings_tpu/parallel/wire.py"
+  assert lint_source(src, wire_path, CTX, ["GL109"]) == []
+  host = """
+def shuffle(x):
+  return lax.ppermute(x, "mp", [(0, 1), (1, 0)])
+"""
+  assert lint_source(host, "m.py", CTX, ["GL109"]) == []
+  assert _rules(lint_source(
+      host, "distributed_embeddings_tpu/parallel/lookup_engine.py", CTX,
+      ["GL109"])) == ["GL109"]
+  # suppression works for the ppermute form too
+  sup = """
+def make_eval_step(plan):
+  def local_eval(state, batch):
+    return lax.ppermute(batch, "mp", [(0, 1)])  # graftlint: disable=GL109
+  return local_eval
+"""
+  assert lint_source(sup, "m.py", CTX, ["GL109"]) == []
+
+
 def test_gl108_unknown_fault_site():
   src = """
 def chaos(inj):
@@ -415,6 +449,44 @@ def test_all_to_all_count_per_mode(artifacts):
   n_plain = summarize(artifacts["sparse_step"][0]).counts["all_to_all"]
   n_wire = summarize(artifacts["sparse_step_wire"][0]).counts["all_to_all"]
   assert n_plain == n_wire
+
+
+def test_ppermute_rounds_per_pipelined_mode(artifacts):
+  """Round-7 pins: each pipelined artifact flies ZERO all_to_alls and
+  exactly ``3 buckets x (world-1) x chunks`` ppermute rounds, with every
+  float round payload in the mode's wire dtype (the fp8 artifact's
+  blocks really are float8_e4m3 on the wire — scales ride inside them);
+  monolithic artifacts fly zero ppermutes."""
+  for wname, dtype in (("f32", "float32"), ("bf16", "bfloat16"),
+                       ("fp8", "float8_e4m3fn")):
+    name = f"sparse_step_pipe_{wname}"
+    jaxpr, expect = artifacts[name]
+    s = summarize(jaxpr)
+    assert audit_summary(name, s, expect) == []
+    assert s.counts.get("all_to_all", 0) == 0, name
+    assert expect.ppermute_count and \
+        s.counts.get("ppermute", 0) == expect.ppermute_count, name
+    floats = [d for d in s.ppermute_dtypes if "float" in d]
+    assert floats and set(floats) == {dtype}, (name, s.ppermute_dtypes)
+    ints = [d for d in s.ppermute_dtypes if "int" in d]
+    assert ints and set(ints) == {"int32"}, (name, s.ppermute_dtypes)
+  for name in ("sparse_step", "sparse_step_guard", "sparse_step_wire",
+               "eval_step", "tiered_step", "tiered_step_guard"):
+    assert summarize(artifacts[name][0]).counts.get("ppermute", 0) == 0, \
+        name
+
+
+def test_audit_flags_ppermute_round_drift(artifacts):
+  """A drifting round count (a chunk falling out of — or sneaking into
+  — the schedule) must be a named violation."""
+  name = "sparse_step_pipe_f32"
+  jaxpr, expect = artifacts[name]
+  s = summarize(jaxpr)
+  import dataclasses
+  bad = dataclasses.replace(expect,
+                            ppermute_count=expect.ppermute_count + 3)
+  out = audit_summary(name, s, bad)
+  assert len(out) == 1 and "ppermute round" in out[0]
 
 
 def test_audit_flags_wire_violations():

@@ -624,6 +624,108 @@ def test_host_gather_retries_exhausted_raises():
 
 
 # ---------------------------------------------------------------------------
+# ResilientTrainer drives TIERED steps (ROADMAP carried follow-on)
+# ---------------------------------------------------------------------------
+
+
+def test_resilient_trainer_drives_tiered_steps(tmp_path):
+  """The trainer accepts the tiered step's return shape and nested
+  metrics dict: bad_step/oov are accounted exactly like the sparse
+  step's (skip counting, consumed-stream position), snapshots flush and
+  checkpoint the host-tier store, and a fresh process auto-resumes —
+  with the prefetcher's resident maps refreshed — to a bit-exact tail
+  trajectory."""
+  from distributed_embeddings_tpu.layers.embedding import TableConfig
+  from distributed_embeddings_tpu.models.dlrm import _dlrm_initializer
+  from distributed_embeddings_tpu.models.synthetic import power_law_ids
+  from distributed_embeddings_tpu.tiering import (
+      HostTierStore,
+      TieredTrainer,
+      TieringConfig,
+      TieringPlan,
+      init_tiered_state,
+  )
+
+  vocab = [5000, 300, 40]
+  mesh = create_mesh(WORLD)
+  plan = DistEmbeddingStrategy(
+      [TableConfig(input_dim=v, output_dim=16,
+                   initializer=_dlrm_initializer(v)) for v in vocab],
+      WORLD, "memory_balanced", dense_row_threshold=0,
+      host_row_threshold=1000)
+  model = DLRM(vocab_sizes=vocab, embedding_dim=16, bottom_mlp=(32, 16),
+               top_mlp=(32, 1), world_size=WORLD,
+               strategy="memory_balanced", dense_row_threshold=0)
+  rule = sparse_rule("adagrad", 0.05)
+  opt = optax.adam(1e-3)
+  cfg = TieringConfig(cache_fraction=0.3, staging_grps=64,
+                      rerank_interval=3)
+
+  def make_batch(seed):
+    r = np.random.default_rng(seed)
+    numerical = r.standard_normal((32, 13)).astype(np.float32)
+    cats = [power_law_ids(r, 32, 1, v, 1.05)[:, 0].astype(np.int32)
+            for v in vocab]
+    labels = r.integers(0, 2, 32).astype(np.float32)
+    return numerical, cats, labels
+
+  batch0 = make_batch(0)
+
+  def fresh(seed):
+    tplan = TieringPlan(plan, rule, cfg)
+    store = HostTierStore(tplan)
+    params = model.init(jax.random.PRNGKey(0), batch0[0],
+                        batch0[1])["params"]
+    dense = {k: v for k, v in params.items() if k != "embeddings"}
+    state = shard_params(
+        init_tiered_state(tplan, store, rule, dense, opt,
+                          jax.random.PRNGKey(seed), mesh=mesh), mesh)
+    tt = TieredTrainer(model, tplan, store, bce_loss, opt, rule, mesh,
+                       state, batch0, donate=False, guard=True)
+    return ResilientTrainer(None, None, plan, rule,
+                            os.path.join(tmp_path, "ck"), mesh=mesh,
+                            snapshot_every=2, tiered=tt)
+
+  # an unguarded tiered trainer is refused up front
+  tplan_u = TieringPlan(plan, rule, cfg)
+  store_u = HostTierStore(tplan_u)
+  params = model.init(jax.random.PRNGKey(0), batch0[0], batch0[1])["params"]
+  dense_u = {k: v for k, v in params.items() if k != "embeddings"}
+  state_u = shard_params(
+      init_tiered_state(tplan_u, store_u, rule, dense_u, opt,
+                        jax.random.PRNGKey(1), mesh=mesh), mesh)
+  tt_u = TieredTrainer(model, tplan_u, store_u, bce_loss, opt, rule, mesh,
+                       state_u, batch0, donate=False, guard=False)
+  with pytest.raises(ValueError, match="guard=True"):
+    ResilientTrainer(None, None, plan, rule, os.path.join(tmp_path, "x"),
+                     mesh=mesh, tiered=tt_u)
+
+  batches = [make_batch(100 + i) for i in range(6)]
+  bad = list(batches[3])
+  bad[2] = np.full_like(bad[2], np.nan)  # poison labels -> NaN loss
+  batches[3] = tuple(bad)
+
+  tr = fresh(7)
+  losses = tr.run(batches)
+  # the poison batch skipped: counted, nothing committed, stream moved on
+  assert not np.isfinite(losses[3])
+  assert tr.step_count == 5
+  assert tr.skipped_steps == 1
+  assert tr.consumed == 6  # == step_count + skipped_steps
+  assert tr.tiered.hit_rate() > 0.5  # tier bookkeeping still accumulates
+
+  # a fresh process (different init seed — must be overwritten by the
+  # restore) resumes at the last snapshot and replays the tail
+  # bit-exactly, skip accounting included
+  tr2 = fresh(99)
+  assert tr2.resumed_from is not None
+  assert tr2.consumed == tr2.step_count + tr2.skipped_steps
+  start = tr2.consumed
+  tail = tr2.run(batches[start:])
+  np.testing.assert_allclose(tail, losses[start:], rtol=0, atol=0)
+
+
+# ---------------------------------------------------------------------------
 # Chaos harness (tools/chaos_train.py): long variant is slow-marked so
 # tier-1 stays fast; `make chaos` runs the short standalone form
 # ---------------------------------------------------------------------------

@@ -1,4 +1,4 @@
-"""Exchange parity suite for the round-6 wire knobs.
+"""Exchange parity suite for the round-6 and round-7 wire knobs.
 
 ``DistEmbeddingStrategy(wire_dtype=..., dedup_exchange=...)`` compresses
 the dp<->mp exchanges; this file pins what each knob may and may not
@@ -25,6 +25,22 @@ change:
   fp-sum slack; the tests assert a 2x margin (``h * 2^-8 * max|row|``).
 - ``exact=True`` demands the f32 wire at build time (sparse AND tiered
   builders), and knob validation/reporting behaves.
+
+Round-7 additions (``overlap='pipelined'``, ``exchange_chunks``,
+``wire_dtype='fp8'``, ``dedup_capacity``):
+
+- the pipelined f32 exchange is BIT-EXACT against the monolithic wire
+  across the whole parity matrix — raw and dedup'd routing, ragged and
+  row-sliced shards, micro-batch and guarded steps, world 1/2/4,
+  including chunk counts that do not divide the payload (the rounds are
+  pure data movement: a roll, (world-1) x chunks ppermutes, a gather);
+- the fp8 wire's error bound is the bf16 bound's analog with the e4m3
+  half-ulp (2^-4 relative for normals, per-block amax scaling):
+  ``|err| <= h * 2^-4 * max|row|`` per output element, asserted at a 2x
+  margin (``h * 2^-3``);
+- ``dedup_capacity`` (a cap below the safe unique bound) is refused by
+  every builder without a counter path, and the guarded/with-metrics
+  paths report the psum'd per-class distinct-overflow count.
 """
 
 import jax
@@ -81,9 +97,13 @@ CFG = SyntheticModelConfig(
 # ---------------------------------------------------------------------------
 
 
-def _forward_outs(plan, params, inputs, in_specs=None):
+def _forward_outs(plan, params, inputs, in_specs=None, world=WORLD):
   engine = DistributedLookup(plan)
-  mesh = create_mesh(WORLD)
+  if world == 1:
+    outs = jax.jit(lambda p, *xs: tuple(engine.forward(p, list(xs))))(
+        params, *inputs)
+    return [np.asarray(o) for o in outs]
+  mesh = create_mesh(world)
   pspecs = {n: P("mp", None) for n in params}
 
   def fwd(params, *xs):
@@ -97,10 +117,10 @@ def _forward_outs(plan, params, inputs, in_specs=None):
   return [np.asarray(o) for o in outs]
 
 
-def _mixed_fixture(combiner, rng, **plan_kw):
+def _mixed_fixture(combiner, rng, world=WORLD, **plan_kw):
   sizes = [50, 80, 23, 31, 47, 19, 27, 35, 41]
   tables = [TableConfig(s, 16, combiner=combiner) for s in sizes]
-  plan = DistEmbeddingStrategy(tables, WORLD, "memory_balanced",
+  plan = DistEmbeddingStrategy(tables, world, "memory_balanced",
                                dense_row_threshold=0, **plan_kw)
   weights = [rng.standard_normal((s, 16)).astype(np.float32) for s in sizes]
   params = {k: jnp.asarray(v)
@@ -128,7 +148,9 @@ def test_forward_bitexact_f32_dedup(combiner):
     np.testing.assert_array_equal(a, b, err_msg=f"table {t}")
 
 
-def test_forward_bitexact_f32_dedup_row_sliced():
+@pytest.mark.parametrize("pipe_kw", [
+    {}, {"overlap": "pipelined", "exchange_chunks": 3}])
+def test_forward_bitexact_f32_dedup_row_sliced(pipe_kw):
   rng = np.random.default_rng(1)
   sizes = [96, 64, 48, 40, 88, 56, 72, 104]
   tables = [TableConfig(s, 8, combiner="mean") for s in sizes]
@@ -146,7 +168,7 @@ def test_forward_bitexact_f32_dedup_row_sliced():
   rng = np.random.default_rng(1)
   plan_a, params_a = build()
   rng = np.random.default_rng(1)
-  plan_b, params_b = build(dedup_exchange=True)
+  plan_b, params_b = build(dedup_exchange=True, **pipe_kw)
   b = 2 * WORLD
   ids = [rng.integers(0, s, (b, 3)).astype(np.int32) for s in sizes]
   for x in ids:
@@ -158,10 +180,14 @@ def test_forward_bitexact_f32_dedup_row_sliced():
     np.testing.assert_array_equal(a, b_, err_msg=f"table {t}")
 
 
-def test_forward_bitexact_f32_dedup_ragged():
+@pytest.mark.parametrize("pipe_kw", [
+    {}, {"overlap": "pipelined", "exchange_chunks": 5}])
+def test_forward_bitexact_f32_dedup_ragged(pipe_kw):
   """A ragged input rides the raw value-stream exchange even under
   ``dedup_exchange=True`` (there is nothing padded to dedup), while the
-  plan's other (padded) buckets dedup — the mix must be bit-exact."""
+  plan's other (padded) buckets dedup — the mix must be bit-exact. The
+  pipelined variant chunks the value-stream AND unique-block wires with
+  a count that divides neither."""
   rng = np.random.default_rng(2)
   tables = [TableConfig(60, 8, combiner="sum"),
             TableConfig(40, 8, combiner="sum")]
@@ -178,7 +204,7 @@ def test_forward_bitexact_f32_dedup_ragged():
   rng = np.random.default_rng(2)
   plan_a, params_a = build()
   rng = np.random.default_rng(2)
-  plan_b, params_b = build(dedup_exchange=True)
+  plan_b, params_b = build(dedup_exchange=True, **pipe_kw)
 
   b_local, cap = 4, 16
   values = rng.integers(0, 60, WORLD * cap).astype(np.int32)
@@ -424,6 +450,299 @@ def test_exchange_report():
   rep1 = DistEmbeddingStrategy([TableConfig(100, 8)], 1,
                                dedup_exchange=True).exchange_report()
   assert not any(c["dedup"] for c in rep1["classes"].values())
+
+
+# ---------------------------------------------------------------------------
+# round 7: pipelined (chunked ppermute) exchange — bit-exact parity matrix
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("world,chunks", [
+    (1, 2),   # no wire: knobs must be inert, not crash
+    (2, 2),
+    (4, 1),   # pure ppermute rewrite, no chunking
+    (4, 2),
+    (4, 3),   # does not divide the per-destination payload
+    (4, 5),   # exceeds some buckets' column counts (all-padding chunks)
+])
+@pytest.mark.parametrize("combiner", ["sum", "mean"])
+def test_pipelined_f32_forward_bitexact(combiner, world, chunks):
+  """The pipelined f32 exchange is pure data movement: forward outputs
+  must equal the monolithic wire's TO THE BIT, at every world size and
+  for chunk counts that do not divide the payload."""
+  rng = np.random.default_rng(10)
+  plan_a, params_a, inputs_a = _mixed_fixture(combiner, rng, world=world)
+  rng = np.random.default_rng(10)
+  plan_b, params_b, inputs_b = _mixed_fixture(
+      combiner, rng, world=world, overlap="pipelined",
+      exchange_chunks=chunks)
+  out_a = _forward_outs(plan_a, params_a, inputs_a, world=world)
+  out_b = _forward_outs(plan_b, params_b, inputs_b, world=world)
+  for t, (a, b) in enumerate(zip(out_a, out_b)):
+    np.testing.assert_array_equal(a, b, err_msg=f"table {t}")
+
+
+def test_pipelined_f32_dedup_forward_bitexact():
+  """Pipelined x dedup'd routing: the unique blocks and their return
+  rows ride the ppermute pipeline — still bit-exact vs the raw
+  monolithic exchange."""
+  rng = np.random.default_rng(11)
+  plan_a, params_a, inputs_a = _mixed_fixture("mean", rng)
+  rng = np.random.default_rng(11)
+  plan_b, params_b, inputs_b = _mixed_fixture(
+      "mean", rng, dedup_exchange=True, overlap="pipelined",
+      exchange_chunks=3)
+  out_a = _forward_outs(plan_a, params_a, inputs_a)
+  out_b = _forward_outs(plan_b, params_b, inputs_b)
+  for t, (a, b) in enumerate(zip(out_a, out_b)):
+    np.testing.assert_array_equal(a, b, err_msg=f"table {t}")
+
+
+def test_pipelined_train_eval_bitexact():
+  """Full fused train steps under the pipelined f32 wire: losses, eval
+  predictions AND final packed tables are bit-identical to the
+  monolithic wire's — the reverse cotangent pipeline (custom_vjp) must
+  deliver exactly the same bits too."""
+  la, pa, para = _run_steps("adagrad")
+  lb, pb, parb = _run_steps("adagrad", overlap="pipelined",
+                            exchange_chunks=2)
+  assert la == lb
+  np.testing.assert_array_equal(pa, pb)
+  for k in para["embeddings"]:
+    np.testing.assert_array_equal(np.asarray(para["embeddings"][k]),
+                                  np.asarray(parb["embeddings"][k]),
+                                  err_msg=k)
+
+
+def test_pipelined_dedup_train_bitexact():
+  la, pa, _ = _run_steps("adagrad", dedup_exchange=True)
+  lb, pb, _ = _run_steps("adagrad", dedup_exchange=True,
+                         overlap="pipelined", exchange_chunks=3)
+  assert la == lb
+  np.testing.assert_array_equal(pa, pb)
+
+
+def test_pipelined_micro_batch_bitexact():
+  la, pa, para = _run_steps("adagrad", step_kw={"micro_batches": 2})
+  lb, pb, parb = _run_steps("adagrad", step_kw={"micro_batches": 2},
+                            overlap="pipelined", exchange_chunks=2)
+  assert la == lb
+  np.testing.assert_array_equal(pa, pb)
+  for k in para["embeddings"]:
+    np.testing.assert_array_equal(np.asarray(para["embeddings"][k]),
+                                  np.asarray(parb["embeddings"][k]),
+                                  err_msg=k)
+
+
+def test_pipelined_guarded_step_skips_poison_batch():
+  """The guard composes with the pipelined wire: a poison batch commits
+  nothing (state bit-identical), and good steps equal the monolithic
+  guarded step's."""
+  model, plan, rule, opt, state, bt, mesh = _fused_setup(
+      "adagrad", dedup_exchange=True, overlap="pipelined",
+      exchange_chunks=2)
+  step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
+                                state, bt, donate=False, guard=True)
+  state1, loss, metrics = step(state, *bt)
+  assert int(metrics["bad_step"]) == 0
+  bad_labels = jnp.full_like(bt[2], jnp.nan)
+  state2, loss2, metrics2 = step(state1, bt[0], bt[1], bad_labels)
+  assert int(metrics2["bad_step"]) == 1
+  before = jax.device_get(state1)
+  after = jax.device_get(state2)
+  for name in before["fused"]:
+    np.testing.assert_array_equal(np.asarray(before["fused"][name]),
+                                  np.asarray(after["fused"][name]))
+  assert int(after["step"]) == int(before["step"])
+
+
+def test_pipelined_exact_composes_f32():
+  """exact=True + pipelined f32: the bit-for-bit dedup'd backward claim
+  survives a pure-data-movement rewrite, so the builder accepts it."""
+  model, plan, rule, opt, state, bt, mesh = _fused_setup(
+      "adagrad", overlap="pipelined", exchange_chunks=2)
+  step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
+                                state, bt, donate=False, exact=True)
+  _, loss = step(state, *bt)
+  assert np.isfinite(float(loss))
+
+
+# ---------------------------------------------------------------------------
+# round 7: fp8 wire — error bound, scale shipping, composition
+# ---------------------------------------------------------------------------
+
+
+def test_forward_fp8_wire_tolerance_bound():
+  """The fp8 analog of the documented bf16 bound: float8_e4m3 carries 3
+  mantissa bits (half-ulp 2^-4 relative for normals) and the per-block
+  amax scaling keeps every element in the normal range relative to the
+  block's max, so one exchange round-trip bounds each output element by
+  ``h * 2^-4 * max|row|``; asserted with a 2x margin (h * 2^-3)."""
+  rng = np.random.default_rng(12)
+  plan_a, params, inputs = _mixed_fixture("sum", rng)
+  rng = np.random.default_rng(12)
+  plan_b, params_b, inputs_b = _mixed_fixture("sum", rng,
+                                              wire_dtype="fp8")
+  out_a = _forward_outs(plan_a, params, inputs)
+  out_b = _forward_outs(plan_b, params_b, inputs_b)
+  h = 3
+  for t, (a, b) in enumerate(zip(out_a, out_b)):
+    bound = h * 2.0 ** -3 * np.abs(a).max() + 1e-6
+    assert np.abs(a - b).max() <= bound, (t, np.abs(a - b).max(), bound)
+    assert np.abs(a - b).max() > 0  # the wire really narrowed something
+
+
+def test_fp8_pipelined_matches_monolithic_one_chunk():
+  """With one chunk the pipelined fp8 wire quantizes over exactly the
+  blocks the monolithic wire does (same per-destination amax), so the
+  two schedules must agree to the bit."""
+  rng = np.random.default_rng(13)
+  plan_a, params_a, inputs_a = _mixed_fixture("sum", rng,
+                                              wire_dtype="fp8")
+  rng = np.random.default_rng(13)
+  plan_b, params_b, inputs_b = _mixed_fixture(
+      "sum", rng, wire_dtype="fp8", overlap="pipelined",
+      exchange_chunks=1)
+  out_a = _forward_outs(plan_a, params_a, inputs_a)
+  out_b = _forward_outs(plan_b, params_b, inputs_b)
+  for t, (a, b) in enumerate(zip(out_a, out_b)):
+    np.testing.assert_array_equal(a, b, err_msg=f"table {t}")
+
+
+def test_train_fp8_pipelined_dedup_converges_close():
+  """The full composition — fp8 wire x dedup'd routing x chunked
+  pipeline — trains within a loose tolerance of the f32 seed path (the
+  fp8 wire is a serving/throughput knob, not a precision claim)."""
+  la, _, _ = _run_steps("sgd")
+  lb, _, _ = _run_steps("sgd", wire_dtype="fp8", dedup_exchange=True,
+                        overlap="pipelined", exchange_chunks=2)
+  assert all(np.isfinite(lb))
+  np.testing.assert_allclose(la, lb, rtol=0, atol=5e-2)
+
+
+def test_exact_rejects_fp8_wire():
+  model, plan, rule, opt, state, bt, mesh = _fused_setup(
+      "adagrad", wire_dtype="fp8")
+  with pytest.raises(ValueError, match="wire_dtype='f32'"):
+    make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh, state,
+                           bt, donate=False, exact=True)
+
+
+# ---------------------------------------------------------------------------
+# round 7: knob validation + reporting
+# ---------------------------------------------------------------------------
+
+
+def test_overlap_knob_validation():
+  with pytest.raises(ValueError, match="overlap"):
+    DistEmbeddingStrategy([TableConfig(8, 4)], 1, overlap="async")
+  with pytest.raises(ValueError, match="exchange_chunks"):
+    DistEmbeddingStrategy([TableConfig(8, 4)], 1, exchange_chunks=0)
+  # chunks without the pipeline would be silently ignored -> refused
+  with pytest.raises(ValueError, match="overlap='pipelined'"):
+    DistEmbeddingStrategy([TableConfig(8, 4)], 1, exchange_chunks=2)
+  # fp8 is a registered wire dtype now; junk still isn't
+  DistEmbeddingStrategy([TableConfig(8, 4)], 1, wire_dtype="fp8")
+  with pytest.raises(ValueError, match="wire_dtype"):
+    DistEmbeddingStrategy([TableConfig(8, 4)], 1, wire_dtype="f8")
+
+
+def test_exchange_report_rounds_geometry():
+  tables, tmap, hotness = expand_tables(CFG)
+  plan = DistEmbeddingStrategy(
+      tables, WORLD, "memory_balanced", input_table_map=tmap,
+      input_hotness=hotness, dense_row_threshold=60,
+      wire_dtype="fp8", dedup_exchange=True, overlap="pipelined",
+      exchange_chunks=3)
+  rep = plan.exchange_report()
+  assert rep["overlap"] == "pipelined"
+  assert rep["exchange_chunks"] == 3
+  assert rep["rounds_per_exchange"] == (WORLD - 1) * 3
+  assert rep["float_wire_bytes_per_value"] == 1
+  # monolithic: one all_to_all per exchange; world 1: no wire at all
+  rep_m = DistEmbeddingStrategy([TableConfig(100, 8)], WORLD).exchange_report()
+  assert rep_m["overlap"] == "none" and rep_m["rounds_per_exchange"] == 1
+  rep_1 = DistEmbeddingStrategy([TableConfig(100, 8)], 1,
+                                overlap="pipelined").exchange_report()
+  assert rep_1["rounds_per_exchange"] == 0
+
+
+# ---------------------------------------------------------------------------
+# round 7: dedup_capacity override + overflow counter
+# ---------------------------------------------------------------------------
+
+
+def test_dedup_capacity_validation():
+  with pytest.raises(ValueError, match="dedup_exchange"):
+    DistEmbeddingStrategy([TableConfig(8, 4)], 1, dedup_capacity=16)
+  with pytest.raises(ValueError, match="dedup_capacity"):
+    DistEmbeddingStrategy([TableConfig(8, 4)], 1, dedup_exchange=True,
+                          dedup_capacity=0)
+
+
+def test_dedup_capacity_refused_without_counter_path():
+  """A silent smaller cap would alias ids: every builder without the
+  overflow-counter path must refuse a capped plan at build time."""
+  model, plan, rule, opt, state, bt, mesh = _fused_setup(
+      "adagrad", dedup_exchange=True, dedup_capacity=3)
+  with pytest.raises(ValueError, match="dedup_capacity"):
+    make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh, state,
+                           bt, donate=False)  # unguarded
+  with pytest.raises(ValueError, match="dedup_capacity"):
+    make_sparse_eval_step(model, plan, rule, mesh, state, bt)  # no metrics
+  from distributed_embeddings_tpu.training import make_train_step
+  with pytest.raises(NotImplementedError, match="dedup_capacity"):
+    make_train_step(lambda p, *b: 0.0, opt, mesh, {}, {}, bt, plan=plan)
+
+
+def test_dedup_capacity_overflow_counter():
+  """A cap below the per-block distinct count must show up in the
+  guarded step's psum'd ``dedup_overflow`` metric (and the with-metrics
+  eval's); a generous cap reports zero."""
+  model, plan, rule, opt, state, bt, mesh = _fused_setup(
+      "adagrad", dedup_exchange=True, dedup_capacity=3)
+  step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
+                                state, bt, donate=False, guard=True)
+  _, _, metrics = step(state, *bt)
+  assert sum(int(v) for v in metrics["dedup_overflow"].values()) > 0
+  # only sparse-kind classes dedup, so only they can overflow
+  for name, v in metrics["dedup_overflow"].items():
+    if "dense" in name:
+      assert int(v) == 0
+  ev = make_sparse_eval_step(model, plan, rule, mesh, state, bt,
+                             with_metrics=True)
+  _, em = ev(state, *bt[:2])
+  assert sum(int(v) for v in em["dedup_overflow"].values()) > 0
+  # micro-batch composition: per-micro-batch counts ride the scan
+  # outputs and sum into the same metric
+  step_mb = make_sparse_train_step(model, plan, bce_loss, opt, rule,
+                                   mesh, state, bt, donate=False,
+                                   guard=True, micro_batches=2)
+  _, _, m_mb = step_mb(state, *bt)
+  assert sum(int(v) for v in m_mb["dedup_overflow"].values()) > 0
+
+  model, plan2, rule, opt, state2, bt2, mesh = _fused_setup(
+      "adagrad", dedup_exchange=True, dedup_capacity=1 << 20)
+  step2 = make_sparse_train_step(model, plan2, bce_loss, opt, rule, mesh,
+                                 state2, bt2, donate=False, guard=True)
+  _, _, m2 = step2(state2, *bt2)
+  assert sum(int(v) for v in m2["dedup_overflow"].values()) == 0
+
+
+def test_dedup_capacity_safe_cap_is_exact():
+  """A capacity at (or above) the safe bound changes nothing: outputs
+  stay bit-exact vs the uncapped dedup'd exchange and the counter stays
+  zero — the knob only bites when it actually caps."""
+  rng = np.random.default_rng(14)
+  plan_a, params_a, inputs_a = _mixed_fixture("sum", rng,
+                                              dedup_exchange=True)
+  rng = np.random.default_rng(14)
+  plan_b, params_b, inputs_b = _mixed_fixture(
+      "sum", rng, dedup_exchange=True, dedup_capacity=1 << 20)
+  out_a = _forward_outs(plan_a, params_a, inputs_a)
+  out_b = _forward_outs(plan_b, params_b, inputs_b)
+  for t, (a, b) in enumerate(zip(out_a, out_b)):
+    np.testing.assert_array_equal(a, b, err_msg=f"table {t}")
 
 
 def test_route_ids_emits_dedup_routed():
