@@ -5,7 +5,8 @@
 PY ?= python
 SHELL := /bin/bash  # verify uses pipefail/PIPESTATUS
 
-.PHONY: test test-fast verify lint native bench dryrun chaos chaos-kill clean
+.PHONY: test test-fast verify lint native bench dryrun chaos chaos-kill \
+	serve-bench serve-smoke clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -17,10 +18,25 @@ test:
 lint:
 	$(PY) tools/graftlint.py
 
+# serving engine load test: step throughput (int8 serve vs f32 eval)
+# plus p50/p99/p99.9 latency vs offered QPS through the micro-batcher,
+# across {f32,int8} x {all-device,tiered} x batcher deadlines
+# (tools/profile_serve.py; budgets recorded in docs/BENCHMARKS.md r8)
+serve-bench:
+	PYTHONPATH=$(CURDIR):$$PYTHONPATH $(PY) tools/profile_serve.py
+
+# the make-verify tier of the serve bench: tiny world, a few hundred
+# requests; asserts finite latency percentiles and exact load-shed
+# rejection accounting (timeout-guarded like the pytest tier — a wedged
+# compile or thread must fail the gate, not hang it)
+serve-smoke:
+	PYTHONPATH=$(CURDIR):$$PYTHONPATH timeout -k 10 300 \
+	  $(PY) tools/profile_serve.py --smoke
+
 # the tier-1 gate, exactly as ROADMAP.md specifies it (CPU mesh, no slow
 # tests, collection errors surfaced but not fatal to the log); lint runs
-# first so invariant violations fail fast
-verify: lint
+# first so invariant violations fail fast, then the serve smoke tier
+verify: lint serve-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -38,6 +38,11 @@ GL110       error      no ``jax.process_count()``/``process_index()``
                        compared against hardcoded world constants (!= 0/1)
                        in durable modules — elastic pods resize the world
                        between runs; derive shapes from the plan/manifest
+GL111       error      train-only surfaces (optax / ``resilience.guards``
+                       imports; the step builders, scatter emitters, and
+                       guard helpers by name) are unreachable from
+                       ``serving/`` modules — the inference path must stay
+                       free of optimizer state and commit gates
 ==========  =========  =====================================================
 
 Trace-reachable scope (GL101/GL102) is structural: any function nested —
@@ -500,6 +505,80 @@ def _check_world_constants(mod: ParsedModule) -> List[Finding]:
             "shape-free."))
         break
   return out
+
+
+# Train-only surfaces a serving module may not reference by name: the
+# step builders and state constructors (they build/consume optimizer
+# state), the scatter-add emitters (serving never writes), and the
+# guard/commit-gate helpers (nothing to gate without a commit).
+_TRAIN_ONLY_NAMES = frozenset({
+    "make_train_step", "make_sparse_train_step", "make_tiered_train_step",
+    "init_sparse_state", "init_sparse_state_direct", "init_tiered_state",
+    "apply_sparse", "apply_sparse_streams", "sparse_delta_streams",
+    "scatter_add_fused", "DistributedOptimizer", "_make_guard_helpers",
+    "select_tree", "check_oov",
+})
+
+
+@_rule("GL111", "error",
+       "train-only surfaces are unreachable from serving/ modules")
+def _check_serving_train_surfaces(mod: ParsedModule) -> List[Finding]:
+  # The serving subsystem's whole point is an inference image with the
+  # optimizer lanes stripped and no write path: an optax import, a
+  # guard/commit-gate helper, or a scatter-add emitter reappearing
+  # there means training plumbing leaked back into the serve step (the
+  # jaxpr audit pins the traced program; this rule catches the leak at
+  # review time, before anything traces). faultinject/retry are NOT
+  # banned — the export path legitimately rides the durable-checkpoint
+  # machinery.
+  norm = mod.path.replace(os.sep, "/")
+  if "/serving/" not in norm and not norm.startswith("serving/"):
+    return []
+  out = []
+  for node in ast.walk(mod.tree):
+    if isinstance(node, ast.Import):
+      for alias in node.names:
+        root = alias.name.split(".")[0]
+        if root == "optax" or alias.name.endswith("resilience.guards"):
+          out.append(mod.finding(
+              "GL111", node,
+              f"import of {alias.name!r} in a serving module: the "
+              "inference path carries no optimizer state or commit "
+              "gate — strip at export instead."))
+    elif isinstance(node, ast.ImportFrom):
+      module = node.module or ""
+      names = [a.name for a in node.names]
+      if module.split(".")[0] == "optax" or module.endswith("guards") \
+          or ("resilience" in module and "guards" in names):
+        out.append(mod.finding(
+            "GL111", node,
+            f"import from {module or '.'!r} of {names} in a serving "
+            "module: optax / resilience.guards are train-only surfaces "
+            "— the serve step has nothing to optimize or gate."))
+      bad = sorted(set(names) & _TRAIN_ONLY_NAMES)
+      if bad:
+        out.append(mod.finding(
+            "GL111", node,
+            f"train-only name(s) {bad} imported into a serving module: "
+            "the step builders, scatter emitters, and guard helpers "
+            "must stay unreachable from the inference path."))
+    elif isinstance(node, (ast.Name, ast.Attribute)):
+      name = node.id if isinstance(node, ast.Name) else node.attr
+      if name in _TRAIN_ONLY_NAMES or name == "optax":
+        out.append(mod.finding(
+            "GL111", node,
+            f"reference to train-only surface {name!r} in a serving "
+            "module: serve buffers have no aux lanes to update and no "
+            "commit to gate — route the need through export/eval "
+            "instead."))
+  # nested attribute chains repeat line numbers; report each line once
+  seen = set()
+  uniq = []
+  for f in out:
+    if f.line not in seen:
+      seen.add(f.line)
+      uniq.append(f)
+  return uniq
 
 
 @_rule("GL108", "error", "fault-injection sites must be registered")

@@ -112,6 +112,9 @@ class JaxprSummary:
   a2a_dtypes: List[str] = field(default_factory=list)
   # same for ppermute payloads (the pipelined wire's rounds)
   ppermute_dtypes: List[str] = field(default_factory=list)
+  # (in, out) element dtypes of every convert_element_type — the serve
+  # artifacts pin the int8 -> float32 dequant on this evidence
+  convert_pairs: List[Tuple[str, str]] = field(default_factory=list)
 
 
 _COLLECTIVES = frozenset({
@@ -131,6 +134,9 @@ def summarize(jaxpr) -> JaxprSummary:
       s.a2a_dtypes.append(str(eqn.invars[0].aval.dtype))
     if name == "ppermute":
       s.ppermute_dtypes.append(str(eqn.invars[0].aval.dtype))
+    if name == "convert_element_type" and eqn.invars and eqn.outvars:
+      s.convert_pairs.append((str(eqn.invars[0].aval.dtype),
+                              str(eqn.outvars[0].aval.dtype)))
     if name in _COLLECTIVES:
       axes = eqn.params.get("axes", eqn.params.get("axis_name", ()))
       if not isinstance(axes, (tuple, list)):
@@ -175,6 +181,15 @@ class Expectation:
   # all_to_alls; a drifting count means a chunk (or a whole exchange)
   # silently fell out of — or was added to — the schedule.
   ppermute_count: Optional[int] = None
+  # exact TOTAL scatter count, any variant, any operand shape (None:
+  # not checked). The serve artifacts pin 0: a forward-only inference
+  # step that scatters anywhere is reverse-mode (or a write) leaking in.
+  scatter_total: Optional[int] = None
+  # a (in_dtype, out_dtype) convert that must appear at least once —
+  # the int8 serve artifact pins ('int8', 'float32'), the evidence that
+  # the dequant actually widens gathered bytes on device (an f32 image
+  # masquerading as int8 would gather f32 and convert nothing)
+  require_convert: Optional[Tuple[str, str]] = None
 
 
 def audit_summary(name: str, s: JaxprSummary, expect: Expectation
@@ -232,6 +247,20 @@ def audit_summary(name: str, s: JaxprSummary, expect: Expectation
           "is broken (an f32 payload under a narrowed wire multiplies "
           "exchange bytes; a narrowed one under f32 silently loses "
           "precision)")
+  if expect.scatter_total is not None \
+      and len(s.scatter_shapes) != expect.scatter_total:
+    out.append(
+        f"{name}: {len(s.scatter_shapes)} scatter op(s) of any kind, "
+        f"expected exactly {expect.scatter_total} — a forward-only "
+        "serve step that scatters is reverse-mode (or a buffer write) "
+        "leaking into the inference path")
+  if expect.require_convert is not None \
+      and tuple(expect.require_convert) not in set(s.convert_pairs):
+    out.append(
+        f"{name}: no {expect.require_convert[0]} -> "
+        f"{expect.require_convert[1]} convert_element_type in the trace "
+        "— the dequantize-on-gather path is not actually widening "
+        "quantized rows on device")
   if s.f64_prims:
     out.append(
         f"{name}: float64 values produced by {sorted(set(s.f64_prims))} "
@@ -282,6 +311,11 @@ def build_artifacts() -> Dict[str, Tuple[Any, Expectation]]:
     the commit gate's pmin must appear exactly once here too, so a
     poison batch cannot fork the tiers
   - ``eval_step``:          ``make_sparse_eval_step`` (zero scatters)
+  - ``serve_step_f32`` / ``serve_step_int8``: ``serving.make_serve_step``
+    over the frozen (optimizer-lanes-stripped) inference image — pinned
+    at zero scatter ops of ANY kind (the no-reverse-mode pin), zero
+    host callbacks, and (int8) the int8 -> f32 dequantize-on-gather
+    convert
   """
   _require_cpu_devices()
   import jax
@@ -372,6 +406,33 @@ def build_artifacts() -> Dict[str, Tuple[Any, Expectation]]:
       Expectation(shapes, mesh_axes, guard=False, scatters_per_class=0,
                   a2a_count=2 * nb, ppermute_count=0,
                   wire_float_dtype="float32"))
+
+  # ---- serve steps on the frozen inference image (round 12) --------------
+  # make_serve_step over export.freeze's stripped buffers: same exchange
+  # structure as eval (ids dp->mp, activations mp->dp), but pinned HARD
+  # at zero scatter ops of ANY operand shape (reverse mode through a
+  # gather lowers to a scatter — forbidding them all is the
+  # no-reverse-mode pin) and zero host callbacks. The int8 artifact
+  # additionally pins the int8 -> f32 dequantize-on-gather convert on
+  # the traced evidence.
+  from ..serving.engine import make_serve_step
+  from ..serving.export import freeze, frozen_device_state
+  for q in ("f32", "int8"):
+    frozen = freeze(plan, rule, state, quantize=q)
+    sstate = frozen_device_state(frozen, plan, mesh)
+    sstep = make_serve_step(model, plan, frozen.meta, mesh, sstate,
+                            (batch0[0], batch0[1]))
+    jx = jax.make_jaxpr(sstep)(sstate, *bt[:2])
+    serve_shapes = {n: (m.packed.phys_rows, m.packed.phys_width)
+                    for n, m in frozen.meta.items()}
+    artifacts[f"serve_step_{q}"] = (
+        jx.jaxpr,
+        Expectation(serve_shapes, mesh_axes, guard=False,
+                    scatters_per_class=0, a2a_count=2 * nb,
+                    ppermute_count=0, wire_float_dtype="float32",
+                    scatter_total=0,
+                    require_convert=("int8", "float32") if q == "int8"
+                    else None))
 
   # ---- compressed-wire sparse step (bf16 wire + dedup'd exchange) --------
   # identical table layout, so the f32 state and batch reuse verbatim;

@@ -633,6 +633,31 @@ def read_manifest(path: str) -> Dict[str, Any]:
     return json.load(f)
 
 
+def publish_manifest_last(tmp: str, path: str,
+                          manifest: Dict[str, Any]) -> None:
+  """Durable publication tail shared by :func:`save` and
+  ``serving.export``: write ``manifest.json`` LAST (after every data
+  file in ``tmp`` exists and is fsynced), fsync it, and atomically
+  rename ``tmp`` into place (previous ``path`` rotates to ``.old``).
+  The manifest must carry the per-file ``checksums`` table so
+  :func:`verify` can validate the published directory."""
+  mpath = os.path.join(tmp, "manifest.json")
+  with open(mpath, "w") as f:
+    json.dump(manifest, f, indent=1)
+    f.flush()
+    os.fsync(f.fileno())
+  _fsync_dir(tmp)
+  faultinject.fire("ckpt_rename", path=path)
+  if os.path.exists(path):
+    backup = path + ".old"
+    if os.path.exists(backup):
+      import shutil
+      shutil.rmtree(backup)
+    os.rename(path, backup)
+  os.rename(tmp, path)
+  _fsync_dir(os.path.dirname(os.path.abspath(path)))
+
+
 def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
          state: Dict[str, Any], store=None,
          extra: Optional[Dict[str, Any]] = None) -> None:
@@ -824,21 +849,7 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
       manifest["extra"] = extra
     if tiering_meta is not None:
       manifest["tiering"] = tiering_meta
-    mpath = os.path.join(tmp, "manifest.json")
-    with open(mpath, "w") as f:
-      json.dump(manifest, f, indent=1)
-      f.flush()
-      os.fsync(f.fileno())
-    _fsync_dir(tmp)
-    faultinject.fire("ckpt_rename", path=path)
-    if os.path.exists(path):
-      backup = path + ".old"
-      if os.path.exists(backup):
-        import shutil
-        shutil.rmtree(backup)
-      os.rename(path, backup)
-    os.rename(tmp, path)
-    _fsync_dir(os.path.dirname(os.path.abspath(path)))
+    publish_manifest_last(tmp, path, manifest)
 
   # The publication must reach the renamed-barrier on EVERY exception —
   # same invariant as the write phase above — or processes 1..n-1 hang

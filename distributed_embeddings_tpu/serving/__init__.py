@@ -1,0 +1,53 @@
+"""Inference-first serving subsystem.
+
+Training state is the wrong shape for serving: the packed buffers
+interleave optimizer-state lanes with every table row (2-3x the bytes a
+lookup needs), the step builders drag scatter-add backward plumbing and
+guard/metrics machinery through the device, and nothing batches
+concurrent user queries. This package is the serve-time counterpart:
+
+- :mod:`.export` — freeze the train state into a contiguous inference
+  artifact: optimizer lanes stripped, optional int8 per-row symmetric
+  quantization (per-row f32 scale bit-packed alongside the row), written
+  through the checkpoint layer's crc32-manifest-last durable protocol.
+- :mod:`.engine` — a jitted serve step (dequantize-on-gather fused into
+  the lookup; no scatters, no metrics, no guard; parameter buffers never
+  donated) plus :class:`ServeEngine`, which drives it — tiered plans
+  serve hot ids from the device cache and cold ids from the stripped
+  host image through the tiering prefetcher's classify path.
+- :mod:`.batcher` — a request micro-batcher: concurrent variable-size
+  queries coalesce into one padded device dispatch with per-request
+  de-interleave, a deadline-or-full flush policy, and a bounded queue
+  that sheds load with a counted rejection instead of unbounded latency.
+
+graftlint GL111 keeps this package honest: train-only surfaces (optax,
+the guard/commit-gate helpers, the scatter-add emitters, the train step
+builders) are unreachable from serving modules.
+"""
+
+from .batcher import MicroBatcher, Rejected
+from .engine import ServeEngine, ServeTierConfig, make_serve_step
+from .export import (
+    ServeClassMeta,
+    dequantize_rows_int8,
+    export,
+    freeze,
+    load,
+    quantize_rows_int8,
+    serve_layout,
+)
+
+__all__ = [
+    "MicroBatcher",
+    "Rejected",
+    "ServeClassMeta",
+    "ServeEngine",
+    "ServeTierConfig",
+    "dequantize_rows_int8",
+    "export",
+    "freeze",
+    "load",
+    "make_serve_step",
+    "quantize_rows_int8",
+    "serve_layout",
+]

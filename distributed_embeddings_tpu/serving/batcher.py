@@ -1,0 +1,313 @@
+"""Request micro-batcher: concurrent queries -> one padded device dispatch.
+
+A serving device wants one big batch; users send many small concurrent
+requests. The :class:`MicroBatcher` sits between them:
+
+- **coalesce**: concurrent variable-size requests append to a FIFO; a
+  flush packs whole requests (requests are never split) into one
+  ``[max_batch, ...]`` dispatch, padding the tail with ``PAD_ID``
+  categorical rows (the engine's hotness-padding sentinel — padded rows
+  gather zero rows and their predictions are sliced off, never
+  delivered).
+- **deadline-or-full flush**: a flush fires when the packed rows reach
+  ``max_batch`` (full) or the OLDEST pending request has waited
+  ``max_delay_s`` (deadline) — the knob trading per-request latency
+  against device efficiency. The padded dispatch shape is constant, so
+  the serve step traces exactly once per batcher.
+- **bounded queue, counted load-shed**: at most ``queue_rows`` rows may
+  be pending; a request that would exceed the bound is REJECTED
+  immediately (:class:`Rejected`, ``stats['rejected']`` counts it)
+  instead of queueing into unbounded latency. Overload shows up as an
+  explicit error rate at the edge — the only place it can be handled —
+  not as a p99 that grew past every deadline.
+- **pipelined completion**: the flusher thread hands the (asynchronous)
+  device dispatch to a completer thread and immediately packs the next
+  batch, so host-side packing and de-interleave overlap device compute;
+  ``pipeline_depth`` bounds the in-flight dispatches.
+
+De-interleave is positional: request k's predictions are exactly rows
+``[off_k, off_k + n_k)`` of the dispatch result — the property test
+pins that every request gets its own rows back under random arrival
+interleavings.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..parallel.lookup_engine import PAD_ID
+
+
+class Rejected(RuntimeError):
+  """The bounded request queue is full; the request was shed (counted in
+  ``MicroBatcher.stats['rejected']``), not enqueued."""
+
+
+class ServeFuture:
+  """Per-request handle: blocks on :meth:`result` until the dispatch
+  carrying this request completes (or fails, re-raising here)."""
+
+  def __init__(self, n: int):
+    self.n = n
+    self.t_submit = time.monotonic()
+    self.t_done: Optional[float] = None
+    self._event = threading.Event()
+    self._value: Optional[np.ndarray] = None
+    self._error: Optional[BaseException] = None
+
+  def _fulfill(self, value: np.ndarray) -> None:
+    self.t_done = time.monotonic()
+    self._value = value
+    self._event.set()
+
+  def _fail(self, exc: BaseException) -> None:
+    self.t_done = time.monotonic()
+    self._error = exc
+    self._event.set()
+
+  def done(self) -> bool:
+    return self._event.is_set()
+
+  def result(self, timeout: Optional[float] = None) -> np.ndarray:
+    if not self._event.wait(timeout):
+      raise TimeoutError("serve request still pending")
+    if self._error is not None:
+      raise self._error
+    return self._value
+
+  @property
+  def latency_s(self) -> Optional[float]:
+    """submit -> fulfill wall time (None while pending)."""
+    return None if self.t_done is None else self.t_done - self.t_submit
+
+
+class _Pending:
+  __slots__ = ("numerical", "cats", "future")
+
+  def __init__(self, numerical, cats, future):
+    self.numerical = numerical
+    self.cats = cats
+    self.future = future
+
+
+class MicroBatcher:
+  """Coalesce concurrent requests into padded fixed-shape dispatches.
+
+  Args:
+    dispatch_fn: ``dispatch_fn(numerical [max_batch, F], cats) ->
+      preds`` — typically ``ServeEngine.dispatch``. May return a device
+      array (completion materializes it on the completer thread, off
+      the flush path); the result's leading axis must be ``max_batch``.
+    max_batch: the dispatch batch (constant — one trace). Requests
+      larger than this are rejected outright.
+    max_delay_s: deadline the oldest pending request may wait before a
+      partial flush fires.
+    queue_rows: pending-row bound (default ``8 * max_batch``); the
+      load-shed knob.
+    pipeline_depth: max dispatches in flight (completer queue bound).
+    start: start the flusher/completer threads (tests drive
+      :meth:`flush_now` deterministically with ``start=False``).
+  """
+
+  def __init__(self, dispatch_fn: Callable, max_batch: int,
+               max_delay_s: float = 0.002,
+               queue_rows: Optional[int] = None,
+               pipeline_depth: int = 2,
+               start: bool = True):
+    if max_batch < 1:
+      raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    self.dispatch_fn = dispatch_fn
+    self.max_batch = int(max_batch)
+    self.max_delay_s = float(max_delay_s)
+    self.queue_rows = int(queue_rows) if queue_rows is not None \
+        else 8 * self.max_batch
+    self._lock = threading.Lock()
+    self._nonempty = threading.Condition(self._lock)
+    self._pending: List[_Pending] = []
+    self._pending_rows = 0
+    self._closed = False
+    self.stats: Dict[str, int] = {
+        "submitted": 0, "rejected": 0, "batches": 0, "completed": 0,
+        "padded_rows": 0,
+    }
+    self._inflight: _queue.Queue = _queue.Queue(maxsize=max(1,
+                                                           pipeline_depth))
+    self._flusher: Optional[threading.Thread] = None
+    self._completer: Optional[threading.Thread] = None
+    if start:
+      self._flusher = threading.Thread(target=self._flush_loop,
+                                       name="serve-batcher-flush",
+                                       daemon=True)
+      self._completer = threading.Thread(target=self._complete_loop,
+                                         name="serve-batcher-complete",
+                                         daemon=True)
+      self._flusher.start()
+      self._completer.start()
+
+  # ---- submission ---------------------------------------------------------
+  def submit(self, numerical, cats: Sequence) -> ServeFuture:
+    """Enqueue one request of ``n = numerical.shape[0]`` rows
+    (``1 <= n <= max_batch``). Returns its :class:`ServeFuture`; raises
+    :class:`Rejected` — counted — when the bounded queue is full."""
+    numerical = np.asarray(numerical)
+    cats = [np.asarray(c) for c in cats]
+    n = numerical.shape[0]
+    if n < 1 or n > self.max_batch:
+      raise ValueError(
+          f"request rows {n} outside [1, max_batch={self.max_batch}] — "
+          "split oversized queries client-side")
+    fut = ServeFuture(n)
+    with self._nonempty:
+      if self._closed:
+        raise RuntimeError("MicroBatcher is closed")
+      self.stats["submitted"] += 1
+      if self._pending_rows + n > self.queue_rows:
+        self.stats["rejected"] += 1
+        raise Rejected(
+            f"serve queue full ({self._pending_rows} rows pending, bound "
+            f"{self.queue_rows}): request shed. The device is saturated "
+            "— back off client-side or raise queue_rows (which only "
+            "trades the error for latency).")
+      self._pending.append(_Pending(numerical, cats, fut))
+      self._pending_rows += n
+      self._nonempty.notify()
+    return fut
+
+  # ---- flush policy -------------------------------------------------------
+  def _take_batch_locked(self) -> List[_Pending]:
+    """Pop whole requests FIFO while they fit in max_batch rows."""
+    taken, rows = [], 0
+    while self._pending and rows + self._pending[0].future.n \
+        <= self.max_batch:
+      p = self._pending.pop(0)
+      rows += p.future.n
+      taken.append(p)
+    self._pending_rows -= rows
+    return taken
+
+  def _flush_ready_locked(self) -> bool:
+    if not self._pending:
+      return False
+    if self._pending_rows >= self.max_batch \
+        or self._pending[0].future.n == self.max_batch:
+      return True
+    oldest = self._pending[0].future.t_submit
+    return (time.monotonic() - oldest) >= self.max_delay_s
+
+  def _flush_loop(self) -> None:
+    while True:
+      with self._nonempty:
+        while not self._flush_ready_locked() and not self._closed:
+          if self._pending:
+            wait = self.max_delay_s - (
+                time.monotonic() - self._pending[0].future.t_submit)
+            self._nonempty.wait(timeout=max(wait, 0.0) + 1e-4)
+          else:
+            self._nonempty.wait(timeout=0.05)
+        if self._closed and not self._pending:
+          self._inflight.put(None)  # completer shutdown sentinel
+          return
+        taken = self._take_batch_locked()
+      if taken:
+        self._dispatch(taken)
+
+  def flush_now(self) -> int:
+    """Synchronous flush (tests / drain): packs and dispatches pending
+    requests batch by batch, completing inline. Returns the number of
+    dispatches issued."""
+    n = 0
+    while True:
+      with self._nonempty:
+        taken = self._take_batch_locked()
+      if not taken:
+        return n
+      item = self._dispatch(taken, inline=True)
+      self._complete(*item)
+      n += 1
+
+  # ---- dispatch + completion ---------------------------------------------
+  def _pad_batch(self, taken: List[_Pending]):
+    numerical = np.concatenate([p.numerical for p in taken])
+    cats = [np.concatenate([p.cats[i] for p in taken])
+            for i in range(len(taken[0].cats))]
+    pad = self.max_batch - numerical.shape[0]
+    if pad:
+      numerical = np.concatenate(
+          [numerical, np.zeros((pad,) + numerical.shape[1:],
+                               numerical.dtype)])
+      cats = [np.concatenate(
+          [c, np.full((pad,) + c.shape[1:], PAD_ID, c.dtype)])
+          for c in cats]
+    self.stats["padded_rows"] += pad
+    return numerical, cats
+
+  def _dispatch(self, taken: List[_Pending], inline: bool = False):
+    try:
+      numerical, cats = self._pad_batch(taken)
+      out = self.dispatch_fn(numerical, cats)
+      self.stats["batches"] += 1
+    except BaseException as e:  # noqa: BLE001 — delivered per request
+      for p in taken:
+        p.future._fail(e)
+      if inline:
+        raise
+      return
+    if inline:
+      return (taken, out)
+    self._inflight.put((taken, out))
+    return None
+
+  def _complete(self, taken: List[_Pending], out: Any) -> None:
+    try:
+      preds = np.asarray(out)  # materializes the async device result
+    except BaseException as e:  # noqa: BLE001
+      for p in taken:
+        p.future._fail(e)
+      return
+    off = 0
+    for p in taken:
+      p.future._fulfill(preds[off:off + p.future.n])
+      off += p.future.n
+      self.stats["completed"] += 1
+
+  def _complete_loop(self) -> None:
+    while True:
+      item = self._inflight.get()
+      if item is None:
+        return
+      self._complete(*item)
+
+  # ---- lifecycle ----------------------------------------------------------
+  def close(self, drain: bool = True) -> None:
+    """Stop the batcher. ``drain`` flushes pending requests first;
+    otherwise they fail with a shutdown error."""
+    with self._nonempty:
+      self._closed = True
+      pending = [] if drain else self._pending[:]
+      if not drain:
+        self._pending.clear()
+        self._pending_rows = 0
+      self._nonempty.notify_all()
+    for p in pending:
+      p.future._fail(RuntimeError("MicroBatcher closed before dispatch"))
+    if self._flusher is not None:
+      self._flusher.join(timeout=10.0)
+      self._completer.join(timeout=10.0)
+    elif drain:
+      try:
+        self.flush_now()
+      finally:
+        # a dispatch failure aborts flush_now mid-drain; requests still
+        # queued behind it must fail loudly, not strand their waiters
+        with self._nonempty:
+          leftover = self._pending[:]
+          self._pending.clear()
+          self._pending_rows = 0
+        for p in leftover:
+          p.future._fail(
+              RuntimeError("MicroBatcher closed before dispatch"))

@@ -1,0 +1,479 @@
+"""The serve step + engine: donated-batch jitted inference on frozen tables.
+
+``make_serve_step`` is ``training.make_sparse_eval_step``'s
+inference-first counterpart, built on the stripped images of
+:mod:`.export` instead of the training buffers:
+
+- **no scatters, no metrics, no guard**: the traced program is route ->
+  gather -> exchange -> assemble -> model forward, nothing else (the
+  jaxpr audit pins zero scatter ops and zero host callbacks on the
+  ``serve_step_{f32,int8}`` artifacts);
+- **dequantize-on-gather**: int8 rows gather as bytes and widen to f32
+  in one fused multiply against the row's bit-packed scale — the gather
+  is row-bound, so the narrower row is the whole win (PAPERS.md,
+  "Dissecting Embedding Bag Performance in DLRM Inference": lookup
+  bytes dominate serve time);
+- **f32 serving is BIT-exact** against ``make_sparse_eval_step``: same
+  gather values, and the multi-hot combine replicates the eval step's
+  fp-addition grouping on narrow aux-packed classes
+  (:func:`_combine_masked_order`);
+- **parameter buffers are never donated** — a serve step is called
+  thousands of times against one frozen table; only the per-dispatch
+  request arrays may be donated (``donate_batch``). The persistent
+  resident maps ride the staged inputs and are never donated either.
+
+Tiered plans serve hot ids from the device cache and cold ids from the
+stripped host image: :class:`ServeEngine` rebuilds the tiering stack
+(``HostTierStore`` + ``TieredPrefetcher``) on the SERVE geometry — the
+classify/stage pipeline is reused verbatim, only the images are
+stripped (and possibly int8) and nothing is ever written back.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..compat import shard_map
+from ..layers.dist_model_parallel import hybrid_partition_specs
+from ..layers.planner import DistEmbeddingStrategy
+from ..ops.packed_table import PackedLayout, gather_fused_chunked
+from ..parallel.lookup_engine import (
+    DedupRouted,
+    DistributedLookup,
+    TierSpec,
+    class_param_name,
+    padded_rows,
+    ragged_hotness,
+)
+from ..training import shard_batch
+from .export import (
+    INT8_SCALE_LANES,
+    FrozenTables,
+    ServeArtifact,
+    ServeClassMeta,
+    frozen_device_state,
+)
+
+
+def _dequant_rows(rows: jax.Array, meta: ServeClassMeta) -> jax.Array:
+  """Gathered serve rows -> f32 table rows.
+
+  f32 images pass through (the gather already returned ``[..., width]``
+  f32 lanes). int8 images arrive ``[..., width + 4]``: the trailing 4
+  int8 lanes bitcast back to the row's f32 scale (the export packed it
+  there — no second gather), and the dequant is one fused widen+multiply
+  per row. Sentinel/OOB ids gathered all-zero rows whose scale bytes
+  decode to 0.0, so they stay exactly zero after the multiply."""
+  if meta.quantize != "int8":
+    return rows
+  w = meta.width
+  q = rows[..., :w]
+  scale = lax.bitcast_convert_type(
+      lax.bitcast_convert_type(rows[..., w:w + INT8_SCALE_LANES],
+                               jnp.uint8), jnp.float32)
+  return q.astype(jnp.float32) * scale[..., None]
+
+
+def _combine_masked_order(engine: DistributedLookup, key,
+                          rows: jax.Array, oids: jax.Array,
+                          rpp: int, rs: bool) -> jax.Array:
+  """Multi-hot combine replicating the eval step's masked-window order.
+
+  The training layout packs ``rpp`` logical rows per physical row, and
+  the eval step's narrow multi-hot fast path
+  (``lookup_engine._z_sparse_fused``) sums window-MASKED physical rows
+  over the hotness axis first and folds the ``rpp`` windows once per
+  bag. That groups the fp additions by ``id % rpp`` — a different
+  summation order than a plain h-axis sum, hence (in general) different
+  last-ulp bits. The f32 serve path claims BIT-exactness against eval,
+  so it reproduces the grouping: serve rows are already table-width, but
+  masking them into ``rpp`` width-w windows by the LOGICAL id's sub-row
+  and reducing h-then-windows adds the same values in the same order
+  (zeros added where eval added a masked-out window's zeros — exact)."""
+  cp = engine.plan.classes[key]
+  if cp.combiner is None:
+    raise ValueError("combiner=None requires hotness-1 inputs in the "
+                     "distributed path (2-D model-parallel outputs)")
+  sentinel = padded_rows(engine.plan, key)
+  valid = (oids >= 0) & (oids < sentinel)
+  sub = jnp.where(valid, oids, 0) % rpp
+  w = rows.shape[-1]
+  win = lax.broadcasted_iota(jnp.int32, (rpp * w,), 0) // w
+  # The tile-to-rpp-windows form is deliberate: XLA's reduce
+  # association varies with the minor-dim shape, and this shape is the
+  # one whose h-axis reduce reproduces the eval path's bit pattern (a
+  # width-w per-window select measured barely faster and broke
+  # bit-exactness). The masked tensor is the same order of size as the
+  # eval step's own masked-phys staging, so f32 serving of multi-hot
+  # narrow classes costs what eval costs — the serving win is int8,
+  # whose generic combine skips this path entirely.
+  masked = jnp.where(win == sub[..., None], jnp.tile(rows, rpp), 0)
+  bag = jnp.sum(masked, axis=2)                       # [n_b, G, rpp*w]
+  z = jnp.sum(bag.reshape(bag.shape[:-1] + (rpp, w)), axis=-2)
+  if cp.combiner == "mean" and not rs:
+    counts = jnp.sum(oids < sentinel, axis=2).astype(z.dtype)
+    z = z / jnp.maximum(counts, 1)[..., None]
+  return z
+
+
+def _serve_lookup(engine: DistributedLookup,
+                  serve_params: Dict[str, jax.Array],
+                  layouts: Dict[str, PackedLayout],
+                  meta: Dict[str, ServeClassMeta],
+                  ids_gather: Dict[tuple, Any],
+                  ids_order: Dict[tuple, Any]) -> Dict[tuple, jax.Array]:
+  """mp-side lookup over the inference images (the serve counterpart of
+  ``lookup_sparse_fused`` — no residuals, dequant fused in).
+
+  ``ids_gather`` addresses the buffers (tiered classes: compact ids
+  after ``translate_tiered_ids``); ``ids_order`` keeps the LOGICAL
+  routing tensors, whose sentinel pattern drives the combiner's
+  valid-counts and the masked-order fold — identical to what the
+  all-device eval step sees, which is what makes tiered f32 serving
+  bit-exact against it."""
+  z: Dict[tuple, jax.Array] = {}
+  for bk, ids in ids_gather.items():
+    key = bk.class_key
+    if engine.plan.classes[key].kind != "sparse":
+      continue
+    name = class_param_name(*key)
+    m = meta[name]
+    lay = layouts[name]
+    buf = engine._squeeze_local(serve_params[name])
+    if isinstance(ids, DedupRouted):
+      # one row per unique id; dp side expands + combines (the reverse
+      # of nothing — serve has no backward) via engine.exchange
+      z[bk] = _dequant_rows(gather_fused_chunked(lay, buf, ids.uniq), m)
+    elif isinstance(ids, tuple):  # ragged value stream (vals, lens)
+      vals, lens = ids
+      rows = _dequant_rows(gather_fused_chunked(lay, buf, vals), m)
+      ovals, _olens = ids_order[bk]
+      z[bk] = engine._combine_ragged(rows, ovals, lens, key, bk.rs)
+    else:
+      rows = _dequant_rows(gather_fused_chunked(lay, buf, ids), m)
+      oids = ids_order[bk]
+      if (m.quantize == "f32" and m.combine_rpp > 1 and oids.ndim == 3
+          and oids.shape[-1] > 1):
+        z[bk] = _combine_masked_order(engine, key, rows, oids,
+                                      m.combine_rpp, bk.rs)
+      else:
+        z[bk] = engine._combine(rows, oids, key, bk.rs)
+  return z
+
+
+def make_serve_step(model, plan: DistEmbeddingStrategy,
+                    serve_meta: Dict[str, ServeClassMeta],
+                    mesh, state: Dict[str, Any], batch_example,
+                    axis_name: str = "mp",
+                    tier_specs: Optional[Dict[str, TierSpec]] = None,
+                    with_metrics: bool = False,
+                    donate_batch: bool = False):
+  """Build the jitted serve step over a frozen-table state.
+
+  Args:
+    serve_meta: per sparse class the inference-image geometry
+      (:class:`~.export.ServeClassMeta` — from ``export.freeze`` or a
+      loaded artifact's ``.meta``).
+    state: ``{'dense', 'emb_dense', 'serve'}`` (device-placed); tiered
+      plans pass the compact cache+staging buffers in ``'serve'`` and
+      the per-dispatch staging upload as the step's ``staged`` input.
+    batch_example: ``(numerical, cats)`` request structure (specs only).
+    tier_specs: serve-geometry :class:`TierSpec` per host-tier class
+      (from :class:`ServeEngine`'s tier plan); routed logical ids are
+      rewritten to cache/staging slots exactly as in the tiered train
+      step, and a spill dispatch retraces per staging bucket.
+    with_metrics: tiered steps also return ``{'tier': {class: [hot,
+      staged, missed, valid] int32}}`` (psum'd) — ``missed > 0`` means
+      the prefetch contract was violated and those lookups read zeros.
+    donate_batch: donate the REQUEST arrays (numerical + cats; the
+      micro-batcher builds fresh ones per dispatch). The parameter
+      buffers and the staged inputs (whose ``resident`` maps persist
+      across dispatches) are NEVER donated: a serve step must be
+      repeatable against one frozen table — see the regression tests.
+
+  Returns:
+    ``step(state, numerical, cats) -> preds`` (tiered:
+    ``step(state, staged, numerical, cats)``; with metrics, ``->
+    (preds, metrics)``).
+  """
+  if getattr(plan, "dedup_capacity", None) is not None:
+    raise ValueError(
+        "plan.dedup_capacity is not servable: a capacity below the safe "
+        "bound aliases distinct ids onto the cap's last slot — those "
+        "predictions read the WRONG rows — and the serve step carries no "
+        "metrics path to count it. Serve an uncapped plan (the artifact "
+        "is the same), or use make_sparse_eval_step(with_metrics=True).")
+  if getattr(plan, "oov", "clip") == "error":
+    raise ValueError(
+        "plan.oov='error' is not servable: enforcement rides the guarded "
+        "train step's metrics + commit gate, and the serve step carries "
+        "neither. Serve with oov='clip' (the routing clamp is identical) "
+        "or run make_sparse_eval_step(with_metrics=True) to count OOV.")
+  engine = DistributedLookup(plan, dp_input=True, axis_name=axis_name)
+  base_layouts = {n: m.packed for n, m in serve_meta.items()}
+  tiered = tier_specs is not None and bool(tier_specs)
+
+  def local_serve(state, *args):
+    if tiered:
+      staged, numerical = args[0], args[1]
+      cats = list(args[2])
+    else:
+      numerical = args[0]
+      cats = list(args[1])
+    b = numerical.shape[0]
+    hotness = [ragged_hotness(c) for c in cats]
+    hotness_of = lambda i: hotness[i]  # noqa: E731
+    ids_all = engine.route_ids(cats, hotness_of)
+    counts = engine.mean_counts(cats)
+    if tiered:
+      # effective layouts from THIS dispatch's staging shapes (spill
+      # dispatches retrace, same contract as the tiered train step)
+      layouts = dict(base_layouts)
+      for name, spec in tier_specs.items():
+        s = staged["grps"][name].shape[0]
+        layouts[name] = PackedLayout(
+            rows=(spec.cache_grps + s) * spec.rpp,
+            width=base_layouts[name].width, n_aux=0)
+      ids_gather, tier_m = engine.translate_tiered_ids(
+          ids_all, tier_specs, staged["resident"], staged["grps"])
+      serve_bufs = engine.install_staging(state["serve"], tier_specs,
+                                          staged["rows"])
+    else:
+      layouts, ids_gather, serve_bufs, tier_m = (
+          base_layouts, ids_all, state["serve"], None)
+    z = _serve_lookup(engine, serve_bufs, layouts, serve_meta,
+                      ids_gather, ids_all)
+    acts = engine.finish_forward(z, state["emb_dense"], ids_gather, b,
+                                 hotness_of, counts)
+    preds = model.apply({"params": state["dense"]}, numerical, cats,
+                        emb_acts=acts)
+    if with_metrics and tiered:
+      if mesh is not None:
+        tier_m = {n: lax.psum(m, axis_name) for n, m in tier_m.items()}
+      return preds, {"tier": tier_m}
+    return preds
+
+  # Donation contract: argnum 0 (the frozen state) is NEVER donated —
+  # donating it would invalidate the table on the first dispatch and
+  # poison every later one. Tiered argnum 1 (staged) is never donated
+  # either: its 'resident' maps persist across dispatches. Only the
+  # request arrays may be donated.
+  batch0 = 2 if tiered else 1
+  donate = tuple(range(batch0, batch0 + 2)) if donate_batch else ()
+  if mesh is None:
+    return jax.jit(local_serve, donate_argnums=donate)
+  sspec = hybrid_partition_specs(state, axis_name)
+  bspec = jax.tree_util.tree_map(
+      lambda _: P(axis_name), tuple(batch_example))
+  in_specs = (sspec,) + bspec
+  if tiered:
+    staged_specs = {
+        "grps": {n: P(axis_name) for n in tier_specs},
+        "resident": {n: P(axis_name) for n in tier_specs},
+        "rows": {n: P(axis_name, None) for n in tier_specs},
+    }
+    in_specs = (sspec, staged_specs) + bspec
+  out_specs = P(axis_name)
+  if with_metrics and tiered:
+    out_specs = (P(axis_name), {"tier": {n: P() for n in tier_specs}})
+  return jax.jit(
+      shard_map(local_serve, mesh=mesh, in_specs=in_specs,
+                out_specs=out_specs),
+      donate_argnums=donate)
+
+
+# ---------------------------------------------------------------------------
+# tiered serve residency: the tiering stack on serve geometry
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeTierConfig:
+  """Serve-side residency knobs (decided at deployment, not export —
+  the same artifact serves from chips with different HBM budgets).
+
+  Attributes:
+    cache_fraction: resident fraction of each host-tier class's serve
+      physical rows. The stripped image packs 2-3x more logical rows
+      per physical row than the training layout, so the same HBM holds
+      a proportionally larger hot set.
+    staging_grps: persistent staging physical rows per class per rank
+      (size near the expected per-dispatch deduped cold-row count).
+    spill_factor_max: staging growth bound (power-of-two buckets; a
+      spill dispatch retraces once per bucket, as in training).
+  """
+
+  cache_fraction: float = 0.25
+  staging_grps: int = 1024
+  spill_factor_max: int = 16
+  rerank_interval: int = 0  # serve residency is frozen; kept for the
+  # prefetcher's maybe_rerank signature compatibility
+
+
+@dataclasses.dataclass(frozen=True)
+class _ServeTierClass:
+  """Duck-type of ``tiering.plan.TieredClassPlan`` on serve geometry —
+  what ``HostTierStore`` and ``TieredPrefetcher`` actually consume."""
+
+  key: tuple
+  name: str
+  spec: TierSpec
+  layout_logical: PackedLayout
+  spill_cap_grps: int
+
+
+class ServeTierPlan:
+  """Serve-geometry twin of ``tiering.TieringPlan``: same classify /
+  stage / translate machinery, sized on the stripped image's physical
+  rows. Duck-types the ``tplan`` the tiering stack binds to."""
+
+  def __init__(self, plan: DistEmbeddingStrategy,
+               meta: Dict[str, ServeClassMeta],
+               config: ServeTierConfig = ServeTierConfig()):
+    host_keys = plan.host_tier_class_keys()
+    if not host_keys:
+      raise ValueError("plan has no host-tier classes")
+    self.plan = plan
+    self.config = config
+    self.classes: Dict[tuple, _ServeTierClass] = {}
+    for key in host_keys:
+      name = class_param_name(*key)
+      m = meta[name]
+      lay = m.packed
+      rpp = lay.rows_per_phys
+      hard_cap = lay.rows // rpp
+      staging = min(config.staging_grps, max(1, lay.phys_rows - 1))
+      cache = min(max(1, int(lay.phys_rows * config.cache_fraction)),
+                  hard_cap - staging)
+      if cache < 1:
+        raise ValueError(
+            f"class {name}: no room for a serve hot cache "
+            f"(staging_grps={staging}, {lay.phys_rows:,} serve physical "
+            "rows); shrink staging_grps or raise cache_fraction's "
+            "denominator by serving the class all-device.")
+      spec = TierSpec(name=name, rows=lay.rows, rpp=rpp,
+                      cache_grps=cache, staging_grps=staging)
+      self.classes[key] = _ServeTierClass(
+          key=key, name=name, spec=spec, layout_logical=lay,
+          spill_cap_grps=hard_cap - cache)
+    self.tier_specs: Dict[str, TierSpec] = {
+        c.name: c.spec for c in self.classes.values()}
+
+  def by_name(self, name: str) -> _ServeTierClass:
+    for c in self.classes.values():
+      if c.name == name:
+        return c
+    raise KeyError(name)
+
+
+class ServeEngine:
+  """Host-side driver: frozen tables in, asynchronous predictions out.
+
+  Owns the jitted serve step (one per traced batch/staging shape), and
+  for tiered plans the serve-geometry residency stack: a
+  ``HostTierStore`` holding the stripped cold images (f32 or int8) with
+  the resident set seeded from the export-time observed-count ranking,
+  and a ``TieredPrefetcher`` whose classify/stage path uploads each
+  dispatch's cold rows — hot ids are served from the device cache, cold
+  ids from the host image, and the upload overlaps the previous
+  dispatch's device work (jax dispatch is asynchronous). Nothing is
+  ever written back: serve images are immutable.
+
+  ``dispatch`` returns the (not-yet-materialized) device predictions so
+  callers — the micro-batcher above all — can pipeline; ``predict``
+  blocks and returns numpy.
+  """
+
+  def __init__(self, model, plan: DistEmbeddingStrategy,
+               artifact, mesh=None, axis_name: str = "mp",
+               tier_config: Optional[ServeTierConfig] = None,
+               with_metrics: bool = False,
+               donate_batch: bool = False):
+    if isinstance(artifact, FrozenTables):
+      state = frozen_device_state(artifact, plan, mesh, axis_name)
+      host_images, ranking = artifact.host_images, artifact.ranking
+    elif isinstance(artifact, ServeArtifact):
+      state = dict(artifact.state)
+      state["serve"] = dict(state["serve"])
+      host_images, ranking = artifact.host_images, artifact.ranking
+    else:
+      raise TypeError(
+          f"artifact must be a FrozenTables (export.freeze) or "
+          f"ServeArtifact (export.load), got {type(artifact)!r}")
+    self.model = model
+    self.plan = plan
+    self.mesh = mesh
+    self.axis_name = axis_name
+    self.meta = artifact.meta
+    self.quantize = artifact.quantize
+    self.with_metrics = with_metrics
+    self.donate_batch = donate_batch
+    self._steps: Dict[Any, Any] = {}
+
+    self.tplan: Optional[ServeTierPlan] = None
+    self.prefetcher = None
+    if host_images:
+      from ..tiering import HostTierStore, TieredPrefetcher
+      self.tplan = ServeTierPlan(plan, self.meta,
+                                 tier_config or ServeTierConfig())
+      store = HostTierStore(
+          self.tplan,
+          dtype=np.int8 if self.quantize == "int8" else np.float32)
+      for name, images in host_images.items():
+        for r, img in enumerate(images):
+          store.set_image(name, r, img)
+      store.warm_start({n: ranking[n] for n in host_images})
+      self.store = store
+      self.prefetcher = TieredPrefetcher(self.tplan, store, mesh,
+                                         axis_name)
+      state["serve"].update(store.build_fused(mesh, axis_name))
+    self.state = state
+
+  @property
+  def tiered(self) -> bool:
+    return self.prefetcher is not None
+
+  def _step_for(self, batch_example, s_eff=None):
+    numerical, cats = batch_example
+    key = (numerical.shape, tuple(np.shape(c) for c in cats),
+           tuple(sorted(s_eff.items())) if s_eff else None)
+    step = self._steps.get(key)
+    if step is None:
+      step = make_serve_step(
+          self.model, self.plan, self.meta, self.mesh, self.state,
+          batch_example, axis_name=self.axis_name,
+          tier_specs=self.tplan.tier_specs if self.tiered else None,
+          with_metrics=self.with_metrics,
+          donate_batch=self.donate_batch)
+      self._steps[key] = step
+    return step
+
+  def dispatch(self, numerical, cats):
+    """One device dispatch; returns device predictions WITHOUT blocking
+    (jax async dispatch — the next dispatch's classify/stage overlaps
+    this one's device work). With ``with_metrics`` on a tiered plan,
+    returns ``(preds, metrics)``."""
+    cats = tuple(np.asarray(c) for c in cats)
+    numerical = np.asarray(numerical)
+    staged = self.prefetcher.prepare(list(cats)) if self.tiered else None
+    step = self._step_for((numerical, cats),
+                          staged.s_eff if staged else None)
+    bt = shard_batch((numerical, cats), self.mesh, self.axis_name)
+    if staged is not None:
+      return step(self.state, staged.device, *bt)
+    return step(self.state, *bt)
+
+  def predict(self, numerical, cats):
+    """Blocking convenience wrapper: numpy predictions."""
+    out = self.dispatch(numerical, cats)
+    if self.with_metrics and self.tiered:
+      preds, metrics = out
+      return np.asarray(preds), jax.tree_util.tree_map(np.asarray, metrics)
+    return np.asarray(out)

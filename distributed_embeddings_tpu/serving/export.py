@@ -1,0 +1,523 @@
+"""Frozen-table artifact export: train state -> inference image.
+
+The packed training buffers carry ``1 + n_aux`` lanes per logical row —
+the table row plus its interleaved optimizer state (`ops/packed_table`).
+Serving gathers never touch the aux lanes, yet every serve-time gather
+of the training image moves them (2x the bytes for adagrad, 3x for
+adam) and every byte of HBM they occupy is a row the hot cache cannot
+hold. :func:`freeze` strips them into a contiguous **inference image**:
+
+- **f32**: the packed layout with ``n_aux=0`` — same physical-row
+  machinery (128-lane rows, sub-row packing), just denser: a width-16
+  adagrad class goes from 4 to 8 logical rows per physical row.
+- **int8**: per-row symmetric quantization. Each logical row stores
+  ``width`` int8 lanes ``q = round(row / scale)`` with
+  ``scale = max|row| / 127`` — plus the row's f32 scale bit-packed into
+  4 trailing int8 lanes, mirroring the fp8 wire's amax-scale trick
+  (`parallel/wire.py`): the scale travels WITH the row, so the serve
+  gather dequantizes in one fused multiply with no second lookup. The
+  per-row dequantization error is bounded by ``scale / 2 =
+  max|row| / 254 < 2^-7 * max|row|``.
+
+Both forms ride :class:`~..ops.packed_table.PackedLayout` (its pack /
+gather arithmetic is dtype-agnostic — for int8 the "lanes" are bytes),
+so the serve engine reuses the row-bound gather path unchanged.
+
+Artifact format — a directory written through the checkpoint layer's
+durable protocol (every file fsynced, per-file crc32+size table in a
+manifest written LAST, atomic rename; ``checkpoint.verify`` validates
+it):
+
+    manifest.json                      'serve' section: quantize mode +
+                                       per-class geometry; plan
+                                       fingerprint; step
+    serve_<class>_r<rank>.npy          device-tier stripped packed blocks
+    serve_cold_<class>_r<rank>.npy     host-tier stripped images
+    serve_ranking.npz                  per host-tier class/rank: serve
+                                       physical rows by export-time
+                                       observed-count priority (seeds
+                                       the serve cache's resident set)
+    dense.npz / emb_dense.npz          model params + MXU-dense tables
+                                       (small by definition; kept f32)
+
+Export is a single-controller operation (the serving pods load the
+artifact read-only); multi-controller exports are refused.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..checkpoint import (
+    _crc32_file,
+    _flatten_with_paths,
+    _fsync_path,
+    _plan_fingerprint,
+    publish_manifest_last,
+)
+from ..checkpoint import verify as verify_dir
+from ..layers.dist_model_parallel import hybrid_partition_specs
+from ..layers.planner import DistEmbeddingStrategy
+from ..ops.packed_table import PackedLayout, SparseRule
+from ..parallel.lookup_engine import (
+    DistributedLookup,
+    class_param_name,
+    padded_rows,
+)
+from ..resilience import faultinject
+
+SERVE_FORMAT_VERSION = 1
+
+# trailing int8 lanes per logical row carrying the row's f32 scale
+# (4 bytes bitcast into 4 single-byte lanes — the fp8 wire's trick at
+# row granularity)
+INT8_SCALE_LANES = 4
+
+QUANTIZE_MODES = ("f32", "int8")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeClassMeta:
+  """Geometry of one sparse class's inference image."""
+
+  name: str
+  rows: int           # logical rows (= padded_rows of the class)
+  width: int          # table width (f32 output lanes after dequant)
+  tier: str           # 'device' | 'host'
+  quantize: str       # 'f32' | 'int8'
+  # The training layout's rows-per-physical-row when the train rule
+  # interleaved aux lanes into narrow rows. The eval step's multi-hot
+  # combine on such classes sums window-MASKED physical rows and folds
+  # the rpp windows per bag — a specific fp-addition grouping — and the
+  # f32 serve path replicates that grouping to stay BIT-exact against
+  # eval (engine._combine_masked_order). 1 = the generic h-axis sum.
+  combine_rpp: int = 1
+
+  @property
+  def lanes(self) -> int:
+    """int8 lanes (bytes) or f32 lanes per stored logical row."""
+    return self.width + (INT8_SCALE_LANES if self.quantize == "int8" else 0)
+
+  @property
+  def packed(self) -> PackedLayout:
+    """Physical layout of the inference image (lane unit = element)."""
+    return PackedLayout(rows=self.rows, width=self.lanes, n_aux=0)
+
+  @property
+  def np_dtype(self):
+    return np.int8 if self.quantize == "int8" else np.float32
+
+  def to_json(self) -> Dict[str, Any]:
+    lay = self.packed
+    return {"rows": self.rows, "width": self.width, "tier": self.tier,
+            "quantize": self.quantize, "combine_rpp": self.combine_rpp,
+            "phys_rows": lay.phys_rows, "phys_width": lay.phys_width,
+            "dtype": str(np.dtype(self.np_dtype))}
+
+  @classmethod
+  def from_json(cls, name: str, d: Dict[str, Any]) -> "ServeClassMeta":
+    return cls(name=name, rows=int(d["rows"]), width=int(d["width"]),
+               tier=d["tier"], quantize=d["quantize"],
+               combine_rpp=int(d.get("combine_rpp", 1)))
+
+
+def serve_layout(meta: ServeClassMeta) -> PackedLayout:
+  """The inference image's :class:`PackedLayout` (alias of
+  ``meta.packed``, exported for callers building layouts dicts)."""
+  return meta.packed
+
+
+# ---------------------------------------------------------------------------
+# int8 row codec
+# ---------------------------------------------------------------------------
+
+
+def quantize_rows_int8(table: np.ndarray) -> np.ndarray:
+  """``[N, w]`` f32 rows -> ``[N, w + 4]`` int8 rows-with-scale.
+
+  Symmetric per-row quantization: ``scale = max|row| / 127`` (1.0 for
+  all-zero rows — nothing to quantize), ``q = clip(round(row / scale),
+  -127, 127)``, the f32 scale bitcast into the 4 trailing int8 lanes.
+  ``|row - q * scale| <= scale / 2 < 2^-7 * max|row|`` per element."""
+  table = np.asarray(table, np.float32)
+  amax = np.max(np.abs(table), axis=1)
+  scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+  q = np.clip(np.rint(table / scale[:, None]), -127, 127).astype(np.int8)
+  lanes = scale.view(np.uint8).reshape(-1, INT8_SCALE_LANES).view(np.int8)
+  return np.concatenate([q, lanes], axis=1)
+
+
+def dequantize_rows_int8(qrows: np.ndarray) -> np.ndarray:
+  """Inverse of :func:`quantize_rows_int8` (host-side; the device path
+  fuses this into the gather, `engine._dequant_rows`)."""
+  q = qrows[:, :-INT8_SCALE_LANES].astype(np.float32)
+  scale = np.ascontiguousarray(
+      qrows[:, -INT8_SCALE_LANES:]).view(np.uint8).view(
+          np.float32).reshape(-1)
+  return q * scale[:, None]
+
+
+# ---------------------------------------------------------------------------
+# freeze: train state -> host-side inference blocks
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FrozenTables:
+  """Host-side inference image of one train state (see :func:`freeze`)."""
+
+  quantize: str
+  step: int
+  meta: Dict[str, ServeClassMeta]
+  device_blocks: Dict[str, List[np.ndarray]]  # per rank, serve layout
+  host_images: Dict[str, List[np.ndarray]]    # per rank, serve layout
+  ranking: Dict[str, List[np.ndarray]]        # per rank, serve phys rows
+  dense: Any                                  # np-leaved pytrees
+  emb_dense: Any
+
+
+def _strip_block(train_lay: PackedLayout, meta: ServeClassMeta,
+                 block: np.ndarray) -> np.ndarray:
+  """One rank's packed TRAIN block -> its serve block: unpack (a pure
+  reshape — the aux lanes fall away), optionally quantize, re-pack into
+  the denser serve layout."""
+  tbl, _aux = train_lay.unpack(np.asarray(block))
+  tbl = np.ascontiguousarray(tbl, np.float32)
+  rows = quantize_rows_int8(tbl) if meta.quantize == "int8" else tbl
+  return np.asarray(meta.packed.pack(rows), meta.np_dtype)
+
+
+def _serve_ranking(meta: ServeClassMeta, train_lay: PackedLayout,
+                   counts: np.ndarray) -> np.ndarray:
+  """Training observed counts (per TRAIN physical row) -> serve physical
+  rows in descending-priority order. Counts spread uniformly over the
+  train row's logical rows and re-sum per serve physical row (the two
+  layouts pack different logical spans per row); ties break lowest row
+  first, matching the store's default warm start."""
+  rpp_t = train_lay.rows_per_phys
+  sl = meta.packed
+  logical = np.repeat(np.asarray(counts, np.int64), rpp_t)[:meta.rows]
+  pad = sl.phys_rows * sl.rows_per_phys - meta.rows
+  if pad:
+    logical = np.concatenate([logical, np.zeros((pad,), np.int64)])
+  per_grp = logical.reshape(sl.phys_rows, sl.rows_per_phys).sum(axis=1)
+  return np.argsort(-per_grp, kind="stable").astype(np.int32)
+
+
+def _to_host_tree(tree):
+  from ..checkpoint import _to_host
+  return jax.tree_util.tree_map(_to_host, tree)
+
+
+def freeze(plan: DistEmbeddingStrategy, rule: SparseRule,
+           state: Dict[str, Any], quantize: str = "f32",
+           store=None) -> FrozenTables:
+  """Strip a fused train state into host-side inference blocks.
+
+  Args:
+    rule: the TRAINING rule (its ``n_aux`` defines the aux lanes being
+      stripped; no optimizer math runs here).
+    quantize: ``'f32'`` (stripped, full precision — bit-exact serving)
+      or ``'int8'`` (per-row symmetric quantization with packed scales).
+      Applies to sparse-kind classes; MXU-dense tables and the model's
+      dense params stay f32 (small by definition — the quantization win
+      lives in the row-gather bytes).
+    store: the run's ``HostTierStore`` for tiered plans (flushed first;
+      cold images strip rank-by-rank and the observed counts become the
+      serve cache's priority ranking).
+  """
+  if quantize not in QUANTIZE_MODES:
+    raise ValueError(f"unknown quantize mode {quantize!r}; "
+                     f"have {list(QUANTIZE_MODES)}")
+  if store is None and plan.host_tier_class_keys():
+    raise ValueError(
+        "plan has host-tier classes but no HostTierStore was passed: "
+        "the cold images hold the authoritative majority of the rows. "
+        "Pass the run's store via freeze(..., store=store).")
+  engine = DistributedLookup(plan)
+  layouts = engine.fused_layouts(
+      rule, rows_overrides=store.tplan.rows_overrides if store else None)
+  tiered_names = frozenset(store.tplan.tier_specs) if store is not None \
+      else frozenset()
+  if store is not None:
+    if not store.owns_all:
+      raise NotImplementedError(
+          "freeze/export is a single-controller operation (the serving "
+          "pods load the artifact read-only); a rank-owner-sharded "
+          "store cannot supply every rank's image here.")
+    store.flush(state["fused"])
+
+  meta: Dict[str, ServeClassMeta] = {}
+  device_blocks: Dict[str, List[np.ndarray]] = {}
+  host_images: Dict[str, List[np.ndarray]] = {}
+  ranking: Dict[str, List[np.ndarray]] = {}
+  for key in plan.class_keys:
+    cp = plan.classes[key]
+    if cp.kind != "sparse":
+      continue
+    name = class_param_name(*key)
+    rows = padded_rows(plan, key)
+    tier = "host" if name in tiered_names else "device"
+    # the full-vocabulary train layout: for tiered classes the device
+    # buffer is compact, but the stripped image covers the whole class
+    # (the host image is the authoritative copy)
+    full_lay = PackedLayout(rows=rows, width=cp.width, n_aux=rule.n_aux)
+    m = ServeClassMeta(
+        name=name, rows=rows, width=cp.width, tier=tier, quantize=quantize,
+        combine_rpp=(full_lay.rows_per_phys
+                     if rule.n_aux and full_lay.rows_per_phys > 1 else 1))
+    meta[name] = m
+    if tier == "host":
+      host_images[name] = [
+          _strip_block(full_lay, m, store.images[name][r])
+          for r in range(plan.world_size)]
+      ranking[name] = [
+          _serve_ranking(m, full_lay, store.counts[name][r])
+          for r in range(plan.world_size)]
+    else:
+      arr = state["fused"][name]
+      if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+        raise NotImplementedError(
+            "freeze/export indexes the global fused buffers and requires "
+            "fully-addressable arrays (single-controller); run the "
+            "export on a single-controller restore of the checkpoint.")
+      lay = layouts[name]
+      # one rank block at a time: peak host memory is one train block
+      # plus its serve block, never the class
+      device_blocks[name] = [
+          _strip_block(lay, m, np.asarray(jax.device_get(
+              arr[r * lay.phys_rows:(r + 1) * lay.phys_rows])))
+          for r in range(plan.world_size)]
+
+  from ..checkpoint import _to_host
+  return FrozenTables(
+      quantize=quantize, step=int(_to_host(state["step"])), meta=meta,
+      device_blocks=device_blocks, host_images=host_images,
+      ranking=ranking, dense=_to_host_tree(state["dense"]),
+      emb_dense=_to_host_tree(state["emb_dense"]))
+
+
+def place_state(state: Dict[str, Any], mesh=None,
+                axis_name: str = "mp") -> Dict[str, Any]:
+  """Device placement for a serve state dict: ``mp_table_*`` 2-D leaves
+  shard ``P(axis, None)`` (serve buffers, MXU-dense tables), everything
+  else replicates."""
+  if mesh is None:
+    return jax.tree_util.tree_map(jnp.asarray, state)
+  specs = hybrid_partition_specs(state, axis_name)
+  return jax.tree_util.tree_map(
+      lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs)
+
+
+def frozen_device_state(frozen: FrozenTables, plan: DistEmbeddingStrategy,
+                        mesh=None, axis_name: str = "mp") -> Dict[str, Any]:
+  """Build the serve state dict from in-memory frozen blocks (the
+  export-free path — tests, the jaxpr audit, single-process serving).
+  Tiered classes' compact device buffers are NOT built here; that is
+  :class:`~.engine.ServeEngine`'s job (it owns the serve cache)."""
+  serve = {name: np.concatenate(blocks)
+           for name, blocks in frozen.device_blocks.items()}
+  return place_state(
+      {"dense": frozen.dense, "emb_dense": frozen.emb_dense,
+       "serve": serve}, mesh, axis_name)
+
+
+# ---------------------------------------------------------------------------
+# durable artifact write / read
+# ---------------------------------------------------------------------------
+
+
+def export(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
+           state: Dict[str, Any], quantize: str = "f32", store=None,
+           extra: Optional[Dict[str, Any]] = None) -> FrozenTables:
+  """Freeze the train state and write the serve artifact at ``path``.
+
+  Rides the checkpoint durability protocol: every file fsynced, per-file
+  crc32+size table in a manifest written LAST (``serve`` section carries
+  the quantize mode and per-class geometry), atomic rename. A crash at
+  any point leaves either a manifest-less ``.tmp`` (detectably
+  incomplete) or a complete artifact; ``checkpoint.verify`` validates a
+  published one. Returns the frozen blocks (callers that serve from the
+  exporting process can skip the read-back)."""
+  if jax.process_count() > 1:
+    raise NotImplementedError(
+        "export is a single-controller operation: the serving pods load "
+        "the artifact read-only. Save a checkpoint from the "
+        "multi-controller run and export from a single-controller "
+        "restore.")
+  frozen = freeze(plan, rule, state, quantize=quantize, store=store)
+
+  tmp = path + ".tmp"
+  if os.path.exists(tmp):
+    import shutil
+    shutil.rmtree(tmp)
+  os.makedirs(tmp)
+  checksums: Dict[str, Dict[str, int]] = {}
+
+  def _seal(fpath: str) -> None:
+    _fsync_path(fpath)
+    faultinject.fire("ckpt_write", path=fpath)
+    checksums[os.path.basename(fpath)] = _crc32_file(fpath)
+
+  for name, blocks in sorted(frozen.device_blocks.items()):
+    for r, block in enumerate(blocks):
+      fpath = os.path.join(tmp, f"serve_{name}_r{r}.npy")
+      np.save(fpath, block)
+      _seal(fpath)
+  for name, images in sorted(frozen.host_images.items()):
+    for r, image in enumerate(images):
+      fpath = os.path.join(tmp, f"serve_cold_{name}_r{r}.npy")
+      np.save(fpath, image)
+      _seal(fpath)
+  if frozen.ranking:
+    fpath = os.path.join(tmp, "serve_ranking.npz")
+    np.savez(fpath, **{f"{name}/r{r}": order
+                       for name, orders in sorted(frozen.ranking.items())
+                       for r, order in enumerate(orders)})
+    _seal(fpath)
+  for part, tree in (("dense", frozen.dense),
+                     ("emb_dense", frozen.emb_dense)):
+    fpath = os.path.join(tmp, f"{part}.npz")
+    np.savez(fpath, **_flatten_with_paths(tree))
+    _seal(fpath)
+
+  manifest: Dict[str, Any] = {
+      "format_version": SERVE_FORMAT_VERSION,
+      "kind": "serve",
+      "step": frozen.step,
+      "rule": {"name": rule.name, "n_aux": rule.n_aux},
+      "plan": _plan_fingerprint(plan),
+      "serve": {
+          "quantize": quantize,
+          "classes": {n: m.to_json() for n, m in sorted(frozen.meta.items())},
+      },
+      "checksums": checksums,
+  }
+  if extra is not None:
+    manifest["extra"] = extra
+  publish_manifest_last(tmp, path, manifest)
+  return frozen
+
+
+@dataclasses.dataclass
+class ServeArtifact:
+  """A loaded serve artifact, device-placed where that is unambiguous.
+
+  ``state`` holds ``{'dense', 'emb_dense', 'serve'}`` with the
+  device-tier classes' inference buffers in ``'serve'``; host-tier
+  classes appear in ``host_images``/``ranking`` instead and become the
+  serve cache + cold store when a :class:`~.engine.ServeEngine` is built
+  on this artifact."""
+
+  quantize: str
+  step: int
+  meta: Dict[str, ServeClassMeta]
+  state: Dict[str, Any]
+  host_images: Dict[str, List[np.ndarray]]
+  ranking: Dict[str, List[np.ndarray]]
+
+
+def _unflatten_paths(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
+  """Path-keyed npz dict -> nested plain dict (serve states carry no
+  optimizer pytrees, so plain dicts reproduce the structure)."""
+  out: Dict[str, Any] = {}
+  for key in sorted(flat):
+    parts = key.split("/")
+    d = out
+    for p in parts[:-1]:
+      d = d.setdefault(p, {})
+    d[parts[-1]] = flat[key]
+  return out
+
+
+def load(path: str, plan: DistEmbeddingStrategy, mesh=None,
+         axis_name: str = "mp",
+         verify_integrity: bool = True) -> ServeArtifact:
+  """Load a serve artifact written by :func:`export`.
+
+  The plan must match the exporting run's exactly (fingerprint
+  equality): serve artifacts do not re-shard elastically — re-export
+  from the checkpoint under the new plan instead (the export is cheap;
+  a serve-side re-shard would duplicate checkpoint.py's streaming
+  machinery for a path that never needs to be fast)."""
+  import json
+  if verify_integrity:
+    problems = verify_dir(path)
+    if problems:
+      raise ValueError(
+          f"serve artifact {path!r} failed integrity verification: "
+          + "; ".join(problems))
+  with open(os.path.join(path, "manifest.json")) as f:
+    manifest = json.load(f)
+  if manifest.get("kind") != "serve":
+    raise ValueError(
+        f"{path!r} is not a serve artifact (manifest kind "
+        f"{manifest.get('kind')!r}); training checkpoints restore via "
+        "checkpoint.restore")
+  if manifest["format_version"] != SERVE_FORMAT_VERSION:
+    raise ValueError(f"serve artifact format {manifest['format_version']} "
+                     f"unsupported (expected {SERVE_FORMAT_VERSION})")
+  want = _plan_fingerprint(plan)
+  if manifest["plan"] != want:
+    diff = sorted(k for k in set(manifest["plan"]) | set(want)
+                  if manifest["plan"].get(k) != want.get(k))
+    raise ValueError(
+        "serve artifact plan does not match the current plan (differs "
+        f"in {diff}): serve artifacts do not re-shard — re-export from "
+        "the checkpoint under this plan.")
+
+  meta = {n: ServeClassMeta.from_json(n, d)
+          for n, d in manifest["serve"]["classes"].items()}
+  world = plan.world_size
+
+  serve: Dict[str, Any] = {}
+  host_images: Dict[str, List[np.ndarray]] = {}
+  ranking: Dict[str, List[np.ndarray]] = {}
+  rank_npz = None
+  if any(m.tier == "host" for m in meta.values()):
+    with np.load(os.path.join(path, "serve_ranking.npz")) as z:
+      rank_npz = dict(z)
+  for name, m in sorted(meta.items()):
+    lay = m.packed
+    if m.tier == "host":
+      host_images[name] = [
+          np.load(os.path.join(path, f"serve_cold_{name}_r{r}.npy"))
+          for r in range(world)]
+      ranking[name] = [rank_npz[f"{name}/r{r}"] for r in range(world)]
+      continue
+    files = [os.path.join(path, f"serve_{name}_r{r}.npy")
+             for r in range(world)]
+    shape = (world * lay.phys_rows, lay.phys_width)
+    if mesh is None:
+      serve[name] = jnp.asarray(np.concatenate(
+          [np.load(f) for f in files]))
+    else:
+      sharding = NamedSharding(mesh, P(axis_name, None))
+
+      def cb(index, files=files, lay=lay):
+        rank = (index[0].start or 0) // lay.phys_rows
+        # mmap: each device materializes exactly its rank block
+        return np.asarray(np.load(files[rank], mmap_mode="r"))
+
+      serve[name] = jax.make_array_from_callback(shape, sharding, cb)
+
+  for part in ("dense", "emb_dense"):
+    with np.load(os.path.join(path, f"{part}.npz")) as z:
+      flat = dict(z)
+    tree = _unflatten_paths(flat)
+    placed = place_state({part: tree}, mesh, axis_name)[part]
+    if part == "dense":
+      dense = placed
+    else:
+      emb_dense = placed
+  state = {"dense": dense, "emb_dense": emb_dense, "serve": serve}
+  return ServeArtifact(quantize=manifest["serve"]["quantize"],
+                       step=int(manifest["step"]), meta=meta, state=state,
+                       host_images=host_images, ranking=ranking)

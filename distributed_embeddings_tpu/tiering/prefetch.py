@@ -197,7 +197,9 @@ class TieredPrefetcher:
         rows = self._gather(c.name, rank, g)  # bounds-checked, retried
         nbytes += rows.nbytes
         r_blocks.append(np.concatenate(
-            [rows, np.zeros((pad, lay.phys_width), np.float32)]))
+            # pad in the image dtype: f32 training stores, and the serve
+            # tier's stripped f32/int8 images ride the same pipeline
+            [rows, np.zeros((pad, lay.phys_width), rows.dtype)]))
       grps_dev[c.name] = self.store._put(
           np.concatenate(g_blocks), self.mesh, self.axis_name)
       rows_dev[c.name] = self.store._put(

@@ -49,12 +49,20 @@ from .plan import TieringPlan
 
 
 class HostTierStore:
-  """Cold-store images + resident-set state for one :class:`TieringPlan`."""
+  """Cold-store images + resident-set state for one :class:`TieringPlan`.
+
+  ``dtype`` parametrizes the image element type: training stores are f32
+  (the default — packed f32 lanes with interleaved optimizer state), and
+  the serving subsystem reuses this class for its stripped inference
+  images (f32 or int8 rows with bit-packed scale columns) by passing a
+  serve-geometry plan duck-type plus the serve dtype."""
 
   def __init__(self, tplan: TieringPlan,
-               owned_ranks: Optional[Iterable[int]] = None):
+               owned_ranks: Optional[Iterable[int]] = None,
+               dtype=np.float32):
     self.tplan = tplan
     self.plan = tplan.plan
+    self.dtype = np.dtype(dtype)
     world = self.plan.world_size
     if owned_ranks is None:
       self.owned_ranks = tuple(range(world))
@@ -74,7 +82,7 @@ class HostTierStore:
     for c in tplan.classes.values():
       lay = c.layout_logical
       self.images[c.name] = [
-          np.zeros((lay.phys_rows, lay.phys_width), np.float32)
+          np.zeros((lay.phys_rows, lay.phys_width), self.dtype)
           if r in owned else None for r in range(world)]
       self.resident_map[c.name] = [
           np.full((lay.phys_rows,), -1, np.int32)
@@ -136,7 +144,7 @@ class HostTierStore:
     if image.shape != (lay.phys_rows, lay.phys_width):
       raise ValueError(f"image shape {image.shape}, expected "
                        f"{(lay.phys_rows, lay.phys_width)}")
-    self.images[name][rank] = np.asarray(image, np.float32).copy()
+    self.images[name][rank] = np.asarray(image, self.dtype).copy()
 
   def warm_start(self, ranking: Optional[Dict[str, List[np.ndarray]]] = None
                  ) -> None:
@@ -229,7 +237,7 @@ class HostTierStore:
     return np.concatenate([
         cache_rows,
         np.zeros((c.spec.staging_grps, c.layout_logical.phys_width),
-                 np.float32)])
+                 self.dtype)])
 
   def _global_or_callback(self, name: str, per_rank_rows: int, width,
                           block_of, mesh, axis_name: str):

@@ -1269,7 +1269,16 @@ def make_sparse_eval_step(model, plan: DistEmbeddingStrategy,
   capacity — those predictions read the wrong rows) and REQUIRE
   ``with_metrics`` here, for the same reason the train builders require
   the guard. Counters are global (psum'd across the mesh) replicated
-  scalars; one compare+reduce per input, fused into the step."""
+  scalars; one compare+reduce per input, fused into the step.
+
+  Donation contract: eval/serve builders NEVER donate the state — a
+  repeated-call step against one frozen/eval state must not invalidate
+  it (the train builders donate because each call consumes its input
+  state; an eval state is read thousands of times). Both jit paths
+  below pass an explicit empty ``donate_argnums``, and
+  ``tests/test_serving.py`` pins the repeated-call behavior; the
+  serving subsystem (``serving.make_serve_step``) inherits the same
+  contract, donating at most the per-dispatch request arrays."""
   has_dedup_cap = getattr(plan, "dedup_capacity", None) is not None
   if has_dedup_cap and not with_metrics:
     raise ValueError(
@@ -1306,7 +1315,10 @@ def make_sparse_eval_step(model, plan: DistEmbeddingStrategy,
     return preds, metrics
 
   if mesh is None:
-    return jax.jit(local_eval)
+    # donate_argnums stays EMPTY (see the docstring's donation
+    # contract): donating argnum 0 here would invalidate the fused
+    # state on the first call and poison every later eval/serve call
+    return jax.jit(local_eval, donate_argnums=())
   sspec = hybrid_partition_specs(state, axis_name)
   bspec = jax.tree_util.tree_map(
       lambda _: P(axis_name), tuple(batch_example[:2]))
@@ -1320,7 +1332,7 @@ def make_sparse_eval_step(model, plan: DistEmbeddingStrategy,
   return jax.jit(shard_map(
       local_eval, mesh=mesh,
       in_specs=(sspec,) + bspec,
-      out_specs=out_specs))
+      out_specs=out_specs), donate_argnums=())
 
 
 def make_eval_step(pred_fn: Callable, mesh: Optional[Mesh],

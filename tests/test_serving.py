@@ -1,0 +1,638 @@
+"""Serving subsystem tests (`distributed_embeddings_tpu/serving/`).
+
+The contracts under test:
+
+- **f32 serving is BIT-exact** against ``make_sparse_eval_step`` across
+  the parity matrix — raw and dedup'd routing, ragged value streams,
+  row-sliced shards, tiered residency, world 1/2/4. Stripping the
+  optimizer lanes is a storage decision, not a numeric one (including
+  the narrow multi-hot combine, whose fp-addition grouping the serve
+  path replicates from the eval step's masked-window fast path).
+- **int8 dequantization error is bounded** per output element by
+  ``h * 2^-7 * max|row|`` (per-row symmetric scales bound each row's
+  error at ``max|row| / 254``; the combiner sums at most ``h`` rows —
+  the asserted bound carries a ~2x margin).
+- **eval/serve steps never donate parameter buffers**: a repeated-call
+  step against one frozen state returns identical results, with or
+  without request-array donation.
+- **export -> load round-trips** through the crc32-manifest-last durable
+  protocol, tiered cold images included; corruption is detected with
+  the file named.
+- **the micro-batcher de-interleaves exactly**: every request gets
+  precisely its own rows back under random arrival interleavings, and
+  the bounded queue sheds load with an exactly-counted rejection.
+"""
+
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_embeddings_tpu import checkpoint
+from distributed_embeddings_tpu.layers.dist_model_parallel import (
+    get_weights,
+    set_weights,
+)
+from distributed_embeddings_tpu.layers.embedding import TableConfig
+from distributed_embeddings_tpu.layers.planner import DistEmbeddingStrategy
+from distributed_embeddings_tpu.models import DLRM
+from distributed_embeddings_tpu.models.dlrm import _dlrm_initializer
+from distributed_embeddings_tpu.models.synthetic import power_law_ids
+from distributed_embeddings_tpu.ops.packed_table import sparse_rule
+from distributed_embeddings_tpu.ops.ragged import RaggedIds
+from distributed_embeddings_tpu.parallel import create_mesh
+from distributed_embeddings_tpu.parallel.lookup_engine import PAD_ID
+from distributed_embeddings_tpu.serving import (
+    MicroBatcher,
+    Rejected,
+    ServeEngine,
+    ServeTierConfig,
+    dequantize_rows_int8,
+    make_serve_step,
+    quantize_rows_int8,
+)
+from distributed_embeddings_tpu.serving.export import (
+    freeze,
+    frozen_device_state,
+)
+from distributed_embeddings_tpu.serving.export import export as serve_export
+from distributed_embeddings_tpu.serving.export import load as serve_load
+from distributed_embeddings_tpu.tiering import (
+    HostTierStore,
+    TieringConfig,
+    TieringPlan,
+    init_tiered_state_from_params,
+)
+from distributed_embeddings_tpu.training import (
+    init_sparse_state,
+    make_sparse_eval_step,
+    shard_batch,
+    shard_params,
+)
+
+
+class ActsModel:
+  """Model stub returning the concatenated embedding activations —
+  eval/serve parity at the lookup layer, every table visible."""
+
+  def apply(self, variables, numerical, cats, emb_acts=None):
+    del variables, numerical, cats
+    return jnp.concatenate(list(emb_acts), axis=-1)
+
+
+SIZES = [131, 97, 53, 40, 67]
+WIDTHS = [16, 16, 8, 8, 16]
+HOTNESS = [3, 1, 3, 2, 1]
+
+
+def _fixture(world, combiner="sum", rule_name="adagrad", seed=0,
+             batch_per_dev=4, **plan_kw):
+  """Mixed fixture: multi-hot narrow w16 (the masked-combine fast path
+  under adagrad), w8 classes, PAD holes; known weights for bounds."""
+  rng = np.random.default_rng(seed)
+  tables = [TableConfig(s, w, combiner=combiner)
+            for s, w in zip(SIZES, WIDTHS)]
+  plan = DistEmbeddingStrategy(tables, world, "memory_balanced",
+                               dense_row_threshold=0,
+                               input_hotness=HOTNESS, **plan_kw)
+  weights = [rng.standard_normal((s, w)).astype(np.float32)
+             for s, w in zip(SIZES, WIDTHS)]
+  params = {"embeddings": {k: jnp.asarray(v)
+                           for k, v in set_weights(plan, weights).items()}}
+  rule = sparse_rule(rule_name, 0.05)
+  opt = optax.sgd(0.01)
+  mesh = create_mesh(world) if world > 1 else None
+  state = shard_params(init_sparse_state(plan, params, rule, opt), mesh)
+  b = batch_per_dev * world
+  ids = []
+  for s, h in zip(SIZES, HOTNESS):
+    x = rng.integers(0, s, (b, h)).astype(np.int32)
+    x[rng.random(x.shape) < 0.25] = PAD_ID
+    ids.append(x)
+  numerical = rng.standard_normal((b, 4)).astype(np.float32)
+  labels = rng.integers(0, 2, b).astype(np.float32)
+  batch = (jnp.asarray(numerical), tuple(jnp.asarray(x) for x in ids),
+           jnp.asarray(labels))
+  return plan, rule, mesh, state, batch, weights
+
+
+def _eval_preds(plan, rule, mesh, state, batch):
+  ev = make_sparse_eval_step(ActsModel(), plan, rule, mesh, state, batch)
+  bt = shard_batch(batch, mesh)
+  return np.asarray(ev(state, *bt[:2])), bt
+
+
+def _serve_preds(plan, rule, mesh, state, batch, quantize,
+                 donate_batch=False):
+  frozen = freeze(plan, rule, state, quantize=quantize)
+  sstate = frozen_device_state(frozen, plan, mesh)
+  step = make_serve_step(ActsModel(), plan, frozen.meta, mesh, sstate,
+                         (batch[0], batch[1]), donate_batch=donate_batch)
+  bt = shard_batch(batch, mesh)
+  return np.asarray(step(sstate, *bt[:2])), (step, sstate, frozen)
+
+
+# ---------------------------------------------------------------------------
+# int8 row codec
+# ---------------------------------------------------------------------------
+
+
+def test_int8_roundtrip_error_bound():
+  rng = np.random.default_rng(1)
+  table = rng.standard_normal((200, 16)).astype(np.float32) * \
+      rng.uniform(0.01, 10.0, (200, 1)).astype(np.float32)
+  table[7] = 0.0  # all-zero row stays exactly zero
+  q = quantize_rows_int8(table)
+  assert q.dtype == np.int8 and q.shape == (200, 20)
+  deq = dequantize_rows_int8(q)
+  amax = np.abs(table).max(axis=1, keepdims=True)
+  assert np.all(np.abs(deq - table) <= amax / 254.0 + 1e-12)
+  np.testing.assert_array_equal(deq[7], 0.0)
+
+
+# ---------------------------------------------------------------------------
+# f32 parity matrix: bit-exact vs make_sparse_eval_step
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("world", [1, 2, 4])
+@pytest.mark.parametrize("dedup", [False, True])
+def test_f32_serve_bitexact(world, dedup):
+  plan, rule, mesh, state, batch, _ = _fixture(
+      world, combiner="sum", dedup_exchange=dedup)
+  want, _ = _eval_preds(plan, rule, mesh, state, batch)
+  got, _ = _serve_preds(plan, rule, mesh, state, batch, "f32")
+  np.testing.assert_array_equal(want, got)
+
+
+def test_f32_serve_bitexact_mean_combiner():
+  plan, rule, mesh, state, batch, _ = _fixture(4, combiner="mean")
+  want, _ = _eval_preds(plan, rule, mesh, state, batch)
+  got, _ = _serve_preds(plan, rule, mesh, state, batch, "f32")
+  np.testing.assert_array_equal(want, got)
+
+
+def test_f32_serve_bitexact_row_sliced():
+  sizes = [96, 64, 48, 88]
+  tables = [TableConfig(s, 8, combiner="mean") for s in sizes]
+  plan = DistEmbeddingStrategy(tables, 4, "basic",
+                               row_slice_threshold=16 * 8,
+                               dense_row_threshold=0,
+                               input_hotness=[3, 3, 1, 2])
+  assert any(sh.row_sliced for shards in plan.rank_shards for sh in shards)
+  rng = np.random.default_rng(3)
+  weights = [rng.standard_normal((s, 8)).astype(np.float32) for s in sizes]
+  params = {"embeddings": {k: jnp.asarray(v)
+                           for k, v in set_weights(plan, weights).items()}}
+  rule = sparse_rule("adagrad", 0.05)
+  mesh = create_mesh(4)
+  state = shard_params(
+      init_sparse_state(plan, params, rule, optax.sgd(0.01)), mesh)
+  b = 8
+  ids = []
+  for s, h in zip(sizes, [3, 3, 1, 2]):
+    x = rng.integers(0, s, (b, h)).astype(np.int32)
+    x[rng.random(x.shape) < 0.2] = PAD_ID
+    ids.append(jnp.asarray(x))
+  batch = (jnp.zeros((b, 2), jnp.float32), tuple(ids),
+           jnp.zeros((b,), jnp.float32))
+  want, _ = _eval_preds(plan, rule, mesh, state, batch)
+  got, _ = _serve_preds(plan, rule, mesh, state, batch, "f32")
+  np.testing.assert_array_equal(want, got)
+
+
+def test_f32_serve_bitexact_ragged():
+  """A ragged value-stream input mixed with padded ones: the serve
+  lookup rides the raw stream exactly like eval (segment-sum combine
+  over identical values)."""
+  world = 4
+  tables = [TableConfig(60, 8, combiner="sum"),
+            TableConfig(40, 8, combiner="sum")]
+  plan = DistEmbeddingStrategy(tables, world, "basic",
+                               input_hotness=[-8, 2],
+                               dense_row_threshold=0)
+  rng = np.random.default_rng(5)
+  weights = [rng.standard_normal((c.input_dim, 8)).astype(np.float32)
+             for c in tables]
+  params = {"embeddings": {k: jnp.asarray(v)
+                           for k, v in set_weights(plan, weights).items()}}
+  rule = sparse_rule("adagrad", 0.05)
+  mesh = create_mesh(world)
+  state = shard_params(
+      init_sparse_state(plan, params, rule, optax.sgd(0.01)), mesh)
+
+  b_local, cap = 4, 8
+  values = rng.integers(0, 60, world * cap).astype(np.int32)
+  lengths = np.minimum(rng.integers(0, 5, (world, b_local)),
+                       cap // b_local)
+  splits = np.concatenate([np.concatenate([[0], np.cumsum(l)])
+                           for l in lengths]).astype(np.int32)
+  rg = RaggedIds(jnp.asarray(values), jnp.asarray(splits))
+  dense = jnp.asarray(
+      rng.integers(0, 40, (world * b_local, 2)).astype(np.int32))
+  b = world * b_local
+  batch = (jnp.zeros((b, 2), jnp.float32), (rg, dense),
+           jnp.zeros((b,), jnp.float32))
+  want, _ = _eval_preds(plan, rule, mesh, state, batch)
+  got, _ = _serve_preds(plan, rule, mesh, state, batch, "f32")
+  np.testing.assert_array_equal(want, got)
+
+
+# ---------------------------------------------------------------------------
+# int8 error bound vs the f32 eval step
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("combiner", ["sum", "mean"])
+def test_int8_serve_error_bound(combiner):
+  plan, rule, mesh, state, batch, weights = _fixture(4, combiner=combiner)
+  want, _ = _eval_preds(plan, rule, mesh, state, batch)
+  got, _ = _serve_preds(plan, rule, mesh, state, batch, "int8")
+  off = 0
+  for t, (w, h) in enumerate(zip(weights, HOTNESS)):
+    width = w.shape[1]
+    a = want[:, off:off + width]
+    b = got[:, off:off + width]
+    # per row |err| <= max|row| / 254; a sum-combined bag adds <= h rows
+    # (mean divides by the same count) -> h * 2^-7 * max|row| carries a
+    # ~2x margin
+    rows = h if combiner == "sum" else 1
+    bound = rows * (2.0 ** -7) * np.abs(w).max() + 1e-6
+    assert np.abs(a - b).max() <= bound, (t, np.abs(a - b).max(), bound)
+    off += width
+  # the quantization really narrowed something
+  assert np.abs(want - got).max() > 0
+
+
+# ---------------------------------------------------------------------------
+# tiered serving: device cache + stripped host image
+# ---------------------------------------------------------------------------
+
+
+def _tiered_fixture():
+  vocab = [5000, 300, 40]
+  width = 16
+  world = 4
+  mktab = lambda: [TableConfig(v, width, initializer=_dlrm_initializer(v))  # noqa: E731
+                   for v in vocab]
+  plan_b = DistEmbeddingStrategy(mktab(), world, "memory_balanced",
+                                 dense_row_threshold=0)
+  plan_t = DistEmbeddingStrategy(mktab(), world, "memory_balanced",
+                                 dense_row_threshold=0,
+                                 host_row_threshold=1000)
+  model = DLRM(vocab_sizes=vocab, embedding_dim=width,
+               bottom_mlp=(32, width), top_mlp=(32, 1), world_size=world,
+               strategy="memory_balanced", dense_row_threshold=0)
+  mesh = create_mesh(world)
+  rule = sparse_rule("adagrad", 0.05)
+  opt = optax.adam(1e-3)
+  r = np.random.default_rng(7)
+  b = 32
+  numerical = r.standard_normal((b, 13)).astype(np.float32)
+  cats = [power_law_ids(r, b, 1, v, 1.05).astype(np.int32)[:, 0]
+          for v in vocab]
+  labels = r.integers(0, 2, b).astype(np.float32)
+  batch = (numerical, cats, labels)
+  params_b = model.init(jax.random.PRNGKey(0), numerical, cats)["params"]
+  state_b = shard_params(init_sparse_state(plan_b, params_b, rule, opt),
+                         mesh)
+  tables_t = set_weights(plan_t, get_weights(plan_b,
+                                             params_b["embeddings"]))
+  params_t = {k: v for k, v in params_b.items() if k != "embeddings"}
+  params_t["embeddings"] = {k: jnp.asarray(v) for k, v in tables_t.items()}
+  tplan = TieringPlan(plan_t, rule,
+                      TieringConfig(cache_fraction=0.3, staging_grps=64))
+  store = HostTierStore(tplan)
+  state_t = shard_params(
+      init_tiered_state_from_params(tplan, store, rule, params_t, opt,
+                                    mesh=mesh), mesh)
+  return (plan_b, plan_t, model, mesh, rule, state_b, state_t, store,
+          batch)
+
+
+@pytest.mark.parametrize("quantize", ["f32", "int8"])
+def test_tiered_serve_vs_all_device_eval(quantize):
+  (plan_b, plan_t, model, mesh, rule, state_b, state_t, store,
+   batch) = _tiered_fixture()
+  numerical, cats, labels = batch
+  bt = shard_batch(batch, mesh)
+  ev = make_sparse_eval_step(model, plan_b, rule, mesh, state_b, batch)
+  want = np.asarray(ev(state_b, *bt[:2]))
+
+  frozen = freeze(plan_t, rule, state_t, quantize=quantize, store=store)
+  eng = ServeEngine(model, plan_t, frozen, mesh=mesh,
+                    tier_config=ServeTierConfig(cache_fraction=0.3,
+                                                staging_grps=64),
+                    with_metrics=True)
+  preds, metrics = eng.predict(numerical, cats)
+  for name, m in metrics["tier"].items():
+    hot, staged, missed, total = (int(v) for v in m)
+    assert missed == 0, (name, m)        # the prefetch contract held
+    assert hot + staged == total > 0, (name, m)
+  if quantize == "f32":
+    np.testing.assert_array_equal(want, preds)
+  else:
+    assert np.abs(want - preds).max() < 1e-3
+  # repeated dispatch: immutable images, persistent residency
+  preds2, _ = eng.predict(numerical, cats)
+  np.testing.assert_array_equal(preds, preds2)
+
+
+# ---------------------------------------------------------------------------
+# export -> load roundtrip (durable protocol)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("quantize", ["f32", "int8"])
+def test_export_load_roundtrip(tmp_path, quantize):
+  plan, rule, mesh, state, batch, _ = _fixture(2)
+  path = os.path.join(str(tmp_path), "serve_art")
+  frozen = serve_export(path, plan, rule, state, quantize=quantize)
+  assert checkpoint.verify(path) == []
+  art = serve_load(path, plan, mesh=mesh)
+  assert art.quantize == quantize
+  for name, blocks in frozen.device_blocks.items():
+    np.testing.assert_array_equal(
+        np.asarray(art.state["serve"][name]), np.concatenate(blocks))
+  # loaded artifact predicts identically to the in-memory frozen state
+  sstate = frozen_device_state(frozen, plan, mesh)
+  step = make_serve_step(ActsModel(), plan, frozen.meta, mesh, sstate,
+                         (batch[0], batch[1]))
+  bt = shard_batch(batch, mesh)
+  want = np.asarray(step(sstate, *bt[:2]))
+  step2 = make_serve_step(ActsModel(), plan, art.meta, mesh, art.state,
+                          (batch[0], batch[1]))
+  np.testing.assert_array_equal(want, np.asarray(step2(art.state,
+                                                       *bt[:2])))
+
+
+def test_export_load_roundtrip_tiered(tmp_path):
+  (plan_b, plan_t, model, mesh, rule, state_b, state_t, store,
+   batch) = _tiered_fixture()
+  numerical, cats, _ = batch
+  path = os.path.join(str(tmp_path), "serve_tiered")
+  frozen = serve_export(path, plan_t, rule, state_t, quantize="f32",
+                        store=store)
+  assert frozen.host_images and checkpoint.verify(path) == []
+  # cold images really landed as files
+  cold = [f for f in os.listdir(path) if f.startswith("serve_cold_")]
+  assert len(cold) == plan_t.world_size * len(frozen.host_images)
+  art = serve_load(path, plan_t, mesh=mesh)
+  for name, images in frozen.host_images.items():
+    for r, img in enumerate(images):
+      np.testing.assert_array_equal(art.host_images[name][r], img)
+    for r in range(plan_t.world_size):
+      np.testing.assert_array_equal(art.ranking[name][r],
+                                    frozen.ranking[name][r])
+  cfg = ServeTierConfig(cache_fraction=0.3, staging_grps=64)
+  want = ServeEngine(model, plan_t, frozen, mesh=mesh,
+                     tier_config=cfg).predict(numerical, cats)
+  got = ServeEngine(model, plan_t, art, mesh=mesh,
+                    tier_config=cfg).predict(numerical, cats)
+  np.testing.assert_array_equal(want, got)
+
+
+def test_export_corruption_detected(tmp_path):
+  plan, rule, mesh, state, batch, _ = _fixture(2)
+  path = os.path.join(str(tmp_path), "serve_bad")
+  serve_export(path, plan, rule, state, quantize="int8")
+  victim = sorted(f for f in os.listdir(path)
+                  if f.startswith("serve_"))[0]
+  fpath = os.path.join(path, victim)
+  with open(fpath, "r+b") as f:
+    f.seek(os.path.getsize(fpath) - 1)
+    byte = f.read(1)
+    f.seek(os.path.getsize(fpath) - 1)
+    f.write(bytes([byte[0] ^ 0xFF]))
+  problems = checkpoint.verify(path)
+  assert problems and victim in problems[0]
+  with pytest.raises(ValueError, match=victim):
+    serve_load(path, plan, mesh=mesh)
+
+
+def test_load_refuses_plan_mismatch(tmp_path):
+  plan, rule, mesh, state, batch, _ = _fixture(2)
+  path = os.path.join(str(tmp_path), "serve_art")
+  serve_export(path, plan, rule, state)
+  other = DistEmbeddingStrategy(
+      [TableConfig(s + 1, w, combiner="sum")
+       for s, w in zip(SIZES, WIDTHS)], 2, "memory_balanced",
+      dense_row_threshold=0, input_hotness=HOTNESS)
+  with pytest.raises(ValueError, match="does not match"):
+    serve_load(path, other, mesh=mesh)
+
+
+# ---------------------------------------------------------------------------
+# donation contract: repeated-call eval/serve steps
+# ---------------------------------------------------------------------------
+
+
+def test_eval_and_serve_steps_never_donate_params():
+  """The regression the ISSUE names: a repeated-call eval/serve step
+  must never invalidate the frozen table. Both steps run TWICE against
+  the same state object — donated buffers would fail loudly on the
+  second call (and the state stays usable afterwards)."""
+  plan, rule, mesh, state, batch, _ = _fixture(2)
+  bt = shard_batch(batch, mesh)
+  ev = make_sparse_eval_step(ActsModel(), plan, rule, mesh, state, batch)
+  first = np.asarray(ev(state, *bt[:2]))
+  second = np.asarray(ev(state, *bt[:2]))
+  np.testing.assert_array_equal(first, second)
+  # serve step WITH request-array donation: params still never donated
+  got, (step, sstate, _) = _serve_preds(plan, rule, mesh, state, batch,
+                                        "f32", donate_batch=True)
+  bt2 = shard_batch(batch, mesh)  # fresh request arrays (donated above)
+  again = np.asarray(step(sstate, *bt2[:2]))
+  np.testing.assert_array_equal(got, again)
+  np.testing.assert_array_equal(first, got)
+
+
+def test_serve_refuses_unservable_plans():
+  plan, rule, mesh, state, batch, _ = _fixture(
+      2, dedup_exchange=True, dedup_capacity=8)
+  frozen = freeze(plan, rule, state)
+  with pytest.raises(ValueError, match="dedup_capacity"):
+    make_serve_step(ActsModel(), plan, frozen.meta, mesh,
+                    frozen_device_state(frozen, plan, mesh),
+                    (batch[0], batch[1]))
+  plan_e, rule_e, mesh_e, state_e, batch_e, _ = _fixture(2, oov="error")
+  frozen_e = freeze(plan_e, rule_e, state_e)
+  with pytest.raises(ValueError, match="oov"):
+    make_serve_step(ActsModel(), plan_e, frozen_e.meta, mesh_e,
+                    frozen_device_state(frozen_e, plan_e, mesh_e),
+                    (batch_e[0], batch_e[1]))
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher
+# ---------------------------------------------------------------------------
+
+
+def _echo_dispatch(numerical, cats):
+  """Row-identity dispatch: output row i encodes (numerical[i, 0],
+  cats[0][i]) — de-interleave errors are unmissable."""
+  return np.stack([numerical[:, 0], cats[0].astype(np.float64)], axis=1)
+
+
+def test_batcher_deinterleave_property():
+  """Every request gets exactly its own rows back under random
+  arrival interleavings from concurrent submitters."""
+  mb = MicroBatcher(_echo_dispatch, max_batch=32, max_delay_s=0.002)
+  failures = []
+
+  def client(tid, rng):
+    for i in range(40):
+      n = int(rng.integers(1, 9))
+      tag = tid * 10000 + i
+      numerical = np.full((n, 3), tag, np.float32)
+      cats = [np.arange(n, dtype=np.int32) + tag]
+      while True:
+        try:
+          fut = mb.submit(numerical, cats)
+          break
+        except Rejected:
+          time.sleep(0.001)
+      out = fut.result(timeout=30)
+      if out.shape[0] != n or not np.all(out[:, 0] == tag) \
+          or not np.all(out[:, 1] == np.arange(n) + tag):
+        failures.append((tid, i, out))
+      if rng.random() < 0.3:
+        time.sleep(float(rng.random()) * 0.002)
+
+  threads = [threading.Thread(target=client,
+                              args=(t, np.random.default_rng(t)))
+             for t in range(6)]
+  for t in threads:
+    t.start()
+  for t in threads:
+    t.join()
+  mb.close()
+  assert not failures
+  assert mb.stats["completed"] == 6 * 40
+  assert mb.stats["batches"] >= (6 * 40 * 1) // 32  # really coalesced
+
+
+def test_batcher_rejection_counted_exactly():
+  """Load-shed accounting: with the flusher paused, submissions past
+  the row bound are rejected — each one counted, none enqueued."""
+  mb = MicroBatcher(_echo_dispatch, max_batch=8, queue_rows=16,
+                    start=False)
+  accepted = rejected = 0
+  for _ in range(10):
+    try:
+      mb.submit(np.zeros((3, 2), np.float32), [np.zeros(3, np.int32)])
+      accepted += 1
+    except Rejected:
+      rejected += 1
+  assert (accepted, rejected) == (5, 5)  # 5*3=15 fits 16; the 6th would be 18
+  assert mb.stats["rejected"] == 5
+  assert mb.stats["submitted"] == 10
+  mb.flush_now()
+  assert mb.stats["completed"] == 5
+  mb.close()
+
+
+def test_batcher_deadline_flush_and_padding():
+  """A lone small request must not wait for a full batch: the deadline
+  flush fires and the dispatch is padded to max_batch."""
+  seen = []
+
+  def spy(numerical, cats):
+    seen.append(numerical.shape[0])
+    return _echo_dispatch(numerical, cats)
+
+  mb = MicroBatcher(spy, max_batch=16, max_delay_s=0.005)
+  t0 = time.monotonic()
+  fut = mb.submit(np.full((2, 1), 3.0, np.float32),
+                  [np.arange(2, dtype=np.int32)])
+  out = fut.result(timeout=10)
+  assert time.monotonic() - t0 < 5.0
+  assert out.shape[0] == 2 and np.all(out[:, 0] == 3.0)
+  assert seen == [16]  # padded to the constant dispatch shape
+  assert mb.stats["padded_rows"] == 14
+  mb.close()
+
+
+def test_batcher_rejects_oversize_and_close():
+  mb = MicroBatcher(_echo_dispatch, max_batch=4, start=False)
+  with pytest.raises(ValueError, match="max_batch"):
+    mb.submit(np.zeros((5, 1), np.float32), [np.zeros(5, np.int32)])
+  fut = mb.submit(np.ones((2, 1), np.float32),
+                  [np.arange(2, dtype=np.int32)])
+  mb.close(drain=True)
+  assert fut.result(timeout=5).shape[0] == 2
+  with pytest.raises(RuntimeError, match="closed"):
+    mb.submit(np.ones((1, 1), np.float32), [np.zeros(1, np.int32)])
+
+
+def test_batcher_drain_failure_fails_queued_waiters():
+  """A dispatch failure mid-drain must fail every still-queued request's
+  future — a stranded waiter with no timeout would block forever."""
+  def boom(numerical, cats):
+    raise RuntimeError("kaput")
+
+  mb = MicroBatcher(boom, max_batch=4, start=False)
+  f1 = mb.submit(np.zeros((4, 1), np.float32), [np.zeros(4, np.int32)])
+  f2 = mb.submit(np.zeros((4, 1), np.float32), [np.zeros(4, np.int32)])
+  with pytest.raises(RuntimeError):
+    mb.close(drain=True)
+  for f in (f1, f2):
+    assert f.done()
+    with pytest.raises(RuntimeError):
+      f.result(timeout=1)
+
+
+@pytest.mark.slow
+def test_profile_serve_full_sweep():
+  """The full serve-bench sweep (throughput + latency-vs-QPS across
+  {f32,int8} x {all-device,tiered} x batcher deadlines) passes its
+  acceptance bars; the smoke tier rides `make verify` instead."""
+  import subprocess
+  import sys
+  repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+  env = dict(os.environ)
+  env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+  r = subprocess.run(
+      [sys.executable, os.path.join(repo, "tools", "profile_serve.py")],
+      env=env, capture_output=True, text=True, timeout=1800)
+  assert r.returncode == 0, r.stdout[-4000:] + r.stderr[-4000:]
+
+
+def test_batcher_end_to_end_with_engine():
+  """Real engine behind the batcher: concurrent variable-size requests
+  against a frozen DLRM, each result matching a direct dispatch of the
+  same rows."""
+  (plan_b, plan_t, model, mesh, rule, state_b, state_t, store,
+   batch) = _tiered_fixture()
+  numerical, cats, _ = batch
+  frozen = freeze(plan_b, rule, state_b, quantize="int8")
+  eng = ServeEngine(model, plan_b, frozen, mesh=mesh)
+  max_batch = 16
+  mb = MicroBatcher(eng.dispatch, max_batch=max_batch, max_delay_s=0.005)
+
+  def direct(rows):
+    n = rows[0].shape[0]
+    pad = max_batch - n
+    num_p = np.concatenate(
+        [rows[0], np.zeros((pad,) + rows[0].shape[1:], np.float32)])
+    cats_p = [np.concatenate([c, np.full((pad,), PAD_ID, c.dtype)])
+              for c in rows[1]]
+    return np.asarray(eng.dispatch(num_p, cats_p))[:n]
+
+  futs, wants = [], []
+  rng = np.random.default_rng(11)
+  for _ in range(12):
+    n = int(rng.integers(1, 6))
+    lo = int(rng.integers(0, numerical.shape[0] - n))
+    req = (numerical[lo:lo + n], [c[lo:lo + n] for c in cats])
+    futs.append(mb.submit(*req))
+    wants.append(direct(req))
+  for fut, want in zip(futs, wants):
+    got = fut.result(timeout=60)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+  mb.close()

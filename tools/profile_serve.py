@@ -1,0 +1,355 @@
+"""Serve-path budget: step throughput + latency vs offered QPS.
+
+Two measurements on the power-law synthetic workload (8-way CPU mesh):
+
+1. **Step throughput** (``--steps`` section, always on): rows/s of the
+   f32 ``make_sparse_eval_step`` (the pre-serving baseline — training
+   layout, optimizer lanes riding every gather) vs the frozen-table
+   serve step in f32 and int8, at equal batch. Acceptance: the int8
+   serve step sustains **>= 1.5x** the f32 eval step's throughput (the
+   stripped+quantized image moves 4x fewer gather bytes; the CPU mesh
+   prices bytes, which is also what the TPU row-gather prices).
+
+2. **Latency vs offered QPS** (micro-batcher): a closed-loop run finds
+   the saturation throughput per configuration, then an open-loop
+   POISSON arrival process offers fractions of it and reports
+   p50/p99/p99.9 per-request latency — the serving metric that
+   steps/sec cannot see. Sweeps {f32, int8} x {all-device, tiered} x
+   batcher deadline settings. Acceptance: with the default batcher the
+   int8 all-device configuration holds **p99 <= 3x p50 at 80% of its
+   saturation QPS** (an unbatched or unbounded queue fails this the
+   moment arrivals cluster).
+
+``--smoke`` runs a tiny-world version wired into ``make verify``: a few
+hundred requests, asserting the latency percentiles are finite and the
+bounded-queue rejection counter is exact.
+
+The recorded budgets live in docs/BENCHMARKS.md ("Round 8: the serving
+engine").
+
+Usage: PYTHONPATH=/root/repo python tools/profile_serve.py [--smoke]
+"""
+
+import argparse
+import os
+import threading
+import time
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+  os.environ["XLA_FLAGS"] = (
+      flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from distributed_embeddings_tpu.layers.planner import (  # noqa: E402
+    DistEmbeddingStrategy,
+)
+from distributed_embeddings_tpu.models.synthetic import (  # noqa: E402
+    EmbeddingGroup,
+    SyntheticModel,
+    SyntheticModelConfig,
+    expand_tables,
+    generate_batch,
+)
+from distributed_embeddings_tpu.ops.packed_table import sparse_rule  # noqa: E402
+from distributed_embeddings_tpu.parallel import create_mesh  # noqa: E402
+from distributed_embeddings_tpu.serving import (  # noqa: E402
+    MicroBatcher,
+    Rejected,
+    ServeEngine,
+    ServeTierConfig,
+)
+from distributed_embeddings_tpu.serving.export import freeze  # noqa: E402
+from distributed_embeddings_tpu.training import (  # noqa: E402
+    init_sparse_state_direct,
+    make_sparse_eval_step,
+    shard_batch,
+    shard_params,
+)
+
+WORLD = 8
+GLOBAL_BATCH = 8192
+ALPHA = 1.05
+STEPS = 5
+
+CFG = SyntheticModelConfig(
+    name="serve-powerlaw",
+    embedding_groups=(EmbeddingGroup(8, (8,), 4096, 16, False),),
+    mlp_sizes=(64, 32), num_numerical_features=8, interact_stride=None)
+
+SMOKE_CFG = SyntheticModelConfig(
+    name="serve-smoke",
+    embedding_groups=(EmbeddingGroup(4, (4,), 512, 16, False),),
+    mlp_sizes=(32, 16), num_numerical_features=4, interact_stride=None)
+
+
+def build(cfg, world, batch, host_thr=None):
+  tables, tmap, hotness = expand_tables(cfg)
+  model = SyntheticModel(cfg)
+  plan = DistEmbeddingStrategy(
+      tables, world, "memory_balanced", input_table_map=tmap,
+      input_hotness=hotness, batch_hint=batch,
+      dense_row_threshold=0, host_row_threshold=host_thr)
+  rule = sparse_rule("adagrad", 0.05)
+  opt = optax.sgd(0.01)
+  mesh = create_mesh(world)
+  numerical, cats, labels = generate_batch(cfg, batch, alpha=ALPHA, seed=3)
+  cats = [np.minimum(np.asarray(c), tables[t].input_dim - 1)
+          for c, t in zip(cats, tmap)]
+  bt_np = (numerical, [jnp.asarray(c) for c in cats], labels)
+  dummy = [jnp.zeros((2, tables[t].output_dim), jnp.float32) for t in tmap]
+  dense_params = model.init(jax.random.PRNGKey(0),
+                            jnp.asarray(numerical[:2]),
+                            [c[:2] for c in bt_np[1]],
+                            emb_acts=dummy)["params"]
+  if host_thr is None:
+    state = shard_params(
+        init_sparse_state_direct(plan, rule, dense_params, opt,
+                                 jax.random.PRNGKey(1)), mesh)
+    store = None
+  else:
+    from distributed_embeddings_tpu.tiering import (
+        HostTierStore,
+        TieringConfig,
+        TieringPlan,
+    )
+    from distributed_embeddings_tpu.tiering.train import init_tiered_state
+    tplan = TieringPlan(plan, rule,
+                        TieringConfig(cache_fraction=0.25,
+                                      staging_grps=256))
+    store = HostTierStore(tplan)
+    state = shard_params(
+        init_tiered_state(tplan, store, rule, dense_params, opt,
+                          jax.random.PRNGKey(1), mesh=mesh), mesh)
+  return model, plan, rule, mesh, state, store, bt_np
+
+
+def time_step(fn, args, steps=STEPS):
+  out = fn(*args)  # compile + warm
+  jax.block_until_ready(out)
+  t0 = time.perf_counter()
+  for _ in range(steps):
+    out = fn(*args)
+  jax.block_until_ready(out)
+  return (time.perf_counter() - t0) / steps
+
+
+def step_throughput(cfg, world, batch):
+  """rows/s of eval-f32 vs serve-f32 vs serve-int8 at equal batch."""
+  model, plan, rule, mesh, state, _store, bt_np = build(cfg, world, batch)
+  batch0 = (jnp.asarray(bt_np[0]), bt_np[1], jnp.asarray(bt_np[2]))
+  bt = shard_batch(batch0, mesh)
+  ev = make_sparse_eval_step(model, plan, rule, mesh, state, batch0)
+  dt_eval = time_step(lambda s, n, c: ev(s, n, c), (state, *bt[:2]))
+  out = {"eval_f32": batch / dt_eval}
+  for q in ("f32", "int8"):
+    frozen = freeze(plan, rule, state, quantize=q)
+    from distributed_embeddings_tpu.serving.export import (
+        frozen_device_state,
+    )
+    from distributed_embeddings_tpu.serving.engine import make_serve_step
+    sstate = frozen_device_state(frozen, plan, mesh)
+    step = make_serve_step(model, plan, frozen.meta, mesh, sstate,
+                           (batch0[0], batch0[1]))
+    dt = time_step(lambda s, n, c: step(s, n, c), (sstate, *bt[:2]))
+    out[f"serve_{q}"] = batch / dt
+  return out
+
+
+# ---------------------------------------------------------------------------
+# latency vs offered QPS through the micro-batcher
+# ---------------------------------------------------------------------------
+
+
+def _requests(bt_np, req_rows, n, seed=0):
+  rng = np.random.default_rng(seed)
+  numerical, cats, _ = bt_np
+  b = numerical.shape[0]
+  out = []
+  for _ in range(n):
+    lo = int(rng.integers(0, b - req_rows))
+    out.append((numerical[lo:lo + req_rows],
+                [np.asarray(c[lo:lo + req_rows]) for c in cats]))
+  return out
+
+def closed_loop(mb, reqs, workers=8, duration_s=6.0):
+  """Saturation: `workers` synchronous clients for `duration_s`;
+  returns (requests/s, latencies)."""
+  done, lats = [], []
+  lock = threading.Lock()
+  stop = time.monotonic() + duration_s
+
+  def worker(w):
+    i = w
+    while time.monotonic() < stop:
+      try:
+        fut = mb.submit(*reqs[i % len(reqs)])
+      except Rejected:
+        time.sleep(0.001)
+        continue
+      out = fut.result(timeout=120)
+      with lock:
+        done.append(out.shape[0])
+        lats.append(fut.latency_s)
+      i += workers
+
+  threads = [threading.Thread(target=worker, args=(w,))
+             for w in range(workers)]
+  t0 = time.monotonic()
+  for t in threads:
+    t.start()
+  for t in threads:
+    t.join()
+  dt = time.monotonic() - t0
+  return len(done) / dt, lats
+
+
+def open_loop(mb, reqs, qps, n_requests, seed=0):
+  """Poisson arrivals at `qps`; returns (latencies, rejected)."""
+  rng = np.random.default_rng(seed)
+  futs = []
+  rejected = 0
+  t_next = time.monotonic()
+  for i in range(n_requests):
+    t_next += float(rng.exponential(1.0 / qps))
+    delay = t_next - time.monotonic()
+    if delay > 0:
+      time.sleep(delay)
+    try:
+      futs.append(mb.submit(*reqs[i % len(reqs)]))
+    except Rejected:
+      rejected += 1
+  for f in futs:  # block until every accepted request completed
+    f.result(timeout=120)
+  return [f.latency_s for f in futs], rejected
+
+
+def pcts(lats):
+  a = np.asarray(sorted(lats))
+  if not a.size:
+    return (float("nan"),) * 3
+  return (float(np.percentile(a, 50)), float(np.percentile(a, 99)),
+          float(np.percentile(a, 99.9)))
+
+
+def latency_sweep(cfg, world, batch, quantize, tiered, max_delay_s,
+                  req_rows=4, n_requests=400, fractions=(0.4, 0.8)):
+  """One configuration's closed-loop saturation + open-loop percentiles
+  at offered fractions of it. Returns a result dict."""
+  model, plan, rule, mesh, state, store, bt_np = build(
+      cfg, world, batch, host_thr=1024 if tiered else None)
+  frozen = freeze(plan, rule, state, quantize=quantize, store=store)
+  eng = ServeEngine(
+      model, plan, frozen, mesh=mesh,
+      tier_config=ServeTierConfig(cache_fraction=0.25, staging_grps=256)
+      if tiered else None)
+  reqs = _requests(bt_np, req_rows, 64)
+  mb = MicroBatcher(eng.dispatch, max_batch=batch,
+                    max_delay_s=max_delay_s)
+  # warm the trace before measuring (compile time is not serve latency)
+  mb.submit(*reqs[0]).result(timeout=300)
+  sat_qps, _ = closed_loop(mb, reqs)
+  rows = {"sat_qps": sat_qps, "points": []}
+  for frac in fractions:
+    qps = max(sat_qps * frac, 1.0)
+    lats, rejected = open_loop(mb, reqs, qps, n_requests)
+    p50, p99, p999 = pcts(lats)
+    rows["points"].append({"frac": frac, "qps": qps, "p50": p50,
+                           "p99": p99, "p999": p999,
+                           "rejected": rejected})
+  mb.close()
+  return rows
+
+
+def main(full_sweep=True):
+  print(f"serve budget: world={WORLD} batch={GLOBAL_BATCH} "
+        f"tables=8x(4096 rows, w16, h8, adagrad lanes) zipf({ALPHA})")
+  thr = step_throughput(CFG, WORLD, GLOBAL_BATCH)
+  for k, v in thr.items():
+    print(f"  {k:<10} {v / 1e3:8.1f} krows/s "
+          f"({GLOBAL_BATCH / v * 1e3:6.1f} ms/step)")
+  ratio = thr["serve_int8"] / thr["eval_f32"]
+  ok_thr = ratio >= 1.5
+  print(f"acceptance (int8 serve >= 1.5x f32 eval step): "
+        f"{'OK' if ok_thr else 'FAIL'} ({ratio:.2f}x)")
+
+  ok_lat = True
+  if full_sweep:
+    combos = [(q, t, d) for q in ("f32", "int8") for t in (False, True)
+              for d in (0.002, 0.01)]
+    print("latency vs offered QPS (micro-batched, Poisson arrivals; "
+          "req=4 rows):")
+    for q, tiered, delay in combos:
+      r = latency_sweep(CFG, WORLD, 512, q, tiered, delay)
+      print(f"  {q:<4} {'tiered' if tiered else 'device':<6} "
+            f"delay={delay * 1e3:4.1f}ms  sat {r['sat_qps']:7.1f} req/s")
+      for p in r["points"]:
+        tag = ""
+        if q == "int8" and not tiered and delay == 0.002 \
+            and p["frac"] == 0.8:
+          mode_ok = p["p99"] <= 3.0 * p["p50"]
+          ok_lat = ok_lat and mode_ok
+          tag = f"  <- acceptance {'OK' if mode_ok else 'FAIL'}"
+        print(f"    offered {p['frac']:.0%} ({p['qps']:7.1f} req/s)  "
+              f"p50 {p['p50'] * 1e3:7.1f}  p99 {p['p99'] * 1e3:7.1f}  "
+              f"p99.9 {p['p999'] * 1e3:7.1f} ms  "
+              f"rejected {p['rejected']}{tag}")
+    print(f"acceptance (p99 <= 3x p50 at 80% of saturation): "
+          f"{'OK' if ok_lat else 'FAIL'}")
+  return 0 if (ok_thr and ok_lat) else 1
+
+
+def main_smoke():
+  """The make-verify tier: tiny world, a few hundred requests; asserts
+  finite percentiles and EXACT rejection accounting."""
+  world, batch = 2, 64
+  model, plan, rule, mesh, state, _store, bt_np = build(
+      SMOKE_CFG, world, batch)
+  frozen = freeze(plan, rule, state, quantize="int8")
+  eng = ServeEngine(model, plan, frozen, mesh=mesh)
+  reqs = _requests(bt_np, 4, 32)
+  mb = MicroBatcher(eng.dispatch, max_batch=batch, max_delay_s=0.002)
+  mb.submit(*reqs[0]).result(timeout=300)  # compile outside the clock
+  lats, rejected = open_loop(mb, reqs, qps=300.0, n_requests=200)
+  p50, p99, p999 = pcts(lats)
+  mb.close()
+  print(f"serve-smoke: world={world} 201 requests  p50 {p50 * 1e3:.1f}  "
+        f"p99 {p99 * 1e3:.1f}  p99.9 {p999 * 1e3:.1f} ms  "
+        f"rejected {rejected}")
+  ok = np.isfinite([p50, p99, p999]).all() and p99 >= p50 > 0
+  # deterministic load-shed accounting: flusher paused, queue bound 16
+  # rows, 10 x 3-row submissions -> exactly 5 accepted, 5 rejected
+  mb2 = MicroBatcher(lambda n, c: np.zeros((batch, 1)), max_batch=8,
+                     queue_rows=16, start=False)
+  shed = 0
+  for _ in range(10):
+    try:
+      mb2.submit(np.zeros((3, 2), np.float32), [np.zeros(3, np.int32)])
+    except Rejected:
+      shed += 1
+  exact = shed == 5 and mb2.stats["rejected"] == 5 \
+      and mb2.stats["submitted"] == 10
+  mb2.close(drain=False)
+  print(f"serve-smoke: rejection accounting "
+        f"{'exact' if exact else 'WRONG'} ({shed}/5)")
+  ok = ok and exact
+  print(f"serve-smoke: {'OK' if ok else 'FAIL'}")
+  return 0 if ok else 1
+
+
+if __name__ == "__main__":
+  ap = argparse.ArgumentParser()
+  ap.add_argument("--smoke", action="store_true",
+                  help="tiny-world smoke tier (wired into make verify)")
+  ap.add_argument("--steps-only", action="store_true",
+                  help="skip the latency sweep (throughput acceptance "
+                       "only)")
+  args = ap.parse_args()
+  if args.smoke:
+    raise SystemExit(main_smoke())
+  raise SystemExit(main(full_sweep=not args.steps_only))
