@@ -6,7 +6,7 @@ PY ?= python
 SHELL := /bin/bash  # verify uses pipefail/PIPESTATUS
 
 .PHONY: test test-fast verify lint native bench dryrun chaos chaos-kill \
-	serve-bench serve-smoke clean
+	serve-bench serve-smoke vocab-bench vocab-smoke clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -33,10 +33,23 @@ serve-smoke:
 	PYTHONPATH=$(CURDIR):$$PYTHONPATH timeout -k 10 300 \
 	  $(PY) tools/profile_serve.py --smoke
 
+# dynamic-vocabulary churn bench: power-law ids with a drifting tail,
+# admission (count-min threshold) vs admit-everything on one stream —
+# acceptance: admission <= 50% of the row allocations at equal final
+# eval loss (tools/profile_dynvocab.py; budget in docs/BENCHMARKS.md r9)
+vocab-bench:
+	PYTHONPATH=$(CURDIR):$$PYTHONPATH $(PY) tools/profile_dynvocab.py
+
+# the make-verify tier of the vocab bench: tiny stream, same assertions,
+# timeout-guarded like the other smoke tiers
+vocab-smoke:
+	PYTHONPATH=$(CURDIR):$$PYTHONPATH timeout -k 10 300 \
+	  $(PY) tools/profile_dynvocab.py --smoke
+
 # the tier-1 gate, exactly as ROADMAP.md specifies it (CPU mesh, no slow
 # tests, collection errors surfaced but not fatal to the log); lint runs
-# first so invariant violations fail fast, then the serve smoke tier
-verify: lint serve-smoke
+# first so invariant violations fail fast, then the smoke tiers
+verify: lint serve-smoke vocab-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -43,6 +43,11 @@ GL111       error      train-only surfaces (optax / ``resilience.guards``
                        guard helpers by name) are unreachable from
                        ``serving/`` modules — the inference path must stay
                        free of optimizer state and commit gates
+GL112       error      dynamic-vocabulary translation state mutates only in
+                       ``dynvocab/`` host paths — the translator surface
+                       (``translate_batch`` / ``translate_dynamic_ids`` /
+                       the table/sketch/recycler constructors) never
+                       appears in trace-reachable step code
 ==========  =========  =====================================================
 
 Trace-reachable scope (GL101/GL102) is structural: any function nested —
@@ -579,6 +584,57 @@ def _check_serving_train_surfaces(mod: ParsedModule) -> List[Finding]:
       seen.add(f.line)
       uniq.append(f)
   return uniq
+
+
+# The dynamic-vocabulary translation surface: every entry point that
+# reads or mutates the host-side id space (open-addressing table,
+# admission sketch, TTL recycler). Distinctively-named on purpose —
+# generic method names (insert/remove/update) stay lintable-free.
+_DYNVOCAB_SURFACE = frozenset({
+    "translate_batch", "translate_readonly", "translate_dynamic_ids",
+    "DynVocabTranslator", "IdTranslationTable", "CountMinSketch",
+    "RowRecycler", "apply_zero_work",
+})
+
+
+@_rule("GL112", "error",
+       "dynvocab translation state mutates only in dynvocab/ host paths")
+def _check_dynvocab_in_trace(mod: ParsedModule) -> List[Finding]:
+  # The allocation protocol's core claim is that the id space is HOST
+  # state mutated between steps (the TieredPrefetcher pattern): the
+  # traced step sees only translated in-range ids, so its jaxpr is
+  # byte-identical to a static-vocab plan's. A translator call inside a
+  # trace-reachable step closure would either fail tracing outright
+  # (numpy on tracers) or — worse — run once at trace time and silently
+  # freeze the id space into the compiled step. The dynvocab package
+  # itself is exempt (it IS the sanctioned home); host-side trainer /
+  # test / tool code is unrestricted.
+  norm = mod.path.replace(os.sep, "/")
+  if "/dynvocab/" in norm or norm.startswith("dynvocab/"):
+    return []
+  out = []
+  seen = set()
+  for fn in _traced_functions(mod.tree):
+    for node in ast.walk(fn):
+      if isinstance(node, ast.Name):
+        name = node.id
+      elif isinstance(node, ast.Attribute):
+        name = node.attr
+      else:
+        continue
+      if name in _DYNVOCAB_SURFACE and node.lineno not in seen:
+        seen.add(node.lineno)  # nested traced fns overlap in their walks
+        out.append(mod.finding(
+            "GL112", node,
+            f"dynvocab translation surface {name!r} inside "
+            "trace-reachable step code: the id space is host state "
+            "mutated BETWEEN steps (the prefetcher pattern) — inside a "
+            "traced closure it would either break tracing or freeze "
+            "one translation into the compiled step. Translate on the "
+            "host side of the step boundary "
+            "(DistributedLookup.translate_dynamic_ids / "
+            "DynVocabTrainer)."))
+  return out
 
 
 @_rule("GL108", "error", "fault-injection sites must be registered")

@@ -299,6 +299,11 @@ def build_artifacts() -> Dict[str, Tuple[Any, Expectation]]:
 
   - ``sparse_step``:        ``make_sparse_train_step(guard=False)``
   - ``sparse_step_guard``:  ``make_sparse_train_step(guard=True)``
+  - ``sparse_step_dynvocab``: the guarded step on an ``oov='allocate'``
+    plan — the dynamic-vocabulary artifact: still exactly one
+    scatter-add per class and ZERO host callbacks (allocation is a
+    host pass BETWEEN steps, never a callback from the trace), plus
+    the allocate policy's commit gate (one pmin, like every guard)
   - ``sparse_step_wire``:   same step on a ``wire_dtype='bf16',
     dedup_exchange=True`` plan (every float exchange must be bf16)
   - ``sparse_step_pipe_f32`` / ``..._bf16`` / ``..._fp8``: the same
@@ -398,6 +403,29 @@ def build_artifacts() -> Dict[str, Tuple[Any, Expectation]]:
         jx.jaxpr, Expectation(shapes, mesh_axes, guard=guard,
                               a2a_count=3 * nb, ppermute_count=0,
                               wire_float_dtype="float32"))
+
+  # ---- dynamic-vocabulary step (oov='allocate', round 13) ----------------
+  # Same tables/state/batch: the dynamic id layer translates HOST-side
+  # (between steps, the prefetcher pattern), so the traced step differs
+  # from sparse_step_guard only by the allocate policy's commit gate
+  # (untranslated-leak tripwire) — pinned here at ONE scatter-add per
+  # class, ZERO host callbacks (the allocation protocol never calls
+  # back into the translator from the trace), one pmin, and the same
+  # 3-per-bucket a2a count. The batch needs no translator: ids already
+  # in [0, vocab) are exactly what a translated stream looks like.
+  plan_dv = DistEmbeddingStrategy(
+      [TableConfig(input_dim=v, output_dim=WIDTH,
+                   initializer=_dlrm_initializer(v)) for v in VOCAB],
+      WORLD, "memory_balanced", dense_row_threshold=60,
+      oov="allocate", admit_threshold=2, evict_ttl=100)
+  step_dv = make_sparse_train_step(model, plan_dv, bce_loss, opt, rule,
+                                   mesh, state, batch0, donate=False,
+                                   guard=True)
+  jx = jax.make_jaxpr(step_dv)(state, *bt)
+  artifacts["sparse_step_dynvocab"] = (
+      jx.jaxpr, Expectation(shapes, mesh_axes, guard=True,
+                            a2a_count=3 * nb, ppermute_count=0,
+                            wire_float_dtype="float32"))
 
   ev = make_sparse_eval_step(model, plan, rule, mesh, state, batch0)
   jx = jax.make_jaxpr(ev)(state, *bt[:2])

@@ -344,11 +344,92 @@ def _elastic_reason(manifest: Dict[str, Any], want: Dict[str, Any],
   return None
 
 
+def _load_tier_state_flat(path: str) -> Dict[str, np.ndarray]:
+  """Merge every ``tiering*.npz`` under ``path`` (one file from a
+  fully-owned save, per-owner files from a sharded one)."""
+  flat: Dict[str, np.ndarray] = {}
+  for fn in sorted(os.listdir(path)):
+    if fn == "tiering.npz" or (fn.startswith("tiering_p")
+                               and fn.endswith(".npz")):
+      with np.load(os.path.join(path, fn)) as z:
+        flat.update({k: np.asarray(v) for k, v in z.items()})
+  return flat
+
+
+def _remap_tier_counts(path: str, manifest: Dict[str, Any],
+                       plan: DistEmbeddingStrategy, store,
+                       n_aux: int) -> Optional[Dict[str, list]]:
+  """Window-wise re-map of host-tier observed counts through an elastic
+  re-shard (ROADMAP carried item: re-deriving them from zero cost one
+  re-rank interval of hot-set warmup after every resize).
+
+  The saved counts are per PHYSICAL row (group) of each source rank's
+  logical layout; the move routes them exactly like the row blocks: per
+  source slot window, each covered LOGICAL table row inherits its
+  group's count (column slices of one table see the same stream, so
+  overlapping sources merge by max), then each target rank's groups
+  max-pool their logical rows — for unchanged windows (an N -> N round
+  trip) the re-map is exact. Writes ``store.counts`` in place and
+  returns the count-descending ``warm_start`` ranking (ties row-id
+  ascending, matching the re-rank's tie policy), or None when the
+  checkpoint carries no counts (pre-tiering or hand-built)."""
+  src_classes = manifest["world"]["classes"]
+  src_layout = manifest["plan"]["layout"]
+  n_src = int(manifest["world"]["ranks"])
+  flat = _load_tier_state_flat(path)
+  if not any(k.endswith("/counts") for k in flat):
+    return None
+  cfgs = plan.global_configs
+  table_counts: Dict[int, np.ndarray] = {}
+  for cname in sorted(src_classes):
+    meta = src_classes[cname]
+    if meta["tier"] != "host":
+      continue
+    lay = PackedLayout(rows=int(meta["rows"]), width=int(meta["width"]),
+                       n_aux=n_aux)
+    rpp = lay.rows_per_phys
+    for rank in range(n_src):
+      cnt = flat.get(f"{cname}/r{rank}/counts")
+      if cnt is None:
+        continue
+      cnt = np.asarray(cnt, np.int64)
+      for slot in src_layout[cname][rank]:
+        t, off, rs0, nrows, _c0, _c1, _rs = (int(v) for v in slot)
+        tc = table_counts.get(t)
+        if tc is None:
+          tc = table_counts[t] = np.zeros((cfgs[t].input_dim,), np.int64)
+        vals = cnt[(off + np.arange(nrows)) // rpp]
+        np.maximum(tc[rs0:rs0 + nrows], vals, out=tc[rs0:rs0 + nrows])
+  ranking: Dict[str, list] = {}
+  for key in plan.host_tier_class_keys():
+    cp = plan.classes[key]
+    name = class_param_name(*key)
+    lay = store.tplan.by_name(name).layout_logical
+    rpp = lay.rows_per_phys
+    per_rank = []
+    for rank in range(plan.world_size):
+      arr = np.zeros((lay.phys_rows,), np.int64)
+      for sh, off in zip(cp.shards_per_rank[rank],
+                         cp.row_offsets_per_rank[rank]):
+        tc = table_counts.get(sh.table_id)
+        if tc is None:
+          continue
+        grp = (off + np.arange(sh.input_dim)) // rpp
+        np.maximum.at(arr, grp,
+                      tc[sh.row_start:sh.row_start + sh.input_dim])
+      if rank in store.owned_ranks:
+        store.counts[name][rank][:] = arr
+      # count-desc, row-id-asc ties (stable argsort over ascending ids)
+      per_rank.append(np.argsort(-arr, kind="stable").astype(np.int32))
+    ranking[name] = per_rank
+  return ranking
+
+
 def _restore_elastic(path: str, manifest: Dict[str, Any],
                      plan: DistEmbeddingStrategy, rule: SparseRule,
                      state_like: Dict[str, Any],
                      mesh: Optional[Mesh], axis_name: str,
-                     store) -> Dict[str, Any]:
+                     store, vocab=None) -> Dict[str, Any]:
   """Load a world-N checkpoint onto a world-M plan by re-slicing rank
   blocks at LOGICAL-row granularity.
 
@@ -486,12 +567,18 @@ def _restore_elastic(path: str, manifest: Dict[str, Any],
       fused[name] = jax.make_array_from_callback(shape, sharding, cb)
 
   if store is not None and tiered_names:
-    # resident sets / counts / staging geometry re-derived from the new
-    # TieringPlan (see docstring); images above are already authoritative
-    for name in store.counts:
-      for rank in store.owned_ranks:
-        store.counts[name][rank][:] = 0
-    store.warm_start()
+    # resident sets / staging geometry re-derive from the new
+    # TieringPlan; the OBSERVED COUNTS re-map window-wise like the row
+    # blocks (each logical row carries its old group's count into its
+    # new group), so the warm-start hot set is the saved run's ranking
+    # instead of the lowest-row default — no re-rank-interval warmup
+    # after a resize. Checkpoints without counts fall back to zeros.
+    ranking = _remap_tier_counts(path, manifest, plan, store, n_aux)
+    if ranking is None:
+      for name in store.counts:
+        for rank in store.owned_ranks:
+          store.counts[name][rank][:] = 0
+    store.warm_start(ranking)
     fused.update(store.build_fused(mesh, axis_name))
 
   # ---- dense-kind (MXU) classes: emb_dense + its optimizer leaves --------
@@ -538,6 +625,10 @@ def _restore_elastic(path: str, manifest: Dict[str, Any],
                                    sh.col_start:sh.col_end]
         out[(head + "/" + name) if head else name] = block
     return out
+
+  # the id space is table-id-keyed (raw id -> logical table row), so an
+  # elastic resize does not touch it: load verbatim
+  _load_vocab(path, manifest, vocab)
 
   parts = {}
   for part in ("dense", "dense_opt", "emb_dense", "emb_dense_opt"):
@@ -660,7 +751,7 @@ def publish_manifest_last(tmp: str, path: str,
 
 def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
          state: Dict[str, Any], store=None,
-         extra: Optional[Dict[str, Any]] = None) -> None:
+         extra: Optional[Dict[str, Any]] = None, vocab=None) -> None:
   """Write the full fused train state under directory ``path``.
 
   Atomicity: everything is written into ``path + '.tmp'`` and renamed at
@@ -688,6 +779,18 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
   cold blocks — sealed into the shared crc32 manifest through the same
   per-process DONE-marker protocol as the fused blocks, so a save is
   published only when every owner's blocks landed.
+
+  Dynamic-vocabulary plans (``oov='allocate'``): pass the run's
+  ``dynvocab.DynVocabTranslator`` as ``vocab``. The whole id space —
+  raw-id -> row mapping, admission sketch, freelist/TTL stamps,
+  cumulative lifecycle counters — is written as ``vocab.npz`` plus a
+  ``vocab`` manifest section (knobs + per-table capacity/occupancy),
+  sealed through the same crc32-manifest-last protocol, so a restore
+  resumes with the EXACT id space (a resumed run translating the same
+  stream allocates the same rows — the consumed-id analogue of the
+  stream-position discipline). The translator is table-id-space (not
+  per rank), so the state also restores unchanged across an elastic
+  world resize.
   """
   engine = DistributedLookup(plan)
   tiered_names = frozenset(store.tplan.tier_specs) if store is not None \
@@ -698,6 +801,19 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
         "saving only the compact device buffers would drop the cold rows "
         "(the authoritative majority of the weights). Pass the run's "
         "store via save(..., store=store).")
+  if vocab is None and getattr(plan, "oov", "clip") == "allocate":
+    raise ValueError(
+        "plan.oov='allocate' but no DynVocabTranslator was passed: "
+        "saving only the buffers would drop the id space (which raw id "
+        "owns which row) — a resumed run would re-allocate from scratch "
+        "and train the restored rows with the WRONG ids. Pass the run's "
+        "translator via save(..., vocab=translator).")
+  if vocab is not None and getattr(plan, "oov", "clip") != "allocate":
+    raise ValueError(
+        "save(..., vocab=...) on a static-vocab plan "
+        f"(oov={getattr(plan, 'oov', 'clip')!r}): there is no id space "
+        "to persist — drop the argument or build the plan with "
+        "oov='allocate'.")
   layouts = engine.fused_layouts(
       rule, rows_overrides=store.tplan.rows_overrides if store else None)
   if store is not None:
@@ -776,6 +892,16 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
       tiering_meta = {"classes": store.tplan.geometry()}
       _write_tier_blocks(tmp, store, _seal)
 
+    vocab_meta = None
+    if vocab is not None:
+      # the id space is table-id-keyed global host state (like the
+      # replicated dense parts): process 0 writes the one npz
+      vocab_meta = vocab.manifest_section()
+      if p0:
+        fpath = os.path.join(tmp, "vocab.npz")
+        np.savez(fpath, **vocab.state_arrays())
+        _seal(fpath)
+
     if p0:
       for part in ("dense", "dense_opt", "emb_dense", "emb_dense_opt"):
         fpath = os.path.join(tmp, f"{part}.npz")
@@ -849,6 +975,8 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
       manifest["extra"] = extra
     if tiering_meta is not None:
       manifest["tiering"] = tiering_meta
+    if vocab_meta is not None:
+      manifest["vocab"] = vocab_meta
     publish_manifest_last(tmp, path, manifest)
 
   # The publication must reach the renamed-barrier on EVERY exception —
@@ -878,11 +1006,37 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
           "(its exception has the root cause)")
 
 
+def _load_vocab(path: str, manifest: Dict[str, Any], vocab) -> None:
+  """Restore the dynamic id space from a checkpoint's ``vocab`` section
+  (presence of the section and of the translator must agree; knob or
+  geometry mismatches refuse inside ``vocab.load_state`` with the
+  reason named)."""
+  section = manifest.get("vocab")
+  if section is None and vocab is None:
+    return
+  if section is not None and vocab is None:
+    raise ValueError(
+        "checkpoint carries a dynamic-vocabulary ('vocab') section but "
+        "no DynVocabTranslator was passed: restoring the buffers without "
+        "the id space would train the restored rows with the WRONG ids. "
+        "Pass restore(..., vocab=translator) built from an "
+        "oov='allocate' plan with the saving run's knobs.")
+  if section is None:
+    raise ValueError(
+        "restore(..., vocab=...) but the checkpoint has no 'vocab' "
+        "section: it was written by a static-vocab run, so there is no "
+        "id space to load — a dynamic run cannot adopt it without an "
+        "explicit (id -> row) seeding step.")
+  with np.load(os.path.join(path, "vocab.npz")) as z:
+    flat = {k: np.asarray(v) for k, v in z.items()}
+  vocab.load_state(flat, section)
+
+
 def restore(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
             state_like: Dict[str, Any],
             mesh: Optional[Mesh] = None,
             axis_name: str = "mp", store=None,
-            verify_integrity: bool = True) -> Dict[str, Any]:
+            verify_integrity: bool = True, vocab=None) -> Dict[str, Any]:
   """Load a checkpoint written by :func:`save` into a new state dict.
 
   Args:
@@ -994,7 +1148,7 @@ def restore(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
     reason = _elastic_reason(manifest, want, plan)
     if reason is None:
       return _restore_elastic(path, manifest, plan, rule, state_like,
-                              mesh, axis_name, store)
+                              mesh, axis_name, store, vocab)
     diff_keys = sorted(k for k in set(manifest["plan"]) | set(want)
                        if manifest["plan"].get(k) != want.get(k))
     detail = "; ".join(
@@ -1024,12 +1178,7 @@ def restore(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
     # tier state: one 'tiering.npz' from a fully-owned save, or per-owner
     # 'tiering_p<k>.npz' files from a sharded one — merge whatever exists
     # (only this store's ranks are read either way)
-    flat: Dict[str, np.ndarray] = {}
-    for fn in sorted(os.listdir(path)):
-      if fn == "tiering.npz" or (fn.startswith("tiering_p")
-                                 and fn.endswith(".npz")):
-        with np.load(os.path.join(path, fn)) as z:
-          flat.update({k: np.asarray(v) for k, v in z.items()})
+    flat = _load_tier_state_flat(path)
     for name in sorted(tiered_names):
       for rank in store.owned_ranks:
         store.set_image(name, rank, np.load(
@@ -1075,6 +1224,8 @@ def restore(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
         return np.load(files[rank], mmap_mode="r")
 
       fused[name] = jax.make_array_from_callback(shape, sharding, cb)
+
+  _load_vocab(path, manifest, vocab)
 
   parts = {}
   for part in ("dense", "dense_opt", "emb_dense", "emb_dense_opt"):

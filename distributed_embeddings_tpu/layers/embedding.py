@@ -330,6 +330,13 @@ class TableConfig:
   regularizer: Any = None  # table penalty (None | name | callable)
   constraint: Any = None  # post-update row projection (None | name | callable)
   name: Optional[str] = None
+  # Dynamic vocabulary (plan oov='allocate'): allocatable rows of THIS
+  # table, overriding the plan-level ``vocab_capacity`` downward (a hot
+  # user table and a long-tail item table rarely want one global cap).
+  # None defers to the plan; the planner refuses the field on static
+  # plans, and it never changes any buffer layout — the manifest's
+  # ``vocab`` section pins the resulting capacity, not this knob.
+  vocab_capacity: Optional[int] = None
 
   def size(self) -> int:
     return self.input_dim * self.output_dim

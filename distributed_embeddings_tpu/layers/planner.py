@@ -324,6 +324,9 @@ class DistEmbeddingStrategy:
                host_row_threshold: Optional[int] = None,
                hbm_budget_bytes: Optional[int] = None,
                oov: str = "clip",
+               vocab_capacity: Optional[int] = None,
+               admit_threshold: int = 1,
+               evict_ttl: Optional[int] = None,
                wire_dtype: str = "f32",
                dedup_exchange: bool = False,
                overlap: str = "none",
@@ -402,9 +405,73 @@ class DistEmbeddingStrategy:
     # from step metrics under jit (resilience.guards.check_oov) — for
     # debugging id pipelines where a clip would bury the bug. Not part of
     # the plan fingerprint: the policy changes no layout and no numerics.
-    if oov not in ("clip", "error"):
-      raise ValueError(f"oov policy must be 'clip' or 'error', got {oov!r}")
+    # "allocate" (dynamic vocabulary, dynvocab/ subsystem): raw 64-bit ids
+    # are TRANSLATED host-side — between steps, like the tiered
+    # prefetcher's classify — to physical rows a host-side
+    # open-addressing table allocates on first admission
+    # (count-min-sketch frequency admission, TTL eviction recycling rows
+    # in place). The traced step only ever sees translated in-range ids,
+    # so the jaxpr is byte-identical to an oov='clip' plan's; a nonzero
+    # in-trace OOV counter under this policy means raw ids leaked past
+    # the translator, which guards.check_oov escalates like 'error'.
+    if oov not in ("clip", "error", "allocate"):
+      raise ValueError(
+          f"oov policy must be 'clip', 'error' or 'allocate', got {oov!r}")
     self.oov = oov
+    # ---- dynamic-vocabulary knobs (oov='allocate' only) -----------------
+    # vocab_capacity: allocatable rows per table (None = the table's full
+    # input_dim — every physical row is allocatable). admit_threshold: an
+    # id must be OBSERVED this many times (count-min-sketch estimate)
+    # before it earns a row; 1 admits everything on first sight.
+    # evict_ttl: steps of non-observation after which a row is reclaimed
+    # to the freelist (its table AND interleaved optimizer lanes re-zero
+    # before reuse); None never evicts. None of these knobs changes any
+    # buffer layout or the traced step, so they are NOT part of the plan
+    # fingerprint — the checkpoint manifest's 'vocab' section pins the
+    # translator state they govern instead.
+    if oov != "allocate":
+      if vocab_capacity is not None or admit_threshold != 1 \
+          or evict_ttl is not None:
+        raise ValueError(
+            "vocab_capacity/admit_threshold/evict_ttl only apply to the "
+            "dynamic-vocabulary policy: build the plan with "
+            "oov='allocate' (got oov=" + repr(oov) + ").")
+      for t, c in enumerate(_normalize_configs(embeddings)):
+        if getattr(c, "vocab_capacity", None) is not None:
+          raise ValueError(
+              f"table {t} carries a per-table vocab_capacity "
+              f"({c.vocab_capacity}) on a static-vocab plan "
+              f"(oov={oov!r}): the cap only governs dynamic allocation "
+              "— build the plan with oov='allocate' or drop the field.")
+    else:
+      if vocab_capacity is not None and (
+          not isinstance(vocab_capacity, int) or vocab_capacity < 1):
+        raise ValueError(
+            f"vocab_capacity must be a positive int, got "
+            f"{vocab_capacity!r}")
+      for t, c in enumerate(_normalize_configs(embeddings)):
+        per = getattr(c, "vocab_capacity", None)
+        if per is not None and (not isinstance(per, int) or per < 1):
+          raise ValueError(
+              f"table {t}'s vocab_capacity must be a positive int, got "
+              f"{per!r}")
+        for cap, what in ((vocab_capacity, "vocab_capacity"),
+                          (per, f"table {t}'s vocab_capacity")):
+          if cap is not None and cap > c.input_dim:
+            raise ValueError(
+                f"{what}={cap:,} exceeds table {t}'s "
+                f"input_dim={c.input_dim:,}: allocated rows must fit the "
+                "physical table. Lower the capacity or grow the table.")
+      if not isinstance(admit_threshold, int) or admit_threshold < 1:
+        raise ValueError(
+            f"admit_threshold must be an int >= 1, got {admit_threshold!r}")
+      if evict_ttl is not None and (not isinstance(evict_ttl, int)
+                                    or evict_ttl < 1):
+        raise ValueError(
+            f"evict_ttl must be None or an int >= 1, got {evict_ttl!r}")
+    self.vocab_capacity = vocab_capacity
+    self.admit_threshold = admit_threshold
+    self.evict_ttl = evict_ttl
     self.strategy = "basic" if world_size == 1 else strategy
     self.world_size = world_size
     # ---- third placement tier: host-offloaded cold storage --------------
@@ -995,6 +1062,18 @@ class DistEmbeddingStrategy:
         assign[id(sh)] = len(bins)
         bins.append([sh.input_dim, -1])
     return assign if assign else None
+
+  def table_vocab_capacity(self, table_id: int) -> int:
+    """Allocatable rows of one table under ``oov='allocate'``: the
+    table's own ``TableConfig.vocab_capacity`` when set, else the
+    plan-level ``vocab_capacity``, else the full ``input_dim``."""
+    cfg = self.global_configs[table_id]
+    cap = cfg.input_dim
+    if getattr(self, "vocab_capacity", None) is not None:
+      cap = min(cap, self.vocab_capacity)
+    if getattr(cfg, "vocab_capacity", None) is not None:
+      cap = min(cap, cfg.vocab_capacity)
+    return cap
 
   def table_tier(self, table_id: int) -> str:
     """Storage tier of one table: 'host' (cold store + hot cache) or

@@ -88,7 +88,8 @@ def prune(root: str, keep: int) -> List[str]:
 def save_rotating(root: str, plan, rule, state: Dict[str, Any],
                   store=None, keep: int = 3,
                   policy: retry.RetryPolicy = retry.DEFAULT_POLICY,
-                  extra: Optional[Dict[str, Any]] = None) -> str:
+                  extra: Optional[Dict[str, Any]] = None,
+                  vocab=None) -> str:
   """Durably save ``state`` as ``<root>/ckpt_<step>`` and rotate.
 
   The step is read from ``state['step']`` so the directory name always
@@ -110,16 +111,18 @@ def save_rotating(root: str, plan, rule, state: Dict[str, Any],
   path = step_dir(root, step)
   os.makedirs(root, exist_ok=True)
   if jax.process_count() > 1:
-    checkpoint.save(path, plan, rule, state, store=store, extra=extra)
+    checkpoint.save(path, plan, rule, state, store=store, extra=extra,
+                    vocab=vocab)
   else:
     retry.retry_call(checkpoint.save, path, plan, rule, state, store=store,
-                     extra=extra, policy=policy)
+                     extra=extra, vocab=vocab, policy=policy)
   prune(root, keep)
   return path
 
 
 def restore_latest(root: str, plan, rule, state_like: Dict[str, Any],
-                   mesh=None, axis_name: str = "mp", store=None
+                   mesh=None, axis_name: str = "mp", store=None,
+                   vocab=None
                    ) -> Optional[Tuple[Dict[str, Any], int, str]]:
   """Auto-resume: restore the newest VALID checkpoint under ``root``.
 
@@ -161,6 +164,6 @@ def restore_latest(root: str, plan, rule, state_like: Dict[str, Any],
       return None
     step, path = got
   state = checkpoint.restore(path, plan, rule, state_like, mesh=mesh,
-                             axis_name=axis_name, store=store,
+                             axis_name=axis_name, store=store, vocab=vocab,
                              verify_integrity=False)
   return state, step, path

@@ -80,7 +80,23 @@ def check_oov(plan, oov_counts: Dict[str, Any],
   """
   counts = {name: int(np.asarray(jax.device_get(v)))
             for name, v in oov_counts.items()}
-  if getattr(plan, "oov", "clip") != "error":
+  policy = getattr(plan, "oov", "clip")
+  if policy == "allocate":
+    # dynamic vocabulary: the translator emits only in-range rows (or
+    # PAD), so a nonzero in-trace counter means RAW ids reached the step
+    # untranslated — a wiring bug the commit gate already kept out of
+    # the state; escalate it like 'error', naming the actual failure
+    bad = {name: n for name, n in counts.items() if n}
+    if bad:
+      raise ValueError(
+          f"OOV policy 'allocate': {where} observed out-of-range ids — "
+          f"{sorted(bad.items())} — but a translated stream is in-range "
+          "by construction, so raw ids leaked past the dynvocab "
+          "translator (was the batch fed to the step without "
+          "DistributedLookup.translate_dynamic_ids / DynVocabTrainer?). "
+          "The offending batch committed nothing.")
+    return counts
+  if policy != "error":
     return counts
   bad = {name: n for name, n in counts.items() if n}
   if bad:

@@ -87,6 +87,18 @@ class ResilientTrainer:
       are then taken from the TieredTrainer (pass ``None``); batches are
       HOST batches (the classify stage needs the global ids before any
       sharding).
+    dynvocab: a GUARDED ``dynvocab.DynVocabTrainer`` — the trainer then
+      drives DYNAMIC-VOCABULARY steps: each :meth:`step` translates the
+      raw-id host batch (allocating/evicting through the id space),
+      re-zeroes recycled rows, and runs the guarded fused step, while
+      THIS trainer owns the durability/guard accounting. Snapshots
+      persist the id space through the manifest's ``vocab`` section and
+      resume/rollback restores it exactly (the translator's cumulative
+      lifecycle counters ride its state, so restarts never
+      double-count). ``step_fn``/``state`` are taken from the
+      DynVocabTrainer (pass ``None``); batches are HOST batches of raw
+      ids. Mutually exclusive with ``tiered`` (the two host passes do
+      not compose yet).
   """
 
   def __init__(self, step_fn, state: Dict[str, Any], plan, rule,
@@ -97,7 +109,45 @@ class ResilientTrainer:
                resume: bool = True, store=None,
                retry_policy: retry.RetryPolicy = retry.DEFAULT_POLICY,
                async_snapshots: bool = False,
-               tiered=None):
+               tiered=None, dynvocab=None):
+    self.dynvocab = dynvocab
+    if dynvocab is not None:
+      # dynvocab mode (the dynamic-vocabulary ROADMAP direction): this
+      # trainer drives a guarded ``dynvocab.DynVocabTrainer`` — per step
+      # the full translate / re-zero / device-step protocol — while
+      # owning the durability/guard accounting. Snapshots persist the id
+      # space (translation table, sketch, freelist, cumulative
+      # counters) through the checkpoint manifest's ``vocab`` section,
+      # and resume/rollback restores it IN PLACE alongside the buffers,
+      # so a restarted run re-translates the remaining stream onto
+      # exactly the rows the killed run would have used.
+      if tiered is not None:
+        raise NotImplementedError(
+            "tiered= with dynvocab=: the dynamic-id translation and the "
+            "tiered classify are separate host passes that do not "
+            "compose yet (make_tiered_train_step refuses oov='allocate' "
+            "for the same reason).")
+      if not getattr(dynvocab, "guard", False):
+        raise ValueError(
+            "ResilientTrainer(dynvocab=...) needs a DynVocabTrainer "
+            "built with guard=True: the resilience accounting reads the "
+            "guarded step's {'bad_step', 'oov'} metrics, and under "
+            "oov='allocate' the in-trace OOV counter doubles as the "
+            "raw-ids-leaked-past-the-translator tripwire.")
+      if step_fn is not None:
+        raise ValueError(
+            "ResilientTrainer(dynvocab=...) drives the DynVocabTrainer's "
+            "own step; pass step_fn=None (the two would race on the "
+            "state).")
+      if async_snapshots:
+        raise NotImplementedError(
+            "async_snapshots with a dynvocab trainer: checkpoint.save "
+            "serializes the translator's live host state (mapping, "
+            "sketch, freelist), which every step's translate pass "
+            "mutates — a background save would tear it (same limit as "
+            "the HostTierStore's images).")
+      state = dynvocab.state if state is None else state
+    self.vocab = dynvocab.translator if dynvocab is not None else None
     self.tiered = tiered
     if tiered is not None:
       if not getattr(tiered, "guard", False):
@@ -197,7 +247,8 @@ class ResilientTrainer:
     self.join_writer()  # never scan the root under a concurrent save
     got = durable.restore_latest(self.ckpt_root, self.plan, self.rule,
                                  self.state, mesh=self.mesh,
-                                 axis_name=self.axis_name, store=self.store)
+                                 axis_name=self.axis_name, store=self.store,
+                                 vocab=self.vocab)
     if got is None:
       return False
     from .. import checkpoint
@@ -211,6 +262,10 @@ class ResilientTrainer:
       # cold rows and trip the missed>0 contract
       self.tiered.state = self.state
       self.tiered.prefetcher.refresh_resident()
+    if self.dynvocab is not None:
+      # the restore loaded the id space into the translator IN PLACE
+      # (restore_latest(vocab=...)); only the state pointer moves
+      self.dynvocab.state = self.state
     self.resumed_from = path
     self._last_snapshot = step
     extra = checkpoint.read_manifest(path).get("extra", {})
@@ -259,7 +314,7 @@ class ResilientTrainer:
       path = durable.save_rotating(self.ckpt_root, self.plan, self.rule,
                                    self.state, store=self.store,
                                    keep=self.keep, policy=self.retry_policy,
-                                   extra=extra)
+                                   extra=extra, vocab=self.vocab)
       self._last_snapshot = self.step_count
       return path
     if jax.process_count() > 1:
@@ -267,6 +322,13 @@ class ResilientTrainer:
           "snapshot(async_=True) under multi-controller: the save's "
           "publication barriers are collective and must run on every "
           "process's main thread. Use synchronous snapshots there.")
+    if self.vocab is not None:
+      raise NotImplementedError(
+          "snapshot(async_=True) with a DynVocabTranslator: the save "
+          "serializes the translator's live host state, which the next "
+          "step's translate pass mutates — a background save would tear "
+          "the id space it checksums. Snapshot dynvocab runs "
+          "synchronously.")
     if self.store is not None:
       raise NotImplementedError(
           "snapshot(async_=True) with a HostTierStore: checkpoint.save "
@@ -342,9 +404,13 @@ class ResilientTrainer:
     Sparse mode: ``batch`` is an already-sharded device batch. Tiered
     mode (``tiered=``): ``batch`` is the HOST ``(numerical, cats,
     labels)`` — the classify stage routes the global ids before the
-    device ever sees them."""
+    device ever sees them. Dynvocab mode (``dynvocab=``): ``batch`` is
+    the HOST batch of RAW ids — the translate pass needs them before
+    any sharding."""
     if self.tiered is not None:
       return self._step_tiered(*batch)
+    if self.dynvocab is not None:
+      return self._step_dynvocab(*batch)
     self.state, loss, metrics = self._step_fn(self.state, *batch)
     self.consumed += 1
     # ONE host transfer for everything the accounting reads. Fetching
@@ -403,6 +469,39 @@ class ResilientTrainer:
       self.snapshot()
     return loss
 
+  def _step_dynvocab(self, numerical, cats, labels) -> float:
+    """One guarded DYNVOCAB step: translate (the id space consumes the
+    batch — allocation, admission counts, TTL clock), re-zero evicted
+    rows, device step, with THIS trainer's guard accounting.
+
+    The id space deliberately consumes guard-SKIPPED batches too — the
+    same discipline as the ``consumed`` stream position: an unkilled
+    reference run translates every batch, so a resumed run must as
+    well, or the two id spaces diverge. Per-class lifecycle counters
+    stay with the DynVocabTrainer (``account_vocab``); the cumulative
+    totals live INSIDE the translator state, so snapshots persist them
+    and restarts never double-count."""
+    from ..training import shard_batch
+
+    d = self.dynvocab
+    d.state = self.state
+    cats_t, vocab_metrics = d._translate(cats)
+    batch = shard_batch((numerical, list(cats_t), labels), self.mesh,
+                        self.axis_name)
+    d.state, loss, metrics = d._step_fn(d.state, *batch)
+    self.consumed += 1
+    loss, metrics, stepped = jax.device_get(
+        (loss, metrics, d.state["step"]))
+    d.account_vocab(vocab_metrics)
+    d.steps += 1
+    self.state = d.state
+    self._account(metrics)
+    loss = float(np.asarray(loss))
+    if self.snapshot_every and \
+        int(stepped) - self._last_snapshot >= self.snapshot_every:
+      self.snapshot()
+    return loss
+
   def run(self, batches: Iterable, snapshot_final: bool = False
           ) -> List[float]:
     """Train over host batches of ``(numerical, cats, labels)``.
@@ -418,7 +517,7 @@ class ResilientTrainer:
 
     losses = []
     for batch in batches:
-      if self.tiered is not None:
+      if self.tiered is not None or self.dynvocab is not None:
         losses.append(self.step(*batch))
         continue
       sb = shard_batch(tuple(batch), self.mesh, self.axis_name)
@@ -439,4 +538,6 @@ class ResilientTrainer:
     }
     if self.dedup_overflow_totals:
       out["dedup_overflow"] = dict(self.dedup_overflow_totals)
+    if self.dynvocab is not None:
+      out["vocab"] = self.dynvocab.metrics_summary()["per_class"]
     return out

@@ -216,6 +216,15 @@ def make_serve_step(model, plan: DistEmbeddingStrategy,
         "train step's metrics + commit gate, and the serve step carries "
         "neither. Serve with oov='clip' (the routing clamp is identical) "
         "or run make_sparse_eval_step(with_metrics=True) to count OOV.")
+  if getattr(plan, "oov", "clip") == "allocate":
+    raise ValueError(
+        "plan.oov='allocate' is not servable: allocation MUTATES the id "
+        "space (admission counts, row allocation, TTL eviction), and an "
+        "inference path must never mutate it — a serve request earning "
+        "rows would shift what training trains, from a path with no "
+        "commit gate. Serve with oov='clip' (same tables, same frozen "
+        "image) and translate request ids read-only host-side "
+        "(dynvocab.DynVocabTranslator.translate_readonly).")
   engine = DistributedLookup(plan, dp_input=True, axis_name=axis_name)
   base_layouts = {n: m.packed for n, m in serve_meta.items()}
   tiered = tier_specs is not None and bool(tier_specs)

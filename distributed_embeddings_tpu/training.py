@@ -246,6 +246,14 @@ def make_train_step(loss_fn: Callable,
         "has no OOV metrics, so out-of-range ids would be silently "
         "clipped — the policy's failure mode. Use the guarded sparse "
         "step, or oov='clip'.")
+  if plan is not None and getattr(plan, "oov", "clip") == "allocate":
+    raise NotImplementedError(
+        "plan.oov='allocate' (dynamic vocabulary) rides the fused sparse "
+        "path: the dynvocab translator allocates into the PACKED class "
+        "buffers and re-zeroes recycled rows' interleaved optimizer "
+        "lanes, which this dense-autodiff builder does not hold. Drive "
+        "training through dynvocab.DynVocabTrainer (make_sparse_train_"
+        "step underneath), or use a static oov policy.")
   if plan is not None and getattr(plan, "dedup_capacity", None) is not None:
     raise NotImplementedError(
         "plan.dedup_capacity caps the dedup'd exchange's unique blocks "
@@ -624,7 +632,10 @@ def _make_guard_helpers(plan: DistEmbeddingStrategy, mesh, axis_name: str):
   - ``oov_ok(oov)``: the oov='error' commit gate (None under 'clip') — a
     batch carrying ANY out-of-range id commits nothing, so the host-side
     ``check_oov`` raise fires with the state bit-identical to before the
-    batch.
+    batch. ``oov='allocate'`` gates identically: translated ids are
+    in-range by construction, so a nonzero counter means RAW ids leaked
+    past the dynvocab translator — that batch must not train the clamp
+    rows either.
   - ``guard_metrics(ok, oov, overflow=None)``: the replicated
     ``{'bad_step', 'oov'}`` metrics dict (counters psum'd across the
     mesh); with ``overflow`` (per-class dedup-capacity overflow counts —
@@ -632,7 +643,7 @@ def _make_guard_helpers(plan: DistEmbeddingStrategy, mesh, axis_name: str):
     entry joins it.
   """
   from .resilience import guards as _guards
-  oov_is_error = getattr(plan, "oov", "clip") == "error"
+  oov_is_error = getattr(plan, "oov", "clip") in ("error", "allocate")
 
   def guard_gate(loss, grads, streams, oov_ok=None):
     ok = _guards.all_finite((loss, grads, streams))
@@ -1091,6 +1102,14 @@ def make_tiered_train_step(model, tplan, loss_fn: Callable,
   """
   plan = tplan.plan
   tier_specs = tplan.tier_specs
+  if getattr(plan, "oov", "clip") == "allocate":
+    raise NotImplementedError(
+        "plan.oov='allocate' with tiered storage: the tiered prefetcher "
+        "classifies RAW ids host-side, so the dynamic-id translation and "
+        "the classify stage would have to compose into one host pass — "
+        "an open follow-on (ROADMAP, dynamic-vocab direction). Keep "
+        "dynamic tables device-resident (host_row_threshold=None) or "
+        "use a static oov policy for tiered plans.")
   if getattr(plan, "oov", "clip") == "error" and not guard:
     raise ValueError(
         "plan.oov='error' requires make_tiered_train_step(guard=True): "
@@ -1287,6 +1306,16 @@ def make_sparse_eval_step(model, plan: DistEmbeddingStrategy,
         "distinct ids onto the cap's last slot — those predictions read "
         "the WRONG rows — and only the metrics path surfaces the psum'd "
         "'dedup_overflow' counter that makes that observable.")
+  if getattr(plan, "oov", "clip") == "allocate":
+    raise ValueError(
+        "plan.oov='allocate' is not evaluable: allocation MUTATES the id "
+        "space (admission counts, row allocation, TTL eviction), and an "
+        "inference path must never mutate it — an eval batch earning "
+        "rows would silently shift what every later training step "
+        "trains. Build the eval plan with oov='clip' (same tables, same "
+        "layouts — the knob changes no buffer) and feed it ids already "
+        "translated read-only (dynvocab.DynVocabTranslator."
+        "translate_readonly).")
   engine = DistributedLookup(plan, dp_input=True, axis_name=axis_name)
   layouts = engine.fused_layouts(rule)
 

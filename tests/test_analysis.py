@@ -382,6 +382,56 @@ import optax  # graftlint: disable=GL111
   assert lint_source(src, SERVING_PATH, CTX, ["GL111"]) == []
 
 
+def test_gl112_translator_call_in_step_builder():
+  """The dynamic-vocab invariant: translation-state mutation lives in
+  dynvocab/ host paths — a translator call inside a trace-reachable
+  step closure would break tracing or freeze one translation into the
+  compiled step."""
+  src = """
+def make_sparse_train_step(plan, translator):
+  def local_step(state, cats, labels):
+    cats, _, _ = translator.translate_batch(cats)
+    return state
+  return local_step
+"""
+  out = lint_source(src, "m.py", CTX, ["GL112"])
+  assert _rules(out) == ["GL112"]
+  assert "host state" in out[0].message
+  # the dynvocab package itself is the sanctioned home
+  assert lint_source(
+      src, "distributed_embeddings_tpu/dynvocab/trainer.py", CTX,
+      ["GL112"]) == []
+  # host-side code (trainers, tools, tests) is unrestricted: the hook
+  # itself lives OUTSIDE any step builder
+  host = """
+def drive(engine, translator, cats):
+  return engine.translate_dynamic_ids(cats, translator)
+"""
+  assert lint_source(host, "m.py", CTX, ["GL112"]) == []
+  assert lint_source(
+      host, "distributed_embeddings_tpu/parallel/lookup_engine.py", CTX,
+      ["GL112"]) == []
+
+
+def test_gl112_constructors_and_suppression():
+  src = """
+def make_eval_step(plan):
+  def local_eval(state, cats):
+    table = IdTranslationTable(100)
+    return state
+  return local_eval
+"""
+  assert _rules(lint_source(src, "m.py", CTX, ["GL112"])) == ["GL112"]
+  sup = """
+def make_eval_step(plan):
+  def local_eval(state, cats):
+    table = IdTranslationTable(100)  # graftlint: disable=GL112
+    return state
+  return local_eval
+"""
+  assert lint_source(sup, "m.py", CTX, ["GL112"]) == []
+
+
 # ---------------------------------------------------------------------------
 # repo-context parsing + HEAD cleanliness
 # ---------------------------------------------------------------------------

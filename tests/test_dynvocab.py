@@ -1,0 +1,644 @@
+"""Dynamic-vocabulary tests (`distributed_embeddings_tpu/dynvocab/`).
+
+The contract under test: ``oov='allocate'`` replaces the static id space
+with a host-side translated one WITHOUT touching the traced step —
+
+- the open-addressing translation table round-trips any id stream within
+  capacity losslessly and deterministically;
+- the count-min sketch never undercounts (admission can only err toward
+  early admission, never starvation), and its overcount is bounded;
+- a dynvocab run over a pre-admitted in-capacity stream is BIT-EXACT
+  against the static-vocab run it shadows (forward, loss, and update
+  trajectory) — across worlds, guarded, and micro-batched;
+- eviction recycles rows in place: a re-admitted id lands on a row whose
+  table AND optimizer lanes were re-zeroed on device;
+- the id space persists through the checkpoint manifest's ``vocab``
+  section: auto-resume restores table/sketch/freelist exactly, and the
+  cumulative lifecycle counters survive restarts un-double-counted;
+- eval and serve builders refuse ``'allocate'`` plans at build time (an
+  inference path must never mutate the id space).
+"""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+
+from distributed_embeddings_tpu import checkpoint
+from distributed_embeddings_tpu.dynvocab import (
+    CountMinSketch,
+    DynVocabTrainer,
+    DynVocabTranslator,
+    IdTranslationTable,
+)
+from distributed_embeddings_tpu.layers.embedding import TableConfig
+from distributed_embeddings_tpu.layers.planner import DistEmbeddingStrategy
+from distributed_embeddings_tpu.models import DLRM, bce_loss
+from distributed_embeddings_tpu.models.dlrm import _dlrm_initializer
+from distributed_embeddings_tpu.ops.packed_table import sparse_rule
+from distributed_embeddings_tpu.parallel import create_mesh
+from distributed_embeddings_tpu.training import (
+    init_sparse_state_direct,
+    make_sparse_eval_step,
+    make_sparse_train_step,
+    make_train_step,
+    shard_batch,
+    shard_params,
+)
+
+WIDTH = 16
+VOCAB = [500, 300]
+RULE = sparse_rule("adagrad", 0.05)
+
+
+def _tables(vocab=VOCAB):
+  return [TableConfig(input_dim=v, output_dim=WIDTH,
+                      initializer=_dlrm_initializer(v)) for v in vocab]
+
+
+def _plan(world, vocab=VOCAB, **kw):
+  return DistEmbeddingStrategy(_tables(vocab), world, "memory_balanced",
+                               dense_row_threshold=0, **kw)
+
+
+def _model(world, vocab=VOCAB):
+  return DLRM(vocab_sizes=vocab, embedding_dim=WIDTH,
+              bottom_mlp=(32, WIDTH), top_mlp=(32, 1), world_size=world,
+              strategy="memory_balanced", dense_row_threshold=0)
+
+
+def _batch(seed, vocab=VOCAB, batch=32):
+  r = np.random.default_rng(seed)
+  numerical = r.standard_normal((batch, 13)).astype(np.float32)
+  cats = [r.integers(0, v, batch, dtype=np.int64) for v in vocab]
+  labels = r.integers(0, 2, batch).astype(np.float32)
+  return numerical, cats, labels
+
+
+def _dense_params(model, batch0):
+  num, cats, _ = batch0
+  dummy = [np.zeros((2, WIDTH), np.float32) for _ in cats]
+  return model.init(jax.random.PRNGKey(0), num[:2], [c[:2] for c in cats],
+                    emb_acts=dummy)["params"]
+
+
+def _fresh(world, plan, batch0, guard=True, micro_batches=1):
+  model = _model(world)
+  mesh = create_mesh(world) if world > 1 else None
+  dense = _dense_params(model, batch0)
+  state = shard_params(
+      init_sparse_state_direct(plan, RULE, dense, optax.adam(1e-3),
+                               jax.random.PRNGKey(1)), mesh)
+  translator = DynVocabTranslator(plan, RULE)
+  trainer = DynVocabTrainer(model, plan, translator, bce_loss,
+                            optax.adam(1e-3), RULE, mesh, state, batch0,
+                            guard=guard, micro_batches=micro_batches,
+                            donate=False)
+  return model, mesh, trainer
+
+
+# ---------------------------------------------------------------------------
+# units: translation table
+# ---------------------------------------------------------------------------
+
+
+def test_table_roundtrip_lossless_and_deterministic():
+  """Any distinct-id set within capacity maps losslessly: distinct rows
+  in [0, capacity), stable across repeated lookups, and identical when a
+  fresh table replays the same insertion sequence."""
+  rng = np.random.default_rng(3)
+  cap = 512
+  ids = rng.choice(10 ** 12, size=cap, replace=False).astype(np.int64)
+  t1 = IdTranslationTable(cap)
+  t2 = IdTranslationTable(cap)
+  for row, i in enumerate(ids.tolist()):
+    t1.insert(i, row)
+    t2.insert(i, row)
+  for t in (t1, t2):
+    rows = t.lookup(ids)
+    assert np.array_equal(rows, np.arange(cap, dtype=np.int32))
+    assert np.array_equal(rows, t.lookup(ids))  # stable
+  # unmapped ids miss, mapped ids hit, interleaved
+  probe = np.concatenate([ids[:7], ids[:7] + 1])
+  got = t1.lookup(probe)
+  assert np.array_equal(got[:7], np.arange(7, dtype=np.int32))
+  assert np.all(got[7:] == -1)
+
+
+def test_table_remove_tombstones_and_rebuild():
+  """Insert/remove churn (forcing tombstone compaction) never corrupts
+  the surviving mapping, and items() captures exactly the live set."""
+  cap = 64
+  t = IdTranslationTable(cap)
+  live = {}
+  next_row = list(range(cap))
+  rng = np.random.default_rng(11)
+  for step in range(2000):
+    if live and (len(live) == cap or rng.random() < 0.5):
+      rid = sorted(live)[int(rng.integers(len(live)))]
+      row = t.remove(rid)
+      assert row == live.pop(rid)
+      next_row.append(row)
+    else:
+      rid = int(rng.integers(10 ** 9))
+      if rid in live:
+        continue
+      row = next_row.pop(0)
+      t.insert(rid, row)
+      live[rid] = row
+  ids, rows = t.items()
+  assert dict(zip(ids.tolist(), rows.tolist())) == live
+  if live:
+    keys = np.asarray(sorted(live), np.int64)
+    assert np.array_equal(t.lookup(keys),
+                          np.asarray([live[k] for k in sorted(live)],
+                                     np.int32))
+
+
+def test_table_serialization_is_mapping_not_probe_history():
+  t = IdTranslationTable(32)
+  for i, rid in enumerate([5, 99, 12345, 7 * 10 ** 11]):
+    t.insert(rid, i)
+  t.remove(99)  # leaves a tombstone in t but not in the serialized form
+  ids, rows = t.items()
+  t2 = IdTranslationTable(32)
+  t2.load_items(ids, rows)
+  probe = np.asarray([5, 99, 12345, 7 * 10 ** 11], np.int64)
+  assert np.array_equal(t.lookup(probe), t2.lookup(probe))
+
+
+# ---------------------------------------------------------------------------
+# units: count-min sketch
+# ---------------------------------------------------------------------------
+
+
+def test_sketch_never_undercounts_and_bounds_overcount():
+  rng = np.random.default_rng(5)
+  sk = CountMinSketch(width=1 << 12, depth=4)
+  ids = rng.integers(0, 10 ** 12, size=5000).astype(np.int64)
+  sk.update(ids)
+  uniq, true = np.unique(ids, return_counts=True)
+  est = sk.estimate(uniq)
+  assert np.all(est >= true), "count-min must NEVER undercount"
+  # classic bound: overcount per cell ~ N/width in expectation; min over
+  # 4 rows makes 8x that a generous deterministic-seed ceiling
+  assert np.max(est - true) <= max(8 * ids.size // (1 << 12), 4)
+
+
+def test_sketch_exact_for_sparse_streams():
+  """A distinct-id stream far below the width collides with nothing at
+  these fixed seeds: estimates are exact."""
+  sk = CountMinSketch(width=1 << 14, depth=4)
+  ids = np.arange(100, dtype=np.int64) * 7919
+  for _ in range(3):
+    sk.update(ids)
+  assert np.array_equal(sk.estimate(ids), np.full(100, 3, np.int64))
+
+
+def test_sketch_state_roundtrip():
+  sk = CountMinSketch(width=1 << 8, depth=2)
+  sk.update(np.asarray([1, 2, 2, 3], np.int64))
+  sk2 = CountMinSketch(width=1 << 8, depth=2)
+  sk2.load_state(sk.state())
+  assert np.array_equal(sk2.estimate(np.asarray([2], np.int64)), [2])
+  with pytest.raises(ValueError, match="width/depth"):
+    CountMinSketch(width=1 << 9, depth=2).load_state(sk.state())
+
+
+# ---------------------------------------------------------------------------
+# planner knobs + builder refusals
+# ---------------------------------------------------------------------------
+
+
+def test_planner_knob_validation():
+  with pytest.raises(ValueError, match="clip.*error.*allocate"):
+    _plan(2, oov="allocat")
+  with pytest.raises(ValueError, match="only apply to"):
+    _plan(2, admit_threshold=3)
+  with pytest.raises(ValueError, match="only apply to"):
+    _plan(2, evict_ttl=10)
+  with pytest.raises(ValueError, match="admit_threshold"):
+    _plan(2, oov="allocate", admit_threshold=0)
+  with pytest.raises(ValueError, match="evict_ttl"):
+    _plan(2, oov="allocate", evict_ttl=0)
+  with pytest.raises(ValueError, match="exceeds table"):
+    _plan(2, oov="allocate", vocab_capacity=10 ** 6)
+  p = _plan(2, oov="allocate", vocab_capacity=200, admit_threshold=2,
+            evict_ttl=5)
+  assert p.table_vocab_capacity(0) == 200
+  assert _plan(2, oov="allocate").table_vocab_capacity(0) == VOCAB[0]
+
+
+def test_per_table_vocab_capacity():
+  import dataclasses
+  tbls = _tables()
+  tbls[0] = dataclasses.replace(tbls[0], vocab_capacity=64)
+  with pytest.raises(ValueError, match="static-vocab plan"):
+    DistEmbeddingStrategy(tbls, 2, "memory_balanced",
+                          dense_row_threshold=0)
+  p = DistEmbeddingStrategy(tbls, 2, "memory_balanced",
+                            dense_row_threshold=0, oov="allocate",
+                            vocab_capacity=200)
+  assert p.table_vocab_capacity(0) == 64   # per-table cap wins downward
+  assert p.table_vocab_capacity(1) == 200  # plan cap covers the rest
+  bad = dataclasses.replace(tbls[0], vocab_capacity=10 ** 7)
+  with pytest.raises(ValueError, match="exceeds table"):
+    DistEmbeddingStrategy([bad] + tbls[1:], 2, "memory_balanced",
+                          dense_row_threshold=0, oov="allocate")
+  # the translator honors the refined capacity
+  tr = DynVocabTranslator(p, RULE)
+  assert tr.tables[0].capacity == 64
+  assert tr.recyclers[1].capacity == 200
+
+
+def test_eval_and_serve_builders_refuse_allocate():
+  world = 2
+  plan = _plan(world, oov="allocate")
+  model = _model(world)
+  mesh = create_mesh(world)
+  batch0 = _batch(0)
+  dense = _dense_params(model, batch0)
+  state = shard_params(
+      init_sparse_state_direct(plan, RULE, dense, optax.adam(1e-3),
+                               jax.random.PRNGKey(1)), mesh)
+  with pytest.raises(ValueError, match="not evaluable.*mutate"):
+    make_sparse_eval_step(model, plan, RULE, mesh, state, batch0)
+  from distributed_embeddings_tpu.serving.engine import make_serve_step
+  with pytest.raises(ValueError, match="not servable.*mutate"):
+    make_serve_step(model, plan, {}, mesh, state, batch0[:2])
+  with pytest.raises(NotImplementedError, match="allocate"):
+    make_train_step(lambda p, *b: 0.0, optax.adam(1e-3), mesh,
+                    {}, {}, batch0, plan=plan)
+
+
+def test_tiered_builder_refuses_allocate():
+  from distributed_embeddings_tpu.tiering import TieringConfig, TieringPlan
+  from distributed_embeddings_tpu.training import make_tiered_train_step
+  plan = DistEmbeddingStrategy(_tables([5000, 300]), 4, "memory_balanced",
+                               dense_row_threshold=0,
+                               host_row_threshold=1000, oov="allocate")
+  tplan = TieringPlan(plan, RULE, TieringConfig(staging_grps=64))
+  with pytest.raises(NotImplementedError, match="tiered"):
+    make_tiered_train_step(None, tplan, bce_loss, optax.adam(1e-3), RULE,
+                           None, {}, None)
+  with pytest.raises(NotImplementedError, match="host-tier"):
+    DynVocabTranslator(plan, RULE)
+
+
+# ---------------------------------------------------------------------------
+# bit-exact parity vs the static-vocab run
+# ---------------------------------------------------------------------------
+
+
+def _paired_losses(world, micro_batches=1, steps=4):
+  """Train dynvocab (pre-admitted identity id space) and the static run
+  on one stream from identical params; return losses + final fused."""
+  batch0 = _batch(100)
+  plan_dv = _plan(world, oov="allocate")
+  plan_st = _plan(world)
+  model, mesh, trainer = _fresh(world, plan_dv, batch0, guard=True,
+                                micro_batches=micro_batches)
+  # pre-admit the identity mapping: threshold 1 admits on sight, and
+  # np.unique + sequential fresh allocation maps id k -> row k
+  trainer.translator.translate_batch(
+      [np.arange(v, dtype=np.int64) for v in VOCAB])
+  dense = _dense_params(model, batch0)
+  state_st = shard_params(
+      init_sparse_state_direct(plan_st, RULE, dense, optax.adam(1e-3),
+                               jax.random.PRNGKey(1)), mesh)
+  step_st = make_sparse_train_step(model, plan_st, bce_loss,
+                                   optax.adam(1e-3), RULE, mesh, state_st,
+                                   batch0, donate=False, guard=True,
+                                   micro_batches=micro_batches)
+  losses_dv, losses_st = [], []
+  for s in range(steps):
+    b = _batch(200 + s)
+    losses_dv.append(trainer.step(*b))
+    sb = shard_batch(b, mesh)
+    state_st, loss, _ = step_st(state_st, *sb)
+    losses_st.append(float(np.asarray(loss)))
+  return losses_dv, losses_st, trainer.state, state_st, trainer
+
+
+@pytest.mark.parametrize("world", [1, 2, 4])
+def test_bit_exact_vs_static(world):
+  """Acceptance: a dynvocab run whose ids are all pre-admitted and
+  within capacity is BIT-EXACT vs the static-vocab run — losses AND the
+  full fused trajectory (tables + optimizer lanes)."""
+  losses_dv, losses_st, st_dv, st_st, trainer = _paired_losses(world)
+  assert losses_dv == losses_st
+  for name in st_st["fused"]:
+    assert np.array_equal(np.asarray(st_dv["fused"][name]),
+                          np.asarray(st_st["fused"][name])), name
+  assert int(np.asarray(st_dv["step"])) == int(np.asarray(st_st["step"]))
+  # nothing was denied or evicted on an in-capacity pre-admitted stream
+  per = trainer.metrics_summary()["per_class"]
+  assert all(v["evictions"] == 0 and v["admit_denied"] == 0
+             for v in per.values())
+
+
+def test_bit_exact_vs_static_micro_batched():
+  losses_dv, losses_st, st_dv, st_st, _ = _paired_losses(
+      4, micro_batches=2)
+  assert losses_dv == losses_st
+  for name in st_st["fused"]:
+    assert np.array_equal(np.asarray(st_dv["fused"][name]),
+                          np.asarray(st_st["fused"][name])), name
+
+
+def test_unguarded_step_matches_guarded_numerics():
+  batch0 = _batch(100)
+  plan = _plan(2, oov="allocate")
+  _, _, tg = _fresh(2, plan, batch0, guard=True)
+  plan2 = _plan(2, oov="allocate")
+  _, _, tu = _fresh(2, plan2, batch0, guard=False)
+  for s in range(3):
+    b = _batch(300 + s)
+    assert tg.step(*b) == tu.step(*b)
+
+
+# ---------------------------------------------------------------------------
+# eviction, recycling, zeroed reuse
+# ---------------------------------------------------------------------------
+
+
+def test_eviction_then_reuse_lands_on_zeroed_row():
+  """Train a dynamic id, let its TTL expire, and check (a) the freed
+  row's lanes — table AND interleaved optimizer state — are zero on
+  device in every shard window, (b) a newly admitted id recycles the
+  freed row (FIFO), starting from the zeroed state."""
+  world = 4
+  batch0 = _batch(100)
+  plan = _plan(world, oov="allocate", evict_ttl=2)
+  _, _, trainer = _fresh(world, plan, batch0, guard=True)
+  tr = trainer.translator
+  b = batch0[0].shape[0]
+  hot_id = 7_000_000_001
+  # step 1 maps hot_id ONCE plus a filler set; later steps reuse only
+  # the fillers, so no new allocation recycles the expired row before
+  # the test inspects it
+  fillers = (np.arange(b, dtype=np.int64) % 60) + 1
+  cats1 = fillers.copy()
+  cats1[0] = hot_id
+  trainer.step(batch0[0], [cats1, np.full(b, 42, np.int64)], batch0[2])
+  row = int(tr.tables[0].lookup(np.asarray([hot_id]))[0])
+  assert row >= 0
+  # the trained row is nonzero before eviction
+  layouts = trainer.layouts
+  def lanes_of(table_row):
+    out = []
+    for (name, base, rs0, nrows, off, rpp) in tr._recipe[0]:
+      if not (rs0 <= table_row < rs0 + nrows):
+        continue
+      local = table_row - rs0 + off
+      lay = layouts[name]
+      phys = np.asarray(trainer.state["fused"][name])[base + local // rpp]
+      out.append(phys[(local % rpp) * lay.stride:
+                      (local % rpp + 1) * lay.stride])
+    assert out, "no shard window covers the row"
+    return out
+  assert any(np.any(w != 0.0) for w in lanes_of(row))
+  # steps without hot_id, past the TTL: only the already-mapped fillers
+  for s in range(4):
+    bb = _batch(400 + s)
+    trainer.step(bb[0], [fillers, np.full(b, 42, np.int64)], bb[2])
+  assert tr.tables[0].lookup(np.asarray([hot_id]))[0] == -1
+  assert row in tr.recyclers[0].freelist
+  for w in lanes_of(row):
+    assert np.all(w == 0.0), "evicted row's lanes must re-zero in place"
+  # FIFO recycling: the oldest freed row is handed out first
+  expect = tr.recyclers[0].freelist[0]
+  new_id = 8_000_000_008
+  trainer.step(batch0[0],
+               [np.full(b, new_id, np.int64), np.full(b, 42, np.int64)],
+               batch0[2])
+  assert int(tr.tables[0].lookup(np.asarray([new_id]))[0]) == expect
+  per = trainer.metrics_summary()["per_class"]
+  assert sum(v["evictions"] for v in per.values()) > 0
+
+
+def test_admission_threshold_denies_one_shot_ids():
+  world = 2
+  batch0 = _batch(100)
+  plan = _plan(world, oov="allocate", admit_threshold=3)
+  _, _, trainer = _fresh(world, plan, batch0, guard=True)
+  tr = trainer.translator
+  b = batch0[0].shape[0]
+  one_shot = np.arange(b, dtype=np.int64) + 10 ** 10  # b distinct ids
+  cats = [one_shot, np.full(b, 1, np.int64)]
+  trainer.step(batch0[0], cats, batch0[2])
+  assert tr.recyclers[0].occupancy == 0, "one-shot ids must not allocate"
+  # the hot singleton in input 1 appears `batch` times per step: admitted
+  # on the FIRST step (estimate b >= 3), occupying exactly one row
+  assert tr.recyclers[1].occupancy == 1
+  per = trainer.metrics_summary()["per_class"]
+  assert sum(v["admit_denied"] for v in per.values()) >= b
+
+
+def test_capacity_cap_denies_and_counts():
+  world = 2
+  batch0 = _batch(100)
+  plan = _plan(world, oov="allocate", vocab_capacity=8)
+  _, _, trainer = _fresh(world, plan, batch0, guard=True)
+  b = batch0[0].shape[0]
+  ids = np.arange(b, dtype=np.int64) + 5 * 10 ** 9
+  trainer.step(batch0[0], [ids, ids + 777], batch0[2])
+  tr = trainer.translator
+  assert tr.recyclers[0].occupancy == 8
+  assert tr.recyclers[1].occupancy == 8
+  per = trainer.metrics_summary()["per_class"]
+  assert sum(v["admit_denied"] for v in per.values()) > 0
+
+
+# ---------------------------------------------------------------------------
+# guard: raw ids leaking past the translator
+# ---------------------------------------------------------------------------
+
+
+def test_untranslated_oov_leak_is_gated_and_raised():
+  """Feeding RAW out-of-range ids straight to a guarded allocate step
+  (bypassing the translator) must commit NOTHING and raise host-side
+  with the leak named."""
+  from distributed_embeddings_tpu.resilience import guards
+  world = 2
+  batch0 = _batch(100)
+  plan = _plan(world, oov="allocate")
+  model = _model(world)
+  mesh = create_mesh(world)
+  dense = _dense_params(model, batch0)
+  state = shard_params(
+      init_sparse_state_direct(plan, RULE, dense, optax.adam(1e-3),
+                               jax.random.PRNGKey(1)), mesh)
+  step = make_sparse_train_step(model, plan, bce_loss, optax.adam(1e-3),
+                                RULE, mesh, state, batch0, donate=False,
+                                guard=True)
+  b = batch0[0].shape[0]
+  bad = (batch0[0], [np.full(b, VOCAB[0] + 50, np.int64),
+                     np.zeros(b, np.int64)], batch0[2])
+  sb = shard_batch(bad, mesh)
+  new_state, _, metrics = step(state, *sb)
+  assert sum(int(np.asarray(v)) for v in metrics["oov"].values()) > 0
+  assert int(np.asarray(new_state["step"])) == 0, "leak must not commit"
+  with pytest.raises(ValueError, match="leaked past the dynvocab"):
+    guards.check_oov(plan, metrics["oov"], where="test")
+
+
+# ---------------------------------------------------------------------------
+# checkpoint: the vocab manifest section
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_vocab_roundtrip(tmp_path):
+  world = 2
+  batch0 = _batch(100)
+  plan = _plan(world, oov="allocate", admit_threshold=2, evict_ttl=50)
+  _, mesh, trainer = _fresh(world, plan, batch0, guard=True)
+  for s in range(3):
+    trainer.step(*_batch(500 + s))
+  path = str(tmp_path / "ckpt")
+  checkpoint.save(path, plan, RULE, trainer.state,
+                  vocab=trainer.translator)
+  manifest = checkpoint.read_manifest(path)
+  assert manifest["vocab"]["admit_threshold"] == 2
+  assert manifest["vocab"]["evict_ttl"] == 50
+  assert set(manifest["vocab"]["tables"]) == {"0", "1"}
+  assert checkpoint.verify(path) == []
+  # restore into a fresh translator: mapping, sketch, recycler, counters
+  tr2 = DynVocabTranslator(plan, RULE)
+  state2 = checkpoint.restore(path, plan, RULE, trainer.state, mesh=mesh,
+                              vocab=tr2)
+  tr = trainer.translator
+  for t in tr.dynamic_tables:
+    a, b = tr.tables[t].items(), tr2.tables[t].items()
+    assert np.array_equal(a[0], b[0]) and np.array_equal(a[1], b[1])
+    assert np.array_equal(tr.sketches[t].state(), tr2.sketches[t].state())
+    assert tr.recyclers[t].freelist == tr2.recyclers[t].freelist
+    assert np.array_equal(tr.recyclers[t].row_to_id,
+                          tr2.recyclers[t].row_to_id)
+    assert np.array_equal(tr.totals[t], tr2.totals[t])
+  assert tr2.steps == tr.steps
+  assert int(np.asarray(state2["step"])) == int(np.asarray(
+      trainer.state["step"]))
+
+
+def test_checkpoint_vocab_mismatches_refuse(tmp_path):
+  world = 2
+  batch0 = _batch(100)
+  plan = _plan(world, oov="allocate", admit_threshold=2)
+  _, mesh, trainer = _fresh(world, plan, batch0, guard=True)
+  trainer.step(*_batch(1))
+  path = str(tmp_path / "ckpt")
+  # allocate plan without the translator: refused at save
+  with pytest.raises(ValueError, match="no DynVocabTranslator"):
+    checkpoint.save(path, plan, RULE, trainer.state)
+  checkpoint.save(path, plan, RULE, trainer.state,
+                  vocab=trainer.translator)
+  # restoring without the translator: refused, names the section
+  with pytest.raises(ValueError, match="'vocab'"):
+    checkpoint.restore(path, plan, RULE, trainer.state, mesh=mesh)
+  # knob mismatch: refused with the knob named
+  plan3 = _plan(world, oov="allocate", admit_threshold=5)
+  tr3 = DynVocabTranslator(plan3, RULE)
+  with pytest.raises(ValueError, match="admit_threshold"):
+    checkpoint.restore(path, plan3, RULE, trainer.state, mesh=mesh,
+                       vocab=tr3)
+  # vocab= on a static plan: refused at save
+  plan_st = _plan(world)
+  with pytest.raises(ValueError, match="static-vocab plan"):
+    checkpoint.save(str(tmp_path / "c2"), plan_st, RULE, trainer.state,
+                    vocab=trainer.translator)
+
+
+def test_vocab_state_survives_elastic_reshard(tmp_path):
+  """The id space is table-id-keyed, so a world resize restores it
+  verbatim while the rank blocks re-shard."""
+  batch0 = _batch(100)
+  plan4 = _plan(4, oov="allocate")
+  _, mesh4, trainer = _fresh(4, plan4, batch0, guard=True)
+  for s in range(2):
+    trainer.step(*_batch(600 + s))
+  path = str(tmp_path / "ckpt")
+  checkpoint.save(path, plan4, RULE, trainer.state,
+                  vocab=trainer.translator)
+  plan2 = _plan(2, oov="allocate")
+  model2 = _model(2)
+  mesh2 = create_mesh(2)
+  dense2 = _dense_params(model2, batch0)
+  like2 = shard_params(
+      init_sparse_state_direct(plan2, RULE, dense2, optax.adam(1e-3),
+                               jax.random.PRNGKey(9)), mesh2)
+  tr2 = DynVocabTranslator(plan2, RULE)
+  checkpoint.restore(path, plan2, RULE, like2, mesh=mesh2, vocab=tr2)
+  tr = trainer.translator
+  for t in tr.dynamic_tables:
+    a, b = tr.tables[t].items(), tr2.tables[t].items()
+    assert np.array_equal(a[0], b[0]) and np.array_equal(a[1], b[1])
+    assert np.array_equal(tr.totals[t], tr2.totals[t])
+
+
+# ---------------------------------------------------------------------------
+# resilience: auto-resume restores the id space exactly
+# ---------------------------------------------------------------------------
+
+
+def _resilient(world, batch0, root, ttl=None):
+  from distributed_embeddings_tpu.resilience import ResilientTrainer
+  kw = {} if ttl is None else {"evict_ttl": ttl}
+  plan = _plan(world, oov="allocate", admit_threshold=1, **kw)
+  _, mesh, dvt = _fresh(world, plan, batch0, guard=True)
+  return plan, ResilientTrainer(
+      None, None, plan, RULE, root, mesh=mesh, snapshot_every=2,
+      resume=True, dynvocab=dvt)
+
+
+def test_resilient_resume_restores_id_space_and_trajectory(tmp_path):
+  """Kill-and-resume contract: an interrupted dynvocab run, resumed by a
+  FRESH trainer from its snapshots, continues to the same losses, the
+  same id space, and the same lifecycle counters as an uninterrupted
+  reference (allocs/evictions never double-counted)."""
+  world = 2
+  batch0 = _batch(100)
+  stream = [_batch(700 + s) for s in range(6)]
+  # uninterrupted reference
+  _, ref = _resilient(world, batch0, str(tmp_path / "ref"), ttl=3)
+  ref_losses = ref.run(stream)
+  # interrupted: consume 3 batches, then "die" (drop the trainer) and
+  # resume a fresh one from the snapshots
+  _, t1 = _resilient(world, batch0, str(tmp_path / "run"), ttl=3)
+  first = [t1.step(*b) for b in stream[:3]]
+  assert t1.consumed == 3
+  _, t2 = _resilient(world, batch0, str(tmp_path / "run"), ttl=3)
+  assert t2.resumed_from is not None
+  resumed_at = t2.consumed  # stepping advances it — capture the resume point
+  rest = [t2.step(*b) for b in stream[resumed_at:]]
+  stitched = first[:resumed_at] + rest
+  assert stitched == ref_losses
+  # id spaces agree exactly
+  tr_ref = ref.dynvocab.translator
+  tr_res = t2.dynvocab.translator
+  for t in tr_ref.dynamic_tables:
+    a, b = tr_ref.tables[t].items(), tr_res.tables[t].items()
+    assert np.array_equal(a[0], b[0]) and np.array_equal(a[1], b[1])
+    assert np.array_equal(tr_ref.totals[t], tr_res.totals[t]), \
+        "cumulative lifecycle counters must survive the restart exactly"
+    assert tr_ref.recyclers[t].freelist == tr_res.recyclers[t].freelist
+  for name in ref.state["fused"]:
+    assert np.array_equal(np.asarray(ref.state["fused"][name]),
+                          np.asarray(t2.state["fused"][name])), name
+
+
+def test_resilient_dynvocab_validation(tmp_path):
+  from distributed_embeddings_tpu.resilience import ResilientTrainer
+  world = 2
+  batch0 = _batch(100)
+  plan = _plan(world, oov="allocate")
+  _, mesh, dvt_unguarded = _fresh(world, plan, batch0, guard=False)
+  with pytest.raises(ValueError, match="guard=True"):
+    ResilientTrainer(None, None, plan, RULE, str(tmp_path / "a"),
+                     mesh=mesh, dynvocab=dvt_unguarded)
+  plan2 = _plan(world, oov="allocate")
+  _, mesh2, dvt = _fresh(world, plan2, batch0, guard=True)
+  with pytest.raises(NotImplementedError, match="async"):
+    ResilientTrainer(None, None, plan2, RULE, str(tmp_path / "b"),
+                     mesh=mesh2, dynvocab=dvt, async_snapshots=True)

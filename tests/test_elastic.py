@@ -372,6 +372,77 @@ def test_tiered_elastic_restore_4_to_2(tmp_path):
              for v in tr2.metrics_summary()["per_class"].values())
 
 
+def _logical_counts(plan, tplan, store):
+  """Table-space view of the per-group observed counts: per logical
+  table row, the count of the GROUP (physical row) holding it."""
+  out = {}
+  for key, c in tplan.classes.items():
+    cp = plan.classes[key]
+    rpp = c.layout_logical.rows_per_phys
+    for rank in store.owned_ranks:
+      cnt = store.counts[c.name][rank]
+      for sh, off in zip(cp.shards_per_rank[rank],
+                         cp.row_offsets_per_rank[rank]):
+        cfg = plan.global_configs[sh.table_id]
+        dst = out.setdefault(sh.table_id,
+                             np.zeros((cfg.input_dim,), np.int64))
+        rows = np.arange(sh.input_dim)
+        win = dst[sh.row_start:sh.row_start + sh.input_dim]
+        np.maximum(win, cnt[(off + rows) // rpp], out=win)
+  return out
+
+
+def test_elastic_reshard_remaps_observed_counts(tmp_path):
+  """ROADMAP carried item: host-tier observed counts route WINDOW-WISE
+  through the elastic re-shard (they used to re-derive from zero,
+  costing one re-rank interval of hot-set warmup after every resize).
+  Pins: counts are nonzero after the re-shard, the hottest rows'
+  counts survive exactly, the warm-start resident set already contains
+  the top-counted groups, and continued training serves with no
+  misses."""
+  mesh4, mesh2 = create_mesh(4), create_mesh(2)
+  plan4, model4, tplan4, store4, b0, state4 = tiered_fresh(4, mesh4)
+  tr4 = TieredTrainer(model4, tplan4, store4, bce_loss, optax.adam(1e-3),
+                      RULE, mesh4, shard_params(state4, mesh4), b0,
+                      donate=False)
+  tr4.run([tiered_batch(100 + i) for i in range(4)])
+  tr4.flush()
+  path = os.path.join(tmp_path, "ck_counts")
+  checkpoint.save(path, plan4, RULE, tr4.state, store=store4)
+  want = _logical_counts(plan4, tplan4, store4)
+  assert sum(int(v.sum()) for v in want.values()) > 0
+
+  plan2, model2, tplan2, store2, _, s2_like = tiered_fresh(2, mesh2,
+                                                           seed=9)
+  s2 = checkpoint.restore(path, plan2, RULE, s2_like, mesh=mesh2,
+                          store=store2)
+  got = _logical_counts(plan2, tplan2, store2)
+  for t in want:
+    assert int(got[t].sum()) > 0, \
+        f"table {t}: counts re-derived from zero (old behavior)"
+    # the re-map max-pools each new group over its logical rows' old
+    # group counts: per row it can only round UP to its new group's
+    # peak, never lose signal — and each table's peak survives exactly
+    assert int(got[t].max()) == int(want[t].max())
+    assert np.all(got[t] >= want[t])
+  # warm start ranked by the re-mapped counts: every rank's hottest
+  # group is already resident (no re-rank interval of warmup)
+  for key, c in tplan2.classes.items():
+    for rank in store2.owned_ranks:
+      cnt = store2.counts[c.name][rank]
+      if cnt.max() == 0:
+        continue
+      hottest = int(np.argmax(cnt))
+      assert hottest in store2.resident_grps[c.name][rank]
+  tr2 = TieredTrainer(model2, tplan2, store2, bce_loss, optax.adam(1e-3),
+                      RULE, mesh2, shard_params(s2, mesh2), b0,
+                      donate=False)
+  losses = tr2.run([tiered_batch(200 + i) for i in range(2)])
+  assert all(np.isfinite(l) for l in losses)
+  assert all(v["missed"] == 0
+             for v in tr2.metrics_summary()["per_class"].values())
+
+
 # ---------------------------------------------------------------------------
 # rank-owner-sharded cold stores + multi-controller save protocol
 # ---------------------------------------------------------------------------

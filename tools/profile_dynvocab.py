@@ -1,0 +1,226 @@
+"""Dynamic-vocabulary churn bench: admission vs admit-everything.
+
+The workload is the production shape the dynvocab subsystem exists for —
+**power-law ids with a drifting tail**: a stable hot head (the same raw
+ids every step, power-law-weighted) plus a tail whose raw ids shift
+every step, so tail ids are overwhelmingly one-shot. Two identical
+training runs consume the SAME stream through
+``dynvocab.DynVocabTrainer``:
+
+- **admit-everything** (``admit_threshold=1``): every first-seen id
+  earns a row immediately — the static-vocab reflex, which burns a row
+  (table + interleaved optimizer lanes) per one-shot tail id;
+- **admission** (``admit_threshold=K``): an id must be observed K times
+  (count-min-sketch estimate) before allocating — one-shot tail ids
+  never earn a row and emit a zero embedding instead.
+
+Both runs evict on the same TTL (recycling through the freelist, rows
+re-zeroed in place), so the comparison is pure admission policy.
+
+Acceptance (docs/BENCHMARKS.md round 9): admission cuts row allocations
+to <= 50% of admit-everything's **at equal final eval loss** (evaluated
+on the hot head through each run's own translator, read-only, within an
+fp-associativity-scale tolerance — the tail ids the policies treat
+differently are one-shot either way, so they carry no learning).
+
+``--smoke`` runs the tiny-world tier wired into ``make verify`` (same
+assertions, smaller stream); the full run records the round-9 budget.
+
+Usage: PYTHONPATH=/root/repo python tools/profile_dynvocab.py [--smoke]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+  os.environ["XLA_FLAGS"] = (
+      flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from distributed_embeddings_tpu.dynvocab import (  # noqa: E402
+    DynVocabTrainer,
+    DynVocabTranslator,
+)
+from distributed_embeddings_tpu.layers.embedding import TableConfig  # noqa: E402
+from distributed_embeddings_tpu.layers.planner import (  # noqa: E402
+    DistEmbeddingStrategy,
+)
+from distributed_embeddings_tpu.models import DLRM, bce_loss  # noqa: E402
+from distributed_embeddings_tpu.models.dlrm import (  # noqa: E402
+    _dlrm_initializer,
+)
+from distributed_embeddings_tpu.ops.packed_table import (  # noqa: E402
+    sparse_rule,
+)
+from distributed_embeddings_tpu.parallel import create_mesh  # noqa: E402
+from distributed_embeddings_tpu.training import (  # noqa: E402
+    init_sparse_state_direct,
+    make_sparse_eval_step,
+    shard_batch,
+    shard_params,
+)
+
+WORLD = 4
+WIDTH = 16
+NUM_DENSE = 13
+
+
+def churn_cats(rng, step, batch, vocab_sizes, hot, drift_base, alpha):
+  """One step's raw-id inputs: power-law ranks; ranks below ``hot`` are
+  the STABLE head (same raw id every step), ranks above it map to raw
+  ids offset by the step index — the drifting tail, one-shot by
+  construction."""
+  del alpha  # the log-uniform rank draw below fixes the skew shape
+  cats = []
+  for ti, _v in enumerate(vocab_sizes):
+    # log-uniform ranks over [1, drift_base] — a heavy head (rank 0 is
+    # the single most likely id) with a long thin tail, the power-law
+    # shape without scipy
+    u = rng.random(batch)
+    ranks = np.floor(np.exp(u * np.log(drift_base))).astype(np.int64) - 1
+    ranks = np.clip(ranks, 0, drift_base - 1)
+    head = ranks < hot
+    raw = np.where(head, ranks,
+                   np.int64(10 ** 9) + np.int64(ti) * np.int64(10 ** 8)
+                   + np.int64(step) * np.int64(drift_base) + ranks)
+    cats.append(raw.astype(np.int64))
+  return cats
+
+
+def build_run(vocab_sizes, admit_threshold, evict_ttl, batch, seed):
+  tables = [TableConfig(input_dim=v, output_dim=WIDTH,
+                        initializer=_dlrm_initializer(v))
+            for v in vocab_sizes]
+  plan = DistEmbeddingStrategy(tables, WORLD, "memory_balanced",
+                               dense_row_threshold=0, oov="allocate",
+                               admit_threshold=admit_threshold,
+                               evict_ttl=evict_ttl)
+  model = DLRM(vocab_sizes=list(vocab_sizes), embedding_dim=WIDTH,
+               bottom_mlp=(32, WIDTH), top_mlp=(32, 1), world_size=WORLD,
+               strategy="memory_balanced", dense_row_threshold=0)
+  mesh = create_mesh(WORLD)
+  rule = sparse_rule("adagrad", 0.05)
+  opt = optax.adam(1e-3)
+  r = np.random.default_rng(seed)
+  num = r.standard_normal((batch, NUM_DENSE)).astype(np.float32)
+  cats0 = [r.integers(0, v, batch, dtype=np.int64) for v in vocab_sizes]
+  labels0 = r.integers(0, 2, batch).astype(np.float32)
+  batch0 = (num, cats0, labels0)
+  dummy = [np.zeros((2, WIDTH), np.float32) for _ in vocab_sizes]
+  dense = model.init(jax.random.PRNGKey(0), num[:2],
+                     [c[:2] for c in cats0], emb_acts=dummy)["params"]
+  state = shard_params(
+      init_sparse_state_direct(plan, rule, dense, opt,
+                               jax.random.PRNGKey(1)), mesh)
+  translator = DynVocabTranslator(plan, rule)
+  trainer = DynVocabTrainer(model, plan, translator, bce_loss, opt, rule,
+                            mesh, state, batch0, guard=True, donate=False)
+  return plan, model, mesh, rule, trainer
+
+
+def eval_loss(plan_args, model, mesh, rule, trainer, eval_batch):
+  """Final eval loss on the hot head, ids translated READ-ONLY through
+  the run's own translator, scored by the static eval step (built on an
+  oov='clip' plan of the same tables — the knob changes no layout, so
+  the trained state evaluates directly)."""
+  vocab_sizes, = plan_args
+  tables = [TableConfig(input_dim=v, output_dim=WIDTH,
+                        initializer=_dlrm_initializer(v))
+            for v in vocab_sizes]
+  plan_eval = DistEmbeddingStrategy(tables, WORLD, "memory_balanced",
+                                    dense_row_threshold=0)
+  num, cats, labels = eval_batch
+  cats_t = trainer.translator.translate_readonly(cats)
+  ev = make_sparse_eval_step(model, plan_eval, rule, mesh, trainer.state,
+                             (num, cats_t))
+  sb = shard_batch((num, [np.asarray(c, np.int32) for c in cats_t]),
+                   mesh)
+  preds = ev(trainer.state, *sb)
+  return float(np.asarray(bce_loss(preds, np.asarray(labels))))
+
+
+def totals_of(trainer):
+  per = trainer.metrics_summary()["per_class"]
+  return {
+      "allocs": sum(v["allocs"] for v in per.values()),
+      "evictions": sum(v["evictions"] for v in per.values()),
+      "admit_denied": sum(v["admit_denied"] for v in per.values()),
+      "occupancy": sum(v["occupancy"] for v in per.values()),
+  }
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument("--smoke", action="store_true",
+                  help="tiny tier for make verify")
+  ap.add_argument("--threshold", type=int, default=3,
+                  help="admission threshold of the admission run")
+  args = ap.parse_args()
+
+  if args.smoke:
+    vocab_sizes, batch, steps, hot, drift_base = [2000, 500], 128, 20, 120, 1500
+    ttl = 12
+  else:
+    vocab_sizes, batch, steps, hot, drift_base = [20000, 4000], 256, 120, 600, 8000
+    ttl = 30
+  alpha = 1.05
+
+  def stream(step):
+    r = np.random.default_rng(1000 + step)
+    num = r.standard_normal((batch, NUM_DENSE)).astype(np.float32)
+    cats = churn_cats(r, step, batch, vocab_sizes, hot, drift_base, alpha)
+    labels = r.integers(0, 2, batch).astype(np.float32)
+    return num, cats, labels
+
+  runs = {}
+  for label, thr in (("admit_everything", 1), ("admission", args.threshold)):
+    t0 = time.monotonic()
+    _, model, mesh, rule, trainer = build_run(vocab_sizes, thr, ttl,
+                                              batch, seed=7)
+    for s in range(steps):
+      trainer.step(*stream(s))
+    # hot-head eval batch: raw ids every run admitted long ago
+    r = np.random.default_rng(99)
+    eval_cats = [r.integers(0, hot, batch).astype(np.int64)
+                 for _ in vocab_sizes]
+    eb = (r.standard_normal((batch, NUM_DENSE)).astype(np.float32),
+          eval_cats, r.integers(0, 2, batch).astype(np.float32))
+    loss = eval_loss((vocab_sizes,), model, mesh, rule, trainer, eb)
+    runs[label] = {**totals_of(trainer), "eval_loss": loss,
+                   "wall_s": round(time.monotonic() - t0, 2)}
+
+  a, b = runs["admit_everything"], runs["admission"]
+  ratio = b["allocs"] / max(1, a["allocs"])
+  dloss = abs(a["eval_loss"] - b["eval_loss"])
+  verdict = {
+      "workload": {"vocab": vocab_sizes, "batch": batch, "steps": steps,
+                   "hot_head": hot, "drift_base": drift_base,
+                   "evict_ttl": ttl,
+                   "admit_threshold": args.threshold},
+      "runs": runs,
+      "alloc_ratio": round(ratio, 4),
+      "eval_loss_delta": round(dloss, 5),
+      "accept_alloc_halved": ratio <= 0.5,
+      "accept_equal_loss": dloss <= 0.05,
+  }
+  ok = verdict["accept_alloc_halved"] and verdict["accept_equal_loss"]
+  verdict["ok"] = ok
+  print(json.dumps(verdict, indent=1))
+  return 0 if ok else 1
+
+
+if __name__ == "__main__":
+  sys.exit(main())
