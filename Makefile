@@ -6,7 +6,8 @@ PY ?= python
 SHELL := /bin/bash  # verify uses pipefail/PIPESTATUS
 
 .PHONY: test test-fast verify lint native bench dryrun chaos chaos-kill \
-	serve-bench serve-smoke vocab-bench vocab-smoke clean
+	serve-bench serve-smoke vocab-bench vocab-smoke obs-bench obs-smoke \
+	clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -46,10 +47,26 @@ vocab-smoke:
 	PYTHONPATH=$(CURDIR):$$PYTHONPATH timeout -k 10 300 \
 	  $(PY) tools/profile_dynvocab.py --smoke
 
+# telemetry overhead bench: spans/counters on the tiered + dynvocab
+# power-law workloads must cost <= 3% of step time with tracing ENABLED,
+# the emitted trace.json must SHOW the prefetch-ahead classify
+# overlapping the device window on separate tracks, and the registry
+# must round-trip through its manifest section
+# (tools/profile_telemetry.py; budget in docs/BENCHMARKS.md r10)
+obs-bench:
+	PYTHONPATH=$(CURDIR):$$PYTHONPATH $(PY) tools/profile_telemetry.py
+
+# the make-verify tier: same structural assertions (trace produced with
+# the overlap visible, counters round-trip), overhead only required
+# finite — tiny world, timeout-guarded
+obs-smoke:
+	PYTHONPATH=$(CURDIR):$$PYTHONPATH timeout -k 10 300 \
+	  $(PY) tools/profile_telemetry.py --smoke
+
 # the tier-1 gate, exactly as ROADMAP.md specifies it (CPU mesh, no slow
 # tests, collection errors surfaced but not fatal to the log); lint runs
 # first so invariant violations fail fast, then the smoke tiers
-verify: lint serve-smoke vocab-smoke
+verify: lint serve-smoke vocab-smoke obs-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -48,6 +48,11 @@ GL112       error      dynamic-vocabulary translation state mutates only in
                        (``translate_batch`` / ``translate_dynamic_ids`` /
                        the table/sketch/recycler constructors) never
                        appears in trace-reachable step code
+GL113       error      no raw ``time.perf_counter``/``time.monotonic``
+                       timing in library modules outside ``telemetry/`` —
+                       spans (and ``telemetry.timed`` / the histogram
+                       type) are the sanctioned form, so every stage is
+                       on one trace and one metrics schema
 ==========  =========  =====================================================
 
 Trace-reachable scope (GL101/GL102) is structural: any function nested —
@@ -634,6 +639,64 @@ def _check_dynvocab_in_trace(mod: ParsedModule) -> List[Finding]:
             "host side of the step boundary "
             "(DistributedLookup.translate_dynamic_ids / "
             "DynVocabTrainer)."))
+  return out
+
+
+_RAW_TIMING_CALLS = frozenset({
+    "perf_counter", "perf_counter_ns", "monotonic", "monotonic_ns",
+})
+
+
+@_rule("GL113", "error",
+       "no raw perf_counter/monotonic timing outside telemetry/")
+def _check_raw_timing(mod: ParsedModule) -> List[Finding]:
+  # Pre-telemetry, ~30 tools and several library modules each hand-rolled
+  # perf_counter timing, so "where did step k's time go?" had no one
+  # answer. telemetry/ is the sanctioned home of raw clock reads in the
+  # LIBRARY package: a library module that wants a duration opens a
+  # span (one trace, per-thread tracks) or observes a telemetry
+  # histogram (one registry, bounded-error percentiles). Scope is the
+  # library package only — tests and tools/ drive their own harnesses
+  # (and the bench utilities consolidate on the histogram type anyway).
+  # Deadline arithmetic that is not timing (the batcher's flush clock,
+  # checkpoint barrier visibility polls) suppresses with the reason.
+  norm = mod.path.replace(os.sep, "/")
+  if "distributed_embeddings_tpu/" not in norm \
+      or "/telemetry/" in norm:
+    return []
+  # both spellings are timing: `time.monotonic()` through any alias of
+  # the module, and bare `perf_counter()` imported (possibly renamed)
+  # from it — a from-import must not be a lint bypass
+  time_aliases = {"time"}
+  from_names: Dict[str, str] = {}  # local alias -> original clock name
+  for node in ast.walk(mod.tree):
+    if isinstance(node, ast.Import):
+      for a in node.names:
+        if a.name == "time":
+          time_aliases.add(a.asname or "time")
+    elif isinstance(node, ast.ImportFrom) and node.module == "time":
+      for a in node.names:
+        if a.name in _RAW_TIMING_CALLS:
+          from_names[a.asname or a.name] = a.name
+  out = []
+  for node in ast.walk(mod.tree):
+    if not isinstance(node, ast.Call):
+      continue
+    root, name = _call_pair(node)
+    clock = None
+    if root in time_aliases and name in _RAW_TIMING_CALLS:
+      clock = name
+    elif root is None and isinstance(node.func, ast.Name) \
+        and node.func.id in from_names:
+      clock = from_names[node.func.id]
+    if clock is not None:
+      out.append(mod.finding(
+          "GL113", node,
+          f"raw time.{clock}() in a library module: timing belongs to "
+          "the telemetry layer — wrap the stage in telemetry.span(...) "
+          "(or telemetry.timed(...) for histogram aggregation) so it "
+          "lands on the shared trace and registry; suppress with the "
+          "reason stated if this is deadline arithmetic, not timing."))
   return out
 
 

@@ -429,7 +429,7 @@ def _restore_elastic(path: str, manifest: Dict[str, Any],
                      plan: DistEmbeddingStrategy, rule: SparseRule,
                      state_like: Dict[str, Any],
                      mesh: Optional[Mesh], axis_name: str,
-                     store, vocab=None) -> Dict[str, Any]:
+                     store, vocab=None, telemetry=None) -> Dict[str, Any]:
   """Load a world-N checkpoint onto a world-M plan by re-slicing rank
   blocks at LOGICAL-row granularity.
 
@@ -627,8 +627,10 @@ def _restore_elastic(path: str, manifest: Dict[str, Any],
     return out
 
   # the id space is table-id-keyed (raw id -> logical table row), so an
-  # elastic resize does not touch it: load verbatim
+  # elastic resize does not touch it: load verbatim — and the telemetry
+  # counters are world-shape-free facts about the run, same treatment
   _load_vocab(path, manifest, vocab)
+  _load_telemetry(manifest, telemetry)
 
   parts = {}
   for part in ("dense", "dense_opt", "emb_dense", "emb_dense_opt"):
@@ -751,7 +753,8 @@ def publish_manifest_last(tmp: str, path: str,
 
 def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
          state: Dict[str, Any], store=None,
-         extra: Optional[Dict[str, Any]] = None, vocab=None) -> None:
+         extra: Optional[Dict[str, Any]] = None, vocab=None,
+         telemetry=None) -> None:
   """Write the full fused train state under directory ``path``.
 
   Atomicity: everything is written into ``path + '.tmp'`` and renamed at
@@ -791,6 +794,15 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
   stream-position discipline). The translator is table-id-space (not
   per rank), so the state also restores unchanged across an elastic
   world resize.
+
+  Telemetry (``telemetry/``): pass the run's ``MetricsRegistry`` (or an
+  already-captured ``state_dict()`` — the async-snapshot path captures
+  synchronously, like the state) as ``telemetry``. Its cumulative
+  counters/gauges/histograms ride the manifest as a ``telemetry``
+  section; ``restore(..., telemetry=registry)`` — and the
+  ResilientTrainer's first resume — adopts the persisted values, so a
+  run's metrics survive restarts without double-counting (the
+  dynvocab-totals pattern, generalized to every metric surface).
   """
   engine = DistributedLookup(plan)
   tiered_names = frozenset(store.tplan.tier_specs) if store is not None \
@@ -892,6 +904,13 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
       tiering_meta = {"classes": store.tplan.geometry()}
       _write_tier_blocks(tmp, store, _seal)
 
+    telemetry_meta = None
+    if telemetry is not None:
+      # a registry is captured here (a consistent point-in-time state);
+      # an already-captured dict (async snapshots) passes through
+      telemetry_meta = telemetry.state_dict() \
+          if hasattr(telemetry, "state_dict") else dict(telemetry)
+
     vocab_meta = None
     if vocab is not None:
       # the id space is table-id-keyed global host state (like the
@@ -922,11 +941,13 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
   # successful save must not be declared incomplete for it. All processes
   # check (not just p0) so that when one process failed, every survivor
   # raises instead of hanging at the final barrier.
-  deadline = time.monotonic() + 30.0
+  # visibility-poll deadline (NFS attribute-cache lag), not timing —
+  # the save itself is spanned at the durable layer
+  deadline = time.monotonic() + 30.0  # graftlint: disable=GL113
   while True:
     done = [p for p in range(n_proc)
             if os.path.exists(os.path.join(tmp, f"DONE_p{p}"))]
-    if len(done) == n_proc or time.monotonic() >= deadline:
+    if len(done) == n_proc or time.monotonic() >= deadline:  # graftlint: disable=GL113
       break
     time.sleep(0.2)
   if len(done) != n_proc:
@@ -977,6 +998,8 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
       manifest["tiering"] = tiering_meta
     if vocab_meta is not None:
       manifest["vocab"] = vocab_meta
+    if telemetry_meta is not None:
+      manifest["telemetry"] = telemetry_meta
     publish_manifest_last(tmp, path, manifest)
 
   # The publication must reach the renamed-barrier on EVERY exception —
@@ -996,14 +1019,28 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
     # signal the other processes can observe (p0's exception is not
     # visible here). Poll briefly for shared-filesystem attribute-cache
     # lag, exactly as with the DONE markers.
-    deadline = time.monotonic() + 30.0
-    while os.path.exists(tmp) and time.monotonic() < deadline:
+    deadline = time.monotonic() + 30.0  # graftlint: disable=GL113
+    while os.path.exists(tmp) and time.monotonic() < deadline:  # graftlint: disable=GL113
       time.sleep(0.2)
     if os.path.exists(tmp):
       raise RuntimeError(
           f"checkpoint publication failed: tmp dir {tmp!r} still present "
           "after the rename barrier — process 0 raised mid-publication "
           "(its exception has the root cause)")
+
+
+def _load_telemetry(manifest: Dict[str, Any], telemetry) -> None:
+  """Adopt a checkpoint's persisted ``telemetry`` section into a
+  registry (REPLACING the named metrics' values — resume must continue
+  the run's counts, not add to whatever this process observed so far).
+  Asymmetric with the vocab section on purpose: a checkpoint without
+  telemetry, or a restore without a registry, is simply a no-op —
+  metrics are observability, not state the training depends on."""
+  if telemetry is None:
+    return
+  section = manifest.get("telemetry")
+  if section is not None:
+    telemetry.load_state_dict(section)
 
 
 def _load_vocab(path: str, manifest: Dict[str, Any], vocab) -> None:
@@ -1036,7 +1073,8 @@ def restore(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
             state_like: Dict[str, Any],
             mesh: Optional[Mesh] = None,
             axis_name: str = "mp", store=None,
-            verify_integrity: bool = True, vocab=None) -> Dict[str, Any]:
+            verify_integrity: bool = True, vocab=None,
+            telemetry=None) -> Dict[str, Any]:
   """Load a checkpoint written by :func:`save` into a new state dict.
 
   Args:
@@ -1148,7 +1186,7 @@ def restore(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
     reason = _elastic_reason(manifest, want, plan)
     if reason is None:
       return _restore_elastic(path, manifest, plan, rule, state_like,
-                              mesh, axis_name, store, vocab)
+                              mesh, axis_name, store, vocab, telemetry)
     diff_keys = sorted(k for k in set(manifest["plan"]) | set(want)
                        if manifest["plan"].get(k) != want.get(k))
     detail = "; ".join(
@@ -1226,6 +1264,7 @@ def restore(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
       fused[name] = jax.make_array_from_callback(shape, sharding, cb)
 
   _load_vocab(path, manifest, vocab)
+  _load_telemetry(manifest, telemetry)
 
   parts = {}
   for part in ("dense", "dense_opt", "emb_dense", "emb_dense_opt"):

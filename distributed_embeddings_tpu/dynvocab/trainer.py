@@ -42,6 +42,7 @@ from ..parallel.lookup_engine import (
     DistributedLookup,
     class_param_name,
 )
+from ..telemetry import get_registry as _registry, span as _span
 from .admission import CountMinSketch
 from .lifecycle import RowRecycler, apply_zero_work, merge_zero_work, \
     zero_targets
@@ -352,7 +353,7 @@ class DynVocabTrainer:
                mesh, state: Dict[str, Any], batch_example: Any,
                axis_name: str = "mp", emb_dense_optimizer=None,
                micro_batches: int = 1, guard: bool = False,
-               donate: bool = True):
+               donate: bool = True, telemetry=None):
     from ..training import make_sparse_train_step
     if getattr(plan, "oov", "clip") != "allocate":
       raise ValueError(
@@ -369,6 +370,8 @@ class DynVocabTrainer:
     self.axis_name = axis_name
     self.state = state
     self.guard = guard
+    # lifecycle counters/gauges emit here (default: process registry)
+    self.telemetry = telemetry if telemetry is not None else _registry()
     self.engine = DistributedLookup(plan, dp_input=True,
                                     axis_name=axis_name)
     self.layouts = self.engine.fused_layouts(rule)
@@ -388,10 +391,15 @@ class DynVocabTrainer:
   def account_vocab(self, vocab: Dict[str, np.ndarray]) -> None:
     """Accumulate one step's per-class lifecycle counters (allocs /
     evictions / denied sum; occupancy is the latest value)."""
+    reg = self.telemetry
     for name, vec in vocab.items():
       tot = self.vocab_totals.setdefault(name, np.zeros((4,), np.int64))
       tot[:3] += vec[:3]
       tot[3] = vec[3]
+      reg.counter(f"vocab/allocs/{name}").inc(int(vec[0]))
+      reg.counter(f"vocab/evictions/{name}").inc(int(vec[1]))
+      reg.counter(f"vocab/admit_denied/{name}").inc(int(vec[2]))
+      reg.gauge(f"vocab/occupancy/{name}").set(int(vec[3]))
 
   def _account(self, metrics) -> None:
     if self.guard:
@@ -428,27 +436,33 @@ class DynVocabTrainer:
 
   # ---- stepping ----------------------------------------------------------
   def _translate(self, cats):
-    cats_t, vocab_metrics, zero = self.engine.translate_dynamic_ids(
-        cats, self.translator)
-    self.state["fused"], zeroed = apply_zero_work(
-        self.layouts, self.state["fused"], zero)
-    self.rows_zeroed += zeroed
-    return cats_t, vocab_metrics
+    with _span("dynvocab/translate"):
+      cats_t, vocab_metrics, zero = self.engine.translate_dynamic_ids(
+          cats, self.translator)
+      self.state["fused"], zeroed = apply_zero_work(
+          self.layouts, self.state["fused"], zero)
+      self.rows_zeroed += zeroed
+      return cats_t, vocab_metrics
 
   def step(self, numerical, cats, labels) -> float:
     """One train step on a GLOBAL host batch of RAW ids."""
     from ..training import shard_batch
     cats_t, vocab_metrics = self._translate(cats)
+    dev = _span("device/step", track="device").start()
     batch = shard_batch((numerical, list(cats_t), labels), self.mesh,
                         self.axis_name)
     if self.guard:
       self.state, loss, metrics = self._step_fn(self.state, *batch)
+      loss = float(np.asarray(loss))  # the host sync ending the window
+      dev.finish()
       self._account(metrics)
     else:
       self.state, loss = self._step_fn(self.state, *batch)
+      loss = float(np.asarray(loss))
+      dev.finish()
       self.steps += 1
     self.account_vocab(vocab_metrics)
-    return float(np.asarray(loss))
+    return loss
 
   def run(self, batches: Iterable) -> list:
     """Train over host batches of ``(numerical, cats, labels)``."""

@@ -89,7 +89,7 @@ def save_rotating(root: str, plan, rule, state: Dict[str, Any],
                   store=None, keep: int = 3,
                   policy: retry.RetryPolicy = retry.DEFAULT_POLICY,
                   extra: Optional[Dict[str, Any]] = None,
-                  vocab=None) -> str:
+                  vocab=None, telemetry=None) -> str:
   """Durably save ``state`` as ``<root>/ckpt_<step>`` and rotate.
 
   The step is read from ``state['step']`` so the directory name always
@@ -106,16 +106,20 @@ def save_rotating(root: str, plan, rule, state: Dict[str, Any],
   import jax
   import numpy as np
   from .. import checkpoint
+  from ..telemetry import counter as _counter, span as _span
 
   step = int(np.asarray(jax.device_get(state["step"])))
   path = step_dir(root, step)
   os.makedirs(root, exist_ok=True)
-  if jax.process_count() > 1:
-    checkpoint.save(path, plan, rule, state, store=store, extra=extra,
-                    vocab=vocab)
-  else:
-    retry.retry_call(checkpoint.save, path, plan, rule, state, store=store,
-                     extra=extra, vocab=vocab, policy=policy)
+  with _span("ckpt/save", args={"step": step}):
+    if jax.process_count() > 1:
+      checkpoint.save(path, plan, rule, state, store=store, extra=extra,
+                      vocab=vocab, telemetry=telemetry)
+    else:
+      retry.retry_call(checkpoint.save, path, plan, rule, state,
+                       store=store, extra=extra, vocab=vocab,
+                       telemetry=telemetry, policy=policy)
+  _counter("ckpt/saves").inc()
   prune(root, keep)
   return path
 
@@ -163,7 +167,10 @@ def restore_latest(root: str, plan, rule, state_like: Dict[str, Any],
     if got is None:
       return None
     step, path = got
-  state = checkpoint.restore(path, plan, rule, state_like, mesh=mesh,
-                             axis_name=axis_name, store=store, vocab=vocab,
-                             verify_integrity=False)
+  from ..telemetry import counter as _counter, span as _span
+  with _span("ckpt/restore", args={"step": step}):
+    state = checkpoint.restore(path, plan, rule, state_like, mesh=mesh,
+                               axis_name=axis_name, store=store,
+                               vocab=vocab, verify_integrity=False)
+  _counter("ckpt/restores").inc()
   return state, step, path

@@ -53,6 +53,8 @@ def retry_call(fn: Callable, *args,
   ``on_retry(attempt, exc)`` is invoked before each sleep (metrics /
   logging hook); ``sleep`` is injectable so tests don't wait wall-clock.
   """
+  from ..telemetry import counter as _counter
+
   attempt = 0
   while True:
     try:
@@ -60,6 +62,9 @@ def retry_call(fn: Callable, *args,
     except policy.retry_on as e:
       if attempt >= policy.retries:
         raise _exhausted(e, attempt + 1) from e
+      # every retried attempt is observable process-wide (next to each
+      # caller's own on_retry accounting, e.g. the prefetcher's)
+      _counter("retry/attempts").inc()
       if on_retry is not None:
         on_retry(attempt, e)
       sleep(policy.sleep_for(attempt))

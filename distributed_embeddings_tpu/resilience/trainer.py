@@ -33,6 +33,8 @@ from typing import Any, Dict, Iterable, List, Optional
 import jax
 import numpy as np
 
+from .. import telemetry as _telemetry
+from ..telemetry import span as _span
 from . import durable, guards, retry
 
 
@@ -99,6 +101,12 @@ class ResilientTrainer:
       DynVocabTrainer (pass ``None``); batches are HOST batches of raw
       ids. Mutually exclusive with ``tiered`` (the two host passes do
       not compose yet).
+    telemetry: the ``telemetry.MetricsRegistry`` this trainer emits
+      through (default: the process-wide registry). Snapshots persist
+      its cumulative state under the checkpoint manifest's
+      ``telemetry`` section and the first resume of a fresh process
+      adopts it — counters survive restarts without double-counting,
+      exactly like the skip/OOV/stream-position accounting.
   """
 
   def __init__(self, step_fn, state: Dict[str, Any], plan, rule,
@@ -109,7 +117,26 @@ class ResilientTrainer:
                resume: bool = True, store=None,
                retry_policy: retry.RetryPolicy = retry.DEFAULT_POLICY,
                async_snapshots: bool = False,
-               tiered=None, dynvocab=None):
+               tiered=None, dynvocab=None, telemetry=None):
+    # The metrics registry this trainer emits through (and persists:
+    # snapshots write its state into the checkpoint manifest's
+    # ``telemetry`` section, and the FIRST resume of a fresh process
+    # adopts the persisted values — the same never-double-count
+    # discipline as the skip/OOV counters below; a mid-run rollback
+    # keeps the observed counts). Defaults to the process-wide registry;
+    # pass a private MetricsRegistry for isolated accounting (tests).
+    # A wrapped tiered/dynvocab trainer (and its prefetcher) is
+    # RE-POINTED at this registry below, so the whole protocol's
+    # counters persist together; only the module-level process counters
+    # (``ckpt/saves|restores``, ``retry/attempts``) stay process-wide
+    # by design — they have no trainer to belong to.
+    self.telemetry = telemetry if telemetry is not None \
+        else _telemetry.get_registry()
+    if tiered is not None:
+      tiered.telemetry = self.telemetry
+      tiered.prefetcher.telemetry = self.telemetry
+    if dynvocab is not None:
+      dynvocab.telemetry = self.telemetry
     self.dynvocab = dynvocab
     if dynvocab is not None:
       # dynvocab mode (the dynamic-vocabulary ROADMAP direction): this
@@ -254,6 +281,17 @@ class ResilientTrainer:
     from .. import checkpoint
     first_resume = self.consumed == 0
     self.state, step, path = got
+    manifest = checkpoint.read_manifest(path)
+    if first_resume:
+      # adopt the persisted cumulative telemetry (counters/histograms)
+      # along with the stream position — a fresh process resuming a run
+      # continues its counts instead of restarting them at zero, and a
+      # run's counters are never double-counted across restarts. A
+      # mid-run rollback keeps the observed values (those events
+      # happened), exactly like the skip/OOV adoption below.
+      sec = manifest.get("telemetry")
+      if sec is not None:
+        self.telemetry.load_state_dict(sec)
     if self.tiered is not None:
       # the restore rewrote the store's host images and resident sets
       # alongside the state: re-point the TieredTrainer at the restored
@@ -268,7 +306,7 @@ class ResilientTrainer:
       self.dynvocab.state = self.state
     self.resumed_from = path
     self._last_snapshot = step
-    extra = checkpoint.read_manifest(path).get("extra", {})
+    extra = manifest.get("extra", {})
     # checkpoints written outside this trainer carry no consumed count;
     # step is then the best (and with no skips, exact) stream position
     self.consumed = int(extra.get("consumed", step))
@@ -305,6 +343,7 @@ class ResilientTrainer:
     images are live mutable host state a background save would tear
     (both limits raise below)."""
     self.join_writer()
+    self.telemetry.counter("ckpt/snapshots").inc()
     extra = {"consumed": self.consumed,
              "skipped": self.skipped_steps,
              "oov": dict(self.oov_totals)}
@@ -314,7 +353,8 @@ class ResilientTrainer:
       path = durable.save_rotating(self.ckpt_root, self.plan, self.rule,
                                    self.state, store=self.store,
                                    keep=self.keep, policy=self.retry_policy,
-                                   extra=extra, vocab=self.vocab)
+                                   extra=extra, vocab=self.vocab,
+                                   telemetry=self.telemetry)
       self._last_snapshot = self.step_count
       return path
     if jax.process_count() > 1:
@@ -341,12 +381,16 @@ class ResilientTrainer:
           "device-side copy to hand a writer thread).")
     state_host = jax.device_get(self.state)
     step_now = int(np.asarray(state_host["step"]))
+    # capture the registry synchronously, like the state: later steps
+    # mutate the live counters while the writer flushes
+    telemetry_state = self.telemetry.state_dict()
 
     def _write():
       try:
         durable.save_rotating(self.ckpt_root, self.plan, self.rule,
                               state_host, store=self.store, keep=self.keep,
-                              policy=self.retry_policy, extra=extra)
+                              policy=self.retry_policy, extra=extra,
+                              telemetry=telemetry_state)
       except BaseException as e:  # surfaced at the next join_writer
         self._writer_err = e
 
@@ -364,10 +408,13 @@ class ResilientTrainer:
     # and snapshots would otherwise persist a stream position whose
     # rejected batch appears in no counter, breaking
     # consumed == step_count + skipped_steps across the resume.
+    reg = self.telemetry
     counts = {name: int(np.asarray(jax.device_get(v)))
               for name, v in metrics["oov"].items()}
     for name, n in counts.items():
       self.oov_totals[name] = self.oov_totals.get(name, 0) + n
+      if n:
+        reg.counter(f"train/oov/{name}").inc(n)
     # dedup_capacity overflow: the counter is the whole point of the
     # knob being legal (aliased ids must be observable), so it gets the
     # same treatment as oov — accumulated, summarized, persisted
@@ -376,6 +423,10 @@ class ResilientTrainer:
       if n:
         self.dedup_overflow_totals[name] = \
             self.dedup_overflow_totals.get(name, 0) + n
+        reg.counter(f"train/dedup_overflow/{name}").inc(n)
+    bad = int(np.asarray(jax.device_get(metrics["bad_step"])))
+    if bad:
+      reg.counter("train/bad_step").inc(bad)
     may_continue = self._bad.update(metrics["bad_step"])
     guards.check_oov(self.plan, counts, where="guarded step")
     if not may_continue:
@@ -411,14 +462,17 @@ class ResilientTrainer:
       return self._step_tiered(*batch)
     if self.dynvocab is not None:
       return self._step_dynvocab(*batch)
+    dev = _span("device/step", track="device").start()
     self.state, loss, metrics = self._step_fn(self.state, *batch)
     self.consumed += 1
+    self.telemetry.counter("train/consumed").inc()
     # ONE host transfer for everything the accounting reads. Fetching
     # the loss, bad_step, each per-class OOV counter, and the step
     # counter separately would cost a blocking device round-trip apiece
     # — dozens per step on wide models, serializing dispatch.
     loss, metrics, stepped = jax.device_get(
         (loss, metrics, self.state["step"]))
+    dev.finish()  # dispatch -> fetched: the device window
     self._account(metrics)
     loss = float(np.asarray(loss))
     if self.snapshot_every and \
@@ -444,8 +498,14 @@ class ResilientTrainer:
     staged_out, metrics, loss = t._dispatch(staged, numerical, cats,
                                             labels)
     self.consumed += 1
+    self.telemetry.counter("train/consumed").inc()
     loss, metrics, stepped = jax.device_get(
         (loss, metrics, t.state["step"]))
+    # THIS fetch is the first host sync of the resilient-tiered step —
+    # close the device window here (finish is idempotent, so _finish's
+    # own post-write-back finish becomes a no-op) or the rendered
+    # window would overstate device time by the write-back
+    t._dev_span.finish()
 
     def account(m):
       # tier bookkeeping (hits + missed>0 contract) stays with the
@@ -486,12 +546,15 @@ class ResilientTrainer:
     d = self.dynvocab
     d.state = self.state
     cats_t, vocab_metrics = d._translate(cats)
+    dev = _span("device/step", track="device").start()
     batch = shard_batch((numerical, list(cats_t), labels), self.mesh,
                         self.axis_name)
     d.state, loss, metrics = d._step_fn(d.state, *batch)
     self.consumed += 1
+    self.telemetry.counter("train/consumed").inc()
     loss, metrics, stepped = jax.device_get(
         (loss, metrics, d.state["step"]))
+    dev.finish()
     d.account_vocab(vocab_metrics)
     d.steps += 1
     self.state = d.state

@@ -29,6 +29,12 @@ De-interleave is positional: request k's predictions are exactly rows
 ``[off_k, off_k + n_k)`` of the dispatch result — the property test
 pins that every request gets its own rows back under random arrival
 interleavings.
+
+Telemetry: the counters live in a ``telemetry.MetricsRegistry``
+(``stats`` is the classic dict view), per-request latency feeds the
+``serve/latency_s`` histogram, and the pack/dispatch/complete stages
+run under spans — on the flusher/completer threads, so an enabled trace
+shows host packing overlapping device compute on separate tracks.
 """
 
 from __future__ import annotations
@@ -41,6 +47,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from ..parallel.lookup_engine import PAD_ID
+from ..telemetry import MetricsRegistry, span as _span
 
 
 class Rejected(RuntimeError):
@@ -54,19 +61,21 @@ class ServeFuture:
 
   def __init__(self, n: int):
     self.n = n
-    self.t_submit = time.monotonic()
+    # latency stamps, not stage timing: the delta feeds the telemetry
+    # histogram; the flush deadline below needs the same clock
+    self.t_submit = time.monotonic()  # graftlint: disable=GL113
     self.t_done: Optional[float] = None
     self._event = threading.Event()
     self._value: Optional[np.ndarray] = None
     self._error: Optional[BaseException] = None
 
   def _fulfill(self, value: np.ndarray) -> None:
-    self.t_done = time.monotonic()
+    self.t_done = time.monotonic()  # graftlint: disable=GL113 (latency stamp)
     self._value = value
     self._event.set()
 
   def _fail(self, exc: BaseException) -> None:
-    self.t_done = time.monotonic()
+    self.t_done = time.monotonic()  # graftlint: disable=GL113 (latency stamp)
     self._error = exc
     self._event.set()
 
@@ -112,13 +121,21 @@ class MicroBatcher:
     pipeline_depth: max dispatches in flight (completer queue bound).
     start: start the flusher/completer threads (tests drive
       :meth:`flush_now` deterministically with ``start=False``).
+    registry: the ``telemetry.MetricsRegistry`` the batcher's counters
+      (``serve/submitted|rejected|batches|completed|padded_rows``) and
+      request-latency histogram (``serve/latency_s``) live in. Default
+      is a PRIVATE registry: the load-shed accounting contract is
+      exactly-counted per batcher, and two batchers sharing names would
+      merge counts. Pass ``telemetry.get_registry()`` to publish into
+      the process-wide registry. ``stats`` stays the classic dict view.
   """
 
   def __init__(self, dispatch_fn: Callable, max_batch: int,
                max_delay_s: float = 0.002,
                queue_rows: Optional[int] = None,
                pipeline_depth: int = 2,
-               start: bool = True):
+               start: bool = True,
+               registry: Optional[MetricsRegistry] = None):
     if max_batch < 1:
       raise ValueError(f"max_batch must be >= 1, got {max_batch}")
     self.dispatch_fn = dispatch_fn
@@ -131,10 +148,11 @@ class MicroBatcher:
     self._pending: List[_Pending] = []
     self._pending_rows = 0
     self._closed = False
-    self.stats: Dict[str, int] = {
-        "submitted": 0, "rejected": 0, "batches": 0, "completed": 0,
-        "padded_rows": 0,
-    }
+    self.telemetry = registry if registry is not None else MetricsRegistry()
+    self._counters = {k: self.telemetry.counter(f"serve/{k}")
+                      for k in ("submitted", "rejected", "batches",
+                                "completed", "padded_rows")}
+    self._latency = self.telemetry.histogram("serve/latency_s")
     self._inflight: _queue.Queue = _queue.Queue(maxsize=max(1,
                                                            pipeline_depth))
     self._flusher: Optional[threading.Thread] = None
@@ -148,6 +166,11 @@ class MicroBatcher:
                                          daemon=True)
       self._flusher.start()
       self._completer.start()
+
+  @property
+  def stats(self) -> Dict[str, int]:
+    """The classic counter view (now registry-backed)."""
+    return {k: c.value for k, c in self._counters.items()}
 
   # ---- submission ---------------------------------------------------------
   def submit(self, numerical, cats: Sequence) -> ServeFuture:
@@ -165,9 +188,9 @@ class MicroBatcher:
     with self._nonempty:
       if self._closed:
         raise RuntimeError("MicroBatcher is closed")
-      self.stats["submitted"] += 1
+      self._counters["submitted"].inc()
       if self._pending_rows + n > self.queue_rows:
-        self.stats["rejected"] += 1
+        self._counters["rejected"].inc()
         raise Rejected(
             f"serve queue full ({self._pending_rows} rows pending, bound "
             f"{self.queue_rows}): request shed. The device is saturated "
@@ -197,15 +220,17 @@ class MicroBatcher:
         or self._pending[0].future.n == self.max_batch:
       return True
     oldest = self._pending[0].future.t_submit
-    return (time.monotonic() - oldest) >= self.max_delay_s
+    # flush-deadline arithmetic against the submit stamps, not timing
+    return (time.monotonic() - oldest) >= self.max_delay_s  # graftlint: disable=GL113
 
   def _flush_loop(self) -> None:
     while True:
       with self._nonempty:
         while not self._flush_ready_locked() and not self._closed:
           if self._pending:
-            wait = self.max_delay_s - (
-                time.monotonic() - self._pending[0].future.t_submit)
+            wait = self.max_delay_s - (  # deadline, not timing
+                time.monotonic()  # graftlint: disable=GL113
+                - self._pending[0].future.t_submit)
             self._nonempty.wait(timeout=max(wait, 0.0) + 1e-4)
           else:
             self._nonempty.wait(timeout=0.05)
@@ -232,25 +257,27 @@ class MicroBatcher:
 
   # ---- dispatch + completion ---------------------------------------------
   def _pad_batch(self, taken: List[_Pending]):
-    numerical = np.concatenate([p.numerical for p in taken])
-    cats = [np.concatenate([p.cats[i] for p in taken])
-            for i in range(len(taken[0].cats))]
-    pad = self.max_batch - numerical.shape[0]
-    if pad:
-      numerical = np.concatenate(
-          [numerical, np.zeros((pad,) + numerical.shape[1:],
-                               numerical.dtype)])
-      cats = [np.concatenate(
-          [c, np.full((pad,) + c.shape[1:], PAD_ID, c.dtype)])
-          for c in cats]
-    self.stats["padded_rows"] += pad
-    return numerical, cats
+    with _span("serve/pack", args={"requests": len(taken)}):
+      numerical = np.concatenate([p.numerical for p in taken])
+      cats = [np.concatenate([p.cats[i] for p in taken])
+              for i in range(len(taken[0].cats))]
+      pad = self.max_batch - numerical.shape[0]
+      if pad:
+        numerical = np.concatenate(
+            [numerical, np.zeros((pad,) + numerical.shape[1:],
+                                 numerical.dtype)])
+        cats = [np.concatenate(
+            [c, np.full((pad,) + c.shape[1:], PAD_ID, c.dtype)])
+            for c in cats]
+      self._counters["padded_rows"].inc(pad)
+      return numerical, cats
 
   def _dispatch(self, taken: List[_Pending], inline: bool = False):
     try:
       numerical, cats = self._pad_batch(taken)
-      out = self.dispatch_fn(numerical, cats)
-      self.stats["batches"] += 1
+      with _span("serve/dispatch"):
+        out = self.dispatch_fn(numerical, cats)
+      self._counters["batches"].inc()
     except BaseException as e:  # noqa: BLE001 — delivered per request
       for p in taken:
         p.future._fail(e)
@@ -263,17 +290,19 @@ class MicroBatcher:
     return None
 
   def _complete(self, taken: List[_Pending], out: Any) -> None:
-    try:
-      preds = np.asarray(out)  # materializes the async device result
-    except BaseException as e:  # noqa: BLE001
+    with _span("serve/complete", args={"requests": len(taken)}):
+      try:
+        preds = np.asarray(out)  # materializes the async device result
+      except BaseException as e:  # noqa: BLE001
+        for p in taken:
+          p.future._fail(e)
+        return
+      off = 0
       for p in taken:
-        p.future._fail(e)
-      return
-    off = 0
-    for p in taken:
-      p.future._fulfill(preds[off:off + p.future.n])
-      off += p.future.n
-      self.stats["completed"] += 1
+        p.future._fulfill(preds[off:off + p.future.n])
+        off += p.future.n
+        self._counters["completed"].inc()
+        self._latency.observe(p.future.latency_s)
 
   def _complete_loop(self) -> None:
     while True:

@@ -1,0 +1,113 @@
+"""Unified telemetry: metrics registry, span tracing, durable export.
+
+The one observability layer every subsystem emits through (round 14):
+
+- :mod:`.registry` — process-wide counters / gauges / log-bucketed
+  latency histograms with bounded-error percentile queries; cumulative
+  counters persist through the checkpoint manifest's ``telemetry``
+  section, so auto-resume adopts instead of double-counting.
+- :mod:`.trace` — nestable ``span("stage")`` context managers over every
+  host-side pipeline stage (dynvocab translate, tiered
+  classify/stage/write-back/re-rank, device dispatch + sync boundary,
+  snapshot save, batcher flush/complete), rendered as Chrome trace-event
+  JSON with one track per worker thread plus virtual tracks (the device
+  window).  Disabled tracing is a true no-op: ``span`` returns a
+  singleton, allocates nothing, and traced step code is never touched —
+  the jaxpr fingerprints stay byte-identical.
+- :mod:`.export` — Prometheus textfile writer, rotated fsynced JSONL
+  event log, and the normalized tool-verdict emitter, all through the
+  durable-write protocol.
+
+graftlint GL113 makes spans the sanctioned timing form: raw
+``time.perf_counter``/``time.monotonic`` calls in library modules
+outside this package are lint errors.
+"""
+
+from .export import (
+    JsonlWriter,
+    atomic_write_text,
+    emit_verdict,
+    prometheus_text,
+    write_prometheus,
+)
+from .registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    counter,
+    gauge,
+    get_registry,
+    histogram,
+)
+from .trace import (
+    Tracer,
+    current_tracer,
+    install_tracer,
+    instant,
+    span,
+    tracing,
+    uninstall_tracer,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "JsonlWriter",
+    "MetricsRegistry",
+    "Tracer",
+    "atomic_write_text",
+    "counter",
+    "current_tracer",
+    "emit_verdict",
+    "gauge",
+    "get_registry",
+    "histogram",
+    "install_tracer",
+    "instant",
+    "prometheus_text",
+    "span",
+    "timed",
+    "tracing",
+    "uninstall_tracer",
+    "write_prometheus",
+]
+
+
+class timed:
+  """Time a block into a named histogram (and a span of the same name).
+
+  The consolidation point for the tools' hand-rolled ``perf_counter``
+  loops::
+
+      with timed("serve/step"):
+          run_once()
+      p50 = get_registry().histogram("serve/step").p50
+
+  ``.elapsed`` holds the block's seconds after exit.  Recording goes
+  through a span even when tracing is disabled: the clock read lives in
+  :mod:`.trace` (the GL113-sanctioned home), and the histogram is
+  observed either way."""
+
+  __slots__ = ("name", "registry", "elapsed", "_t0")
+
+  def __init__(self, name: str, registry: MetricsRegistry = None):
+    self.name = name
+    self.registry = registry if registry is not None else get_registry()
+    self.elapsed = 0.0
+
+  def __enter__(self) -> "timed":
+    import time
+    self._t0 = time.perf_counter_ns()
+    return self
+
+  def __exit__(self, exc_type, exc, tb):
+    import time
+    t1 = time.perf_counter_ns()
+    self.elapsed = (t1 - self._t0) / 1e9
+    self.registry.histogram(self.name).observe(self.elapsed)
+    tr = current_tracer()
+    if tr is not None:
+      tr.record_window(self.name, self._t0, t1)
+    return False

@@ -1,0 +1,282 @@
+"""Host/device span tracing: nestable spans -> Chrome trace-event JSON.
+
+The trainers' per-step protocols are host-side pipelines (dynvocab
+translate, tiered classify/stage/write-back/re-rank, device dispatch +
+the block_until_ready boundary, snapshot save, batcher flush/complete)
+whose whole value proposition is OVERLAP — the prefetcher classifying
+batch k+1 while the device computes batch k, the batcher packing the
+next dispatch while the completer drains the last.  This module makes
+those claims visible instead of asserted: every stage runs under a
+``span(...)`` and an enabled run writes ``trace.json``, viewable in
+``chrome://tracing`` / Perfetto, with one track per real thread (the
+batcher's flusher/completer workers, the async checkpoint writer) plus
+named VIRTUAL tracks (``track="device"``) for windows that are not a
+thread — the device-compute window between dispatch and the first host
+sync.
+
+Disabled mode is a true no-op and the default: :func:`span` returns one
+process-wide ``_NullSpan`` singleton — no object, dict, or closure is
+allocated per call (pinned by a tracemalloc test), nothing is timed, and
+traced step code is never touched at all (spans live strictly on the
+host side of the step boundary; the jaxpr fingerprints stay
+byte-identical).
+
+When enabled (:func:`install_tracer` / the :func:`tracing` context
+manager), each span costs two ``perf_counter_ns`` reads and one
+append to a thread-local buffer — no lock on the hot path.
+
+This module is the sanctioned home of raw clock reads in the library
+package: graftlint GL113 flags ``time.perf_counter``/``time.monotonic``
+calls in library modules outside ``telemetry/``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "Tracer",
+    "span",
+    "tracing",
+    "install_tracer",
+    "uninstall_tracer",
+    "current_tracer",
+]
+
+_tracer: Optional["Tracer"] = None
+
+
+class _NullSpan:
+  """The disabled-mode span: a process-wide singleton whose enter/exit
+  do nothing.  ``start``/``finish`` support the cross-function window
+  form (``span(...).start()`` ... ``.finish()``)."""
+
+  __slots__ = ()
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, exc_type, exc, tb):
+    return False
+
+  def start(self):
+    return self
+
+  def finish(self):
+    return None
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+  """One live span: records on exit into its tracer.  Exit/finish is
+  idempotent — a protocol that syncs earlier than its tail (the
+  resilient tiered step's metric fetch) may close the window at the
+  true first sync and let the tail's finish be a no-op."""
+
+  __slots__ = ("_tracer", "name", "track", "args", "_t0", "_done")
+
+  def __init__(self, tracer: "Tracer", name: str, track: Optional[str],
+               args: Optional[Dict[str, Any]]):
+    self._tracer = tracer
+    self.name = name
+    self.track = track
+    self.args = args
+    self._t0 = 0
+    self._done = False
+
+  def __enter__(self):
+    self._t0 = time.perf_counter_ns()
+    return self
+
+  def __exit__(self, exc_type, exc, tb):
+    if not self._done:
+      self._done = True
+      self._tracer._record(self)
+    return False
+
+  # cross-function window form (e.g. device dispatch -> first host sync)
+  def start(self):
+    return self.__enter__()
+
+  def finish(self):
+    self.__exit__(None, None, None)
+
+
+def span(name: str, track: Optional[str] = None,
+         args: Optional[Dict[str, Any]] = None):
+  """A context manager timing one pipeline stage.
+
+  ``track`` names a virtual track (e.g. ``"device"``) instead of the
+  calling thread's; ``args`` is an optional JSON-able payload shown in
+  the trace viewer.  With tracing disabled this returns the no-op
+  singleton and allocates nothing."""
+  tr = _tracer
+  if tr is None:
+    return _NULL_SPAN
+  return _Span(tr, name, track, args)
+
+
+def instant(name: str, track: Optional[str] = None) -> None:
+  """A zero-duration marker event (no-op when tracing is disabled)."""
+  tr = _tracer
+  if tr is not None:
+    tr._instant(name, track)
+
+
+class Tracer:
+  """Collects span events and renders Chrome trace-event JSON.
+
+  Buffers are per thread (``threading.local``): the hot path is an
+  unlocked list append; the tracer's lock is taken only when a thread
+  records its FIRST event (buffer registration) and at render time.
+  Events carry their track key, so a span targeting a virtual track is
+  still appended to the calling thread's buffer."""
+
+  def __init__(self):
+    self._lock = threading.Lock()
+    self._local = threading.local()
+    self._buffers: List[List[tuple]] = []
+    self._threads: Dict[int, str] = {}
+    self.t0_ns = time.perf_counter_ns()
+
+  # ---- recording ----------------------------------------------------------
+  def _buffer(self) -> List[tuple]:
+    buf = getattr(self._local, "buf", None)
+    if buf is None:
+      t = threading.current_thread()
+      buf = self._local.buf = []
+      with self._lock:
+        # the track key is the registration index, NOT t.ident: CPython
+        # reuses idents after a thread exits, so two short-lived writer
+        # threads (ckpt-writer-<k>, ckpt-writer-<k+n>) would otherwise
+        # merge onto one misnamed track
+        key = len(self._buffers)
+        self._buffers.append(buf)
+        self._threads[key] = t.name
+      self._local.tid = key
+    return buf
+
+  def _record(self, sp: _Span) -> None:
+    t1 = time.perf_counter_ns()
+    self._buffer().append(
+        ("X", sp.track or self._local.tid, sp.name, sp._t0, t1 - sp._t0,
+         sp.args))
+
+  def _instant(self, name: str, track: Optional[str]) -> None:
+    t = time.perf_counter_ns()
+    self._buffer().append(
+        ("i", track or self._local.tid, name, t, 0, None))
+
+  def record_window(self, name: str, t0_ns: int, t1_ns: int,
+                    track: Optional[str] = None,
+                    args: Optional[Dict[str, Any]] = None) -> None:
+    """Record an already-measured ``[t0_ns, t1_ns)`` window (the
+    ``timed`` helper's path — its clock reads happen either way, so it
+    hands the finished window here instead of opening a span)."""
+    buf = self._buffer()
+    buf.append(("X", track or self._local.tid, name, t0_ns, t1_ns - t0_ns,
+                args))
+
+  # ---- rendering ----------------------------------------------------------
+  def events(self) -> List[tuple]:
+    with self._lock:
+      return [e for buf in self._buffers for e in buf]
+
+  def to_chrome(self) -> Dict[str, Any]:
+    """The trace as a Chrome trace-event JSON object: one ``pid``, one
+    ``tid`` per real thread, virtual tracks as extra tids sorted below
+    the threads, ``ts``/``dur`` in microseconds from tracer start."""
+    pid = 1
+    with self._lock:
+      events = [e for buf in self._buffers for e in buf]
+      threads = dict(self._threads)
+    tids: Dict[Any, int] = {}
+    out: List[Dict[str, Any]] = [
+        {"ph": "M", "pid": pid, "name": "process_name",
+         "args": {"name": "distributed_embeddings_tpu"}}]
+
+    def tid_of(key) -> int:
+      tid = tids.get(key)
+      if tid is None:
+        tid = tids[key] = len(tids) + 1
+        label = threads.get(key, key if isinstance(key, str) else
+                            f"thread-{key}")
+        out.append({"ph": "M", "pid": pid, "tid": tid,
+                    "name": "thread_name", "args": {"name": str(label)}})
+        # virtual tracks sort below the real threads
+        out.append({"ph": "M", "pid": pid, "tid": tid,
+                    "name": "thread_sort_index",
+                    "args": {"sort_index": 1000 + tid
+                             if isinstance(key, str) else tid}})
+      return tid
+
+    for ph, key, name, t0, dur, args in sorted(
+        events, key=lambda e: e[3]):
+      ev: Dict[str, Any] = {
+          "ph": ph, "pid": pid, "tid": tid_of(key), "name": name,
+          "ts": (t0 - self.t0_ns) / 1e3,
+      }
+      if ph == "X":
+        ev["dur"] = dur / 1e3
+      if args:
+        ev["args"] = dict(args)
+      out.append(ev)
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+  def save(self, path: str) -> str:
+    """Write the trace as ``chrome://tracing``-viewable JSON through the
+    durable-write protocol (tmp + fsync + atomic rename)."""
+    from .export import atomic_write_text
+    atomic_write_text(path, json.dumps(self.to_chrome()))
+    return path
+
+
+def install_tracer(tracer: Tracer) -> Tracer:
+  """Enable tracing process-wide; returns the installed tracer."""
+  global _tracer
+  _tracer = tracer
+  return tracer
+
+
+def uninstall_tracer() -> Optional[Tracer]:
+  """Disable tracing; returns the tracer that was active (if any)."""
+  global _tracer
+  tr, _tracer = _tracer, None
+  return tr
+
+
+def current_tracer() -> Optional[Tracer]:
+  return _tracer
+
+
+class tracing:
+  """``with tracing("trace.json") as tr:`` — install a fresh tracer for
+  the block, then save (when a path was given) and uninstall.  The
+  previously-installed tracer (if any) is restored on exit, so scoped
+  traces compose with a long-lived one."""
+
+  def __init__(self, path: Optional[str] = None):
+    self.path = path
+    self.tracer = Tracer()
+    self._prev: Optional[Tracer] = None
+
+  def __enter__(self) -> Tracer:
+    global _tracer
+    self._prev = _tracer
+    install_tracer(self.tracer)
+    return self.tracer
+
+  def __exit__(self, exc_type, exc, tb):
+    global _tracer
+    _tracer = self._prev
+    if self.path is not None:
+      os.makedirs(os.path.dirname(os.path.abspath(self.path)),
+                  exist_ok=True)
+      self.tracer.save(self.path)
+    return False

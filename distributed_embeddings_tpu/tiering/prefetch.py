@@ -39,6 +39,7 @@ import numpy as np
 from ..ops.ragged import RaggedIds
 from ..parallel.lookup_engine import TIER_PAD_GRP
 from ..resilience import retry as _retry
+from ..telemetry import get_registry as _registry, span as _span
 from .plan import TieringPlan
 from .store import HostTierStore
 
@@ -59,12 +60,17 @@ class TieredPrefetcher:
 
   def __init__(self, tplan: TieringPlan, store: HostTierStore,
                mesh=None, axis_name: str = "mp",
-               retry_policy: _retry.RetryPolicy = _retry.DEFAULT_POLICY):
+               retry_policy: _retry.RetryPolicy = _retry.DEFAULT_POLICY,
+               telemetry=None):
     self.tplan = tplan
     self.store = store
     self.plan = tplan.plan
     self.mesh = mesh
     self.axis_name = axis_name
+    # the registry the gather/spill counters land in (default: the
+    # process-wide one; a wrapping trainer may re-point it so isolated
+    # accounting captures the WHOLE protocol's counters)
+    self.telemetry = telemetry if telemetry is not None else _registry()
     # Host gathers are the one step-critical operation here that touches
     # storage outside our control (host RAM today, NFS/disk-backed
     # stores tomorrow — and the fault injector either way): a transient
@@ -75,6 +81,7 @@ class TieredPrefetcher:
 
     def _count_retry(attempt, exc):
       self.host_gather_retries += 1
+      self.telemetry.counter("tiered/host_gather_retries").inc()
 
     self._gather = _retry.retrying(store.gather, policy=retry_policy,
                                    on_retry=_count_retry)
@@ -122,6 +129,10 @@ class TieredPrefetcher:
     """Global batch -> per class name, per rank, the deduped COLD
     physical rows; updates the observed counts (occurrences, not dedup
     presence — re-ranking should weight by traffic)."""
+    with _span("tiered/classify"):
+      return self._classify(cats)
+
+  def _classify(self, cats: Sequence) -> Dict[str, List[np.ndarray]]:
     cold: Dict[str, List[np.ndarray]] = {}
     for key, c in self.tplan.classes.items():
       rpp = c.spec.rpp
@@ -181,6 +192,10 @@ class TieredPrefetcher:
 
   def stage(self, cold: Dict[str, List[np.ndarray]]) -> StagedBatch:
     """Host-gather the cold rows and upload the staging inputs."""
+    with _span("tiered/stage"):
+      return self._stage(cold)
+
+  def _stage(self, cold: Dict[str, List[np.ndarray]]) -> StagedBatch:
     grps_dev, rows_dev, s_eff = {}, {}, {}
     nbytes = 0
     spilled = False
@@ -207,6 +222,9 @@ class TieredPrefetcher:
       s_eff[c.name] = s
     self.total_host_gather_bytes += nbytes
     self.spill_steps += int(spilled)
+    self.telemetry.counter("tiered/host_gather_bytes").inc(nbytes)
+    if spilled:
+      self.telemetry.counter("tiered/spill_steps").inc()
     return StagedBatch(
         device={"grps": grps_dev, "rows": rows_dev,
                 "resident": self._resident_dev},
@@ -221,14 +239,15 @@ class TieredPrefetcher:
                  staged_out: Dict[str, jax.Array]) -> None:
     """Overwrite the staged rows in the host images with the
     post-scatter device values."""
-    for c in self.tplan.classes.values():
-      s = staged.s_eff[c.name]
-      out_np = np.asarray(staged_out[c.name])
-      for rank, g in enumerate(staged.cold[c.name]):
-        if not g.shape[0]:
-          continue
-        self.store.scatter(c.name, rank, g,
-                           out_np[rank * s:rank * s + g.shape[0]])
+    with _span("tiered/write_back"):
+      for c in self.tplan.classes.values():
+        s = staged.s_eff[c.name]
+        out_np = np.asarray(staged_out[c.name])
+        for rank, g in enumerate(staged.cold[c.name]):
+          if not g.shape[0]:
+            continue
+          self.store.scatter(c.name, rank, g,
+                             out_np[rank * s:rank * s + g.shape[0]])
 
   # ---- promotion / eviction ----------------------------------------------
   def maybe_rerank(self, fused: Dict[str, jax.Array], decay: bool = True
@@ -252,6 +271,11 @@ class TieredPrefetcher:
     resident maps (host + device) are refreshed. ``decay`` halves the
     counts afterward so the ranking tracks traffic drift instead of
     accumulating forever."""
+    with _span("tiered/rerank"):
+      return self._rerank(fused, decay=decay)
+
+  def _rerank(self, fused: Dict[str, jax.Array], decay: bool = True
+              ) -> Dict[str, jax.Array]:
     fused = dict(fused)
     for c in self.tplan.classes.values():
       spec, lay = c.spec, c.layout_logical

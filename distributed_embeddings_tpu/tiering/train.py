@@ -38,6 +38,7 @@ from ..parallel.lookup_engine import (
     class_param_name,
     padded_rows,
 )
+from ..telemetry import get_registry as _registry, span as _span
 from ..training import make_tiered_train_step, shard_batch
 from .plan import TieringPlan
 from .prefetch import TieredPrefetcher
@@ -251,14 +252,19 @@ class TieredTrainer:
                    optax.GradientTransformation] = None,
                exact: bool = False,
                donate: bool = True,
-               guard: bool = False):
+               guard: bool = False,
+               telemetry=None):
     self.tplan = tplan
     self.store = store
     self.mesh = mesh
     self.axis_name = axis_name
     self.state = state
     self.guard = guard
-    self.prefetcher = TieredPrefetcher(tplan, store, mesh, axis_name)
+    # hit/lookup counters emit here (default: the process registry);
+    # the prefetcher shares it so one registry sees the whole protocol
+    self.telemetry = telemetry if telemetry is not None else _registry()
+    self.prefetcher = TieredPrefetcher(tplan, store, mesh, axis_name,
+                                       telemetry=self.telemetry)
     self._step_fn = make_tiered_train_step(
         model, tplan, loss_fn, dense_optimizer, rule, mesh, state,
         batch_example, axis_name=axis_name,
@@ -277,9 +283,13 @@ class TieredTrainer:
     ``missed > 0`` prefetch contract. Split out of :meth:`_account` so a
     wrapping trainer (``resilience.ResilientTrainer(tiered=...)``) can
     own the guard accounting while the tier bookkeeping stays here."""
+    reg = self.telemetry
     for name, m in tier.items():
       m = np.asarray(m, np.int64)
       self.hits[name] += m
+      reg.counter(f"tiered/hits_hot/{name}").inc(int(m[0]))
+      reg.counter(f"tiered/hits_staged/{name}").inc(int(m[1]))
+      reg.counter(f"tiered/lookups/{name}").inc(int(m[3]))
       if m[2]:
         raise RuntimeError(
             f"class {name}: {int(m[2])} of {int(m[3])} lookups hit neither "
@@ -345,9 +355,15 @@ class TieredTrainer:
                         jnp.asarray(labels)), self.mesh, self.axis_name)
 
   def _dispatch(self, staged, numerical, cats, labels):
-    batch = self._device_batch(numerical, cats, labels)
-    self.state, staged_out, metrics, loss = self._step_fn(
-        self.state, staged.device, *batch)
+    # the device window rides its own trace track, from dispatch (jax
+    # returns immediately — dispatch is asynchronous) to the first host
+    # sync (_finish's write-back fetch), so the look-ahead classify on
+    # the main-thread track is VISIBLY inside it in trace.json
+    self._dev_span = _span("device/step", track="device").start()
+    with _span("tiered/dispatch"):
+      batch = self._device_batch(numerical, cats, labels)
+      self.state, staged_out, metrics, loss = self._step_fn(
+          self.state, staged.device, *batch)
     return staged_out, metrics, loss
 
   def _finish(self, staged, staged_out, metrics, account=None):
@@ -358,6 +374,7 @@ class TieredTrainer:
     (``resilience.ResilientTrainer(tiered=...)``) can own the guard
     bookkeeping without duplicating this sequence."""
     self.prefetcher.write_back(staged, staged_out)  # syncs on the device
+    self._dev_span.finish()  # dispatch -> post-write-back sync window
     (account or self._account)(metrics)
     self.state["fused"] = self.prefetcher.maybe_rerank(self.state["fused"])
 

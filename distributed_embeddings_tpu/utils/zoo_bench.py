@@ -12,13 +12,14 @@ records), ``tests/test_plan_scale.py`` (CI bound), and
 from __future__ import annotations
 
 import dataclasses
-import time
 from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+
+from ..telemetry import timed
 
 
 def run_zoo_plan_step(name: str, mesh, world: int, b_local: int = 2,
@@ -49,12 +50,11 @@ def run_zoo_plan_step(name: str, mesh, world: int, b_local: int = 2,
             for t in tables]
   batch = b_local * world
 
-  t0 = time.perf_counter()
-  plan = DistEmbeddingStrategy(tables, world, "memory_balanced",
-                               input_table_map=tmap,
-                               dense_row_threshold=dense_row_threshold,
-                               input_hotness=hotness, batch_hint=batch)
-  plan_s = time.perf_counter() - t0
+  with timed("zoo/plan") as t_plan:
+    plan = DistEmbeddingStrategy(tables, world, "memory_balanced",
+                                 input_table_map=tmap,
+                                 dense_row_threshold=dense_row_threshold,
+                                 input_hotness=hotness, batch_hint=batch)
 
   model = SyntheticModel(config=cfg, world_size=world,
                          dense_row_threshold=dense_row_threshold)
@@ -66,10 +66,10 @@ def run_zoo_plan_step(name: str, mesh, world: int, b_local: int = 2,
   numerical = jnp.asarray(numerical)
   labels = jnp.asarray(labels)
   dummy = [jnp.zeros((2, tables[t].output_dim), jnp.float32) for t in tmap]
-  t0 = time.perf_counter()
-  dense_params = model.init(jax.random.PRNGKey(0), numerical[:2],
-                            [c[:2] for c in cats], emb_acts=dummy)["params"]
-  init_s = time.perf_counter() - t0
+  with timed("zoo/init") as t_init:
+    dense_params = model.init(jax.random.PRNGKey(0), numerical[:2],
+                              [c[:2] for c in cats],
+                              emb_acts=dummy)["params"]
   rule = adagrad_rule(0.01)
   opt = optax.adagrad(0.01)
   state = shard_params(
@@ -78,17 +78,16 @@ def run_zoo_plan_step(name: str, mesh, world: int, b_local: int = 2,
   batch_tree = shard_batch((numerical, tuple(cats), labels), mesh)
   step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
                                 state, batch_tree)
-  t0 = time.perf_counter()
-  state, loss = step(state, *batch_tree)
-  loss = float(jax.block_until_ready(loss))
-  step_s = time.perf_counter() - t0
+  with timed("zoo/step") as t_step:
+    state, loss = step(state, *batch_tree)
+    loss = float(jax.block_until_ready(loss))
   return {
       "name": name,
       "tables": len(tables),
       "inputs": len(cats),
       "classes": len(plan.class_keys),
-      "plan_s": plan_s,
-      "init_s": init_s,
-      "step_s": step_s,
+      "plan_s": t_plan.elapsed,
+      "init_s": t_init.elapsed,
+      "step_s": t_step.elapsed,
       "loss": loss,
   }

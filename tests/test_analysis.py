@@ -432,6 +432,87 @@ def make_eval_step(plan):
   assert lint_source(sup, "m.py", CTX, ["GL112"]) == []
 
 
+def test_gl113_raw_timing_in_library_module():
+  """Raw perf_counter/monotonic timing in a library module: spans (or
+  the telemetry histogram type) are the sanctioned form — one trace,
+  one registry, instead of ~30 hand-rolled timing loops."""
+  src = """
+import time
+
+def stage(store):
+  t0 = time.perf_counter()
+  store.gather()
+  return time.perf_counter() - t0
+
+def deadline():
+  return time.monotonic() + 30.0
+"""
+  out = lint_source(src, "distributed_embeddings_tpu/tiering/prefetch.py",
+                    CTX, ["GL113"])
+  assert _rules(out) == ["GL113", "GL113", "GL113"]
+  assert "telemetry.span" in out[0].message
+
+
+def test_gl113_from_import_and_alias_forms():
+  """A from-import (or module alias) must not be a bypass: the rule
+  tracks `from time import perf_counter [as pc]` and `import time as
+  t` and flags the bare-name calls the same way."""
+  src = """
+from time import perf_counter as pc
+import time as clk
+
+def stage():
+  t0 = pc()
+  return clk.monotonic() - t0
+"""
+  out = lint_source(src, "distributed_embeddings_tpu/tiering/store.py",
+                    CTX, ["GL113"])
+  assert _rules(out) == ["GL113", "GL113"]
+  assert "perf_counter" in out[0].message
+  # an unrelated bare name is not flagged
+  ok = """
+def stage(perf_counter_like):
+  return perf_counter_like()
+"""
+  assert lint_source(ok, "distributed_embeddings_tpu/tiering/store.py",
+                     CTX, ["GL113"]) == []
+
+
+def test_gl113_scope_and_suppression():
+  src = """
+import time
+
+def stage():
+  return time.perf_counter()
+"""
+  # telemetry/ is the sanctioned home of the clock reads themselves
+  assert lint_source(
+      src, "distributed_embeddings_tpu/telemetry/trace.py", CTX,
+      ["GL113"]) == []
+  # tools/tests drive their own harnesses — library-package scope only
+  assert lint_source(src, "tools/profile_thing.py", CTX, ["GL113"]) == []
+  assert lint_source(src, "tests/test_thing.py", CTX, ["GL113"]) == []
+  # non-timing uses of the time module stay legal
+  ok = """
+import time
+
+def backoff():
+  time.sleep(0.1)
+"""
+  assert lint_source(
+      ok, "distributed_embeddings_tpu/resilience/retry.py", CTX,
+      ["GL113"]) == []
+  sup = """
+import time
+
+def deadline():
+  return time.monotonic() + 30.0  # graftlint: disable=GL113 (deadline)
+"""
+  assert lint_source(
+      sup, "distributed_embeddings_tpu/checkpoint.py", CTX,
+      ["GL113"]) == []
+
+
 # ---------------------------------------------------------------------------
 # repo-context parsing + HEAD cleanliness
 # ---------------------------------------------------------------------------

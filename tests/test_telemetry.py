@@ -1,0 +1,487 @@
+"""Telemetry subsystem tests: registry, histograms, spans, persistence.
+
+The four contracts the observability layer rests on (ISSUE 10):
+
+- **histogram correctness**: the log-bucket percentile estimates stay
+  within the constructed relative-error bound against EXACT nearest-rank
+  percentiles on adversarial distributions (power-law, bimodal spikes,
+  ten-decade dynamic range, constants, zeros);
+- **span nesting / thread-track attribution**: spans record on the
+  track of the thread that ran them — pinned under the micro-batcher's
+  REAL flusher/completer worker threads and the async checkpoint
+  writer, plus virtual tracks for the device window;
+- **counter persistence**: cumulative counters ride the checkpoint
+  manifest's ``telemetry`` section through a kill/resume cycle without
+  double-counting (the dynvocab-totals discipline, generalized);
+- **disabled-mode cost**: ``span()`` with no tracer installed returns a
+  process-wide singleton and allocates NOTHING (tracemalloc-pinned) —
+  disabled telemetry is a true no-op, and the jaxpr fingerprints
+  (tests/test_analysis.py) stay byte-identical because spans never
+  enter traced code at all.
+"""
+
+import json
+import math
+import os
+import threading
+import tracemalloc
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from distributed_embeddings_tpu import telemetry
+from distributed_embeddings_tpu.layers.planner import DistEmbeddingStrategy
+from distributed_embeddings_tpu.models import DLRM, bce_loss
+from distributed_embeddings_tpu.ops.packed_table import sparse_rule
+from distributed_embeddings_tpu.parallel import create_mesh
+from distributed_embeddings_tpu.resilience import faultinject
+from distributed_embeddings_tpu.resilience.trainer import ResilientTrainer
+from distributed_embeddings_tpu.serving import MicroBatcher
+from distributed_embeddings_tpu.telemetry import (
+    Histogram,
+    JsonlWriter,
+    MetricsRegistry,
+    emit_verdict,
+    prometheus_text,
+    span,
+    tracing,
+)
+from distributed_embeddings_tpu.training import (
+    init_sparse_state,
+    make_sparse_train_step,
+    shard_batch,
+    shard_params,
+)
+
+WORLD = 4
+VOCAB = [300, 200, 150, 20]
+
+
+# ---------------------------------------------------------------------------
+# histogram: log-bucket error bound vs exact percentiles
+# ---------------------------------------------------------------------------
+
+
+def _exact_nearest_rank(xs, q):
+  s = np.sort(np.asarray(xs))
+  return float(s[max(1, math.ceil(q / 100.0 * len(s))) - 1])
+
+
+@pytest.mark.parametrize("rel_err", [0.01, 0.05])
+def test_histogram_bound_on_adversarial_distributions(rel_err):
+  """Estimates stay within the constructed relative-error bound against
+  the exact nearest-rank percentile, for distributions chosen to break
+  bucketing schemes: heavy tails, ten-decade range, point masses sitting
+  exactly on bucket boundaries' bad side, and bimodal spikes."""
+  rng = np.random.default_rng(0)
+  dists = {
+      "powerlaw": rng.pareto(1.05, 4000) + 1e-7,
+      "ten_decades": 10.0 ** rng.uniform(-8, 2, 4000),
+      "bimodal_spikes": np.r_[np.full(999, 1e-6), np.full(1000, 123.456),
+                              rng.normal(1.0, 1e-4, 5)],
+      "constant": np.full(100, 0.0421),
+      "lognormal": rng.lognormal(0.0, 5.0, 3000),
+  }
+  for name, xs in dists.items():
+    h = Histogram("t", rel_err=rel_err)
+    h.observe_many(xs)
+    assert h.count == len(xs)
+    for q in (0.1, 25, 50, 90, 99, 99.9, 100):
+      exact = _exact_nearest_rank(xs, q)
+      est = h.percentile(q)
+      assert abs(est - exact) <= rel_err * exact * (1 + 1e-9), \
+          (name, q, est, exact)
+
+
+def test_histogram_zeros_count_and_merge():
+  h = Histogram("t", rel_err=0.01)
+  h.observe_many([0.0, 0.0, 0.0, 1.0])
+  assert h.percentile(50) == 0.0 and h.percentile(75) == 0.0
+  assert abs(h.percentile(100) - 1.0) <= 0.01
+  other = Histogram("t", rel_err=0.01)
+  other.observe_many([2.0] * 4)
+  h.merge(other)
+  assert h.count == 8 and abs(h.percentile(100) - 2.0) <= 0.02
+  with pytest.raises(ValueError, match="rel_err"):
+    h.merge(Histogram("t", rel_err=0.02))
+  assert math.isnan(Histogram("e").percentile(50))
+  with pytest.raises(ValueError, match="nan"):
+    h.observe(float("nan"))
+
+
+def test_histogram_state_roundtrip_through_json():
+  rng = np.random.default_rng(3)
+  h = Histogram("t", rel_err=0.01)
+  h.observe_many(rng.lognormal(0, 3, 500))
+  st = json.loads(json.dumps(h.state()))  # the manifest path is JSON
+  h2 = Histogram("t", rel_err=0.01)
+  h2.load(st)
+  assert h2.count == h.count and h2.sum == h.sum
+  for q in (50, 99):
+    assert h2.percentile(q) == h.percentile(q)
+  with pytest.raises(ValueError, match="rel_err"):
+    Histogram("t", rel_err=0.05).load(st)
+
+
+# ---------------------------------------------------------------------------
+# registry: schema, thread-safety, prometheus rendering
+# ---------------------------------------------------------------------------
+
+
+def test_registry_kinds_and_conflicts():
+  r = MetricsRegistry()
+  r.counter("a").inc(2)
+  assert r.counter("a").value == 2  # same object on re-request
+  with pytest.raises(ValueError, match="already registered"):
+    r.gauge("a")
+  with pytest.raises(ValueError, match="monotone"):
+    r.counter("a").inc(-1)
+  r.gauge("g").set(1.5)
+  assert r.snapshot()["g"] == 1.5
+  # a histogram re-request with a different error bound is a loud
+  # mismatch, not a silently-wrong geometry (same policy as load/merge)
+  r.histogram("h", rel_err=0.01)
+  with pytest.raises(ValueError, match="rel_err"):
+    r.histogram("h", rel_err=0.001)
+
+
+def test_registry_counters_under_thread_contention():
+  r = MetricsRegistry()
+
+  def work():
+    c = r.counter("hits")
+    for _ in range(10000):
+      c.inc()
+
+  threads = [threading.Thread(target=work) for _ in range(8)]
+  for t in threads:
+    t.start()
+  for t in threads:
+    t.join()
+  assert r.counter("hits").value == 80000
+
+
+def test_registry_state_dict_roundtrip_and_adoption():
+  r = MetricsRegistry()
+  r.counter("train/bad_step").inc(3)
+  r.gauge("vocab/occupancy/c").set(17)
+  r.histogram("serve/latency_s").observe_many([0.001, 0.002, 0.4])
+  section = json.loads(json.dumps(r.state_dict()))
+
+  r2 = MetricsRegistry()
+  r2.counter("train/bad_step").inc(99)   # pre-resume local noise
+  r2.counter("other/thing").inc(5)       # not in the section: untouched
+  r2.load_state_dict(section)
+  assert r2.counter("train/bad_step").value == 3  # REPLACED, not added
+  assert r2.counter("other/thing").value == 5
+  assert r2.gauge("vocab/occupancy/c").value == 17
+  assert r2.histogram("serve/latency_s").count == 3
+
+
+def test_prometheus_text_format():
+  r = MetricsRegistry()
+  r.counter("train/oov/class_a").inc(4)
+  r.gauge("queue/depth").set(2)
+  r.histogram("serve/latency_s").observe_many([0.01] * 100)
+  text = prometheus_text(r)
+  assert "# TYPE train_oov_class_a counter" in text
+  assert "train_oov_class_a 4" in text
+  assert "# TYPE serve_latency_s summary" in text
+  assert 'serve_latency_s{quantile="0.99"}' in text
+  assert "serve_latency_s_count 100" in text
+
+
+# ---------------------------------------------------------------------------
+# spans: disabled-mode no-op, nesting, thread tracks
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_span_is_singleton_and_zero_allocation():
+  """The disabled path must cost nothing: one shared no-op object, zero
+  allocations attributed to the telemetry modules (tracemalloc pins the
+  'spans compile to nothing' claim — a closure or kwargs dict per call
+  would show up here)."""
+  assert telemetry.current_tracer() is None
+  assert span("a") is span("b") is span("c", track="device")
+
+  here = os.path.dirname(telemetry.__file__)
+  for _ in range(100):  # warm any lazy interning
+    with span("warm"):
+      pass
+  tracemalloc.start()
+  try:
+    s0 = tracemalloc.take_snapshot()
+    for _ in range(5000):
+      with span("hot/stage"):
+        pass
+    s1 = tracemalloc.take_snapshot()
+  finally:
+    tracemalloc.stop()
+  telem = [st for st in s1.compare_to(s0, "filename")
+           if here in st.traceback[0].filename and st.count_diff > 0]
+  # a couple of constant warm-up blocks (code-object bookkeeping under
+  # tracemalloc) are tolerated; anything PER-CALL over 5000 iterations
+  # would show up as thousands
+  blocks = sum(st.count_diff for st in telem)
+  assert blocks < 50, f"disabled spans allocate per call: {telem}"
+
+
+def test_span_nesting_and_virtual_tracks():
+  with tracing() as tr:
+    with span("outer"):
+      with span("inner", args={"k": 3}):
+        pass
+    dev = span("device/step", track="device").start()
+    with span("overlapped-host-work"):
+      pass
+    dev.finish()
+  chrome = tr.to_chrome()
+  evs = {e["name"]: e for e in chrome["traceEvents"] if e["ph"] == "X"}
+  # nesting: inner starts no earlier and ends no later than outer
+  out_, in_ = evs["outer"], evs["inner"]
+  assert out_["ts"] <= in_["ts"]
+  assert in_["ts"] + in_["dur"] <= out_["ts"] + out_["dur"] + 1e-6
+  assert in_["args"] == {"k": 3}
+  # the virtual device track is a distinct tid, and the host span is
+  # inside the device window — overlap is visible, not asserted
+  assert evs["device/step"]["tid"] != evs["overlapped-host-work"]["tid"]
+  d, h = evs["device/step"], evs["overlapped-host-work"]
+  assert d["ts"] <= h["ts"] and h["ts"] + h["dur"] <= d["ts"] + d["dur"]
+  names = {e["args"]["name"] for e in chrome["traceEvents"]
+           if e.get("name") == "thread_name"}
+  assert "device" in names
+  assert telemetry.current_tracer() is None  # uninstalled on exit
+
+
+def test_thread_tracks_unique_across_sequential_threads():
+  """CPython reuses thread idents after a thread exits; track keys must
+  not — two short-lived workers (the async ckpt-writer pattern) each
+  get their OWN correctly-named track."""
+  def worker():
+    with span("w"):
+      pass
+
+  with tracing() as tr:
+    for i in range(2):
+      th = threading.Thread(target=worker, name=f"writer-{i}")
+      th.start()
+      th.join()
+  chrome = tr.to_chrome()
+  names = {e["args"]["name"] for e in chrome["traceEvents"]
+           if e.get("name") == "thread_name"}
+  assert {"writer-0", "writer-1"} <= names
+  w_tids = {e["tid"] for e in chrome["traceEvents"]
+            if e.get("ph") == "X" and e["name"] == "w"}
+  assert len(w_tids) == 2  # one track per thread, never merged
+
+
+def test_span_thread_tracks_under_real_batcher_threads(tmp_path):
+  """Track attribution under the batcher's REAL flusher/completer
+  threads: pack/dispatch spans land on the flusher's track, completion
+  spans on the completer's, both distinct from the submitting thread."""
+  def dispatch(numerical, cats):
+    return np.zeros((8, 1), np.float32)
+
+  path = str(tmp_path / "trace.json")
+  with tracing(path):
+    mb = MicroBatcher(dispatch, max_batch=8, max_delay_s=0.001)
+    futs = [mb.submit(np.zeros((2, 3), np.float32),
+                      [np.zeros((2,), np.int32)]) for _ in range(6)]
+    for f in futs:
+      f.result(timeout=30)
+    mb.close()
+  chrome = json.load(open(path))
+  tracks = {e["tid"]: e["args"]["name"] for e in chrome["traceEvents"]
+            if e.get("name") == "thread_name"}
+  by_name = {}
+  for e in chrome["traceEvents"]:
+    if e["ph"] == "X":
+      by_name.setdefault(e["name"], set()).add(tracks[e["tid"]])
+  assert by_name["serve/pack"] == {"serve-batcher-flush"}
+  assert by_name["serve/dispatch"] == {"serve-batcher-flush"}
+  assert by_name["serve/complete"] == {"serve-batcher-complete"}
+  # and the registry-backed accounting saw every request
+  assert mb.stats["completed"] == 6 and mb.stats["rejected"] == 0
+  assert mb.telemetry.histogram("serve/latency_s").count == 6
+
+
+def test_ckpt_save_span_on_async_writer_thread(tmp_path):
+  """The async snapshot's ckpt/save span lands on the writer thread's
+  own track (named ckpt-writer-<step>), while training-side spans stay
+  on the main thread — the overlap the async path exists for is a
+  visible two-track fact in the trace."""
+  from tests.test_resilience import build, init_state, make_batch
+
+  model, plan, rule, opt = build(1)
+  batch = make_batch(1)
+  state = init_state(model, plan, rule, opt, batch)
+  step = make_sparse_train_step(model, plan, bce_loss, opt, rule, None,
+                                state, batch, donate=False, guard=True)
+  path = str(tmp_path / "trace.json")
+  with tracing(path):
+    t = ResilientTrainer(step, state, plan, rule,
+                         str(tmp_path / "ckpts"), resume=False,
+                         async_snapshots=True,
+                         telemetry=MetricsRegistry())
+    t.step(*shard_batch(batch, None))
+    t.snapshot(async_=True)
+    t.join_writer()
+  chrome = json.load(open(path))
+  tracks = {e["tid"]: e["args"]["name"] for e in chrome["traceEvents"]
+            if e.get("name") == "thread_name"}
+  saves = [e for e in chrome["traceEvents"]
+           if e.get("ph") == "X" and e["name"] == "ckpt/save"]
+  assert saves and all(
+      tracks[e["tid"]].startswith("ckpt-writer-") for e in saves)
+  assert t.telemetry.counter("ckpt/snapshots").value == 1
+
+
+# ---------------------------------------------------------------------------
+# counter persistence across a kill/resume cycle
+# ---------------------------------------------------------------------------
+
+
+def _trainer_fixture(root, registry, mesh, built, state0, step):
+  model, plan, rule, opt = built
+  return ResilientTrainer(step, state0, plan, rule, str(root), mesh=mesh,
+                          snapshot_every=2, telemetry=registry)
+
+
+def test_counters_persist_across_kill_resume(tmp_path):
+  """The generalized dynvocab pattern: cumulative telemetry rides the
+  manifest's ``telemetry`` section; a fresh process (fresh registry)
+  adopts the persisted counts on first resume and continues them —
+  totals over the logical run match an uninterrupted run exactly, with
+  nothing double-counted on the replayed tail."""
+  from tests.test_resilience import build, init_state, make_batch
+
+  mesh = create_mesh(WORLD)
+  built = build(WORLD)
+  model, plan, rule, opt = built
+  batches = [make_batch(WORLD, seed) for seed in range(8)]
+  stream = list(faultinject.nan_batches(batches, at_steps={2, 5}))
+  state0 = init_state(model, plan, rule, opt, batches[0], mesh)
+  step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
+                                state0, batches[0], donate=False,
+                                guard=True)
+
+  # uninterrupted reference
+  ref_reg = MetricsRegistry()
+  ref = _trainer_fixture(tmp_path / "ref", ref_reg, mesh, built,
+                         init_state(model, plan, rule, opt, batches[0],
+                                    mesh), step)
+  ref.run(stream)
+  assert ref_reg.counter("train/bad_step").value == 2
+  assert ref_reg.counter("train/consumed").value == 8
+
+  # killed run: crash mid-save partway through the stream
+  reg1 = MetricsRegistry()
+  t1 = _trainer_fixture(tmp_path / "run", reg1, mesh, built,
+                        init_state(model, plan, rule, opt, batches[0],
+                                   mesh), step)
+  inj = faultinject.FaultInjector().crash_after("ckpt_write", 20)
+  with pytest.raises(faultinject.InjectedCrash):
+    with faultinject.injected(inj):
+      for b in stream:
+        t1.step(*shard_batch(b, mesh))
+
+  # fresh process stand-in: NEW registry, adopts the persisted section
+  reg2 = MetricsRegistry()
+  reg2.counter("train/bad_step").inc(7)  # pre-resume noise: replaced
+  t2 = _trainer_fixture(tmp_path / "run", reg2, mesh, built,
+                        init_state(model, plan, rule, opt, batches[0],
+                                   mesh), step)
+  assert t2.resumed_from is not None
+  persisted_bad = reg2.counter("train/bad_step").value
+  persisted_consumed = reg2.counter("train/consumed").value
+  assert persisted_consumed == t2.consumed  # adopted, in sync
+  t2.run(stream[t2.consumed:])
+  assert reg2.counter("train/consumed").value == 8
+  assert reg2.counter("train/bad_step").value == 2  # never double-counted
+  assert reg2.counter("train/bad_step").value >= persisted_bad
+  assert reg2.counter("ckpt/restores").value >= 0  # global-registry metric
+  # and the manifest section is plain JSON in the checkpoint
+  from distributed_embeddings_tpu import checkpoint
+  from distributed_embeddings_tpu.resilience import durable
+  _, path = durable.latest_valid(str(tmp_path / "run"))
+  sec = checkpoint.read_manifest(path)["telemetry"]
+  assert sec["counters"]["train/consumed"] >= persisted_consumed
+
+
+# ---------------------------------------------------------------------------
+# export: jsonl rotation durability, verdict schema
+# ---------------------------------------------------------------------------
+
+
+def test_jsonl_writer_rotation_keeps_tail(tmp_path):
+  path = str(tmp_path / "events.jsonl")
+  w = JsonlWriter(path, max_bytes=120, keep=2)
+  for i in range(120):
+    w.write({"i": i})
+  w.close()
+  assert os.path.exists(path + ".1") and os.path.exists(path + ".2")
+  assert not os.path.exists(path + ".3")  # keep bound enforced
+  ids = []
+  for f in (path + ".2", path + ".1", path):
+    with open(f) as fh:
+      ids += [json.loads(line)["i"] for line in fh]
+  # the surviving window is contiguous and ends at the last write
+  assert ids == list(range(ids[0], 120))
+
+
+def test_emit_verdict_schema_and_exit_codes(tmp_path, capsys):
+  log = str(tmp_path / "verdicts.jsonl")
+  assert emit_verdict("chaos", {"ok": True, "skips": 3}, path=log) == 0
+  assert emit_verdict("chaos-kill", {"ok": False}, verbose=False,
+                      path=log) == 1
+  assert emit_verdict("obs-bench", {}, verbose=False, path=log) == 1
+  out = capsys.readouterr().out
+  assert "CHAOS: PASS" in out and "CHAOS-KILL: FAIL" in out
+  with open(log) as f:
+    records = [json.loads(line) for line in f]
+  assert [r["tool"] for r in records] == ["chaos", "chaos-kill",
+                                          "obs-bench"]
+  assert [r["ok"] for r in records] == [True, False, False]
+  assert records[0]["verdict"]["skips"] == 3  # full result rides along
+
+
+def test_write_prometheus_atomic(tmp_path):
+  r = MetricsRegistry()
+  r.counter("x").inc()
+  path = str(tmp_path / "metrics.prom")
+  telemetry.write_prometheus(r, path)
+  assert open(path).read().startswith("# TYPE x counter")
+  assert not os.path.exists(path + ".tmp")
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integration: telemetry section through save/restore
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_telemetry_section_roundtrip(tmp_path):
+  from distributed_embeddings_tpu import checkpoint
+  from tests.test_resilience import build, init_state, make_batch
+
+  model, plan, rule, opt = build(1)
+  batch = make_batch(1)
+  state = init_state(model, plan, rule, opt, batch)
+  reg = MetricsRegistry()
+  reg.counter("train/consumed").inc(11)
+  reg.histogram("serve/latency_s").observe_many([0.01, 0.02])
+  path = str(tmp_path / "ckpt")
+  checkpoint.save(path, plan, rule, state, telemetry=reg)
+  assert not checkpoint.verify(path)
+  reg2 = MetricsRegistry()
+  checkpoint.restore(path, plan, rule, state, telemetry=reg2)
+  assert reg2.counter("train/consumed").value == 11
+  assert reg2.histogram("serve/latency_s").count == 2
+  # a registry-less restore ignores the section (observability, not
+  # state), and a section-less checkpoint is fine with a registry
+  checkpoint.restore(path, plan, rule, state)
+  path2 = str(tmp_path / "ckpt2")
+  checkpoint.save(path2, plan, rule, state)
+  checkpoint.restore(path2, plan, rule, state,
+                     telemetry=MetricsRegistry())

@@ -7,13 +7,19 @@ scalar, so every timing here ends with a host fetch of one element.
 
 Timing: chained steps at two chain lengths, differenced, so dispatch/RTT
 overheads cancel. With ``donate=True`` the first positional argument is
-donated and the chain carries its successor.
+donated and the chain carries its successor.  Every differenced
+measurement is also recorded into the process-wide telemetry registry
+(histogram ``bench/<name>``), so the profilers share one metrics
+surface with the rest of the stack instead of each keeping private
+floats.
 """
 
 import time
 
 import jax
 import jax.numpy as jnp
+
+from distributed_embeddings_tpu.telemetry import get_registry
 
 
 def sync(x):
@@ -40,6 +46,7 @@ def timeit(name, fn, first, *args, donate=True, n_norm=None, reps=5):
   t1, carry = run(reps, carry)
   t2, carry = run(2 * reps, carry)
   dt = (t2 - t1) / reps
+  get_registry().histogram(f"bench/{name}").observe(dt)
   per = f"  {dt / n_norm * 1e9:6.1f} ns/elem" if n_norm else ""
   print(f"{name:56s}: {dt * 1e3:8.2f} ms{per}", flush=True)
   return carry

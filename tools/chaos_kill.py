@@ -30,8 +30,10 @@ gap:
    slow-storage delay and must log steps completing WHILE the writer
    thread is flushing, with an unchanged trajectory.
 
-Run ``make chaos-kill`` (JSON verdict, exit 0/1); the longer multi-cycle
-variant is ``@pytest.mark.slow`` in ``tests/test_elastic.py``.
+Run ``make chaos-kill`` — the verdict goes through
+``telemetry.emit_verdict`` (the same normalized record, JSONL log hook,
+and 0/1 exit-code convention as ``chaos_train.py``); the longer
+multi-cycle variant is ``@pytest.mark.slow`` in ``tests/test_elastic.py``.
 """
 
 import argparse
@@ -385,10 +387,13 @@ def main(argv=None) -> int:
                async_snapshots=args.async_snapshots,
                slow_writes=args.slow_writes)
     return 0
+  from distributed_embeddings_tpu.telemetry import emit_verdict
+
   res = run_chaos_kill(steps=args.steps, resize_world=args.resize_world,
-                       extra_cycles=args.extra_cycles)
-  print("CHAOS-KILL:", "PASS" if res["ok"] else "FAIL")
-  return 0 if res["ok"] else 1
+                       extra_cycles=args.extra_cycles, verbose=False)
+  # same emitter as chaos_train.py: one verdict schema, one exit-code
+  # convention, shared JSONL log hook ($DE_TPU_VERDICT_LOG)
+  return emit_verdict("chaos-kill", res)
 
 
 if __name__ == "__main__":

@@ -17,9 +17,12 @@ deterministically —
    the post-warmup loss must have improved (the run converges despite
    the chaos).
 
-Run directly (``make chaos``) — prints a JSON verdict, exit code 0/1 —
-or through the ``@pytest.mark.slow`` wrapper in
-``tests/test_resilience.py`` with a longer schedule.
+Run directly (``make chaos``) — the verdict goes through the telemetry
+layer's normalized emitter (``telemetry.emit_verdict``: one field
+schema, one exit-code convention, optional JSONL log via
+``$DE_TPU_VERDICT_LOG``, exit code 0/1) — or through the
+``@pytest.mark.slow`` wrapper in ``tests/test_resilience.py`` with a
+longer schedule.
 """
 
 import json
@@ -183,6 +186,10 @@ def run_chaos(steps: int = 24, nan_every: int = 7, snapshot_every: int = 4,
 
 
 if __name__ == "__main__":
-  res = run_chaos()
-  print("CHAOS:", "PASS" if res["ok"] else "FAIL")
-  sys.exit(0 if res["ok"] else 1)
+  from distributed_embeddings_tpu.telemetry import emit_verdict
+
+  # the verdict record, the PASS/FAIL line, the optional JSONL log, and
+  # the exit-code semantics all come from the one telemetry emitter —
+  # chaos_kill.py emits through the same call, so the two cannot drift
+  res = run_chaos(verbose=False)
+  sys.exit(emit_verdict("chaos", res))

@@ -33,7 +33,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
@@ -54,6 +53,7 @@ from distributed_embeddings_tpu.dynvocab import (  # noqa: E402
     DynVocabTrainer,
     DynVocabTranslator,
 )
+from distributed_embeddings_tpu.telemetry import timed  # noqa: E402
 from distributed_embeddings_tpu.layers.embedding import TableConfig  # noqa: E402
 from distributed_embeddings_tpu.layers.planner import (  # noqa: E402
     DistEmbeddingStrategy,
@@ -187,20 +187,20 @@ def main():
 
   runs = {}
   for label, thr in (("admit_everything", 1), ("admission", args.threshold)):
-    t0 = time.monotonic()
-    _, model, mesh, rule, trainer = build_run(vocab_sizes, thr, ttl,
-                                              batch, seed=7)
-    for s in range(steps):
-      trainer.step(*stream(s))
-    # hot-head eval batch: raw ids every run admitted long ago
-    r = np.random.default_rng(99)
-    eval_cats = [r.integers(0, hot, batch).astype(np.int64)
-                 for _ in vocab_sizes]
-    eb = (r.standard_normal((batch, NUM_DENSE)).astype(np.float32),
-          eval_cats, r.integers(0, 2, batch).astype(np.float32))
-    loss = eval_loss((vocab_sizes,), model, mesh, rule, trainer, eb)
+    with timed(f"vocab/run/{label}") as tw:
+      _, model, mesh, rule, trainer = build_run(vocab_sizes, thr, ttl,
+                                                batch, seed=7)
+      for s in range(steps):
+        trainer.step(*stream(s))
+      # hot-head eval batch: raw ids every run admitted long ago
+      r = np.random.default_rng(99)
+      eval_cats = [r.integers(0, hot, batch).astype(np.int64)
+                   for _ in vocab_sizes]
+      eb = (r.standard_normal((batch, NUM_DENSE)).astype(np.float32),
+            eval_cats, r.integers(0, 2, batch).astype(np.float32))
+      loss = eval_loss((vocab_sizes,), model, mesh, rule, trainer, eb)
     runs[label] = {**totals_of(trainer), "eval_loss": loss,
-                   "wall_s": round(time.monotonic() - t0, 2)}
+                   "wall_s": round(tw.elapsed, 2)}
 
   a, b = runs["admit_everything"], runs["admission"]
   ratio = b["allocs"] / max(1, a["allocs"])
