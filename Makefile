@@ -7,7 +7,7 @@ SHELL := /bin/bash  # verify uses pipefail/PIPESTATUS
 
 .PHONY: test test-fast verify lint native bench dryrun chaos chaos-kill \
 	serve-bench serve-smoke vocab-bench vocab-smoke obs-bench obs-smoke \
-	clean
+	fresh-bench fresh-smoke clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -63,10 +63,24 @@ obs-smoke:
 	PYTHONPATH=$(CURDIR):$$PYTHONPATH timeout -k 10 300 \
 	  $(PY) tools/profile_telemetry.py --smoke
 
+# online-learning freshness bench: trainer publishes row-granular deltas
+# while a live subscriber+batcher serve concurrent traffic — measures
+# train-step->servable lag (stream/freshness_s), delta bytes vs the
+# full export, chain convergence, and delta-vs-reexport bit-exactness
+# (tools/profile_freshness.py; budget in docs/BENCHMARKS.md r11)
+fresh-bench:
+	PYTHONPATH=$(CURDIR):$$PYTHONPATH $(PY) tools/profile_freshness.py
+
+# the make-verify tier of the freshness bench: tiny world, same
+# structural assertions, timeout-guarded like the other smoke tiers
+fresh-smoke:
+	PYTHONPATH=$(CURDIR):$$PYTHONPATH timeout -k 10 300 \
+	  $(PY) tools/profile_freshness.py --smoke
+
 # the tier-1 gate, exactly as ROADMAP.md specifies it (CPU mesh, no slow
 # tests, collection errors surfaced but not fatal to the log); lint runs
 # first so invariant violations fail fast, then the smoke tiers
-verify: lint serve-smoke vocab-smoke obs-smoke
+verify: lint serve-smoke vocab-smoke obs-smoke fresh-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -726,6 +726,18 @@ def read_manifest(path: str) -> Dict[str, Any]:
     return json.load(f)
 
 
+def manifest_fingerprint(path: str) -> str:
+  """The identity of one published artifact: sha256 over its manifest
+  bytes. The manifest carries every data file's crc32+size, so this one
+  hash transitively pins the artifact's full content — it is what the
+  streaming delta chain links through (``base_fingerprint``): a delta
+  published against any OTHER predecessor state hashes differently and
+  is refused by construction."""
+  import hashlib
+  with open(os.path.join(path, "manifest.json"), "rb") as f:
+    return hashlib.sha256(f.read()).hexdigest()
+
+
 def publish_manifest_last(tmp: str, path: str,
                           manifest: Dict[str, Any]) -> None:
   """Durable publication tail shared by :func:`save` and

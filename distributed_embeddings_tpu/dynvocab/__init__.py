@@ -33,7 +33,7 @@ clamp or reject out-of-range ids, ``oov='allocate'`` ALLOCATES for them:
 
 from .admission import CountMinSketch
 from .lifecycle import RowRecycler, apply_zero_work, zero_rows_update
-from .table import IdTranslationTable
+from .table import IdTranslationTable, ReadonlyIdTranslator
 from .trainer import DynVocabTrainer, DynVocabTranslator
 
 __all__ = [
@@ -41,6 +41,7 @@ __all__ = [
     "DynVocabTrainer",
     "DynVocabTranslator",
     "IdTranslationTable",
+    "ReadonlyIdTranslator",
     "RowRecycler",
     "apply_zero_work",
     "zero_rows_update",

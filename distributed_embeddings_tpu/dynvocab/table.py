@@ -20,7 +20,7 @@ table regardless of how many tombstones the saving run had accumulated.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Any, Dict, List, Tuple
 
 import numpy as np
 
@@ -197,3 +197,96 @@ class IdTranslationTable:
     self._tombstones = 0
     for i, r in zip(ids.tolist(), rows.tolist()):
       self.insert(int(i), int(r))
+
+
+class ReadonlyIdTranslator:
+  """An immutable, serializable snapshot of a dynamic id space — the
+  SERVE-SIDE form of ``DynVocabTranslator.translate_readonly``.
+
+  The live translator is trainer state (admission sketch, freelist, TTL
+  stamps) that must never leave the training process; what serving needs
+  is only the pure raw-id -> row MAPPING at one instant, plus the plan's
+  input -> table wiring so request inputs route to the right table. This
+  class captures exactly that pair, round-trips through flat npz arrays
+  (it rides the serve artifact and every streaming delta — new ids
+  admitted by training become servable in the same delta cycle), and
+  translates with the identical semantics: unmapped ids emit ``PAD_ID``
+  (-1, the engine's hotness-padding sentinel — a row-less id contributes
+  a zero embedding), inputs of non-dynamic tables pass through.
+  """
+
+  def __init__(self, tables: Dict[int, IdTranslationTable],
+               input_table_map: List[int]):
+    self.tables = tables
+    self.input_table_map = [int(t) for t in input_table_map]
+
+  @classmethod
+  def from_translator(cls, translator) -> "ReadonlyIdTranslator":
+    """Snapshot a live ``DynVocabTranslator`` (mapping only — the
+    sketch / freelist / TTL state stays behind)."""
+    tables = {}
+    for t in translator.dynamic_tables:
+      ids, rows = translator.tables[t].items()
+      tab = IdTranslationTable(max(1, translator.tables[t].capacity))
+      tab.load_items(ids, rows)
+      tables[int(t)] = tab
+    return cls(tables, list(translator.plan.input_table_map))
+
+  # ---- the read path ------------------------------------------------------
+  def translate(self, inputs) -> list:
+    """Raw-id inputs -> translated int32 arrays (pure lookup; the id
+    space cannot change under a reader by construction — promotion
+    swaps the whole snapshot reference)."""
+    out = []
+    for i, x in enumerate(inputs):
+      t = self.input_table_map[i]
+      tab = self.tables.get(t)
+      if tab is None:
+        out.append(x)
+        continue
+      arr = np.asarray(x)
+      flat = arr.reshape(-1).astype(np.int64)
+      valid = flat >= 0
+      res = np.full(flat.shape, -1, np.int32)
+      res[valid] = tab.lookup(flat[valid])
+      out.append(res.reshape(arr.shape))
+    return out
+
+  def occupancy(self) -> Dict[int, int]:
+    return {t: len(tab) for t, tab in self.tables.items()}
+
+  # ---- serialization (rides serve artifacts and streaming deltas) --------
+  def state_arrays(self) -> Dict[str, np.ndarray]:
+    flat: Dict[str, np.ndarray] = {
+        "input_table_map": np.asarray(self.input_table_map, np.int64)}
+    for t, tab in sorted(self.tables.items()):
+      ids, rows = tab.items()
+      flat[f"t{t}/ids"] = ids
+      flat[f"t{t}/rows"] = rows
+      flat[f"t{t}/capacity"] = np.asarray([tab.capacity], np.int64)
+    return flat
+
+  def manifest_section(self) -> Dict[str, Any]:
+    """The artifact manifest's ``vocab_snapshot`` section (geometry +
+    occupancy — observability and a load-time cross-check)."""
+    return {
+        "tables": {str(t): {"capacity": tab.capacity,
+                            "occupancy": len(tab)}
+                   for t, tab in sorted(self.tables.items())},
+        "input_table_map": list(self.input_table_map),
+    }
+
+  @classmethod
+  def from_arrays(cls, flat: Dict[str, np.ndarray]) -> "ReadonlyIdTranslator":
+    tables: Dict[int, IdTranslationTable] = {}
+    for key in flat:
+      if not (key.startswith("t") and key.endswith("/ids")):
+        continue
+      t = int(key[1:].split("/", 1)[0])
+      cap = int(np.asarray(flat[f"t{t}/capacity"]).reshape(-1)[0])
+      tab = IdTranslationTable(max(1, cap))
+      tab.load_items(np.asarray(flat[f"t{t}/ids"], np.int64),
+                     np.asarray(flat[f"t{t}/rows"], np.int32))
+      tables[t] = tab
+    return cls(tables,
+               np.asarray(flat["input_table_map"], np.int64).tolist())

@@ -1193,3 +1193,58 @@ class DistEmbeddingStrategy:
           entries.append((rank, sh))
     entries.sort(key=lambda e: (e[1].col_start, e[1].row_start))
     return entries
+
+  def routing_recipe(self, key) -> List[List[Tuple[int, int, int, int,
+                                                   int, bool]]]:
+    """Host-side routing slots of one class, per rank: ``(input_id,
+    row_offset, row_start, shard_rows, vocab, row_sliced)``.
+
+    The numpy replica of the engine's in-trace id routing
+    (``lookup_engine._build_routing``): a raw id of ``input_id`` lands on
+    ``rank`` at logical row ``clip(id, 0, shard_rows - 1) + row_offset``
+    (row-sliced shards keep only ids in ``[row_start, row_start +
+    shard_rows)`` after the vocab clamp). One shared recipe so every
+    host-side pass that must agree with the traced step's row targeting
+    — the tiered prefetcher's classify, the streaming row-generation
+    tracker — derives it from the plan instead of hand-copying the
+    slot walk."""
+    cp = self.classes[key]
+    per_rank = []
+    for rank in range(self.world_size):
+      slots = []
+      for slot in cp.slots_per_rank[rank]:
+        sh = slot.shard
+        vocab = self.global_configs[sh.table_id].input_dim
+        slots.append((slot.input_id, slot.row_offset, sh.row_start,
+                      sh.input_dim, vocab, sh.row_sliced))
+      per_rank.append(slots)
+    return per_rank
+
+
+def routed_rows(slots, cats, ids_of):
+  """Apply one rank's :meth:`DistEmbeddingStrategy.routing_recipe` slots
+  to a batch: the LOGICAL rows this rank's block is addressed at, as one
+  concatenated int64 array (valid ids only — hotness padding dropped;
+  occurrences kept, for callers that count traffic).
+
+  ``ids_of(x)`` flattens one input to a 1-D id array — callers differ
+  only in their ragged-input policy (the tiered prefetcher refuses
+  RaggedIds, the streaming tracker reads the value stream), so the
+  routing arithmetic itself lives HERE, once: clip to the shard (or, row
+  -sliced, clamp to the vocab then keep the shard's window) and offset
+  into the rank block — exactly what the traced step's routing does."""
+  import numpy as np
+  routed_all = []
+  for (input_id, off, row_start, rows, vocab, rs) in slots:
+    ids = ids_of(cats[input_id])
+    if rs:
+      clamped = np.clip(ids, 0, vocab - 1)
+      m = (ids >= 0) & (clamped >= row_start) \
+          & (clamped < row_start + rows)
+      routed = clamped[m] - row_start + off
+    else:
+      routed = np.clip(ids[ids >= 0], 0, rows - 1) + off
+    routed_all.append(routed.astype(np.int64))
+  if not routed_all:
+    return np.zeros((0,), np.int64)
+  return np.concatenate(routed_all)

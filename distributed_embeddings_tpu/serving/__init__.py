@@ -29,10 +29,13 @@ from .batcher import MicroBatcher, Rejected
 from .engine import ServeEngine, ServeTierConfig, make_serve_step
 from .export import (
     ServeClassMeta,
+    dequantize_rows_fp8,
     dequantize_rows_int8,
     export,
     freeze,
     load,
+    quantize_rows,
+    quantize_rows_fp8,
     quantize_rows_int8,
     serve_layout,
 )
@@ -43,11 +46,14 @@ __all__ = [
     "ServeClassMeta",
     "ServeEngine",
     "ServeTierConfig",
+    "dequantize_rows_fp8",
     "dequantize_rows_int8",
     "export",
     "freeze",
     "load",
     "make_serve_step",
+    "quantize_rows",
+    "quantize_rows_fp8",
     "quantize_rows_int8",
     "serve_layout",
 ]

@@ -172,6 +172,15 @@ class MicroBatcher:
     """The classic counter view (now registry-backed)."""
     return {k: c.value for k, c in self._counters.items()}
 
+  def set_dispatch_fn(self, dispatch_fn: Callable) -> None:
+    """Swap the dispatch binding between flushes (the streaming
+    subscriber's rebase hook: re-point the batcher at a freshly loaded
+    engine without stopping either thread). ``_dispatch`` captures the
+    binding once per flush, so every flush runs entirely through one
+    binding — the swap can never split a batch across two engines."""
+    with self._lock:
+      self.dispatch_fn = dispatch_fn
+
   # ---- submission ---------------------------------------------------------
   def submit(self, numerical, cats: Sequence) -> ServeFuture:
     """Enqueue one request of ``n = numerical.shape[0]`` rows
@@ -273,10 +282,11 @@ class MicroBatcher:
       return numerical, cats
 
   def _dispatch(self, taken: List[_Pending], inline: bool = False):
+    dispatch_fn = self.dispatch_fn  # one binding per flush (see setter)
     try:
       numerical, cats = self._pad_batch(taken)
       with _span("serve/dispatch"):
-        out = self.dispatch_fn(numerical, cats)
+        out = dispatch_fn(numerical, cats)
       self._counters["batches"].inc()
     except BaseException as e:  # noqa: BLE001 — delivered per request
       for p in taken:

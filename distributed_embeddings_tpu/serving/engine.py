@@ -32,6 +32,7 @@ stripped (and possibly int8) and nothing is ever written back.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Any, Dict, Optional
 
 import jax
@@ -66,12 +67,13 @@ def _dequant_rows(rows: jax.Array, meta: ServeClassMeta) -> jax.Array:
   """Gathered serve rows -> f32 table rows.
 
   f32 images pass through (the gather already returned ``[..., width]``
-  f32 lanes). int8 images arrive ``[..., width + 4]``: the trailing 4
-  int8 lanes bitcast back to the row's f32 scale (the export packed it
-  there — no second gather), and the dequant is one fused widen+multiply
-  per row. Sentinel/OOB ids gathered all-zero rows whose scale bytes
-  decode to 0.0, so they stay exactly zero after the multiply."""
-  if meta.quantize != "int8":
+  f32 lanes). int8/fp8 images arrive ``[..., width + 4]``: the trailing
+  4 byte-wide lanes bitcast back to the row's f32 scale (the export
+  packed it there — no second gather), and the dequant is one fused
+  widen+multiply per row. Sentinel/OOB ids gathered all-zero rows whose
+  scale bytes decode to 0.0, so they stay exactly zero after the
+  multiply."""
+  if meta.quantize == "f32":
     return rows
   w = meta.width
   q = rows[..., :w]
@@ -425,16 +427,21 @@ class ServeEngine:
     self.with_metrics = with_metrics
     self.donate_batch = donate_batch
     self._steps: Dict[Any, Any] = {}
+    # The promote point (streaming deltas): dispatch holds this lock for
+    # the brief host-side dispatch window, and a DeltaSubscriber holds
+    # it while SWAPPING the serve state references — so a swap lands
+    # between dispatches, never inside one. Re-entrant so a wrapper
+    # (translate-then-dispatch) can hold it across both.
+    self.lock = threading.RLock()
 
     self.tplan: Optional[ServeTierPlan] = None
     self.prefetcher = None
     if host_images:
       from ..tiering import HostTierStore, TieredPrefetcher
+      from .export import np_dtype_of
       self.tplan = ServeTierPlan(plan, self.meta,
                                  tier_config or ServeTierConfig())
-      store = HostTierStore(
-          self.tplan,
-          dtype=np.int8 if self.quantize == "int8" else np.float32)
+      store = HostTierStore(self.tplan, dtype=np_dtype_of(self.quantize))
       for name, images in host_images.items():
         for r, img in enumerate(images):
           store.set_image(name, r, img)
@@ -468,16 +475,23 @@ class ServeEngine:
     """One device dispatch; returns device predictions WITHOUT blocking
     (jax async dispatch — the next dispatch's classify/stage overlaps
     this one's device work). With ``with_metrics`` on a tiered plan,
-    returns ``(preds, metrics)``."""
-    cats = tuple(np.asarray(c) for c in cats)
-    numerical = np.asarray(numerical)
-    staged = self.prefetcher.prepare(list(cats)) if self.tiered else None
-    step = self._step_for((numerical, cats),
-                          staged.s_eff if staged else None)
-    bt = shard_batch((numerical, cats), self.mesh, self.axis_name)
-    if staged is not None:
-      return step(self.state, staged.device, *bt)
-    return step(self.state, *bt)
+    returns ``(preds, metrics)``.
+
+    Runs under :attr:`lock`: a concurrent delta promotion swaps the
+    serve state references only between dispatches, so one dispatch
+    always sees one consistent (images, resident maps, buffers)
+    snapshot — the in-flight device work itself holds references to the
+    old arrays and is never disturbed."""
+    with self.lock:
+      cats = tuple(np.asarray(c) for c in cats)
+      numerical = np.asarray(numerical)
+      staged = self.prefetcher.prepare(list(cats)) if self.tiered else None
+      step = self._step_for((numerical, cats),
+                            staged.s_eff if staged else None)
+      bt = shard_batch((numerical, cats), self.mesh, self.axis_name)
+      if staged is not None:
+        return step(self.state, staged.device, *bt)
+      return step(self.state, *bt)
 
   def predict(self, numerical, cats):
     """Blocking convenience wrapper: numpy predictions."""

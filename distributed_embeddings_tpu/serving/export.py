@@ -18,6 +18,16 @@ hold. :func:`freeze` strips them into a contiguous **inference image**:
   gather dequantizes in one fused multiply with no second lookup. The
   per-row dequantization error is bounded by ``scale / 2 =
   max|row| / 254 < 2^-7 * max|row|``.
+- **fp8**: the wire format (`float8_e4m3fn`) as ROW STORAGE — same
+  bytes-per-row as int8 (``width`` single-byte lanes + the f32 scale in
+  4 trailing fp8 lanes), but the rounding grid is logarithmic: rows are
+  scaled so ``max|row|`` maps to the largest finite e4m3 value (448)
+  and cast, so small-magnitude elements keep ~2 significant digits
+  where int8's uniform grid flushes them toward zero. The per-element
+  error is bounded by ``2^-4 * max|row|`` (3 mantissa bits), looser at
+  the top of the range than int8's ``2^-7 * max|row|`` — which of the
+  two serves a given model better is a real-TPU pricing question
+  (ROADMAP); both ride the same gather + fused-dequant path.
 
 Both forms ride :class:`~..ops.packed_table.PackedLayout` (its pack /
 gather arithmetic is dtype-agnostic — for int8 the "lanes" are bytes),
@@ -75,12 +85,32 @@ from ..resilience import faultinject
 
 SERVE_FORMAT_VERSION = 1
 
-# trailing int8 lanes per logical row carrying the row's f32 scale
-# (4 bytes bitcast into 4 single-byte lanes — the fp8 wire's trick at
-# row granularity)
+# trailing single-byte lanes per logical row carrying the row's f32
+# scale (4 bytes bitcast into 4 byte-wide lanes — the fp8 wire's trick
+# at row granularity; int8 and fp8 rows pack it identically)
 INT8_SCALE_LANES = 4
 
-QUANTIZE_MODES = ("f32", "int8")
+QUANTIZE_MODES = ("f32", "int8", "fp8")
+
+# largest finite float8_e4m3fn value — fp8 rows are scaled so the row's
+# amax lands exactly here (the same normalization as the fp8 wire's
+# per-block scale, parallel/wire.py)
+FP8_MAX = 448.0
+
+
+def fp8_dtype() -> np.dtype:
+  """The float8_e4m3fn numpy dtype (via ml_dtypes, jax's own dep)."""
+  import ml_dtypes
+  return np.dtype(ml_dtypes.float8_e4m3fn)
+
+
+def np_dtype_of(quantize: str) -> np.dtype:
+  """Element dtype of a serve image under one quantize mode."""
+  if quantize == "int8":
+    return np.dtype(np.int8)
+  if quantize == "fp8":
+    return fp8_dtype()
+  return np.dtype(np.float32)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -102,8 +132,9 @@ class ServeClassMeta:
 
   @property
   def lanes(self) -> int:
-    """int8 lanes (bytes) or f32 lanes per stored logical row."""
-    return self.width + (INT8_SCALE_LANES if self.quantize == "int8" else 0)
+    """byte lanes (int8/fp8) or f32 lanes per stored logical row."""
+    return self.width + (INT8_SCALE_LANES
+                         if self.quantize in ("int8", "fp8") else 0)
 
   @property
   def packed(self) -> PackedLayout:
@@ -112,7 +143,18 @@ class ServeClassMeta:
 
   @property
   def np_dtype(self):
-    return np.int8 if self.quantize == "int8" else np.float32
+    return np_dtype_of(self.quantize)
+
+  def to_disk(self, arr: np.ndarray) -> np.ndarray:
+    """On-disk byte view: fp8 arrays persist viewed as int8 (np.load
+    round-trips ml_dtypes as an opaque void dtype otherwise)."""
+    return arr.view(np.int8) if self.quantize == "fp8" else arr
+
+  def from_disk(self, arr: np.ndarray) -> np.ndarray:
+    """Inverse of :meth:`to_disk` (also re-types the void-dtype form)."""
+    if self.quantize == "fp8":
+      return np.asarray(arr).view(fp8_dtype())
+    return arr
 
   def to_json(self) -> Dict[str, Any]:
     lay = self.packed
@@ -164,6 +206,42 @@ def dequantize_rows_int8(qrows: np.ndarray) -> np.ndarray:
   return q * scale[:, None]
 
 
+def quantize_rows_fp8(table: np.ndarray) -> np.ndarray:
+  """``[N, w]`` f32 rows -> ``[N, w + 4]`` fp8 rows-with-scale.
+
+  Per-row amax scaling onto the e4m3 grid: ``scale = max|row| / 448``
+  (1.0 for all-zero rows), elements cast to ``float8_e4m3fn`` after the
+  divide — the row's amax lands exactly on the largest finite value, so
+  nothing saturates — and the f32 scale bitcast into the 4 trailing fp8
+  lanes. ``|row - deq| <= 2^-4 * max|row|`` per element (3 mantissa
+  bits; the fp8 wire's bound at row granularity)."""
+  f8 = fp8_dtype()
+  table = np.asarray(table, np.float32)
+  amax = np.max(np.abs(table), axis=1)
+  scale = np.where(amax > 0, amax / FP8_MAX, 1.0).astype(np.float32)
+  q = (table / scale[:, None]).astype(f8)
+  lanes = scale.view(np.uint8).reshape(-1, INT8_SCALE_LANES).view(f8)
+  return np.concatenate([q, lanes], axis=1)
+
+
+def dequantize_rows_fp8(qrows: np.ndarray) -> np.ndarray:
+  """Inverse of :func:`quantize_rows_fp8` (host-side form)."""
+  q = qrows[:, :-INT8_SCALE_LANES].astype(np.float32)
+  scale = np.ascontiguousarray(
+      qrows[:, -INT8_SCALE_LANES:]).view(np.uint8).view(
+          np.float32).reshape(-1)
+  return q * scale[:, None]
+
+
+def quantize_rows(table: np.ndarray, quantize: str) -> np.ndarray:
+  """Dispatch one mode's row codec (f32 passes through)."""
+  if quantize == "int8":
+    return quantize_rows_int8(table)
+  if quantize == "fp8":
+    return quantize_rows_fp8(table)
+  return np.ascontiguousarray(table, np.float32)
+
+
 # ---------------------------------------------------------------------------
 # freeze: train state -> host-side inference blocks
 # ---------------------------------------------------------------------------
@@ -189,8 +267,8 @@ def _strip_block(train_lay: PackedLayout, meta: ServeClassMeta,
   reshape — the aux lanes fall away), optionally quantize, re-pack into
   the denser serve layout."""
   tbl, _aux = train_lay.unpack(np.asarray(block))
-  tbl = np.ascontiguousarray(tbl, np.float32)
-  rows = quantize_rows_int8(tbl) if meta.quantize == "int8" else tbl
+  rows = quantize_rows(np.ascontiguousarray(tbl, np.float32),
+                       meta.quantize)
   return np.asarray(meta.packed.pack(rows), meta.np_dtype)
 
 
@@ -214,6 +292,37 @@ def _serve_ranking(meta: ServeClassMeta, train_lay: PackedLayout,
 def _to_host_tree(tree):
   from ..checkpoint import _to_host
   return jax.tree_util.tree_map(_to_host, tree)
+
+
+def serve_class_meta(plan: DistEmbeddingStrategy, rule: SparseRule,
+                     quantize: str, tiered_names=frozenset()):
+  """Per sparse class: its :class:`ServeClassMeta` and the
+  full-vocabulary TRAIN layout its rows strip from.
+
+  The ONE place serve geometry is derived from a plan — :func:`freeze`
+  (full export) and the streaming ``DeltaPublisher`` both consume this,
+  which is what guarantees a delta row and a full re-export of the same
+  logical row are byte-identical."""
+  meta: Dict[str, ServeClassMeta] = {}
+  full_lays: Dict[str, PackedLayout] = {}
+  for key in plan.class_keys:
+    cp = plan.classes[key]
+    if cp.kind != "sparse":
+      continue
+    name = class_param_name(*key)
+    rows = padded_rows(plan, key)
+    # the full-vocabulary train layout: for tiered classes the device
+    # buffer is compact, but the stripped image covers the whole class
+    # (the host image is the authoritative copy)
+    full_lay = PackedLayout(rows=rows, width=cp.width, n_aux=rule.n_aux)
+    full_lays[name] = full_lay
+    meta[name] = ServeClassMeta(
+        name=name, rows=rows, width=cp.width,
+        tier="host" if name in tiered_names else "device",
+        quantize=quantize,
+        combine_rpp=(full_lay.rows_per_phys
+                     if rule.n_aux and full_lay.rows_per_phys > 1 else 1))
+  return meta, full_lays
 
 
 def freeze(plan: DistEmbeddingStrategy, rule: SparseRule,
@@ -254,27 +363,13 @@ def freeze(plan: DistEmbeddingStrategy, rule: SparseRule,
           "store cannot supply every rank's image here.")
     store.flush(state["fused"])
 
-  meta: Dict[str, ServeClassMeta] = {}
+  meta, full_lays = serve_class_meta(plan, rule, quantize, tiered_names)
   device_blocks: Dict[str, List[np.ndarray]] = {}
   host_images: Dict[str, List[np.ndarray]] = {}
   ranking: Dict[str, List[np.ndarray]] = {}
-  for key in plan.class_keys:
-    cp = plan.classes[key]
-    if cp.kind != "sparse":
-      continue
-    name = class_param_name(*key)
-    rows = padded_rows(plan, key)
-    tier = "host" if name in tiered_names else "device"
-    # the full-vocabulary train layout: for tiered classes the device
-    # buffer is compact, but the stripped image covers the whole class
-    # (the host image is the authoritative copy)
-    full_lay = PackedLayout(rows=rows, width=cp.width, n_aux=rule.n_aux)
-    m = ServeClassMeta(
-        name=name, rows=rows, width=cp.width, tier=tier, quantize=quantize,
-        combine_rpp=(full_lay.rows_per_phys
-                     if rule.n_aux and full_lay.rows_per_phys > 1 else 1))
-    meta[name] = m
-    if tier == "host":
+  for name, m in meta.items():
+    full_lay = full_lays[name]
+    if m.tier == "host":
       host_images[name] = [
           _strip_block(full_lay, m, store.images[name][r])
           for r in range(plan.world_size)]
@@ -334,9 +429,20 @@ def frozen_device_state(frozen: FrozenTables, plan: DistEmbeddingStrategy,
 # ---------------------------------------------------------------------------
 
 
+def vocab_snapshot(vocab):
+  """Normalize a ``vocab`` argument to the serializable read-only form:
+  a live ``dynvocab.DynVocabTranslator`` is snapshotted (mapping only),
+  a ``ReadonlyIdTranslator`` passes through."""
+  from ..dynvocab import ReadonlyIdTranslator
+  if vocab is None or isinstance(vocab, ReadonlyIdTranslator):
+    return vocab
+  return ReadonlyIdTranslator.from_translator(vocab)
+
+
 def export(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
            state: Dict[str, Any], quantize: str = "f32", store=None,
-           extra: Optional[Dict[str, Any]] = None) -> FrozenTables:
+           extra: Optional[Dict[str, Any]] = None,
+           vocab=None) -> FrozenTables:
   """Freeze the train state and write the serve artifact at ``path``.
 
   Rides the checkpoint durability protocol: every file fsynced, per-file
@@ -345,13 +451,22 @@ def export(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
   any point leaves either a manifest-less ``.tmp`` (detectably
   incomplete) or a complete artifact; ``checkpoint.verify`` validates a
   published one. Returns the frozen blocks (callers that serve from the
-  exporting process can skip the read-back)."""
+  exporting process can skip the read-back).
+
+  ``vocab``: for dynamic-vocabulary (``oov='allocate'``) trainers, the
+  run's ``DynVocabTranslator`` (or an already-taken
+  ``ReadonlyIdTranslator`` snapshot). The read-only raw-id -> row
+  mapping rides the artifact as ``vocab_snapshot.npz`` + a
+  ``vocab_snapshot`` manifest section, making the serve artifact
+  SELF-CONTAINED: the serving process translates request ids against
+  the exact id space the exported rows were trained under."""
   if jax.process_count() > 1:
     raise NotImplementedError(
         "export is a single-controller operation: the serving pods load "
         "the artifact read-only. Save a checkpoint from the "
         "multi-controller run and export from a single-controller "
         "restore.")
+  snap = vocab_snapshot(vocab)
   frozen = freeze(plan, rule, state, quantize=quantize, store=store)
 
   tmp = path + ".tmp"
@@ -369,12 +484,12 @@ def export(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
   for name, blocks in sorted(frozen.device_blocks.items()):
     for r, block in enumerate(blocks):
       fpath = os.path.join(tmp, f"serve_{name}_r{r}.npy")
-      np.save(fpath, block)
+      np.save(fpath, frozen.meta[name].to_disk(block))
       _seal(fpath)
   for name, images in sorted(frozen.host_images.items()):
     for r, image in enumerate(images):
       fpath = os.path.join(tmp, f"serve_cold_{name}_r{r}.npy")
-      np.save(fpath, image)
+      np.save(fpath, frozen.meta[name].to_disk(image))
       _seal(fpath)
   if frozen.ranking:
     fpath = os.path.join(tmp, "serve_ranking.npz")
@@ -386,6 +501,10 @@ def export(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
                      ("emb_dense", frozen.emb_dense)):
     fpath = os.path.join(tmp, f"{part}.npz")
     np.savez(fpath, **_flatten_with_paths(tree))
+    _seal(fpath)
+  if snap is not None:
+    fpath = os.path.join(tmp, "vocab_snapshot.npz")
+    np.savez(fpath, **snap.state_arrays())
     _seal(fpath)
 
   manifest: Dict[str, Any] = {
@@ -400,6 +519,8 @@ def export(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
       },
       "checksums": checksums,
   }
+  if snap is not None:
+    manifest["vocab_snapshot"] = snap.manifest_section()
   if extra is not None:
     manifest["extra"] = extra
   publish_manifest_last(tmp, path, manifest)
@@ -414,7 +535,9 @@ class ServeArtifact:
   device-tier classes' inference buffers in ``'serve'``; host-tier
   classes appear in ``host_images``/``ranking`` instead and become the
   serve cache + cold store when a :class:`~.engine.ServeEngine` is built
-  on this artifact."""
+  on this artifact. ``vocab`` is the exported
+  ``dynvocab.ReadonlyIdTranslator`` snapshot (None for static-vocab
+  artifacts) — translate request raw ids through it before dispatch."""
 
   quantize: str
   step: int
@@ -422,6 +545,7 @@ class ServeArtifact:
   state: Dict[str, Any]
   host_images: Dict[str, List[np.ndarray]]
   ranking: Dict[str, List[np.ndarray]]
+  vocab: Any = None
 
 
 def _unflatten_paths(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
@@ -488,7 +612,8 @@ def load(path: str, plan: DistEmbeddingStrategy, mesh=None,
     lay = m.packed
     if m.tier == "host":
       host_images[name] = [
-          np.load(os.path.join(path, f"serve_cold_{name}_r{r}.npy"))
+          m.from_disk(np.load(os.path.join(path,
+                                           f"serve_cold_{name}_r{r}.npy")))
           for r in range(world)]
       ranking[name] = [rank_npz[f"{name}/r{r}"] for r in range(world)]
       continue
@@ -497,14 +622,14 @@ def load(path: str, plan: DistEmbeddingStrategy, mesh=None,
     shape = (world * lay.phys_rows, lay.phys_width)
     if mesh is None:
       serve[name] = jnp.asarray(np.concatenate(
-          [np.load(f) for f in files]))
+          [m.from_disk(np.load(f)) for f in files]))
     else:
       sharding = NamedSharding(mesh, P(axis_name, None))
 
-      def cb(index, files=files, lay=lay):
+      def cb(index, files=files, lay=lay, m=m):
         rank = (index[0].start or 0) // lay.phys_rows
         # mmap: each device materializes exactly its rank block
-        return np.asarray(np.load(files[rank], mmap_mode="r"))
+        return m.from_disk(np.asarray(np.load(files[rank], mmap_mode="r")))
 
       serve[name] = jax.make_array_from_callback(shape, sharding, cb)
 
@@ -517,7 +642,14 @@ def load(path: str, plan: DistEmbeddingStrategy, mesh=None,
       dense = placed
     else:
       emb_dense = placed
+  vocab = None
+  if manifest.get("vocab_snapshot") is not None:
+    from ..dynvocab import ReadonlyIdTranslator
+    with np.load(os.path.join(path, "vocab_snapshot.npz")) as z:
+      vocab = ReadonlyIdTranslator.from_arrays(
+          {k: np.asarray(v) for k, v in z.items()})
   state = {"dense": dense, "emb_dense": emb_dense, "serve": serve}
   return ServeArtifact(quantize=manifest["serve"]["quantize"],
                        step=int(manifest["step"]), meta=meta, state=state,
-                       host_images=host_images, ranking=ranking)
+                       host_images=host_images, ranking=ranking,
+                       vocab=vocab)

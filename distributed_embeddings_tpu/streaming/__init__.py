@@ -1,0 +1,56 @@
+"""Online learning: row-granular streaming from trainer to serving.
+
+A production recommender retrains continuously; its train -> serve
+freshness lag is a product metric (the Google Ads training-infra loop —
+PAPERS.md). Before this package the only bridge was the full
+frozen-table re-export: model freshness gated on re-publishing every
+row. This package streams instead, built entirely on substrates the
+repo already had:
+
+- :mod:`.generations` — :class:`RowGenerationTracker`: the sparse
+  backward updates exactly the routed rows, and routing is a pure host
+  computation (``plan.routing_recipe``), so stamping each observed
+  batch's routed logical rows with a monotone clock identifies the
+  precise row set a delta must ship;
+- :mod:`.publish` — :class:`DeltaPublisher`: window-wise extraction of
+  the advanced rows from the packed rank blocks (the elastic re-shard's
+  streaming discipline), quantized with the frozen-table row codecs
+  (f32/int8/fp8), sealed as ``delta_<seq>/`` through the
+  crc32-manifest-last protocol with a sha256-chained
+  ``base_fingerprint`` per delta — torn, out-of-order, or forked deltas
+  are refused by construction;
+- :mod:`.subscribe` — :class:`DeltaSubscriber`: polls the publish
+  directory, validates the chain, and folds deltas into a running
+  ``ServeEngine`` via copy-on-promote (build off-thread, swap the
+  reference between micro-batcher flushes — traffic never pauses),
+  re-ranks the tiered serve cache with the publisher-shipped observed
+  counts, promotes the dynvocab read-only snapshot (ids admitted by
+  training become servable in the same delta cycle), and measures the
+  end-to-end ``stream/freshness_s`` lag.
+
+``tools/profile_freshness.py`` (``make fresh-bench``) prices the loop
+under concurrent serve load; ARCHITECTURE.md §19 documents the delta
+format and the chaining/promotion protocols.
+"""
+
+from .generations import RowGenerationTracker
+from .publish import (
+    BASE_DIR,
+    DeltaPublisher,
+    artifact_bytes,
+    delta_dirname,
+    extract_changed_rows,
+    published_delta_seqs,
+)
+from .subscribe import DeltaSubscriber
+
+__all__ = [
+    "BASE_DIR",
+    "DeltaPublisher",
+    "DeltaSubscriber",
+    "RowGenerationTracker",
+    "artifact_bytes",
+    "delta_dirname",
+    "extract_changed_rows",
+    "published_delta_seqs",
+]

@@ -1,0 +1,143 @@
+"""Per-logical-row generation accounting for incremental publication.
+
+The sparse train step's backward is ONE scatter-add per fused class over
+exactly the rows the batch routed (`ops/packed_table.scatter_add_fused`)
+— an un-routed row's table and optimizer lanes are bit-identical before
+and after the step. Which logical rows a batch routes is a pure host
+computation over the raw ids (the plan's ``routing_recipe``, the same
+numpy replica of the traced routing the tiered prefetcher classifies
+with). :class:`RowGenerationTracker` exploits both facts: observe each
+global batch BETWEEN steps (the prefetcher/translator pattern), stamp
+every routed logical row with a monotone clock, and the set of rows
+whose stamp advanced past a publication watermark is EXACTLY the set a
+delta export must ship — everything else is provably unchanged since the
+last publish, whatever the step's knobs (dedup, wire dtype, overlap,
+micro-batching; a guard-skipped step leaves rows unchanged, which makes
+the stamp a harmless superset).
+
+The tracker also accumulates per-row observed counts (occurrences, not
+dedup presence — the re-rank signal, same convention as the prefetcher),
+which the delta publisher ships so a tiered SERVING process can re-rank
+its hot cache against training-time traffic, and wall-clock stamps of
+the oldest/newest unpublished observation — the anchors of the
+train-step -> servable freshness measurement.
+
+Dense-kind (MXU) classes and the model's dense params update every step
+and are small by definition; the publisher ships them wholesale per
+delta, so the tracker covers sparse-kind classes only.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..layers.planner import DistEmbeddingStrategy, routed_rows
+from ..parallel.lookup_engine import class_param_name, padded_rows
+
+
+class RowGenerationTracker:
+  """Logical-row update stamps + observed counts for one train run.
+
+  Per sparse class ``name`` and rank ``r``:
+
+  - ``gen[name][r]``: int64 ``[rows]`` — the clock value at which each
+    logical row of that rank block was last routed (0 = never);
+  - ``counts[name][r]``: int64 ``[rows]`` — cumulative routed
+    occurrences (the serve-cache re-rank signal).
+
+  ``clock`` advances once per observed batch. The tracker must see every
+  batch the step trains, translated exactly as the step sees it (for
+  ``oov='allocate'`` runs: AFTER ``DynVocabTranslator.translate_batch``,
+  so stamps land on the allocated rows). Observation is host-side and
+  single-writer by contract — call it from the training loop, between
+  steps, like the tiered classify.
+  """
+
+  def __init__(self, plan: DistEmbeddingStrategy, rule=None):
+    del rule  # geometry is logical-row-shaped; kept for call symmetry
+    self.plan = plan
+    self.clock = 0
+    self.gen: Dict[str, List[np.ndarray]] = {}
+    self.counts: Dict[str, List[np.ndarray]] = {}
+    self._recipe: Dict[str, list] = {}
+    self._rows: Dict[str, int] = {}
+    for key in plan.class_keys:
+      cp = plan.classes[key]
+      if cp.kind != "sparse":
+        continue
+      name = class_param_name(*key)
+      rows = padded_rows(plan, key)
+      self._rows[name] = rows
+      self._recipe[name] = plan.routing_recipe(key)
+      self.gen[name] = [np.zeros((rows,), np.int64)
+                        for _ in range(plan.world_size)]
+      self.counts[name] = [np.zeros((rows,), np.int64)
+                           for _ in range(plan.world_size)]
+    if not self.gen:
+      raise ValueError(
+          "plan has no sparse-kind classes: every table rides the "
+          "MXU-dense path, which the publisher ships wholesale — there "
+          "are no row-granular deltas to track. Lower "
+          "dense_row_threshold, or publish full exports.")
+    # freshness anchors: wall time of the oldest and newest observation
+    # not yet covered by a publish (reset by the publisher)
+    self.oldest_unpublished_wall: Optional[float] = None
+    self.newest_wall: Optional[float] = None
+
+  @staticmethod
+  def _input_ids_np(x) -> np.ndarray:
+    from ..ops.ragged import RaggedIds
+    if isinstance(x, RaggedIds):
+      # the value stream IS the id stream (splits only group it)
+      return np.asarray(x.values).reshape(-1)
+    return np.asarray(x).reshape(-1)
+
+  def observe(self, cats: Sequence) -> int:
+    """Stamp one GLOBAL batch's routed rows; returns the new clock."""
+    if len(cats) != self.plan.num_inputs:
+      raise ValueError(
+          f"expected {self.plan.num_inputs} inputs, got {len(cats)}")
+    self.clock += 1
+    now = time.time()
+    if self.oldest_unpublished_wall is None:
+      self.oldest_unpublished_wall = now
+    self.newest_wall = now
+    for name, per_rank in self._recipe.items():
+      rows_n = self._rows[name]
+      for rank, slots in enumerate(per_rank):
+        flat = routed_rows(slots, cats, self._input_ids_np)
+        if not flat.size:
+          continue
+        # one sort serves both outputs (the prefetcher's trick): dedup
+        # for the stamps, occurrence counts for the re-rank signal
+        u, occ = np.unique(flat, return_counts=True)
+        if u[0] < 0 or u[-1] >= rows_n:
+          bad = int(u[0] if u[0] < 0 else u[-1])
+          raise IndexError(
+              f"class {name!r} rank {rank}: routed logical row {bad} "
+              f"outside [0, {rows_n}) — routing arithmetic diverged "
+              "from the plan (corrupt id stream or a recipe bug).")
+        self.gen[name][rank][u] = self.clock
+        self.counts[name][rank][u] += occ
+    return self.clock
+
+  def changed_rows(self, watermark: int) -> Dict[str, List[np.ndarray]]:
+    """Per class, per rank: the SORTED logical rows whose generation
+    advanced past ``watermark`` — the delta's exact row set."""
+    out: Dict[str, List[np.ndarray]] = {}
+    for name, per_rank in self.gen.items():
+      out[name] = [np.where(g > watermark)[0].astype(np.int64)
+                   for g in per_rank]
+    return out
+
+  def changed_row_total(self, watermark: int) -> int:
+    return sum(int(np.sum(g > watermark))
+               for per_rank in self.gen.values() for g in per_rank)
+
+  def mark_published(self) -> None:
+    """Reset the freshness anchor (every observation so far is now
+    covered by a publish)."""
+    self.oldest_unpublished_wall = None

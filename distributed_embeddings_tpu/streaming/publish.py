@@ -1,0 +1,388 @@
+"""Incremental (delta) publication of a live train state to serving.
+
+The full frozen-table export (:mod:`..serving.export`) re-publishes
+every row; a continuously-retraining recommender changes a tiny,
+traffic-shaped fraction of its rows between publishes, and its
+train -> serve freshness lag is a first-class product metric. The
+:class:`DeltaPublisher` closes that gap: given the run's
+:class:`~.generations.RowGenerationTracker`, each ``publish_delta``
+extracts ONLY the logical rows whose generation advanced past the last
+publication watermark — window-wise over the packed rank blocks, the
+elastic re-shard's streaming discipline, so peak memory is one window
+of one rank block — quantizes them with the frozen-table row codecs
+(f32 / int8 / fp8), and seals them as ``delta_<seq>/`` through the
+checkpoint layer's crc32-manifest-last durable protocol.
+
+Chain rule (torn / out-of-order / forked deltas are refused by
+construction on the serve side): every published artifact is identified
+by ``checkpoint.manifest_fingerprint`` (sha256 of its manifest, which
+carries every data file's crc32+size), and delta ``seq`` records the
+fingerprint of its predecessor (``base_fingerprint`` — delta ``1``
+links the base export, delta ``k`` links delta ``k-1``). A subscriber
+therefore applies a delta only when (a) its directory verifies against
+its own manifest, (b) its ``seq`` is exactly the next in line, and (c)
+its ``base_fingerprint`` matches the artifact the subscriber last
+applied — any publisher restart, reordering, or corruption breaks the
+chain VISIBLY instead of serving a frankenstate.
+
+Delta contents, per ``delta_<seq>/``:
+
+    manifest.json                  seq, chained base_fingerprint, plan
+                                   fingerprint, serve geometry, stream
+                                   section (row counts per class/rank),
+                                   freshness wall anchors, checksums
+    rows_<class>_r<rank>.npz       {'idx': int64 changed logical rows,
+                                    'data': [n, lanes] serve-layout rows}
+    counts_<class>.npz             per-rank per-serve-physical-row
+                                   observed counts (host-tier classes —
+                                   the serve cache re-rank signal)
+    dense.npz / emb_dense.npz      model params + MXU-dense tables
+                                   (small by definition; shipped whole)
+    vocab_snapshot.npz             the read-only dynvocab mapping
+                                   (``oov='allocate'`` runs) — ids
+                                   admitted by training become servable
+                                   in the same delta cycle
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..checkpoint import (
+    _crc32_file,
+    _flatten_with_paths,
+    _fsync_path,
+    _plan_fingerprint,
+    _to_host,
+    manifest_fingerprint,
+    publish_manifest_last,
+    read_manifest,
+)
+from ..layers.planner import DistEmbeddingStrategy
+from ..ops.packed_table import PackedLayout, SparseRule
+from ..parallel.lookup_engine import DistributedLookup
+from ..resilience import faultinject
+from ..serving.export import (
+    QUANTIZE_MODES,
+    quantize_rows,
+    serve_class_meta,
+    vocab_snapshot,
+)
+from ..serving.export import export as full_export
+from ..telemetry import get_registry as _registry, span as _span
+from .generations import RowGenerationTracker
+
+DELTA_FORMAT_VERSION = 1
+BASE_DIR = "base"
+_DELTA_RE = re.compile(r"^delta_(\d{6})$")
+
+# fired once per contiguous physical-row window an extract reads — the
+# streaming counterpart of the elastic re-shard's ``reshard_gather``
+DELTA_EXTRACT_SITE = faultinject.register_site("delta_extract")
+
+
+def delta_dirname(seq: int) -> str:
+  return f"delta_{seq:06d}"
+
+
+def published_delta_seqs(path: str) -> List[int]:
+  """Seq numbers of the PUBLISHED deltas under ``path`` (ignores
+  ``.tmp`` / ``.old`` and anything without a manifest)."""
+  out = []
+  try:
+    names = os.listdir(path)
+  except OSError:
+    return out
+  for name in names:
+    m = _DELTA_RE.match(name)
+    if m and os.path.isfile(os.path.join(path, name, "manifest.json")):
+      out.append(int(m.group(1)))
+  return sorted(out)
+
+
+def artifact_bytes(path: str) -> int:
+  """Total payload bytes of one published artifact (from its manifest's
+  checksum table — no filesystem walk)."""
+  return sum(int(v["size"])
+             for v in read_manifest(path).get("checksums", {}).values())
+
+
+def extract_changed_rows(lay: PackedLayout, reader, changed: np.ndarray,
+                         merge_gap: int = 8) -> np.ndarray:
+  """Changed LOGICAL rows of one packed rank block, window-wise.
+
+  ``reader(p0, p1)`` returns physical rows ``[p0, p1)`` of the block
+  (``[p1 - p0, phys_width]``); ``changed`` is the sorted logical-row
+  set. Contiguous physical-row runs are read as one window (runs closer
+  than ``merge_gap`` physical rows merge — fewer reads beat the few
+  discarded rows), unpacked (a pure reshape), and the changed rows'
+  TABLE lanes selected — so peak memory is one window, never the block.
+  Returns ``[len(changed), width]`` f32."""
+  if not changed.size:
+    return np.zeros((0, lay.width), np.float32)
+  rpp = lay.rows_per_phys
+  pg = np.unique(changed // rpp)
+  cuts = np.where(np.diff(pg) > merge_gap)[0] + 1
+  out = np.empty((changed.size, lay.width), np.float32)
+  done = 0
+  for run in np.split(pg, cuts):
+    p0, p1 = int(run[0]), int(run[-1]) + 1
+    faultinject.fire("delta_extract", rows=(p1 - p0) * rpp)
+    sub = np.asarray(reader(p0, p1))
+    sublay = PackedLayout(rows=(p1 - p0) * rpp, width=lay.width,
+                         n_aux=lay.n_aux)
+    tbl, _aux = sublay.unpack(sub)
+    sel = changed[(changed >= p0 * rpp) & (changed < p1 * rpp)]
+    out[done:done + sel.size] = np.asarray(tbl, np.float32)[sel - p0 * rpp]
+    done += sel.size
+  assert done == changed.size
+  return out
+
+
+class DeltaPublisher:
+  """Trainer-side half of the streaming pipeline.
+
+  Owns the publish directory's chain state (seq, predecessor
+  fingerprint, generation watermark). Protocol::
+
+      tracker = RowGenerationTracker(plan)
+      pub = DeltaPublisher(pubdir, plan, rule, tracker,
+                           quantize="int8", store=store, vocab=translator)
+      ...
+      pub.observe_batch(cats)      # every batch, translated as the step
+      state = step(state, *batch)  # sees it — between steps, host-side
+      ...
+      pub.publish_base(state)      # once: the full export the chain roots at
+      ...
+      pub.publish_delta(state)     # any time later: only advanced rows
+
+  A failed publish (crash, injected fault) leaves a manifest-less
+  ``.tmp`` the subscriber never reads; the chain state only advances on
+  success, so the retry re-publishes the SAME seq and the subscriber
+  converges. A publisher restart has no tracker history: call
+  ``publish_base`` again — subscribers detect the new base fingerprint
+  and rebase.
+  """
+
+  def __init__(self, path: str, plan: DistEmbeddingStrategy,
+               rule: SparseRule, tracker: RowGenerationTracker,
+               quantize: str = "f32", store=None, vocab=None,
+               telemetry=None):
+    if quantize not in QUANTIZE_MODES:
+      raise ValueError(f"unknown quantize mode {quantize!r}; "
+                       f"have {list(QUANTIZE_MODES)}")
+    if tracker.plan is not plan:
+      raise ValueError(
+          "tracker was built for a different plan object: the routing "
+          "recipe and class geometry must be THIS plan's.")
+    if store is None and plan.host_tier_class_keys():
+      raise ValueError(
+          "plan has host-tier classes but no HostTierStore was passed: "
+          "the cold images hold the authoritative rows the delta must "
+          "read. Pass the run's store.")
+    if jax.process_count() > 1:
+      raise NotImplementedError(
+          "delta publication is a single-controller operation (like the "
+          "full export): publish from a single-controller run or a "
+          "restored checkpoint.")
+    self.path = path
+    self.plan = plan
+    self.rule = rule
+    self.tracker = tracker
+    self.quantize = quantize
+    self.store = store
+    self.vocab = vocab
+    self.telemetry = telemetry if telemetry is not None else _registry()
+    os.makedirs(path, exist_ok=True)
+
+    engine = DistributedLookup(plan)
+    self._layouts = engine.fused_layouts(
+        rule, rows_overrides=store.tplan.rows_overrides if store else None)
+    self._tiered_names = frozenset(store.tplan.tier_specs) \
+        if store is not None else frozenset()
+    # the SAME geometry derivation as freeze() — shared helper, so a
+    # delta row and a full re-export of the same logical row are
+    # byte-identical by construction
+    self.meta, self._full_lay = serve_class_meta(
+        plan, rule, quantize, self._tiered_names)
+
+    # chain state (advances only on successful publication)
+    self.seq = 0
+    self.fingerprint: Optional[str] = None  # predecessor of the NEXT delta
+    self.base_fingerprint: Optional[str] = None
+    self.watermark = 0  # tracker clock covered by the last publication
+    self.last_publish_bytes = 0
+
+  # ---- observation (delegates to the tracker) -----------------------------
+  def observe_batch(self, cats) -> int:
+    """Stamp one global batch (call with the ids the STEP consumes —
+    post-translation under ``oov='allocate'``)."""
+    return self.tracker.observe(cats)
+
+  # ---- base ---------------------------------------------------------------
+  def publish_base(self, state: Dict[str, Any]) -> str:
+    """Full frozen-table export rooting (or re-rooting) the chain."""
+    base = os.path.join(self.path, BASE_DIR)
+    clock = self.tracker.clock
+    full_export(base, self.plan, self.rule, state, quantize=self.quantize,
+                store=self.store, vocab=self.vocab,
+                extra={"stream": {"clock": clock,
+                                  "published_wall": time.time()}})
+    self.seq = 0
+    self.fingerprint = self.base_fingerprint = manifest_fingerprint(base)
+    self.watermark = clock
+    self.last_publish_bytes = artifact_bytes(base)
+    self.tracker.mark_published()
+    self.telemetry.counter("stream/base_published").inc()
+    self.telemetry.counter("stream/bytes_published").inc(
+        self.last_publish_bytes)
+    return base
+
+  # ---- delta --------------------------------------------------------------
+  def _reader(self, name: str, state: Dict[str, Any], rank: int):
+    """Physical-row window reader over one rank's AUTHORITATIVE packed
+    block: the flushed host image for tiered classes, the device buffer
+    (one window device_get at a time) otherwise."""
+    if name in self._tiered_names:
+      img = self.store.images[name][rank]
+      return lambda p0, p1: img[p0:p1]
+    arr = state["fused"][name]
+    if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+      raise NotImplementedError(
+          "delta extraction indexes the global fused buffers and "
+          "requires fully-addressable arrays (single-controller).")
+    base = rank * self._layouts[name].phys_rows
+    return lambda p0, p1: np.asarray(
+        jax.device_get(arr[base + p0:base + p1]))
+
+  def _serve_phys_counts(self, name: str, rank: int) -> np.ndarray:
+    """Tracker logical-row counts re-binned to SERVE physical rows (the
+    granularity the serve cache ranks at)."""
+    m = self.meta[name]
+    sl = m.packed
+    c = self.tracker.counts[name][rank]
+    pad = sl.phys_rows * sl.rows_per_phys - m.rows
+    if pad:
+      c = np.concatenate([c, np.zeros((pad,), np.int64)])
+    return c.reshape(sl.phys_rows, sl.rows_per_phys).sum(axis=1)
+
+  def publish_delta(self, state: Dict[str, Any]) -> Optional[str]:
+    """Extract + seal one delta; returns its path, or None when nothing
+    was observed since the last publication."""
+    if self.fingerprint is None:
+      raise RuntimeError(
+          "publish_delta before publish_base: the chain needs a root "
+          "artifact for the first base_fingerprint to link.")
+    clock = self.tracker.clock
+    if clock == self.watermark:
+      return None
+    seq = self.seq + 1
+    path = os.path.join(self.path, delta_dirname(seq))
+
+    with _span("stream/extract", args={"seq": seq}):
+      if self.store is not None:
+        self.store.flush(state["fused"])
+      changed = self.tracker.changed_rows(self.watermark)
+      payload: Dict[str, List[tuple]] = {}
+      n_rows = 0
+      for name, per_rank in changed.items():
+        lay = (self._full_lay[name] if name in self._tiered_names
+               else self._layouts[name])
+        m = self.meta[name]
+        blocks = []
+        for rank, idx in enumerate(per_rank):
+          tbl = extract_changed_rows(lay, self._reader(name, state, rank),
+                                     idx)
+          blocks.append((idx, quantize_rows(tbl, self.quantize)
+                         if idx.size else
+                         np.zeros((0, m.lanes), m.np_dtype)))
+          n_rows += idx.size
+        payload[name] = blocks
+
+    with _span("stream/seal", args={"seq": seq}):
+      tmp = path + ".tmp"
+      if os.path.exists(tmp):
+        import shutil
+        shutil.rmtree(tmp)
+      os.makedirs(tmp)
+      checksums: Dict[str, Dict[str, int]] = {}
+
+      def _seal(fpath: str) -> None:
+        _fsync_path(fpath)
+        faultinject.fire("ckpt_write", path=fpath)
+        checksums[os.path.basename(fpath)] = _crc32_file(fpath)
+
+      stream_rows: Dict[str, Dict[str, int]] = {}
+      for name, blocks in sorted(payload.items()):
+        per_rank_n = {}
+        for rank, (idx, data) in enumerate(blocks):
+          if not idx.size:
+            continue
+          per_rank_n[str(rank)] = int(idx.size)
+          fpath = os.path.join(tmp, f"rows_{name}_r{rank}.npz")
+          np.savez(fpath, idx=idx.astype(np.int64),
+                   data=self.meta[name].to_disk(np.ascontiguousarray(data)))
+          _seal(fpath)
+        if per_rank_n:
+          stream_rows[name] = per_rank_n
+      for name in sorted(self._tiered_names):
+        fpath = os.path.join(tmp, f"counts_{name}.npz")
+        np.savez(fpath, **{f"r{r}": self._serve_phys_counts(name, r)
+                           for r in range(self.plan.world_size)})
+        _seal(fpath)
+      for part in ("dense", "emb_dense"):
+        fpath = os.path.join(tmp, f"{part}.npz")
+        np.savez(fpath, **_flatten_with_paths(state[part]))
+        _seal(fpath)
+      snap = vocab_snapshot(self.vocab)
+      if snap is not None:
+        fpath = os.path.join(tmp, "vocab_snapshot.npz")
+        np.savez(fpath, **snap.state_arrays())
+        _seal(fpath)
+
+      manifest: Dict[str, Any] = {
+          "format_version": DELTA_FORMAT_VERSION,
+          "kind": "serve_delta",
+          "seq": seq,
+          "step": int(_to_host(state["step"])),
+          "base_fingerprint": self.fingerprint,
+          "plan": _plan_fingerprint(self.plan),
+          "rule": {"name": self.rule.name, "n_aux": self.rule.n_aux},
+          "serve": {
+              "quantize": self.quantize,
+              "classes": {n: m.to_json()
+                          for n, m in sorted(self.meta.items())},
+          },
+          "stream": {
+              "rows": stream_rows,
+              "counts_classes": sorted(self._tiered_names),
+              "watermark": {"from_clock": self.watermark,
+                            "to_clock": clock},
+              "train_wall_oldest": self.tracker.oldest_unpublished_wall,
+              "train_wall_newest": self.tracker.newest_wall,
+              "published_wall": time.time(),
+          },
+          "checksums": checksums,
+      }
+      if snap is not None:
+        manifest["vocab_snapshot"] = snap.manifest_section()
+      publish_manifest_last(tmp, path, manifest)
+
+    self.seq = seq
+    self.fingerprint = manifest_fingerprint(path)
+    self.watermark = clock
+    self.last_publish_bytes = sum(int(v["size"])
+                                  for v in checksums.values())
+    self.tracker.mark_published()
+    reg = self.telemetry
+    reg.counter("stream/deltas_published").inc()
+    reg.counter("stream/rows_published").inc(n_rows)
+    reg.counter("stream/bytes_published").inc(self.last_publish_bytes)
+    reg.gauge("stream/publish_seq").set(seq)
+    return path

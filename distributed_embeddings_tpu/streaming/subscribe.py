@@ -1,0 +1,481 @@
+"""Serve-side delta adoption: validate, fold, promote — without pausing.
+
+:class:`DeltaSubscriber` is the serving half of the streaming pipeline.
+It watches a publish directory, validates each ``delta_<seq>/`` against
+the chain contract (directory integrity against its own crc32 manifest;
+``seq`` exactly next; ``base_fingerprint`` equal to the fingerprint of
+the artifact last applied — see :mod:`.publish`), and folds a valid
+delta into a RUNNING :class:`~..serving.engine.ServeEngine` by
+**copy-on-promote**:
+
+- device-tier classes: the new row block is built OFF the dispatch path
+  (``buf.at[...].set`` — an out-of-place scatter producing a NEW device
+  array; in-flight dispatches keep their references to the old one),
+  and only the reference swap happens under the engine's dispatch lock
+  — between micro-batcher flushes, never inside one;
+- host-tier (tiered serve) classes: the delta scatters into a COPY of
+  each touched cold image, the copies swap in under the lock, resident
+  hot-cache rows whose image rows changed are re-uploaded, and the
+  publisher-shipped observed counts re-rank the cache through the
+  prefetcher's own re-rank machinery — live hot-set adaptation on the
+  (until now frozen) serve path;
+- the dense/MXU parts and the dynvocab read-only snapshot swap
+  wholesale (they ship whole per delta) — a raw id admitted by training
+  becomes servable in the same delta cycle, translated by
+  :meth:`dispatch` against the promoted snapshot.
+
+A delta that fails validation is REFUSED — counted, recorded in
+``last_refusal`` with the failing field named — and the subscriber
+keeps serving the last valid state; it never advances past a broken
+link, so a torn or forked chain degrades to staleness, not to wrong
+rows. When the BASE artifact's fingerprint changes (a restarted
+publisher re-rooted the chain), the subscriber rebases: reloads the
+full artifact and resumes the new chain.
+
+Freshness: each promotion observes ``now - train_wall_oldest`` (the
+wall time of the oldest trainer observation the delta covers) into the
+``stream/freshness_s`` histogram — the end-to-end train-step ->
+servable lag, bucket-collapse-bounded so an unbounded lag range cannot
+grow the histogram without bound.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..checkpoint import manifest_fingerprint, read_manifest
+from ..checkpoint import verify as verify_dir
+from ..checkpoint import _plan_fingerprint
+from ..layers.planner import DistEmbeddingStrategy
+from ..ops.packed_table import host_gather_rows
+from ..serving.engine import ServeEngine
+from ..serving.export import ServeClassMeta
+from ..serving.export import load as serve_load
+from ..telemetry import get_registry as _registry, span as _span
+from .publish import (
+    BASE_DIR,
+    DELTA_FORMAT_VERSION,
+    delta_dirname,
+    published_delta_seqs,
+)
+
+# Freshness histogram geometry: lag spans many decades (ms when
+# healthy, hours when a publisher is down), and this metric must never
+# grow without bound. At rel_err=0.05 a bucket covers ~4.3% of a decade,
+# so 256 buckets span ~11 decades before the lowest ones start
+# collapsing — the bound is a backstop, not an operating regime.
+FRESHNESS_REL_ERR = 0.05
+FRESHNESS_MAX_BUCKETS = 256
+
+
+class DeltaSubscriber:
+  """Fold published deltas into a running serve engine.
+
+  Build via :meth:`from_artifact` (loads the base export, builds the
+  engine, and records the factory so a base re-root can rebase), or
+  directly from an existing engine + the base fingerprint it was built
+  from. ``poll_once`` is the deterministic test surface; ``start`` runs
+  it on a daemon thread every ``poll_interval_s``.
+  """
+
+  def __init__(self, engine: ServeEngine, path: str,
+               plan: DistEmbeddingStrategy,
+               base_fingerprint: Optional[str] = None,
+               translator=None, poll_interval_s: float = 0.05,
+               telemetry=None):
+    self.engine = engine
+    self.path = path
+    self.plan = plan
+    self.translator = translator
+    self.poll_interval_s = float(poll_interval_s)
+    self.telemetry = telemetry if telemetry is not None else _registry()
+    self.applied_seq = 0
+    self.base_fingerprint = base_fingerprint if base_fingerprint \
+        is not None else manifest_fingerprint(os.path.join(path, BASE_DIR))
+    # fingerprint of the artifact last applied (the chain link)
+    self.fingerprint = self.base_fingerprint
+    self.last_refusal: Optional[Dict[str, Any]] = None
+    self.last_error: Optional[BaseException] = None
+    self.freshness = self.telemetry.histogram(
+        "stream/freshness_s", rel_err=FRESHNESS_REL_ERR,
+        max_buckets=FRESHNESS_MAX_BUCKETS)
+    self._factory: Optional[Dict[str, Any]] = None
+    self._stop = threading.Event()
+    self._thread: Optional[threading.Thread] = None
+
+  @classmethod
+  def from_artifact(cls, model, plan: DistEmbeddingStrategy, path: str,
+                    mesh=None, axis_name: str = "mp", tier_config=None,
+                    with_metrics: bool = False,
+                    donate_batch: bool = False,
+                    poll_interval_s: float = 0.05,
+                    telemetry=None) -> "DeltaSubscriber":
+    """Load ``<path>/base`` and build the engine + subscriber pair."""
+    base = os.path.join(path, BASE_DIR)
+    art = serve_load(base, plan, mesh=mesh, axis_name=axis_name)
+    engine = ServeEngine(model, plan, art, mesh=mesh, axis_name=axis_name,
+                         tier_config=tier_config,
+                         with_metrics=with_metrics,
+                         donate_batch=donate_batch)
+    sub = cls(engine, path, plan,
+              base_fingerprint=manifest_fingerprint(base),
+              translator=art.vocab, poll_interval_s=poll_interval_s,
+              telemetry=telemetry)
+    sub._factory = dict(model=model, mesh=mesh, axis_name=axis_name,
+                        tier_config=tier_config, with_metrics=with_metrics,
+                        donate_batch=donate_batch)
+    return sub
+
+  # ---- the serve surface --------------------------------------------------
+  def dispatch(self, numerical, cats):
+    """Translate (dynvocab snapshots) + dispatch, atomically against
+    promotion: the engine lock pairs the id space with the row values
+    it was trained under. Bind THIS to the micro-batcher."""
+    while True:
+      eng = self.engine
+      with eng.lock:
+        if eng is not self.engine:
+          # a rebase swapped engines while we waited on the OLD lock:
+          # retry on the new pair — translating with the new snapshot
+          # but dispatching into the old engine would serve the new id
+          # space against rows it was not trained under
+          continue
+        translator = self.translator
+        tcats = translator.translate(list(cats)) \
+            if translator is not None else cats
+        return eng.dispatch(numerical, tcats)
+
+  def predict(self, numerical, cats):
+    out = self.dispatch(numerical, cats)
+    if self.engine.with_metrics and self.engine.tiered:
+      preds, metrics = out
+      return np.asarray(preds), jax.tree_util.tree_map(np.asarray, metrics)
+    return np.asarray(out)
+
+  # ---- polling ------------------------------------------------------------
+  def start(self) -> "DeltaSubscriber":
+    """Poll on a daemon thread until :meth:`stop`. Errors are recorded
+    (``last_error`` + ``stream/poll_errors``), never thread-fatal —
+    a serving process outlives a flaky shared filesystem."""
+    if self._thread is not None and self._thread.is_alive():
+      return self
+    self._stop.clear()
+    self._thread = threading.Thread(target=self._poll_loop,
+                                    name="stream-delta-subscriber",
+                                    daemon=True)
+    self._thread.start()
+    return self
+
+  def stop(self) -> None:
+    self._stop.set()
+    if self._thread is not None:
+      self._thread.join(timeout=10.0)
+
+  def _poll_loop(self) -> None:
+    while not self._stop.is_set():
+      try:
+        self.poll_once()
+      except Exception as e:  # noqa: BLE001 — recorded, loop survives
+        self.last_error = e
+        self.telemetry.counter("stream/poll_errors").inc()
+      self._stop.wait(self.poll_interval_s)
+
+  def poll_once(self) -> int:
+    """Scan + apply every ready delta in seq order; returns how many
+    were applied. Stops (without advancing) at the first refusal."""
+    applied = 0
+    base = os.path.join(self.path, BASE_DIR)
+    if os.path.isfile(os.path.join(base, "manifest.json")):
+      current = manifest_fingerprint(base)
+      if current != self.base_fingerprint:
+        self._rebase(base, current)
+        applied += 1
+    while True:
+      seq = self.applied_seq + 1
+      path = os.path.join(self.path, delta_dirname(seq))
+      if not os.path.isfile(os.path.join(path, "manifest.json")):
+        later = [s for s in published_delta_seqs(self.path) if s > seq]
+        if later:
+          self._refuse(seq, "seq",
+                       f"delta {min(later)} is published but delta {seq} "
+                       "is missing — out-of-order publication; holding "
+                       "at the last valid artifact")
+        break
+      if not self._validate_and_apply(path, seq):
+        break
+      applied += 1
+    return applied
+
+  # ---- validation ---------------------------------------------------------
+  def _refuse(self, seq: int, field: str, reason: str) -> bool:
+    self.last_refusal = {"seq": seq, "field": field, "reason": reason}
+    self.telemetry.counter("stream/deltas_refused").inc()
+    return False
+
+  def _validate_and_apply(self, path: str, seq: int) -> bool:
+    with _span("stream/validate", args={"seq": seq}):
+      problems = verify_dir(path)
+      if problems:
+        return self._refuse(
+            seq, "checksums",
+            f"torn or corrupt delta {path!r}: " + "; ".join(problems))
+      manifest = read_manifest(path)
+      if manifest.get("kind") != "serve_delta" \
+          or manifest.get("format_version") != DELTA_FORMAT_VERSION:
+        return self._refuse(
+            seq, "kind",
+            f"{path!r} is not a v{DELTA_FORMAT_VERSION} serve_delta "
+            f"(kind={manifest.get('kind')!r}, "
+            f"format={manifest.get('format_version')!r})")
+      if int(manifest["seq"]) != seq:
+        return self._refuse(
+            seq, "seq",
+            f"directory {os.path.basename(path)} carries manifest seq "
+            f"{manifest['seq']} — expected {seq}; out-of-order or "
+            "renamed delta refused")
+      if manifest["base_fingerprint"] != self.fingerprint:
+        return self._refuse(
+            seq, "base_fingerprint",
+            f"delta {seq} chains base_fingerprint "
+            f"{manifest['base_fingerprint'][:12]}... but the last "
+            f"applied artifact is {self.fingerprint[:12]}... — the "
+            "publisher re-rooted or forked; refusing to fold a delta "
+            "built against different predecessor rows")
+      if manifest["plan"] != _plan_fingerprint(self.plan):
+        return self._refuse(
+            seq, "plan",
+            "delta plan fingerprint does not match the serving plan — "
+            "serve artifacts do not re-shard; re-export under this plan")
+      if manifest["serve"]["quantize"] != self.engine.quantize:
+        return self._refuse(
+            seq, "quantize",
+            f"delta quantize={manifest['serve']['quantize']!r} but the "
+            f"engine serves {self.engine.quantize!r}")
+      try:
+        meta, rows = self._load_rows(path, manifest)
+      except (OSError, KeyError, ValueError) as e:
+        return self._refuse(seq, "rows",
+                            f"unreadable delta row payload: {e!r}")
+      world = self.plan.world_size
+      for name, m in meta.items():
+        have = self.engine.meta.get(name)
+        if have is None or m.packed != have.packed:
+          return self._refuse(
+              seq, "geometry",
+              f"delta class {name!r} geometry {m.to_json()} does not "
+              "match the engine's serve geometry — artifact and engine "
+              "disagree")
+      for name, per_rank in rows.items():
+        n_rows = meta[name].rows
+        lanes = meta[name].lanes
+        for rank, (idx, data) in per_rank.items():
+          # explicit bounds on externally-derived indices (the repo's
+          # store.check_rows discipline): a silent device scatter-drop
+          # of an OOB row would break the delta==re-export invariant,
+          # and a raw host IndexError would loop the poll thread
+          # forever instead of recording a named refusal
+          if rank < 0 or rank >= world:
+            return self._refuse(
+                seq, "rows",
+                f"class {name!r}: delta names rank {rank} outside "
+                f"[0, {world})")
+          if idx.size and (int(idx.min()) < 0
+                           or int(idx.max()) >= n_rows):
+            bad = int(idx.min() if idx.min() < 0 else idx.max())
+            return self._refuse(
+                seq, "rows",
+                f"class {name!r} rank {rank}: delta row index {bad} "
+                f"outside this class's [0, {n_rows}) logical rows")
+          if data.shape != (idx.size, lanes):
+            return self._refuse(
+                seq, "rows",
+                f"class {name!r} rank {rank}: row data shape "
+                f"{data.shape} != ({idx.size}, {lanes})")
+    self._apply(path, manifest, meta, rows, seq)
+    return True
+
+  # ---- application --------------------------------------------------------
+  def _load_rows(self, path: str, manifest: Dict[str, Any]):
+    """Delta row payloads, host-side: ``{name: {rank: (idx, data)}}``."""
+    meta = {n: ServeClassMeta.from_json(n, d)
+            for n, d in manifest["serve"]["classes"].items()}
+    out: Dict[str, Dict[int, tuple]] = {}
+    for name, per_rank in manifest["stream"]["rows"].items():
+      m = meta[name]
+      out[name] = {}
+      for rank_s in per_rank:
+        rank = int(rank_s)
+        with np.load(os.path.join(path,
+                                  f"rows_{name}_r{rank}.npz")) as z:
+          idx = np.asarray(z["idx"], np.int64)
+          data = m.from_disk(np.asarray(z["data"]))
+        out[name][rank] = (idx, data)
+    return meta, out
+
+  def _build_device_updates(self, rows: Dict[str, Dict[int, tuple]]
+                            ) -> Dict[str, jax.Array]:
+    """Out-of-place scatters for device-tier classes (the expensive
+    half of copy-on-promote — runs OFF the dispatch lock)."""
+    eng = self.engine
+    updates: Dict[str, jax.Array] = {}
+    for name, per_rank in rows.items():
+      m = eng.meta[name]
+      if m.tier != "device":
+        continue
+      lay = m.packed
+      rpp, lanes = lay.rows_per_phys, m.lanes
+      grp_parts, sub_parts, val_parts = [], [], []
+      for rank, (idx, data) in sorted(per_rank.items()):
+        grp_parts.append(rank * lay.phys_rows + idx // rpp)
+        sub_parts.append(idx % rpp)
+        val_parts.append(data)
+      grp = np.concatenate(grp_parts)
+      sub = np.concatenate(sub_parts)
+      vals = np.concatenate(val_parts)
+      cols = (sub[:, None] * lanes
+              + np.arange(lanes, dtype=np.int64)[None, :])
+      buf = eng.state["serve"][name]
+      new = jnp.asarray(buf).at[jnp.asarray(grp)[:, None],
+                                jnp.asarray(cols)].set(jnp.asarray(vals))
+      if isinstance(buf, jax.Array):
+        new = jax.device_put(new, buf.sharding)
+      new.block_until_ready()  # build completes BEFORE the lock is taken
+      updates[name] = new
+    return updates
+
+  def _fold_tiered(self, rows: Dict[str, Dict[int, tuple]],
+                   new_images: Dict[str, Dict[int, np.ndarray]],
+                   counts: Dict[str, Dict[int, np.ndarray]]) -> None:
+    """Under the engine lock: swap image copies in, refresh resident
+    cache rows whose backing image rows changed, adopt the shipped
+    counts, re-rank. Value-preserving throughout — the serve output for
+    any id is a pure function of the promoted images."""
+    eng = self.engine
+    store = eng.store
+    serve = dict(eng.state["serve"])
+    for name, per_rank in new_images.items():
+      c = eng.tplan.by_name(name)
+      lay, spec = c.layout_logical, c.spec
+      per = spec.cache_grps + spec.staging_grps
+      for rank, img in sorted(per_rank.items()):
+        store.images[name][rank] = img
+        idx, _ = rows[name][rank]
+        changed_pg = np.unique(idx // lay.rows_per_phys)
+        rmap = store.resident_map[name][rank]
+        slots = rmap[changed_pg]
+        hot = slots >= 0
+        if np.any(hot):
+          gidx = rank * per + slots[hot]
+          vals = host_gather_rows(lay, img,
+                                  changed_pg[hot].astype(np.int64))
+          buf = serve[name]
+          new = jnp.asarray(buf).at[jnp.asarray(gidx)].set(
+              jnp.asarray(vals))
+          if isinstance(buf, jax.Array):
+            new = jax.device_put(new, buf.sharding)
+          serve[name] = new
+    for name, per_rank in counts.items():
+      for rank, cnt in sorted(per_rank.items()):
+        store.counts[name][rank][:] = cnt
+    eng.state["serve"] = serve
+    if counts:
+      # the shipped counts ARE the decayed/ranked signal; rerank without
+      # a second decay so repeated deltas with stable counts are stable
+      eng.state["serve"] = eng.prefetcher.rerank(eng.state["serve"],
+                                                 decay=False)
+
+  def _apply(self, path: str, manifest: Dict[str, Any], meta, rows,
+             seq: int) -> None:
+    from ..serving.export import _unflatten_paths, place_state
+    eng = self.engine
+    with _span("stream/promote", args={"seq": seq}):
+      # --- build everything off the dispatch lock ---
+      updates = self._build_device_updates(rows)
+      new_images: Dict[str, Dict[int, np.ndarray]] = {}
+      for name, per_rank in rows.items():
+        m = eng.meta[name]
+        if m.tier != "host":
+          continue
+        lay = m.packed
+        rpp, lanes = lay.rows_per_phys, m.lanes
+        new_images[name] = {}
+        for rank, (idx, data) in sorted(per_rank.items()):
+          img = eng.store.images[name][rank].copy()
+          cols = ((idx % rpp)[:, None] * lanes
+                  + np.arange(lanes, dtype=np.int64)[None, :])
+          img[(idx // rpp)[:, None], cols] = data
+          new_images[name][rank] = img
+      counts: Dict[str, Dict[int, np.ndarray]] = {}
+      for name in manifest["stream"].get("counts_classes", []):
+        if eng.meta[name].tier != "host":
+          continue
+        with np.load(os.path.join(path, f"counts_{name}.npz")) as z:
+          counts[name] = {int(k[1:]): np.asarray(v, np.int64)
+                          for k, v in z.items()}
+      parts = {}
+      for part in ("dense", "emb_dense"):
+        with np.load(os.path.join(path, f"{part}.npz")) as z:
+          flat = dict(z)
+        parts[part] = place_state({part: _unflatten_paths(flat)},
+                                  eng.mesh, eng.axis_name)[part]
+      translator = self.translator
+      if manifest.get("vocab_snapshot") is not None:
+        from ..dynvocab import ReadonlyIdTranslator
+        with np.load(os.path.join(path, "vocab_snapshot.npz")) as z:
+          translator = ReadonlyIdTranslator.from_arrays(
+              {k: np.asarray(v) for k, v in z.items()})
+
+      # --- the swap: reference promotion between dispatches ---
+      with eng.lock:
+        eng.state["serve"] = dict(eng.state["serve"], **updates)
+        if new_images or counts:
+          self._fold_tiered(rows, new_images, counts)
+        eng.state["dense"] = parts["dense"]
+        eng.state["emb_dense"] = parts["emb_dense"]
+        self.translator = translator
+
+    self.applied_seq = seq
+    self.fingerprint = manifest_fingerprint(path)
+    self.last_refusal = None
+    reg = self.telemetry
+    reg.counter("stream/deltas_applied").inc()
+    reg.counter("stream/rows_applied").inc(
+        sum(idx.size for per in rows.values() for idx, _ in per.values()))
+    reg.gauge("stream/applied_seq").set(seq)
+    oldest = manifest["stream"].get("train_wall_oldest")
+    if oldest is not None:
+      self.freshness.observe(max(0.0, time.time() - float(oldest)))
+
+  # ---- rebase (publisher re-rooted the chain) -----------------------------
+  def _rebase(self, base: str, fingerprint: str) -> None:
+    if self._factory is None:
+      raise RuntimeError(
+          "the publish directory's base artifact changed (fingerprint "
+          f"{fingerprint[:12]}... != {self.base_fingerprint[:12]}...) "
+          "but this subscriber was constructed without a factory — "
+          "build it with DeltaSubscriber.from_artifact to enable "
+          "automatic rebase, or rebuild the engine by hand.")
+    with _span("stream/rebase"):
+      f = self._factory
+      art = serve_load(base, self.plan, mesh=f["mesh"],
+                       axis_name=f["axis_name"])
+      engine = ServeEngine(f["model"], self.plan, art, mesh=f["mesh"],
+                           axis_name=f["axis_name"],
+                           tier_config=f["tier_config"],
+                           with_metrics=f["with_metrics"],
+                           donate_batch=f["donate_batch"])
+      old = self.engine
+      with old.lock:
+        self.engine = engine
+        self.translator = art.vocab
+        self.base_fingerprint = fingerprint
+        self.fingerprint = fingerprint
+        self.applied_seq = 0
+      self.telemetry.counter("stream/rebases").inc()

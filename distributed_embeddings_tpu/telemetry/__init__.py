@@ -40,6 +40,7 @@ from .registry import (
     get_registry,
     histogram,
 )
+from .http import MetricsServer
 from .trace import (
     Tracer,
     current_tracer,
@@ -56,6 +57,7 @@ __all__ = [
     "Histogram",
     "JsonlWriter",
     "MetricsRegistry",
+    "MetricsServer",
     "Tracer",
     "atomic_write_text",
     "counter",

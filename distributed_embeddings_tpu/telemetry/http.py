@@ -1,0 +1,111 @@
+"""Live ``/metrics`` scrape endpoint over the Prometheus renderer.
+
+The textfile exporter (:func:`.export.write_prometheus`) covers the
+node-exporter deployment shape — a sidecar reads a file the process
+atomically replaces.  A live serving or training process wants the other
+standard shape too: Prometheus scraping ``GET /metrics`` straight off
+the process, no file and no sidecar.  :class:`MetricsServer` is that
+endpoint — a stdlib ``ThreadingHTTPServer`` on a daemon thread rendering
+:func:`.export.prometheus_text` per request, so the scrape always sees a
+point-in-time consistent snapshot (the registry lock is taken once per
+render, never held across the socket write).
+
+Lifecycle is explicit and shutdown-clean: ``close()`` (or the context
+manager) shuts the serve loop down, closes the listening socket, and
+JOINS the serve thread — a test or a draining server never leaks the
+port or the thread.  Bind ``port=0`` to let the OS pick a free port
+(``server.port`` reports the bound one).
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .export import prometheus_text
+from .registry import MetricsRegistry, get_registry
+
+__all__ = ["MetricsServer"]
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class _Handler(BaseHTTPRequestHandler):
+  """One registry, two routes: ``/metrics`` (Prometheus text) and
+  ``/healthz`` (liveness ping). Everything else is 404."""
+
+  # the registry rides the SERVER object (one handler instance per
+  # request; BaseHTTPRequestHandler offers no clean per-handler state)
+  def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler's contract
+    path = self.path.split("?", 1)[0]
+    if path == "/metrics":
+      body = prometheus_text(self.server.registry).encode("utf-8")
+      self.send_response(200)
+      self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+    elif path == "/healthz":
+      body = b"ok\n"
+      self.send_response(200)
+      self.send_header("Content-Type", "text/plain; charset=utf-8")
+    else:
+      body = b"not found: /metrics and /healthz are served\n"
+      self.send_response(404)
+      self.send_header("Content-Type", "text/plain; charset=utf-8")
+    self.send_header("Content-Length", str(len(body)))
+    self.end_headers()
+    self.wfile.write(body)
+
+  def log_message(self, format, *args):  # noqa: A002 — base signature
+    pass  # scrapes every few seconds must not spam the process log
+
+
+class _Server(ThreadingHTTPServer):
+  daemon_threads = True  # per-request handler threads die with close()
+  registry: MetricsRegistry
+
+
+class MetricsServer:
+  """Serve a registry at ``http://host:port/metrics`` until closed.
+
+  Args:
+    registry: the registry to expose (default: the process-wide one).
+    host: bind address — default loopback; bind ``"0.0.0.0"`` only when
+      the scraper really is remote.
+    port: TCP port; ``0`` (the default) picks a free one, reported by
+      :attr:`port` / :attr:`url`.
+  """
+
+  def __init__(self, registry: Optional[MetricsRegistry] = None,
+               host: str = "127.0.0.1", port: int = 0):
+    self._server = _Server((host, port), _Handler)
+    self._server.registry = registry if registry is not None \
+        else get_registry()
+    self.host = self._server.server_address[0]
+    self.port = int(self._server.server_address[1])
+    self._thread = threading.Thread(
+        target=self._server.serve_forever, name="telemetry-metrics-http",
+        daemon=True)
+    self._thread.start()
+
+  @property
+  def url(self) -> str:
+    return f"http://{self.host}:{self.port}/metrics"
+
+  @property
+  def closed(self) -> bool:
+    return not self._thread.is_alive()
+
+  def close(self) -> None:
+    """Stop serving: shut the loop down, close the socket, join the
+    thread. Idempotent."""
+    if self._thread.is_alive():
+      self._server.shutdown()
+      self._thread.join(timeout=10.0)
+    self._server.server_close()
+
+  def __enter__(self) -> "MetricsServer":
+    return self
+
+  def __exit__(self, exc_type, exc, tb):
+    self.close()
+    return False

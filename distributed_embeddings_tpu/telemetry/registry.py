@@ -125,17 +125,33 @@ class Histogram:
   any distribution, ``|estimate - exact| <= rel_err * exact`` against
   the exact nearest-rank value of the raw stream (pinned adversarially
   in tests/test_telemetry.py).
+
+  ``max_buckets`` bounds the occupied-bucket cardinality for metrics fed
+  by unbounded-magnitude streams (a freshness lag that can span
+  microseconds to hours would otherwise grow a bucket per decade-ish of
+  gamma): when the bound is exceeded the LOWEST buckets collapse upward
+  (the DDSketch policy — the smallest observations are the ones a
+  latency/lag SLO never reads), so memory is O(max_buckets) forever.
+  The ``rel_err`` percentile guarantee then holds only for quantiles
+  landing ABOVE the collapse boundary; collapsed mass is reported at the
+  boundary bucket's value (an overestimate of the collapsed samples,
+  never of the upper quantiles).
   """
 
   __slots__ = ("name", "_lock", "rel_err", "_gamma", "_log_gamma",
-               "_buckets", "_zero", "_count", "_sum", "_min", "_max")
+               "_buckets", "_zero", "_count", "_sum", "_min", "_max",
+               "max_buckets", "_collapsed")
 
   kind = "histogram"
 
   def __init__(self, name: str = "", rel_err: float = 0.01,
-               lock: Optional[threading.RLock] = None):
+               lock: Optional[threading.RLock] = None,
+               max_buckets: Optional[int] = None):
     if not 0.0 < rel_err < 1.0:
       raise ValueError(f"rel_err must be in (0, 1), got {rel_err}")
+    if max_buckets is not None and max_buckets < 2:
+      raise ValueError(f"max_buckets must be >= 2 (the collapse needs a "
+                       f"boundary bucket to merge into), got {max_buckets}")
     self.name = name
     self._lock = lock if lock is not None else threading.RLock()
     self.rel_err = float(rel_err)
@@ -147,6 +163,8 @@ class Histogram:
     self._sum = 0.0
     self._min = math.inf
     self._max = -math.inf
+    self.max_buckets = max_buckets
+    self._collapsed = 0  # observations folded upward by bucket collapse
 
   # ---- recording ----------------------------------------------------------
   def observe(self, x: float) -> None:
@@ -163,6 +181,19 @@ class Histogram:
       else:
         i = math.ceil(math.log(x) / self._log_gamma)
         self._buckets[i] = self._buckets.get(i, 0) + 1
+        if self.max_buckets is not None \
+            and len(self._buckets) > self.max_buckets:
+          self._collapse_locked()
+
+  def _collapse_locked(self) -> None:
+    """Merge the lowest buckets upward until the cardinality bound
+    holds (caller holds the lock). Count/sum/min/max are exact
+    regardless; only the collapsed samples' bucket resolution is lost."""
+    while len(self._buckets) > self.max_buckets:
+      lo = sorted(self._buckets)[:2]
+      n = self._buckets.pop(lo[0])
+      self._buckets[lo[1]] += n
+      self._collapsed += n
 
   def observe_many(self, xs: Iterable[float]) -> None:
     for x in xs:
@@ -234,11 +265,15 @@ class Histogram:
       self._sum += other._sum
       self._min = min(self._min, other._min)
       self._max = max(self._max, other._max)
+      self._collapsed += other._collapsed
+      if self.max_buckets is not None \
+          and len(self._buckets) > self.max_buckets:
+        self._collapse_locked()
 
   # ---- persistence --------------------------------------------------------
   def state(self) -> Dict[str, Any]:
     with self._lock:
-      return {
+      out = {
           "rel_err": self.rel_err,
           "count": self._count,
           "sum": self._sum,
@@ -248,6 +283,9 @@ class Histogram:
           # JSON object keys are strings; indices may be negative
           "buckets": {str(i): n for i, n in sorted(self._buckets.items())},
       }
+      if self._collapsed:
+        out["collapsed"] = self._collapsed
+      return out
 
   def load(self, state: Dict[str, Any]) -> None:
     if float(state["rel_err"]) != self.rel_err:
@@ -263,6 +301,12 @@ class Histogram:
       self._zero = int(state["zero"])
       self._buckets = {int(i): int(n)
                        for i, n in state.get("buckets", {}).items()}
+      self._collapsed = int(state.get("collapsed", 0))
+      if self.max_buckets is not None \
+          and len(self._buckets) > self.max_buckets:
+        # a persisted unbounded (or wider-bound) histogram adopts this
+        # configuration's bound on load
+        self._collapse_locked()
 
 
 _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
@@ -305,8 +349,10 @@ class MetricsRegistry:
   def gauge(self, name: str) -> Gauge:
     return self._get(name, "gauge")
 
-  def histogram(self, name: str, rel_err: float = 0.01) -> Histogram:
-    h = self._get(name, "histogram", rel_err=rel_err)
+  def histogram(self, name: str, rel_err: float = 0.01,
+                max_buckets: Optional[int] = None) -> Histogram:
+    h = self._get(name, "histogram", rel_err=rel_err,
+                  max_buckets=max_buckets)
     if h.rel_err != rel_err:
       # the silent alternative would hand back buckets with a different
       # error bound than the caller asked for — the same loud-mismatch
@@ -315,6 +361,18 @@ class MetricsRegistry:
           f"histogram {name!r} already registered with rel_err="
           f"{h.rel_err}, requested {rel_err} — the bucket geometries "
           "differ; pick one rel_err per metric name")
+    if max_buckets is not None and h.max_buckets != max_buckets:
+      if h.max_buckets is not None:
+        raise ValueError(
+            f"histogram {name!r} already bounded at max_buckets="
+            f"{h.max_buckets}, requested {max_buckets} — pick one bound "
+            "per metric name")
+      # an unbounded histogram adopts the first explicit bound (readers
+      # calling histogram(name) with the default None keep not caring)
+      with h._lock:
+        h.max_buckets = max_buckets
+        if len(h._buckets) > max_buckets:
+          h._collapse_locked()
     return h
 
   def metrics(self) -> Dict[str, Any]:
@@ -366,5 +424,6 @@ def gauge(name: str) -> Gauge:
   return _GLOBAL.gauge(name)
 
 
-def histogram(name: str, rel_err: float = 0.01) -> Histogram:
-  return _GLOBAL.histogram(name, rel_err=rel_err)
+def histogram(name: str, rel_err: float = 0.01,
+              max_buckets: Optional[int] = None) -> Histogram:
+  return _GLOBAL.histogram(name, rel_err=rel_err, max_buckets=max_buckets)

@@ -86,20 +86,11 @@ class TieredPrefetcher:
     self._gather = _retry.retrying(store.gather, policy=retry_policy,
                                    on_retry=_count_retry)
     # routing recipe: class key -> per rank -> [(input_id, row_offset,
-    # row_start, shard_rows, vocab, row_sliced)]
-    self._recipe: Dict[tuple, List[list]] = {}
-    for key in tplan.classes:
-      cp = self.plan.classes[key]
-      per_rank = []
-      for rank in range(self.plan.world_size):
-        slots = []
-        for slot in cp.slots_per_rank[rank]:
-          sh = slot.shard
-          vocab = self.plan.global_configs[sh.table_id].input_dim
-          slots.append((slot.input_id, slot.row_offset, sh.row_start,
-                        sh.input_dim, vocab, sh.row_sliced))
-        per_rank.append(slots)
-      self._recipe[key] = per_rank
+    # row_start, shard_rows, vocab, row_sliced)] — the plan's shared
+    # host-side replica of the traced routing (also consumed by the
+    # streaming row-generation tracker)
+    self._recipe: Dict[tuple, List[list]] = {
+        key: self.plan.routing_recipe(key) for key in tplan.classes}
     self._resident_dev = store.resident_arrays(mesh, axis_name)
     self.steps_since_rerank = 0
     self.total_host_gather_bytes = 0
@@ -133,26 +124,17 @@ class TieredPrefetcher:
       return self._classify(cats)
 
   def _classify(self, cats: Sequence) -> Dict[str, List[np.ndarray]]:
+    from ..layers.planner import routed_rows
     cold: Dict[str, List[np.ndarray]] = {}
     for key, c in self.tplan.classes.items():
       rpp = c.spec.rpp
       per_rank = []
       for rank in range(self.plan.world_size):
-        routed_all = []
-        for (input_id, off, row_start, rows, vocab,
-             rs) in self._recipe[key][rank]:
-          ids = self._input_ids_np(cats[input_id])
-          if rs:
-            clamped = np.clip(ids, 0, vocab - 1)
-            m = (ids >= 0) & (clamped >= row_start) \
-                & (clamped < row_start + rows)
-            routed = clamped[m] - row_start + off
-          else:
-            m = ids >= 0
-            routed = np.clip(ids[m], 0, rows - 1) + off
-          routed_all.append((routed // rpp).astype(np.int64))
-        grps_occ = (np.concatenate(routed_all) if routed_all
-                    else np.zeros((0,), np.int64))
+        # the shared numpy replica of the traced routing (planner.
+        # routed_rows — also the streaming tracker's), then physical
+        # groups for the hot/cold split
+        grps_occ = routed_rows(self._recipe[key][rank], cats,
+                               self._input_ids_np) // rpp
         # one sort serves both outputs: dedup for the hot/cold split and
         # occurrence counts for re-ranking (np.add.at over the raw stream
         # is ~10x slower, and this stage must stay ahead of the device)

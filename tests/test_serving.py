@@ -52,8 +52,10 @@ from distributed_embeddings_tpu.serving import (
     Rejected,
     ServeEngine,
     ServeTierConfig,
+    dequantize_rows_fp8,
     dequantize_rows_int8,
     make_serve_step,
+    quantize_rows_fp8,
     quantize_rows_int8,
 )
 from distributed_embeddings_tpu.serving.export import (
@@ -269,6 +271,39 @@ def test_int8_serve_error_bound(combiner):
   assert np.abs(want - got).max() > 0
 
 
+def test_fp8_roundtrip_error_bound():
+  rng = np.random.default_rng(2)
+  table = rng.standard_normal((200, 16)).astype(np.float32) * \
+      rng.uniform(0.01, 10.0, (200, 1)).astype(np.float32)
+  table[7] = 0.0  # all-zero row stays exactly zero
+  q = quantize_rows_fp8(table)
+  assert str(q.dtype) == "float8_e4m3fn" and q.shape == (200, 20)
+  deq = dequantize_rows_fp8(q)
+  amax = np.abs(table).max(axis=1, keepdims=True)
+  # e4m3: 3 mantissa bits -> per-element error <= 2^-4 * max|row|
+  assert np.all(np.abs(deq - table) <= amax * 2.0 ** -4 + 1e-12)
+  np.testing.assert_array_equal(deq[7], 0.0)
+
+
+@pytest.mark.parametrize("combiner", ["sum", "mean"])
+def test_fp8_serve_error_bound(combiner):
+  plan, rule, mesh, state, batch, weights = _fixture(4, combiner=combiner)
+  want, _ = _eval_preds(plan, rule, mesh, state, batch)
+  got, _ = _serve_preds(plan, rule, mesh, state, batch, "fp8")
+  off = 0
+  for t, (w, h) in enumerate(zip(weights, HOTNESS)):
+    width = w.shape[1]
+    a = want[:, off:off + width]
+    b = got[:, off:off + width]
+    # per row |err| <= 2^-4 * max|row| (the wire bound at row
+    # granularity); a sum-combined bag adds <= h rows
+    rows = h if combiner == "sum" else 1
+    bound = rows * (2.0 ** -4) * np.abs(w).max() + 1e-6
+    assert np.abs(a - b).max() <= bound, (t, np.abs(a - b).max(), bound)
+    off += width
+  assert np.abs(want - got).max() > 0
+
+
 # ---------------------------------------------------------------------------
 # tiered serving: device cache + stripped host image
 # ---------------------------------------------------------------------------
@@ -348,7 +383,7 @@ def test_tiered_serve_vs_all_device_eval(quantize):
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("quantize", ["f32", "int8"])
+@pytest.mark.parametrize("quantize", ["f32", "int8", "fp8"])
 def test_export_load_roundtrip(tmp_path, quantize):
   plan, rule, mesh, state, batch, _ = _fixture(2)
   path = os.path.join(str(tmp_path), "serve_art")
@@ -357,8 +392,10 @@ def test_export_load_roundtrip(tmp_path, quantize):
   art = serve_load(path, plan, mesh=mesh)
   assert art.quantize == quantize
   for name, blocks in frozen.device_blocks.items():
+    # byte view: the bit-packed scale lanes may hold NaN-patterned fp8
     np.testing.assert_array_equal(
-        np.asarray(art.state["serve"][name]), np.concatenate(blocks))
+        np.asarray(art.state["serve"][name]).view(np.uint8),
+        np.concatenate(blocks).view(np.uint8))
   # loaded artifact predicts identically to the in-memory frozen state
   sstate = frozen_device_state(frozen, plan, mesh)
   step = make_serve_step(ActsModel(), plan, frozen.meta, mesh, sstate,

@@ -1,0 +1,704 @@
+"""Streaming (online-learning) subsystem tests (`streaming/`).
+
+The contracts under test:
+
+- **delta = full re-export, bit for bit**: folding published deltas into
+  a running serve engine yields EXACTLY the artifact a full re-export at
+  the same watermark would — f32 bit-exact, int8/fp8 quant-exact (the
+  same bytes) — across raw/dedup/tiered layouts and world 1/2/4.
+- **the tracker's row set is exact**: rows the batches routed advance,
+  nothing else does; the delta ships exactly the advanced set.
+- **chain durability**: a torn (corrupt) delta is refused and skipped
+  with the failing field named; an out-of-order seq is refused; a
+  base_fingerprint mismatch is refused naming the field; a publish
+  killed by injected ``ckpt_write``/``ckpt_rename`` faults leaves only a
+  manifest-less ``.tmp`` the subscriber never reads, and the retried
+  publish converges it to the last valid delta.
+- **dynvocab rides the delta**: a raw id newly admitted by training is
+  servable after ONE delta cycle — no full re-export — through the
+  promoted read-only snapshot.
+- **live hot-set adaptation**: the publisher-shipped observed counts
+  re-rank the tiered serve cache through the prefetcher's re-rank
+  machinery, value-preservingly.
+- **copy-on-promote never pauses traffic**: a micro-batcher keeps
+  dispatching while deltas fold in; every request resolves.
+"""
+
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_embeddings_tpu import checkpoint
+from distributed_embeddings_tpu.layers.dist_model_parallel import (
+    set_weights,
+)
+from distributed_embeddings_tpu.layers.embedding import TableConfig
+from distributed_embeddings_tpu.layers.planner import DistEmbeddingStrategy
+from distributed_embeddings_tpu.models import DLRM
+from distributed_embeddings_tpu.models.dlrm import (
+    _dlrm_initializer,
+    bce_loss,
+)
+from distributed_embeddings_tpu.models.synthetic import power_law_ids
+from distributed_embeddings_tpu.dynvocab import DynVocabTranslator
+from distributed_embeddings_tpu.ops.packed_table import sparse_rule
+from distributed_embeddings_tpu.parallel import create_mesh
+from distributed_embeddings_tpu.parallel.lookup_engine import PAD_ID
+from distributed_embeddings_tpu.resilience import faultinject
+from distributed_embeddings_tpu.serving import (
+    MicroBatcher,
+    ServeEngine,
+    ServeTierConfig,
+)
+from distributed_embeddings_tpu.serving.export import export as serve_export
+from distributed_embeddings_tpu.serving.export import load as serve_load
+from distributed_embeddings_tpu.streaming import (
+    DeltaPublisher,
+    DeltaSubscriber,
+    RowGenerationTracker,
+    artifact_bytes,
+)
+from distributed_embeddings_tpu.telemetry import MetricsRegistry
+from distributed_embeddings_tpu.tiering import (
+    HostTierStore,
+    TieredTrainer,
+    TieringConfig,
+    TieringPlan,
+    init_tiered_state_from_params,
+)
+from distributed_embeddings_tpu.training import (
+    init_sparse_state,
+    make_sparse_train_step,
+    shard_batch,
+    shard_params,
+)
+
+
+class ActsModel:
+  """Embedding-activations stub: every table's rows visible in preds."""
+
+  def apply(self, variables, numerical, cats, emb_acts=None):
+    del variables, numerical, cats
+    return jnp.concatenate(list(emb_acts), axis=-1)
+
+
+def loss_fn(preds, labels):
+  return jnp.mean((jnp.sum(preds, axis=-1) - labels) ** 2)
+
+
+SIZES = [131, 97, 53, 40, 67]
+WIDTHS = [16, 16, 8, 8, 16]
+HOTNESS = [3, 1, 3, 2, 1]
+
+
+def _mkbatch(rng, b):
+  ids = []
+  for s, h in zip(SIZES, HOTNESS):
+    x = rng.integers(0, s, (b, h)).astype(np.int32)
+    x[rng.random(x.shape) < 0.25] = PAD_ID
+    ids.append(x)
+  return (rng.standard_normal((b, 4)).astype(np.float32), ids,
+          rng.integers(0, 2, b).astype(np.float32))
+
+
+def _device_run(tmp_path, world, quantize="f32", dedup=False,
+                pre_steps=2, post_steps=2, registry=None):
+  """Train, publish base, train more, publish a delta; returns the
+  pieces every device-tier test compares."""
+  rng = np.random.default_rng(world * 31 + (7 if dedup else 0))
+  tables = [TableConfig(s, w, combiner="sum")
+            for s, w in zip(SIZES, WIDTHS)]
+  plan = DistEmbeddingStrategy(tables, world, "memory_balanced",
+                               dense_row_threshold=0,
+                               input_hotness=HOTNESS,
+                               dedup_exchange=dedup)
+  weights = [rng.standard_normal((s, w)).astype(np.float32)
+             for s, w in zip(SIZES, WIDTHS)]
+  params = {"embeddings": {k: jnp.asarray(v)
+                           for k, v in set_weights(plan, weights).items()}}
+  rule = sparse_rule("adagrad", 0.05)
+  opt = optax.sgd(0.01)
+  mesh = create_mesh(world) if world > 1 else None
+  state = shard_params(init_sparse_state(plan, params, rule, opt), mesh)
+  b = 4 * world
+  batch0 = _mkbatch(rng, b)
+  step = make_sparse_train_step(ActsModel(), plan, loss_fn, opt, rule,
+                                mesh, state, batch0, donate=False)
+
+  pub = os.path.join(str(tmp_path), "pub")
+  tracker = RowGenerationTracker(plan)
+  publisher = DeltaPublisher(pub, plan, rule, tracker, quantize=quantize,
+                             telemetry=registry)
+
+  def train(state, n):
+    for _ in range(n):
+      batch = _mkbatch(rng, b)
+      publisher.observe_batch(batch[1])
+      state, _ = step(state, *shard_batch(batch, mesh))
+    return state
+
+  state = train(state, pre_steps)
+  publisher.publish_base(state)
+  sub = DeltaSubscriber.from_artifact(ActsModel(), plan, pub, mesh=mesh,
+                                      telemetry=registry)
+  state = train(state, post_steps)
+  assert publisher.publish_delta(state) is not None
+  return plan, rule, mesh, state, publisher, sub, rng, b
+
+
+def _full_engine(tmp_path, plan, rule, mesh, state, quantize,
+                 store=None, model=None, tier_config=None, vocab=None):
+  full = os.path.join(str(tmp_path), "full")
+  serve_export(full, plan, rule, state, quantize=quantize, store=store,
+               vocab=vocab)
+  art = serve_load(full, plan, mesh=mesh)
+  eng = ServeEngine(model or ActsModel(), plan, art, mesh=mesh,
+                    tier_config=tier_config)
+  return eng, art
+
+
+# ---------------------------------------------------------------------------
+# the tracker: exact row accounting
+# ---------------------------------------------------------------------------
+
+
+def test_tracker_rows_exact_and_watermarked():
+  plan = DistEmbeddingStrategy(
+      [TableConfig(64, 8, combiner="sum"), TableConfig(40, 8,
+                                                       combiner="sum")],
+      1, "basic", dense_row_threshold=0, input_hotness=[2, 1])
+  tracker = RowGenerationTracker(plan)
+  cats = [np.array([[3, 5], [3, PAD_ID]], np.int32),
+          np.array([[7], [7]], np.int32)]
+  c1 = tracker.observe(cats)
+  changed = tracker.changed_rows(0)
+  (name,) = changed  # both tables share one w8 class
+  rows = np.concatenate(changed[name])
+  # exactly the routed valid ids (table 1 offsets by table 0's rows)
+  off = {s[0]: s[1] for s in plan.routing_recipe(
+      list(plan.class_keys)[0])[0]}
+  want = sorted({3 + off[0], 5 + off[0], 7 + off[1]})
+  assert sorted(rows.tolist()) == want
+  # counts weigh occurrences (3 twice, 7 twice, 5 once)
+  cnt = tracker.counts[name][0]
+  assert cnt[3 + off[0]] == 2 and cnt[5 + off[0]] == 1 \
+      and cnt[7 + off[1]] == 2
+  # watermark filters: nothing advanced past c1
+  assert tracker.changed_row_total(c1) == 0
+  tracker.observe([np.array([[9, PAD_ID]], np.int32),
+                   np.full((1, 1), PAD_ID, np.int32)])
+  assert np.concatenate(
+      tracker.changed_rows(c1)[name]).tolist() == [9 + off[0]]
+
+
+# ---------------------------------------------------------------------------
+# delta == full re-export: the parity matrix
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("world", [1, 2, 4])
+@pytest.mark.parametrize("dedup", [False, True])
+def test_delta_parity_f32(tmp_path, world, dedup):
+  plan, rule, mesh, state, publisher, sub, rng, b = _device_run(
+      tmp_path, world, "f32", dedup)
+  engB, art = _full_engine(tmp_path, plan, rule, mesh, state, "f32")
+  assert sub.poll_once() == 1
+  for name, want in art.state["serve"].items():
+    np.testing.assert_array_equal(
+        np.asarray(sub.engine.state["serve"][name]), np.asarray(want))
+  probe = _mkbatch(rng, b)
+  np.testing.assert_array_equal(sub.predict(probe[0], probe[1]),
+                                engB.predict(probe[0], probe[1]))
+
+
+@pytest.mark.parametrize("quantize", ["int8", "fp8"])
+def test_delta_parity_quantized(tmp_path, quantize):
+  plan, rule, mesh, state, publisher, sub, rng, b = _device_run(
+      tmp_path, 2, quantize)
+  engB, art = _full_engine(tmp_path, plan, rule, mesh, state, quantize)
+  assert sub.poll_once() == 1
+  for name, want in art.state["serve"].items():
+    got = np.asarray(sub.engine.state["serve"][name])
+    # quant-exact: the same stored bytes, not merely close dequants
+    np.testing.assert_array_equal(got.view(np.uint8),
+                                  np.asarray(want).view(np.uint8))
+  probe = _mkbatch(rng, b)
+  np.testing.assert_array_equal(sub.predict(probe[0], probe[1]),
+                                engB.predict(probe[0], probe[1]))
+
+
+def test_multi_delta_chain(tmp_path):
+  """Three consecutive deltas applied in order land on the same state
+  as one full export; the chain fingerprints advance."""
+  plan, rule, mesh, state, publisher, sub, rng, b = _device_run(
+      tmp_path, 2, "f32")
+  assert sub.poll_once() == 1
+  fp1 = sub.fingerprint
+  step = make_sparse_train_step(ActsModel(), plan, loss_fn,
+                                optax.sgd(0.01), rule, mesh, state,
+                                _mkbatch(rng, b), donate=False)
+  for _ in range(2):
+    batch = _mkbatch(rng, b)
+    publisher.observe_batch(batch[1])
+    state, _ = step(state, *shard_batch(batch, mesh))
+    assert publisher.publish_delta(state) is not None
+  assert sub.poll_once() == 2
+  assert sub.applied_seq == 3 and sub.fingerprint != fp1
+  engB, art = _full_engine(tmp_path, plan, rule, mesh, state, "f32")
+  for name, want in art.state["serve"].items():
+    np.testing.assert_array_equal(
+        np.asarray(sub.engine.state["serve"][name]), np.asarray(want))
+
+
+def test_delta_bytes_far_below_full_export(tmp_path):
+  """On a churn workload (few rows advance per interval) the delta
+  payload is a small fraction of the full artifact."""
+  plan, rule, mesh, state, publisher, sub, rng, b = _device_run(
+      tmp_path, 2, "f32", pre_steps=2, post_steps=1)
+  base_bytes = artifact_bytes(os.path.join(sub.path, "base"))
+  assert publisher.last_publish_bytes < base_bytes / 2, \
+      (publisher.last_publish_bytes, base_bytes)
+
+
+# ---------------------------------------------------------------------------
+# tiered: images, prediction parity, hot-set adaptation
+# ---------------------------------------------------------------------------
+
+T_VOCAB = [2000, 300, 40]
+T_WIDTH = 16
+
+
+def _tiered_run(tmp_path, world, quantize, post_steps=2):
+  tables = [TableConfig(input_dim=v, output_dim=T_WIDTH,
+                        initializer=_dlrm_initializer(v)) for v in T_VOCAB]
+  plan = DistEmbeddingStrategy(tables, world, "memory_balanced",
+                               dense_row_threshold=0,
+                               host_row_threshold=1000)
+  model = DLRM(vocab_sizes=T_VOCAB, embedding_dim=T_WIDTH,
+               bottom_mlp=(32, T_WIDTH), top_mlp=(32, 1),
+               world_size=world, strategy="memory_balanced",
+               dense_row_threshold=0)
+  mesh = create_mesh(world) if world > 1 else None
+  rule = sparse_rule("adagrad", 0.05)
+  opt = optax.adam(1e-3)
+  rng = np.random.default_rng(world)
+
+  def batch(seed, n=32):
+    r = np.random.default_rng(seed)
+    return (r.standard_normal((n, 13)).astype(np.float32),
+            [power_law_ids(r, n, 1, v, 1.05).astype(np.int32)[:, 0]
+             for v in T_VOCAB],
+            r.integers(0, 2, n).astype(np.float32))
+
+  b0 = batch(100)
+  params_b = model.init(jax.random.PRNGKey(0), b0[0], b0[1])["params"]
+  # the model's own plan is untiered: remap its table weights onto the
+  # tiered plan's class layout (generation assignment differs)
+  from distributed_embeddings_tpu.layers.dist_model_parallel import (
+      get_weights)
+  plan_b = DistEmbeddingStrategy(tables, world, "memory_balanced",
+                                 dense_row_threshold=0)
+  tables_t = set_weights(plan, get_weights(plan_b,
+                                           params_b["embeddings"]))
+  params = {k: v for k, v in params_b.items() if k != "embeddings"}
+  params["embeddings"] = {k: jnp.asarray(v) for k, v in tables_t.items()}
+  tplan = TieringPlan(plan, rule, TieringConfig(cache_fraction=0.3,
+                                                staging_grps=64))
+  store = HostTierStore(tplan)
+  state = shard_params(init_tiered_state_from_params(
+      tplan, store, rule, params, opt, mesh=mesh), mesh)
+  trainer = TieredTrainer(model, tplan, store, bce_loss, opt, rule, mesh,
+                          state, b0, donate=False)
+  pub = os.path.join(str(tmp_path), "pub")
+  tracker = RowGenerationTracker(plan)
+  publisher = DeltaPublisher(pub, plan, rule, tracker, quantize=quantize,
+                             store=store)
+  for i in range(2):
+    bt = batch(100 + i)
+    publisher.observe_batch(bt[1])
+    trainer.step(*bt)
+  publisher.publish_base(trainer.state)
+  cfg = ServeTierConfig(cache_fraction=0.3, staging_grps=64)
+  sub = DeltaSubscriber.from_artifact(model, plan, pub, mesh=mesh,
+                                      tier_config=cfg, with_metrics=True)
+  for i in range(post_steps):
+    bt = batch(200 + i)
+    publisher.observe_batch(bt[1])
+    trainer.step(*bt)
+  assert publisher.publish_delta(trainer.state) is not None
+  return (plan, model, mesh, rule, trainer, store, publisher, sub, cfg,
+          batch)
+
+
+@pytest.mark.parametrize("world,quantize",
+                         [(1, "f32"), (2, "f32"), (4, "f32"), (4, "int8")])
+def test_delta_parity_tiered(tmp_path, world, quantize):
+  (plan, model, mesh, rule, trainer, store, publisher, sub, cfg,
+   batch) = _tiered_run(tmp_path, world, quantize)
+  assert sub.poll_once() == 1
+  full = os.path.join(str(tmp_path), "full")
+  serve_export(full, plan, rule, trainer.state, quantize=quantize,
+               store=store)
+  art = serve_load(full, plan, mesh=mesh)
+  # cold images: the delta fold reproduced the full export bit for bit
+  for name, images in art.host_images.items():
+    for r, img in enumerate(images):
+      np.testing.assert_array_equal(
+          sub.engine.store.images[name][r].view(np.uint8),
+          np.asarray(img).view(np.uint8))
+  engB = ServeEngine(model, plan, art, mesh=mesh, tier_config=cfg,
+                     with_metrics=True)
+  probe = batch(999)
+  pa, ma = sub.predict(probe[0], probe[1])
+  pb, _mb = engB.predict(probe[0], probe[1])
+  np.testing.assert_array_equal(pa, pb)
+  assert all(int(v[2]) == 0 for v in ma["tier"].values())  # no misses
+
+
+def test_tiered_hot_set_adapts_to_shipped_counts(tmp_path):
+  """The publisher's counts re-rank the serve cache: after the fold,
+  every rank's resident set is a top-count set under the shipped
+  signal (the prefetcher's own re-rank machinery, now exercised on the
+  serve path)."""
+  (plan, model, mesh, rule, trainer, store, publisher, sub, cfg,
+   batch) = _tiered_run(tmp_path, 2, "f32")
+  assert sub.poll_once() == 1
+  eng = sub.engine
+  shipped_total = 0
+  for name in eng.store.images:
+    c = eng.tplan.by_name(name)
+    for rank in range(plan.world_size):
+      counts = eng.store.counts[name][rank]
+      shipped_total += int(counts.sum())
+      resident = set(eng.store.resident_grps[name][rank].tolist())
+      assert len(resident) == c.spec.cache_grps
+      # no non-resident row outranks the weakest resident row
+      floor = min(int(counts[g]) for g in resident)
+      outside = np.delete(counts, sorted(resident))
+      assert outside.size == 0 or int(outside.max()) <= floor
+  # the shipped signal landed somewhere (a power-law stream may leave a
+  # cold rank's vocab window untouched — that rank's zeros are correct)
+  assert shipped_total > 0
+
+
+# ---------------------------------------------------------------------------
+# dynvocab: a newly admitted raw id is servable after one delta cycle
+# ---------------------------------------------------------------------------
+
+
+def test_dynvocab_new_id_servable_after_one_delta(tmp_path):
+  world = 2
+  sizes, widths, hot = [256, 40], [16, 8], [2, 1]
+
+  def mk(**kw):
+    return DistEmbeddingStrategy(
+        [TableConfig(s, w, combiner="sum") for s, w in zip(sizes, widths)],
+        world, "memory_balanced", dense_row_threshold=0,
+        input_hotness=hot, **kw)
+
+  plan = mk(oov="allocate", admit_threshold=1)
+  serve_plan = mk()  # same tables -> same fingerprint; serving clips
+  rng = np.random.default_rng(0)
+  weights = [rng.standard_normal((s, w)).astype(np.float32) * 0.1
+             for s, w in zip(sizes, widths)]
+  params = {"embeddings": {k: jnp.asarray(v)
+                           for k, v in set_weights(plan, weights).items()}}
+  rule = sparse_rule("adagrad", 0.05)
+  opt = optax.sgd(0.01)
+  mesh = create_mesh(world)
+  state = shard_params(init_sparse_state(plan, params, rule, opt), mesh)
+  b = 8
+
+  def mkbatch(extra_id=None):
+    ids = [rng.integers(0, 10**9, (b, h)).astype(np.int64) for h in hot]
+    if extra_id is not None:
+      ids[0][0, 0] = extra_id
+    return (rng.standard_normal((b, 4)).astype(np.float32), ids,
+            rng.integers(0, 2, b).astype(np.float32))
+
+  translator = DynVocabTranslator(plan, rule)
+  b0 = mkbatch()
+  cats0, _, _ = translator.translate_batch(b0[1])
+  step = make_sparse_train_step(ActsModel(), plan, loss_fn, opt, rule,
+                                mesh, state, (b0[0], cats0, b0[2]),
+                                donate=False)
+  pub = os.path.join(str(tmp_path), "pub")
+  tracker = RowGenerationTracker(plan)
+  publisher = DeltaPublisher(pub, plan, rule, tracker, quantize="f32",
+                             vocab=translator)
+
+  def train(state, raw):
+    cats_t, _, _ = translator.translate_batch(raw[1])
+    publisher.observe_batch(cats_t)  # the ids the STEP consumes
+    state, _ = step(state, *shard_batch((raw[0], cats_t, raw[2]), mesh))
+    return state
+
+  state = train(state, mkbatch())
+  publisher.publish_base(state)
+  sub = DeltaSubscriber.from_artifact(ActsModel(), serve_plan, pub,
+                                      mesh=mesh)
+  assert sub.translator is not None  # snapshot rode the base artifact
+
+  new_id = 987_654_321
+  probe = mkbatch(new_id)
+  assert sub.translator.translate(
+      [np.asarray(c) for c in probe[1]])[0][0, 0] == PAD_ID
+  p_before = sub.predict(probe[0], probe[1])
+
+  state = train(state, probe)  # admits new_id, trains its row
+  assert publisher.publish_delta(state) is not None
+  assert sub.poll_once() == 1  # ONE delta cycle, no full re-export
+
+  row = sub.translator.translate(
+      [np.asarray(c) for c in probe[1]])[0][0, 0]
+  assert row >= 0  # servable: the promoted snapshot maps it
+  p_after = sub.predict(probe[0], probe[1])
+  assert not np.array_equal(p_before[0], p_after[0])
+
+  # and the delta-cycled engine agrees with a full re-export + readonly
+  # translation of the same state
+  engB, art = _full_engine(tmp_path, serve_plan, rule, mesh, state,
+                           "f32", vocab=translator)
+  cats_ro = art.vocab.translate([np.asarray(c) for c in probe[1]])
+  np.testing.assert_array_equal(p_after, engB.predict(probe[0], cats_ro))
+
+
+# ---------------------------------------------------------------------------
+# chain durability: torn, out-of-order, forked, faulted
+# ---------------------------------------------------------------------------
+
+
+def test_torn_delta_refused_and_skipped(tmp_path):
+  reg = MetricsRegistry()
+  plan, rule, mesh, state, publisher, sub, rng, b = _device_run(
+      tmp_path, 2, "f32", registry=reg)
+  dpath = os.path.join(sub.path, "delta_000001")
+  victim = sorted(f for f in os.listdir(dpath)
+                  if f.startswith("rows_"))[0]
+  faultinject.bitflip_file(os.path.join(dpath, victim))
+  probe = _mkbatch(rng, b)
+  before = sub.predict(probe[0], probe[1])
+  assert sub.poll_once() == 0  # refused, not applied, not crashed
+  assert sub.applied_seq == 0
+  assert sub.last_refusal["field"] == "checksums"
+  assert victim in sub.last_refusal["reason"]
+  assert reg.counter("stream/deltas_refused").value == 1
+  # still serving the last valid artifact
+  np.testing.assert_array_equal(sub.predict(probe[0], probe[1]), before)
+
+
+def test_manifestless_tmp_dir_ignored(tmp_path):
+  plan, rule, mesh, state, publisher, sub, rng, b = _device_run(
+      tmp_path, 1, "f32")
+  assert sub.poll_once() == 1
+  # a crashed publish leaves a manifest-less .tmp: never even considered
+  os.makedirs(os.path.join(sub.path, "delta_000002.tmp"))
+  assert sub.poll_once() == 0
+  assert sub.last_refusal is None
+
+
+def test_out_of_order_seq_refused(tmp_path):
+  import shutil
+  plan, rule, mesh, state, publisher, sub, rng, b = _device_run(
+      tmp_path, 1, "f32")
+  step = make_sparse_train_step(ActsModel(), plan, loss_fn,
+                                optax.sgd(0.01), rule, mesh, state,
+                                _mkbatch(rng, b), donate=False)
+  batch = _mkbatch(rng, b)
+  publisher.observe_batch(batch[1])
+  state, _ = step(state, *shard_batch(batch, mesh))
+  publisher.publish_delta(state)
+  shutil.rmtree(os.path.join(sub.path, "delta_000001"))
+  assert sub.poll_once() == 0
+  assert sub.last_refusal["field"] == "seq"
+  assert sub.applied_seq == 0
+
+
+def test_base_fingerprint_mismatch_refused_naming_field(tmp_path):
+  import json
+  plan, rule, mesh, state, publisher, sub, rng, b = _device_run(
+      tmp_path, 1, "f32")
+  mpath = os.path.join(sub.path, "delta_000001", "manifest.json")
+  with open(mpath) as f:
+    manifest = json.load(f)
+  manifest["base_fingerprint"] = "f" * 64  # a fork/replay
+  with open(mpath, "w") as f:
+    json.dump(manifest, f)
+  assert sub.poll_once() == 0
+  assert sub.last_refusal["field"] == "base_fingerprint"
+  assert "base_fingerprint" in sub.last_refusal["reason"]
+
+
+def test_out_of_bounds_delta_rows_refused(tmp_path):
+  """A delta whose row indices fall outside the class geometry is
+  refused with the field named — a silent device scatter-drop would
+  break the delta==re-export invariant, and a raw host IndexError
+  would loop the poll thread instead of recording a refusal. The file
+  is re-sealed (manifest crc updated), so only the bounds check can
+  catch it."""
+  import json
+  plan, rule, mesh, state, publisher, sub, rng, b = _device_run(
+      tmp_path, 1, "f32")
+  dpath = os.path.join(sub.path, "delta_000001")
+  victim = sorted(f for f in os.listdir(dpath)
+                  if f.startswith("rows_"))[0]
+  fpath = os.path.join(dpath, victim)
+  with np.load(fpath) as z:
+    idx, data = np.asarray(z["idx"]), np.asarray(z["data"])
+  idx[-1] = 10**9
+  np.savez(fpath, idx=idx, data=data)
+  mpath = os.path.join(dpath, "manifest.json")
+  with open(mpath) as f:
+    manifest = json.load(f)
+  manifest["checksums"][victim] = checkpoint._crc32_file(fpath)
+  with open(mpath, "w") as f:
+    json.dump(manifest, f)
+  assert sub.poll_once() == 0
+  assert sub.applied_seq == 0
+  assert sub.last_refusal["field"] == "rows"
+  assert "1000000000" in sub.last_refusal["reason"]
+
+
+@pytest.mark.parametrize("site", ["ckpt_write", "ckpt_rename"])
+def test_faulted_publish_retries_and_converges(tmp_path, site):
+  plan, rule, mesh, state, publisher, sub, rng, b = _device_run(
+      tmp_path, 2, "f32")
+  assert sub.poll_once() == 1
+  step = make_sparse_train_step(ActsModel(), plan, loss_fn,
+                                optax.sgd(0.01), rule, mesh, state,
+                                _mkbatch(rng, b), donate=False)
+  batch = _mkbatch(rng, b)
+  publisher.observe_batch(batch[1])
+  state, _ = step(state, *shard_batch(batch, mesh))
+  seq_before = publisher.seq
+  # 0-indexed: ckpt_rename fires once per publish, ckpt_write per file —
+  # crash the first event either way
+  inj = faultinject.FaultInjector().crash_after(site, 0)
+  with faultinject.injected(inj):
+    with pytest.raises(faultinject.InjectedCrash):
+      publisher.publish_delta(state)
+  # the chain did not advance; nothing published the subscriber can see
+  assert publisher.seq == seq_before
+  assert sub.poll_once() == 0
+  # retry (fault cleared) publishes the SAME seq; subscriber converges
+  assert publisher.publish_delta(state) is not None
+  assert sub.poll_once() == 1
+  engB, art = _full_engine(tmp_path, plan, rule, mesh, state, "f32")
+  for name, want in art.state["serve"].items():
+    np.testing.assert_array_equal(
+        np.asarray(sub.engine.state["serve"][name]), np.asarray(want))
+
+
+def test_publisher_rebase_resets_chain(tmp_path):
+  """A restarted publisher (no tracker history) re-roots with a new
+  base; the subscriber detects the fingerprint change and rebases."""
+  plan, rule, mesh, state, publisher, sub, rng, b = _device_run(
+      tmp_path, 1, "f32")
+  assert sub.poll_once() == 1
+  old_base_fp = sub.base_fingerprint
+  # restart: fresh tracker/publisher, one more step, publish_base anew
+  tracker2 = RowGenerationTracker(plan)
+  pub2 = DeltaPublisher(sub.path, plan, rule, tracker2, quantize="f32")
+  step = make_sparse_train_step(ActsModel(), plan, loss_fn,
+                                optax.sgd(0.01), rule, mesh, state,
+                                _mkbatch(rng, b), donate=False)
+  batch = _mkbatch(rng, b)
+  tracker2.observe(batch[1])
+  state, _ = step(state, *shard_batch(batch, mesh))
+  pub2.publish_base(state)
+  assert sub.poll_once() >= 1  # the rebase
+  assert sub.base_fingerprint != old_base_fp
+  assert sub.applied_seq == 0
+  engB, art = _full_engine(tmp_path, plan, rule, mesh, state, "f32")
+  probe = _mkbatch(rng, b)
+  np.testing.assert_array_equal(sub.predict(probe[0], probe[1]),
+                                engB.predict(probe[0], probe[1]))
+
+
+# ---------------------------------------------------------------------------
+# copy-on-promote under live traffic
+# ---------------------------------------------------------------------------
+
+
+def test_promote_under_concurrent_batcher_traffic(tmp_path):
+  """Deltas fold in while a micro-batcher keeps dispatching: every
+  request resolves, no dispatch ever mixes old and new state (the lock
+  pairs translate+dispatch with a consistent snapshot), and the final
+  state equals the full re-export."""
+  reg = MetricsRegistry()  # isolated: the freshness count is asserted
+  plan, rule, mesh, state, publisher, sub, rng, b = _device_run(
+      tmp_path, 2, "f32", registry=reg)
+  step = make_sparse_train_step(ActsModel(), plan, loss_fn,
+                                optax.sgd(0.01), rule, mesh, state,
+                                _mkbatch(rng, b), donate=False)
+  batcher = MicroBatcher(sub.dispatch, max_batch=b, max_delay_s=0.001,
+                         registry=MetricsRegistry())
+  stop = threading.Event()
+  failures = []
+
+  def client():
+    r = np.random.default_rng(threading.get_ident() % 2**31)
+    while not stop.is_set():
+      n = int(r.integers(1, b + 1))
+      batch = _mkbatch(np.random.default_rng(int(r.integers(2**31))), n)
+      try:
+        fut = batcher.submit(batch[0], batch[1])
+        fut.result(timeout=30.0)
+      except Exception as e:  # noqa: BLE001 — collected for the assert
+        from distributed_embeddings_tpu.serving import Rejected
+        if not isinstance(e, Rejected):
+          failures.append(e)
+
+  threads = [threading.Thread(target=client) for _ in range(3)]
+  for t in threads:
+    t.start()
+  sub.start()
+  try:
+    for _ in range(3):
+      batch = _mkbatch(rng, b)
+      publisher.observe_batch(batch[1])
+      state, _ = step(state, *shard_batch(batch, mesh))
+      publisher.publish_delta(state)
+  finally:
+    stop.set()
+    for t in threads:
+      t.join(timeout=30.0)
+    sub.stop()
+    batcher.close()
+  assert not failures, failures
+  assert sub.last_error is None
+  assert sub.applied_seq == publisher.seq  # converged under load
+  engB, art = _full_engine(tmp_path, plan, rule, mesh, state, "f32")
+  for name, want in art.state["serve"].items():
+    np.testing.assert_array_equal(
+        np.asarray(sub.engine.state["serve"][name]), np.asarray(want))
+  assert sub.freshness.count == publisher.seq
+  assert np.isfinite(sub.freshness.p99)
+
+
+def test_batcher_dispatch_fn_swap_between_flushes():
+  calls = []
+
+  def d1(numerical, cats):
+    calls.append(1)
+    return np.zeros((numerical.shape[0], 1))
+
+  def d2(numerical, cats):
+    calls.append(2)
+    return np.ones((numerical.shape[0], 1))
+
+  mb = MicroBatcher(d1, max_batch=4, start=False,
+                    registry=MetricsRegistry())
+  mb.submit(np.zeros((2, 1)), [np.zeros((2, 1), np.int32)])
+  mb.flush_now()
+  mb.set_dispatch_fn(d2)
+  fut = mb.submit(np.zeros((2, 1)), [np.zeros((2, 1), np.int32)])
+  mb.flush_now()
+  assert calls == [1, 2]
+  np.testing.assert_array_equal(fut.result(), np.ones((2, 1)))
+  mb.close()

@@ -485,3 +485,97 @@ def test_checkpoint_telemetry_section_roundtrip(tmp_path):
   checkpoint.save(path2, plan, rule, state)
   checkpoint.restore(path2, plan, rule, state,
                      telemetry=MetricsRegistry())
+
+
+# ---------------------------------------------------------------------------
+# histogram bucket-collapse (bounded cardinality for unbounded streams)
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_bucket_collapse_bounds_cardinality():
+  # At rel_err=0.05 a bucket covers ~4.3% of a decade, so 64 buckets
+  # span ~2.8 decades; eight decades of uniform-log input would occupy
+  # ~185 buckets unbounded. The collapse folds the LOWEST buckets, so
+  # the top of the distribution keeps its bound while small values
+  # degrade (upward — never under-reported).
+  h = telemetry.Histogram("stream/freshness_s", rel_err=0.05,
+                          max_buckets=64)
+  rng = np.random.default_rng(0)
+  xs = 10.0 ** rng.uniform(-4, 4, 5000)
+  h.observe_many(xs.tolist())
+  assert len(h._buckets) <= 64
+  # exact aggregates survive the collapse
+  assert h.count == 5000
+  assert abs(h.sum - xs.sum()) < 1e-6 * xs.sum()
+  assert h.min == xs.min() and h.max == xs.max()
+  ordered = np.sort(xs)
+  # p99 lives in the intact top buckets: the rel_err bound holds
+  exact99 = ordered[max(1, math.ceil(0.99 * 5000)) - 1]
+  assert abs(h.percentile(99.0) - exact99) <= 0.0501 * exact99
+  # below the collapse boundary estimates degrade, but only UPWARD (a
+  # lag histogram that can only over-report staleness stays safe to
+  # alert on)
+  exact50 = ordered[max(1, math.ceil(0.5 * 5000)) - 1]
+  assert h.percentile(50.0) >= exact50 * (1.0 - 0.05)
+  # state round-trips the collapse accounting
+  h2 = telemetry.Histogram("x", rel_err=0.05, max_buckets=64)
+  h2.load(h.state())
+  assert h2.percentile(99.0) == h.percentile(99.0)
+  assert h2._collapsed == h._collapsed > 0
+
+
+def test_histogram_collapse_needs_two_buckets():
+  with pytest.raises(ValueError, match="max_buckets"):
+    telemetry.Histogram("h", max_buckets=1)
+
+
+def test_registry_histogram_max_buckets_policy():
+  reg = telemetry.MetricsRegistry()
+  h = reg.histogram("stream/freshness_s", max_buckets=8)
+  # readers with the default None get the same (bounded) histogram
+  assert reg.histogram("stream/freshness_s") is h
+  # an unbounded histogram adopts the FIRST explicit bound...
+  u = reg.histogram("serve/latency_s")
+  for v in (1e-6, 1e-3, 1.0, 1e3, 1e6):
+    u.observe(v)
+  assert reg.histogram("serve/latency_s", max_buckets=4) is u
+  assert u.max_buckets == 4 and len(u._buckets) <= 4
+  # ...but two different explicit bounds are a loud conflict
+  with pytest.raises(ValueError, match="max_buckets"):
+    reg.histogram("stream/freshness_s", max_buckets=32)
+
+
+# ---------------------------------------------------------------------------
+# live /metrics scrape endpoint
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_http_endpoint_serves_and_shuts_down_clean():
+  import urllib.error
+  import urllib.request
+
+  reg = telemetry.MetricsRegistry()
+  reg.counter("stream/deltas_applied").inc(5)
+  reg.gauge("vocab/occupancy/t0").set(17.0)
+  reg.histogram("serve/latency_s").observe_many([0.001, 0.004, 0.2])
+  with telemetry.MetricsServer(reg) as server:
+    assert server.port > 0
+    body = urllib.request.urlopen(server.url, timeout=5).read().decode()
+    assert "# TYPE stream_deltas_applied counter" in body
+    assert "stream_deltas_applied 5" in body
+    assert "vocab_occupancy_t0 17.0" in body
+    assert 'serve_latency_s{quantile="0.99"}' in body
+    # same content as the textfile renderer: one schema, two transports
+    assert body == telemetry.prometheus_text(reg)
+    health = urllib.request.urlopen(
+        f"http://{server.host}:{server.port}/healthz", timeout=5).read()
+    assert health == b"ok\n"
+    with pytest.raises(urllib.error.HTTPError):
+      urllib.request.urlopen(
+          f"http://{server.host}:{server.port}/nope", timeout=5)
+    port = server.port
+  # shutdown-clean: thread joined, socket closed, port refused
+  assert server.closed
+  with pytest.raises(OSError):
+    urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=2)
+  server.close()  # idempotent

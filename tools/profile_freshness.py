@@ -1,0 +1,289 @@
+"""Online-learning freshness bench: train -> delta-publish -> serve, live.
+
+The measurement the streaming subsystem exists for: a trainer keeps
+stepping on a power-law churn stream while a SEPARATE serving stack — a
+``ServeEngine`` fed by a ``DeltaSubscriber`` poll thread, fronted by the
+``MicroBatcher`` with client threads submitting concurrent requests —
+adopts row-granular deltas published every few steps. Reported:
+
+- **freshness**: the ``stream/freshness_s`` histogram (train-step ->
+  servable wall lag, measured per promotion from the publisher's wall
+  anchors), under the concurrent serve load — p50/p99/max;
+- **delta economy**: mean delta bytes vs the full base-export bytes on
+  the churn workload (row-granular publication only pays for rows the
+  interval's batches actually touched);
+- **convergence + exactness**: every published delta applied, zero
+  refusals, zero dropped requests, and the delta-folded serve state
+  byte-identical to a full re-export at the final watermark;
+- **live scrape**: the registry's ``/metrics`` HTTP endpoint serves the
+  stream counters while the loop runs.
+
+Acceptance (docs/BENCHMARKS.md round 11): mean delta bytes <= 50% of the
+full-export bytes (expected far below), all deltas applied with the
+delta-folded state bit-exact vs re-export, and finite freshness
+percentiles. ``--smoke`` is the ``make verify`` tier: tiny world, same
+structural assertions.
+
+Usage: PYTHONPATH=/root/repo python tools/profile_freshness.py [--smoke]
+"""
+
+import argparse
+import os
+import sys
+import threading
+import urllib.request
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+  os.environ["XLA_FLAGS"] = (
+      flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from distributed_embeddings_tpu import telemetry  # noqa: E402
+from distributed_embeddings_tpu.layers.dist_model_parallel import (  # noqa: E402
+    set_weights,
+)
+from distributed_embeddings_tpu.layers.embedding import TableConfig  # noqa: E402
+from distributed_embeddings_tpu.layers.planner import (  # noqa: E402
+    DistEmbeddingStrategy,
+)
+from distributed_embeddings_tpu.models.synthetic import power_law_ids  # noqa: E402
+from distributed_embeddings_tpu.ops.packed_table import sparse_rule  # noqa: E402
+from distributed_embeddings_tpu.parallel import create_mesh  # noqa: E402
+from distributed_embeddings_tpu.serving import (  # noqa: E402
+    MicroBatcher,
+    Rejected,
+    ServeEngine,
+)
+from distributed_embeddings_tpu.serving.export import (  # noqa: E402
+    export as serve_export,
+)
+from distributed_embeddings_tpu.serving.export import (  # noqa: E402
+    load as serve_load,
+)
+from distributed_embeddings_tpu.streaming import (  # noqa: E402
+    DeltaPublisher,
+    DeltaSubscriber,
+    RowGenerationTracker,
+    artifact_bytes,
+)
+from distributed_embeddings_tpu.training import (  # noqa: E402
+    init_sparse_state,
+    make_sparse_train_step,
+    shard_batch,
+    shard_params,
+)
+
+
+class ActsModel:
+  """Embedding activations straight through — the serve path's row
+  bytes are the whole workload, which is what freshness prices."""
+
+  def apply(self, variables, numerical, cats, emb_acts=None):
+    del variables, numerical, cats
+    return jnp.concatenate(list(emb_acts), axis=-1)
+
+
+def loss_fn(preds, labels):
+  return jnp.mean((jnp.sum(preds, axis=-1) - labels) ** 2)
+
+
+def churn_batch(rng, sizes, hotness, b, step, drift=0.01):
+  """Power-law head + a tail window drifting with ``step`` — each
+  interval touches the hot head plus a moving sliver of the tail."""
+  cats = []
+  for s, h in zip(sizes, hotness):
+    ids = power_law_ids(rng, b, h, s, 1.1).astype(np.int32)
+    shift = int(step * drift * s)
+    tail = rng.random(ids.shape) < 0.15
+    ids[tail] = (ids[tail] + shift) % s
+    cats.append(ids)
+  numerical = rng.standard_normal((b, 4)).astype(np.float32)
+  labels = rng.integers(0, 2, b).astype(np.float32)
+  return numerical, cats, labels
+
+
+def run(world, sizes, hotness, intervals, steps_per_interval, b,
+        quantize, pubdir, n_clients=2):
+  rng = np.random.default_rng(0)
+  widths = [16] * len(sizes)
+  tables = [TableConfig(s, w, combiner="sum")
+            for s, w in zip(sizes, widths)]
+  plan = DistEmbeddingStrategy(tables, world, "memory_balanced",
+                               dense_row_threshold=0,
+                               input_hotness=hotness)
+  weights = [rng.standard_normal((s, w)).astype(np.float32) * 0.1
+             for s, w in zip(sizes, widths)]
+  params = {"embeddings": {k: jnp.asarray(v)
+                           for k, v in set_weights(plan, weights).items()}}
+  rule = sparse_rule("adagrad", 0.05)
+  opt = optax.sgd(0.01)
+  mesh = create_mesh(world) if world > 1 else None
+  state = shard_params(init_sparse_state(plan, params, rule, opt), mesh)
+  batch0 = churn_batch(rng, sizes, hotness, b, 0)
+  step_fn = make_sparse_train_step(ActsModel(), plan, loss_fn, opt, rule,
+                                   mesh, state, batch0, donate=False)
+
+  registry = telemetry.MetricsRegistry()
+  tracker = RowGenerationTracker(plan)
+  publisher = DeltaPublisher(pubdir, plan, rule, tracker,
+                             quantize=quantize, telemetry=registry)
+
+  # warm + root the chain
+  step_no = 0
+  for _ in range(steps_per_interval):
+    batch = churn_batch(rng, sizes, hotness, b, step_no)
+    publisher.observe_batch(batch[1])
+    state, _ = step_fn(state, *shard_batch(batch, mesh))
+    step_no += 1
+  publisher.publish_base(state)
+  base_bytes = artifact_bytes(os.path.join(pubdir, "base"))
+
+  sub = DeltaSubscriber.from_artifact(ActsModel(), plan, pubdir,
+                                      mesh=mesh, poll_interval_s=0.01,
+                                      telemetry=registry).start()
+  batcher = MicroBatcher(sub.dispatch, max_batch=b, max_delay_s=0.002,
+                         registry=registry)
+  scrape = telemetry.MetricsServer(registry)
+
+  stop = threading.Event()
+  client_failures = []
+  served = [0]
+
+  def client(seed):
+    r = np.random.default_rng(seed)
+    while not stop.is_set():
+      n = int(r.integers(1, b + 1))
+      numerical, cats, _ = churn_batch(r, sizes, hotness, n,
+                                       int(r.integers(0, 100)))
+      try:
+        batcher.submit(numerical, cats).result(timeout=60.0)
+        served[0] += 1  # benign race: a throughput indicator, not a pin
+      except Rejected:
+        pass  # load shed is counted by the batcher itself
+      except Exception as e:  # noqa: BLE001 — collected for the verdict
+        client_failures.append(repr(e))
+        return
+
+  clients = [threading.Thread(target=client, args=(1000 + i,),
+                              daemon=True) for i in range(n_clients)]
+  for c in clients:
+    c.start()
+
+  delta_bytes = []
+  try:
+    with telemetry.timed("fresh/loop", registry):
+      for _ in range(intervals):
+        for _ in range(steps_per_interval):
+          batch = churn_batch(rng, sizes, hotness, b, step_no)
+          publisher.observe_batch(batch[1])
+          state, _ = step_fn(state, *shard_batch(batch, mesh))
+          step_no += 1
+        if publisher.publish_delta(state) is not None:
+          delta_bytes.append(publisher.last_publish_bytes)
+    # let the poll thread drain the tail of the chain
+    deadline_polls = 500
+    while sub.applied_seq < publisher.seq and deadline_polls > 0:
+      stop.wait(0.02)
+      deadline_polls -= 1
+    scrape_text = urllib.request.urlopen(scrape.url, timeout=5
+                                         ).read().decode()
+  finally:
+    stop.set()
+    for c in clients:
+      c.join(timeout=30.0)
+    batcher.close()
+    sub.stop()
+    scrape.close()
+
+  # exactness: the delta-folded serve state == a full re-export now
+  full = os.path.join(pubdir, "full_reexport")
+  serve_export(full, plan, rule, state, quantize=quantize)
+  art = serve_load(full, plan, mesh=mesh)
+  bit_exact = all(
+      np.array_equal(np.asarray(sub.engine.state["serve"][n]).view(np.uint8),
+                     np.asarray(a).view(np.uint8))
+      for n, a in art.state["serve"].items())
+
+  fresh = sub.freshness
+  stats = batcher.stats
+  return {
+      "world": world,
+      "quantize": quantize,
+      "train_steps": step_no,
+      "deltas_published": publisher.seq,
+      "deltas_applied": sub.applied_seq,
+      "refusals": registry.counter("stream/deltas_refused").value,
+      "requests_completed": stats["completed"],
+      "requests_rejected": stats["rejected"],
+      "client_failures": client_failures,
+      "served_during_stream": served[0],
+      "freshness_s": {
+          "count": fresh.count,
+          "p50": fresh.p50,
+          "p99": fresh.p99,
+          "max": fresh.max,
+      },
+      "base_bytes": base_bytes,
+      "delta_bytes_mean": (float(np.mean(delta_bytes))
+                           if delta_bytes else 0.0),
+      "delta_bytes_max": (int(np.max(delta_bytes)) if delta_bytes else 0),
+      "delta_to_full_ratio": (float(np.mean(delta_bytes)) / base_bytes
+                              if delta_bytes else 0.0),
+      "bit_exact_vs_reexport": bool(bit_exact),
+      "metrics_scrape_ok": "stream_freshness_s" in scrape_text,
+      "loop_s": registry.histogram("fresh/loop").sum,
+  }
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument("--smoke", action="store_true",
+                  help="tiny-world make-verify tier (same assertions)")
+  ap.add_argument("--quantize", default="int8",
+                  choices=["f32", "int8", "fp8"])
+  args = ap.parse_args()
+
+  import tempfile
+  pubdir = tempfile.mkdtemp(prefix="fresh_bench_")
+  if args.smoke:
+    result = run(world=2, sizes=[4000, 600], hotness=[2, 1],
+                 intervals=3, steps_per_interval=2, b=16,
+                 quantize=args.quantize, pubdir=pubdir, n_clients=2)
+  else:
+    result = run(world=4, sizes=[50000, 8000, 1200], hotness=[3, 2, 1],
+                 intervals=8, steps_per_interval=4, b=64,
+                 quantize=args.quantize, pubdir=pubdir, n_clients=3)
+
+  checks = {
+      "all_deltas_applied": bool(result["deltas_published"] > 0
+                                 and result["deltas_applied"]
+                                 == result["deltas_published"]
+                                 and result["refusals"] == 0),
+      "no_client_failures": not result["client_failures"],
+      "requests_served": bool(result["requests_completed"] > 0),
+      "bit_exact_vs_reexport": result["bit_exact_vs_reexport"],
+      "freshness_measured": bool(
+          result["freshness_s"]["count"] == result["deltas_published"]
+          and np.isfinite(result["freshness_s"]["p99"])),
+      "delta_bytes_below_half_full": bool(
+          result["delta_to_full_ratio"] < 0.5),
+      "metrics_scrape_ok": bool(result["metrics_scrape_ok"]),
+  }
+  result["checks"] = checks
+  result["ok"] = all(checks.values())
+  sys.exit(telemetry.emit_verdict("fresh_bench", result))
+
+
+if __name__ == "__main__":
+  main()
