@@ -6,8 +6,8 @@ PY ?= python
 SHELL := /bin/bash  # verify uses pipefail/PIPESTATUS
 
 .PHONY: test test-fast verify lint native bench dryrun chaos chaos-kill \
-	serve-bench serve-smoke vocab-bench vocab-smoke obs-bench obs-smoke \
-	fresh-bench fresh-smoke clean
+	chaos-stream stream-smoke serve-bench serve-smoke vocab-bench \
+	vocab-smoke obs-bench obs-smoke fresh-bench fresh-smoke clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -63,6 +63,24 @@ obs-smoke:
 	PYTHONPATH=$(CURDIR):$$PYTHONPATH timeout -k 10 300 \
 	  $(PY) tools/profile_telemetry.py --smoke
 
+# streaming chaos: SIGKILL the trainer mid-publish (torn delta tmp), the
+# compactor mid-fold, and the subscriber mid-promote; relaunch each and
+# assert the folded serve state is bit-exact vs an unkilled reference at
+# the same watermark, the chain fingerprints sha256-continuous across
+# the trainer kill (publisher ATTACH, no re-root), and cold start from
+# the compacted base+tail converges (tools/chaos_stream.py; the long
+# variant is @pytest.mark.slow in tests/test_streaming.py)
+chaos-stream:
+	$(PY) tools/chaos_stream.py
+
+# the make-verify tier of the streaming chaos: 2 worker subprocesses
+# (the mid-publish SIGKILL + attach relaunch), subscriber fold and
+# compaction checked in-driver — same bit-exactness assertions,
+# timeout-guarded like the other smoke tiers
+stream-smoke:
+	PYTHONPATH=$(CURDIR):$$PYTHONPATH timeout -k 10 480 \
+	  $(PY) tools/chaos_stream.py --smoke
+
 # online-learning freshness bench: trainer publishes row-granular deltas
 # while a live subscriber+batcher serve concurrent traffic — measures
 # train-step->servable lag (stream/freshness_s), delta bytes vs the
@@ -80,7 +98,7 @@ fresh-smoke:
 # the tier-1 gate, exactly as ROADMAP.md specifies it (CPU mesh, no slow
 # tests, collection errors surfaced but not fatal to the log); lint runs
 # first so invariant violations fail fast, then the smoke tiers
-verify: lint serve-smoke vocab-smoke obs-smoke fresh-smoke
+verify: lint serve-smoke vocab-smoke obs-smoke fresh-smoke stream-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

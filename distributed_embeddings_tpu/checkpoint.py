@@ -429,7 +429,8 @@ def _restore_elastic(path: str, manifest: Dict[str, Any],
                      plan: DistEmbeddingStrategy, rule: SparseRule,
                      state_like: Dict[str, Any],
                      mesh: Optional[Mesh], axis_name: str,
-                     store, vocab=None, telemetry=None) -> Dict[str, Any]:
+                     store, vocab=None, telemetry=None,
+                     stream=None) -> Dict[str, Any]:
   """Load a world-N checkpoint onto a world-M plan by re-slicing rank
   blocks at LOGICAL-row granularity.
 
@@ -631,6 +632,13 @@ def _restore_elastic(path: str, manifest: Dict[str, Any],
   # counters are world-shape-free facts about the run, same treatment
   _load_vocab(path, manifest, vocab)
   _load_telemetry(manifest, telemetry)
+  # the STREAM section, by contrast, is deliberately NOT adopted across
+  # an elastic re-shard: the delta chain's plan fingerprint changed with
+  # the world shape, so the saved chain cannot be continued — every
+  # published delta would refuse the new plan. The publisher stays fresh
+  # and must re-root with publish_base (subscribers rebase) — the
+  # designed degradation for a resize, documented in ARCHITECTURE §19.
+  del stream
 
   parts = {}
   for part in ("dense", "dense_opt", "emb_dense", "emb_dense_opt"):
@@ -766,7 +774,7 @@ def publish_manifest_last(tmp: str, path: str,
 def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
          state: Dict[str, Any], store=None,
          extra: Optional[Dict[str, Any]] = None, vocab=None,
-         telemetry=None) -> None:
+         telemetry=None, stream=None) -> None:
   """Write the full fused train state under directory ``path``.
 
   Atomicity: everything is written into ``path + '.tmp'`` and renamed at
@@ -815,6 +823,19 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
   ResilientTrainer's first resume — adopts the persisted values, so a
   run's metrics survive restarts without double-counting (the
   dynvocab-totals pattern, generalized to every metric surface).
+
+  Streaming (``streaming/``): pass the run's ``DeltaPublisher`` as
+  ``stream``. Its chain state (last published seq, the sha256 chain
+  fingerprints, the publication watermark) rides the manifest as a
+  ``stream`` section next to ``vocab``/``telemetry``, and the
+  generation tracker's row stamps + observed counts are sealed as
+  ``stream.npz`` through the same crc32-manifest-last protocol —
+  ``restore(..., stream=publisher)`` loads them back so a killed and
+  auto-resumed trainer RE-JOINS its existing delta chain
+  (``publisher.attach()``) instead of re-rooting it and forcing every
+  subscriber through a full-artifact rebase. The publisher is
+  single-controller host state (like the translator), so process 0
+  writes it.
   """
   engine = DistributedLookup(plan)
   tiered_names = frozenset(store.tplan.tier_specs) if store is not None \
@@ -933,6 +954,18 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
         np.savez(fpath, **vocab.state_arrays())
         _seal(fpath)
 
+    stream_meta = None
+    if stream is not None:
+      # the publisher's chain state + generation stamps: host state of
+      # the (single-controller) publishing process, written by p0 like
+      # the id space — captured HERE so the manifest's seq/watermark and
+      # the npz's row stamps are one consistent point in time
+      stream_meta = stream.manifest_section()
+      if p0:
+        fpath = os.path.join(tmp, "stream.npz")
+        np.savez(fpath, **stream.state_arrays())
+        _seal(fpath)
+
     if p0:
       for part in ("dense", "dense_opt", "emb_dense", "emb_dense_opt"):
         fpath = os.path.join(tmp, f"{part}.npz")
@@ -1012,6 +1045,8 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
       manifest["vocab"] = vocab_meta
     if telemetry_meta is not None:
       manifest["telemetry"] = telemetry_meta
+    if stream_meta is not None:
+      manifest["stream"] = stream_meta
     publish_manifest_last(tmp, path, manifest)
 
   # The publication must reach the renamed-barrier on EVERY exception —
@@ -1055,6 +1090,25 @@ def _load_telemetry(manifest: Dict[str, Any], telemetry) -> None:
     telemetry.load_state_dict(section)
 
 
+def _load_stream(path: str, manifest: Dict[str, Any], stream) -> None:
+  """Restore a checkpoint's ``stream`` section (publisher chain state +
+  generation stamps) into a ``DeltaPublisher``. Lenient on absence —
+  a checkpoint written before the chain was rooted (or by a
+  non-streaming run) leaves the publisher fresh, and ``attach()`` then
+  refuses until the caller roots a chain explicitly; quantize/geometry
+  mismatches refuse inside ``publisher.load_state`` with the field
+  named. The restored publisher is UN-attached: it must validate the
+  pubdir tail (``attach``) before its next publication."""
+  if stream is None:
+    return
+  section = manifest.get("stream")
+  if section is None:
+    return
+  with np.load(os.path.join(path, "stream.npz")) as z:
+    flat = {k: np.asarray(v) for k, v in z.items()}
+  stream.load_state(flat, section)
+
+
 def _load_vocab(path: str, manifest: Dict[str, Any], vocab) -> None:
   """Restore the dynamic id space from a checkpoint's ``vocab`` section
   (presence of the section and of the translator must agree; knob or
@@ -1086,7 +1140,7 @@ def restore(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
             mesh: Optional[Mesh] = None,
             axis_name: str = "mp", store=None,
             verify_integrity: bool = True, vocab=None,
-            telemetry=None) -> Dict[str, Any]:
+            telemetry=None, stream=None) -> Dict[str, Any]:
   """Load a checkpoint written by :func:`save` into a new state dict.
 
   Args:
@@ -1198,7 +1252,8 @@ def restore(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
     reason = _elastic_reason(manifest, want, plan)
     if reason is None:
       return _restore_elastic(path, manifest, plan, rule, state_like,
-                              mesh, axis_name, store, vocab, telemetry)
+                              mesh, axis_name, store, vocab, telemetry,
+                              stream)
     diff_keys = sorted(k for k in set(manifest["plan"]) | set(want)
                        if manifest["plan"].get(k) != want.get(k))
     detail = "; ".join(
@@ -1277,6 +1332,7 @@ def restore(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
 
   _load_vocab(path, manifest, vocab)
   _load_telemetry(manifest, telemetry)
+  _load_stream(path, manifest, stream)
 
   parts = {}
   for part in ("dense", "dense_opt", "emb_dense", "emb_dense_opt"):

@@ -89,7 +89,7 @@ def save_rotating(root: str, plan, rule, state: Dict[str, Any],
                   store=None, keep: int = 3,
                   policy: retry.RetryPolicy = retry.DEFAULT_POLICY,
                   extra: Optional[Dict[str, Any]] = None,
-                  vocab=None, telemetry=None) -> str:
+                  vocab=None, telemetry=None, stream=None) -> str:
   """Durably save ``state`` as ``<root>/ckpt_<step>`` and rotate.
 
   The step is read from ``state['step']`` so the directory name always
@@ -114,11 +114,11 @@ def save_rotating(root: str, plan, rule, state: Dict[str, Any],
   with _span("ckpt/save", args={"step": step}):
     if jax.process_count() > 1:
       checkpoint.save(path, plan, rule, state, store=store, extra=extra,
-                      vocab=vocab, telemetry=telemetry)
+                      vocab=vocab, telemetry=telemetry, stream=stream)
     else:
       retry.retry_call(checkpoint.save, path, plan, rule, state,
                        store=store, extra=extra, vocab=vocab,
-                       telemetry=telemetry, policy=policy)
+                       telemetry=telemetry, stream=stream, policy=policy)
   _counter("ckpt/saves").inc()
   prune(root, keep)
   return path
@@ -126,7 +126,7 @@ def save_rotating(root: str, plan, rule, state: Dict[str, Any],
 
 def restore_latest(root: str, plan, rule, state_like: Dict[str, Any],
                    mesh=None, axis_name: str = "mp", store=None,
-                   vocab=None
+                   vocab=None, stream=None
                    ) -> Optional[Tuple[Dict[str, Any], int, str]]:
   """Auto-resume: restore the newest VALID checkpoint under ``root``.
 
@@ -171,6 +171,7 @@ def restore_latest(root: str, plan, rule, state_like: Dict[str, Any],
   with _span("ckpt/restore", args={"step": step}):
     state = checkpoint.restore(path, plan, rule, state_like, mesh=mesh,
                                axis_name=axis_name, store=store,
-                               vocab=vocab, verify_integrity=False)
+                               vocab=vocab, stream=stream,
+                               verify_integrity=False)
   _counter("ckpt/restores").inc()
   return state, step, path

@@ -27,6 +27,25 @@ Instrumented sites consult the active injector by name via :func:`fire`:
   (world-N save -> world-M restore) re-shard in ``checkpoint.restore`` —
   lets chaos interrupt the re-shard itself.
 
+Streaming (online-learning) extension sites, registered by their home
+modules via :func:`register_site` (same lint/validation treatment as
+``SITES`` members):
+
+- ``"delta_extract"`` (`streaming/publish.py`): per physical-row window
+  a delta extraction reads.
+- ``"delta_seal"`` (`streaming/publish.py`): per data file sealed into
+  a ``delta_<seq>.tmp`` — SIGKILL here leaves a torn publish the
+  subscriber never reads (``tools/chaos_stream.py``).
+- ``"stream_attach"`` (`streaming/publish.py`): per tail delta a
+  publisher ATTACH validates after a kill/restore.
+- ``"stream_read"`` (`streaming/subscribe.py`): per subscriber
+  filesystem read ATTEMPT, inside the retry loop — ``fail_first``
+  simulates the transient NFS/GCS-fuse errors retry must absorb.
+- ``"delta_promote"`` (`streaming/subscribe.py`): at the start of each
+  delta application — the kill-the-subscriber-mid-promote hook.
+- ``"compact_fold"`` (`streaming/compact.py`): per sparse class folded
+  into a compacted base — the kill-the-compactor-mid-fold hook.
+
 With no injector installed :func:`fire` is a dict lookup + None check:
 the hooks cost nothing in production.
 

@@ -107,6 +107,22 @@ class ResilientTrainer:
       ``telemetry`` section and the first resume of a fresh process
       adopts it — counters survive restarts without double-counting,
       exactly like the skip/OOV/stream-position accounting.
+    stream: the run's ``streaming.DeltaPublisher`` — the trainer then
+      makes the delta chain CRASH-SAFE: each snapshot seals the
+      publisher's chain state + generation stamps into the checkpoint
+      (manifest ``stream`` section + ``stream.npz``), and every resume
+      — auto-resume after a SIGKILL and the abort-path rollback alike —
+      restores them and RE-ATTACHES the publisher to the pubdir tail
+      (``publisher.attach()``): deltas published between the snapshot
+      and the kill are validated against the restored fingerprints and
+      their rows force-re-stamped, so the next publication is a
+      superset and the chain is never re-rooted. A forked or diverged
+      pubdir refuses (``ChainDivergedError`` naming the field) instead
+      of silently forking. The publisher's tracker must observe every
+      batch BEFORE the step consumes it (the ``observe_batch`` /
+      ``step`` ordering in the online-learning quickstart), so a
+      snapshot taken inside :meth:`step` captures stamps consistent
+      with the consumed-stream position.
   """
 
   def __init__(self, step_fn, state: Dict[str, Any], plan, rule,
@@ -117,7 +133,7 @@ class ResilientTrainer:
                resume: bool = True, store=None,
                retry_policy: retry.RetryPolicy = retry.DEFAULT_POLICY,
                async_snapshots: bool = False,
-               tiered=None, dynvocab=None, telemetry=None):
+               tiered=None, dynvocab=None, telemetry=None, stream=None):
     # The metrics registry this trainer emits through (and persists:
     # snapshots write its state into the checkpoint manifest's
     # ``telemetry`` section, and the FIRST resume of a fresh process
@@ -196,6 +212,14 @@ class ResilientTrainer:
             "them (same limit as snapshot(async_=True) with a store).")
       state = tiered.state if state is None else state
       store = tiered.store if store is None else store
+    self.stream = stream
+    if stream is not None and async_snapshots:
+      raise NotImplementedError(
+          "async_snapshots with a DeltaPublisher (stream=...): the "
+          "publisher's tracker stamps are live host state every "
+          "observe_batch mutates — a background save would tear the "
+          "chain state it seals (same limit as the translator). "
+          "Snapshot streaming runs synchronously.")
     self._step_fn = step_fn
     self.state = state
     self.plan = plan
@@ -275,7 +299,7 @@ class ResilientTrainer:
     got = durable.restore_latest(self.ckpt_root, self.plan, self.rule,
                                  self.state, mesh=self.mesh,
                                  axis_name=self.axis_name, store=self.store,
-                                 vocab=self.vocab)
+                                 vocab=self.vocab, stream=self.stream)
     if got is None:
       return False
     from .. import checkpoint
@@ -304,6 +328,15 @@ class ResilientTrainer:
       # the restore loaded the id space into the translator IN PLACE
       # (restore_latest(vocab=...)); only the state pointer moves
       self.dynvocab.state = self.state
+    if self.stream is not None and not self.stream.attached:
+      # the restore loaded chain state the publisher has not validated
+      # against the pubdir yet: RE-ATTACH now — auto-resume AND the
+      # abort-path rollback both land here, and in both cases deltas
+      # published past the restored watermark must be re-validated and
+      # their rows force-re-stamped (the superset rule) before the next
+      # publication. A forked/diverged chain raises ChainDivergedError
+      # with the field named — never a silent re-root.
+      self.stream.attach()
     self.resumed_from = path
     self._last_snapshot = step
     extra = manifest.get("extra", {})
@@ -354,7 +387,8 @@ class ResilientTrainer:
                                    self.state, store=self.store,
                                    keep=self.keep, policy=self.retry_policy,
                                    extra=extra, vocab=self.vocab,
-                                   telemetry=self.telemetry)
+                                   telemetry=self.telemetry,
+                                   stream=self.stream)
       self._last_snapshot = self.step_count
       return path
     if jax.process_count() > 1:

@@ -424,6 +424,11 @@ class ServeEngine:
     self.axis_name = axis_name
     self.meta = artifact.meta
     self.quantize = artifact.quantize
+    # the TRAIN step the served rows were exported at — the serving
+    # watermark. A DeltaSubscriber advances it (under `lock`) with each
+    # promoted delta, so operators/chaos can ask a live engine "whose
+    # training state am I serving" without touching the pubdir.
+    self.step = int(getattr(artifact, "step", 0))
     self.with_metrics = with_metrics
     self.donate_batch = donate_batch
     self._steps: Dict[Any, Any] = {}

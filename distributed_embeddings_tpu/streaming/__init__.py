@@ -28,29 +28,56 @@ repo already had:
   training become servable in the same delta cycle), and measures the
   end-to-end ``stream/freshness_s`` lag.
 
+Crash-safe operation (round 16) makes the loop survive the death of any
+participant: the publisher's chain state + generation stamps persist
+through the checkpoint manifest's ``stream`` section and
+:meth:`DeltaPublisher.attach` re-joins the existing chain from the
+pubdir tail after a kill (superset re-publication, fork refusal with
+the field named — never a silent re-root); :mod:`.compact` folds
+``delta_1..k`` into a new sealed base (cold starts load base+tail) and
+garbage-collects folded deltas under a heartbeat retention floor;
+subscribers heartbeat their ``applied_seq`` into the pubdir and the
+publisher throttles-then-coalesces publication when a live subscriber
+lags (``max_subscriber_lag``) while expired heartbeats drop from the
+quorum — staleness degrades, correctness never does.
+``tools/chaos_stream.py`` (``make chaos-stream``) SIGKILLs each
+participant mid-operation and proves bit-exactness against an unkilled
+reference.
+
 ``tools/profile_freshness.py`` (``make fresh-bench``) prices the loop
 under concurrent serve load; ARCHITECTURE.md §19 documents the delta
-format and the chaining/promotion protocols.
+format and the chaining/promotion/attach/compaction protocols.
 """
 
+from .compact import DeltaCompactor, compact_chain
 from .generations import RowGenerationTracker
 from .publish import (
     BASE_DIR,
+    ChainDivergedError,
     DeltaPublisher,
     artifact_bytes,
+    chain_anchor,
     delta_dirname,
     extract_changed_rows,
     published_delta_seqs,
+    read_heartbeats,
+    write_heartbeat,
 )
 from .subscribe import DeltaSubscriber
 
 __all__ = [
     "BASE_DIR",
+    "ChainDivergedError",
+    "DeltaCompactor",
     "DeltaPublisher",
     "DeltaSubscriber",
     "RowGenerationTracker",
     "artifact_bytes",
+    "chain_anchor",
+    "compact_chain",
     "delta_dirname",
     "extract_changed_rows",
     "published_delta_seqs",
+    "read_heartbeats",
+    "write_heartbeat",
 ]

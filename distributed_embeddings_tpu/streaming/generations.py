@@ -124,6 +124,82 @@ class RowGenerationTracker:
         self.counts[name][rank][u] += occ
     return self.clock
 
+  def force_dirty(self, rows: Dict[str, Dict[int, np.ndarray]],
+                  floor: Optional[int] = None) -> None:
+    """Stamp the given logical rows dirty at a clock strictly above
+    ``floor`` (default: the current clock).
+
+    The publisher ATTACH path uses this to guarantee the superset rule:
+    every row a now-orphaned tail delta shipped is re-stamped, so the
+    next publication re-ships it at the resumed trainer's values —
+    whatever the interleaving of snapshots, publishes, and the kill.
+    ``rows`` maps class name -> rank -> sorted logical-row indices."""
+    if floor is not None:
+      self.clock = max(self.clock, int(floor) + 1)
+    else:
+      # no floor given: advance the clock so the stamps are strictly
+      # above EVERY earlier stamp — in particular above a watermark
+      # that equals the current clock (right after a publication),
+      # where stamping at the unadvanced clock would silently exclude
+      # the forced rows from every future delta
+      self.clock += 1
+    now = time.time()
+    if self.oldest_unpublished_wall is None:
+      self.oldest_unpublished_wall = now
+    self.newest_wall = now
+    for name, per_rank in rows.items():
+      if name not in self.gen:
+        raise ValueError(
+            f"force_dirty names unknown class {name!r}: this tracker "
+            f"covers {sorted(self.gen)} — the rows came from a chain "
+            "built under a different plan")
+      rows_n = self._rows[name]
+      for rank, idx in per_rank.items():
+        idx = np.asarray(idx, np.int64)
+        if not idx.size:
+          continue
+        if int(idx.min()) < 0 or int(idx.max()) >= rows_n:
+          bad = int(idx.min() if idx.min() < 0 else idx.max())
+          raise IndexError(
+              f"class {name!r} rank {rank}: force-dirty row {bad} "
+              f"outside [0, {rows_n}) — the delta rows do not fit this "
+              "plan's geometry")
+        self.gen[name][int(rank)][idx] = self.clock
+
+  def state_arrays(self) -> Dict[str, np.ndarray]:
+    """Flat (npz-keyed) persistence form of the generation state —
+    ``<class>/r<rank>/gen|counts`` — written into the checkpoint next
+    to ``vocab.npz`` so a killed-and-resumed trainer re-joins its delta
+    chain instead of re-rooting it."""
+    flat: Dict[str, np.ndarray] = {}
+    for name, per_rank in self.gen.items():
+      for rank, g in enumerate(per_rank):
+        flat[f"{name}/r{rank}/gen"] = g
+        flat[f"{name}/r{rank}/counts"] = self.counts[name][rank]
+    return flat
+
+  def load_arrays(self, flat: Dict[str, np.ndarray]) -> None:
+    """Inverse of :meth:`state_arrays`; refuses geometry mismatches
+    with the field named (a checkpoint written under a different plan
+    must not silently mis-stamp rows)."""
+    for name, per_rank in self.gen.items():
+      rows_n = self._rows[name]
+      for rank in range(len(per_rank)):
+        for part, dst in (("gen", self.gen), ("counts", self.counts)):
+          key = f"{name}/r{rank}/{part}"
+          arr = flat.get(key)
+          if arr is None:
+            raise ValueError(
+                f"checkpoint stream state is missing {key!r}: it was "
+                "written under a different plan or world size — the "
+                "generation stamps cannot be adopted")
+          arr = np.asarray(arr, np.int64)
+          if arr.shape != (rows_n,):
+            raise ValueError(
+                f"checkpoint stream state {key!r} has shape {arr.shape}, "
+                f"this plan implies ({rows_n},) — geometry mismatch")
+          dst[name][rank] = arr.copy()
+
   def changed_rows(self, watermark: int) -> Dict[str, List[np.ndarray]]:
     """Per class, per rank: the SORTED logical rows whose generation
     advanced past ``watermark`` — the delta's exact row set."""

@@ -46,10 +46,11 @@ Delta contents, per ``delta_<seq>/``:
 
 from __future__ import annotations
 
+import json
 import os
 import re
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -64,6 +65,7 @@ from ..checkpoint import (
     publish_manifest_last,
     read_manifest,
 )
+from ..checkpoint import verify as verify_dir
 from ..layers.planner import DistEmbeddingStrategy
 from ..ops.packed_table import PackedLayout, SparseRule
 from ..parallel.lookup_engine import DistributedLookup
@@ -80,20 +82,104 @@ from .generations import RowGenerationTracker
 
 DELTA_FORMAT_VERSION = 1
 BASE_DIR = "base"
+HEARTBEAT_DIR = "heartbeats"
 _DELTA_RE = re.compile(r"^delta_(\d{6})$")
 
 # fired once per contiguous physical-row window an extract reads — the
 # streaming counterpart of the elastic re-shard's ``reshard_gather``
 DELTA_EXTRACT_SITE = faultinject.register_site("delta_extract")
+# fired per data file sealed into a delta's .tmp dir — the streaming
+# counterpart of ``ckpt_write``, so chaos can SIGKILL a publisher
+# mid-publish (leaving a torn ``delta_<seq>.tmp``) without disturbing
+# the ckpt_write counters the trainer's own snapshots consume
+DELTA_SEAL_SITE = faultinject.register_site("delta_seal")
+# fired once per tail delta a publisher ATTACH validates/adopts
+STREAM_ATTACH_SITE = faultinject.register_site("stream_attach")
+
+
+class ChainDivergedError(RuntimeError):
+  """A publisher ATTACH found the pubdir chain incompatible with its
+  restored state: re-joining would fork the chain or serve rows built
+  against a different predecessor state. ``field`` names the failing
+  manifest field (the subscriber refusal convention, raised publisher-
+  side). The remedy is explicit: re-root with ``publish_base`` (every
+  subscriber rebases) — never silent."""
+
+  def __init__(self, field: str, msg: str):
+    super().__init__(msg)
+    self.field = field
 
 
 def delta_dirname(seq: int) -> str:
   return f"delta_{seq:06d}"
 
 
+def validate_chain_link(path: str, seq: int, prev_fp: str,
+                        plan_fp=None, quantize: Optional[str] = None,
+                        where: str = "chain"
+                        ) -> Tuple[Dict[str, Any], str]:
+  """One delta's chain-continuity validation — the ONE refusal protocol
+  the publisher's ATTACH walk and the compactor's fold walk both
+  enforce (the subscriber's per-delta checks mirror it in refusal-return
+  form). Verifies directory integrity against its own crc32 manifest,
+  ``base_fingerprint`` continuity from ``prev_fp``, and (when given)
+  plan-fingerprint and quantize equality; any break raises
+  :class:`ChainDivergedError` naming the field. Returns
+  ``(manifest, fingerprint)`` for the next link."""
+  problems = verify_dir(path)
+  if problems:
+    raise ChainDivergedError(
+        "checksums",
+        f"{where}: delta {seq} fails integrity verification: "
+        + "; ".join(problems))
+  man = read_manifest(path)
+  if man.get("base_fingerprint") != prev_fp:
+    raise ChainDivergedError(
+        "base_fingerprint",
+        f"{where}: delta {seq} chains base_fingerprint "
+        f"{str(man.get('base_fingerprint'))[:12]}... but the validated "
+        f"predecessor is {prev_fp[:12]}... — the chain is forked; "
+        "refusing to adopt it")
+  if plan_fp is not None and man.get("plan") != plan_fp:
+    raise ChainDivergedError(
+        "plan",
+        f"{where}: delta {seq} was published under a different plan "
+        "fingerprint — this chain cannot be continued under the "
+        "current plan")
+  if quantize is not None and man["serve"]["quantize"] != quantize:
+    raise ChainDivergedError(
+        "quantize",
+        f"{where}: delta {seq} quantizes "
+        f"{man['serve']['quantize']!r}, expected {quantize!r} — a "
+        "chain never changes row codec mid-stream")
+  return man, manifest_fingerprint(path)
+
+
+def chain_anchor(base_manifest: Dict[str, Any], fp_base: str
+                 ) -> Tuple[int, str, str]:
+  """Where a (possibly compacted) base artifact anchors the chain:
+  ``(applied_seq, fingerprint, chain_root)``. A plain base anchors at
+  seq 0 with its own fingerprint as both link and root; a COMPACTED
+  base (``stream.compacted`` manifest section, :mod:`.compact`) anchors
+  at the folded ``through_seq`` with ``through_fingerprint`` as the
+  link — a cold-starting subscriber folds only the tail past the
+  compaction point — and carries the original chain root forward."""
+  comp = (base_manifest.get("stream") or {}).get("compacted")
+  if comp:
+    return (int(comp["through_seq"]), comp["through_fingerprint"],
+            comp.get("chain_root", fp_base))
+  return 0, fp_base, fp_base
+
+
 def published_delta_seqs(path: str) -> List[int]:
-  """Seq numbers of the PUBLISHED deltas under ``path`` (ignores
-  ``.tmp`` / ``.old`` and anything without a manifest)."""
+  """Seq numbers of the PUBLISHED deltas under ``path``.
+
+  Robust against whatever else accumulates in a long-lived pubdir: a
+  torn ``delta_<seq>.tmp`` from a killed publisher, ``.old`` rotations,
+  a manifest-less delta dir (crash between mkdir and publication), a
+  stray FILE named like a delta, foreign dirs (``heartbeats/``,
+  operator droppings), and entries that vanish mid-scan (a concurrent
+  GC) are all ignored — never a crash of the seq scan."""
   out = []
   try:
     names = os.listdir(path)
@@ -101,9 +187,73 @@ def published_delta_seqs(path: str) -> List[int]:
     return out
   for name in names:
     m = _DELTA_RE.match(name)
-    if m and os.path.isfile(os.path.join(path, name, "manifest.json")):
-      out.append(int(m.group(1)))
+    if not m:
+      continue
+    try:
+      entry = os.path.join(path, name)
+      if os.path.isdir(entry) \
+          and os.path.isfile(os.path.join(entry, "manifest.json")):
+        out.append(int(m.group(1)))
+    except OSError:
+      continue  # vanished mid-scan (concurrent GC) or unreadable: skip
   return sorted(out)
+
+
+# ---------------------------------------------------------------------------
+# subscriber heartbeats (the back-pressure / retention signal)
+# ---------------------------------------------------------------------------
+
+
+def heartbeat_path(path: str, subscriber_id: str) -> str:
+  return os.path.join(path, HEARTBEAT_DIR, subscriber_id + ".json")
+
+
+def write_heartbeat(path: str, subscriber_id: str, applied_seq: int,
+                    fingerprint: Optional[str] = None) -> None:
+  """Atomically publish one subscriber's liveness + applied position
+  into the pubdir (the telemetry layer's fsync + atomic-replace + dir
+  fsync — a crash never leaves a torn heartbeat the publisher could
+  misread as a lagging live subscriber)."""
+  from ..telemetry import atomic_write_text
+  os.makedirs(os.path.join(path, HEARTBEAT_DIR), exist_ok=True)
+  atomic_write_text(
+      heartbeat_path(path, subscriber_id),
+      json.dumps({"id": subscriber_id, "applied_seq": int(applied_seq),
+                  "fingerprint": fingerprint, "wall": time.time()}))
+
+
+def read_heartbeats(path: str, ttl_s: float
+                    ) -> Tuple[Dict[str, Dict[str, Any]],
+                               Dict[str, Dict[str, Any]]]:
+  """``(live, expired)`` heartbeat records keyed by subscriber id.
+
+  A record older than ``ttl_s`` is EXPIRED: dropped from the
+  back-pressure quorum and the GC retention floor (a dead serving
+  process must not stall the publisher forever — staleness degrades,
+  correctness never does: if it revives past GC it rebases onto the
+  compacted base instead of folding deleted deltas). Unreadable or
+  foreign files are ignored, like the delta seq scan."""
+  live: Dict[str, Dict[str, Any]] = {}
+  expired: Dict[str, Dict[str, Any]] = {}
+  hb_dir = os.path.join(path, HEARTBEAT_DIR)
+  try:
+    names = os.listdir(hb_dir)
+  except OSError:
+    return live, expired
+  now = time.time()
+  for name in names:
+    if not name.endswith(".json"):
+      continue
+    try:
+      with open(os.path.join(hb_dir, name)) as f:
+        rec = json.load(f)
+      sid = str(rec["id"])
+      rec["applied_seq"] = int(rec["applied_seq"])
+      rec["wall"] = float(rec["wall"])
+    except (OSError, ValueError, KeyError, TypeError):
+      continue
+    (expired if now - rec["wall"] > ttl_s else live)[sid] = rec
+  return live, expired
 
 
 def artifact_bytes(path: str) -> int:
@@ -165,15 +315,38 @@ class DeltaPublisher:
   A failed publish (crash, injected fault) leaves a manifest-less
   ``.tmp`` the subscriber never reads; the chain state only advances on
   success, so the retry re-publishes the SAME seq and the subscriber
-  converges. A publisher restart has no tracker history: call
-  ``publish_base`` again — subscribers detect the new base fingerprint
-  and rebase.
+  converges. A RESTARTED publisher has two paths back:
+
+  - **attach** (the crash-safe path): when the chain state + tracker
+    stamps were persisted through the checkpoint manifest's ``stream``
+    section (``checkpoint.save(stream=publisher)`` — the
+    ``ResilientTrainer(stream=...)`` wiring does this per snapshot), a
+    restored publisher calls :meth:`attach`: it validates the pubdir
+    tail against its restored fingerprints (refusing a forked or
+    diverged chain with the field named) and RE-JOINS the chain at the
+    tail — rows the orphaned tail deltas shipped are force-re-stamped,
+    so the next delta is a superset and nothing is ever lost;
+  - **re-root** (the stateless fallback): ``publish_base`` again —
+    subscribers detect the new base fingerprint and rebase.
+
+  Back-pressure: when ``max_subscriber_lag`` is set, ``publish_delta``
+  reads the subscriber heartbeats (``heartbeats/<id>.json``, written
+  fsynced+atomic by each :class:`~.subscribe.DeltaSubscriber`) and
+  DEFERS publication while any live subscriber lags that many deltas —
+  the watermark holds, so the deferred intervals coalesce into one
+  superset delta once the laggard catches up (``publishes_throttled``
+  and ``deltas_coalesced`` count the two halves). A heartbeat older
+  than ``heartbeat_ttl_s`` drops out of the quorum with a counted
+  ``stream/subscribers_expired`` — a dead serving process degrades
+  freshness for itself only, never correctness, and never stalls the
+  publisher.
   """
 
   def __init__(self, path: str, plan: DistEmbeddingStrategy,
                rule: SparseRule, tracker: RowGenerationTracker,
                quantize: str = "f32", store=None, vocab=None,
-               telemetry=None):
+               telemetry=None, max_subscriber_lag: Optional[int] = None,
+               heartbeat_ttl_s: float = 30.0):
     if quantize not in QUANTIZE_MODES:
       raise ValueError(f"unknown quantize mode {quantize!r}; "
                        f"have {list(QUANTIZE_MODES)}")
@@ -212,12 +385,25 @@ class DeltaPublisher:
     self.meta, self._full_lay = serve_class_meta(
         plan, rule, quantize, self._tiered_names)
 
+    if max_subscriber_lag is not None and max_subscriber_lag < 1:
+      raise ValueError(
+          f"max_subscriber_lag must be >= 1 (got {max_subscriber_lag}): "
+          "lag 0 would defer every publication forever")
+    self.max_subscriber_lag = max_subscriber_lag
+    self.heartbeat_ttl_s = float(heartbeat_ttl_s)
+
     # chain state (advances only on successful publication)
     self.seq = 0
     self.fingerprint: Optional[str] = None  # predecessor of the NEXT delta
     self.base_fingerprint: Optional[str] = None
+    self.chain_root: Optional[str] = None  # the ORIGINAL base's identity
     self.watermark = 0  # tracker clock covered by the last publication
     self.last_publish_bytes = 0
+    # RESTORED chain state must be explicitly re-joined (attach) before
+    # publishing — a fresh publisher owns its own new chain
+    self.attached = True
+    self._expired_ids: set = set()
+    self._throttled_pending = False
 
   # ---- observation (delegates to the tracker) -----------------------------
   def observe_batch(self, cats) -> int:
@@ -236,13 +422,217 @@ class DeltaPublisher:
                                   "published_wall": time.time()}})
     self.seq = 0
     self.fingerprint = self.base_fingerprint = manifest_fingerprint(base)
+    self.chain_root = self.base_fingerprint
     self.watermark = clock
     self.last_publish_bytes = artifact_bytes(base)
+    self.attached = True  # a re-root IS the explicit recovery choice
     self.tracker.mark_published()
     self.telemetry.counter("stream/base_published").inc()
     self.telemetry.counter("stream/bytes_published").inc(
         self.last_publish_bytes)
     return base
+
+  # ---- chain-state persistence (the checkpoint `stream` section) ----------
+  def state_arrays(self) -> Dict[str, np.ndarray]:
+    """The tracker's generation stamps + observed counts, flat-keyed —
+    written as ``stream.npz`` through the checkpoint's
+    crc32-manifest-last protocol (``checkpoint.save(stream=self)``)."""
+    return self.tracker.state_arrays()
+
+  def manifest_section(self) -> Dict[str, Any]:
+    """The checkpoint manifest's ``stream`` section: everything
+    :meth:`attach` needs to re-join the chain after a kill — last
+    published seq, the chain fingerprints, the publication watermark,
+    and the tracker clock."""
+    return {
+        "seq": self.seq,
+        "fingerprint": self.fingerprint,
+        "base_fingerprint": self.base_fingerprint,
+        "chain_root": self.chain_root,
+        "watermark": self.watermark,
+        "clock": self.tracker.clock,
+        "quantize": self.quantize,
+    }
+
+  def load_state(self, flat: Dict[str, np.ndarray],
+                 section: Dict[str, Any]) -> None:
+    """Adopt a checkpoint's persisted chain state (the restore half of
+    the ``stream`` section). Refuses a quantize-mode mismatch with the
+    field named; geometry mismatches refuse inside the tracker. Marks
+    the publisher un-attached: :meth:`attach` must validate the pubdir
+    tail before the next publication."""
+    if section.get("quantize") != self.quantize:
+      raise ValueError(
+          f"checkpoint stream section was written with quantize="
+          f"{section.get('quantize')!r} but this publisher quantizes "
+          f"{self.quantize!r} — a delta chain never changes row codec "
+          "mid-stream; rebuild the publisher with the saving run's mode")
+    self.tracker.load_arrays(flat)
+    self.seq = int(section["seq"])
+    self.fingerprint = section["fingerprint"]
+    self.base_fingerprint = section["base_fingerprint"]
+    self.chain_root = section.get("chain_root",
+                                  section["base_fingerprint"])
+    self.watermark = int(section["watermark"])
+    self.tracker.clock = int(section["clock"])
+    # a snapshot taken BEFORE the chain was rooted restores a fresh
+    # publisher (fingerprint None): there is no chain to re-join, so it
+    # stays "attached" — publish_base roots one, and publish_delta
+    # already refuses root-less chains with its own message. Only a
+    # restored REAL chain link demands attach() before publication.
+    self.attached = self.fingerprint is None
+
+  # ---- attach: re-join the chain after a kill/restore ---------------------
+  def attach(self) -> int:
+    """Re-join the existing pubdir chain from restored chain state.
+
+    Validates the tail against the restored fingerprints and adopts it:
+    for every delta published after the restored ``seq`` (published
+    between the snapshot and the kill, now "orphaned" — the restored
+    tracker has no memory of the batches that produced them), the chain
+    link is verified (``base_fingerprint`` continuity from the restored
+    fingerprint, per-directory crc32 integrity, plan + quantize match)
+    and its shipped row set is FORCE-RE-STAMPED dirty above the
+    restored watermark — so the next ``publish_delta`` ships a superset
+    of everything the orphaned tail claimed, at the resumed (replayed,
+    bit-identical) trainer's values. Rows are never lost and the chain
+    is never re-rooted.
+
+    Any incompatibility — a re-rooted or compacted-past-us base, a gap
+    in the tail, a fork (fingerprint mismatch), a plan or quantize
+    change — raises :class:`ChainDivergedError` naming the field,
+    REFUSING to publish rather than forking the chain; the explicit
+    remedy is ``publish_base`` (re-root, subscribers rebase).
+
+    Returns the number of tail deltas adopted."""
+    if self.fingerprint is None:
+      raise RuntimeError(
+          "attach() without restored chain state: nothing links this "
+          "publisher to an existing chain — the checkpoint had no "
+          "'stream' section (or load_state was never called). Root a "
+          "new chain with publish_base instead.")
+    base = os.path.join(self.path, BASE_DIR)
+    if not os.path.isfile(os.path.join(base, "manifest.json")):
+      raise ChainDivergedError(
+          "base",
+          f"attach: pubdir {self.path!r} has no published base artifact "
+          "— the chain this state was saved against is gone; re-root "
+          "with publish_base")
+    fp_base = manifest_fingerprint(base)
+    if fp_base != self.base_fingerprint:
+      comp = (read_manifest(base).get("stream") or {}).get("compacted")
+      if comp and comp.get("chain_root") == self.chain_root \
+          and int(comp["through_seq"]) <= self.seq:
+        # same chain, compacted behind our restored position: adopt the
+        # new base identity; the delta links we validate below are
+        # untouched by compaction
+        self.base_fingerprint = fp_base
+      else:
+        raise ChainDivergedError(
+            "base_fingerprint",
+            f"attach: base artifact fingerprint {fp_base[:12]}... does "
+            f"not match the restored chain's {self.base_fingerprint[:12]}"
+            "... — the chain was re-rooted (or compacted past the "
+            "restored seq) by another publisher; refusing to fork it. "
+            "Re-root explicitly with publish_base if this publisher "
+            "should own the directory.")
+    seqs = published_delta_seqs(self.path)
+    if self.seq > 0 and self.seq in seqs:
+      got = manifest_fingerprint(
+          os.path.join(self.path, delta_dirname(self.seq)))
+      if got != self.fingerprint:
+        raise ChainDivergedError(
+            "fingerprint",
+            f"attach: delta {self.seq} on disk has fingerprint "
+            f"{got[:12]}... but the restored state published "
+            f"{self.fingerprint[:12]}... — a different publisher "
+            "overwrote the chain; refusing to fork it")
+    tail = [s for s in seqs if s > self.seq]
+    prev = self.fingerprint
+    dirty: Dict[str, Dict[int, list]] = {}
+    for want in range(self.seq + 1, (max(tail) + 1) if tail else
+                      self.seq + 1):
+      dpath = os.path.join(self.path, delta_dirname(want))
+      faultinject.fire("stream_attach", seq=want)
+      if want not in seqs:
+        raise ChainDivergedError(
+            "seq",
+            f"attach: delta {want} is missing but delta {max(tail)} is "
+            "published — a gap in the tail (partial GC or out-of-order "
+            "publication); the chain cannot be validated past it")
+      man, next_fp = validate_chain_link(
+          dpath, want, prev, plan_fp=_plan_fingerprint(self.plan),
+          quantize=self.quantize, where="attach")
+      for name, per_rank in man["stream"]["rows"].items():
+        # bounds-validate HERE, while nothing has been mutated: attach
+        # must adopt the whole tail or refuse it naming the field — a
+        # raw IndexError out of force_dirty after seq advanced would
+        # leave the publisher half-attached (the subscriber and the
+        # compactor guard the same pubdir input surface the same way)
+        if name not in self.tracker.gen:
+          raise ChainDivergedError(
+              "rows",
+              f"attach: delta {want} ships rows for class {name!r}, "
+              f"unknown to this plan's tracker ({sorted(self.tracker.gen)})")
+        rows_n = self.tracker.gen[name][0].shape[0]
+        world = len(self.tracker.gen[name])
+        for rank_s in per_rank:
+          rank = int(rank_s)
+          if rank < 0 or rank >= world:
+            raise ChainDivergedError(
+                "rows",
+                f"attach: delta {want} class {name!r} names rank {rank} "
+                f"outside [0, {world})")
+          with np.load(os.path.join(
+              dpath, f"rows_{name}_r{rank}.npz")) as z:
+            idx = np.asarray(z["idx"], np.int64)
+          if idx.size and (int(idx.min()) < 0
+                           or int(idx.max()) >= rows_n):
+            bad = int(idx.min() if idx.min() < 0 else idx.max())
+            raise ChainDivergedError(
+                "rows",
+                f"attach: delta {want} class {name!r} rank {rank} row "
+                f"{bad} outside this class's [0, {rows_n}) logical rows")
+          dirty.setdefault(name, {}).setdefault(rank, []).append(idx)
+      prev = next_fp
+    adopted = len(tail)
+    self.seq += adopted
+    self.fingerprint = prev
+    if dirty:
+      # the superset rule: every row an orphaned tail delta shipped is
+      # re-stamped above the restored watermark, so the next delta
+      # re-ships it at the resumed trainer's (bit-identical, replayed)
+      # values — whatever the snapshot/publish/kill interleaving was
+      merged = {
+          name: {rank: np.unique(np.concatenate(parts))
+                 for rank, parts in per_rank.items()}
+          for name, per_rank in dirty.items()}
+      self.tracker.force_dirty(merged, floor=self.watermark)
+    self.attached = True
+    self.telemetry.counter("stream/attaches").inc()
+    if adopted:
+      self.telemetry.counter("stream/attach_deltas_adopted").inc(adopted)
+    return adopted
+
+  # ---- back-pressure ------------------------------------------------------
+  def subscriber_lag(self) -> Optional[int]:
+    """How far the slowest LIVE subscriber trails the published head
+    (None when no live subscriber is registered — no quorum, no
+    back-pressure). Newly-expired heartbeats are counted once through
+    ``stream/subscribers_expired`` and dropped from the quorum; a
+    revived subscriber re-enters it on its next heartbeat."""
+    live, expired = read_heartbeats(self.path, self.heartbeat_ttl_s)
+    fresh_expired = set(expired) - set(live) - self._expired_ids
+    if fresh_expired:
+      self.telemetry.counter("stream/subscribers_expired").inc(
+          len(fresh_expired))
+      self._expired_ids |= fresh_expired
+    self._expired_ids -= set(live)  # revived: back in the quorum
+    if not live:
+      return None
+    lag = self.seq - min(hb["applied_seq"] for hb in live.values())
+    self.telemetry.gauge("stream/subscriber_lag").set(lag)
+    return lag
 
   # ---- delta --------------------------------------------------------------
   def _reader(self, name: str, state: Dict[str, Any], rank: int):
@@ -272,16 +662,33 @@ class DeltaPublisher:
       c = np.concatenate([c, np.zeros((pad,), np.int64)])
     return c.reshape(sl.phys_rows, sl.rows_per_phys).sum(axis=1)
 
-  def publish_delta(self, state: Dict[str, Any]) -> Optional[str]:
+  def publish_delta(self, state: Dict[str, Any],
+                    force: bool = False) -> Optional[str]:
     """Extract + seal one delta; returns its path, or None when nothing
-    was observed since the last publication."""
+    was observed since the last publication OR publication was deferred
+    by back-pressure (``force=True`` bypasses the lag check — an
+    operator override, never the training loop's default)."""
     if self.fingerprint is None:
       raise RuntimeError(
           "publish_delta before publish_base: the chain needs a root "
           "artifact for the first base_fingerprint to link.")
+    if not self.attached:
+      raise RuntimeError(
+          "publish_delta on restored-but-unattached chain state: call "
+          "attach() first (validates the pubdir tail and re-joins the "
+          "chain), or re-root explicitly with publish_base.")
     clock = self.tracker.clock
     if clock == self.watermark:
       return None
+    if self.max_subscriber_lag is not None and not force:
+      lag = self.subscriber_lag()
+      if lag is not None and lag >= self.max_subscriber_lag:
+        # defer: the watermark holds, so this interval's rows coalesce
+        # into the next successful publication — freshness degrades for
+        # the laggard's benefit, the chain (and correctness) never does
+        self._throttled_pending = True
+        self.telemetry.counter("stream/publishes_throttled").inc()
+        return None
     seq = self.seq + 1
     path = os.path.join(self.path, delta_dirname(seq))
 
@@ -316,6 +723,7 @@ class DeltaPublisher:
       def _seal(fpath: str) -> None:
         _fsync_path(fpath)
         faultinject.fire("ckpt_write", path=fpath)
+        faultinject.fire("delta_seal", path=fpath, seq=seq)
         checksums[os.path.basename(fpath)] = _crc32_file(fpath)
 
       stream_rows: Dict[str, Dict[str, int]] = {}
@@ -385,4 +793,8 @@ class DeltaPublisher:
     reg.counter("stream/rows_published").inc(n_rows)
     reg.counter("stream/bytes_published").inc(self.last_publish_bytes)
     reg.gauge("stream/publish_seq").set(seq)
+    if self._throttled_pending:
+      # this publication folded at least one deferred interval's rows
+      self._throttled_pending = False
+      reg.counter("stream/deltas_coalesced").inc()
     return path

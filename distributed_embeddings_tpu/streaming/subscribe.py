@@ -41,6 +41,7 @@ grow the histogram without bound.
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 import time
@@ -55,6 +56,7 @@ from ..checkpoint import verify as verify_dir
 from ..checkpoint import _plan_fingerprint
 from ..layers.planner import DistEmbeddingStrategy
 from ..ops.packed_table import host_gather_rows
+from ..resilience import faultinject, retry
 from ..serving.engine import ServeEngine
 from ..serving.export import ServeClassMeta
 from ..serving.export import load as serve_load
@@ -62,8 +64,10 @@ from ..telemetry import get_registry as _registry, span as _span
 from .publish import (
     BASE_DIR,
     DELTA_FORMAT_VERSION,
+    chain_anchor as _chain_anchor,
     delta_dirname,
     published_delta_seqs,
+    write_heartbeat,
 )
 
 # Freshness histogram geometry: lag spans many decades (ms when
@@ -73,6 +77,25 @@ from .publish import (
 # collapsing — the bound is a backstop, not an operating regime.
 FRESHNESS_REL_ERR = 0.05
 FRESHNESS_MAX_BUCKETS = 256
+
+# fired per filesystem read attempt on the subscriber's validate/fold
+# path (inside the retry loop, so fail_first simulates the transient
+# NFS/GCS-fuse errors the retry layer must absorb — the host_gather
+# discipline, applied to the streaming reads)
+STREAM_READ_SITE = faultinject.register_site("stream_read")
+# fired at the start of each delta application — the chaos harness's
+# SIGKILL-the-subscriber-mid-promote hook (tools/chaos_stream.py)
+DELTA_PROMOTE_SITE = faultinject.register_site("delta_promote")
+
+
+def _fp_and_manifest(path: str):
+  """Fingerprint AND parsed manifest from ONE read of the manifest
+  bytes — the two are guaranteed to describe the same artifact version
+  even while a compactor atomically swaps ``base/`` underneath."""
+  import hashlib
+  with open(os.path.join(path, "manifest.json"), "rb") as f:
+    raw = f.read()
+  return hashlib.sha256(raw).hexdigest(), json.loads(raw.decode())
 
 
 class DeltaSubscriber:
@@ -89,20 +112,51 @@ class DeltaSubscriber:
                plan: DistEmbeddingStrategy,
                base_fingerprint: Optional[str] = None,
                translator=None, poll_interval_s: float = 0.05,
-               telemetry=None):
+               telemetry=None, subscriber_id: Optional[str] = None,
+               heartbeat: bool = True,
+               retry_policy: retry.RetryPolicy = retry.DEFAULT_POLICY,
+               base_manifest: Optional[Dict[str, Any]] = None):
     self.engine = engine
     self.path = path
     self.plan = plan
     self.translator = translator
     self.poll_interval_s = float(poll_interval_s)
     self.telemetry = telemetry if telemetry is not None else _registry()
-    self.applied_seq = 0
-    self.base_fingerprint = base_fingerprint if base_fingerprint \
-        is not None else manifest_fingerprint(os.path.join(path, BASE_DIR))
-    # fingerprint of the artifact last applied (the chain link)
-    self.fingerprint = self.base_fingerprint
+    self.retry_policy = retry_policy
+    if subscriber_id is None:
+      import uuid
+      subscriber_id = f"sub-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+    self.subscriber_id = subscriber_id
+    self.heartbeat = heartbeat
+    # anchor the chain: the artifact-last-applied fingerprint (the
+    # link) and the chain's root identity (survives compaction — a
+    # compacted base changes the base fingerprint but carries the root
+    # forward). The fingerprint and the anchoring manifest come from
+    # ONE read of the manifest bytes (or the caller passes the pair it
+    # loaded the engine against), so a compactor swapping base/
+    # mid-construction can never pair one version's fingerprint with
+    # another's anchor. A transient read failure must NOT silently
+    # anchor a compacted base at seq 0 with the wrong root — retried,
+    # raised when persistent; only an EXPLICIT base_fingerprint (the
+    # caller vouches for a plain base) falls back to the seq-0 anchor.
+    if base_fingerprint is not None:
+      self.base_fingerprint = base_fingerprint
+      bman = base_manifest
+      if bman is None:
+        try:
+          bman = self._retried(read_manifest,
+                               os.path.join(path, BASE_DIR))
+        except (OSError, ValueError):
+          bman = {}
+    else:
+      self.base_fingerprint, bman = self._retried(
+          _fp_and_manifest, os.path.join(path, BASE_DIR))
+    self.applied_seq, self.fingerprint, self.chain_root = \
+        _chain_anchor(bman, self.base_fingerprint)
     self.last_refusal: Optional[Dict[str, Any]] = None
     self.last_error: Optional[BaseException] = None
+    # (fingerprint, compacted-section-or-None) — see _base_compaction
+    self._comp_cache: Optional[tuple] = None
     self.freshness = self.telemetry.histogram(
         "stream/freshness_s", rel_err=FRESHNESS_REL_ERR,
         max_buckets=FRESHNESS_MAX_BUCKETS)
@@ -110,24 +164,66 @@ class DeltaSubscriber:
     self._stop = threading.Event()
     self._thread: Optional[threading.Thread] = None
 
+  # ---- retried filesystem reads -------------------------------------------
+  def _retried(self, fn, *args):
+    """Run one filesystem read with the subscriber's retry policy: a
+    transient NFS/GCS-fuse ``OSError`` is retried with backoff (counted
+    process-wide as ``retry/attempts``) instead of surfacing as a
+    refusal; each attempt fires the ``stream_read`` fault site."""
+    def attempt():
+      faultinject.fire("stream_read", op=getattr(fn, "__name__", "read"))
+      return fn(*args)
+    return retry.retry_call(attempt, policy=self.retry_policy)
+
+  def _read_npz(self, fpath: str) -> Dict[str, np.ndarray]:
+    def _load():
+      with np.load(fpath) as z:
+        return {k: np.asarray(v) for k, v in z.items()}
+    _load.__name__ = "npz:" + os.path.basename(fpath)
+    return self._retried(_load)
+
   @classmethod
   def from_artifact(cls, model, plan: DistEmbeddingStrategy, path: str,
                     mesh=None, axis_name: str = "mp", tier_config=None,
                     with_metrics: bool = False,
                     donate_batch: bool = False,
                     poll_interval_s: float = 0.05,
-                    telemetry=None) -> "DeltaSubscriber":
-    """Load ``<path>/base`` and build the engine + subscriber pair."""
+                    telemetry=None, subscriber_id: Optional[str] = None,
+                    heartbeat: bool = True,
+                    retry_policy=retry.DEFAULT_POLICY
+                    ) -> "DeltaSubscriber":
+    """Load ``<path>/base`` and build the engine + subscriber pair.
+
+    A COMPACTED base anchors the subscriber at its ``through_seq``
+    (cold start loads base + the tail, never replays the folded
+    chain). The fingerprint is read before AND after ``serve_load``:
+    a concurrent compactor's atomic base swap mid-load would otherwise
+    pair old row images with the new base's mid-chain anchor, silently
+    skipping the folded deltas — an unstable load retries (bounded),
+    and ``serve_load``'s own crc verification catches a swap landing
+    inside the load itself."""
     base = os.path.join(path, BASE_DIR)
-    art = serve_load(base, plan, mesh=mesh, axis_name=axis_name)
+    for _ in range(5):
+      fp, bman = _fp_and_manifest(base)
+      art = serve_load(base, plan, mesh=mesh, axis_name=axis_name)
+      fp_after, _ = _fp_and_manifest(base)
+      if fp_after == fp:
+        break
+    else:
+      raise RuntimeError(
+          f"base artifact {base!r} kept changing under the load "
+          "(a compactor or re-rooting publisher is racing this cold "
+          "start faster than it can read); retry when the pubdir "
+          "settles")
     engine = ServeEngine(model, plan, art, mesh=mesh, axis_name=axis_name,
                          tier_config=tier_config,
                          with_metrics=with_metrics,
                          donate_batch=donate_batch)
-    sub = cls(engine, path, plan,
-              base_fingerprint=manifest_fingerprint(base),
+    sub = cls(engine, path, plan, base_fingerprint=fp,
+              base_manifest=bman,
               translator=art.vocab, poll_interval_s=poll_interval_s,
-              telemetry=telemetry)
+              telemetry=telemetry, subscriber_id=subscriber_id,
+              heartbeat=heartbeat, retry_policy=retry_policy)
     sub._factory = dict(model=model, mesh=mesh, axis_name=axis_name,
                         tier_config=tier_config, with_metrics=with_metrics,
                         donate_batch=donate_batch)
@@ -187,30 +283,92 @@ class DeltaSubscriber:
         self.telemetry.counter("stream/poll_errors").inc()
       self._stop.wait(self.poll_interval_s)
 
+  def _base_compaction(self, base: str, fp: str):
+    """The base's compacted-section if it belongs to OUR chain (else
+    None): ``{'through_seq', 'through_fingerprint', 'chain_root'}``.
+    Cached by ``fp`` — the fingerprint IS the sha256 of the manifest
+    bytes, so the answer for a given fingerprint is immutable and an
+    idle poll loop never re-reads the (possibly NFS-hosted, tens-of-KB)
+    manifest it already parsed."""
+    cached = self._comp_cache
+    if cached is not None and cached[0] == fp:
+      comp = cached[1]
+    else:
+      try:
+        bman = self._retried(read_manifest, base)
+      except (OSError, ValueError):
+        return None  # transient: not cached, re-read next poll
+      comp = (bman.get("stream") or {}).get("compacted")
+      self._comp_cache = (fp, comp)  # RAW section: a rebase may change
+      #   self.chain_root after the cache fill, so filter per call
+    if comp and comp.get("chain_root") == self.chain_root:
+      return comp
+    return None
+
   def poll_once(self) -> int:
     """Scan + apply every ready delta in seq order; returns how many
-    were applied. Stops (without advancing) at the first refusal."""
+    were applied (a rebase counts as one). Stops (without advancing) at
+    the first refusal, and publishes this subscriber's heartbeat
+    (liveness + ``applied_seq``) into the pubdir either way — the
+    publisher's back-pressure quorum and the GC retention floor read
+    it."""
     applied = 0
+    current = self.base_fingerprint
     base = os.path.join(self.path, BASE_DIR)
-    if os.path.isfile(os.path.join(base, "manifest.json")):
-      current = manifest_fingerprint(base)
-      if current != self.base_fingerprint:
-        self._rebase(base, current)
+    try:
+      if os.path.isfile(os.path.join(base, "manifest.json")):
+        current = self._retried(manifest_fingerprint, base)
+        if current != self.base_fingerprint:
+          comp = self._base_compaction(base, current)
+          if comp is not None \
+              and int(comp["through_seq"]) <= self.applied_seq:
+            # our own chain, compacted at or behind our position: only
+            # the base's identity changed — the links we fold are
+            # untouched. Adopt quietly; nothing to reload.
+            self.base_fingerprint = current
+            self.telemetry.counter("stream/compactions_adopted").inc()
+          elif comp is not None:
+            # compacted PAST us (our heartbeat expired, or a cold gap):
+            # the deltas we still need may exist (retention floor) — if
+            # the next one does, keep folding the old links below; if
+            # it was GC'd, the gap branch in the loop rebases onto the
+            # compacted base. Either way adopt the base identity so
+            # this branch doesn't re-trigger every poll.
+            self.base_fingerprint = current
+            self.telemetry.counter("stream/compactions_adopted").inc()
+          else:
+            self._rebase(base, current)
+            applied += 1
+      while True:
+        seq = self.applied_seq + 1
+        path = os.path.join(self.path, delta_dirname(seq))
+        if not os.path.isfile(os.path.join(path, "manifest.json")):
+          comp = self._base_compaction(base, current)
+          if comp is not None \
+              and int(comp["through_seq"]) > self.applied_seq:
+            # the delta we need was folded into the compacted base and
+            # GC'd: jump forward by rebasing onto it (staleness spike,
+            # never wrong rows), then keep folding its tail
+            self._rebase(base, current)
+            applied += 1
+            continue
+          later = [s for s in published_delta_seqs(self.path) if s > seq]
+          if later:
+            self._refuse(seq, "seq",
+                         f"delta {min(later)} is published but delta "
+                         f"{seq} is missing — out-of-order publication; "
+                         "holding at the last valid artifact")
+          break
+        if not self._validate_and_apply(path, seq):
+          break
         applied += 1
-    while True:
-      seq = self.applied_seq + 1
-      path = os.path.join(self.path, delta_dirname(seq))
-      if not os.path.isfile(os.path.join(path, "manifest.json")):
-        later = [s for s in published_delta_seqs(self.path) if s > seq]
-        if later:
-          self._refuse(seq, "seq",
-                       f"delta {min(later)} is published but delta {seq} "
-                       "is missing — out-of-order publication; holding "
-                       "at the last valid artifact")
-        break
-      if not self._validate_and_apply(path, seq):
-        break
-      applied += 1
+    finally:
+      if self.heartbeat:
+        try:
+          write_heartbeat(self.path, self.subscriber_id,
+                          self.applied_seq, self.fingerprint)
+        except OSError:
+          self.telemetry.counter("stream/heartbeat_errors").inc()
     return applied
 
   # ---- validation ---------------------------------------------------------
@@ -221,12 +379,12 @@ class DeltaSubscriber:
 
   def _validate_and_apply(self, path: str, seq: int) -> bool:
     with _span("stream/validate", args={"seq": seq}):
-      problems = verify_dir(path)
+      problems = self._retried(verify_dir, path)
       if problems:
         return self._refuse(
             seq, "checksums",
             f"torn or corrupt delta {path!r}: " + "; ".join(problems))
-      manifest = read_manifest(path)
+      manifest = self._retried(read_manifest, path)
       if manifest.get("kind") != "serve_delta" \
           or manifest.get("format_version") != DELTA_FORMAT_VERSION:
         return self._refuse(
@@ -303,7 +461,10 @@ class DeltaSubscriber:
 
   # ---- application --------------------------------------------------------
   def _load_rows(self, path: str, manifest: Dict[str, Any]):
-    """Delta row payloads, host-side: ``{name: {rank: (idx, data)}}``."""
+    """Delta row payloads, host-side: ``{name: {rank: (idx, data)}}``.
+    Every file read goes through the retry policy — a transient
+    filesystem error is absorbed (counted ``retry/attempts``), only a
+    persistent one surfaces as a refusal."""
     meta = {n: ServeClassMeta.from_json(n, d)
             for n, d in manifest["serve"]["classes"].items()}
     out: Dict[str, Dict[int, tuple]] = {}
@@ -312,10 +473,9 @@ class DeltaSubscriber:
       out[name] = {}
       for rank_s in per_rank:
         rank = int(rank_s)
-        with np.load(os.path.join(path,
-                                  f"rows_{name}_r{rank}.npz")) as z:
-          idx = np.asarray(z["idx"], np.int64)
-          data = m.from_disk(np.asarray(z["data"]))
+        z = self._read_npz(os.path.join(path, f"rows_{name}_r{rank}.npz"))
+        idx = np.asarray(z["idx"], np.int64)
+        data = m.from_disk(np.asarray(z["data"]))
         out[name][rank] = (idx, data)
     return meta, out
 
@@ -395,6 +555,7 @@ class DeltaSubscriber:
              seq: int) -> None:
     from ..serving.export import _unflatten_paths, place_state
     eng = self.engine
+    faultinject.fire("delta_promote", seq=seq)
     with _span("stream/promote", args={"seq": seq}):
       # --- build everything off the dispatch lock ---
       updates = self._build_device_updates(rows)
@@ -416,21 +577,19 @@ class DeltaSubscriber:
       for name in manifest["stream"].get("counts_classes", []):
         if eng.meta[name].tier != "host":
           continue
-        with np.load(os.path.join(path, f"counts_{name}.npz")) as z:
-          counts[name] = {int(k[1:]): np.asarray(v, np.int64)
-                          for k, v in z.items()}
+        z = self._read_npz(os.path.join(path, f"counts_{name}.npz"))
+        counts[name] = {int(k[1:]): np.asarray(v, np.int64)
+                        for k, v in z.items()}
       parts = {}
       for part in ("dense", "emb_dense"):
-        with np.load(os.path.join(path, f"{part}.npz")) as z:
-          flat = dict(z)
+        flat = self._read_npz(os.path.join(path, f"{part}.npz"))
         parts[part] = place_state({part: _unflatten_paths(flat)},
                                   eng.mesh, eng.axis_name)[part]
       translator = self.translator
       if manifest.get("vocab_snapshot") is not None:
         from ..dynvocab import ReadonlyIdTranslator
-        with np.load(os.path.join(path, "vocab_snapshot.npz")) as z:
-          translator = ReadonlyIdTranslator.from_arrays(
-              {k: np.asarray(v) for k, v in z.items()})
+        translator = ReadonlyIdTranslator.from_arrays(
+            self._read_npz(os.path.join(path, "vocab_snapshot.npz")))
 
       # --- the swap: reference promotion between dispatches ---
       with eng.lock:
@@ -439,11 +598,22 @@ class DeltaSubscriber:
           self._fold_tiered(rows, new_images, counts)
         eng.state["dense"] = parts["dense"]
         eng.state["emb_dense"] = parts["emb_dense"]
+        eng.step = int(manifest["step"])  # the served watermark
         self.translator = translator
 
     self.applied_seq = seq
     self.fingerprint = manifest_fingerprint(path)
     self.last_refusal = None
+    if self.heartbeat:
+      # heartbeat PER APPLIED DELTA, not just per poll: one poll_once
+      # can drain a long backlog, and a publisher that reads the
+      # backlog-era heartbeat right after would defer a publication the
+      # subscriber has in fact already caught up to
+      try:
+        write_heartbeat(self.path, self.subscriber_id, seq,
+                        self.fingerprint)
+      except OSError:
+        self.telemetry.counter("stream/heartbeat_errors").inc()
     reg = self.telemetry
     reg.counter("stream/deltas_applied").inc()
     reg.counter("stream/rows_applied").inc(
@@ -464,18 +634,38 @@ class DeltaSubscriber:
           "automatic rebase, or rebuild the engine by hand.")
     with _span("stream/rebase"):
       f = self._factory
-      art = serve_load(base, self.plan, mesh=f["mesh"],
-                       axis_name=f["axis_name"])
+      # fingerprint + anchoring manifest from ONE read, re-checked
+      # after the load: the engine's row images and the chain anchor
+      # must describe the SAME base version, or a compactor's swap
+      # mid-rebase would pair old images with the new mid-chain anchor
+      # and silently skip the folded deltas. An unstable load raises
+      # (the poll loop records it and retries next poll); a persistent
+      # manifest-read failure likewise — defaulting to a seq-0 anchor
+      # would mis-root a compacted base and wedge the subscriber.
+      for _ in range(5):
+        fp, bman = self._retried(_fp_and_manifest, base)
+        art = serve_load(base, self.plan, mesh=f["mesh"],
+                         axis_name=f["axis_name"])
+        fp_after, _ = self._retried(_fp_and_manifest, base)
+        if fp_after == fp:
+          break
+      else:
+        raise RuntimeError(
+            f"base artifact {base!r} kept changing under the rebase; "
+            "retrying next poll")
+      del fingerprint  # superseded by the consistent re-read above
       engine = ServeEngine(f["model"], self.plan, art, mesh=f["mesh"],
                            axis_name=f["axis_name"],
                            tier_config=f["tier_config"],
                            with_metrics=f["with_metrics"],
                            donate_batch=f["donate_batch"])
+      anchor_seq, anchor_fp, root = _chain_anchor(bman, fp)
       old = self.engine
       with old.lock:
         self.engine = engine
         self.translator = art.vocab
-        self.base_fingerprint = fingerprint
-        self.fingerprint = fingerprint
-        self.applied_seq = 0
+        self.base_fingerprint = fp
+        self.fingerprint = anchor_fp
+        self.chain_root = root
+        self.applied_seq = anchor_seq
       self.telemetry.counter("stream/rebases").inc()

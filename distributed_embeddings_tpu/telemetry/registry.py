@@ -390,6 +390,25 @@ class MetricsRegistry:
         out[name] = m.value
     return out
 
+  def merge(self, other: "MetricsRegistry") -> None:
+    """Fold another registry's observations into this one — the fleet
+    ROLL-UP: N serving processes (or N subscribers on one delta chain)
+    each keep a private registry for exact per-process accounting, and
+    an aggregator merges them for the global view. Counters and
+    histograms ADD (both are pure observation counts); gauges take the
+    other's value (last-writer — a gauge is a point-in-time reading, so
+    roll up gauges only from registries snapshotted together). Metric
+    geometry mismatches (kind, histogram rel_err) raise loudly, the
+    same policy as ``Histogram.merge``."""
+    for name, m in sorted(other.metrics().items()):
+      if m.kind == "counter":
+        self.counter(name).inc(m.value)
+      elif m.kind == "gauge":
+        self.gauge(name).set(m.value)
+      else:
+        self.histogram(name, rel_err=m.rel_err,
+                       max_buckets=m.max_buckets).merge(m)
+
   # ---- persistence --------------------------------------------------------
   def state_dict(self) -> Dict[str, Any]:
     """The manifest ``telemetry`` section (JSON-serializable)."""

@@ -522,12 +522,14 @@ def test_repo_context_parses_markers_and_sites():
   ctx = LintContext.for_repo(REPO)
   assert "slow" in ctx.registered_markers
   # SITES literal members plus register_site-registered extensions
-  # ("sigkill" in faultinject.py, "delta_extract" in streaming/publish.py
-  # — both registered at module level) — test files' ad-hoc
-  # registrations are deliberately NOT scanned
+  # ("sigkill" in faultinject.py, the streaming sites in
+  # streaming/publish.py|subscribe.py|compact.py — all registered at
+  # module level) — test files' ad-hoc registrations are deliberately
+  # NOT scanned
   assert ctx.fault_sites == frozenset(
       {"ckpt_write", "ckpt_rename", "host_gather", "ckpt_owner_write",
-       "reshard_gather", "sigkill", "delta_extract"})
+       "reshard_gather", "sigkill", "delta_extract", "delta_seal",
+       "stream_attach", "stream_read", "delta_promote", "compact_fold"})
   assert "test_extension_site" not in ctx.fault_sites
 
 

@@ -57,11 +57,19 @@ from distributed_embeddings_tpu.serving import (
 )
 from distributed_embeddings_tpu.serving.export import export as serve_export
 from distributed_embeddings_tpu.serving.export import load as serve_load
+from distributed_embeddings_tpu.resilience import retry
+from distributed_embeddings_tpu.resilience.trainer import ResilientTrainer
 from distributed_embeddings_tpu.streaming import (
+    ChainDivergedError,
+    DeltaCompactor,
     DeltaPublisher,
     DeltaSubscriber,
     RowGenerationTracker,
     artifact_bytes,
+    delta_dirname,
+    published_delta_seqs,
+    read_heartbeats,
+    write_heartbeat,
 )
 from distributed_embeddings_tpu.telemetry import MetricsRegistry
 from distributed_embeddings_tpu.tiering import (
@@ -702,3 +710,546 @@ def test_batcher_dispatch_fn_swap_between_flushes():
   assert calls == [1, 2]
   np.testing.assert_array_equal(fut.result(), np.ones((2, 1)))
   mb.close()
+
+
+# ---------------------------------------------------------------------------
+# pubdir hygiene: the seq scan survives whatever accumulates there
+# ---------------------------------------------------------------------------
+
+
+def test_published_delta_seqs_ignores_stray_entries(tmp_path):
+  pub = str(tmp_path)
+  # a real published delta
+  os.makedirs(os.path.join(pub, "delta_000002"))
+  open(os.path.join(pub, "delta_000002", "manifest.json"), "w").write("{}")
+  # a torn publish (killed mid-seal): dir without a manifest
+  os.makedirs(os.path.join(pub, "delta_000001"))
+  # a torn tmp, an .old rotation, a heartbeats dir, operator droppings
+  os.makedirs(os.path.join(pub, "delta_000003.tmp"))
+  os.makedirs(os.path.join(pub, "delta_000004.old"))
+  os.makedirs(os.path.join(pub, "heartbeats"))
+  os.makedirs(os.path.join(pub, "not_a_delta"))
+  # a stray FILE named like a delta
+  open(os.path.join(pub, "delta_000005"), "w").write("x")
+  assert published_delta_seqs(pub) == [2]
+  # a missing dir is an empty scan, never a crash
+  assert published_delta_seqs(os.path.join(pub, "nope")) == []
+
+
+# ---------------------------------------------------------------------------
+# publisher ATTACH: chain state through the checkpoint, superset re-publish
+# ---------------------------------------------------------------------------
+
+
+def _train_more(plan, rule, mesh, state, publisher, rng, b, n=1):
+  """Train ``n`` more observed batches on a fresh step fn; returns the
+  new state and the batches consumed (for deterministic replay)."""
+  step = make_sparse_train_step(ActsModel(), plan, loss_fn,
+                                optax.sgd(0.01), rule, mesh, state,
+                                _mkbatch(rng, b), donate=False)
+  consumed = []
+  for _ in range(n):
+    batch = _mkbatch(rng, b)
+    consumed.append(batch)
+    publisher.observe_batch(batch[1])
+    state, _ = step(state, *shard_batch(batch, mesh))
+  return state, consumed, step
+
+
+def test_publisher_attach_rejoins_chain_with_superset(tmp_path):
+  """Snapshot the chain state mid-chain, publish one more delta (the
+  orphan), 'kill' the publisher, restore + attach: the tail delta is
+  adopted (fingerprint continuity), the next publication re-ships a
+  SUPERSET of the orphan's rows at replayed values, and the folded
+  subscriber equals a full re-export — no re-root anywhere."""
+  plan, rule, mesh, state, publisher, sub, rng, b = _device_run(
+      tmp_path, 2, "f32")
+  ckpt = os.path.join(str(tmp_path), "ckpt")
+  checkpoint.save(ckpt, plan, rule, state, stream=publisher)
+  seq_snap, fp_snap = publisher.seq, publisher.fingerprint
+
+  # post-snapshot: one more observed batch + delta 2 (the orphan)
+  state, replay, step = _train_more(plan, rule, mesh, state, publisher,
+                                    rng, b)
+  assert publisher.publish_delta(state) is not None
+  orphan = os.path.join(str(tmp_path), "pub", delta_dirname(2))
+  with np.load(os.path.join(orphan, sorted(
+      f for f in os.listdir(orphan) if f.startswith("rows_"))[0])) as z:
+    pass  # the orphan has row payloads; the superset check reads below
+
+  # "kill": a fresh publisher restores the snapshot and attaches
+  tracker2 = RowGenerationTracker(plan)
+  pub2 = DeltaPublisher(sub.path, plan, rule, tracker2, quantize="f32")
+  state2 = checkpoint.restore(ckpt, plan, rule, state, mesh=mesh,
+                              stream=pub2)
+  assert not pub2.attached
+  with pytest.raises(RuntimeError, match="unattached"):
+    pub2.publish_delta(state2)
+  assert pub2.seq == seq_snap and pub2.fingerprint == fp_snap
+  assert pub2.attach() == 1  # the orphan tail delta is adopted
+  assert pub2.attached and pub2.seq == 2
+  assert pub2.fingerprint == checkpoint.manifest_fingerprint(orphan)
+
+  # replay the post-snapshot stream (bit-identical training), publish:
+  # delta 3 must cover every row the orphan shipped (the superset rule)
+  for batch in replay:
+    pub2.observe_batch(batch[1])
+    state2, _ = step(state2, *shard_batch(batch, mesh))
+  assert pub2.publish_delta(state2) is not None
+  d3 = os.path.join(str(tmp_path), "pub", delta_dirname(3))
+
+  def rows_of(dpath):
+    out = {}
+    for f in os.listdir(dpath):
+      if f.startswith("rows_"):
+        with np.load(os.path.join(dpath, f)) as z:
+          out[f] = set(np.asarray(z["idx"]).tolist())
+    return out
+  orphan_rows, d3_rows = rows_of(orphan), rows_of(d3)
+  for f, idx in orphan_rows.items():
+    assert idx <= d3_rows.get(f, set()), f
+
+  # the subscriber folds 1..3 and lands on the replayed state exactly
+  assert sub.poll_once() == 3
+  engB, art = _full_engine(tmp_path, plan, rule, mesh, state2, "f32")
+  for name, want in art.state["serve"].items():
+    np.testing.assert_array_equal(
+        np.asarray(sub.engine.state["serve"][name]), np.asarray(want))
+
+
+def test_preroot_snapshot_resumes_fresh_publisher(tmp_path):
+  """A checkpoint saved BEFORE the chain was rooted (publisher
+  fingerprint None) restores a FRESH publisher: the resume does not
+  demand attach() (there is no chain to re-join), publish_base roots
+  one, and the loop proceeds — not a permanent crash loop."""
+  plan, rule, mesh, state, publisher, sub, rng, b = _device_run(
+      tmp_path, 1, "f32")
+  fresh = DeltaPublisher(os.path.join(str(tmp_path), "pub2"), plan,
+                         rule, RowGenerationTracker(plan),
+                         quantize="f32")
+  ckpt = os.path.join(str(tmp_path), "ckpt_preroot")
+  checkpoint.save(ckpt, plan, rule, state, stream=fresh)
+  pub2 = DeltaPublisher(os.path.join(str(tmp_path), "pub2"), plan,
+                        rule, RowGenerationTracker(plan),
+                        quantize="f32")
+  checkpoint.restore(ckpt, plan, rule, state, mesh=mesh, stream=pub2)
+  assert pub2.attached and pub2.fingerprint is None
+  pub2.publish_base(state)  # root explicitly; no ChainDiverged crash
+  assert pub2.seq == 0 and pub2.fingerprint is not None
+
+
+def test_attach_refuses_forked_or_rerooted_chain(tmp_path):
+  import json
+  plan, rule, mesh, state, publisher, sub, rng, b = _device_run(
+      tmp_path, 1, "f32")
+  ckpt = os.path.join(str(tmp_path), "ckpt")
+  checkpoint.save(ckpt, plan, rule, state, stream=publisher)
+  state, _replay, _step = _train_more(plan, rule, mesh, state,
+                                      publisher, rng, b)
+  assert publisher.publish_delta(state) is not None
+
+  # fork: the tail delta chains a different predecessor
+  dpath = os.path.join(sub.path, delta_dirname(2))
+  mpath = os.path.join(dpath, "manifest.json")
+  with open(mpath) as f:
+    manifest = json.load(f)
+  good = manifest["base_fingerprint"]
+  manifest["base_fingerprint"] = "f" * 64
+  with open(mpath, "w") as f:
+    json.dump(manifest, f)
+  pub2 = DeltaPublisher(sub.path, plan, rule,
+                        RowGenerationTracker(plan), quantize="f32")
+  checkpoint.restore(ckpt, plan, rule, state, mesh=mesh, stream=pub2)
+  with pytest.raises(ChainDivergedError) as ei:
+    pub2.attach()
+  assert ei.value.field == "base_fingerprint"
+  assert not pub2.attached
+  manifest["base_fingerprint"] = good
+  with open(mpath, "w") as f:
+    json.dump(manifest, f)
+
+  # re-rooted base: another publisher replaced base/ entirely
+  pub3 = DeltaPublisher(sub.path, plan, rule,
+                        RowGenerationTracker(plan), quantize="f32")
+  tr = RowGenerationTracker(plan)
+  reroot = DeltaPublisher(sub.path, plan, rule, tr, quantize="f32")
+  tr.observe(_mkbatch(rng, b)[1])
+  reroot.publish_base(state)
+  checkpoint.restore(ckpt, plan, rule, state, mesh=mesh, stream=pub3)
+  with pytest.raises(ChainDivergedError) as ei:
+    pub3.attach()
+  assert ei.value.field == "base_fingerprint"
+
+
+def test_attach_fault_injection_crash_and_retry(tmp_path):
+  """crash_after on the stream_attach site interrupts the tail walk
+  mid-validation; the retried attach (fault cleared) adopts the tail —
+  attach mutates nothing until the whole tail validates."""
+  plan, rule, mesh, state, publisher, sub, rng, b = _device_run(
+      tmp_path, 1, "f32")
+  ckpt = os.path.join(str(tmp_path), "ckpt")
+  checkpoint.save(ckpt, plan, rule, state, stream=publisher)
+  state, _replay, _step = _train_more(plan, rule, mesh, state,
+                                      publisher, rng, b)
+  assert publisher.publish_delta(state) is not None
+  pub2 = DeltaPublisher(sub.path, plan, rule,
+                        RowGenerationTracker(plan), quantize="f32")
+  checkpoint.restore(ckpt, plan, rule, state, mesh=mesh, stream=pub2)
+  inj = faultinject.FaultInjector().crash_after("stream_attach", 0)
+  with faultinject.injected(inj):
+    with pytest.raises(faultinject.InjectedCrash):
+      pub2.attach()
+  assert not pub2.attached and pub2.seq == 1  # nothing adopted
+  assert pub2.attach() == 1
+  assert pub2.attached and pub2.seq == 2
+
+
+def test_resilient_trainer_stream_auto_reattach(tmp_path):
+  """The full wiring: ResilientTrainer(stream=publisher) persists the
+  chain state per snapshot; a fresh trainer+publisher pair auto-resumes
+  AND auto-attaches, and the continued chain folds to the re-export of
+  the continued state."""
+  rng = np.random.default_rng(3)
+  tables = [TableConfig(s, w, combiner="sum")
+            for s, w in zip(SIZES, WIDTHS)]
+  plan = DistEmbeddingStrategy(tables, 2, "memory_balanced",
+                               dense_row_threshold=0,
+                               input_hotness=HOTNESS)
+  weights = [rng.standard_normal((s, w)).astype(np.float32)
+             for s, w in zip(SIZES, WIDTHS)]
+  params = {"embeddings": {k: jnp.asarray(v)
+                           for k, v in set_weights(plan, weights).items()}}
+  rule = sparse_rule("adagrad", 0.05)
+  opt = optax.sgd(0.01)
+  mesh = create_mesh(2)
+  state0 = shard_params(init_sparse_state(plan, params, rule, opt), mesh)
+  b = 8
+  stream = [_mkbatch(rng, b) for _ in range(6)]
+  step = make_sparse_train_step(ActsModel(), plan, loss_fn, opt, rule,
+                                mesh, state0, stream[0], donate=False,
+                                guard=True)
+  root = os.path.join(str(tmp_path), "ckpts")
+  pubdir = os.path.join(str(tmp_path), "pub")
+
+  tracker = RowGenerationTracker(plan)
+  publisher = DeltaPublisher(pubdir, plan, rule, tracker, quantize="f32")
+  t1 = ResilientTrainer(step, state0, plan, rule, root, mesh=mesh,
+                        snapshot_every=2, stream=publisher)
+  publisher.publish_base(t1.state)
+  t1.snapshot()
+  for i in range(4):  # first lifetime: 4 of 6 batches, 2 deltas
+    publisher.observe_batch(stream[i][1])
+    t1.step(*shard_batch(stream[i], mesh))
+    if (i + 1) % 2 == 0:
+      assert publisher.publish_delta(t1.state) is not None
+
+  # lifetime 2: fresh objects, auto-resume + auto-attach
+  tracker2 = RowGenerationTracker(plan)
+  pub2 = DeltaPublisher(pubdir, plan, rule, tracker2, quantize="f32")
+  t2 = ResilientTrainer(step, state0, plan, rule, root, mesh=mesh,
+                        snapshot_every=2, stream=pub2)
+  assert t2.resumed_from is not None
+  assert pub2.attached
+  assert pub2.seq == publisher.seq
+  assert pub2.base_fingerprint == publisher.base_fingerprint
+  for i in range(t2.consumed, 6):
+    pub2.observe_batch(stream[i][1])
+    t2.step(*shard_batch(stream[i], mesh))
+  assert pub2.publish_delta(t2.state) is not None
+
+  sub = DeltaSubscriber.from_artifact(ActsModel(), plan, pubdir,
+                                      mesh=mesh)
+  assert sub.poll_once() == pub2.seq
+  engB, art = _full_engine(tmp_path, plan, rule, mesh, t2.state, "f32")
+  for name, want in art.state["serve"].items():
+    np.testing.assert_array_equal(
+        np.asarray(sub.engine.state["serve"][name]), np.asarray(want))
+
+
+# ---------------------------------------------------------------------------
+# compaction: fold the chain into a new base, GC under the retention floor
+# ---------------------------------------------------------------------------
+
+
+def _chain_of(tmp_path, n_deltas, world=2, quantize="f32"):
+  plan, rule, mesh, state, publisher, sub, rng, b = _device_run(
+      tmp_path, world, quantize)
+  for _ in range(n_deltas - 1):
+    state, _c, _s = _train_more(plan, rule, mesh, state, publisher,
+                                rng, b)
+    assert publisher.publish_delta(state) is not None
+  return plan, rule, mesh, state, publisher, sub, rng, b
+
+
+def test_compaction_folds_chain_to_new_base(tmp_path):
+  plan, rule, mesh, state, publisher, sub, rng, b = _chain_of(
+      tmp_path, 3)
+  reg = MetricsRegistry()
+  res = DeltaCompactor(sub.path, telemetry=reg).compact_once(
+      through_seq=2)
+  assert res["through_seq"] == 2 and res["deltas_folded"] == 2
+  # no registered subscriber heartbeats yet -> folded deltas are GC'd
+  assert res["gc_removed"] == [1, 2]
+  assert published_delta_seqs(sub.path) == [3]
+  base = os.path.join(sub.path, "base")
+  comp = checkpoint.read_manifest(base)["stream"]["compacted"]
+  assert comp["through_seq"] == 2
+  assert comp["chain_root"] == publisher.base_fingerprint
+
+  # cold start: anchors at the compaction point, folds ONLY the tail
+  cold = DeltaSubscriber.from_artifact(ActsModel(), plan, sub.path,
+                                       mesh=mesh, heartbeat=False)
+  assert cold.applied_seq == 2
+  assert cold.poll_once() == 1
+  assert cold.applied_seq == 3
+  engB, art = _full_engine(tmp_path, plan, rule, mesh, state, "f32")
+  for name, want in art.state["serve"].items():
+    np.testing.assert_array_equal(
+        np.asarray(cold.engine.state["serve"][name]), np.asarray(want))
+
+  # a LIVE subscriber already past the compaction point only adopts the
+  # new base identity — no rebase, no reload
+  sreg = MetricsRegistry()
+  live = DeltaSubscriber.from_artifact(ActsModel(), plan, sub.path,
+                                       mesh=mesh, telemetry=sreg,
+                                       heartbeat=False)
+  live.poll_once()
+  before = live.base_fingerprint
+  res2 = DeltaCompactor(sub.path).compact_once()  # fold the tail too
+  assert res2["through_seq"] == 3
+  assert live.poll_once() == 0
+  assert live.base_fingerprint != before
+  assert sreg.counter("stream/compactions_adopted").value == 1
+  assert sreg.counter("stream/rebases").value == 0
+
+
+def test_compaction_quantized_cold_start_quant_exact(tmp_path):
+  plan, rule, mesh, state, publisher, sub, rng, b = _chain_of(
+      tmp_path, 2, quantize="int8")
+  DeltaCompactor(sub.path).compact_once(through_seq=1)
+  cold = DeltaSubscriber.from_artifact(ActsModel(), plan, sub.path,
+                                       mesh=mesh, heartbeat=False)
+  cold.poll_once()
+  engB, art = _full_engine(tmp_path, plan, rule, mesh, state, "int8")
+  for name, want in art.state["serve"].items():
+    np.testing.assert_array_equal(
+        np.asarray(cold.engine.state["serve"][name]).view(np.uint8),
+        np.asarray(want).view(np.uint8))
+
+
+def test_compaction_crash_mid_fold_never_corrupts_base(tmp_path):
+  plan, rule, mesh, state, publisher, sub, rng, b = _chain_of(
+      tmp_path, 2)
+  base = os.path.join(sub.path, "base")
+  fp_before = checkpoint.manifest_fingerprint(base)
+  # crash_after: die between the first and second class fold
+  inj = faultinject.FaultInjector().crash_after("compact_fold", 0)
+  with faultinject.injected(inj):
+    with pytest.raises(faultinject.InjectedCrash):
+      DeltaCompactor(sub.path).compact_once()
+  assert checkpoint.manifest_fingerprint(base) == fp_before
+  assert checkpoint.verify(base) == []
+  assert published_delta_seqs(sub.path) == [1, 2]  # GC never ran
+  # fail_first: a transient fold-time error propagates the same way
+  inj = faultinject.FaultInjector().fail_first("compact_fold", 1)
+  with faultinject.injected(inj):
+    with pytest.raises(faultinject.TransientIOError):
+      DeltaCompactor(sub.path).compact_once()
+  assert checkpoint.verify(base) == []
+  # the retry (fault cleared) compacts; the torn tmp is replaced
+  res = DeltaCompactor(sub.path).compact_once()
+  assert res["through_seq"] == 2
+  cold = DeltaSubscriber.from_artifact(ActsModel(), plan, sub.path,
+                                       mesh=mesh, heartbeat=False)
+  assert cold.applied_seq == 2
+  engB, art = _full_engine(tmp_path, plan, rule, mesh, state, "f32")
+  for name, want in art.state["serve"].items():
+    np.testing.assert_array_equal(
+        np.asarray(cold.engine.state["serve"][name]), np.asarray(want))
+
+
+def test_compaction_retention_respects_live_heartbeats(tmp_path):
+  import json
+  import time as _time
+  plan, rule, mesh, state, publisher, sub, rng, b = _chain_of(
+      tmp_path, 3)
+  # a live subscriber still at seq 1: deltas > 1 must survive GC
+  write_heartbeat(sub.path, "laggard", 1)
+  res = DeltaCompactor(sub.path).compact_once(through_seq=3)
+  assert res["gc_removed"] == [1]
+  assert published_delta_seqs(sub.path) == [2, 3]
+  # an EXPIRED heartbeat does not hold the floor
+  hb = {"id": "dead", "applied_seq": 0, "wall": _time.time() - 10_000}
+  with open(os.path.join(sub.path, "heartbeats", "dead.json"),
+            "w") as f:
+    json.dump(hb, f)
+  os.remove(os.path.join(sub.path, "heartbeats", "laggard.json"))
+  comp = DeltaCompactor(sub.path)
+  removed = comp.gc_deltas(3)
+  assert removed == [2, 3]
+
+
+# ---------------------------------------------------------------------------
+# back-pressure: heartbeats, throttle-then-coalesce, expiry
+# ---------------------------------------------------------------------------
+
+
+def test_backpressure_throttles_then_coalesces(tmp_path):
+  reg = MetricsRegistry()
+  plan, rule, mesh, state, publisher, sub, rng, b = _device_run(
+      tmp_path, 1, "f32", registry=reg)
+  publisher.max_subscriber_lag = 2
+  # a registered live subscriber stuck at seq 0
+  write_heartbeat(sub.path, "slow", 0)
+  # delta 1 already exists (lag 1 < 2); delta 2 publishes (lag then 2)
+  state, _c, step = _train_more(plan, rule, mesh, state, publisher,
+                                rng, b)
+  assert publisher.publish_delta(state) is not None
+  assert publisher.seq == 2
+  # lag 2 >= 2: the next interval DEFERS — watermark holds
+  wm = publisher.watermark
+  state, _c, _s = _train_more(plan, rule, mesh, state, publisher,
+                              rng, b)
+  assert publisher.publish_delta(state) is None
+  assert publisher.watermark == wm
+  assert reg.counter("stream/publishes_throttled").value == 1
+  # ... unless forced (operator override)
+  # the laggard catches up: the deferred interval coalesces into seq 3
+  write_heartbeat(sub.path, "slow", 2)
+  changed_before = publisher.tracker.changed_row_total(wm)
+  assert publisher.publish_delta(state) is not None
+  assert publisher.seq == 3
+  assert reg.counter("stream/deltas_coalesced").value == 1
+  d3 = os.path.join(sub.path, delta_dirname(3))
+  n_shipped = sum(
+      int(np.load(os.path.join(d3, f))["idx"].size)
+      for f in os.listdir(d3) if f.startswith("rows_"))
+  assert n_shipped == changed_before  # both intervals' rows, one delta
+  # the real subscriber still folds the whole chain exactly
+  assert sub.poll_once() == 3
+  engB, art = _full_engine(tmp_path, plan, rule, mesh, state, "f32")
+  for name, want in art.state["serve"].items():
+    np.testing.assert_array_equal(
+        np.asarray(sub.engine.state["serve"][name]), np.asarray(want))
+
+
+def test_backpressure_force_bypasses_throttle(tmp_path):
+  reg = MetricsRegistry()
+  plan, rule, mesh, state, publisher, sub, rng, b = _device_run(
+      tmp_path, 1, "f32", registry=reg)
+  publisher.max_subscriber_lag = 1
+  write_heartbeat(sub.path, "slow", 0)
+  state, _c, _s = _train_more(plan, rule, mesh, state, publisher,
+                              rng, b)
+  assert publisher.publish_delta(state) is None  # lag 1 >= 1
+  assert publisher.publish_delta(state, force=True) is not None
+
+
+def test_expired_heartbeat_drops_from_quorum_once(tmp_path):
+  import json
+  import time as _time
+  reg = MetricsRegistry()
+  plan, rule, mesh, state, publisher, sub, rng, b = _device_run(
+      tmp_path, 1, "f32", registry=reg)
+  publisher.max_subscriber_lag = 1
+  hb = {"id": "dead", "applied_seq": 0, "wall": _time.time() - 10_000}
+  os.makedirs(os.path.join(sub.path, "heartbeats"), exist_ok=True)
+  with open(os.path.join(sub.path, "heartbeats", "dead.json"),
+            "w") as f:
+    json.dump(hb, f)
+  # the dead subscriber is dropped (counted once), not a throttle vote
+  state, _c, _s = _train_more(plan, rule, mesh, state, publisher,
+                              rng, b)
+  assert publisher.publish_delta(state) is not None
+  assert reg.counter("stream/subscribers_expired").value == 1
+  state, _c, _s = _train_more(plan, rule, mesh, state, publisher,
+                              rng, b)
+  assert publisher.publish_delta(state) is not None
+  assert reg.counter("stream/subscribers_expired").value == 1  # once
+
+
+def test_two_subscribers_one_chain_heartbeats_and_rollup(tmp_path):
+  """Two serving processes on one chain: independent applied_seq
+  heartbeats in the pubdir, per-process freshness in private
+  registries, and the fleet view rolled up through the registry
+  merge."""
+  plan, rule, mesh, state, publisher, sub, rng, b = _device_run(
+      tmp_path, 2, "f32")
+  regA, regB = MetricsRegistry(), MetricsRegistry()
+  subA = DeltaSubscriber.from_artifact(ActsModel(), plan, sub.path,
+                                       mesh=mesh, telemetry=regA,
+                                       subscriber_id="serve-a")
+  subB = DeltaSubscriber.from_artifact(ActsModel(), plan, sub.path,
+                                       mesh=mesh, telemetry=regB,
+                                       subscriber_id="serve-b")
+  assert subA.poll_once() == 1 and subB.poll_once() == 1
+  # one more delta; only A polls -> independent applied positions
+  state, _c, _s = _train_more(plan, rule, mesh, state, publisher,
+                              rng, b)
+  assert publisher.publish_delta(state) is not None
+  assert subA.poll_once() == 1
+  live, expired = read_heartbeats(sub.path, ttl_s=30.0)
+  assert not expired
+  assert live["serve-a"]["applied_seq"] == 2
+  assert live["serve-b"]["applied_seq"] == 1
+  # publisher-side lag reads the slowest live subscriber
+  publisher.max_subscriber_lag = 10
+  assert publisher.subscriber_lag() == 1
+
+  fleet = MetricsRegistry()
+  fleet.merge(regA)
+  fleet.merge(regB)
+  assert fleet.counter("stream/deltas_applied").value == 3
+  rolled = fleet.metrics()["stream/freshness_s"]
+  assert rolled.count == 3
+  assert rolled.count == (regA.metrics()["stream/freshness_s"].count
+                          + regB.metrics()["stream/freshness_s"].count)
+
+
+# ---------------------------------------------------------------------------
+# transient-read retry on the subscriber's validate/fold path
+# ---------------------------------------------------------------------------
+
+
+def test_subscriber_retries_transient_reads(tmp_path):
+  from distributed_embeddings_tpu import telemetry as _t
+  plan, rule, mesh, state, publisher, sub, rng, b = _device_run(
+      tmp_path, 1, "f32")
+  sub.retry_policy = retry.RetryPolicy(retries=3, backoff=0.0)
+  before = _t.get_registry().counter("retry/attempts").value
+  inj = faultinject.FaultInjector().fail_first("stream_read", 2)
+  with faultinject.injected(inj):
+    assert sub.poll_once() == 1  # transient faults absorbed, applied
+  assert sub.last_refusal is None
+  assert _t.get_registry().counter("retry/attempts").value - before == 2
+
+
+def test_subscriber_exhausted_reads_surface_without_advancing(tmp_path):
+  plan, rule, mesh, state, publisher, sub, rng, b = _device_run(
+      tmp_path, 1, "f32")
+  sub.retry_policy = retry.RetryPolicy(retries=1, backoff=0.0)
+  inj = faultinject.FaultInjector().fail_first("stream_read", 10_000)
+  with faultinject.injected(inj):
+    with pytest.raises(OSError):
+      sub.poll_once()
+  assert sub.applied_seq == 0  # held position, nothing half-applied
+  # the fault clears (NFS came back): the same chain applies cleanly
+  assert sub.poll_once() == 1
+  assert sub.applied_seq == 1
+
+
+# ---------------------------------------------------------------------------
+# streaming chaos: the long cross-process variant
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_stream_full():
+  import sys
+  sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                  "tools"))
+  import chaos_stream
+  res = chaos_stream.run_chaos_stream(steps=16, world=2,
+                                      publish_every=2, quantize="int8",
+                                      smoke=False, verbose=False)
+  assert res["ok"], res

@@ -1,0 +1,570 @@
+"""Streaming chaos: SIGKILL every delta-chain participant, relaunch, verify.
+
+`tools/chaos_kill.py` proved the TRAINING loop survives real SIGKILLs;
+this driver proves the ONLINE-LEARNING loop does — the trainer→serving
+delta chain survives the death of any participant without ever serving
+wrong rows:
+
+1. **reference**: one unkilled trainer runs a fixed stream, publishing a
+   base + row-granular deltas, and dumps its final state in serve
+   layout — the byte-exact target every killed cycle must reproduce;
+2. **trainer kill mid-publish** (``delta_seal`` site): a real SIGKILL
+   during a delta's seal leaves a torn ``delta_<seq>.tmp``; the
+   relaunch auto-resumes through ``ResilientTrainer(stream=publisher)``
+   — the checkpoint manifest's ``stream`` section restores the chain
+   state + generation stamps and ``publisher.attach()`` re-joins the
+   existing chain from the pubdir tail (NO re-root: the base
+   fingerprint is unchanged and every delta's ``base_fingerprint``
+   stays sha256-continuous across the kill); rows touched between the
+   restored snapshot and the kill are re-published as a superset delta;
+3. **trainer kill between steps after a publish** (``sigkill`` marker):
+   exercises tail ADOPTION — the restored snapshot predates deltas the
+   killed lifetime already published, so attach validates and adopts
+   them and force-re-stamps their rows;
+4. **subscriber kill mid-promote** (``delta_promote`` site): a fresh
+   cold-start relaunch replays the chain and converges to the same
+   bytes;
+5. **compactor kill mid-fold** (``compact_fold`` site): the torn
+   ``base.compact.tmp`` never touches the live base (still verifies);
+   the relaunch compacts through ``final_seq - 1`` and a cold-start
+   subscriber then loads compacted base + the one-delta tail — same
+   bytes again, with the folded/GC'd prefix gone.
+
+Verdict via ``telemetry.emit_verdict`` (exit 0 iff every cycle passed).
+``--smoke`` is the ``make verify`` tier: 2 worker subprocesses (the
+mid-publish kill + relaunch), subscriber/compaction checks in-driver.
+The full run is ``make chaos-stream``; the long variant is
+``@pytest.mark.slow`` in ``tests/test_streaming.py``.
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+if __name__ == "__main__":  # standalone: build the virtual CPU mesh
+  flags = os.environ.get("XLA_FLAGS", "")
+  if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+  os.environ.setdefault("JAX_PLATFORMS", "cpu")
+  sys.path.insert(0, _REPO)
+
+VOCAB = [500, 300, 150]
+WIDTHS = [16, 8, 8]  # two widths -> >= 2 fused classes (compact_fold
+                     # fires per class, so the mid-fold kill can land
+                     # between them)
+HOTNESS = [2, 1, 1]
+GLOBAL_BATCH = 16
+
+
+def _make_plan(world):
+  from distributed_embeddings_tpu.layers.embedding import TableConfig
+  from distributed_embeddings_tpu.layers.planner import (
+      DistEmbeddingStrategy,
+  )
+  tables = [TableConfig(v, w, combiner="sum")
+            for v, w in zip(VOCAB, WIDTHS)]
+  return DistEmbeddingStrategy(tables, world, "memory_balanced",
+                               dense_row_threshold=0,
+                               input_hotness=HOTNESS)
+
+
+def _batches(n, seed=11):
+  """World-independent deterministic stream (multi-hot, PAD holes)."""
+  import numpy as np
+  from distributed_embeddings_tpu.parallel.lookup_engine import PAD_ID
+  rng = np.random.default_rng(seed)
+  out = []
+  for _ in range(n):
+    cats = []
+    for v, h in zip(VOCAB, HOTNESS):
+      x = rng.integers(0, v, (GLOBAL_BATCH, h)).astype(np.int32)
+      x[rng.random(x.shape) < 0.2] = PAD_ID
+      cats.append(x)
+    numerical = rng.standard_normal((GLOBAL_BATCH, 4)).astype(np.float32)
+    labels = rng.integers(0, 2, GLOBAL_BATCH).astype(np.float32)
+    out.append((numerical, cats, labels))
+  return out
+
+
+class _ActsModel:
+  """Embedding activations straight through — the serve-state bytes are
+  the whole comparison surface."""
+
+  def apply(self, variables, numerical, cats, emb_acts=None):
+    import jax.numpy as jnp
+    del variables, numerical, cats
+    return jnp.concatenate(list(emb_acts), axis=-1)
+
+
+def _loss(preds, labels):
+  import jax.numpy as jnp
+  return jnp.mean((jnp.sum(preds, axis=-1) - labels) ** 2)
+
+
+def _dump_state_digest(out_path, plan, rule, state, quantize):
+  """Final train state in serve layout (freeze codecs), byte-comparable
+  across processes: per class the concatenated disk-form blocks, plus
+  the flat dense parts."""
+  import numpy as np
+  from distributed_embeddings_tpu.checkpoint import _flatten_with_paths
+  from distributed_embeddings_tpu.serving.export import freeze
+  frozen = freeze(plan, rule, state, quantize=quantize)
+  flat = {}
+  for name, blocks in frozen.device_blocks.items():
+    flat["serve/" + name] = frozen.meta[name].to_disk(
+        np.concatenate(blocks))
+  for part, tree in (("dense", frozen.dense),
+                     ("emb_dense", frozen.emb_dense)):
+    for k, v in _flatten_with_paths(tree).items():
+      flat[f"{part}/{k}"] = v
+  np.savez(out_path, **flat)
+
+
+def _dump_engine_digest(out_path, sub):
+  """A subscriber's folded serve state in the same digest layout."""
+  import numpy as np
+  from distributed_embeddings_tpu.checkpoint import _flatten_with_paths
+  eng = sub.engine
+  flat = {}
+  for name, buf in eng.state["serve"].items():
+    flat["serve/" + name] = eng.meta[name].to_disk(np.asarray(buf))
+  for part in ("dense", "emb_dense"):
+    for k, v in _flatten_with_paths(eng.state[part]).items():
+      flat[f"{part}/{k}"] = v
+  np.savez(out_path, **flat)
+
+
+def _digests_equal(a_path, b_path):
+  import numpy as np
+  with np.load(a_path) as za, np.load(b_path) as zb:
+    a = {k: np.asarray(v) for k, v in za.items()}
+    b = {k: np.asarray(v) for k, v in zb.items()}
+  if set(a) != set(b):
+    return False
+  return all(np.array_equal(a[k].view(np.uint8), b[k].view(np.uint8))
+             for k in a)
+
+
+# ---------------------------------------------------------------------------
+# workers: one participant process lifetime each
+# ---------------------------------------------------------------------------
+
+
+def run_trainer(root, pubdir, world, steps, publish_every=2,
+                snapshot_every=2, quantize="f32", kill_site="",
+                kill_event=-1, digest_path=""):
+  """One trainer lifetime: auto-resume + ATTACH, observe/step/publish."""
+  import jax
+  import numpy as np
+  import optax
+
+  from distributed_embeddings_tpu import telemetry
+  from distributed_embeddings_tpu.layers.dist_model_parallel import (
+      set_weights,
+  )
+  from distributed_embeddings_tpu.ops.packed_table import sparse_rule
+  from distributed_embeddings_tpu.parallel import create_mesh
+  from distributed_embeddings_tpu.resilience import (
+      FaultInjector,
+      faultinject,
+  )
+  from distributed_embeddings_tpu.resilience.trainer import ResilientTrainer
+  from distributed_embeddings_tpu.streaming import (
+      DeltaPublisher,
+      RowGenerationTracker,
+  )
+  from distributed_embeddings_tpu.training import (
+      init_sparse_state,
+      make_sparse_train_step,
+      shard_batch,
+      shard_params,
+  )
+
+  plan = _make_plan(world)
+  rng = np.random.default_rng(0)
+  weights = [rng.standard_normal((v, w)).astype(np.float32) * 0.1
+             for v, w in zip(VOCAB, WIDTHS)]
+  params = {"embeddings": {k: np.asarray(v) for k, v in
+                           set_weights(plan, weights).items()}}
+  rule = sparse_rule("adagrad", 0.05)
+  opt = optax.sgd(0.01)
+  mesh = create_mesh(world) if world > 1 else None
+  state = shard_params(init_sparse_state(plan, params, rule, opt), mesh)
+  stream = _batches(steps)
+  step = make_sparse_train_step(_ActsModel(), plan, _loss, opt, rule,
+                                mesh, state, stream[0], donate=False,
+                                guard=True)
+
+  tracker = RowGenerationTracker(plan)
+  publisher = DeltaPublisher(pubdir, plan, rule, tracker,
+                             quantize=quantize)
+  t = ResilientTrainer(step, state, plan, rule, root, mesh=mesh,
+                       snapshot_every=snapshot_every, stream=publisher)
+  if publisher.fingerprint is None:
+    # fresh start (or a pre-chain checkpoint): root the chain, then
+    # snapshot immediately so any later kill can ATTACH instead of
+    # re-rooting
+    publisher.publish_base(t.state)
+    t.snapshot()
+
+  inj = FaultInjector()
+  if kill_site:
+    inj.kill_at(kill_site, kill_event)
+  with faultinject.injected(inj):
+    for i in range(t.consumed, steps):
+      faultinject.fire(faultinject.SIGKILL_SITE, batch=i)
+      publisher.observe_batch(stream[i][1])
+      t.step(*shard_batch(stream[i], mesh))
+      if (i + 1) % publish_every == 0:
+        publisher.publish_delta(t.state)
+    publisher.publish_delta(t.state)  # ship any tail rows
+    t.snapshot()
+
+  reg = telemetry.get_registry()
+  summary = {
+      "world": world,
+      "steps": t.step_count,
+      "consumed": t.consumed,
+      "final_seq": publisher.seq,
+      "final_fingerprint": publisher.fingerprint,
+      "base_fingerprint": publisher.base_fingerprint,
+      "resumed_from": t.resumed_from,
+      "attaches": reg.counter("stream/attaches").value,
+      "attach_deltas_adopted":
+          reg.counter("stream/attach_deltas_adopted").value,
+  }
+  if digest_path:
+    _dump_state_digest(digest_path, plan, rule, t.state, quantize)
+  with open(os.path.join(pubdir, "chain_done.json"), "w") as f:
+    json.dump(summary, f)
+  return summary
+
+
+def run_subscriber(pubdir, world, out_path, kill_site="", kill_event=-1,
+                   subscriber_id="chaos-sub", max_polls=500):
+  """One subscriber lifetime: cold-start, fold to the chain head, dump
+  the folded state digest."""
+  import time
+
+  from distributed_embeddings_tpu import telemetry
+  from distributed_embeddings_tpu.parallel import create_mesh
+  from distributed_embeddings_tpu.resilience import (
+      FaultInjector,
+      faultinject,
+  )
+  from distributed_embeddings_tpu.streaming import (
+      DeltaSubscriber,
+      artifact_bytes,
+      delta_dirname,
+  )
+
+  with open(os.path.join(pubdir, "chain_done.json")) as f:
+    done = json.load(f)
+  plan = _make_plan(world)
+  mesh = create_mesh(world) if world > 1 else None
+  reg = telemetry.MetricsRegistry()
+  inj = FaultInjector()
+  if kill_site:
+    inj.kill_at(kill_site, kill_event)
+  with faultinject.injected(inj):
+    sub = DeltaSubscriber.from_artifact(
+        _ActsModel(), plan, pubdir, mesh=mesh, telemetry=reg,
+        subscriber_id=subscriber_id)
+    start_seq = sub.applied_seq  # compacted bases anchor mid-chain
+    polls = 0
+    while (sub.applied_seq < done["final_seq"]
+           or sub.fingerprint != done["final_fingerprint"]):
+      sub.poll_once()
+      polls += 1
+      if polls >= max_polls:
+        break
+      time.sleep(0.01)
+  folded_bytes = artifact_bytes(os.path.join(pubdir, "base")) + sum(
+      artifact_bytes(os.path.join(pubdir, delta_dirname(s)))
+      for s in range(start_seq + 1, sub.applied_seq + 1))
+  _dump_engine_digest(out_path, sub)
+  summary = {
+      "applied_seq": sub.applied_seq,
+      "start_seq": start_seq,
+      "converged": sub.fingerprint == done["final_fingerprint"],
+      "refusals": reg.counter("stream/deltas_refused").value,
+      "rebases": reg.counter("stream/rebases").value,
+      "cold_start_bytes": folded_bytes,
+      "last_refusal": sub.last_refusal,
+  }
+  with open(out_path + ".summary", "w") as f:
+    json.dump(summary, f)
+  return summary
+
+
+def run_compactor(pubdir, through=None, kill_site="", kill_event=-1):
+  from distributed_embeddings_tpu.resilience import (
+      FaultInjector,
+      faultinject,
+  )
+  from distributed_embeddings_tpu.streaming import DeltaCompactor
+
+  inj = FaultInjector()
+  if kill_site:
+    inj.kill_at(kill_site, kill_event)
+  with faultinject.injected(inj):
+    res = DeltaCompactor(pubdir).compact_once(through_seq=through)
+  with open(os.path.join(pubdir, "compact_done.json"), "w") as f:
+    json.dump(res, f)
+  return res
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def _spawn(role, pubdir, world, extra_args=()):
+  env = dict(os.environ)
+  env.setdefault("JAX_PLATFORMS", "cpu")
+  flags = env.get("XLA_FLAGS", "")
+  if "xla_force_host_platform_device_count" not in flags:
+    env["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+  cmd = [sys.executable, os.path.abspath(__file__), "--worker", role,
+         "--pubdir", pubdir, "--world", str(world)] + list(extra_args)
+  return subprocess.run(cmd, cwd=_REPO, env=env).returncode
+
+
+def _chain_links_continuous(pubdir):
+  """Every published delta's ``base_fingerprint`` equals the sha256
+  manifest fingerprint of its predecessor — the no-re-root proof."""
+  from distributed_embeddings_tpu.checkpoint import (
+      manifest_fingerprint,
+      read_manifest,
+  )
+  from distributed_embeddings_tpu.streaming import (
+      chain_anchor,
+      delta_dirname,
+      published_delta_seqs,
+  )
+  base = os.path.join(pubdir, "base")
+  fp = manifest_fingerprint(base)
+  anchor_seq, prev, _root = chain_anchor(read_manifest(base), fp)
+  for seq in published_delta_seqs(pubdir):
+    if seq <= anchor_seq:
+      return False  # a folded delta survived GC'ing AND the base moved
+    dpath = os.path.join(pubdir, delta_dirname(seq))
+    if read_manifest(dpath).get("base_fingerprint") != prev:
+      return False
+    prev = manifest_fingerprint(dpath)
+  return True
+
+
+def run_chaos_stream(steps=12, world=2, publish_every=2, quantize="f32",
+                     smoke=False, verbose=False):
+  from distributed_embeddings_tpu import checkpoint
+
+  work = tempfile.mkdtemp(prefix="chaos_stream_")
+  result = {"steps": steps, "world": world, "quantize": quantize,
+            "cycles": {}}
+
+  def dirs(name):
+    d = os.path.join(work, name)
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, "ckpts"), os.path.join(d, "pub")
+
+  t_args = ["--steps", str(steps), "--publish-every", str(publish_every),
+            "--quantize", quantize]
+
+  # ---- reference: one unkilled trainer lifetime --------------------------
+  ref_root, ref_pub = dirs("ref")
+  ref_digest = os.path.join(work, "ref", "digest.npz")
+  rc = _spawn("trainer", ref_pub, world,
+              t_args + ["--root", ref_root, "--digest", ref_digest])
+  ref_ok = rc == 0 and os.path.exists(ref_digest)
+  result["cycles"]["ref"] = {"rc": rc, "ok": ref_ok}
+  if not ref_ok:
+    result["ok"] = False
+    return result
+
+  # ---- cycle A: trainer SIGKILLed mid-publish (torn delta tmp) -----------
+  root, pub = dirs("mid_publish")
+  digest = os.path.join(work, "mid_publish", "digest.npz")
+  rc1 = _spawn("trainer", pub, world,
+               t_args + ["--root", root, "--kill-site", "delta_seal",
+                         "--kill-event", "7"])
+  torn = any(n.startswith("delta_") and n.endswith(".tmp")
+             for n in os.listdir(pub))
+  base_fp_kill = checkpoint.manifest_fingerprint(
+      os.path.join(pub, "base"))
+  rc2 = _spawn("trainer", pub, world,
+               t_args + ["--root", root, "--digest", digest])
+  with open(os.path.join(pub, "chain_done.json")) as f:
+    done_a = json.load(f)
+  base_fp_after = checkpoint.manifest_fingerprint(
+      os.path.join(pub, "base"))
+  result["cycles"]["mid_publish"] = {
+      "killed_rc": rc1, "relaunch_rc": rc2, "torn_tmp_present": torn,
+      "summary": done_a,
+      "no_reroot": base_fp_kill == base_fp_after,
+      "chain_continuous": _chain_links_continuous(pub),
+      "state_matches_ref": _digests_equal(digest, ref_digest),
+      "ok": rc1 == -signal.SIGKILL and rc2 == 0 and torn
+            and base_fp_kill == base_fp_after
+            and _chain_links_continuous(pub)
+            and _digests_equal(digest, ref_digest)}
+
+  # ---- cycle B: trainer SIGKILLed after a publish (tail ADOPTION) --------
+  if not smoke:
+    root, pub2 = dirs("adopt_tail")
+    digest2 = os.path.join(work, "adopt_tail", "digest.npz")
+    rc1 = _spawn("trainer", pub2, world,
+                 t_args + ["--root", root, "--kill-site", "sigkill",
+                           "--kill-event", "6"])
+    rc2 = _spawn("trainer", pub2, world,
+                 t_args + ["--root", root, "--digest", digest2])
+    with open(os.path.join(pub2, "chain_done.json")) as f:
+      done_b = json.load(f)
+    result["cycles"]["adopt_tail"] = {
+        "killed_rc": rc1, "relaunch_rc": rc2, "summary": done_b,
+        "chain_continuous": _chain_links_continuous(pub2),
+        "state_matches_ref": _digests_equal(digest2, ref_digest),
+        "ok": rc1 == -signal.SIGKILL and rc2 == 0
+              and done_b["attaches"] >= 1
+              and done_b["attach_deltas_adopted"] >= 1
+              and _chain_links_continuous(pub2)
+              and _digests_equal(digest2, ref_digest)}
+
+  # ---- cycle C: subscriber SIGKILLed mid-promote, cold relaunch ----------
+  sub_out = os.path.join(work, "mid_publish", "sub_digest.npz")
+  if smoke:
+    # in-driver cold fold (no kill): still proves the post-kill chain
+    # folds to the reference bytes
+    summary = run_subscriber(pub, world, sub_out,
+                             subscriber_id="smoke-sub")
+    rc1 = rc2 = None  # the SIGKILL half is the full tier's job
+    killed_ok = True
+  else:
+    rc1 = _spawn("subscriber", pub, world,
+                 ["--out", sub_out, "--kill-site", "delta_promote",
+                  "--kill-event", "1", "--sub-id", "chaos-sub-a"])
+    killed_ok = rc1 == -signal.SIGKILL
+    rc2 = _spawn("subscriber", pub, world,
+                 ["--out", sub_out, "--sub-id", "chaos-sub-a"])
+    killed_ok = killed_ok and rc2 == 0
+    with open(sub_out + ".summary") as f:
+      summary = json.load(f)
+  result["cycles"]["sub_promote"] = {
+      "killed_rc": rc1, "relaunch_rc": rc2, "summary": summary,
+      "state_matches_ref": _digests_equal(sub_out, ref_digest),
+      "ok": killed_ok and summary["converged"]
+            and summary["refusals"] == 0
+            and _digests_equal(sub_out, ref_digest)}
+  full_chain_bytes = summary["cold_start_bytes"]
+
+  # ---- cycle D: compactor SIGKILLed mid-fold, relaunch, cold base+tail ---
+  through = done_a["final_seq"] - 1
+  if smoke:
+    from distributed_embeddings_tpu.resilience import faultinject
+    from distributed_embeddings_tpu.streaming import DeltaCompactor
+    inj = faultinject.FaultInjector().crash_after("compact_fold", 1)
+    crashed = False
+    try:
+      with faultinject.injected(inj):
+        DeltaCompactor(pub).compact_once(through_seq=through)
+    except faultinject.InjectedCrash:
+      crashed = True
+    # smoke substitutes an injected crash for the real SIGKILL (one
+    # process, no relaunch); the full tier exercises the real kill
+    rc1 = -signal.SIGKILL if crashed else 0
+  else:
+    rc1 = _spawn("compactor", pub, world,
+                 ["--through", str(through), "--kill-site",
+                  "compact_fold", "--kill-event", "1"])
+  torn_tmp = os.path.isdir(os.path.join(pub, "base.compact.tmp"))
+  base_still_valid = not checkpoint.verify(os.path.join(pub, "base"))
+  if smoke:
+    res = run_compactor(pub, through=through)
+    rc2 = 0
+  else:
+    rc2 = _spawn("compactor", pub, world, ["--through", str(through)])
+    with open(os.path.join(pub, "compact_done.json")) as f:
+      res = json.load(f)
+  compacted = (checkpoint.read_manifest(os.path.join(pub, "base"))
+               .get("stream", {}).get("compacted"))
+  cold_out = os.path.join(work, "mid_publish", "cold_digest.npz")
+  cold = run_subscriber(pub, world, cold_out,
+                        subscriber_id="chaos-cold")
+  result["cycles"]["compact"] = {
+      "killed_rc": rc1, "relaunch_rc": rc2,
+      "torn_tmp_present": torn_tmp,
+      "base_valid_after_kill": base_still_valid,
+      "result": res, "cold_summary": cold,
+      "cold_state_matches_ref": _digests_equal(cold_out, ref_digest),
+      "replay_bytes_full_chain": full_chain_bytes,
+      "replay_bytes_base_tail": cold["cold_start_bytes"],
+      "ok": rc1 == -signal.SIGKILL and rc2 == 0 and torn_tmp
+            and base_still_valid
+            and bool(compacted
+                     and int(compacted["through_seq"]) == through)
+            and cold["start_seq"] == through
+            and cold["converged"] and cold["refusals"] == 0
+            and _digests_equal(cold_out, ref_digest)}
+
+  result["ok"] = all(c["ok"] for c in result["cycles"].values())
+  if verbose:
+    print(json.dumps(result, indent=1))
+  return result
+
+
+def main(argv=None) -> int:
+  p = argparse.ArgumentParser(description=__doc__)
+  p.add_argument("--worker", default="",
+                 choices=["", "trainer", "subscriber", "compactor"])
+  p.add_argument("--root", default="")
+  p.add_argument("--pubdir", default="")
+  p.add_argument("--out", default="")
+  p.add_argument("--digest", default="")
+  p.add_argument("--world", type=int, default=2)
+  p.add_argument("--steps", type=int, default=12)
+  p.add_argument("--publish-every", type=int, default=2)
+  p.add_argument("--quantize", default="f32",
+                 choices=["f32", "int8", "fp8"])
+  p.add_argument("--kill-site", default="")
+  p.add_argument("--kill-event", type=int, default=-1)
+  p.add_argument("--through", type=int, default=-1)
+  p.add_argument("--sub-id", default="chaos-sub")
+  p.add_argument("--smoke", action="store_true")
+  args = p.parse_args(argv)
+  if args.worker == "trainer":
+    run_trainer(args.root, args.pubdir, args.world, args.steps,
+                publish_every=args.publish_every,
+                quantize=args.quantize, kill_site=args.kill_site,
+                kill_event=args.kill_event, digest_path=args.digest)
+    return 0
+  if args.worker == "subscriber":
+    run_subscriber(args.pubdir, args.world, args.out,
+                   kill_site=args.kill_site, kill_event=args.kill_event,
+                   subscriber_id=args.sub_id)
+    return 0
+  if args.worker == "compactor":
+    run_compactor(args.pubdir,
+                  through=None if args.through < 0 else args.through,
+                  kill_site=args.kill_site, kill_event=args.kill_event)
+    return 0
+
+  from distributed_embeddings_tpu.telemetry import emit_verdict
+
+  res = run_chaos_stream(
+      steps=args.steps, world=args.world,
+      publish_every=args.publish_every,
+      quantize=args.quantize if not args.smoke else "f32",
+      smoke=args.smoke)
+  return emit_verdict("chaos-stream", res)
+
+
+if __name__ == "__main__":
+  sys.exit(main())

@@ -16,13 +16,23 @@ adopts row-granular deltas published every few steps. Reported:
   refusals, zero dropped requests, and the delta-folded serve state
   byte-identical to a full re-export at the final watermark;
 - **live scrape**: the registry's ``/metrics`` HTTP endpoint serves the
-  stream counters while the loop runs.
+  stream counters while the loop runs;
+- **back-pressure**: mid-run the subscriber's poll thread pauses while
+  the publisher (``max_subscriber_lag``) keeps training — publication
+  defers once the live heartbeat lags, the deferred intervals coalesce
+  into one superset delta when polling resumes, and the report prices
+  the publisher's THROTTLE OCCUPANCY (deferred / attempted);
+- **cold-start economics**: a fresh subscriber replays the FULL chain
+  (timed, delta bytes summed), then the chain is compacted through
+  ``head - 1`` and a second cold start loads compacted base + the
+  one-delta tail — the report compares replay bytes and wall time.
 
-Acceptance (docs/BENCHMARKS.md round 11): mean delta bytes <= 50% of the
-full-export bytes (expected far below), all deltas applied with the
-delta-folded state bit-exact vs re-export, and finite freshness
-percentiles. ``--smoke`` is the ``make verify`` tier: tiny world, same
-structural assertions.
+Acceptance (docs/BENCHMARKS.md round 11/12): mean delta bytes <= 50% of
+the full-export bytes (expected far below), all deltas applied with the
+delta-folded state bit-exact vs re-export, finite freshness
+percentiles, and (bench tier) cold-start base+tail replay <= 25% of the
+full-chain replay delta bytes. ``--smoke`` is the ``make verify`` tier:
+tiny world, same structural assertions plus one compaction cycle.
 
 Usage: PYTHONPATH=/root/repo python tools/profile_freshness.py [--smoke]
 """
@@ -72,10 +82,12 @@ from distributed_embeddings_tpu.serving.export import (  # noqa: E402
     load as serve_load,
 )
 from distributed_embeddings_tpu.streaming import (  # noqa: E402
+    DeltaCompactor,
     DeltaPublisher,
     DeltaSubscriber,
     RowGenerationTracker,
     artifact_bytes,
+    delta_dirname,
 )
 from distributed_embeddings_tpu.training import (  # noqa: E402
     init_sparse_state,
@@ -113,8 +125,28 @@ def churn_batch(rng, sizes, hotness, b, step, drift=0.01):
   return numerical, cats, labels
 
 
+def cold_start(plan, mesh, pubdir, head_seq, registry):
+  """Time a fresh subscriber from the pubdir base to ``head_seq``;
+  returns ``(elapsed_s, replay_delta_bytes, deltas_folded, sub)``. The
+  probe is heartbeat-free so it never joins the back-pressure quorum or
+  pins the GC retention floor."""
+  with telemetry.timed("fresh/cold_start", registry) as tm:
+    sub = DeltaSubscriber.from_artifact(ActsModel(), plan, pubdir,
+                                        mesh=mesh, telemetry=registry,
+                                        heartbeat=False)
+    start = sub.applied_seq
+    while sub.applied_seq < head_seq:
+      if sub.poll_once() == 0:
+        break
+  replay_bytes = sum(
+      artifact_bytes(os.path.join(pubdir, delta_dirname(s)))
+      for s in range(start + 1, sub.applied_seq + 1))
+  return tm.elapsed, replay_bytes, sub.applied_seq - start, sub
+
+
 def run(world, sizes, hotness, intervals, steps_per_interval, b,
-        quantize, pubdir, n_clients=2):
+        quantize, pubdir, n_clients=2, max_subscriber_lag=3,
+        pause_at=None, pause_intervals=0):
   rng = np.random.default_rng(0)
   widths = [16] * len(sizes)
   tables = [TableConfig(s, w, combiner="sum")
@@ -136,8 +168,14 @@ def run(world, sizes, hotness, intervals, steps_per_interval, b,
 
   registry = telemetry.MetricsRegistry()
   tracker = RowGenerationTracker(plan)
+  # heartbeat_ttl far above any plausible pause: the paused subscriber
+  # stops heartbeating (poll_once is the only writer), and an expired
+  # heartbeat would drop it from the quorum and silently end the
+  # throttling the bench is asserting — a timing flake on slow CI
   publisher = DeltaPublisher(pubdir, plan, rule, tracker,
-                             quantize=quantize, telemetry=registry)
+                             quantize=quantize, telemetry=registry,
+                             max_subscriber_lag=max_subscriber_lag,
+                             heartbeat_ttl_s=600.0)
 
   # warm + root the chain
   step_no = 0
@@ -183,7 +221,17 @@ def run(world, sizes, hotness, intervals, steps_per_interval, b,
   delta_bytes = []
   try:
     with telemetry.timed("fresh/loop", registry):
+      interval_no = 0
       for _ in range(intervals):
+        if pause_at is not None and interval_no == pause_at:
+          # back-pressure scenario: the subscriber's poll thread stalls
+          # (its heartbeat stays LIVE — the process is up, just slow),
+          # the publisher keeps training, and once the lag reaches
+          # max_subscriber_lag publication defers until polling resumes
+          sub.stop()
+        if pause_at is not None \
+            and interval_no == pause_at + pause_intervals:
+          sub.start()
         for _ in range(steps_per_interval):
           batch = churn_batch(rng, sizes, hotness, b, step_no)
           publisher.observe_batch(batch[1])
@@ -191,6 +239,30 @@ def run(world, sizes, hotness, intervals, steps_per_interval, b,
           step_no += 1
         if publisher.publish_delta(state) is not None:
           delta_bytes.append(publisher.last_publish_bytes)
+        interval_no += 1
+      sub.start()  # idempotent; revives the poller if a pause ran long
+      # let polling catch back up, then ship any deferred (coalesced)
+      # rows in one superset delta
+      deadline_polls = 500
+      while sub.applied_seq < publisher.seq and deadline_polls > 0:
+        stop.wait(0.02)
+        deadline_polls -= 1
+      if publisher.publish_delta(state) is not None:
+        delta_bytes.append(publisher.last_publish_bytes)
+      # one post-recovery interval so the chain TAIL is a typical delta
+      # (the coalesced superset above would otherwise dominate the tail
+      # the cold-start economics below measure)
+      for _ in range(steps_per_interval):
+        batch = churn_batch(rng, sizes, hotness, b, step_no)
+        publisher.observe_batch(batch[1])
+        state, _ = step_fn(state, *shard_batch(batch, mesh))
+        step_no += 1
+      deadline_polls = 500
+      while sub.applied_seq < publisher.seq and deadline_polls > 0:
+        stop.wait(0.02)
+        deadline_polls -= 1
+      if publisher.publish_delta(state) is not None:
+        delta_bytes.append(publisher.last_publish_bytes)
     # let the poll thread drain the tail of the chain
     deadline_polls = 500
     while sub.applied_seq < publisher.seq and deadline_polls > 0:
@@ -214,6 +286,24 @@ def run(world, sizes, hotness, intervals, steps_per_interval, b,
       np.array_equal(np.asarray(sub.engine.state["serve"][n]).view(np.uint8),
                      np.asarray(a).view(np.uint8))
       for n, a in art.state["serve"].items())
+
+  # cold-start economics: full-chain replay, then compact and re-probe
+  head = publisher.seq
+  full_s, full_replay_bytes, full_deltas, _probe = cold_start(
+      plan, mesh, pubdir, head, telemetry.MetricsRegistry())
+  compacted = DeltaCompactor(pubdir, telemetry=registry).compact_once(
+      through_seq=max(head - 1, 0) or None)
+  tail_s, tail_replay_bytes, tail_deltas, cold_sub = cold_start(
+      plan, mesh, pubdir, head, telemetry.MetricsRegistry())
+  cold_exact = cold_sub.applied_seq == head and all(
+      np.array_equal(np.asarray(cold_sub.engine.state["serve"][n])
+                     .view(np.uint8),
+                     np.asarray(a).view(np.uint8))
+      for n, a in art.state["serve"].items())
+
+  throttled = registry.counter("stream/publishes_throttled").value
+  attempted = throttled + registry.counter(
+      "stream/deltas_published").value
 
   fresh = sub.freshness
   stats = batcher.stats
@@ -243,6 +333,23 @@ def run(world, sizes, hotness, intervals, steps_per_interval, b,
       "bit_exact_vs_reexport": bool(bit_exact),
       "metrics_scrape_ok": "stream_freshness_s" in scrape_text,
       "loop_s": registry.histogram("fresh/loop").sum,
+      "throttle": {
+          "throttled": throttled,
+          "coalesced": registry.counter("stream/deltas_coalesced").value,
+          "occupancy": throttled / attempted if attempted else 0.0,
+      },
+      "cold_start": {
+          "full_chain": {"s": full_s, "replay_bytes": full_replay_bytes,
+                         "deltas": full_deltas},
+          "base_tail": {"s": tail_s, "replay_bytes": tail_replay_bytes,
+                        "deltas": tail_deltas},
+          "replay_bytes_ratio": (tail_replay_bytes / full_replay_bytes
+                                 if full_replay_bytes else 0.0),
+          "time_ratio": tail_s / full_s if full_s else 0.0,
+          "compacted_through": (compacted or {}).get("through_seq"),
+          "gc_removed": (compacted or {}).get("gc_removed"),
+          "cold_exact": bool(cold_exact),
+      },
   }
 
 
@@ -258,12 +365,13 @@ def main():
   pubdir = tempfile.mkdtemp(prefix="fresh_bench_")
   if args.smoke:
     result = run(world=2, sizes=[4000, 600], hotness=[2, 1],
-                 intervals=3, steps_per_interval=2, b=16,
+                 intervals=4, steps_per_interval=2, b=16,
                  quantize=args.quantize, pubdir=pubdir, n_clients=2)
   else:
     result = run(world=4, sizes=[50000, 8000, 1200], hotness=[3, 2, 1],
-                 intervals=8, steps_per_interval=4, b=64,
-                 quantize=args.quantize, pubdir=pubdir, n_clients=3)
+                 intervals=12, steps_per_interval=4, b=64,
+                 quantize=args.quantize, pubdir=pubdir, n_clients=3,
+                 pause_at=6, pause_intervals=5)
 
   checks = {
       "all_deltas_applied": bool(result["deltas_published"] > 0
@@ -274,12 +382,30 @@ def main():
       "requests_served": bool(result["requests_completed"] > 0),
       "bit_exact_vs_reexport": result["bit_exact_vs_reexport"],
       "freshness_measured": bool(
-          result["freshness_s"]["count"] == result["deltas_published"]
+          result["freshness_s"]["count"] >= result["deltas_published"]
           and np.isfinite(result["freshness_s"]["p99"])),
       "delta_bytes_below_half_full": bool(
           result["delta_to_full_ratio"] < 0.5),
       "metrics_scrape_ok": bool(result["metrics_scrape_ok"]),
+      # one compaction cycle: a cold start on the compacted base + tail
+      # replays fewer delta bytes than the full chain and lands on the
+      # same serve bytes
+      "compaction_cold_start_exact": bool(
+          result["cold_start"]["cold_exact"]),
+      "compaction_shrinks_replay": bool(
+          result["cold_start"]["replay_bytes_ratio"] < 1.0
+          or result["cold_start"]["full_chain"]["deltas"] <= 1),
   }
+  if not args.smoke:
+    # acceptance: cold start from compacted base+tail replays <= 25% of
+    # the full-chain delta bytes on the bench workload
+    checks["cold_start_replay_below_quarter"] = bool(
+        result["cold_start"]["replay_bytes_ratio"] <= 0.25)
+    # the paused-subscriber phase must actually defer publication (and
+    # the resume coalesce it)
+    checks["backpressure_throttled"] = bool(
+        result["throttle"]["throttled"] > 0
+        and result["throttle"]["coalesced"] > 0)
   result["checks"] = checks
   result["ok"] = all(checks.values())
   sys.exit(telemetry.emit_verdict("fresh_bench", result))
