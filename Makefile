@@ -7,7 +7,8 @@ SHELL := /bin/bash  # verify uses pipefail/PIPESTATUS
 
 .PHONY: test test-fast verify lint native bench dryrun chaos chaos-kill \
 	chaos-stream stream-smoke serve-bench serve-smoke vocab-bench \
-	vocab-smoke obs-bench obs-smoke fresh-bench fresh-smoke clean
+	vocab-smoke obs-bench obs-smoke fresh-bench fresh-smoke \
+	fleet-bench fleet-smoke clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -95,10 +96,28 @@ fresh-smoke:
 	PYTHONPATH=$(CURDIR):$$PYTHONPATH timeout -k 10 300 \
 	  $(PY) tools/profile_freshness.py --smoke
 
+# open-loop fleet load generator: exactness vs the single-process
+# engine (f32 bit-exact incl. tiered; int8/fp8 byte-exact), p50/p99/
+# p99.9 vs offered QPS across fleet sizes {1,2,4 owners} with
+# per-process telemetry rolled up through the registry merge, and a
+# kill-one-replicated-owner-mid-load run proving zero wrong answers
+# with counted failover (tools/profile_fleet.py; budgets in
+# docs/BENCHMARKS.md r17)
+fleet-bench:
+	PYTHONPATH=$(CURDIR):$$PYTHONPATH $(PY) tools/profile_fleet.py
+
+# the make-verify tier of the fleet bench: tiny world, 1-2 owners, a
+# few hundred requests — same exactness/failover/roll-up assertions,
+# timeout-guarded like the other smoke tiers
+fleet-smoke:
+	PYTHONPATH=$(CURDIR):$$PYTHONPATH timeout -k 10 300 \
+	  $(PY) tools/profile_fleet.py --smoke
+
 # the tier-1 gate, exactly as ROADMAP.md specifies it (CPU mesh, no slow
 # tests, collection errors surfaced but not fatal to the log); lint runs
 # first so invariant violations fail fast, then the smoke tiers
-verify: lint serve-smoke vocab-smoke obs-smoke fresh-smoke stream-smoke
+verify: lint serve-smoke vocab-smoke obs-smoke fresh-smoke stream-smoke \
+	fleet-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -53,6 +53,10 @@ GL113       error      no raw ``time.perf_counter``/``time.monotonic``
                        spans (and ``telemetry.timed`` / the histogram
                        type) are the sanctioned form, so every stage is
                        on one trace and one metrics schema
+GL114       error      train-only surfaces (the GL111 list) are
+                       unreachable from ``fleet/`` modules — the fleet
+                       tier is the serving engine spread over processes,
+                       same inference-only contract at fleet scope
 ==========  =========  =====================================================
 
 Trace-reachable scope (GL101/GL102) is structural: any function nested —
@@ -530,19 +534,12 @@ _TRAIN_ONLY_NAMES = frozenset({
 })
 
 
-@_rule("GL111", "error",
-       "train-only surfaces are unreachable from serving/ modules")
-def _check_serving_train_surfaces(mod: ParsedModule) -> List[Finding]:
-  # The serving subsystem's whole point is an inference image with the
-  # optimizer lanes stripped and no write path: an optax import, a
-  # guard/commit-gate helper, or a scatter-add emitter reappearing
-  # there means training plumbing leaked back into the serve step (the
-  # jaxpr audit pins the traced program; this rule catches the leak at
-  # review time, before anything traces). faultinject/retry are NOT
-  # banned — the export path legitimately rides the durable-checkpoint
-  # machinery.
+def _train_surface_findings(mod: ParsedModule, rule_id: str,
+                            pkg: str, where: str) -> List[Finding]:
+  """Shared body of GL111/GL114: train-only surfaces referenced inside
+  one inference-side package (``pkg`` is the directory name)."""
   norm = mod.path.replace(os.sep, "/")
-  if "/serving/" not in norm and not norm.startswith("serving/"):
+  if f"/{pkg}/" not in norm and not norm.startswith(f"{pkg}/"):
     return []
   out = []
   for node in ast.walk(mod.tree):
@@ -551,8 +548,8 @@ def _check_serving_train_surfaces(mod: ParsedModule) -> List[Finding]:
         root = alias.name.split(".")[0]
         if root == "optax" or alias.name.endswith("resilience.guards"):
           out.append(mod.finding(
-              "GL111", node,
-              f"import of {alias.name!r} in a serving module: the "
+              rule_id, node,
+              f"import of {alias.name!r} in a {where} module: the "
               "inference path carries no optimizer state or commit "
               "gate — strip at export instead."))
     elif isinstance(node, ast.ImportFrom):
@@ -561,23 +558,23 @@ def _check_serving_train_surfaces(mod: ParsedModule) -> List[Finding]:
       if module.split(".")[0] == "optax" or module.endswith("guards") \
           or ("resilience" in module and "guards" in names):
         out.append(mod.finding(
-            "GL111", node,
-            f"import from {module or '.'!r} of {names} in a serving "
+            rule_id, node,
+            f"import from {module or '.'!r} of {names} in a {where} "
             "module: optax / resilience.guards are train-only surfaces "
             "— the serve step has nothing to optimize or gate."))
       bad = sorted(set(names) & _TRAIN_ONLY_NAMES)
       if bad:
         out.append(mod.finding(
-            "GL111", node,
-            f"train-only name(s) {bad} imported into a serving module: "
+            rule_id, node,
+            f"train-only name(s) {bad} imported into a {where} module: "
             "the step builders, scatter emitters, and guard helpers "
             "must stay unreachable from the inference path."))
     elif isinstance(node, (ast.Name, ast.Attribute)):
       name = node.id if isinstance(node, ast.Name) else node.attr
       if name in _TRAIN_ONLY_NAMES or name == "optax":
         out.append(mod.finding(
-            "GL111", node,
-            f"reference to train-only surface {name!r} in a serving "
+            rule_id, node,
+            f"reference to train-only surface {name!r} in a {where} "
             "module: serve buffers have no aux lanes to update and no "
             "commit to gate — route the need through export/eval "
             "instead."))
@@ -589,6 +586,32 @@ def _check_serving_train_surfaces(mod: ParsedModule) -> List[Finding]:
       seen.add(f.line)
       uniq.append(f)
   return uniq
+
+
+@_rule("GL111", "error",
+       "train-only surfaces are unreachable from serving/ modules")
+def _check_serving_train_surfaces(mod: ParsedModule) -> List[Finding]:
+  # The serving subsystem's whole point is an inference image with the
+  # optimizer lanes stripped and no write path: an optax import, a
+  # guard/commit-gate helper, or a scatter-add emitter reappearing
+  # there means training plumbing leaked back into the serve step (the
+  # jaxpr audit pins the traced program; this rule catches the leak at
+  # review time, before anything traces). faultinject/retry are NOT
+  # banned — the export path legitimately rides the durable-checkpoint
+  # machinery.
+  return _train_surface_findings(mod, "GL111", "serving", "serving")
+
+
+@_rule("GL114", "error",
+       "train-only surfaces are unreachable from fleet/ modules")
+def _check_fleet_train_surfaces(mod: ParsedModule) -> List[Finding]:
+  # The fleet tier is the serving engine spread over processes — the
+  # same inference-only contract at fleet scope: a router or owner that
+  # imports optax, a step builder, a scatter-add emitter, or a guard
+  # helper has train plumbing on the request path (GL111's invariant,
+  # one package over). faultinject/retry stay legal — the fleet rides
+  # the durable/retry machinery by design.
+  return _train_surface_findings(mod, "GL114", "fleet", "fleet")
 
 
 # The dynamic-vocabulary translation surface: every entry point that

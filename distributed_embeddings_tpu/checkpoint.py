@@ -130,7 +130,7 @@ def _fsync_dir(path: str) -> None:
     pass
 
 
-def verify(path: str) -> List[str]:
+def verify(path: str, only=None) -> List[str]:
   """Validate a checkpoint directory; returns a list of problems
   (empty == valid).
 
@@ -140,7 +140,12 @@ def verify(path: str) -> List[str]:
   Pre-resilience checkpoints (no table) fall back to an existence check
   of the file set derivable from the manifest. Used by ``restore`` (to
   fail with the bad file named) and by ``resilience.durable`` (to fall
-  back to the newest VALID checkpoint)."""
+  back to the newest VALID checkpoint).
+
+  ``only``: an optional collection of basenames — verify just those
+  checksum entries (each must exist in the table). The owner-sharded
+  serve load uses this so a process holding two ranks of a terabyte
+  artifact does not crc32-read every other owner's blocks."""
   mpath = os.path.join(path, "manifest.json")
   if not os.path.isfile(mpath):
     return [f"missing manifest: {mpath}"]
@@ -152,6 +157,11 @@ def verify(path: str) -> List[str]:
   problems = []
   checksums = manifest.get("checksums")
   if checksums is not None:
+    if only is not None:
+      missing = sorted(set(only) - set(checksums))
+      if missing:
+        return [f"file(s) {missing} not in the manifest checksum table"]
+      checksums = {f: checksums[f] for f in only}
     for fname, want in sorted(checksums.items()):
       fpath = os.path.join(path, fname)
       if not os.path.isfile(fpath):

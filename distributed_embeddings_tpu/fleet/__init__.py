@@ -1,0 +1,66 @@
+"""Fleet serving: rank-owner-sharded serve stores behind a routing tier.
+
+PR 8's serving engine loads the whole exported artifact into one
+process, so inference capacity caps at one host's memory and gather
+bandwidth. This package is the millions-of-users shape: ONE published
+artifact behind N serving processes.
+
+- :mod:`.plan` — :class:`FleetPlan`: which owner process holds which
+  mesh rank's blocks, with R-way replication of hot ranks (seeded from
+  the artifact's own observed counts) — replication is the lever past
+  one owner's gather bandwidth.
+- :mod:`.owner` — :class:`FleetOwner`: a partial serve store
+  (``export.load(owned_ranks=...)`` — the elastic cold-store owner
+  contract re-aimed at inference) answering per-rank physical-row
+  gathers; no model, no step, just bounded, bounds-checked gathers.
+- :mod:`.transport` — the RPC surface between router and owners:
+  in-process (tests/bench/chaos) and TCP socket backends, a shared
+  ``fleet_rpc`` fault site, and the error taxonomy the failover stack
+  keys on (transient ``OSError`` retries; :class:`RemoteRefusal`
+  propagates; :class:`OwnerUnavailableError` fails the request).
+- :mod:`.router` — :class:`FleetRouter`: the aggregation tier. The
+  single-process TIERED serve path with the host image replaced by the
+  fleet: classify by the plan's shared routing recipe, fan gathers out
+  to owners (balanced replica choice, counted failover), stage, and
+  run the same jitted combine + model forward — which is why fleet
+  answers are f32 BIT-exact against a single-process engine.
+- :mod:`.reshard` — serve-side artifact re-cut for a fleet resize (the
+  elastic window-wise path; no trainer checkpoint round-trip).
+- :mod:`.stream` — :class:`FleetDeltaFollower`: every fleet member
+  follows the publish directory independently (validated folds,
+  fsynced heartbeats — the PR 12 N-subscriber quorum shape), so the
+  fleet stays online-fresh.
+
+graftlint GL114 keeps this package honest the way GL111 keeps
+serving/: train-only surfaces (optax, guard helpers, step builders,
+scatter emitters) are unreachable from fleet modules.
+"""
+
+from .owner import FleetOwner
+from .plan import FleetPlan, rank_weights_from_artifact
+from .reshard import reshard
+from .router import FleetConfig, FleetRouter, FleetStore
+from .stream import FleetDeltaFollower
+from .transport import (
+    InProcTransport,
+    OwnerUnavailableError,
+    RemoteRefusal,
+    SocketOwnerServer,
+    SocketTransport,
+)
+
+__all__ = [
+    "FleetConfig",
+    "FleetDeltaFollower",
+    "FleetOwner",
+    "FleetPlan",
+    "FleetRouter",
+    "FleetStore",
+    "InProcTransport",
+    "OwnerUnavailableError",
+    "RemoteRefusal",
+    "SocketOwnerServer",
+    "SocketTransport",
+    "rank_weights_from_artifact",
+    "reshard",
+]

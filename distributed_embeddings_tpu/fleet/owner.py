@@ -1,0 +1,145 @@
+"""Fleet owner: a rank-owner-sharded serve store answering partial gathers.
+
+One :class:`FleetOwner` is one serving process's share of the fleet: it
+loads ONLY its ranks' blocks of the published artifact
+(``export.load(owned_ranks=...)`` — PR 6's elastic cold-store owner
+contract re-aimed at inference) and answers per-rank physical-row
+gathers over them. It holds no model, traces no step, and never
+combines: the routing tier owns routing and reassembly, so an owner is
+exactly a remote memory system priced by its gather bandwidth — the
+resource replication scales (PAPERS.md, the EmbeddingBag-inference
+dissection).
+
+The RPC surface (``rpc_*`` methods, reachable through either
+``fleet.transport`` backend):
+
+- ``handshake``: identity + geometry — the router refuses a fleet whose
+  members disagree on plan fingerprint, quantize mode, or class
+  geometry before the first gather.
+- ``gather``: serve-layout physical rows of one owned rank, disk/wire
+  form (fp8 rides as int8 bytes). Bounds violations and un-owned ranks
+  REFUSE naming the rank — never a silent clamp.
+- ``ranking``: the rank's export-time priority order (seeds the
+  router's hot-shard replica cache).
+- ``ping``: liveness + served watermark.
+
+Online freshness: :class:`~.stream.FleetDeltaFollower` binds an owner
+to a publish directory — validated deltas scatter into the owned
+blocks under :attr:`lock` (gathers see either the old rows or the new,
+never a torn row), and the owner heartbeats its applied position like
+any other subscriber.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict
+
+import numpy as np
+
+from ..checkpoint import _plan_fingerprint
+from ..layers.planner import DistEmbeddingStrategy
+from ..ops.packed_table import host_gather_rows
+from ..serving.export import load as serve_load
+from ..telemetry import get_registry as _registry
+
+
+class FleetOwner:
+  """One owner process: partial serve store + gather server."""
+
+  def __init__(self, path: str, plan: DistEmbeddingStrategy,
+               owned_ranks, owner_id: int = 0,
+               telemetry=None, verify_integrity: bool = True):
+    owned_ranks = tuple(sorted(set(int(r) for r in owned_ranks)))
+    if not owned_ranks:
+      raise ValueError(
+          "a FleetOwner must own at least one rank (a rank-less owner "
+          "answers nothing; shrink the fleet instead)")
+    self.owner_id = int(owner_id)
+    self.plan = plan
+    self.path = path
+    self.telemetry = telemetry if telemetry is not None else _registry()
+    self.artifact = serve_load(path, plan, owned_ranks=owned_ranks,
+                               verify_integrity=verify_integrity)
+    self.owned_ranks = owned_ranks
+    self.meta = self.artifact.meta
+    self.quantize = self.artifact.quantize
+    self.step = self.artifact.step
+    # delta application swaps row values under this lock; gathers take
+    # it too, so a gather sees one consistent block version
+    self.lock = threading.Lock()
+    self._counters = {
+        k: self.telemetry.counter(f"fleet/owner/{k}")
+        for k in ("gathers", "rows", "bytes")}
+
+  # ---- the RPC surface ----------------------------------------------------
+  def rpc_handshake(self) -> Dict[str, Any]:
+    return {
+        "owner_id": self.owner_id,
+        "owned_ranks": list(self.owned_ranks),
+        "quantize": self.quantize,
+        "step": int(self.step),
+        "plan": _plan_fingerprint(self.plan),
+        "classes": {n: m.to_json() for n, m in sorted(self.meta.items())},
+    }
+
+  def rpc_ping(self) -> Dict[str, Any]:
+    return {"ok": 1, "owner_id": self.owner_id, "step": int(self.step)}
+
+  def rpc_gather(self, name: str, rank: int,
+                 grps: np.ndarray) -> Dict[str, Any]:
+    """Serve-layout physical rows ``grps`` of one owned rank, in the
+    disk/wire byte form (``ServeClassMeta.to_disk``)."""
+    m = self.meta.get(name)
+    if m is None:
+      raise ValueError(f"unknown serve class {name!r}; this owner has "
+                       f"{sorted(self.meta)}")
+    rank = int(rank)
+    grps = np.asarray(grps, np.int64)
+    with self.lock:
+      block = self.artifact.rank_block(name, rank)  # refuses un-owned
+      rows = host_gather_rows(m.packed, block, grps)
+    self._counters["gathers"].inc()
+    self._counters["rows"].inc(int(grps.size))
+    self._counters["bytes"].inc(int(rows.nbytes))
+    return {"rows": m.to_disk(rows)}
+
+  def rpc_ranking(self, name: str, rank: int) -> Dict[str, Any]:
+    """Export-time priority order of one owned rank's serve physical
+    rows (host-tier classes ship theirs in the artifact; device-tier
+    classes default to row order — the store's own warm-start
+    default)."""
+    m = self.meta.get(name)
+    if m is None:
+      raise ValueError(f"unknown serve class {name!r}; this owner has "
+                       f"{sorted(self.meta)}")
+    rank = int(rank)
+    self.artifact.rank_block(name, rank)  # ownership check, named refusal
+    order = self.artifact.ranking[name][rank] if m.tier == "host" else None
+    if order is None:
+      order = np.arange(m.packed.phys_rows, dtype=np.int32)
+    return {"order": np.asarray(order, np.int32)}
+
+  # ---- delta application (FleetDeltaFollower's member surface) ------------
+  def apply_delta_rows(self, name: str, rank: int, idx: np.ndarray,
+                       data: np.ndarray) -> int:
+    """Scatter one delta's logical rows into an OWNED rank's block
+    (un-owned ranks are a no-op — the delta names every rank; each
+    owner folds its share). ``data`` is serve-layout rows-with-scale in
+    the image dtype. Returns rows applied."""
+    if self.artifact.owned_ranks is not None \
+        and rank not in self.artifact.owned_ranks:
+      return 0
+    m = self.meta[name]
+    lay = m.packed
+    rpp, lanes = lay.rows_per_phys, m.lanes
+    idx = np.asarray(idx, np.int64)
+    cols = ((idx % rpp)[:, None] * lanes
+            + np.arange(lanes, dtype=np.int64)[None, :])
+    with self.lock:
+      block = self.artifact.rank_block(name, rank)
+      block[(idx // rpp)[:, None], cols] = data
+    return int(idx.size)
+
+  def adopt_step(self, step: int) -> None:
+    self.step = int(step)

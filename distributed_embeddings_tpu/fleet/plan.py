@@ -1,0 +1,172 @@
+"""Fleet plan: which serving process owns — and replicates — which rank.
+
+A serve artifact shards by MESH rank (one ``serve_<class>_r<rank>.npy``
+block per rank, `serving/export`). A fleet maps those ranks onto N
+OWNER processes: each owner materializes only its ranks' blocks
+(``export.load(owned_ranks=...)``) and answers per-rank partial
+gathers; the routing tier fans a request's routed ids out by owner and
+reassembles.
+
+Replication is the scaling lever past one owner's gather bandwidth
+(PAPERS.md, the EmbeddingBag-inference dissection: DLRM inference is
+gather-bandwidth-bound): a POPULAR rank is assigned to R > 1 owners,
+the router spreads gathers across the replicas (balanced choice by
+outstanding load), and a dead replica fails over — counted, never a
+wrong answer. Popularity is seeded from the artifact's own observed
+counts (``serve_ranking.npz`` ships the per-serve-physical-row counts
+alongside the ranking) or from explicit operator weights.
+
+The plan is pure data (JSON round-trip): deployment tooling writes it
+once and every router/owner process reads the same assignment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetPlan:
+  """Rank -> owner assignment for one fleet.
+
+  Attributes:
+    world_size: mesh ranks of the serving plan (= the artifact's).
+    n_owners: owner processes in the fleet.
+    owners: per rank, the owner ids holding its blocks — at least one;
+      first entry is the PRIMARY (deterministic tie-break for routing).
+  """
+
+  world_size: int
+  n_owners: int
+  owners: Tuple[Tuple[int, ...], ...]
+
+  def __post_init__(self):
+    if self.world_size < 1 or self.n_owners < 1:
+      raise ValueError(
+          f"fleet needs world_size >= 1 and n_owners >= 1 "
+          f"(got {self.world_size}, {self.n_owners})")
+    if len(self.owners) != self.world_size:
+      raise ValueError(
+          f"owners names {len(self.owners)} ranks but world_size is "
+          f"{self.world_size}")
+    seen_owner = set()
+    for rank, reps in enumerate(self.owners):
+      if not reps:
+        raise ValueError(
+            f"rank {rank} has no owner: every rank's blocks must live "
+            "somewhere or its gathers have nowhere to go")
+      if len(set(reps)) != len(reps):
+        raise ValueError(f"rank {rank} lists owner(s) twice: {reps}")
+      for o in reps:
+        if o < 0 or o >= self.n_owners:
+          raise ValueError(
+              f"rank {rank} names owner {o} outside [0, {self.n_owners})")
+        seen_owner.add(o)
+    idle = sorted(set(range(self.n_owners)) - seen_owner)
+    if idle:
+      raise ValueError(
+          f"owner(s) {idle} own no rank: an idle serving process is a "
+          "misconfiguration — shrink n_owners or assign them replicas")
+
+  # ---- queries ------------------------------------------------------------
+  def owners_of(self, rank: int) -> Tuple[int, ...]:
+    if rank < 0 or rank >= self.world_size:
+      raise ValueError(f"rank {rank} outside [0, {self.world_size})")
+    return self.owners[rank]
+
+  def owned_ranks(self, owner_id: int) -> Tuple[int, ...]:
+    """Every rank ``owner_id`` holds (primary or replica) — exactly the
+    ``owned_ranks=`` its process passes to ``export.load``."""
+    if owner_id < 0 or owner_id >= self.n_owners:
+      raise ValueError(f"owner {owner_id} outside [0, {self.n_owners})")
+    return tuple(r for r in range(self.world_size)
+                 if owner_id in self.owners[r])
+
+  def replicated_ranks(self) -> Tuple[int, ...]:
+    return tuple(r for r in range(self.world_size)
+                 if len(self.owners[r]) > 1)
+
+  # ---- construction -------------------------------------------------------
+  @classmethod
+  def balanced(cls, world_size: int, n_owners: int) -> "FleetPlan":
+    """Round-robin single-owner assignment (no replication)."""
+    return cls(world_size, n_owners,
+               tuple((r % n_owners,) for r in range(world_size)))
+
+  @classmethod
+  def replicated(cls, world_size: int, n_owners: int,
+                 rank_weights: Optional[Sequence[float]] = None,
+                 replicas: int = 2,
+                 hot_fraction: float = 0.25) -> "FleetPlan":
+    """Round-robin base assignment plus R-way replication of the hot
+    ranks.
+
+    ``rank_weights`` (default uniform) ranks popularity — typically the
+    artifact's observed counts summed per rank
+    (:func:`rank_weights_from_artifact`). The hottest
+    ``ceil(world_size * hot_fraction)`` ranks get ``replicas`` owners;
+    replica owners are chosen least-loaded-first (by accumulated
+    weight), so replication also levels the fleet."""
+    if replicas < 1:
+      raise ValueError(f"replicas must be >= 1, got {replicas}")
+    if not 0.0 <= hot_fraction <= 1.0:
+      raise ValueError(f"hot_fraction must be in [0, 1], got {hot_fraction}")
+    replicas = min(replicas, n_owners)
+    w = np.ones(world_size) if rank_weights is None \
+        else np.asarray(rank_weights, np.float64)
+    if w.shape != (world_size,):
+      raise ValueError(
+          f"rank_weights shape {w.shape} != ({world_size},)")
+    owners = [[r % n_owners] for r in range(world_size)]
+    load = np.zeros(n_owners)
+    for r in range(world_size):
+      load[owners[r][0]] += w[r]
+    n_hot = int(np.ceil(world_size * hot_fraction)) if replicas > 1 else 0
+    # hottest first, ties lowest rank (stable argsort over -w)
+    for r in np.argsort(-w, kind="stable")[:n_hot]:
+      r = int(r)
+      while len(owners[r]) < replicas:
+        # least-loaded owner not already holding this rank
+        order = np.argsort(load, kind="stable")
+        pick = next(int(o) for o in order if int(o) not in owners[r])
+        owners[r].append(pick)
+        load[pick] += w[r]
+    return cls(world_size, n_owners, tuple(tuple(o) for o in owners))
+
+  # ---- persistence --------------------------------------------------------
+  def to_json(self) -> Dict[str, Any]:
+    return {"world_size": self.world_size, "n_owners": self.n_owners,
+            "owners": [list(o) for o in self.owners]}
+
+  @classmethod
+  def from_json(cls, d: Dict[str, Any]) -> "FleetPlan":
+    return cls(int(d["world_size"]), int(d["n_owners"]),
+               tuple(tuple(int(o) for o in reps) for reps in d["owners"]))
+
+
+def rank_weights_from_artifact(path: str, world_size: int) -> np.ndarray:
+  """Per-rank popularity weights from a serve artifact's observed
+  counts (the ``counts/<class>/r<rank>`` arrays riding
+  ``serve_ranking.npz``). Artifacts exported before the counts rode
+  along — or with no host-tier classes — fall back to uniform weights
+  (every rank weight 1.0); replication then levels by rank count
+  alone."""
+  import os
+  w = np.zeros(world_size, np.float64)
+  fpath = os.path.join(path, "serve_ranking.npz")
+  have = False
+  if os.path.isfile(fpath):
+    with np.load(fpath) as z:
+      for key in z.files:
+        if not key.startswith("counts/"):
+          continue
+        rank = int(key.rsplit("/r", 1)[1])
+        if 0 <= rank < world_size:
+          w[rank] += float(np.asarray(z[key], np.int64).sum())
+          have = True
+  if not have or not w.sum():
+    return np.ones(world_size, np.float64)
+  return w

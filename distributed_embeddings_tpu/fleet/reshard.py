@@ -1,0 +1,323 @@
+"""Serve-side artifact re-shard: re-cut a published artifact for a new
+world shape without round-tripping through a trainer checkpoint.
+
+A fleet resize changes how many rank blocks the serving side wants
+(more owners want more, smaller blocks; a shrink wants fewer). The
+trainer-side answer — restore the checkpoint under the new plan and
+re-export — drags the training cluster into a serving operation.
+:func:`reshard` is the serve-side path: the elastic restore's
+window-wise discipline (`checkpoint._restore_elastic`) applied to the
+INFERENCE image — per target rank block, each slot's logical table
+row/column windows are pulled from the source rank files via
+memory-mapped physical-row slices, unpacked (a pure reshape), and
+re-packed into the new plan's serve layout. Peak host memory is one
+target rank block plus one source window.
+
+Rows move as RAW BYTES in the artifact's disk form:
+
+- **f32** rows re-cut at element granularity (row AND column windows
+  may both change) — every logical element lands bit-identical;
+- **int8/fp8** rows carry their bit-packed per-row scale, which was
+  computed over the row's class-width span — the rows move WHOLESALE
+  (quantized lanes + scale lanes together, byte-identical), which
+  requires the two plans to agree on each table's column windows. A
+  column-slicing change under a quantized artifact is refused naming
+  the table: re-quantizing rows serve-side would change served values
+  silently, and that is the exporter's decision to make.
+
+Host-tier observed counts re-map window-wise exactly like the rows
+(each logical row carries its group's count; overlapping column slices
+max-merge — the checkpoint's ``_remap_tier_counts`` policy), so the
+re-cut artifact's ranking is the source run's, not a cold default.
+
+MXU-dense (``kind='dense'``) classes are refused for now: their
+one-hot window layout re-shards through the checkpoint's regroup path
+— re-export from the checkpoint for plans that place tables on the
+MXU. (Sparse-kind classes are the fleet's whole reason to exist.)
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ..checkpoint import (
+    _crc32_file,
+    _fsync_path,
+    _plan_fingerprint,
+    publish_manifest_last,
+    read_manifest,
+)
+from ..checkpoint import verify as verify_dir
+from ..layers.planner import DistEmbeddingStrategy
+from ..ops.packed_table import PackedLayout
+from ..parallel.lookup_engine import class_param_name, padded_rows
+from ..resilience import faultinject
+from ..serving.export import (
+    SERVE_FORMAT_VERSION,
+    ServeClassMeta,
+    _serve_ranking,
+)
+
+
+def _sparse_names(plan: DistEmbeddingStrategy) -> Dict[str, tuple]:
+  out = {}
+  for key in plan.class_keys:
+    cp = plan.classes[key]
+    if cp.kind == "dense":
+      raise NotImplementedError(
+          "fleet.reshard handles sparse-kind classes only: MXU-dense "
+          f"class {class_param_name(*key)!r} re-shards through the "
+          "checkpoint regroup path — re-export from the checkpoint "
+          "under the new plan instead.")
+    if cp.kind == "sparse":
+      out[class_param_name(*key)] = key
+  return out
+
+
+def _src_windows(plan: DistEmbeddingStrategy, key) -> Dict[int, set]:
+  """table id -> {(rank, row_offset, row_start, nrows, c0, c1)} for one
+  class (shared tables list a shard once per feeding slot — dedup)."""
+  out: Dict[int, set] = {}
+  for rank, slots in enumerate(plan.classes[key].slots_per_rank):
+    for s in slots:
+      sh = s.shard
+      out.setdefault(sh.table_id, set()).add(
+          (rank, s.row_offset, sh.row_start, sh.input_dim,
+           sh.col_start, sh.col_end))
+  return out
+
+
+def reshard(src_path: str, src_plan: DistEmbeddingStrategy,
+            dst_path: str, dst_plan: DistEmbeddingStrategy,
+            verify_integrity: bool = True) -> Dict[str, Any]:
+  """Re-cut the serve artifact at ``src_path`` (exported under
+  ``src_plan``) into ``dst_path`` under ``dst_plan``. Returns the new
+  manifest. Written through the crc32-manifest-last durable protocol —
+  a crash leaves a manifest-less ``.tmp``, never a half artifact."""
+  if verify_integrity:
+    problems = verify_dir(src_path)
+    if problems:
+      raise ValueError(
+          f"source artifact {src_path!r} failed integrity verification: "
+          + "; ".join(problems))
+  manifest = read_manifest(src_path)
+  if manifest.get("kind") != "serve":
+    raise ValueError(f"{src_path!r} is not a serve artifact "
+                     f"(kind={manifest.get('kind')!r})")
+  if manifest["format_version"] != SERVE_FORMAT_VERSION:
+    raise ValueError(
+        f"serve artifact format {manifest['format_version']} unsupported")
+  if manifest["plan"] != _plan_fingerprint(src_plan):
+    raise ValueError(
+        "src_plan does not match the artifact's plan fingerprint: pass "
+        "the plan the artifact was EXPORTED under (the window map is "
+        "derived from its slot layout)")
+  quantize = manifest["serve"]["quantize"]
+  src_meta = {n: ServeClassMeta.from_json(n, d)
+              for n, d in manifest["serve"]["classes"].items()}
+
+  src_names = _sparse_names(src_plan)
+  dst_names = _sparse_names(dst_plan)
+  if set(src_names) != set(dst_names):
+    raise ValueError(
+        f"plans disagree on sparse class names (src {sorted(src_names)} "
+        f"vs dst {sorted(dst_names)}): a re-shard moves rows between "
+        "rank blocks of the SAME classes — table widths/combiners must "
+        "match")
+
+  # dst geometry: source tier + quantize, new per-rank rows
+  dst_meta: Dict[str, ServeClassMeta] = {}
+  for name, key in dst_names.items():
+    sm = src_meta[name]
+    dst_meta[name] = ServeClassMeta(
+        name=name, rows=padded_rows(dst_plan, key),
+        width=dst_plan.classes[key].width, tier=sm.tier,
+        quantize=quantize, combine_rpp=sm.combine_rpp)
+
+  # quantized rows move wholesale: column windows must agree per table
+  if quantize != "f32":
+    for name, key in dst_names.items():
+      src_w = _src_windows(src_plan, src_names[name])
+      dst_w = _src_windows(dst_plan, key)
+      for t in dst_w:
+        src_cols = {(c0, c1) for (_, _, _, _, c0, c1) in src_w.get(t, ())}
+        dst_cols = {(c0, c1) for (_, _, _, _, c0, c1) in dst_w[t]}
+        if src_cols != dst_cols:
+          raise ValueError(
+              f"table {t} changes column windows across the re-shard "
+              f"({sorted(src_cols)} -> {sorted(dst_cols)}) under "
+              f"quantize={quantize!r}: the bit-packed per-row scales "
+              "were computed over the source column span, so the rows "
+              "cannot be re-cut without re-quantizing — re-export from "
+              "the checkpoint for a column-slicing change.")
+
+  # ---- load the ranking counts (host-tier re-map signal) -------------------
+  rank_npz: Dict[str, np.ndarray] = {}
+  rpath = os.path.join(src_path, "serve_ranking.npz")
+  if os.path.isfile(rpath):
+    with np.load(rpath) as z:
+      rank_npz = dict(z)
+
+  # ---- window-wise block assembly -----------------------------------------
+  def src_file(name: str, rank: int) -> str:
+    prefix = "serve_cold" if src_meta[name].tier == "host" else "serve"
+    return os.path.join(src_path, f"{prefix}_{name}_r{rank}.npy")
+
+  def read_window(name: str, rank: int, lo: int, hi: int) -> np.ndarray:
+    """Logical rows ``[lo, hi)`` of one source rank block, disk dtype,
+    ``[hi - lo, lanes]`` — memory-mapped physical slices only."""
+    sm = src_meta[name]
+    lay = sm.packed
+    faultinject.fire("reshard_gather", file=src_file(name, rank),
+                     rows=hi - lo)
+    blk = np.load(src_file(name, rank), mmap_mode="r")
+    if blk.shape != (lay.phys_rows, lay.phys_width):
+      raise ValueError(
+          f"{src_file(name, rank)} has shape {blk.shape}, expected "
+          f"{(lay.phys_rows, lay.phys_width)} — manifest and files "
+          "disagree")
+    rpp = lay.rows_per_phys
+    p0, p1 = lo // rpp, -(-hi // rpp)
+    sub = np.asarray(blk[p0:p1])
+    sublay = PackedLayout(rows=(p1 - p0) * rpp, width=sm.lanes, n_aux=0)
+    tbl, _aux = sublay.unpack(sub)
+    skip = lo - p0 * rpp
+    return np.asarray(tbl)[skip:skip + (hi - lo)]
+
+  def dst_rank_block(name: str, rank: int) -> np.ndarray:
+    """One target rank's packed serve block, assembled window-wise."""
+    dm = dst_meta[name]
+    src_w = _src_windows(src_plan, src_names[name])
+    rows = np.zeros((dm.rows, dm.lanes), dm.np_dtype)
+    sm = src_meta[name]
+    for s in dst_plan.classes[dst_names[name]].slots_per_rank[rank]:
+      sh = s.shard
+      for (r_s, off_s, rs0_s, n_s, c0_s, c1_s) \
+          in sorted(src_w.get(sh.table_id, ())):
+        r0 = max(sh.row_start, rs0_s)
+        r1 = min(sh.row_start + sh.input_dim, rs0_s + n_s)
+        ca = max(sh.col_start, c0_s)
+        cb = min(sh.col_end, c1_s)
+        if r0 >= r1 or ca >= cb:
+          continue
+        win = read_window(name, r_s, off_s + (r0 - rs0_s),
+                          off_s + (r1 - rs0_s))
+        tgt = rows[s.row_offset + (r0 - sh.row_start):
+                   s.row_offset + (r1 - sh.row_start)]
+        if quantize == "f32":
+          tgt[:, ca - sh.col_start:cb - sh.col_start] = \
+              win[:, ca - c0_s:cb - c0_s]
+        else:
+          # equal column windows (validated above): the whole row —
+          # quantized lanes AND the trailing scale lanes — moves intact
+          tgt[:, :sm.lanes] = win
+    return np.asarray(dm.packed.pack(rows), dm.np_dtype)
+
+  # ---- counts re-map (host-tier ranking) ----------------------------------
+  def dst_counts(name: str) -> List[np.ndarray]:
+    """Source serve-physical-row counts -> per-dst-rank counts, routed
+    like the rows (logical rows inherit their group's count; column
+    overlaps max-merge)."""
+    key_s, key_d = src_names[name], dst_names[name]
+    sm, dm = src_meta[name], dst_meta[name]
+    table_counts: Dict[int, np.ndarray] = {}
+    rpp_s = sm.packed.rows_per_phys
+    for t, wins in _src_windows(src_plan, key_s).items():
+      for (r_s, off_s, rs0_s, n_s, _c0, _c1) in sorted(wins):
+        cnt = rank_npz.get(f"counts/{name}/r{r_s}")
+        if cnt is None:
+          continue
+        cnt = np.asarray(cnt, np.int64)
+        tc = table_counts.get(t)
+        if tc is None:
+          vocab = rs0_s + n_s
+          for (_r2, _o2, rs2, n2, _c2, _c3) in wins:
+            vocab = max(vocab, rs2 + n2)
+          tc = table_counts[t] = np.zeros((vocab,), np.int64)
+        vals = cnt[(off_s + np.arange(n_s)) // rpp_s]
+        np.maximum(tc[rs0_s:rs0_s + n_s], vals,
+                   out=tc[rs0_s:rs0_s + n_s])
+    rpp_d = dm.packed.rows_per_phys
+    out = []
+    for rank in range(dst_plan.world_size):
+      arr = np.zeros((dm.rows,), np.int64)
+      for s in dst_plan.classes[key_d].slots_per_rank[rank]:
+        sh = s.shard
+        tc = table_counts.get(sh.table_id)
+        if tc is None:
+          continue
+        np.maximum(arr[s.row_offset:s.row_offset + sh.input_dim],
+                   tc[sh.row_start:sh.row_start + sh.input_dim],
+                   out=arr[s.row_offset:s.row_offset + sh.input_dim])
+      pad = dm.packed.phys_rows * rpp_d - dm.rows
+      if pad:
+        arr = np.concatenate([arr, np.zeros((pad,), np.int64)])
+      out.append(arr.reshape(dm.packed.phys_rows, rpp_d).sum(axis=1))
+    return out
+
+  # ---- durable write ------------------------------------------------------
+  tmp = dst_path + ".tmp"
+  if os.path.exists(tmp):
+    shutil.rmtree(tmp)
+  os.makedirs(tmp)
+  checksums: Dict[str, Dict[str, int]] = {}
+
+  def _seal(fpath: str) -> None:
+    _fsync_path(fpath)
+    faultinject.fire("ckpt_write", path=fpath)
+    checksums[os.path.basename(fpath)] = _crc32_file(fpath)
+
+  ranking_arrays: Dict[str, np.ndarray] = {}
+  for name in sorted(dst_meta):
+    dm = dst_meta[name]
+    prefix = "serve_cold" if dm.tier == "host" else "serve"
+    for rank in range(dst_plan.world_size):
+      fpath = os.path.join(tmp, f"{prefix}_{name}_r{rank}.npy")
+      np.save(fpath, dst_rank_block(name, rank))
+      _seal(fpath)
+    if dm.tier == "host":
+      cnts = dst_counts(name)
+      for rank, cnt in enumerate(cnts):
+        ranking_arrays[f"{name}/r{rank}"] = _serve_ranking(cnt)
+        ranking_arrays[f"counts/{name}/r{rank}"] = cnt
+  if ranking_arrays:
+    fpath = os.path.join(tmp, "serve_ranking.npz")
+    np.savez(fpath, **ranking_arrays)
+    _seal(fpath)
+
+  # world-shape-free parts copy verbatim (byte-identical; model params
+  # and the vocab snapshot know nothing about rank blocks)
+  for fn in ("dense.npz", "emb_dense.npz", "vocab_snapshot.npz"):
+    src_f = os.path.join(src_path, fn)
+    if os.path.isfile(src_f):
+      dst_f = os.path.join(tmp, fn)
+      shutil.copyfile(src_f, dst_f)
+      _seal(dst_f)
+
+  new_manifest: Dict[str, Any] = {
+      "format_version": SERVE_FORMAT_VERSION,
+      "kind": "serve",
+      "step": manifest["step"],
+      "rule": manifest["rule"],
+      "plan": _plan_fingerprint(dst_plan),
+      "serve": {
+          "quantize": quantize,
+          "classes": {n: m.to_json() for n, m in sorted(dst_meta.items())},
+      },
+      "checksums": checksums,
+      "extra": {
+          "resharded": {
+              "from_plan": manifest["plan"],
+              "src_world": src_plan.world_size,
+              "dst_world": dst_plan.world_size,
+          }
+      },
+  }
+  if manifest.get("vocab_snapshot") is not None:
+    new_manifest["vocab_snapshot"] = manifest["vocab_snapshot"]
+  publish_manifest_last(tmp, dst_path, new_manifest)
+  return new_manifest

@@ -1,0 +1,614 @@
+"""The fleet routing/aggregation tier: one engine over N owner stores.
+
+The single-process :class:`~..serving.engine.ServeEngine` already knows
+how to serve rows that do not live on the device: the tiered path
+classifies each dispatch's routed ids host-side (the plan's shared
+``routing_recipe``), stages the missing rows into the step's compact
+staging buffer, and the traced step rewrites logical ids to compact
+slots (`translate_tiered_ids`) — f32 bit-exact against the all-device
+step by construction. The fleet router IS that path with the host
+image replaced by the network: every sparse class is "cold", its
+authoritative rows live on rank-owner processes
+(:class:`~.owner.FleetOwner`), and the per-dispatch stage gathers them
+through a transport with replica choice and counted failover. The
+combine and model forward run in the router's own jitted step — the
+same traced program as tiered serving — which is what makes fleet
+answers BIT-exact (f32) against a single-process engine on identical
+requests: the owners only moved the memory, never the arithmetic.
+
+Hot-shard handling has two independent levers:
+
+- **replication** (:class:`~.plan.FleetPlan`): a popular rank's blocks
+  live on R > 1 owners; the router spreads gathers by outstanding
+  in-flight load (balanced choice) and fails over — counted — when a
+  replica dies. A rank whose every replica is dead FAILS the request
+  (:class:`~.transport.OwnerUnavailableError`): explicit errors at the
+  edge, never a wrong answer.
+- **router-local caching** (``FleetConfig.cache_fraction``): the
+  hottest serve physical rows (export-time observed ranking) are
+  replicated INTO the router's device cache at startup, so the steady
+  -state remote traffic is the cold tail.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..checkpoint import _plan_fingerprint
+from ..layers.planner import DistEmbeddingStrategy
+from ..resilience import faultinject, retry
+from ..serving.engine import ServeEngine, ServeTierConfig, ServeTierPlan
+from ..serving.export import ServeClassMeta, np_dtype_of
+from ..serving.export import load as serve_load
+from ..telemetry import get_registry as _registry, span as _span
+from ..tiering.prefetch import TieredPrefetcher
+from ..training import shard_batch
+from .plan import FleetPlan
+from .transport import OwnerUnavailableError, RemoteRefusal
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+  """Router-side knobs (deployment decisions, not artifact state).
+
+  Attributes:
+    cache_fraction: fraction of each class's serve physical rows
+      replicated into the router's device cache (the local hot-shard
+      replica, seeded from the owners' export-time ranking).
+    staging_grps: persistent staging physical rows per class per rank
+      (size near the expected per-dispatch deduped remote-row count).
+    spill_factor_max: staging growth bound (power-of-two buckets; a
+      spill dispatch retraces once per bucket — the tiered contract).
+    shard_min_phys_rows: classes whose per-rank serve block is smaller
+      than this many physical rows are REPLICATED whole into the
+      router's device state (fetched from the owners once at startup)
+      instead of sharded: a table a single batch can cover gains
+      nothing from remote gathers, and the compact-slot arithmetic
+      needs headroom (cache + staging under the class's physical
+      capacity). Real fleets shard the big tables and replicate the
+      small — this is that policy, mechanized.
+    revive_after_s: how long a dead owner stays out of the replica
+      rotation before the router probes it again.
+    fanout_threads: concurrent owner gathers per dispatch (the fan-out
+      width of the stage's remote reads).
+  """
+
+  cache_fraction: float = 0.05
+  staging_grps: int = 1024
+  spill_factor_max: int = 16
+  shard_min_phys_rows: int = 256
+  revive_after_s: float = 5.0
+  fanout_threads: int = 8
+
+
+class FleetStore:
+  """Duck-type of ``tiering.HostTierStore`` whose images are remote.
+
+  The :class:`~..tiering.prefetch.TieredPrefetcher` binds to this
+  exactly as it binds to a host store: ``check_rows`` bounds-checks
+  batch-derived indices, ``counts``/``resident_map``/``resident_grps``
+  are router-local residency state, and :meth:`gather` is the one
+  difference — rows come from the rank's owners over the transport,
+  with balanced replica choice, bounded retry (``fleet_rpc`` fault
+  site), and counted failover. ``scatter`` refuses: the fleet serve
+  path is read-only by construction.
+  """
+
+  def __init__(self, tplan: Optional[ServeTierPlan], fplan: FleetPlan,
+               transport, plan: DistEmbeddingStrategy,
+               meta: Dict[str, ServeClassMeta], quantize: str,
+               config: FleetConfig = FleetConfig(),
+               retry_policy: retry.RetryPolicy = retry.DEFAULT_POLICY,
+               telemetry=None):
+    if fplan.world_size != plan.world_size:
+      raise ValueError(
+          f"fleet plan world_size {fplan.world_size} != serving plan "
+          f"world_size {plan.world_size}")
+    self.tplan = tplan  # None: every class replicated, nothing sharded
+    self.plan = plan
+    self.fplan = fplan
+    self.transport = transport
+    self.meta = meta
+    self.config = config
+    self.retry_policy = retry_policy
+    self.dtype = np_dtype_of(quantize)
+    self.telemetry = telemetry if telemetry is not None else _registry()
+    world = self.plan.world_size
+    self.owned_ranks = tuple(range(world))  # router addresses every rank
+    self.resident_map: Dict[str, List[np.ndarray]] = {}
+    self.resident_grps: Dict[str, List[np.ndarray]] = {}
+    self.counts: Dict[str, List[np.ndarray]] = {}
+    for c in (tplan.classes.values() if tplan is not None else ()):
+      lay = c.layout_logical
+      self.resident_map[c.name] = [
+          np.full((lay.phys_rows,), -1, np.int32) for _ in range(world)]
+      self.resident_grps[c.name] = [
+          np.zeros((c.spec.cache_grps,), np.int32) for _ in range(world)]
+      self.counts[c.name] = [
+          np.zeros((lay.phys_rows,), np.int64) for _ in range(world)]
+    self._lock = threading.Lock()
+    self._inflight: Dict[int, int] = {o: 0 for o in range(fplan.n_owners)}
+    self._dead: Dict[int, float] = {}  # owner -> monotonic death stamp
+    self._prefetched: Dict[tuple, tuple] = {}
+    self._pool = None
+    self._counters = {k: self.telemetry.counter(f"fleet/{k}")
+                      for k in ("rpcs", "rpc_bytes", "rpc_retries",
+                                "failovers", "dead_rank_errors")}
+    self._dead_gauge = self.telemetry.gauge("fleet/owners_dead")
+
+  @property
+  def owns_all(self) -> bool:
+    return True
+
+  # ---- HostTierStore surface the prefetcher consumes ----------------------
+  def check_rows(self, name: str, rank: int, grps: np.ndarray) -> np.ndarray:
+    """Bounds-validate batch-derived physical-row indices (the host
+    store's discipline, verbatim — a routing bug must fail named, not
+    travel to an owner as a bad gather)."""
+    grps = np.asarray(grps)
+    if not grps.size:
+      return grps
+    lay = self.meta[name].packed
+    lo, hi = int(grps.min()), int(grps.max())
+    if lo < 0 or hi >= lay.phys_rows:
+      bad = int(grps[(grps < 0) | (grps >= lay.phys_rows)][0])
+      raise IndexError(
+          f"class {name!r} rank {rank}: physical-row index {bad} is "
+          f"outside this rank's serve image [0, {lay.phys_rows}). The "
+          "ids came from the batch's routing arithmetic — this is a "
+          "routing/classify bug or a corrupt id stream, not a fleet "
+          "problem.")
+    return grps
+
+  def _put(self, arr: np.ndarray, mesh, axis_name: str):
+    import jax
+    import jax.numpy as jnp
+    if mesh is None:
+      return jnp.asarray(arr)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    spec = P(axis_name) if arr.ndim == 1 else P(axis_name, None)
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+  def warm_start(self, ranking: Optional[Dict[str, List[np.ndarray]]] = None
+                 ) -> None:
+    """Choose the router's resident (locally replicated) hot set —
+    ``HostTierStore.warm_start``'s policy on the fleet's residency
+    arrays."""
+    for name, maps in self.resident_map.items():
+      cache = self.tplan.by_name(name).spec.cache_grps
+      for rank in range(self.plan.world_size):
+        if ranking is not None and name in ranking:
+          grps = np.asarray(ranking[name][rank][:cache], np.int32)
+          if grps.shape[0] < cache:
+            rest = np.setdiff1d(
+                np.arange(maps[rank].shape[0], dtype=np.int32), grps,
+                assume_unique=False)[:cache - grps.shape[0]]
+            grps = np.concatenate([grps, rest])
+        else:
+          grps = np.arange(cache, dtype=np.int32)
+        maps[rank][:] = -1
+        maps[rank][grps] = np.arange(cache, dtype=np.int32)
+        self.resident_grps[name][rank] = grps.copy()
+
+  def resident_arrays(self, mesh=None, axis_name: str = "mp"):
+    out = {}
+    for c in (self.tplan.classes.values() if self.tplan else ()):
+      out[c.name] = self._put(
+          np.concatenate(self.resident_map[c.name]), mesh, axis_name)
+    return out
+
+  def fetch_block(self, name: str, rank: int) -> np.ndarray:
+    """One rank's WHOLE serve block from its owners (the replicated
+    -class startup fill; small by the shard threshold's definition)."""
+    lay = self.meta[name].packed
+    return self._fetch_meta(name, rank,
+                            np.arange(lay.phys_rows, dtype=np.int64))
+
+  def build_fused(self, mesh=None, axis_name: str = "mp"):
+    """Compact device buffers: the resident hot rows FETCHED FROM THE
+    OWNERS (this is the hot-shard replica fill — one bulk gather per
+    class/rank at startup), staging region zeroed."""
+    out = {}
+    for c in (self.tplan.classes.values() if self.tplan else ()):
+      blocks = []
+      for rank in range(self.plan.world_size):
+        cache_rows = self.gather(c.name, rank,
+                                 self.resident_grps[c.name][rank])
+        blocks.append(np.concatenate([
+            cache_rows,
+            np.zeros((c.spec.staging_grps, c.layout_logical.phys_width),
+                     self.dtype)]))
+      out[c.name] = self._put(np.concatenate(blocks), mesh, axis_name)
+    return out
+
+  def scatter(self, name: str, rank: int, grps, rows) -> None:
+    raise RuntimeError(
+        "FleetStore is read-only: the fleet serve path never writes "
+        "back (serve images are immutable; freshness arrives through "
+        "the delta stream on each owner). A scatter here means train "
+        "plumbing leaked into the router.")
+
+  # ---- remote gathers ------------------------------------------------------
+  def _now(self) -> float:
+    import time
+    return time.monotonic()  # graftlint: disable=GL113 (revival deadline, not timing)
+
+  def _maybe_probe(self, owners) -> None:
+    """Organic revival: a dead owner due a re-probe gets one cheap
+    ``ping`` (single attempt, no retry) BEFORE replica selection — a
+    recovered owner rejoins the rotation even while its replicas keep
+    serving (failover alone would never call it again). The death stamp
+    is refreshed first, so concurrent gathers probe at most once per
+    ``revive_after_s`` interval."""
+    now = self._now()
+    due = []
+    with self._lock:
+      for o in owners:
+        died = self._dead.get(o)
+        if died is not None and now - died >= self.config.revive_after_s:
+          self._dead[o] = now
+          due.append(o)
+    for o in due:
+      try:
+        self.transport.call(o, "ping")
+      except (OSError, RemoteRefusal):
+        continue  # still dead (or confused); stays out of the rotation
+      self._mark_alive(o)
+
+  def _replica_order(self, owners) -> List[int]:
+    """Balanced choice: live replicas by least outstanding in-flight
+    load (ties break primary-first — the plan's deterministic order),
+    then dead replicas — so a fully-dead rank still tries everyone
+    before failing the request."""
+    with self._lock:
+      live, dead = [], []
+      for i, o in enumerate(owners):
+        died = self._dead.get(o)
+        if died is None:
+          live.append((self._inflight.get(o, 0), i, o))
+        else:
+          dead.append((died, o))
+    return ([o for _, _, o in sorted(live)]
+            + [o for _, o in sorted(dead)])
+
+  def _mark_dead(self, owner: int) -> None:
+    with self._lock:
+      if owner not in self._dead:
+        self._dead[owner] = self._now()
+      self._dead_gauge.set(len(self._dead))
+
+  def _mark_alive(self, owner: int) -> None:
+    with self._lock:
+      self._dead.pop(owner, None)
+      self._dead_gauge.set(len(self._dead))
+
+  def _call(self, owner: int, method: str, **kwargs) -> Dict[str, Any]:
+    """One owner RPC, retried per the policy (transient ``OSError``
+    only — a :class:`~.transport.RemoteRefusal` propagates: a replica
+    would refuse the same request identically)."""
+    def attempt():
+      faultinject.fire("fleet_rpc", owner=owner, method=method)
+      return self.transport.call(owner, method, **kwargs)
+
+    def count_retry(attempt_i, exc):
+      self._counters["rpc_retries"].inc()
+
+    with self._lock:
+      self._inflight[owner] = self._inflight.get(owner, 0) + 1
+    try:
+      out = retry.retry_call(attempt, policy=self.retry_policy,
+                             on_retry=count_retry)
+    finally:
+      with self._lock:
+        self._inflight[owner] -= 1
+    self._counters["rpcs"].inc()
+    return out
+
+  def _failover_call(self, for_rank: int, method: str, **kwargs
+                     ) -> Dict[str, Any]:
+    """Try the rank's replicas in balanced order (probing any dead one
+    due a revival check first); count each move to the next replica;
+    raise :class:`OwnerUnavailableError` when every one is dead."""
+    owners = self.fplan.owners_of(for_rank)
+    self._maybe_probe(owners)
+    last: Optional[BaseException] = None
+    for k, owner in enumerate(self._replica_order(owners)):
+      try:
+        out = self._call(owner, method, **kwargs)
+      except OSError as e:
+        self._mark_dead(owner)
+        last = e
+        # a move PAST a failed replica is a failover (counted once per
+        # replica abandoned, not per retry attempt)
+        self._counters["failovers"].inc()
+        continue
+      self._mark_alive(owner)
+      return out
+    self._counters["dead_rank_errors"].inc()
+    raise OwnerUnavailableError(
+        f"rank {for_rank}: every replica {list(owners)} is unreachable "
+        f"(last error: {last!r}). The request fails explicitly — the "
+        "router never substitutes rows it cannot fetch.")
+
+  def _fetch_meta(self, name: str, rank: int,
+                  grps: np.ndarray) -> np.ndarray:
+    m = self.meta[name]
+    lay = m.packed
+    grps = np.asarray(grps, np.int64)
+    if not grps.size:
+      return np.zeros((0, lay.phys_width), self.dtype)
+    out = self._failover_call(rank, "gather", name=name, rank=rank,
+                              grps=grps)
+    rows = m.from_disk(np.asarray(out["rows"]))
+    if rows.shape != (grps.size, lay.phys_width):
+      raise ValueError(
+          f"class {name!r} rank {rank}: owner returned rows shaped "
+          f"{rows.shape}, expected {(grps.size, lay.phys_width)} — "
+          "owner and router disagree on serve geometry")
+    self._counters["rpc_bytes"].inc(int(rows.nbytes))
+    return rows
+
+  def _fetch(self, name: str, rank: int, grps: np.ndarray) -> np.ndarray:
+    return self._fetch_meta(name, rank, grps)
+
+  def fetch_ranking(self, name: str, rank: int) -> np.ndarray:
+    out = self._failover_call(rank, "ranking", name=name, rank=rank)
+    return np.asarray(out["order"], np.int32)
+
+  def prefetch(self, cold: Dict[str, List[np.ndarray]]) -> None:
+    """Fan the per-(class, rank) remote gathers out concurrently; the
+    prefetcher's sequential ``stage`` then consumes the buffered rows.
+    Fetch errors are re-raised on consumption (the dispatch fails, the
+    batcher delivers it per request)."""
+    from concurrent.futures import ThreadPoolExecutor
+    if self._pool is None:
+      self._pool = ThreadPoolExecutor(
+          max_workers=max(1, self.config.fanout_threads),
+          thread_name_prefix="fleet-gather")
+    with _span("fleet/fanout"):
+      futs = {}
+      for name, per_rank in cold.items():
+        for rank, grps in enumerate(per_rank):
+          if np.asarray(grps).size:
+            futs[(name, rank)] = (grps, self._pool.submit(
+                self._fetch, name, rank, np.asarray(grps, np.int64)))
+      for key, (grps, fut) in futs.items():
+        try:
+          self._prefetched[key] = (np.asarray(grps), fut.result())
+        except BaseException as e:  # noqa: BLE001 — delivered on gather
+          self._prefetched[key] = (np.asarray(grps), e)
+
+  def gather(self, name: str, rank: int, grps: np.ndarray) -> np.ndarray:
+    """The prefetcher's gather: buffered fan-out rows when they match
+    this exact request, a direct fetch otherwise."""
+    grps = np.asarray(grps)
+    pre = self._prefetched.pop((name, rank), None)
+    if pre is not None and pre[0].shape == grps.shape \
+        and np.array_equal(pre[0], grps):
+      if isinstance(pre[1], BaseException):
+        raise pre[1]
+      return pre[1]
+    return self._fetch(name, rank, np.asarray(grps, np.int64))
+
+  def close(self) -> None:
+    if self._pool is not None:
+      self._pool.shutdown(wait=False)
+      self._pool = None
+
+
+class FleetRouter(ServeEngine):
+  """A ServeEngine whose rows live on the fleet.
+
+  Builds the tiered serve stack with EVERY sparse class remote-tier:
+  the jitted step, the compact cache+staging buffers, and the
+  per-dispatch classify/stage pipeline are the single-process tiered
+  path verbatim — only the store is a :class:`FleetStore`. Inherits
+  ``predict`` / ``_step_for`` / the promote-lock discipline from
+  :class:`~..serving.engine.ServeEngine`.
+  """
+
+  def __init__(self, model, plan: DistEmbeddingStrategy, path: str,
+               fleet_plan: FleetPlan, transport, mesh=None,
+               axis_name: str = "mp",
+               config: Optional[FleetConfig] = None,
+               with_metrics: bool = False, donate_batch: bool = False,
+               retry_policy: retry.RetryPolicy = retry.DEFAULT_POLICY,
+               telemetry=None):
+    # deliberately NOT calling ServeEngine.__init__: the fleet builds
+    # its state from owner handshakes + remote warm fill, not from a
+    # locally materialized artifact
+    config = config or FleetConfig()
+    art = serve_load(path, plan, mesh=mesh, axis_name=axis_name,
+                     owned_ranks=())
+    self.model = model
+    self.plan = plan
+    self.mesh = mesh
+    self.axis_name = axis_name
+    self.meta = art.meta
+    self.quantize = art.quantize
+    self.step = int(art.step)
+    self.with_metrics = with_metrics
+    self.donate_batch = donate_batch
+    self.translator = art.vocab
+    self.telemetry = telemetry if telemetry is not None else _registry()
+    self._steps: Dict[Any, Any] = {}
+    self.lock = threading.RLock()
+    self.fleet_plan = fleet_plan
+
+    self._validate_fleet(transport, fleet_plan)
+
+    sparse_keys = [k for k in plan.class_keys
+                   if plan.classes[k].kind == "sparse"]
+    if not sparse_keys:
+      raise ValueError(
+          "the plan has no sparse-kind classes: nothing to shard across "
+          "a fleet — serve the artifact single-process")
+    from ..parallel.lookup_engine import class_param_name
+    # the shard/replicate split: big classes stage remotely through the
+    # tiered path; small ones replicate whole into the router (a table
+    # one batch can cover gains nothing from remote gathers, and the
+    # compact-slot arithmetic needs headroom)
+    sharded_keys = [
+        k for k in sparse_keys
+        if self.meta[class_param_name(*k)].packed.phys_rows
+        >= config.shard_min_phys_rows]
+    self.replicated_names = tuple(sorted(
+        class_param_name(*k) for k in sparse_keys
+        if k not in set(sharded_keys)))
+    tier_cfg = ServeTierConfig(cache_fraction=config.cache_fraction,
+                               staging_grps=config.staging_grps,
+                               spill_factor_max=config.spill_factor_max)
+    self.tplan = ServeTierPlan(plan, self.meta, tier_cfg,
+                               keys=sharded_keys) if sharded_keys else None
+    self.store = FleetStore(self.tplan, fleet_plan, transport, plan,
+                            self.meta, self.quantize, config,
+                            retry_policy=retry_policy,
+                            telemetry=self.telemetry)
+    if self.tplan is not None:
+      ranking = {
+          c.name: [self.store.fetch_ranking(c.name, r)
+                   for r in range(plan.world_size)]
+          for c in self.tplan.classes.values()}
+      self.store.warm_start(ranking)
+    state = dict(art.state)
+    serve = self.store.build_fused(mesh, axis_name)
+    for name in self.replicated_names:
+      blocks = [self.store.fetch_block(name, r)
+                for r in range(plan.world_size)]
+      serve[name] = self.store._put(np.concatenate(blocks), mesh,
+                                    axis_name)
+    state["serve"] = serve
+    self.state = state
+    self.prefetcher = TieredPrefetcher(
+        self.tplan, self.store, mesh, axis_name,
+        retry_policy=retry_policy,
+        telemetry=self.telemetry) if self.tplan is not None else None
+
+  def _validate_fleet(self, transport, fleet_plan: FleetPlan) -> None:
+    """Handshake every owner before the first gather: plan fingerprint,
+    quantize mode, class geometry, and actual rank coverage must agree
+    — a fleet that disagrees refuses to start, naming the owner and
+    field."""
+    want_plan = _plan_fingerprint(self.plan)
+    want_classes = {n: m.to_json() for n, m in sorted(self.meta.items())}
+    covered: Dict[int, list] = {r: [] for r in range(self.plan.world_size)}
+    for owner_id in transport.owner_ids():
+      h = transport.call(owner_id, "handshake")
+      if h["plan"] != want_plan:
+        raise ValueError(
+            f"fleet owner {owner_id} serves a different plan "
+            "fingerprint than the router's artifact — one fleet, one "
+            "plan; re-point the owner or re-shard the artifact "
+            "(fleet.reshard)")
+      if h["quantize"] != self.quantize:
+        raise ValueError(
+            f"fleet owner {owner_id} serves quantize={h['quantize']!r} "
+            f"but the router expects {self.quantize!r}")
+      if h["classes"] != want_classes:
+        raise ValueError(
+            f"fleet owner {owner_id} disagrees on serve class geometry "
+            "— owners and router must load the same artifact version")
+      for r in h["owned_ranks"]:
+        covered[int(r)].append(owner_id)
+    for rank in range(self.plan.world_size):
+      for o in fleet_plan.owners_of(rank):
+        if o not in covered[rank]:
+          raise ValueError(
+              f"fleet plan assigns rank {rank} to owner {o}, but that "
+              f"owner's store holds ranks {sorted(covered_ranks(covered, o))}"
+              " — fleet plan and owner stores disagree; rebuild the "
+              "owners from FleetPlan.owned_ranks")
+
+  def dispatch(self, numerical, cats):
+    """classify -> concurrent owner fan-out -> stage -> jitted step.
+
+    Runs under :attr:`lock` (the promote-lock contract: a delta
+    follower swaps state references only between dispatches)."""
+    with self.lock:
+      if self.translator is not None:
+        cats = self.translator.translate(list(cats))
+      cats = tuple(np.asarray(c) for c in cats)
+      numerical = np.asarray(numerical)
+      if self.prefetcher is None:
+        # every class replicated locally: the plain all-device step
+        step = self._step_for((numerical, cats))
+        bt = shard_batch((numerical, cats), self.mesh, self.axis_name)
+        return step(self.state, *bt)
+      with _span("fleet/route"):
+        cold = self.prefetcher.classify(list(cats))
+      self.store.prefetch(cold)
+      staged = self.prefetcher.stage(cold)
+      step = self._step_for((numerical, cats), staged.s_eff)
+      bt = shard_batch((numerical, cats), self.mesh, self.axis_name)
+      return step(self.state, staged.device, *bt)
+
+  # ---- delta application (FleetDeltaFollower's member surface) ------------
+  def apply_delta_rows(self, name: str, rank: int, idx: np.ndarray,
+                       data: np.ndarray) -> int:
+    """Refresh router-cached rows a delta changed. The authoritative
+    copies live on the owners (their followers fold the same delta);
+    the router only patches its local hot-shard replica, from the delta
+    payload itself — no re-fetch. Swaps under :attr:`lock` (between
+    dispatches, never inside one)."""
+    import jax
+    import jax.numpy as jnp
+    m = self.meta[name]
+    lay = m.packed
+    rpp, lanes = lay.rows_per_phys, m.lanes
+    idx = np.asarray(idx, np.int64)
+    with self.lock:
+      if name in self.replicated_names:
+        # replicated class: the router holds the full buffer — scatter
+        # the changed logical rows exactly as the single-process
+        # subscriber does
+        rows_idx = rank * lay.phys_rows + idx // rpp
+        sub = idx
+        hot = np.ones(idx.shape, bool)
+      else:
+        spec = self.tplan.by_name(name).spec
+        per = spec.cache_grps + spec.staging_grps
+        slot = self.store.resident_map[name][rank][idx // rpp]
+        hot = slot >= 0
+        if not np.any(hot):
+          return 0
+        rows_idx = rank * per + slot[hot].astype(np.int64)
+        sub = idx[hot]
+      cols = ((sub % rpp)[:, None] * lanes
+              + np.arange(lanes, dtype=np.int64)[None, :])
+      buf = self.state["serve"][name]
+      new = jnp.asarray(buf).at[
+          jnp.asarray(rows_idx)[:, None],
+          jnp.asarray(cols)].set(jnp.asarray(data[hot]))
+      if isinstance(buf, jax.Array):
+        new = jax.device_put(new, buf.sharding)
+      serve = dict(self.state["serve"])
+      serve[name] = new
+      self.state["serve"] = serve
+      return int(np.sum(hot))
+
+  def apply_delta_parts(self, dense, emb_dense, vocab_arrays) -> None:
+    """Swap the delta's dense/MXU parts (shipped whole) and the
+    dynvocab read-only snapshot in, under :attr:`lock`."""
+    from ..serving.export import place_state
+    placed = place_state({"dense": dense, "emb_dense": emb_dense},
+                         self.mesh, self.axis_name)
+    with self.lock:
+      self.state["dense"] = placed["dense"]
+      self.state["emb_dense"] = placed["emb_dense"]
+      if vocab_arrays is not None:
+        from ..dynvocab import ReadonlyIdTranslator
+        self.translator = ReadonlyIdTranslator.from_arrays(vocab_arrays)
+
+  def adopt_step(self, step: int) -> None:
+    self.step = int(step)
+
+  def close(self) -> None:
+    self.store.close()
+
+
+def covered_ranks(covered: Dict[int, list], owner: int) -> list:
+  return [r for r, owners in covered.items() if owner in owners]

@@ -1,0 +1,278 @@
+"""Online freshness for fleet members: per-member delta following.
+
+Every fleet member — each owner AND the router — runs its own follower
+on the shared publish directory, exactly the N-subscriber shape PR 12's
+back-pressure quorum already handles: independent validated folds,
+independent fsynced heartbeats (``applied_seq`` per member), the
+publisher throttles on the slowest LIVE member and GC keeps every
+heartbeated member's tail alive.
+
+Per validated ``delta_<seq>/`` (the chain contract is
+:func:`~..streaming.publish.validate_chain_link`, verbatim — integrity
+against the delta's own crc32 manifest, seq exactly next,
+``base_fingerprint`` continuity, plan + quantize equality):
+
+- an OWNER scatters the rows of its owned ranks into its blocks (other
+  ranks' payloads are skipped — each owner folds its share);
+- the ROUTER patches its local hot-shard replica rows from the same
+  payload (the delta carries the new values — no re-fetch) and swaps
+  the dense/MXU parts + dynvocab snapshot;
+- both adopt the delta's train step as their served watermark.
+
+Members converge independently, so a fleet answer during catch-up can
+mix delta ``k`` rows from one owner with ``k-1`` from another — the
+same freshness (never correctness) window N independent full
+subscribers have today; the bench and tests compare answers at
+quiesced watermarks. A broken link REFUSES with the field named and the
+member keeps serving its last valid state.
+
+Polling rides the subscriber's deterministic anti-stampede phase
+(:func:`~..streaming.subscribe.poll_phase`): N members' polls spread
+over ``poll_jitter_s`` instead of statting the pubdir in lockstep.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..checkpoint import _plan_fingerprint, manifest_fingerprint, read_manifest
+from ..layers.planner import DistEmbeddingStrategy
+from ..resilience import retry
+from ..serving.export import ServeClassMeta, _unflatten_paths
+from ..streaming.publish import (
+    BASE_DIR,
+    ChainDivergedError,
+    chain_anchor,
+    delta_dirname,
+    validate_chain_link,
+    write_heartbeat,
+)
+from ..streaming.subscribe import _fp_and_manifest, poll_phase
+from ..telemetry import get_registry as _registry, span as _span
+
+
+class FleetDeltaFollower:
+  """Fold published deltas into one fleet member (owner or router).
+
+  ``member`` provides ``quantize``, ``meta``, ``plan``,
+  ``apply_delta_rows(name, rank, idx, data) -> int`` and
+  ``adopt_step(step)``; a member with ``apply_delta_parts`` (the
+  router) also receives each delta's dense/MXU parts and vocab
+  snapshot. ``poll_once`` is the deterministic test surface; ``start``
+  polls on a daemon thread at ``poll_interval_s`` with the member's
+  deterministic phase offset."""
+
+  def __init__(self, member, path: str, plan: DistEmbeddingStrategy,
+               subscriber_id: Optional[str] = None,
+               poll_interval_s: float = 0.05,
+               poll_jitter_s: float = 0.0,
+               heartbeat: bool = True, telemetry=None,
+               retry_policy: retry.RetryPolicy = retry.DEFAULT_POLICY):
+    self.member = member
+    self.path = path
+    self.plan = plan
+    self.poll_interval_s = float(poll_interval_s)
+    self.heartbeat = heartbeat
+    self.telemetry = telemetry if telemetry is not None else _registry()
+    self.retry_policy = retry_policy
+    if subscriber_id is None:
+      import uuid
+      kind = type(member).__name__.lower()
+      subscriber_id = f"fleet-{kind}-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+    self.subscriber_id = subscriber_id
+    self.poll_phase_s = poll_phase(subscriber_id, float(poll_jitter_s))
+    fp, bman = self._retried(_fp_and_manifest,
+                             os.path.join(path, BASE_DIR))
+    self.base_fingerprint = fp
+    self.applied_seq, self.fingerprint, self.chain_root = \
+        chain_anchor(bman, fp)
+    self.last_refusal: Optional[Dict[str, Any]] = None
+    self.last_error: Optional[BaseException] = None
+    self._stop = threading.Event()
+    self._thread: Optional[threading.Thread] = None
+
+  def _retried(self, fn, *args):
+    return retry.retry_call(fn, *args, policy=self.retry_policy)
+
+  # ---- polling ------------------------------------------------------------
+  def start(self) -> "FleetDeltaFollower":
+    if self._thread is not None and self._thread.is_alive():
+      return self
+    self._stop.clear()
+    self._thread = threading.Thread(target=self._poll_loop,
+                                    name="fleet-delta-follower",
+                                    daemon=True)
+    self._thread.start()
+    return self
+
+  def stop(self) -> None:
+    self._stop.set()
+    if self._thread is not None:
+      self._thread.join(timeout=10.0)
+
+  def _poll_loop(self) -> None:
+    if self.poll_phase_s:
+      self._stop.wait(self.poll_phase_s)
+    while not self._stop.is_set():
+      try:
+        self.poll_once()
+      except Exception as e:  # noqa: BLE001 — recorded, loop survives
+        self.last_error = e
+        self.telemetry.counter("fleet/poll_errors").inc()
+      self._stop.wait(self.poll_interval_s)
+
+  def _refuse(self, seq: int, field: str, reason: str) -> None:
+    self.last_refusal = {"seq": seq, "field": field, "reason": reason}
+    self.telemetry.counter("fleet/deltas_refused").inc()
+
+  def poll_once(self) -> int:
+    """Apply every ready delta in seq order; returns how many applied.
+    Stops (without advancing) at the first refusal; heartbeats either
+    way — the publisher's quorum and the GC retention floor must see
+    every live fleet member."""
+    applied = 0
+    base = os.path.join(self.path, BASE_DIR)
+    try:
+      if os.path.isfile(os.path.join(base, "manifest.json")):
+        current = self._retried(manifest_fingerprint, base)
+        if current != self.base_fingerprint:
+          comp = (self._retried(read_manifest, base).get("stream")
+                  or {}).get("compacted")
+          if comp and comp.get("chain_root") == self.chain_root \
+              and int(comp["through_seq"]) <= self.applied_seq:
+            # our chain, compacted at/behind us: identity change only
+            self.base_fingerprint = current
+            self.telemetry.counter("fleet/compactions_adopted").inc()
+          else:
+            # a re-rooted (or compacted-past-us) base cannot be folded
+            # row-wise: a fleet member reloads its partial store from
+            # the new base (operator/driver action — the member's
+            # blocks are whole-artifact state, not a delta)
+            self._refuse(
+                self.applied_seq + 1, "base_fingerprint",
+                f"base artifact changed ({current[:12]}... != "
+                f"{self.base_fingerprint[:12]}...): rebuild this fleet "
+                "member from the new base (partial stores reload, they "
+                "do not rebase row-wise)")
+            return applied
+      while not self._stop.is_set():
+        seq = self.applied_seq + 1
+        dpath = os.path.join(self.path, delta_dirname(seq))
+        if not os.path.isfile(os.path.join(dpath, "manifest.json")):
+          break
+        try:
+          manifest, next_fp = validate_chain_link(
+              dpath, seq, self.fingerprint,
+              plan_fp=_plan_fingerprint(self.plan),
+              quantize=self.member.quantize, where="fleet")
+        except ChainDivergedError as e:
+          self._refuse(seq, e.field, str(e))
+          break
+        if not self._apply(dpath, manifest, seq):
+          break
+        self.fingerprint = next_fp
+        applied += 1
+    finally:
+      if self.heartbeat:
+        try:
+          write_heartbeat(self.path, self.subscriber_id,
+                          self.applied_seq, self.fingerprint)
+        except OSError:
+          self.telemetry.counter("fleet/heartbeat_errors").inc()
+    return applied
+
+  # ---- application --------------------------------------------------------
+  def _apply(self, dpath: str, manifest: Dict[str, Any], seq: int) -> bool:
+    """Two phases, strictly ordered: validate + load EVERY payload of
+    the delta, then apply. A refusal anywhere in phase one mutates
+    nothing — the member keeps serving its last valid state whole,
+    never a half-applied delta (the copy-on-promote discipline, at
+    follower granularity)."""
+    member = self.member
+    meta = {n: ServeClassMeta.from_json(n, d)
+            for n, d in manifest["serve"]["classes"].items()}
+    world = self.plan.world_size
+    with _span("fleet/fold", args={"seq": seq}):
+      # --- phase 1: validate and load everything, touching nothing ---
+      staged = []  # (name, rank, idx, data)
+      for name, per_rank in manifest["stream"]["rows"].items():
+        m = meta.get(name)
+        have = member.meta.get(name)
+        if m is None or have is None or m.packed != have.packed:
+          self._refuse(seq, "geometry",
+                       f"delta class {name!r} geometry does not match "
+                       "this member's serve geometry")
+          return False
+        for rank_s in per_rank:
+          rank = int(rank_s)
+          if rank < 0 or rank >= world:
+            self._refuse(seq, "rows",
+                         f"class {name!r}: delta names rank {rank} "
+                         f"outside [0, {world})")
+            return False
+          def _load(fp=os.path.join(dpath, f"rows_{name}_r{rank}.npz")):
+            with np.load(fp) as z:
+              return {k: np.asarray(v) for k, v in z.items()}
+          try:
+            z = self._retried(_load)
+          except (OSError, ValueError) as e:
+            self._refuse(seq, "rows", f"unreadable delta payload: {e!r}")
+            return False
+          idx = np.asarray(z["idx"], np.int64)
+          data = m.from_disk(np.asarray(z["data"]))
+          if idx.size and (int(idx.min()) < 0
+                           or int(idx.max()) >= m.rows):
+            bad = int(idx.min() if idx.min() < 0 else idx.max())
+            self._refuse(seq, "rows",
+                         f"class {name!r} rank {rank}: row index {bad} "
+                         f"outside [0, {m.rows})")
+            return False
+          if data.shape != (idx.size, m.lanes):
+            self._refuse(seq, "rows",
+                         f"class {name!r} rank {rank}: data shape "
+                         f"{data.shape} != ({idx.size}, {m.lanes})")
+            return False
+          staged.append((name, rank, idx, data))
+      parts = None
+      vocab_arrays = None
+      if hasattr(member, "apply_delta_parts"):
+        parts = {}
+        for part in ("dense", "emb_dense"):
+          def _loadp(fp=os.path.join(dpath, f"{part}.npz")):
+            with np.load(fp) as z:
+              return {k: np.asarray(v) for k, v in z.items()}
+          try:
+            parts[part] = _unflatten_paths(self._retried(_loadp))
+          except (OSError, ValueError) as e:
+            self._refuse(seq, "rows",
+                         f"unreadable delta {part} payload: {e!r}")
+            return False
+        if manifest.get("vocab_snapshot") is not None:
+          def _loadv(fp=os.path.join(dpath, "vocab_snapshot.npz")):
+            with np.load(fp) as z:
+              return {k: np.asarray(v) for k, v in z.items()}
+          try:
+            vocab_arrays = self._retried(_loadv)
+          except (OSError, ValueError) as e:
+            self._refuse(seq, "rows",
+                         f"unreadable delta vocab payload: {e!r}")
+            return False
+      # --- phase 2: apply (nothing below can refuse) ---
+      rows_applied = 0
+      for name, rank, idx, data in staged:
+        rows_applied += member.apply_delta_rows(name, rank, idx, data)
+      if parts is not None:
+        member.apply_delta_parts(parts["dense"], parts["emb_dense"],
+                                 vocab_arrays)
+      member.adopt_step(int(manifest["step"]))
+    self.applied_seq = seq
+    self.last_refusal = None
+    reg = self.telemetry
+    reg.counter("fleet/deltas_applied").inc()
+    reg.counter("fleet/rows_applied").inc(rows_applied)
+    reg.gauge(f"fleet/applied_seq/{self.subscriber_id}").set(seq)
+    return True

@@ -1,0 +1,356 @@
+"""Process-local transport abstraction for fleet RPCs.
+
+The router talks to owners through one small surface —
+``call(owner_id, method, **kwargs)`` — with two backends:
+
+- :class:`InProcTransport`: owners live in this process (tests, the
+  bench, single-host fleets). Calls are direct method dispatch;
+  :meth:`InProcTransport.kill` simulates a dead owner (every later call
+  raises ``ConnectionError``), which is how the chaos/bench tier proves
+  counted failover without real processes.
+- :class:`SocketTransport`: owners are separate processes serving a
+  length-prefixed binary frame protocol over TCP
+  (:class:`SocketOwnerServer`). Payloads are JSON headers plus raw
+  ``np.save`` bytes per array — no pickle on the wire, so a fleet
+  member never executes a peer's bytes.
+
+Error taxonomy (what the retry/failover stack keys on):
+
+- transport failures (unreachable owner, torn connection, a remote
+  ``OSError``) surface as ``OSError`` — the resilience retry policy
+  absorbs transients, and the router fails over to a replica when they
+  persist;
+- remote CORRECTNESS refusals (bounds violations, un-owned ranks)
+  surface as :class:`RemoteRefusal` — NEVER retried or failed over: a
+  refusal means the request itself is wrong, and a replica would refuse
+  it identically.
+
+Every RPC attempt fires the ``fleet_rpc`` fault site (the streaming
+``stream_read`` discipline applied to the fleet), so chaos can inject
+transient failures between the router and any owner.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from ..resilience import faultinject
+
+# fired per RPC attempt, client side, inside the retry loop — fail_first
+# simulates a flaky network the retry layer must absorb
+FLEET_RPC_SITE = faultinject.register_site("fleet_rpc")
+
+
+class RemoteRefusal(RuntimeError):
+  """The owner refused the request as WRONG (bounds, ownership, chain
+  mismatch) — not unavailable. Deliberately not an ``OSError``: the
+  retry layer must let it propagate (a replica would refuse the same
+  request the same way)."""
+
+  def __init__(self, remote_type: str, msg: str):
+    super().__init__(f"[{remote_type}] {msg}")
+    self.remote_type = remote_type
+
+
+class OwnerUnavailableError(RuntimeError):
+  """Every replica of a rank is dead or unreachable: the request FAILS
+  (the batcher delivers the error per request) — the fleet degrades to
+  explicit errors at the edge, never to a wrong answer."""
+
+
+# ---------------------------------------------------------------------------
+# wire form: JSON header + per-array np.save bytes, length-prefixed
+# ---------------------------------------------------------------------------
+
+
+def encode_message(msg: Dict[str, Any]) -> bytes:
+  """One frame: numpy values split out as raw ``np.save`` bytes, the
+  rest as a JSON header. fp8 arrays must be viewed to a byte dtype by
+  the caller first (the serve artifact's ``to_disk`` convention — the
+  disk form IS the wire form)."""
+  arrays = {k: v for k, v in msg.items() if isinstance(v, np.ndarray)}
+  plain = {k: v for k, v in msg.items() if k not in arrays}
+  header = json.dumps({"plain": plain, "arrays": sorted(arrays)})
+  out = [struct.pack(">I", len(header)), header.encode("utf-8")]
+  for k in sorted(arrays):
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(arrays[k]), allow_pickle=False)
+    raw = buf.getvalue()
+    out.append(struct.pack(">Q", len(raw)))
+    out.append(raw)
+  return b"".join(out)
+
+
+def decode_message(raw: bytes) -> Dict[str, Any]:
+  (hlen,) = struct.unpack(">I", raw[:4])
+  header = json.loads(raw[4:4 + hlen].decode("utf-8"))
+  msg = dict(header["plain"])
+  off = 4 + hlen
+  for k in header["arrays"]:
+    (alen,) = struct.unpack(">Q", raw[off:off + 8])
+    off += 8
+    msg[k] = np.load(io.BytesIO(raw[off:off + alen]), allow_pickle=False)
+    off += alen
+  return msg
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+  chunks = []
+  while n:
+    chunk = sock.recv(min(n, 1 << 20))
+    if not chunk:
+      raise ConnectionError("fleet socket closed mid-frame")
+    chunks.append(chunk)
+    n -= len(chunk)
+  return b"".join(chunks)
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+  sock.sendall(struct.pack(">Q", len(payload)) + payload)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+  (n,) = struct.unpack(">Q", _read_exact(sock, 8))
+  return _read_exact(sock, n)
+
+
+# remote exception types that surface client-side as OSError (the
+# retry/failover food); everything else is a RemoteRefusal
+_TRANSIENT_TYPES = frozenset({
+    "OSError", "TransientIOError", "ConnectionError", "TimeoutError",
+    "BrokenPipeError", "ConnectionResetError", "ConnectionRefusedError",
+})
+
+
+def _raise_remote(err: Dict[str, Any]) -> None:
+  if err["type"] in _TRANSIENT_TYPES:
+    raise OSError(f"remote owner error [{err['type']}]: {err['msg']}")
+  raise RemoteRefusal(err["type"], err["msg"])
+
+
+# ---------------------------------------------------------------------------
+# backends
+# ---------------------------------------------------------------------------
+
+
+class InProcTransport:
+  """Owners in this process: direct dispatch, with kill/revive hooks so
+  tests and the bench can prove failover without real processes."""
+
+  def __init__(self, owners: Dict[int, Any]):
+    self._owners = dict(owners)
+    self._dead: set = set()
+    self._lock = threading.Lock()
+
+  def owner_ids(self) -> Tuple[int, ...]:
+    return tuple(sorted(self._owners))
+
+  def kill(self, owner_id: int) -> None:
+    """Simulate a dead owner: every later call raises ConnectionError
+    (an OSError — the router's retry/failover path sees exactly what a
+    SIGKILLed owner process would look like)."""
+    with self._lock:
+      self._dead.add(owner_id)
+
+  def revive(self, owner_id: int) -> None:
+    with self._lock:
+      self._dead.discard(owner_id)
+
+  def call(self, owner_id: int, method: str, **kwargs) -> Dict[str, Any]:
+    with self._lock:
+      dead = owner_id in self._dead
+      owner = self._owners.get(owner_id)
+    if dead or owner is None:
+      raise ConnectionError(
+          f"fleet owner {owner_id} is unreachable (killed or never "
+          "registered)")
+    fn = getattr(owner, "rpc_" + method, None)
+    if fn is None:
+      raise RemoteRefusal("AttributeError",
+                          f"owner {owner_id} has no RPC {method!r}")
+    return fn(**kwargs)
+
+  def close(self) -> None:
+    pass
+
+
+class _OwnerHandler(socketserver.BaseRequestHandler):
+  def setup(self):
+    self.server.track(self.request)  # type: ignore[attr-defined]
+
+  def finish(self):
+    self.server.untrack(self.request)  # type: ignore[attr-defined]
+
+  def handle(self):
+    owner = self.server.owner  # type: ignore[attr-defined]
+    try:
+      while True:
+        try:
+          raw = _recv_frame(self.request)
+        except (ConnectionError, struct.error):
+          return
+        msg = decode_message(raw)
+        method = msg.pop("method")
+        fn = getattr(owner, "rpc_" + method, None)
+        try:
+          if fn is None:
+            raise AttributeError(f"no RPC {method!r}")
+          reply = fn(**msg)
+        except Exception as e:  # noqa: BLE001 — serialized to the peer
+          reply = {"error": {"type": type(e).__name__, "msg": str(e)}}
+        _send_frame(self.request, encode_message(reply))
+    except BrokenPipeError:
+      return
+
+
+class _OwnerTCPServer(socketserver.ThreadingTCPServer):
+  daemon_threads = True
+  allow_reuse_address = True
+  owner: Any = None
+
+  def __init__(self, *args, **kwargs):
+    super().__init__(*args, **kwargs)
+    self._active_lock = threading.Lock()
+    self._active: set = set()
+
+  def track(self, sock) -> None:
+    with self._active_lock:
+      self._active.add(sock)
+
+  def untrack(self, sock) -> None:
+    with self._active_lock:
+      self._active.discard(sock)
+
+  def close_active(self) -> None:
+    """Tear down established connections too: a CLOSED owner must stop
+    answering — a router holding a persistent connection would
+    otherwise keep being served by a server that claims to be down
+    (and a kill test would prove nothing)."""
+    with self._active_lock:
+      socks = list(self._active)
+    for sock in socks:
+      try:
+        sock.shutdown(socket.SHUT_RDWR)
+      except OSError:
+        pass
+      try:
+        sock.close()
+      except OSError:
+        pass
+
+
+class SocketOwnerServer:
+  """Serve one owner's RPC surface on a TCP port until closed."""
+
+  def __init__(self, owner, host: str = "127.0.0.1", port: int = 0):
+    self._server = _OwnerTCPServer((host, port), _OwnerHandler)
+    self._server.owner = owner
+    self.host, self.port = self._server.server_address[:2]
+    self._thread = threading.Thread(
+        target=self._server.serve_forever, name="fleet-owner-rpc",
+        daemon=True)
+    self._thread.start()
+
+  @property
+  def address(self) -> Tuple[str, int]:
+    return (self.host, int(self.port))
+
+  def close(self) -> None:
+    self._server.shutdown()
+    self._thread.join(timeout=10.0)
+    self._server.close_active()
+    self._server.server_close()
+
+  def __enter__(self) -> "SocketOwnerServer":
+    return self
+
+  def __exit__(self, exc_type, exc, tb):
+    self.close()
+    return False
+
+
+class SocketTransport:
+  """Owners behind TCP endpoints, with a small per-owner CONNECTION
+  POOL: concurrent calls to one owner each check an idle connection out
+  (dialing a fresh one when none is idle), so the router's per-dispatch
+  fan-out really runs in parallel over TCP — one serialized socket
+  would make the stage latency the SUM of an owner's gathers instead of
+  the max. A torn connection is dropped, never returned to the pool
+  (the OSError is retry/failover food, exactly like the in-proc kill);
+  ``pool_size`` bounds the idle connections KEPT per owner (excess
+  concurrency still dials, then closes on return)."""
+
+  def __init__(self, addresses: Dict[int, Tuple[str, int]],
+               timeout_s: float = 10.0, pool_size: int = 8):
+    self._addresses = dict(addresses)
+    self._timeout_s = float(timeout_s)
+    self._pool_size = int(pool_size)
+    self._lock = threading.Lock()
+    self._idle: Dict[int, list] = {o: [] for o in self._addresses}
+    self._closed = False
+
+  def owner_ids(self) -> Tuple[int, ...]:
+    return tuple(sorted(self._addresses))
+
+  def _acquire(self, owner_id: int) -> socket.socket:
+    with self._lock:
+      if self._closed:
+        raise ConnectionError("SocketTransport is closed")
+      idle = self._idle[owner_id]
+      if idle:
+        return idle.pop()
+    host, port = self._addresses[owner_id]
+    sock = socket.create_connection((host, port),
+                                    timeout=self._timeout_s)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+  def _release(self, owner_id: int, sock: socket.socket) -> None:
+    with self._lock:
+      idle = self._idle[owner_id]
+      if not self._closed and len(idle) < self._pool_size:
+        idle.append(sock)
+        return
+    try:
+      sock.close()
+    except OSError:
+      pass
+
+  def call(self, owner_id: int, method: str, **kwargs) -> Dict[str, Any]:
+    if owner_id not in self._addresses:
+      raise ConnectionError(f"fleet owner {owner_id} has no address")
+    sock = self._acquire(owner_id)
+    try:
+      _send_frame(sock, encode_message(dict(kwargs, method=method)))
+      reply = decode_message(_recv_frame(sock))
+    except OSError:
+      # torn mid-call: this connection is unusable — drop it
+      try:
+        sock.close()
+      except OSError:
+        pass
+      raise
+    self._release(owner_id, sock)
+    if "error" in reply:
+      _raise_remote(reply["error"])
+    return reply
+
+  def close(self) -> None:
+    with self._lock:
+      self._closed = True
+      socks = [s for idle in self._idle.values() for s in idle]
+      for idle in self._idle.values():
+        idle.clear()
+    for sock in socks:
+      try:
+        sock.close()
+      except OSError:
+        pass

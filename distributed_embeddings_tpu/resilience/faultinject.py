@@ -45,6 +45,9 @@ modules via :func:`register_site` (same lint/validation treatment as
   delta application — the kill-the-subscriber-mid-promote hook.
 - ``"compact_fold"`` (`streaming/compact.py`): per sparse class folded
   into a compacted base — the kill-the-compactor-mid-fold hook.
+- ``"fleet_rpc"`` (`fleet/transport.py`): per router->owner RPC attempt,
+  inside the retry loop — ``fail_first`` simulates a flaky fleet
+  network; persistent failure drives the router's counted failover.
 
 With no injector installed :func:`fire` is a dict lookup + None check:
 the hooks cost nothing in production.

@@ -50,9 +50,27 @@ from ..parallel.lookup_engine import PAD_ID
 from ..telemetry import MetricsRegistry, span as _span
 
 
+REJECT_REASONS = ("queue_full", "deadline_expired", "priority_shed")
+
+
 class Rejected(RuntimeError):
-  """The bounded request queue is full; the request was shed (counted in
-  ``MicroBatcher.stats['rejected']``), not enqueued."""
+  """The request was shed — counted, never silently dropped.
+
+  ``reason`` names the shed class (callers route their backoff on it):
+
+  - ``'queue_full'``: the bounded queue had no room (and nothing of
+    lower priority to evict);
+  - ``'deadline_expired'``: the request's own deadline passed before a
+    flush could dispatch it;
+  - ``'priority_shed'``: a higher-priority request evicted this one
+    from the full queue.
+
+  Each reason has its own counter (``serve/rejected/<reason>``);
+  ``serve/rejected`` stays the exact total."""
+
+  def __init__(self, msg: str, reason: str = "queue_full"):
+    super().__init__(msg)
+    self.reason = reason
 
 
 class ServeFuture:
@@ -96,12 +114,20 @@ class ServeFuture:
 
 
 class _Pending:
-  __slots__ = ("numerical", "cats", "future")
+  __slots__ = ("numerical", "cats", "future", "priority", "deadline_s",
+               "seq")
 
-  def __init__(self, numerical, cats, future):
+  def __init__(self, numerical, cats, future, priority=0,
+               deadline_s=None, seq=0):
     self.numerical = numerical
     self.cats = cats
     self.future = future
+    self.priority = priority
+    self.deadline_s = deadline_s  # absolute monotonic stamp, or None
+    self.seq = seq
+
+  def expired(self, now: float) -> bool:
+    return self.deadline_s is not None and now >= self.deadline_s
 
 
 class MicroBatcher:
@@ -152,6 +178,10 @@ class MicroBatcher:
     self._counters = {k: self.telemetry.counter(f"serve/{k}")
                       for k in ("submitted", "rejected", "batches",
                                 "completed", "padded_rows")}
+    self._counters.update(
+        {f"rejected/{r}": self.telemetry.counter(f"serve/rejected/{r}")
+         for r in REJECT_REASONS})
+    self._seq = 0  # arrival order (FIFO tie-break within a priority)
     self._latency = self.telemetry.histogram("serve/latency_s")
     self._inflight: _queue.Queue = _queue.Queue(maxsize=max(1,
                                                            pipeline_depth))
@@ -182,10 +212,55 @@ class MicroBatcher:
       self.dispatch_fn = dispatch_fn
 
   # ---- submission ---------------------------------------------------------
-  def submit(self, numerical, cats: Sequence) -> ServeFuture:
+  def _reject(self, reason: str, msg: str) -> Rejected:
+    """Count one shed (total + per-reason) and build the exception —
+    the load-shed accounting contract: every shed is exactly one total
+    count and exactly one reason count."""
+    self._counters["rejected"].inc()
+    self._counters[f"rejected/{reason}"].inc()
+    return Rejected(msg, reason=reason)
+
+  def _evict_for_locked(self, n: int, priority: int) -> None:
+    """Make room for an incoming higher-priority request by shedding
+    pending LOWER-priority requests — lowest priority first, youngest
+    first within a priority (the request that waited longest keeps its
+    place). Sheds only what the incoming rows need; sheds nothing if
+    even shedding everything below ``priority`` cannot make room."""
+    room = self.queue_rows - self._pending_rows
+    victims = sorted((p for p in self._pending if p.priority < priority),
+                     key=lambda p: (p.priority, -p.seq))
+    chosen, freed = [], 0
+    for p in victims:
+      if room + freed >= n:
+        break
+      chosen.append(p)
+      freed += p.future.n
+    if room + freed < n:
+      return
+    for p in chosen:
+      self._pending.remove(p)
+      self._pending_rows -= p.future.n
+      p.future._fail(self._reject(
+          "priority_shed",
+          f"request shed for priority-{priority} traffic (this request "
+          f"is priority {p.priority}; the queue is full). Re-submit "
+          "with backoff, or raise this caller's priority class."))
+
+  def submit(self, numerical, cats: Sequence, priority: int = 0,
+             deadline_s: Optional[float] = None) -> ServeFuture:
     """Enqueue one request of ``n = numerical.shape[0]`` rows
     (``1 <= n <= max_batch``). Returns its :class:`ServeFuture`; raises
-    :class:`Rejected` — counted — when the bounded queue is full."""
+    :class:`Rejected` — counted, with ``reason`` — when it cannot be
+    queued.
+
+    ``priority``: admission class (higher wins). Flushes pack pending
+    requests highest-priority-first, and a full queue sheds
+    lower-priority pending work to admit higher-priority arrivals —
+    so p99.9 for priority traffic survives overload instead of queueing
+    behind it. ``deadline_s``: seconds from now this request is worth
+    dispatching; one that expires in the queue is shed
+    (``deadline_expired``) instead of wasting a dispatch slot on an
+    answer nobody is waiting for."""
     numerical = np.asarray(numerical)
     cats = [np.asarray(c) for c in cats]
     n = numerical.shape[0]
@@ -199,47 +274,96 @@ class MicroBatcher:
         raise RuntimeError("MicroBatcher is closed")
       self._counters["submitted"].inc()
       if self._pending_rows + n > self.queue_rows:
-        self._counters["rejected"].inc()
-        raise Rejected(
+        # expired occupants have no claim on the rows a live request
+        # needs: purge them before rejecting or evicting live work
+        self._purge_expired_locked()
+      if self._pending_rows + n > self.queue_rows:
+        # an arrival OUTRANKING pending work may evict it (the victim
+        # filter is strict-lower-priority, so all-equal traffic no-ops)
+        self._evict_for_locked(n, priority)
+      if self._pending_rows + n > self.queue_rows:
+        raise self._reject(
+            "queue_full",
             f"serve queue full ({self._pending_rows} rows pending, bound "
             f"{self.queue_rows}): request shed. The device is saturated "
             "— back off client-side or raise queue_rows (which only "
             "trades the error for latency).")
-      self._pending.append(_Pending(numerical, cats, fut))
+      self._seq += 1
+      deadline = None
+      if deadline_s is not None:
+        # absolute stamp on the flush clock (deadline arithmetic)
+        deadline = fut.t_submit + float(deadline_s)
+      self._pending.append(_Pending(numerical, cats, fut,
+                                    priority=int(priority),
+                                    deadline_s=deadline, seq=self._seq))
       self._pending_rows += n
       self._nonempty.notify()
     return fut
 
   # ---- flush policy -------------------------------------------------------
+  def _purge_expired_locked(self) -> None:
+    """Shed pending requests whose own deadline passed — counted
+    ``deadline_expired``; their waiters fail immediately instead of
+    riding a dispatch whose answer is already too late."""
+    now = time.monotonic()  # graftlint: disable=GL113 (deadline arithmetic)
+    expired = [p for p in self._pending if p.expired(now)]
+    for p in expired:
+      self._pending.remove(p)
+      self._pending_rows -= p.future.n
+      p.future._fail(self._reject(
+          "deadline_expired",
+          f"request deadline passed after {now - p.future.t_submit:.4f}s "
+          "in the serve queue — shed instead of dispatched late."))
+
   def _take_batch_locked(self) -> List[_Pending]:
-    """Pop whole requests FIFO while they fit in max_batch rows."""
+    """Pop whole requests while they fit in max_batch rows: highest
+    priority first, FIFO within a priority (all-default-priority
+    traffic keeps the classic FIFO order exactly). Expired requests
+    are purged first — they never occupy dispatch rows (the inline
+    ``flush_now`` path's purge; the flusher thread purges in its
+    readiness check)."""
+    self._purge_expired_locked()
+    order = sorted(self._pending, key=lambda p: (-p.priority, p.seq))
     taken, rows = [], 0
-    while self._pending and rows + self._pending[0].future.n \
-        <= self.max_batch:
-      p = self._pending.pop(0)
+    for p in order:
+      if rows + p.future.n > self.max_batch:
+        break
+      self._pending.remove(p)
       rows += p.future.n
       taken.append(p)
     self._pending_rows -= rows
     return taken
 
   def _flush_ready_locked(self) -> bool:
+    # purge expired waiters HERE (they fail at their own deadline — the
+    # wait timeout wakes the loop then) rather than treating expiry as
+    # flush-readiness: an expired co-tenant must not force the live
+    # requests into a premature, heavily padded dispatch
+    self._purge_expired_locked()
     if not self._pending:
       return False
+    now = time.monotonic()  # graftlint: disable=GL113 (deadline arithmetic)
     if self._pending_rows >= self.max_batch \
         or self._pending[0].future.n == self.max_batch:
       return True
     oldest = self._pending[0].future.t_submit
     # flush-deadline arithmetic against the submit stamps, not timing
-    return (time.monotonic() - oldest) >= self.max_delay_s  # graftlint: disable=GL113
+    return (now - oldest) >= self.max_delay_s
 
   def _flush_loop(self) -> None:
     while True:
       with self._nonempty:
         while not self._flush_ready_locked() and not self._closed:
           if self._pending:
-            wait = self.max_delay_s - (  # deadline, not timing
-                time.monotonic()  # graftlint: disable=GL113
-                - self._pending[0].future.t_submit)
+            now = time.monotonic()  # graftlint: disable=GL113 (deadline)
+            wait = self.max_delay_s - (now
+                                       - self._pending[0].future.t_submit)
+            # a per-request deadline expiring BEFORE the flush deadline
+            # must wake the loop then: its waiter fails at its own
+            # deadline, not up to max_delay_s late
+            for p in self._pending:
+              if p.deadline_s is not None:
+                wait = min(wait, p.deadline_s - now)
             self._nonempty.wait(timeout=max(wait, 0.0) + 1e-4)
           else:
             self._nonempty.wait(timeout=0.05)

@@ -343,12 +343,19 @@ class _ServeTierClass:
 class ServeTierPlan:
   """Serve-geometry twin of ``tiering.TieringPlan``: same classify /
   stage / translate machinery, sized on the stripped image's physical
-  rows. Duck-types the ``tplan`` the tiering stack binds to."""
+  rows. Duck-types the ``tplan`` the tiering stack binds to.
+
+  ``keys``: the class keys whose rows live off-device (default: the
+  plan's host-tier classes — single-process tiered serving). The fleet
+  router passes EVERY sparse class: behind a routing tier, all rows are
+  "cold" on their rank owners, and the hot cache is the router's local
+  hot-shard replica."""
 
   def __init__(self, plan: DistEmbeddingStrategy,
                meta: Dict[str, ServeClassMeta],
-               config: ServeTierConfig = ServeTierConfig()):
-    host_keys = plan.host_tier_class_keys()
+               config: ServeTierConfig = ServeTierConfig(),
+               keys=None):
+    host_keys = plan.host_tier_class_keys() if keys is None else list(keys)
     if not host_keys:
       raise ValueError("plan has no host-tier classes")
     self.plan = plan
@@ -360,7 +367,11 @@ class ServeTierPlan:
       lay = m.packed
       rpp = lay.rows_per_phys
       hard_cap = lay.rows // rpp
-      staging = min(config.staging_grps, max(1, lay.phys_rows - 1))
+      # clamp to the class's own capacity: a small class must leave at
+      # least one physical row of cache under the hard cap (compact ids
+      # stay below the sentinel), whatever the configured staging is
+      staging = min(config.staging_grps, max(1, lay.phys_rows - 1),
+                    max(1, hard_cap - 1))
       cache = min(max(1, int(lay.phys_rows * config.cache_fraction)),
                   hard_cap - staging)
       if cache < 1:

@@ -46,7 +46,12 @@ it):
     serve_ranking.npz                  per host-tier class/rank: serve
                                        physical rows by export-time
                                        observed-count priority (seeds
-                                       the serve cache's resident set)
+                                       the serve cache's resident set),
+                                       plus the per-serve-physical-row
+                                       observed counts themselves
+                                       (``counts/<class>/r<rank>`` —
+                                       the fleet plan's hot-rank
+                                       replication signal)
     dense.npz / emb_dense.npz          model params + MXU-dense tables
                                        (small by definition; kept f32)
 
@@ -259,6 +264,11 @@ class FrozenTables:
   ranking: Dict[str, List[np.ndarray]]        # per rank, serve phys rows
   dense: Any                                  # np-leaved pytrees
   emb_dense: Any
+  # per host-tier class/rank: observed counts re-binned per SERVE
+  # physical row (the ranking's raw signal — rides the artifact so a
+  # FleetPlan can weigh rank popularity without the training run)
+  counts: Dict[str, List[np.ndarray]] = dataclasses.field(
+      default_factory=dict)
 
 
 def _strip_block(train_lay: PackedLayout, meta: ServeClassMeta,
@@ -272,20 +282,25 @@ def _strip_block(train_lay: PackedLayout, meta: ServeClassMeta,
   return np.asarray(meta.packed.pack(rows), meta.np_dtype)
 
 
-def _serve_ranking(meta: ServeClassMeta, train_lay: PackedLayout,
-                   counts: np.ndarray) -> np.ndarray:
-  """Training observed counts (per TRAIN physical row) -> serve physical
-  rows in descending-priority order. Counts spread uniformly over the
-  train row's logical rows and re-sum per serve physical row (the two
-  layouts pack different logical spans per row); ties break lowest row
-  first, matching the store's default warm start."""
+def _serve_grp_counts(meta: ServeClassMeta, train_lay: PackedLayout,
+                      counts: np.ndarray) -> np.ndarray:
+  """Training observed counts (per TRAIN physical row) re-binned per
+  SERVE physical row. Counts spread uniformly over the train row's
+  logical rows and re-sum per serve physical row (the two layouts pack
+  different logical spans per row)."""
   rpp_t = train_lay.rows_per_phys
   sl = meta.packed
   logical = np.repeat(np.asarray(counts, np.int64), rpp_t)[:meta.rows]
   pad = sl.phys_rows * sl.rows_per_phys - meta.rows
   if pad:
     logical = np.concatenate([logical, np.zeros((pad,), np.int64)])
-  per_grp = logical.reshape(sl.phys_rows, sl.rows_per_phys).sum(axis=1)
+  return logical.reshape(sl.phys_rows, sl.rows_per_phys).sum(axis=1)
+
+
+def _serve_ranking(per_grp: np.ndarray) -> np.ndarray:
+  """Serve-physical-row counts -> rows in descending-priority order;
+  ties break lowest row first, matching the store's default warm
+  start."""
   return np.argsort(-per_grp, kind="stable").astype(np.int32)
 
 
@@ -367,15 +382,17 @@ def freeze(plan: DistEmbeddingStrategy, rule: SparseRule,
   device_blocks: Dict[str, List[np.ndarray]] = {}
   host_images: Dict[str, List[np.ndarray]] = {}
   ranking: Dict[str, List[np.ndarray]] = {}
+  grp_counts: Dict[str, List[np.ndarray]] = {}
   for name, m in meta.items():
     full_lay = full_lays[name]
     if m.tier == "host":
       host_images[name] = [
           _strip_block(full_lay, m, store.images[name][r])
           for r in range(plan.world_size)]
-      ranking[name] = [
-          _serve_ranking(m, full_lay, store.counts[name][r])
+      grp_counts[name] = [
+          _serve_grp_counts(m, full_lay, store.counts[name][r])
           for r in range(plan.world_size)]
+      ranking[name] = [_serve_ranking(c) for c in grp_counts[name]]
     else:
       arr = state["fused"][name]
       if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
@@ -396,7 +413,7 @@ def freeze(plan: DistEmbeddingStrategy, rule: SparseRule,
       quantize=quantize, step=int(_to_host(state["step"])), meta=meta,
       device_blocks=device_blocks, host_images=host_images,
       ranking=ranking, dense=_to_host_tree(state["dense"]),
-      emb_dense=_to_host_tree(state["emb_dense"]))
+      emb_dense=_to_host_tree(state["emb_dense"]), counts=grp_counts)
 
 
 def place_state(state: Dict[str, Any], mesh=None,
@@ -493,9 +510,16 @@ def export(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
       _seal(fpath)
   if frozen.ranking:
     fpath = os.path.join(tmp, "serve_ranking.npz")
-    np.savez(fpath, **{f"{name}/r{r}": order
-                       for name, orders in sorted(frozen.ranking.items())
-                       for r, order in enumerate(orders)})
+    arrays = {f"{name}/r{r}": order
+              for name, orders in sorted(frozen.ranking.items())
+              for r, order in enumerate(orders)}
+    # the raw per-serve-physical-row counts ride alongside the derived
+    # order (extra keys — old readers ignore them): the fleet planner's
+    # hot-rank replication weights come from exactly these
+    arrays.update({f"counts/{name}/r{r}": cnt
+                   for name, cnts in sorted(frozen.counts.items())
+                   for r, cnt in enumerate(cnts)})
+    np.savez(fpath, **arrays)
     _seal(fpath)
   for part, tree in (("dense", frozen.dense),
                      ("emb_dense", frozen.emb_dense)):
@@ -537,7 +561,16 @@ class ServeArtifact:
   serve cache + cold store when a :class:`~.engine.ServeEngine` is built
   on this artifact. ``vocab`` is the exported
   ``dynvocab.ReadonlyIdTranslator`` snapshot (None for static-vocab
-  artifacts) — translate request raw ids through it before dispatch."""
+  artifacts) — translate request raw ids through it before dispatch.
+
+  **Owner-sharded form** (``load(owned_ranks=...)``): only the named
+  ranks' blocks are materialized, host-side — ``rank_blocks`` holds the
+  device-tier classes' serve-layout blocks per owned rank,
+  ``host_images``/``ranking``/``counts`` carry ``None`` at un-owned
+  ranks, and ``state['serve']`` is empty (a partial artifact cannot
+  assemble the global device buffers; the fleet owner serves per-rank
+  gathers from the host blocks instead). :meth:`rank_block` is the one
+  access path and refuses un-owned ranks naming the rank."""
 
   quantize: str
   step: int
@@ -546,6 +579,45 @@ class ServeArtifact:
   host_images: Dict[str, List[np.ndarray]]
   ranking: Dict[str, List[np.ndarray]]
   vocab: Any = None
+  # observed counts per serve physical row (host-tier classes; empty
+  # lists/zeros for artifacts exported before the counts rode along)
+  counts: Dict[str, List[np.ndarray]] = dataclasses.field(
+      default_factory=dict)
+  # owner-sharded load only: class name -> {rank: serve-layout block}
+  rank_blocks: Dict[str, Dict[int, np.ndarray]] = dataclasses.field(
+      default_factory=dict)
+  owned_ranks: Optional[tuple] = None  # None = full artifact
+
+  def rank_block(self, name: str, rank: int) -> np.ndarray:
+    """One rank's serve-layout block of one class, host-side
+    ``[phys_rows, phys_width]`` (element dtype per the quantize mode).
+    On an owner-sharded artifact, asking for an un-owned rank raises
+    naming the rank — the fleet routing tier must send that gather to
+    the rank's owner, never read a block this process does not hold."""
+    m = self.meta.get(name)
+    if m is None:
+      raise KeyError(f"unknown serve class {name!r}; artifact has "
+                     f"{sorted(self.meta)}")
+    if self.owned_ranks is not None and rank not in self.owned_ranks:
+      raise ValueError(
+          f"class {name!r} rank {rank} is not owned by this artifact "
+          f"(owned_ranks={self.owned_ranks}): an owner-sharded serve "
+          "store materializes only its ranks' blocks — route the gather "
+          "to the owning process (fleet.FleetRouter does).")
+    if m.tier == "host":
+      img = self.host_images[name][rank]
+      if img is None:
+        raise ValueError(
+            f"class {name!r} rank {rank} image was not loaded "
+            f"(owned_ranks={self.owned_ranks})")
+      return img
+    if self.owned_ranks is not None:
+      return self.rank_blocks[name][rank]
+    # full artifact: slice the (host-fetched) global device buffer
+    lay = self.meta[name].packed
+    return np.asarray(
+        self.state["serve"][name][rank * lay.phys_rows:
+                                  (rank + 1) * lay.phys_rows])
 
 
 def _unflatten_paths(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
@@ -563,16 +635,25 @@ def _unflatten_paths(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
 
 def load(path: str, plan: DistEmbeddingStrategy, mesh=None,
          axis_name: str = "mp",
-         verify_integrity: bool = True) -> ServeArtifact:
+         verify_integrity: bool = True,
+         owned_ranks=None) -> ServeArtifact:
   """Load a serve artifact written by :func:`export`.
 
   The plan must match the exporting run's exactly (fingerprint
-  equality): serve artifacts do not re-shard elastically — re-export
-  from the checkpoint under the new plan instead (the export is cheap;
-  a serve-side re-shard would duplicate checkpoint.py's streaming
-  machinery for a path that never needs to be fast)."""
+  equality): serve artifacts do not re-shard elastically under this
+  loader — re-export from the checkpoint under the new plan, or re-cut
+  the published artifact serve-side with ``fleet.reshard`` (the elastic
+  window-wise path, no trainer round-trip).
+
+  ``owned_ranks``: the owner-sharded form — materialize ONLY the named
+  mesh ranks' blocks (host-side numpy, no device placement of the serve
+  buffers; ``state['serve']`` stays empty). The empty tuple loads
+  manifest + dense parts + vocab only (what a routing tier needs). This
+  is PR 6's elastic cold-store owner contract re-aimed at inference:
+  each serving process holds its ranks, ``ServeArtifact.rank_block``
+  refuses the rest naming the rank."""
   import json
-  if verify_integrity:
+  if verify_integrity and owned_ranks is None:
     problems = verify_dir(path)
     if problems:
       raise ValueError(
@@ -600,25 +681,67 @@ def load(path: str, plan: DistEmbeddingStrategy, mesh=None,
   meta = {n: ServeClassMeta.from_json(n, d)
           for n, d in manifest["serve"]["classes"].items()}
   world = plan.world_size
+  if owned_ranks is not None:
+    owned_ranks = tuple(sorted(set(int(r) for r in owned_ranks)))
+    if owned_ranks and (owned_ranks[0] < 0 or owned_ranks[-1] >= world):
+      raise ValueError(
+          f"owned_ranks {owned_ranks} outside [0, {world}) — serve "
+          "stores shard by MESH rank, not process index")
+  owned = set(range(world)) if owned_ranks is None else set(owned_ranks)
+
+  if verify_integrity and owned_ranks is not None:
+    # the partial-load contract extends to verification: crc32-read only
+    # the files THIS process will open — an owner of two ranks of a
+    # terabyte artifact must not scan every other owner's blocks
+    needed = ["dense.npz", "emb_dense.npz"]
+    if manifest.get("vocab_snapshot") is not None:
+      needed.append("vocab_snapshot.npz")
+    if any(m.tier == "host" for m in meta.values()):
+      needed.append("serve_ranking.npz")
+    for name, m in sorted(meta.items()):
+      prefix = "serve_cold" if m.tier == "host" else "serve"
+      needed.extend(f"{prefix}_{name}_r{r}.npy" for r in sorted(owned))
+    problems = verify_dir(path, only=needed)
+    if problems:
+      raise ValueError(
+          f"serve artifact {path!r} failed integrity verification: "
+          + "; ".join(problems))
 
   serve: Dict[str, Any] = {}
   host_images: Dict[str, List[np.ndarray]] = {}
   ranking: Dict[str, List[np.ndarray]] = {}
+  counts: Dict[str, List[np.ndarray]] = {}
+  rank_blocks: Dict[str, Dict[int, np.ndarray]] = {}
   rank_npz = None
   if any(m.tier == "host" for m in meta.values()):
     with np.load(os.path.join(path, "serve_ranking.npz")) as z:
-      rank_npz = dict(z)
+      # owned ranks' arrays only: a partial load must not materialize
+      # every rank's ranking/counts
+      rank_npz = {k: np.asarray(z[k]) for k in z.files
+                  if int(k.rsplit("/r", 1)[1]) in owned}
   for name, m in sorted(meta.items()):
     lay = m.packed
     if m.tier == "host":
       host_images[name] = [
           m.from_disk(np.load(os.path.join(path,
                                            f"serve_cold_{name}_r{r}.npy")))
-          for r in range(world)]
-      ranking[name] = [rank_npz[f"{name}/r{r}"] for r in range(world)]
+          if r in owned else None for r in range(world)]
+      ranking[name] = [rank_npz[f"{name}/r{r}"] if r in owned else None
+                      for r in range(world)]
+      counts[name] = [
+          (np.asarray(rank_npz[f"counts/{name}/r{r}"], np.int64)
+           if f"counts/{name}/r{r}" in rank_npz
+           else np.zeros((lay.phys_rows,), np.int64))
+          if r in owned else None for r in range(world)]
       continue
     files = [os.path.join(path, f"serve_{name}_r{r}.npy")
              for r in range(world)]
+    if owned_ranks is not None:
+      # owner-sharded: host-side per-rank blocks only — no device
+      # placement (the fleet owner answers host gathers off these)
+      rank_blocks[name] = {r: m.from_disk(np.load(files[r]))
+                           for r in range(world) if r in owned}
+      continue
     shape = (world * lay.phys_rows, lay.phys_width)
     if mesh is None:
       serve[name] = jnp.asarray(np.concatenate(
@@ -652,4 +775,5 @@ def load(path: str, plan: DistEmbeddingStrategy, mesh=None,
   return ServeArtifact(quantize=manifest["serve"]["quantize"],
                        step=int(manifest["step"]), meta=meta, state=state,
                        host_images=host_images, ranking=ranking,
-                       vocab=vocab)
+                       vocab=vocab, counts=counts,
+                       rank_blocks=rank_blocks, owned_ranks=owned_ranks)

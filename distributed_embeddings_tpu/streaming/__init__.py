@@ -63,9 +63,10 @@ from .publish import (
     read_heartbeats,
     write_heartbeat,
 )
-from .subscribe import DeltaSubscriber
+from .subscribe import DeltaSubscriber, poll_phase
 
 __all__ = [
+    "poll_phase",
     "BASE_DIR",
     "ChainDivergedError",
     "DeltaCompactor",

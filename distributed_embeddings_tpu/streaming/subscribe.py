@@ -88,6 +88,24 @@ STREAM_READ_SITE = faultinject.register_site("stream_read")
 DELTA_PROMOTE_SITE = faultinject.register_site("delta_promote")
 
 
+def poll_phase(subscriber_id: str, jitter_s: float) -> float:
+  """Deterministic per-subscriber poll phase offset in ``[0, jitter_s)``.
+
+  N fleet subscribers sharing one pubdir poll in lockstep without it —
+  every ``poll_interval_s`` the whole fleet stats the same directory at
+  the same instant (an NFS/GCS-fuse stampede that scales with fleet
+  size). The phase is a pure function of the subscriber id (sha256 —
+  uniform over ids, stable across restarts), so the fleet's polls
+  spread over the jitter window deterministically: no RNG, no
+  coordination, reproducible in tests."""
+  if jitter_s <= 0.0:
+    return 0.0
+  import hashlib
+  digest = hashlib.sha256(subscriber_id.encode("utf-8")).digest()
+  frac = int.from_bytes(digest[:8], "big") / float(1 << 64)
+  return frac * float(jitter_s)
+
+
 def _fp_and_manifest(path: str):
   """Fingerprint AND parsed manifest from ONE read of the manifest
   bytes — the two are guaranteed to describe the same artifact version
@@ -115,18 +133,26 @@ class DeltaSubscriber:
                telemetry=None, subscriber_id: Optional[str] = None,
                heartbeat: bool = True,
                retry_policy: retry.RetryPolicy = retry.DEFAULT_POLICY,
-               base_manifest: Optional[Dict[str, Any]] = None):
+               base_manifest: Optional[Dict[str, Any]] = None,
+               poll_jitter_s: float = 0.0):
     self.engine = engine
     self.path = path
     self.plan = plan
     self.translator = translator
     self.poll_interval_s = float(poll_interval_s)
+    self.poll_jitter_s = float(poll_jitter_s)
     self.telemetry = telemetry if telemetry is not None else _registry()
     self.retry_policy = retry_policy
     if subscriber_id is None:
       import uuid
       subscriber_id = f"sub-{os.getpid()}-{uuid.uuid4().hex[:8]}"
     self.subscriber_id = subscriber_id
+    # deterministic anti-stampede phase: this subscriber's polls sit at
+    # phase + k * poll_interval_s, so N subscribers on one pubdir spread
+    # over the jitter window instead of statting it in lockstep
+    self.poll_phase_s = poll_phase(subscriber_id, self.poll_jitter_s)
+    self.poll_walls: list = []  # last poll stamps (bounded; tests pin
+    #   that two subscribers' polls interleave, not collide)
     self.heartbeat = heartbeat
     # anchor the chain: the artifact-last-applied fingerprint (the
     # link) and the chain's root identity (survives compaction — a
@@ -190,7 +216,8 @@ class DeltaSubscriber:
                     poll_interval_s: float = 0.05,
                     telemetry=None, subscriber_id: Optional[str] = None,
                     heartbeat: bool = True,
-                    retry_policy=retry.DEFAULT_POLICY
+                    retry_policy=retry.DEFAULT_POLICY,
+                    poll_jitter_s: float = 0.0
                     ) -> "DeltaSubscriber":
     """Load ``<path>/base`` and build the engine + subscriber pair.
 
@@ -223,7 +250,8 @@ class DeltaSubscriber:
               base_manifest=bman,
               translator=art.vocab, poll_interval_s=poll_interval_s,
               telemetry=telemetry, subscriber_id=subscriber_id,
-              heartbeat=heartbeat, retry_policy=retry_policy)
+              heartbeat=heartbeat, retry_policy=retry_policy,
+              poll_jitter_s=poll_jitter_s)
     sub._factory = dict(model=model, mesh=mesh, axis_name=axis_name,
                         tier_config=tier_config, with_metrics=with_metrics,
                         donate_batch=donate_batch)
@@ -275,7 +303,13 @@ class DeltaSubscriber:
       self._thread.join(timeout=10.0)
 
   def _poll_loop(self) -> None:
+    if self.poll_phase_s:
+      self._stop.wait(self.poll_phase_s)
     while not self._stop.is_set():
+      import time
+      # phase stamp, not timing (the jitter test reads the spacing)
+      self.poll_walls.append(time.monotonic())  # graftlint: disable=GL113
+      del self.poll_walls[:-64]
       try:
         self.poll_once()
       except Exception as e:  # noqa: BLE001 — recorded, loop survives

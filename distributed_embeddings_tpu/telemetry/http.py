@@ -10,6 +10,18 @@ endpoint — a stdlib ``ThreadingHTTPServer`` on a daemon thread rendering
 point-in-time consistent snapshot (the registry lock is taken once per
 render, never held across the socket write).
 
+**Fleet roll-up** (``GET /metrics?scope=fleet``): a fleet of serving
+processes each keeps a private registry for exact per-process
+accounting; the roll-up view answers "what is the FLEET doing" from one
+scrape.  Members push registry snapshots (``state_dict()`` JSON) — in
+process via :meth:`MetricsServer.push`, or over HTTP via
+``POST /push`` with ``{"source": id, "telemetry": state_dict}`` — and
+the fleet scope renders this process's registry MERGED with every
+pushed snapshot through ``MetricsRegistry.merge``: counters and
+histogram buckets ADD, gauges take the LAST writer (push order), metric
+geometry mismatches fail the scrape loudly.  Snapshots replace by
+source id, so a re-pushing member never double-counts.
+
 Lifecycle is explicit and shutdown-clean: ``close()`` (or the context
 manager) shuts the serve loop down, closes the listening socket, and
 JOINS the serve thread — a test or a draining server never leaks the
@@ -19,9 +31,11 @@ port or the thread.  Bind ``port=0`` to let the OS pick a free port
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Any, Dict, Optional
+from urllib.parse import parse_qs, urlparse
 
 from .export import prometheus_text
 from .registry import MetricsRegistry, get_registry
@@ -32,25 +46,57 @@ PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 class _Handler(BaseHTTPRequestHandler):
-  """One registry, two routes: ``/metrics`` (Prometheus text) and
-  ``/healthz`` (liveness ping). Everything else is 404."""
+  """One registry, three routes: ``/metrics`` (Prometheus text —
+  ``?scope=fleet`` renders the merged roll-up), ``/healthz`` (liveness
+  ping), and ``POST /push`` (fleet snapshot ingestion). Everything else
+  is 404."""
 
   # the registry rides the SERVER object (one handler instance per
   # request; BaseHTTPRequestHandler offers no clean per-handler state)
   def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler's contract
-    path = self.path.split("?", 1)[0]
+    parsed = urlparse(self.path)
+    path = parsed.path
     if path == "/metrics":
-      body = prometheus_text(self.server.registry).encode("utf-8")
-      self.send_response(200)
-      self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+      scope = parse_qs(parsed.query).get("scope", ["self"])[0]
+      try:
+        registry = self.server.fleet_registry() if scope == "fleet" \
+            else self.server.registry
+        body = prometheus_text(registry).encode("utf-8")
+        self.send_response(200)
+        self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+      except ValueError as e:
+        # a geometry mismatch across members must fail the scrape
+        # loudly, not render half a fleet
+        body = f"fleet merge failed: {e}\n".encode("utf-8")
+        self.send_response(500)
+        self.send_header("Content-Type", "text/plain; charset=utf-8")
     elif path == "/healthz":
       body = b"ok\n"
       self.send_response(200)
       self.send_header("Content-Type", "text/plain; charset=utf-8")
     else:
-      body = b"not found: /metrics and /healthz are served\n"
+      body = b"not found: /metrics, /healthz and POST /push are served\n"
       self.send_response(404)
       self.send_header("Content-Type", "text/plain; charset=utf-8")
+    self.send_header("Content-Length", str(len(body)))
+    self.end_headers()
+    self.wfile.write(body)
+
+  def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler's contract
+    if urlparse(self.path).path != "/push":
+      body = b"not found\n"
+      self.send_response(404)
+    else:
+      try:
+        n = int(self.headers.get("Content-Length", "0"))
+        payload = json.loads(self.rfile.read(n).decode("utf-8"))
+        self.server.push(str(payload["source"]), payload["telemetry"])
+        body = b"ok\n"
+        self.send_response(200)
+      except (ValueError, KeyError, TypeError) as e:
+        body = f"bad push payload: {e}\n".encode("utf-8")
+        self.send_response(400)
+    self.send_header("Content-Type", "text/plain; charset=utf-8")
     self.send_header("Content-Length", str(len(body)))
     self.end_headers()
     self.wfile.write(body)
@@ -62,6 +108,38 @@ class _Handler(BaseHTTPRequestHandler):
 class _Server(ThreadingHTTPServer):
   daemon_threads = True  # per-request handler threads die with close()
   registry: MetricsRegistry
+
+  def __init__(self, *args, **kwargs):
+    super().__init__(*args, **kwargs)
+    self._push_lock = threading.Lock()
+    self._snapshots: Dict[str, Dict[str, Any]] = {}  # insertion-ordered
+
+  def push(self, source: str, section: Dict[str, Any]) -> None:
+    # validate BEFORE adopting: a malformed snapshot must fail ITS push
+    # (400 to the sender), never poison every later fleet scrape — the
+    # throwaway load raises exactly what fleet_registry() would have
+    try:
+      MetricsRegistry().load_state_dict(section)
+    except (ValueError, TypeError, KeyError, AttributeError) as e:
+      raise ValueError(
+          f"snapshot from {source!r} is not a registry state_dict: {e}"
+      ) from e
+    with self._push_lock:
+      # replace-by-source: a member re-pushing moves to the back of the
+      # last-writer order and never double-counts
+      self._snapshots.pop(source, None)
+      self._snapshots[source] = section
+
+  def fleet_registry(self) -> MetricsRegistry:
+    merged = MetricsRegistry()
+    merged.merge(self.registry)
+    with self._push_lock:
+      snaps = list(self._snapshots.items())
+    for _source, section in snaps:
+      tmp = MetricsRegistry()
+      tmp.load_state_dict(section)
+      merged.merge(tmp)
+    return merged
 
 
 class MetricsServer:
@@ -90,6 +168,18 @@ class MetricsServer:
   @property
   def url(self) -> str:
     return f"http://{self.host}:{self.port}/metrics"
+
+  @property
+  def fleet_url(self) -> str:
+    return f"http://{self.host}:{self.port}/metrics?scope=fleet"
+
+  def push(self, source: str, snapshot) -> None:
+    """Adopt one fleet member's registry snapshot for the fleet scope.
+    ``snapshot``: a ``MetricsRegistry`` (its ``state_dict()`` is taken
+    now) or a ``state_dict()``-shaped JSON section."""
+    if isinstance(snapshot, MetricsRegistry):
+      snapshot = snapshot.state_dict()
+    self._server.push(source, snapshot)
 
   @property
   def closed(self) -> bool:

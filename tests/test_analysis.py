@@ -382,6 +382,56 @@ import optax  # graftlint: disable=GL111
   assert lint_source(src, SERVING_PATH, CTX, ["GL111"]) == []
 
 
+FLEET_PATH = "distributed_embeddings_tpu/fleet/router.py"
+
+
+def test_gl114_train_surfaces_in_fleet_module():
+  """The fleet tier is the serving engine spread over processes — the
+  same inference-only contract (GL111) at fleet scope."""
+  src = """
+import optax
+def f(params, grads):
+  return optax.apply_updates(params, grads)
+"""
+  out = lint_source(src, FLEET_PATH, CTX, ["GL114"])
+  assert _rules(out) and all(r == "GL114" for r in _rules(out))
+  assert "optax" in out[0].message
+  # outside fleet/, optax is business as usual (and GL111 owns serving/)
+  assert lint_source(src, "distributed_embeddings_tpu/training.py", CTX,
+                     ["GL114"]) == []
+  assert lint_source(src, SERVING_PATH, CTX, ["GL114"]) == []
+  # guard/builder imports and by-name references fire too
+  imp = """
+from distributed_embeddings_tpu.resilience import guards
+from distributed_embeddings_tpu.training import make_sparse_train_step
+"""
+  out = lint_source(imp, FLEET_PATH, CTX, ["GL114"])
+  assert len(out) == 2 and set(_rules(out)) == {"GL114"}
+  ref = """
+def serve(engine, state, layouts, dz, residuals, rule, step):
+  return engine.apply_sparse(state, layouts, dz, residuals, rule, step)
+"""
+  out = lint_source(ref, FLEET_PATH, CTX, ["GL114"])
+  assert _rules(out) == ["GL114"]
+  assert "apply_sparse" in out[0].message
+
+
+def test_gl114_allows_fleet_legitimate_imports_and_suppression():
+  # the fleet rides retry/faultinject and the serving engine by design
+  src = """
+from distributed_embeddings_tpu.resilience import faultinject, retry
+from distributed_embeddings_tpu.serving.engine import ServeEngine
+from distributed_embeddings_tpu.parallel.lookup_engine import (
+    class_param_name,
+)
+"""
+  assert lint_source(src, FLEET_PATH, CTX, ["GL114"]) == []
+  sup = """
+import optax  # graftlint: disable=GL114
+"""
+  assert lint_source(sup, FLEET_PATH, CTX, ["GL114"]) == []
+
+
 def test_gl112_translator_call_in_step_builder():
   """The dynamic-vocab invariant: translation-state mutation lives in
   dynvocab/ host paths — a translator call inside a trace-reachable
@@ -523,13 +573,14 @@ def test_repo_context_parses_markers_and_sites():
   assert "slow" in ctx.registered_markers
   # SITES literal members plus register_site-registered extensions
   # ("sigkill" in faultinject.py, the streaming sites in
-  # streaming/publish.py|subscribe.py|compact.py — all registered at
-  # module level) — test files' ad-hoc registrations are deliberately
-  # NOT scanned
+  # streaming/publish.py|subscribe.py|compact.py, the fleet RPC site in
+  # fleet/transport.py — all registered at module level) — test files'
+  # ad-hoc registrations are deliberately NOT scanned
   assert ctx.fault_sites == frozenset(
       {"ckpt_write", "ckpt_rename", "host_gather", "ckpt_owner_write",
        "reshard_gather", "sigkill", "delta_extract", "delta_seal",
-       "stream_attach", "stream_read", "delta_promote", "compact_fold"})
+       "stream_attach", "stream_read", "delta_promote", "compact_fold",
+       "fleet_rpc"})
   assert "test_extension_site" not in ctx.fault_sites
 
 

@@ -595,6 +595,72 @@ def test_batcher_deadline_flush_and_padding():
   mb.close()
 
 
+def test_batcher_reject_reasons_exact_accounting():
+  """Every shed carries its reason and is counted exactly once in the
+  total AND once per reason — queue-full, deadline-expired, and
+  priority-shed are distinguishable at the edge."""
+  mb = MicroBatcher(_echo_dispatch, max_batch=8, queue_rows=16,
+                    start=False)
+  # --- queue_full: default-priority overflow sheds the INCOMING request
+  for _ in range(5):
+    mb.submit(np.zeros((3, 2), np.float32), [np.zeros(3, np.int32)])
+  with pytest.raises(Rejected) as exc:
+    mb.submit(np.zeros((3, 2), np.float32), [np.zeros(3, np.int32)])
+  assert exc.value.reason == "queue_full"
+  assert mb.stats["rejected"] == 1
+  assert mb.stats["rejected/queue_full"] == 1
+  # --- priority_shed: a priority arrival evicts queued priority-0 work
+  hi = mb.submit(np.zeros((3, 2), np.float32), [np.zeros(3, np.int32)],
+                 priority=2)
+  assert mb.stats["rejected/priority_shed"] == 1
+  assert mb.stats["rejected"] == 2
+  mb.flush_now()
+  assert hi.result(timeout=5).shape[0] == 3  # the priority request ran
+  # --- deadline_expired: an expired request is purged, never dispatched
+  fut = mb.submit(np.zeros((2, 2), np.float32), [np.zeros(2, np.int32)],
+                  deadline_s=0.0)
+  completed0 = mb.stats["completed"]
+  mb.flush_now()
+  with pytest.raises(Rejected) as exc:
+    fut.result(timeout=5)
+  assert exc.value.reason == "deadline_expired"
+  assert mb.stats["rejected/deadline_expired"] == 1
+  assert mb.stats["rejected"] == 3
+  assert mb.stats["completed"] == completed0  # it consumed no dispatch
+  assert mb.stats["rejected"] == sum(
+      mb.stats[f"rejected/{r}"] for r in
+      ("queue_full", "deadline_expired", "priority_shed"))
+  mb.close()
+
+
+def test_batcher_priority_shed_fails_victim_and_packs_priority_first():
+  """The evicted victim's future fails with reason 'priority_shed';
+  flushes pack higher priorities first (FIFO within a priority)."""
+  order = []
+
+  def spy(numerical, cats):
+    order.append(numerical[:, 0].copy())
+    return _echo_dispatch(numerical, cats)
+
+  mb = MicroBatcher(spy, max_batch=4, queue_rows=8, start=False)
+  lo1 = mb.submit(np.full((4, 2), 1.0, np.float32),
+                  [np.zeros(4, np.int32)], priority=0)
+  lo2 = mb.submit(np.full((4, 2), 2.0, np.float32),
+                  [np.zeros(4, np.int32)], priority=0)
+  hi = mb.submit(np.full((4, 2), 9.0, np.float32),
+                 [np.zeros(4, np.int32)], priority=5)
+  # the YOUNGEST low-priority request was evicted; the older kept its place
+  with pytest.raises(Rejected) as exc:
+    lo2.result(timeout=5)
+  assert exc.value.reason == "priority_shed"
+  mb.flush_now()
+  assert hi.result(timeout=5) is not None
+  assert lo1.result(timeout=5) is not None
+  # priority 5 dispatched before the remaining priority 0
+  assert [int(b[0]) for b in order] == [9, 1]
+  mb.close()
+
+
 def test_batcher_rejects_oversize_and_close():
   mb = MicroBatcher(_echo_dispatch, max_batch=4, start=False)
   with pytest.raises(ValueError, match="max_batch"):

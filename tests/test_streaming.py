@@ -1206,6 +1206,53 @@ def test_two_subscribers_one_chain_heartbeats_and_rollup(tmp_path):
                           + regB.metrics()["stream/freshness_s"].count)
 
 
+def test_subscriber_poll_jitter_phases_and_interleave(tmp_path):
+  """N subscribers on one pubdir must not stat it in lockstep: the
+  deterministic per-subscriber phase offset spreads their polls over
+  the jitter window, and two jittered subscribers' poll timestamps
+  INTERLEAVE instead of colliding."""
+  import time as _time
+
+  from distributed_embeddings_tpu.streaming import poll_phase
+
+  # the phase is a pure function of the id: deterministic, in-range,
+  # distinct across ids, zero when jitter is off
+  pa, pb = poll_phase("serve-a", 0.04), poll_phase("serve-b", 0.04)
+  assert pa != pb and 0.0 <= pa < 0.04 and 0.0 <= pb < 0.04
+  assert poll_phase("serve-a", 0.04) == pa
+  assert poll_phase("serve-a", 0.0) == 0.0
+  # phases scale with the window (same fraction)
+  assert abs(poll_phase("serve-a", 0.4) - 10 * pa) < 1e-12
+
+  plan, rule, mesh, state, publisher, sub, rng, b = _device_run(
+      tmp_path, 1, "f32", pre_steps=1, post_steps=1)
+  subA = DeltaSubscriber.from_artifact(ActsModel(), plan, sub.path,
+                                       subscriber_id="serve-a",
+                                       poll_interval_s=0.05,
+                                       poll_jitter_s=0.04)
+  subB = DeltaSubscriber.from_artifact(ActsModel(), plan, sub.path,
+                                       subscriber_id="serve-b",
+                                       poll_interval_s=0.05,
+                                       poll_jitter_s=0.04)
+  assert subA.poll_phase_s == pa and subB.poll_phase_s == pb
+  # fold the pending delta BEFORE timing polls: the first poll compiles
+  # the promote scatter; later polls are cheap directory stats — the
+  # regime the jitter exists for
+  assert subA.poll_once() == 1 and subB.poll_once() == 1
+  subA.start()
+  subB.start()
+  _time.sleep(0.6)
+  subA.stop()
+  subB.stop()
+  assert len(subA.poll_walls) >= 3 and len(subB.poll_walls) >= 3
+  # interleaved: neither subscriber's polls all precede the other's —
+  # the merged timeline alternates at least twice
+  merged = sorted([(t, "a") for t in subA.poll_walls]
+                  + [(t, "b") for t in subB.poll_walls])
+  flips = sum(1 for x, y in zip(merged, merged[1:]) if x[1] != y[1])
+  assert flips >= 2, merged
+
+
 # ---------------------------------------------------------------------------
 # transient-read retry on the subscriber's validate/fold path
 # ---------------------------------------------------------------------------

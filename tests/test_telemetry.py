@@ -579,3 +579,45 @@ def test_metrics_http_endpoint_serves_and_shuts_down_clean():
   with pytest.raises(OSError):
     urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=2)
   server.close()  # idempotent
+
+
+def test_metrics_fleet_rollup_merges_pushed_snapshots():
+  """``/metrics?scope=fleet``: counters sum across pushed per-process
+  snapshots, gauges take the last writer, and the default scope stays
+  the local registry only."""
+  import json
+  import urllib.request
+
+  local = telemetry.MetricsRegistry()
+  local.counter("serve/completed").inc(10)
+  local.gauge("fleet/owners_dead").set(0.0)
+  member_a = telemetry.MetricsRegistry()
+  member_a.counter("serve/completed").inc(7)
+  member_a.gauge("fleet/owners_dead").set(1.0)
+  member_b = telemetry.MetricsRegistry()
+  member_b.counter("serve/completed").inc(5)
+  member_b.gauge("fleet/owners_dead").set(2.0)
+  member_b.histogram("serve/latency_s").observe_many([0.01, 0.02])
+  with telemetry.MetricsServer(local) as server:
+    server.push("owner-0", member_a)
+    # the second member pushes over HTTP, the deployment shape
+    payload = json.dumps({"source": "owner-1",
+                          "telemetry": member_b.state_dict()})
+    req = urllib.request.Request(
+        f"http://{server.host}:{server.port}/push",
+        data=payload.encode("utf-8"), method="POST")
+    assert urllib.request.urlopen(req, timeout=5).status == 200
+    fleet = urllib.request.urlopen(server.fleet_url,
+                                   timeout=5).read().decode()
+    assert "serve_completed 22" in fleet          # 10 + 7 + 5: counters SUM
+    assert "fleet_owners_dead 2.0" in fleet       # last writer (owner-1)
+    assert 'serve_latency_s{quantile="0.5"}' in fleet
+    # default scope: the local registry only, untouched by pushes
+    solo = urllib.request.urlopen(server.url, timeout=5).read().decode()
+    assert "serve_completed 10" in solo
+    # replace-by-source: a re-push never double-counts
+    member_a.counter("serve/completed").inc(1)
+    server.push("owner-0", member_a)
+    fleet = urllib.request.urlopen(server.fleet_url,
+                                   timeout=5).read().decode()
+    assert "serve_completed 23" in fleet

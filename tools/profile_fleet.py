@@ -1,0 +1,371 @@
+"""Fleet-serving budget: exactness, failover, and latency vs fleet size.
+
+The open-loop fleet load generator (``make fleet-bench``). Three
+measurements on the 8-way CPU mesh:
+
+1. **Exactness** (always on): fleet answers vs the single-process
+   ``ServeEngine`` on identical requests — BIT-exact for f32 (including
+   a tiered artifact), byte-exact for int8/fp8. The owners move the
+   memory, never the arithmetic; this is the wire that proves it.
+
+2. **Latency vs offered QPS across fleet sizes {1, 2, 4 owners}**: a
+   closed-loop run finds each fleet's saturation throughput, then an
+   open-loop POISSON arrival process offers fractions of it through the
+   micro-batcher and reports p50/p99/p99.9 per-request latency.
+   Per-process telemetry (each owner's and the router's private
+   registry) rolls up through ``MetricsRegistry.merge`` — the fleet
+   view the acceptance names. Acceptance: finite percentiles at every
+   fleet size, and the rolled-up ``fleet/owner/gathers`` equals the sum
+   of the members' own counts (the merge is exact, not approximate).
+
+3. **Failover under load**: a fully 2-way-replicated fleet serves an
+   open loop while one owner is KILLED mid-load. Acceptance: ZERO wrong
+   answers (every completed request bitwise-matches the single-process
+   engine), zero failed requests (the replica absorbed the rank), and
+   ``fleet/failovers`` counted the event.
+
+``--smoke`` runs a tiny-world tier wired into ``make verify`` (same
+assertions, 1-2 owners, ~150 requests), timeout-guarded like the other
+smoke tiers. Verdict via ``telemetry.emit_verdict`` either way; the
+recorded budgets live in docs/BENCHMARKS.md ("Round 17: fleet
+serving").
+
+Usage: PYTHONPATH=/root/repo python tools/profile_fleet.py [--smoke]
+"""
+
+import argparse
+import os
+import shutil
+import tempfile
+import threading
+import time
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+  os.environ["XLA_FLAGS"] = (
+      flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402,F401  (device platform must initialize first)
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from distributed_embeddings_tpu import telemetry  # noqa: E402
+from distributed_embeddings_tpu.fleet import (  # noqa: E402
+    FleetConfig,
+    FleetOwner,
+    FleetPlan,
+    FleetRouter,
+    InProcTransport,
+)
+from distributed_embeddings_tpu.layers.dist_model_parallel import (  # noqa: E402
+    set_weights,
+)
+from distributed_embeddings_tpu.layers.embedding import TableConfig  # noqa: E402
+from distributed_embeddings_tpu.layers.planner import (  # noqa: E402
+    DistEmbeddingStrategy,
+)
+from distributed_embeddings_tpu.ops.packed_table import sparse_rule  # noqa: E402
+from distributed_embeddings_tpu.parallel import create_mesh  # noqa: E402
+from distributed_embeddings_tpu.parallel.lookup_engine import PAD_ID  # noqa: E402
+from distributed_embeddings_tpu.serving import (  # noqa: E402
+    MicroBatcher,
+    Rejected,
+    ServeEngine,
+    ServeTierConfig,
+)
+from distributed_embeddings_tpu.serving.export import (  # noqa: E402
+    export as serve_export,
+)
+from distributed_embeddings_tpu.serving.export import load as serve_load  # noqa: E402
+from distributed_embeddings_tpu.tiering import (  # noqa: E402
+    HostTierStore,
+    TieringConfig,
+    TieringPlan,
+    init_tiered_state_from_params,
+)
+from distributed_embeddings_tpu.training import (  # noqa: E402
+    init_sparse_state,
+    shard_params,
+)
+
+
+class ActsModel:
+  def apply(self, variables, numerical, cats, emb_acts=None):
+    del variables, numerical, cats
+    return jnp.concatenate(list(emb_acts), axis=-1)
+
+
+BENCH = dict(world=4, sizes=[65536, 16384, 4096], widths=[16, 16, 16],
+             hotness=[4, 2, 1], req_rows=4, max_batch=64,
+             n_requests=400, fleets=(1, 2, 4))
+SMOKE = dict(world=2, sizes=[1536, 768], widths=[16, 16],
+             hotness=[2, 1], req_rows=4, max_batch=32,
+             n_requests=150, fleets=(1, 2))
+
+FLEET_CFG = FleetConfig(cache_fraction=0.05, staging_grps=256,
+                        shard_min_phys_rows=16)
+
+
+def build(cfg, tiered=False, host_row_threshold=None):
+  rng = np.random.default_rng(7)
+  tables = [TableConfig(s, w, combiner="sum")
+            for s, w in zip(cfg["sizes"], cfg["widths"])]
+  kw = {}
+  if tiered:
+    kw["host_row_threshold"] = host_row_threshold or cfg["sizes"][-1]
+  plan = DistEmbeddingStrategy(tables, cfg["world"], "memory_balanced",
+                               dense_row_threshold=0,
+                               input_hotness=cfg["hotness"], **kw)
+  weights = [(rng.standard_normal((s, w)) / np.sqrt(w)).astype(np.float32)
+             for s, w in zip(cfg["sizes"], cfg["widths"])]
+  params = {"embeddings": {k: jnp.asarray(v)
+                           for k, v in set_weights(plan, weights).items()}}
+  rule = sparse_rule("adagrad", 0.05)
+  mesh = create_mesh(cfg["world"])
+  if tiered:
+    tplan = TieringPlan(plan, rule, TieringConfig(cache_fraction=0.25,
+                                                  staging_grps=256))
+    store = HostTierStore(tplan)
+    state = shard_params(
+        init_tiered_state_from_params(tplan, store, rule, params,
+                                      optax.sgd(0.01), mesh=mesh), mesh)
+  else:
+    store = None
+    state = shard_params(init_sparse_state(plan, params, rule,
+                                           optax.sgd(0.01)), mesh)
+  return plan, rule, mesh, state, store, rng
+
+
+def mkreq(rng, cfg, n):
+  ids = []
+  for s, h in zip(cfg["sizes"], cfg["hotness"]):
+    x = rng.integers(0, s, (n, h)).astype(np.int32)
+    x[rng.random(x.shape) < 0.2] = PAD_ID
+    ids.append(x)
+  return rng.standard_normal((n, 4)).astype(np.float32), ids
+
+
+def build_fleet(path, plan, mesh, n_owners, replicas=1,
+                config=FLEET_CFG):
+  world = plan.world_size
+  if replicas > 1:
+    fplan = FleetPlan.replicated(world, n_owners, replicas=replicas,
+                                 hot_fraction=1.0)
+  else:
+    fplan = FleetPlan.balanced(world, n_owners)
+  owner_regs = {o: telemetry.MetricsRegistry()
+                for o in range(n_owners)}
+  owners = {o: FleetOwner(path, plan, fplan.owned_ranks(o), owner_id=o,
+                          telemetry=owner_regs[o])
+            for o in range(n_owners)}
+  transport = InProcTransport(owners)
+  router_reg = telemetry.MetricsRegistry()
+  router = FleetRouter(ActsModel(), plan, path, fplan, transport,
+                       mesh=mesh, config=config, telemetry=router_reg)
+  return fplan, owners, owner_regs, transport, router, router_reg
+
+
+def rollup(router_reg, owner_regs):
+  """The fleet view: every member's private registry merged."""
+  fleet = telemetry.MetricsRegistry()
+  fleet.merge(router_reg)
+  for reg in owner_regs.values():
+    fleet.merge(reg)
+  return fleet
+
+
+def pcts(lats):
+  if not lats:
+    return float("nan"), float("nan"), float("nan")
+  a = np.sort(np.asarray(lats))
+  pick = lambda q: float(a[min(len(a) - 1, int(q * len(a)))])  # noqa: E731
+  return pick(0.50), pick(0.99), pick(0.999)
+
+
+def open_loop(mb, reqs, qps, n_requests, rng):
+  """Poisson arrivals at the offered rate; returns (latencies,
+  rejected, results)."""
+  futs, rejected = [], 0
+  t = time.perf_counter()
+  for i in range(n_requests):
+    t += float(rng.exponential(1.0 / qps))
+    now = time.perf_counter()
+    if t > now:
+      time.sleep(t - now)
+    numerical, ids = reqs[i % len(reqs)]
+    try:
+      futs.append((i % len(reqs), mb.submit(numerical, ids)))
+    except Rejected:
+      rejected += 1
+  out, lats = [], []
+  for ri, f in futs:
+    out.append((ri, f.result(timeout=300)))
+    lats.append(f.latency_s)
+  return lats, rejected, out
+
+
+# ---------------------------------------------------------------------------
+# measurements
+# ---------------------------------------------------------------------------
+
+
+def check_exactness(cfg, tmp, result):
+  """Fleet == single process, every layout."""
+  oks = {}
+  plan, rule, mesh, state, _store, rng = build(cfg)
+  for quantize in ("f32", "int8", "fp8"):
+    path = os.path.join(tmp, f"art_{quantize}")
+    serve_export(path, plan, rule, state, quantize=quantize)
+    single = ServeEngine(ActsModel(), plan,
+                         serve_load(path, plan, mesh=mesh), mesh=mesh)
+    _, owners, oregs, transport, router, rreg = build_fleet(
+        path, plan, mesh, 2)
+    ok = True
+    for _ in range(3):
+      numerical, ids = mkreq(rng, cfg, cfg["req_rows"])
+      ok &= np.array_equal(single.predict(numerical, ids),
+                           router.predict(numerical, ids))
+    oks[quantize] = bool(ok)
+    router.close()
+  # tiered artifact (f32): the serve cache + cold images behind a fleet
+  plan_t, rule_t, mesh_t, state_t, store_t, rng_t = build(cfg,
+                                                          tiered=True)
+  path = os.path.join(tmp, "art_tiered")
+  serve_export(path, plan_t, rule_t, state_t, quantize="f32",
+               store=store_t)
+  single = ServeEngine(ActsModel(), plan_t,
+                       serve_load(path, plan_t, mesh=mesh_t), mesh=mesh_t,
+                       tier_config=ServeTierConfig(cache_fraction=0.25,
+                                                   staging_grps=128))
+  _, _, _, _, router, _ = build_fleet(path, plan_t, mesh_t, 2)
+  ok = True
+  for _ in range(2):
+    numerical, ids = mkreq(rng_t, cfg, cfg["req_rows"])
+    ok &= np.array_equal(single.predict(numerical, ids),
+                         router.predict(numerical, ids))
+  oks["tiered_f32"] = bool(ok)
+  router.close()
+  result["exact"] = oks
+  print("exactness vs single-process: "
+        + "  ".join(f"{k}={'OK' if v else 'FAIL'}"
+                    for k, v in oks.items()))
+  return all(oks.values())
+
+
+def sweep_fleet_sizes(cfg, tmp, result):
+  """p50/p99/p99.9 vs offered QPS across fleet sizes, telemetry rolled
+  up through the registry merge."""
+  plan, rule, mesh, state, _store, rng = build(cfg)
+  path = os.path.join(tmp, "art_sweep")
+  serve_export(path, plan, rule, state, quantize="int8")
+  reqs = [mkreq(rng, cfg, cfg["req_rows"]) for _ in range(32)]
+  ok = True
+  rows = []
+  print(f"latency vs offered QPS (req={cfg['req_rows']} rows, "
+        "Poisson arrivals):")
+  for n_owners in cfg["fleets"]:
+    _, owners, oregs, transport, router, rreg = build_fleet(
+        path, plan, mesh, n_owners)
+    mb = MicroBatcher(router.dispatch, max_batch=cfg["max_batch"],
+                      max_delay_s=0.002)
+    mb.submit(*reqs[0]).result(timeout=300)  # compile off the clock
+    # closed loop: saturation estimate
+    t0 = time.perf_counter()
+    n_sat = 40
+    futs = [mb.submit(*reqs[i % len(reqs)]) for i in range(n_sat)]
+    for f in futs:
+      f.result(timeout=300)
+    sat_qps = n_sat / (time.perf_counter() - t0)
+    per_fleet = {"owners": n_owners, "sat_qps": sat_qps, "points": []}
+    for frac in (0.5, 0.8):
+      qps = max(1.0, sat_qps * frac)
+      lats, rejected, _ = open_loop(mb, reqs, qps, cfg["n_requests"],
+                                    rng)
+      p50, p99, p999 = pcts(lats)
+      ok &= bool(np.isfinite([p50, p99, p999]).all() and p99 >= p50 > 0)
+      per_fleet["points"].append(
+          {"frac": frac, "qps": qps, "p50": p50, "p99": p99,
+           "p999": p999, "rejected": rejected})
+      print(f"  owners={n_owners}  offered {frac:.0%} ({qps:7.1f} req/s)"
+            f"  p50 {p50 * 1e3:7.1f}  p99 {p99 * 1e3:7.1f}  "
+            f"p99.9 {p999 * 1e3:7.1f} ms  rejected {rejected}")
+    mb.close()
+    # the fleet roll-up: merged counters equal the members' sums
+    fleet = rollup(rreg, oregs)
+    want = sum(r.counter("fleet/owner/gathers").value
+               for r in oregs.values())
+    merged = fleet.counter("fleet/owner/gathers").value
+    ok &= merged == want
+    per_fleet["rollup_gathers"] = merged
+    per_fleet["router_rpcs"] = rreg.counter("fleet/rpcs").value
+    print(f"  owners={n_owners}  roll-up: fleet/owner/gathers {merged} "
+          f"(= sum of members: {'OK' if merged == want else 'FAIL'}), "
+          f"router rpcs {per_fleet['router_rpcs']}")
+    router.close()
+    rows.append(per_fleet)
+  result["sweep"] = rows
+  return ok
+
+
+def check_failover_under_load(cfg, tmp, result):
+  """Kill one owner of a fully replicated fleet mid-load: zero wrong
+  answers, zero failed requests, counted failover."""
+  plan, rule, mesh, state, _store, rng = build(cfg)
+  path = os.path.join(tmp, "art_failover")
+  serve_export(path, plan, rule, state, quantize="f32")
+  single = ServeEngine(ActsModel(), plan,
+                       serve_load(path, plan, mesh=mesh), mesh=mesh)
+  reqs = [mkreq(rng, cfg, cfg["req_rows"]) for _ in range(8)]
+  wants = [np.asarray(single.predict(*r)) for r in reqs]
+  cfg_f = FleetConfig(cache_fraction=0.05, staging_grps=256,
+                      shard_min_phys_rows=16, revive_after_s=3600.0)
+  _, owners, oregs, transport, router, rreg = build_fleet(
+      path, plan, mesh, 2, replicas=2, config=cfg_f)
+  mb = MicroBatcher(router.dispatch, max_batch=cfg["max_batch"],
+                    max_delay_s=0.002)
+  mb.submit(*reqs[0]).result(timeout=300)  # compile off the clock
+  n = max(60, cfg["n_requests"] // 3)
+  killer = threading.Timer(0.2, transport.kill, args=(0,))
+  killer.start()
+  lats, rejected, out = open_loop(mb, reqs, qps=200.0, n_requests=n,
+                                  rng=rng)
+  killer.join()
+  mb.close()
+  wrong = sum(0 if np.array_equal(res, wants[ri]) else 1
+              for ri, res in out)
+  failovers = rreg.counter("fleet/failovers").value
+  result["failover"] = {"requests": n, "wrong": wrong,
+                        "failed": n - len(out) - rejected,
+                        "rejected": rejected, "failovers": failovers}
+  ok = wrong == 0 and len(out) + rejected == n and failovers >= 1
+  print(f"failover under load: {n} requests, wrong={wrong}, "
+        f"rejected={rejected}, failovers={failovers} "
+        f"{'OK' if ok else 'FAIL'}")
+  router.close()
+  return ok
+
+
+def main(cfg, tag):
+  tmp = tempfile.mkdtemp(prefix="fleet_bench_")
+  result = {"config": {k: v for k, v in cfg.items()}}
+  try:
+    ok = check_exactness(cfg, tmp, result)
+    ok = sweep_fleet_sizes(cfg, tmp, result) and ok
+    ok = check_failover_under_load(cfg, tmp, result) and ok
+  finally:
+    shutil.rmtree(tmp, ignore_errors=True)
+  result["ok"] = bool(ok)
+  result["config"]["fleets"] = list(cfg["fleets"])
+  return telemetry.emit_verdict(tag, result)
+
+
+if __name__ == "__main__":
+  ap = argparse.ArgumentParser()
+  ap.add_argument("--smoke", action="store_true",
+                  help="tiny-world smoke tier (wired into make verify)")
+  args = ap.parse_args()
+  if args.smoke:
+    raise SystemExit(main(SMOKE, "fleet-smoke"))
+  raise SystemExit(main(BENCH, "fleet-bench"))
