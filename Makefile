@@ -8,7 +8,7 @@ SHELL := /bin/bash  # verify uses pipefail/PIPESTATUS
 .PHONY: test test-fast verify lint native bench dryrun chaos chaos-kill \
 	chaos-stream stream-smoke serve-bench serve-smoke vocab-bench \
 	vocab-smoke obs-bench obs-smoke fresh-bench fresh-smoke \
-	fleet-bench fleet-smoke clean
+	fleet-bench fleet-smoke trace-bench trace-smoke clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -113,11 +113,31 @@ fleet-smoke:
 	PYTHONPATH=$(CURDIR):$$PYTHONPATH timeout -k 10 300 \
 	  $(PY) tools/profile_fleet.py --smoke
 
+# distributed-tracing budget: tracing-enabled fleet serve overhead
+# <= 3% vs disabled (the PR 10 budget on the fleet path), ONE merged
+# Chrome trace from a world-2 multi-process fleet run (router + 2 owner
+# processes + device track; clock-offset handshake, rpc-contains-gather
+# nesting after correction), and a chaos-injected failover producing a
+# flight-recorder bundle whose slowest request's critical path names
+# the rpc stage (tools/profile_trace.py; budgets in docs/BENCHMARKS.md
+# r18). DE_TPU_KEEP_TRACE=<dir> keeps the merged trace.json.
+trace-bench:
+	PYTHONPATH=$(CURDIR):$$PYTHONPATH $(PY) tools/profile_trace.py
+
+# the make-verify tier of the trace bench: tiny world, same structural
+# assertions (merged tracks, nesting, flight bundle), overhead only
+# required finite — timeout-guarded like the other smoke tiers (the
+# longer budget covers the two real owner-process spawns, like
+# stream-smoke's worker subprocesses)
+trace-smoke:
+	PYTHONPATH=$(CURDIR):$$PYTHONPATH timeout -k 10 480 \
+	  $(PY) tools/profile_trace.py --smoke
+
 # the tier-1 gate, exactly as ROADMAP.md specifies it (CPU mesh, no slow
 # tests, collection errors surfaced but not fatal to the log); lint runs
 # first so invariant violations fail fast, then the smoke tiers
 verify: lint serve-smoke vocab-smoke obs-smoke fresh-smoke stream-smoke \
-	fleet-smoke
+	fleet-smoke trace-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

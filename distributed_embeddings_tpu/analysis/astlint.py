@@ -57,6 +57,13 @@ GL114       error      train-only surfaces (the GL111 list) are
                        unreachable from ``fleet/`` modules — the fleet
                        tier is the serving engine spread over processes,
                        same inference-only contract at fleet scope
+GL115       error      trace ids / clock epochs are minted only inside
+                       ``telemetry/``: raw ``uuid.*`` / ``secrets`` /
+                       ``os.urandom`` / ``time.time_ns`` minting in the
+                       request/delta-path packages (``serving/``,
+                       ``fleet/``, ``streaming/``) is flagged — ids
+                       minted elsewhere never land on one trace, and a
+                       second clock-epoch source cannot be correlated
 ==========  =========  =====================================================
 
 Trace-reachable scope (GL101/GL102) is structural: any function nested —
@@ -720,6 +727,91 @@ def _check_raw_timing(mod: ParsedModule) -> List[Finding]:
           "(or telemetry.timed(...) for histogram aggregation) so it "
           "lands on the shared trace and registry; suppress with the "
           "reason stated if this is deadline arithmetic, not timing."))
+  return out
+
+
+# id/epoch mints GL115 guards: uuid (any version), the secrets module,
+# raw urandom, and wall-epoch reads in ns (perf_counter/monotonic are
+# GL113's; time_ns is the remaining epoch-mint spelling)
+_MINT_UUID = frozenset({"uuid1", "uuid3", "uuid4", "uuid5"})
+_MINT_SECRETS = frozenset({"token_hex", "token_bytes", "token_urlsafe"})
+_MINT_EPOCH = frozenset({"time_ns"})
+_GL115_PKGS = ("serving", "fleet", "streaming")
+
+
+@_rule("GL115", "error",
+       "trace ids / clock epochs are minted only inside telemetry/")
+def _check_raw_minting(mod: ParsedModule) -> List[Finding]:
+  # The distributed-tracing contract: every id that might need to be
+  # followed across a process boundary (trace ids, span ids,
+  # subscriber ids) comes from telemetry.trace.mint_id/mint_context,
+  # and every clock-epoch exchange rides
+  # telemetry.estimate_clock_offset — so one merge pass can assemble
+  # the fleet's buffers into one timeline. A raw uuid/urandom mint in
+  # the request/delta-path packages creates an id namespace the trace
+  # layer has never heard of; a raw time_ns epoch read there is a
+  # second clock domain nothing can correlate. Scope: library modules
+  # of serving/, fleet/, streaming/ only — trainers, tools, and tests
+  # mint freely (nothing follows their ids across processes).
+  norm = mod.path.replace(os.sep, "/")
+  if "distributed_embeddings_tpu/" not in norm:
+    return []
+  if not any(f"/{pkg}/" in norm for pkg in _GL115_PKGS):
+    return []
+  # track BOTH import spellings so neither is a bypass: `from uuid
+  # import uuid4 [as u4]` / `from time import time_ns`, and module
+  # aliases `import uuid as u; u.uuid4()`
+  from_names: Dict[str, str] = {}
+  mod_alias = {"uuid": {"uuid"}, "secrets": {"secrets"},
+               "os": {"os"}, "time": {"time"}}
+  for node in ast.walk(mod.tree):
+    if isinstance(node, ast.Import):
+      for a in node.names:
+        if a.name in mod_alias:
+          mod_alias[a.name].add(a.asname or a.name)
+    elif isinstance(node, ast.ImportFrom):
+      if node.module == "uuid":
+        for a in node.names:
+          if a.name in _MINT_UUID:
+            from_names[a.asname or a.name] = f"uuid.{a.name}"
+      elif node.module == "secrets":
+        for a in node.names:
+          if a.name in _MINT_SECRETS:
+            from_names[a.asname or a.name] = f"secrets.{a.name}"
+      elif node.module == "time":
+        for a in node.names:
+          if a.name in _MINT_EPOCH:
+            from_names[a.asname or a.name] = f"time.{a.name}"
+      elif node.module == "os":
+        for a in node.names:
+          if a.name == "urandom":
+            from_names[a.asname or a.name] = "os.urandom"
+  out = []
+  for node in ast.walk(mod.tree):
+    if not isinstance(node, ast.Call):
+      continue
+    root, name = _call_pair(node)
+    minted = None
+    if root in mod_alias["uuid"] and name in _MINT_UUID:
+      minted = f"uuid.{name}"
+    elif root in mod_alias["secrets"] and name in _MINT_SECRETS:
+      minted = f"secrets.{name}"
+    elif root in mod_alias["os"] and name == "urandom":
+      minted = "os.urandom"
+    elif root in mod_alias["time"] and name in _MINT_EPOCH:
+      minted = f"time.{name}"
+    elif root is None and isinstance(node.func, ast.Name) \
+        and node.func.id in from_names:
+      minted = from_names[node.func.id]
+    if minted is not None:
+      out.append(mod.finding(
+          "GL115", node,
+          f"raw {minted}() in a request/delta-path module: trace ids "
+          "and clock epochs are minted only inside telemetry/ — use "
+          "telemetry.trace.mint_id()/mint_context() for ids and "
+          "telemetry.estimate_clock_offset(...) for clock handshakes, "
+          "so ids land on one trace and clock domains stay "
+          "correlated."))
   return out
 
 

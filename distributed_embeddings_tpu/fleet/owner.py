@@ -22,6 +22,18 @@ The RPC surface (``rpc_*`` methods, reachable through either
 - ``ranking``: the rank's export-time priority order (seeds the
   router's hot-shard replica cache).
 - ``ping``: liveness + served watermark.
+- ``clock``: this process's span clock (``telemetry.trace.clock_ns``) —
+  one leg of the router's clock-offset handshake, so the owner's span
+  buffer can be mapped onto the router's timeline with bounded
+  uncertainty.
+- ``trace``: the owner's Chrome span buffer (when tracing is enabled in
+  this process), collected by the router/tooling for the merged fleet
+  timeline.
+
+Gathers run under a ``fleet/owner/gather`` span that ADOPTS the trace
+context the transport carried — the router's rpc span's child, which is
+what lets a merged trace show one request's fan-out nested correctly
+across process tracks.
 
 Online freshness: :class:`~.stream.FleetDeltaFollower` binds an owner
 to a publish directory — validated deltas scatter into the owned
@@ -41,7 +53,8 @@ from ..checkpoint import _plan_fingerprint
 from ..layers.planner import DistEmbeddingStrategy
 from ..ops.packed_table import host_gather_rows
 from ..serving.export import load as serve_load
-from ..telemetry import get_registry as _registry
+from ..telemetry import get_registry as _registry, span as _span
+from ..telemetry import trace as _trace
 
 
 class FleetOwner:
@@ -86,6 +99,18 @@ class FleetOwner:
   def rpc_ping(self) -> Dict[str, Any]:
     return {"ok": 1, "owner_id": self.owner_id, "step": int(self.step)}
 
+  def rpc_clock(self) -> Dict[str, Any]:
+    """One leg of the clock-offset handshake
+    (``telemetry.estimate_clock_offset`` drives the rounds)."""
+    return {"t_ns": _trace.clock_ns(), "owner_id": self.owner_id}
+
+  def rpc_trace(self) -> Dict[str, Any]:
+    """This process's span buffer as a Chrome trace dict (None when
+    tracing is disabled here) — the merged-timeline collection hook."""
+    tr = _trace.current_tracer()
+    return {"trace": None if tr is None else tr.to_chrome(),
+            "owner_id": self.owner_id}
+
   def rpc_gather(self, name: str, rank: int,
                  grps: np.ndarray) -> Dict[str, Any]:
     """Serve-layout physical rows ``grps`` of one owned rank, in the
@@ -96,9 +121,13 @@ class FleetOwner:
                        f"{sorted(self.meta)}")
     rank = int(rank)
     grps = np.asarray(grps, np.int64)
-    with self.lock:
-      block = self.artifact.rank_block(name, rank)  # refuses un-owned
-      rows = host_gather_rows(m.packed, block, grps)
+    # adopts the transport-carried context: the router rpc span's child
+    with _span("fleet/owner/gather",
+               args={"owner": self.owner_id, "class": name,
+                     "rank": rank, "rows": int(grps.size)}):
+      with self.lock:
+        block = self.artifact.rank_block(name, rank)  # refuses un-owned
+        rows = host_gather_rows(m.packed, block, grps)
     self._counters["gathers"].inc()
     self._counters["rows"].inc(int(grps.size))
     self._counters["bytes"].inc(int(rows.nbytes))

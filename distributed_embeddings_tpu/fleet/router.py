@@ -45,6 +45,8 @@ from ..serving.engine import ServeEngine, ServeTierConfig, ServeTierPlan
 from ..serving.export import ServeClassMeta, np_dtype_of
 from ..serving.export import load as serve_load
 from ..telemetry import get_registry as _registry, span as _span
+from ..telemetry import flight as _flight
+from ..telemetry import trace as _trace
 from ..tiering.prefetch import TieredPrefetcher
 from ..training import shard_batch
 from .plan import FleetPlan
@@ -289,10 +291,18 @@ class FleetStore:
   def _call(self, owner: int, method: str, **kwargs) -> Dict[str, Any]:
     """One owner RPC, retried per the policy (transient ``OSError``
     only — a :class:`~.transport.RemoteRefusal` propagates: a replica
-    would refuse the same request identically)."""
+    would refuse the same request identically).  Each ATTEMPT runs
+    under its own ``fleet/rpc`` span — a retried rpc shows as two
+    spans, and the owner-side gather span is the attempt span's child
+    (the span installs itself as the thread's current context; the
+    transport carries it across the wire)."""
     def attempt():
-      faultinject.fire("fleet_rpc", owner=owner, method=method)
-      return self.transport.call(owner, method, **kwargs)
+      # the fire lives INSIDE the span so a chaos-injected failure is
+      # still an attempt on the timeline (the one-span-per-attempt
+      # contract above holds for injected faults too)
+      with _span("fleet/rpc", args={"owner": owner, "method": method}):
+        faultinject.fire("fleet_rpc", owner=owner, method=method)
+        return self.transport.call(owner, method, **kwargs)
 
     def count_retry(attempt_i, exc):
       self._counters["rpc_retries"].inc()
@@ -323,8 +333,15 @@ class FleetStore:
         self._mark_dead(owner)
         last = e
         # a move PAST a failed replica is a failover (counted once per
-        # replica abandoned, not per retry attempt)
+        # replica abandoned, not per retry attempt) — and a flight
+        # recorder trip: the bundle captures what the recent requests
+        # were doing when the replica died
         self._counters["failovers"].inc()
+        rec = _flight.current_flight_recorder()
+        if rec is not None:
+          rec.note("failover", owner=owner, rank=for_rank,
+                   error=repr(e))
+        _flight.flight_trip("failover", owner=owner, rank=for_rank)
         continue
       self._mark_alive(owner)
       return out
@@ -359,6 +376,45 @@ class FleetStore:
     out = self._failover_call(rank, "ranking", name=name, rank=rank)
     return np.asarray(out["order"], np.int32)
 
+  def _fetch_under(self, ctx, rec, name: str, rank: int,
+                   grps: np.ndarray) -> np.ndarray:
+    """Pool-thread fetch body: re-installs the dispatching thread's
+    trace context AND flight record (thread-locals do not cross the
+    executor), so the per-owner rpc spans — and the owner-side gather
+    spans they parent — stay on the request's trace, and a failover
+    fired here lands its note on the request's flight record."""
+    fr = _flight.current_flight_recorder()
+    if fr is not None and rec is not None:
+      fr.bind(rec)
+    try:
+      with _trace.use_context(ctx):
+        return self._fetch(name, rank, grps)
+    finally:
+      if fr is not None and rec is not None:
+        fr.bind(None)
+
+  def clock_offsets(self, rounds: int = 8) -> Dict[int, Any]:
+    """Handshake every owner's clock: ``{owner_id: ClockOffset}`` via
+    the ``clock`` RPC (offset + bounded uncertainty, the merge's input).
+    The estimation itself lives in telemetry (GL115's one sanctioned
+    handshake mint) — this only supplies the channel, through the same
+    retried ``_call`` every other owner RPC rides (``fleet_rpc`` fault
+    site, transient OSErrors absorbed; retries inflate that round's
+    RTT, which the min-RTT selection then discards)."""
+    out = {}
+    for owner_id in self.transport.owner_ids():
+      out[owner_id] = _trace.estimate_clock_offset(
+          lambda o=owner_id: self._call(o, "clock")["t_ns"],
+          rounds=rounds)
+    return out
+
+  def collect_traces(self) -> Dict[int, Optional[Dict[str, Any]]]:
+    """Every owner's Chrome span buffer (None where tracing is off) —
+    the merged-timeline collection pass (retried like every owner
+    RPC)."""
+    return {o: self._call(o, "trace")["trace"]
+            for o in self.transport.owner_ids()}
+
   def prefetch(self, cold: Dict[str, List[np.ndarray]]) -> None:
     """Fan the per-(class, rank) remote gathers out concurrently; the
     prefetcher's sequential ``stage`` then consumes the buffered rows.
@@ -369,13 +425,18 @@ class FleetStore:
       self._pool = ThreadPoolExecutor(
           max_workers=max(1, self.config.fanout_threads),
           thread_name_prefix="fleet-gather")
-    with _span("fleet/fanout"):
+    fr = _flight.current_flight_recorder()
+    rec = fr.current() if fr is not None else None
+    with _span("fleet/fanout"), \
+        _flight.stage("rpc", registry=self.telemetry):
+      ctx = _trace.get_current_context()  # the fanout span's own ctx
       futs = {}
       for name, per_rank in cold.items():
         for rank, grps in enumerate(per_rank):
           if np.asarray(grps).size:
             futs[(name, rank)] = (grps, self._pool.submit(
-                self._fetch, name, rank, np.asarray(grps, np.int64)))
+                self._fetch_under, ctx, rec, name, rank,
+                np.asarray(grps, np.int64)))
       for key, (grps, fut) in futs.items():
         try:
           self._prefetched[key] = (np.asarray(grps), fut.result())
@@ -537,14 +598,17 @@ class FleetRouter(ServeEngine):
         # every class replicated locally: the plain all-device step
         step = self._step_for((numerical, cats))
         bt = shard_batch((numerical, cats), self.mesh, self.axis_name)
-        return step(self.state, *bt)
+        with _flight.stage("combine", registry=self.telemetry):
+          return step(self.state, *bt)
       with _span("fleet/route"):
         cold = self.prefetcher.classify(list(cats))
       self.store.prefetch(cold)
-      staged = self.prefetcher.stage(cold)
+      with _flight.stage("gather", registry=self.telemetry):
+        staged = self.prefetcher.stage(cold)
       step = self._step_for((numerical, cats), staged.s_eff)
       bt = shard_batch((numerical, cats), self.mesh, self.axis_name)
-      return step(self.state, staged.device, *bt)
+      with _flight.stage("combine", registry=self.telemetry):
+        return step(self.state, staged.device, *bt)
 
   # ---- delta application (FleetDeltaFollower's member surface) ------------
   def apply_delta_rows(self, name: str, rank: int, idx: np.ndarray,

@@ -53,6 +53,10 @@ from ..streaming.publish import (
 )
 from ..streaming.subscribe import _fp_and_manifest, poll_phase
 from ..telemetry import get_registry as _registry, span as _span
+from ..telemetry import clear_promote as _clear_promote
+from ..telemetry import record_promote as _record_promote
+from ..telemetry import flight as _flight
+from ..telemetry import trace as _trace
 
 
 class FleetDeltaFollower:
@@ -80,9 +84,9 @@ class FleetDeltaFollower:
     self.telemetry = telemetry if telemetry is not None else _registry()
     self.retry_policy = retry_policy
     if subscriber_id is None:
-      import uuid
       kind = type(member).__name__.lower()
-      subscriber_id = f"fleet-{kind}-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+      # id minted through telemetry (GL115): one mint, one id namespace
+      subscriber_id = f"fleet-{kind}-{os.getpid()}-{_trace.mint_id(4)}"
     self.subscriber_id = subscriber_id
     self.poll_phase_s = poll_phase(subscriber_id, float(poll_jitter_s))
     fp, bman = self._retried(_fp_and_manifest,
@@ -113,6 +117,11 @@ class FleetDeltaFollower:
     self._stop.set()
     if self._thread is not None:
       self._thread.join(timeout=10.0)
+    # leave the /healthz quorum: a decommissioned member's promote
+    # gauges (keyed AND unkeyed last-writer pair) must not read as a
+    # stalled sibling forever — a stalled member never reaches here,
+    # so it stays visible
+    _clear_promote(self.telemetry, self.subscriber_id)
 
   def _poll_loop(self) -> None:
     if self.poll_phase_s:
@@ -128,6 +137,10 @@ class FleetDeltaFollower:
   def _refuse(self, seq: int, field: str, reason: str) -> None:
     self.last_refusal = {"seq": seq, "field": field, "reason": reason}
     self.telemetry.counter("fleet/deltas_refused").inc()
+    # a refused delta is a flight-recorder moment: the bundle shows what
+    # the member was serving when freshness stalled
+    _flight.flight_trip("refusal", seq=seq, field=field,
+                        member=self.subscriber_id)
 
   def poll_once(self) -> int:
     """Apply every ready delta in seq order; returns how many applied.
@@ -196,7 +209,10 @@ class FleetDeltaFollower:
     meta = {n: ServeClassMeta.from_json(n, d)
             for n, d in manifest["serve"]["classes"].items()}
     world = self.plan.world_size
-    with _span("fleet/fold", args={"seq": seq}):
+    # promotions mint their own trace context: a fold's validate/apply
+    # spans share one trace id, mergeable across the fleet's members
+    with _trace.use_context(_trace.mint_context()), \
+        _span("fleet/fold", args={"seq": seq}):
       # --- phase 1: validate and load everything, touching nothing ---
       staged = []  # (name, rank, idx, data)
       for name, per_rank in manifest["stream"]["rows"].items():
@@ -275,4 +291,8 @@ class FleetDeltaFollower:
     reg.counter("fleet/deltas_applied").inc()
     reg.counter("fleet/rows_applied").inc(rows_applied)
     reg.gauge(f"fleet/applied_seq/{self.subscriber_id}").set(seq)
+    # readiness detail the /healthz probe reports: the served train
+    # watermark and when this member last promoted (unkeyed + keyed
+    # pairs; one helper spells the gauge names for every member kind)
+    _record_promote(reg, int(manifest["step"]), self.subscriber_id)
     return True

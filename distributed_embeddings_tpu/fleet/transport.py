@@ -28,6 +28,15 @@ Error taxonomy (what the retry/failover stack keys on):
 Every RPC attempt fires the ``fleet_rpc`` fault site (the streaming
 ``stream_read`` discipline applied to the fleet), so chaos can inject
 transient failures between the router and any owner.
+
+Trace propagation: when the calling thread carries a
+``telemetry.TraceContext`` (the router's rpc span installs one), the
+socket transport serializes it as a reserved ``_trace`` header field
+and the owner-side handler re-installs it around the RPC body — so an
+owner's gather span is the router's rpc span's CHILD even across
+processes, and a merged timeline shows one request end to end.  The
+in-proc transport needs no wire form: caller and owner share a thread,
+so the thread-local context flows by construction.
 """
 
 from __future__ import annotations
@@ -43,6 +52,11 @@ from typing import Any, Dict, Tuple
 import numpy as np
 
 from ..resilience import faultinject
+from ..telemetry import trace as _trace
+
+# reserved header field carrying the TraceContext wire form (never a
+# user kwarg: rpc_* methods must not see it)
+TRACE_FIELD = "_trace"
 
 # fired per RPC attempt, client side, inside the retry loop — fail_first
 # simulates a flaky network the retry layer must absorb
@@ -199,11 +213,15 @@ class _OwnerHandler(socketserver.BaseRequestHandler):
           return
         msg = decode_message(raw)
         method = msg.pop("method")
+        wire_ctx = msg.pop(TRACE_FIELD, None)
+        ctx = _trace.TraceContext.from_wire(wire_ctx) \
+            if wire_ctx is not None else None
         fn = getattr(owner, "rpc_" + method, None)
         try:
           if fn is None:
             raise AttributeError(f"no RPC {method!r}")
-          reply = fn(**msg)
+          with _trace.use_context(ctx):
+            reply = fn(**msg)
         except Exception as e:  # noqa: BLE001 — serialized to the peer
           reply = {"error": {"type": type(e).__name__, "msg": str(e)}}
         _send_frame(self.request, encode_message(reply))
@@ -327,9 +345,13 @@ class SocketTransport:
   def call(self, owner_id: int, method: str, **kwargs) -> Dict[str, Any]:
     if owner_id not in self._addresses:
       raise ConnectionError(f"fleet owner {owner_id} has no address")
+    msg = dict(kwargs, method=method)
+    ctx = _trace.get_current_context()
+    if ctx is not None:
+      msg[TRACE_FIELD] = ctx.to_wire()
     sock = self._acquire(owner_id)
     try:
-      _send_frame(sock, encode_message(dict(kwargs, method=method)))
+      _send_frame(sock, encode_message(msg))
       reply = decode_message(_recv_frame(sock))
     except OSError:
       # torn mid-call: this connection is unusable — drop it

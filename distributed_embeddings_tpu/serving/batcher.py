@@ -48,6 +48,8 @@ import numpy as np
 
 from ..parallel.lookup_engine import PAD_ID
 from ..telemetry import MetricsRegistry, span as _span
+from ..telemetry import flight as _flight
+from ..telemetry import trace as _trace
 
 
 REJECT_REASONS = ("queue_full", "deadline_expired", "priority_shed")
@@ -115,16 +117,17 @@ class ServeFuture:
 
 class _Pending:
   __slots__ = ("numerical", "cats", "future", "priority", "deadline_s",
-               "seq")
+               "seq", "trace_id")
 
   def __init__(self, numerical, cats, future, priority=0,
-               deadline_s=None, seq=0):
+               deadline_s=None, seq=0, trace_id=None):
     self.numerical = numerical
     self.cats = cats
     self.future = future
     self.priority = priority
     self.deadline_s = deadline_s  # absolute monotonic stamp, or None
     self.seq = seq
+    self.trace_id = trace_id  # minted at admission when tracing is on
 
   def expired(self, now: float) -> bool:
     return self.deadline_s is not None and now >= self.deadline_s
@@ -215,9 +218,14 @@ class MicroBatcher:
   def _reject(self, reason: str, msg: str) -> Rejected:
     """Count one shed (total + per-reason) and build the exception —
     the load-shed accounting contract: every shed is exactly one total
-    count and exactly one reason count."""
+    count and exactly one reason count.  A shed also trips the flight
+    recorder (no-op when none is installed): overload is exactly the
+    moment the last-N-requests bundle is worth having.  ``defer=True``
+    because this runs under the batcher's one lock — the bundle's
+    write+fsync must not stall every submitter at peak overload."""
     self._counters["rejected"].inc()
     self._counters[f"rejected/{reason}"].inc()
+    _flight.flight_trip(f"shed/{reason}", defer=True)
     return Rejected(msg, reason=reason)
 
   def _evict_for_locked(self, n: int, priority: int) -> None:
@@ -293,9 +301,18 @@ class MicroBatcher:
       if deadline_s is not None:
         # absolute stamp on the flush clock (deadline arithmetic)
         deadline = fut.t_submit + float(deadline_s)
+      # ADMISSION is where a request's trace identity is minted: the id
+      # rides the dispatch context over the fleet wire, so every
+      # process track a dispatch touches carries this request's id.
+      # Minted only when tracing or the flight recorder is active — the
+      # disabled path allocates nothing extra.
+      trace_id = _trace.mint_id(8) \
+          if (_trace.current_tracer() is not None
+              or _flight.current_flight_recorder() is not None) else None
       self._pending.append(_Pending(numerical, cats, fut,
                                     priority=int(priority),
-                                    deadline_s=deadline, seq=self._seq))
+                                    deadline_s=deadline, seq=self._seq,
+                                    trace_id=trace_id))
       self._pending_rows += n
       self._nonempty.notify()
     return fut
@@ -407,36 +424,77 @@ class MicroBatcher:
 
   def _dispatch(self, taken: List[_Pending], inline: bool = False):
     dispatch_fn = self.dispatch_fn  # one binding per flush (see setter)
+    # the dispatch context: primary id = the first packed request's,
+    # trace_ids = every coalesced request's — each request's id appears
+    # on every process track the fan-out touches
+    tids = [p.trace_id for p in taken if p.trace_id is not None]
+    ctx = _trace.mint_context(tids) if tids else None
+    fr = _flight.current_flight_recorder()
+    rec = None
+    if fr is not None and ctx is not None:
+      rec = fr.begin(ctx.trace_id, ctx.trace_ids)
+      fr.bind(rec)
+    # queue stage: how long the oldest coalesced request waited for
+    # this flush (latency stamps on the submit clock, not timing)
+    now = time.monotonic()  # graftlint: disable=GL113 (latency stamp)
+    _flight.observe_stage(
+        "queue", max(0.0, now - min(p.future.t_submit for p in taken)),
+        registry=self.telemetry)
     try:
-      numerical, cats = self._pad_batch(taken)
-      with _span("serve/dispatch"):
-        out = dispatch_fn(numerical, cats)
+      with _trace.use_context(ctx):
+        with _flight.stage("pack", registry=self.telemetry):
+          numerical, cats = self._pad_batch(taken)
+        with _span("serve/dispatch",
+                   args={"requests": len(taken)}):
+          out = dispatch_fn(numerical, cats)
       self._counters["batches"].inc()
     except BaseException as e:  # noqa: BLE001 — delivered per request
       for p in taken:
         p.future._fail(e)
+      if rec is not None:
+        fr.bind(None)
+        fr.end(rec, error=e)
       if inline:
         raise
       return
+    if fr is not None:
+      fr.bind(None)
+    # fr rides the item: completion must end the record against the
+    # recorder that BEGAN it — re-resolving the global there would leak
+    # the record (and wedge pending trips) across a recorder swap
     if inline:
-      return (taken, out)
-    self._inflight.put((taken, out))
+      return (taken, out, rec, ctx, fr)
+    self._inflight.put((taken, out, rec, ctx, fr))
     return None
 
-  def _complete(self, taken: List[_Pending], out: Any) -> None:
-    with _span("serve/complete", args={"requests": len(taken)}):
-      try:
-        preds = np.asarray(out)  # materializes the async device result
-      except BaseException as e:  # noqa: BLE001
+  def _complete(self, taken: List[_Pending], out: Any, rec=None,
+                ctx=None, fr=None) -> None:
+    if fr is not None and rec is not None:
+      fr.bind(rec)  # the drain happens HERE, on the completer thread
+    try:
+      with _trace.use_context(ctx), \
+          _span("serve/complete", args={"requests": len(taken)}):
+        try:
+          with _flight.stage("dequant", registry=self.telemetry):
+            preds = np.asarray(out)  # materializes the device result
+        except BaseException as e:  # noqa: BLE001
+          for p in taken:
+            p.future._fail(e)
+          if fr is not None and rec is not None:
+            fr.end(rec, error=e)
+            rec = None
+          return
+        off = 0
         for p in taken:
-          p.future._fail(e)
-        return
-      off = 0
-      for p in taken:
-        p.future._fulfill(preds[off:off + p.future.n])
-        off += p.future.n
-        self._counters["completed"].inc()
-        self._latency.observe(p.future.latency_s)
+          p.future._fulfill(preds[off:off + p.future.n])
+          off += p.future.n
+          self._counters["completed"].inc()
+          self._latency.observe(p.future.latency_s)
+      if fr is not None and rec is not None:
+        fr.end(rec)
+    finally:
+      if fr is not None:
+        fr.bind(None)
 
   def _complete_loop(self) -> None:
     while True:

@@ -44,6 +44,8 @@ from jax.sharding import PartitionSpec as P
 from ..compat import shard_map
 from ..layers.dist_model_parallel import hybrid_partition_specs
 from ..layers.planner import DistEmbeddingStrategy
+from ..telemetry import flight as _flight
+from ..telemetry import get_registry as _get_registry
 from ..ops.packed_table import PackedLayout, gather_fused_chunked
 from ..parallel.lookup_engine import (
     DedupRouted,
@@ -417,7 +419,8 @@ class ServeEngine:
                artifact, mesh=None, axis_name: str = "mp",
                tier_config: Optional[ServeTierConfig] = None,
                with_metrics: bool = False,
-               donate_batch: bool = False):
+               donate_batch: bool = False,
+               telemetry=None):
     if isinstance(artifact, FrozenTables):
       state = frozen_device_state(artifact, plan, mesh, axis_name)
       host_images, ranking = artifact.host_images, artifact.ranking
@@ -442,6 +445,12 @@ class ServeEngine:
     self.step = int(getattr(artifact, "step", 0))
     self.with_metrics = with_metrics
     self.donate_batch = donate_batch
+    # where this engine's gather/combine stage observations land when
+    # no flight recorder is installed — threaded through like
+    # FleetRouter's, so one registry can hold the WHOLE serve/stage_s
+    # taxonomy (wire the batcher's registry here for that)
+    self.telemetry = telemetry if telemetry is not None \
+        else _get_registry()
     self._steps: Dict[Any, Any] = {}
     # The promote point (streaming deltas): dispatch holds this lock for
     # the brief host-side dispatch window, and a DeltaSubscriber holds
@@ -501,13 +510,21 @@ class ServeEngine:
     with self.lock:
       cats = tuple(np.asarray(c) for c in cats)
       numerical = np.asarray(numerical)
-      staged = self.prefetcher.prepare(list(cats)) if self.tiered else None
+      if self.tiered:
+        # the serve pipeline's stage taxonomy (flight recorder /
+        # serve/stage_s histograms): classify+stage+upload is `gather`,
+        # the jitted step launch is `combine`
+        with _flight.stage("gather", registry=self.telemetry):
+          staged = self.prefetcher.prepare(list(cats))
+      else:
+        staged = None
       step = self._step_for((numerical, cats),
                             staged.s_eff if staged else None)
       bt = shard_batch((numerical, cats), self.mesh, self.axis_name)
-      if staged is not None:
-        return step(self.state, staged.device, *bt)
-      return step(self.state, *bt)
+      with _flight.stage("combine", registry=self.telemetry):
+        if staged is not None:
+          return step(self.state, staged.device, *bt)
+        return step(self.state, *bt)
 
   def predict(self, numerical, cats):
     """Blocking convenience wrapper: numpy predictions."""

@@ -61,6 +61,10 @@ from ..serving.engine import ServeEngine
 from ..serving.export import ServeClassMeta
 from ..serving.export import load as serve_load
 from ..telemetry import get_registry as _registry, span as _span
+from ..telemetry import clear_promote as _clear_promote
+from ..telemetry import record_promote as _record_promote
+from ..telemetry import flight as _flight
+from ..telemetry import trace as _trace
 from .publish import (
     BASE_DIR,
     DELTA_FORMAT_VERSION,
@@ -144,8 +148,8 @@ class DeltaSubscriber:
     self.telemetry = telemetry if telemetry is not None else _registry()
     self.retry_policy = retry_policy
     if subscriber_id is None:
-      import uuid
-      subscriber_id = f"sub-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+      # id minted through telemetry (GL115): one mint, one id namespace
+      subscriber_id = f"sub-{os.getpid()}-{_trace.mint_id(4)}"
     self.subscriber_id = subscriber_id
     # deterministic anti-stampede phase: this subscriber's polls sit at
     # phase + k * poll_interval_s, so N subscribers on one pubdir spread
@@ -245,7 +249,8 @@ class DeltaSubscriber:
     engine = ServeEngine(model, plan, art, mesh=mesh, axis_name=axis_name,
                          tier_config=tier_config,
                          with_metrics=with_metrics,
-                         donate_batch=donate_batch)
+                         donate_batch=donate_batch,
+                         telemetry=telemetry)
     sub = cls(engine, path, plan, base_fingerprint=fp,
               base_manifest=bman,
               translator=art.vocab, poll_interval_s=poll_interval_s,
@@ -301,6 +306,11 @@ class DeltaSubscriber:
     self._stop.set()
     if self._thread is not None:
       self._thread.join(timeout=10.0)
+    # leave the /healthz quorum: a decommissioned subscriber's promote
+    # gauges (keyed AND unkeyed last-writer pair) must not read as a
+    # stalled sibling forever — a stalled subscriber never reaches
+    # here, so it stays visible
+    _clear_promote(self.telemetry, self.subscriber_id)
 
   def _poll_loop(self) -> None:
     if self.poll_phase_s:
@@ -409,6 +419,10 @@ class DeltaSubscriber:
   def _refuse(self, seq: int, field: str, reason: str) -> bool:
     self.last_refusal = {"seq": seq, "field": field, "reason": reason}
     self.telemetry.counter("stream/deltas_refused").inc()
+    # a refusal degrades serving to staleness — trip the flight
+    # recorder (no-op without one) so the moment is captured
+    _flight.flight_trip("refusal", seq=seq, field=field,
+                        member=self.subscriber_id)
     return False
 
   def _validate_and_apply(self, path: str, seq: int) -> bool:
@@ -590,7 +604,10 @@ class DeltaSubscriber:
     from ..serving.export import _unflatten_paths, place_state
     eng = self.engine
     faultinject.fire("delta_promote", seq=seq)
-    with _span("stream/promote", args={"seq": seq}):
+    # promotions mint their own trace context (telemetry is the one
+    # sanctioned mint — GL115): the promote/fold spans share a trace id
+    with _trace.use_context(_trace.mint_context()), \
+        _span("stream/promote", args={"seq": seq}):
       # --- build everything off the dispatch lock ---
       updates = self._build_device_updates(rows)
       new_images: Dict[str, Dict[int, np.ndarray]] = {}
@@ -653,6 +670,11 @@ class DeltaSubscriber:
     reg.counter("stream/rows_applied").inc(
         sum(idx.size for per in rows.values() for idx, _ in per.values()))
     reg.gauge("stream/applied_seq").set(seq)
+    # readiness detail the /healthz probe reports: served watermark +
+    # last-promote wall time (a stalled subscriber shows as a growing
+    # staleness age from the probe alone; one helper spells the gauge
+    # names for every member kind)
+    _record_promote(reg, int(manifest["step"]), self.subscriber_id)
     oldest = manifest["stream"].get("train_wall_oldest")
     if oldest is not None:
       self.freshness.observe(max(0.0, time.time() - float(oldest)))
@@ -692,7 +714,8 @@ class DeltaSubscriber:
                            axis_name=f["axis_name"],
                            tier_config=f["tier_config"],
                            with_metrics=f["with_metrics"],
-                           donate_batch=f["donate_batch"])
+                           donate_batch=f["donate_batch"],
+                           telemetry=self.telemetry)
       anchor_seq, anchor_fp, root = _chain_anchor(bman, fp)
       old = self.engine
       with old.lock:

@@ -18,9 +18,20 @@ The one observability layer every subsystem emits through (round 14):
   event log, and the normalized tool-verdict emitter, all through the
   durable-write protocol.
 
+Round 18 made the tracing DISTRIBUTED: a :class:`TraceContext` minted
+at batcher admission rides the fleet wire framing (owner gather spans
+become the router rpc span's children across processes), a
+clock-offset handshake (:func:`estimate_clock_offset` — bounded
+uncertainty) lets :func:`merge_traces` assemble every process's buffer
+plus jax.profiler's device trace into ONE timeline, and
+:mod:`.flight`'s :class:`FlightRecorder` keeps the last N request
+traces with per-stage critical paths, dumping a debug bundle whenever
+a failover/refusal/shed fires.
+
 graftlint GL113 makes spans the sanctioned timing form: raw
 ``time.perf_counter``/``time.monotonic`` calls in library modules
-outside this package are lint errors.
+outside this package are lint errors; GL115 pins trace-id/clock-epoch
+minting to this package on the request/delta paths.
 """
 
 from .export import (
@@ -40,39 +51,73 @@ from .registry import (
     get_registry,
     histogram,
 )
-from .http import MetricsServer
+from .http import MetricsServer, clear_promote, record_promote
+from .flight import (
+    FlightRecorder,
+    current_flight_recorder,
+    flight_trip,
+    install_flight_recorder,
+    uninstall_flight_recorder,
+)
 from .trace import (
+    ClockOffset,
+    TraceContext,
     Tracer,
+    attach_device_track,
     current_tracer,
+    estimate_clock_offset,
+    get_current_context,
     install_tracer,
     instant,
+    merge_traces,
+    mint_context,
+    mint_id,
+    set_current_context,
     span,
     tracing,
     uninstall_tracer,
+    use_context,
 )
 
 __all__ = [
+    "ClockOffset",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "JsonlWriter",
     "MetricsRegistry",
     "MetricsServer",
+    "TraceContext",
     "Tracer",
     "atomic_write_text",
+    "attach_device_track",
+    "clear_promote",
     "counter",
+    "current_flight_recorder",
     "current_tracer",
     "emit_verdict",
+    "estimate_clock_offset",
+    "flight_trip",
     "gauge",
+    "get_current_context",
     "get_registry",
     "histogram",
+    "install_flight_recorder",
     "install_tracer",
     "instant",
+    "merge_traces",
+    "mint_context",
+    "mint_id",
+    "record_promote",
     "prometheus_text",
+    "set_current_context",
     "span",
     "timed",
     "tracing",
+    "uninstall_flight_recorder",
     "uninstall_tracer",
+    "use_context",
     "write_prometheus",
 ]
 

@@ -379,6 +379,22 @@ class MetricsRegistry:
     with self._lock:
       return dict(self._metrics)
 
+  def peek(self, name: str):
+    """The metric named ``name``, or None — WITHOUT creating it: a
+    probe-style read (/healthz scans the :meth:`metrics` view for the
+    same reason) must not materialize a gauge that nothing ever set."""
+    with self._lock:
+      return self._metrics.get(name)
+
+  def remove(self, name: str) -> bool:
+    """Drop the metric named ``name``; False if absent. A DELIBERATELY
+    stopped fleet member removes its keyed promote gauges so the
+    /healthz most-stale scan doesn't report a decommissioned member as
+    stalled forever — a genuinely stalled member never calls this, so
+    it stays visible (the heartbeat-quorum rule on the health plane)."""
+    with self._lock:
+      return self._metrics.pop(name, None) is not None
+
   def snapshot(self) -> Dict[str, Any]:
     """Human-facing summary: scalar values, histogram digests."""
     out: Dict[str, Any] = {}

@@ -563,6 +563,89 @@ def deadline():
       ["GL113"]) == []
 
 
+def test_gl115_raw_minting_in_request_path_packages():
+  """Raw uuid/epoch minting in serving/fleet/streaming: ids minted
+  outside telemetry never land on one trace, and a second clock-epoch
+  source cannot be correlated into the merged timeline."""
+  src = """
+import os
+import time
+import uuid
+
+def subscriber_id():
+  return uuid.uuid4().hex[:8]
+
+def epoch():
+  return time.time_ns()
+
+def token():
+  return os.urandom(8).hex()
+"""
+  for path in ("distributed_embeddings_tpu/streaming/subscribe.py",
+               "distributed_embeddings_tpu/fleet/stream.py",
+               "distributed_embeddings_tpu/serving/batcher.py"):
+    out = lint_source(src, path, CTX, ["GL115"])
+    assert _rules(out) == ["GL115", "GL115", "GL115"], path
+    assert "mint_id" in out[0].message
+
+
+def test_gl115_from_import_and_alias_forms():
+  src = """
+from uuid import uuid4 as u4
+from time import time_ns
+
+def mint():
+  return u4().hex, time_ns()
+"""
+  out = lint_source(src, "distributed_embeddings_tpu/fleet/router.py",
+                    CTX, ["GL115"])
+  assert _rules(out) == ["GL115", "GL115"]
+  # a module alias is not a bypass either
+  aliased = """
+import uuid as u
+import time as clk
+
+def mint():
+  return u.uuid4().hex, clk.time_ns()
+"""
+  out = lint_source(aliased,
+                    "distributed_embeddings_tpu/fleet/router.py",
+                    CTX, ["GL115"])
+  assert _rules(out) == ["GL115", "GL115"]
+
+
+def test_gl115_scope_and_suppression():
+  src = """
+import uuid
+
+def mint():
+  return uuid.uuid4().hex
+"""
+  # telemetry/ is the sanctioned mint; trainers/tools/tests mint freely
+  for path in ("distributed_embeddings_tpu/telemetry/trace.py",
+               "distributed_embeddings_tpu/resilience/trainer.py",
+               "distributed_embeddings_tpu/dynvocab/table.py",
+               "tools/profile_fleet.py", "tests/test_fleet.py"):
+    assert lint_source(src, path, CTX, ["GL115"]) == [], path
+  # non-minting uses of the modules stay legal (time.time wall anchors)
+  ok = """
+import time
+
+def anchor():
+  return time.time()
+"""
+  assert lint_source(ok, "distributed_embeddings_tpu/streaming/publish.py",
+                     CTX, ["GL115"]) == []
+  sup = """
+import uuid
+
+def legacy():
+  return uuid.uuid4().hex  # graftlint: disable=GL115 (external id)
+"""
+  assert lint_source(sup, "distributed_embeddings_tpu/fleet/stream.py",
+                     CTX, ["GL115"]) == []
+
+
 # ---------------------------------------------------------------------------
 # repo-context parsing + HEAD cleanliness
 # ---------------------------------------------------------------------------

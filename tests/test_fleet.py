@@ -522,6 +522,13 @@ def test_fleet_delta_followers_converge_bitexact(tmp_path):
     assert f.poll_once() == 1
     assert f.last_refusal is None
   assert router.step == sub.engine.step
+  # each promote records the /healthz readiness detail: the served
+  # watermark and the last-promote wall time (staleness probe source)
+  for f in followers:
+    assert f.telemetry.peek("stream/served_step").value == router.step
+    assert f.telemetry.peek("stream/last_promote_unixtime").value > 0
+  assert sub.telemetry.peek("stream/served_step").value \
+      == sub.engine.step
   numerical, ids = _mkbatch(rng, 4 * world)
   np.testing.assert_array_equal(sub.predict(numerical, ids),
                                 router.predict(numerical, ids))
@@ -569,3 +576,167 @@ def test_rank_weights_from_artifact(tmp_path):
   path = _export(tmp_path, plan, rule, state, "f32")
   w = rank_weights_from_artifact(path, 2)
   np.testing.assert_array_equal(w, np.ones(2))
+
+
+# ---------------------------------------------------------------------------
+# distributed tracing across the fleet wire (round 18)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_trace_context_crosses_socket_transport(tmp_path):
+  """A request context minted at the edge must ride the TCP framing:
+  the owner-side gather span adopts the request's trace id and parents
+  to the router's rpc span — even though the gather runs on the owner
+  server's handler thread, where no thread-local could have leaked."""
+  from distributed_embeddings_tpu import telemetry
+
+  world = 2
+  plan, rule, mesh, state, rng = _fixture(world)
+  path = _export(tmp_path, plan, rule, state, "f32")
+  fplan = FleetPlan.balanced(world, 2)
+  owners = {o: FleetOwner(path, plan, fplan.owned_ranks(o), owner_id=o)
+            for o in range(2)}
+  servers = {o: SocketOwnerServer(owners[o]) for o in owners}
+  transport = SocketTransport({o: s.address for o, s in servers.items()})
+  try:
+    router = FleetRouter(ActsModel(), plan, path, fplan, transport,
+                         mesh=mesh, config=FLEET_CFG)
+    numerical, ids = _mkbatch(rng, 4 * world)
+    with telemetry.tracing() as tr:
+      with telemetry.use_context(telemetry.mint_context(["req-7"])):
+        router.predict(numerical, ids)
+    evs = [e for e in tr.to_chrome()["traceEvents"]
+           if e.get("ph") == "X"]
+    gathers = [e for e in evs if e["name"] == "fleet/owner/gather"
+               and (e.get("args") or {}).get("trace_id")]
+    rpcs = {e["args"]["span_id"]: e for e in evs
+            if e["name"] == "fleet/rpc" and "span_id" in
+            (e.get("args") or {})}
+    assert gathers, "no context-carrying gather spans recorded"
+    for g in gathers:
+      # the id minted at the edge reached the owner over the wire...
+      assert g["args"]["trace_id"] == "req-7"
+      # ...as the child of the specific rpc attempt that carried it,
+      # nested inside it on the (shared same-process) clock
+      rpc = rpcs[g["args"]["parent_span_id"]]
+      assert rpc["args"]["trace_id"] == "req-7"
+      assert rpc["ts"] <= g["ts"]
+      assert g["ts"] + g["dur"] <= rpc["ts"] + rpc["dur"]
+    # fan-out/route spans share the same trace
+    assert any(e["name"] == "fleet/fanout"
+               and (e.get("args") or {}).get("trace_id") == "req-7"
+               for e in evs)
+  finally:
+    transport.close()
+    for s in servers.values():
+      s.close()
+
+
+def test_fleet_clock_handshake_and_trace_collection(tmp_path):
+  """Every owner answers the ``clock`` RPC (bounded-uncertainty offset
+  per owner) and the ``trace`` RPC (its span buffer, or None when
+  tracing is off in that process)."""
+  from distributed_embeddings_tpu import telemetry
+
+  world = 2
+  plan, rule, mesh, state, rng = _fixture(world)
+  path = _export(tmp_path, plan, rule, state, "f32")
+  fplan = FleetPlan.balanced(world, 2)
+  owners, transport, router = _fleet(path, plan, fplan, mesh)
+  offsets = router.store.clock_offsets(rounds=4)
+  assert sorted(offsets) == [0, 1]
+  for off in offsets.values():
+    # same process, same CLOCK_MONOTONIC: the offset is bounded by the
+    # handshake's own stated uncertainty
+    assert abs(off.offset_ns) <= off.uncertainty_ns
+    assert off.uncertainty_ns >= 1 and off.rtt_ns >= 0
+  # tracing disabled in the "owner process": trace collection says so
+  assert router.store.collect_traces() == {0: None, 1: None}
+  with telemetry.tracing():
+    numerical, ids = _mkbatch(rng, 4 * world)
+    router.predict(numerical, ids)
+    traces = router.store.collect_traces()
+  for o in (0, 1):
+    assert traces[o] is not None and "traceEvents" in traces[o]
+  router.close()
+
+
+def test_injected_rpc_fault_is_an_attempt_span(tmp_path):
+  """A chaos-injected rpc failure records its own ``fleet/rpc`` span —
+  the one-span-per-ATTEMPT contract holds for faults fired at the
+  ``fleet_rpc`` site, not just transport errors, so retry storms under
+  chaos are visible on the merged timeline."""
+  from distributed_embeddings_tpu import telemetry
+  from distributed_embeddings_tpu.resilience import retry as _retry
+
+  world = 2
+  plan, rule, mesh, state, rng = _fixture(world)
+  path = _export(tmp_path, plan, rule, state, "f32")
+  fplan = FleetPlan.balanced(world, 2)
+  owners = {o: FleetOwner(path, plan, fplan.owned_ranks(o), owner_id=o)
+            for o in range(2)}
+  transport = InProcTransport(owners)
+  router = FleetRouter(ActsModel(), plan, path, fplan, transport,
+                       mesh=mesh, config=FLEET_CFG,
+                       retry_policy=_retry.RetryPolicy(retries=3,
+                                                       backoff=0.0))
+  numerical, ids = _mkbatch(rng, 4 * world)
+  router.predict(numerical, ids)  # compile off the traced run
+  with telemetry.tracing() as tr:
+    router.predict(numerical, ids)
+  baseline = sum(e.get("name") == "fleet/rpc"
+                 for e in tr.to_chrome()["traceEvents"])
+  inj = faultinject.FaultInjector().fail_first("fleet_rpc", 2)
+  with telemetry.tracing() as tr:
+    with faultinject.injected(inj):
+      router.predict(numerical, ids)
+  spans = [e for e in tr.to_chrome()["traceEvents"]
+           if e.get("ph") == "X" and e["name"] == "fleet/rpc"]
+  # the two injected failures each burned an attempt span on top of
+  # the fault-free run's count
+  assert len(spans) == baseline + 2, (len(spans), baseline)
+
+
+def test_follower_stop_leaves_healthz_quorum(tmp_path):
+  """A deliberately stopped follower removes its promote gauges —
+  keyed AND the unkeyed last-writer pair — so the /healthz most-stale
+  scan never reports a decommissioned member as stalled forever."""
+  world = 2
+  plan, rule, mesh, state, rng = _fixture(world, seed=6)
+  batch0 = _mkbatch(rng, 4 * world)
+  step = make_sparse_train_step(
+      ActsModel(), plan,
+      lambda preds, labels: jnp.mean((jnp.sum(preds, -1) - labels) ** 2),
+      optax.sgd(0.01), rule, mesh, state,
+      (jnp.asarray(batch0[0]), tuple(jnp.asarray(x) for x in batch0[1]),
+       jnp.zeros((4 * world,), jnp.float32)), donate=False)
+  pub = os.path.join(str(tmp_path), "pub")
+  tracker = RowGenerationTracker(plan)
+  publisher = DeltaPublisher(pub, plan, rule, tracker, quantize="f32")
+  numerical, ids = _mkbatch(rng, 4 * world)
+  labels = rng.integers(0, 2, 4 * world).astype(np.float32)
+  publisher.observe_batch(ids)
+  state, _ = step(state, *shard_batch(
+      (numerical, tuple(jnp.asarray(x) for x in ids), labels), mesh))
+  base = publisher.publish_base(state)
+  fplan = FleetPlan.balanced(world, 2)
+  owners = {o: FleetOwner(base, plan, fplan.owned_ranks(o), owner_id=o)
+            for o in range(2)}
+  follower = FleetDeltaFollower(owners[0], pub, plan,
+                                subscriber_id="f0")
+  reg = follower.telemetry
+  publisher.observe_batch(ids)
+  state, _ = step(state, *shard_batch(
+      (numerical, tuple(jnp.asarray(x) for x in ids), labels), mesh))
+  assert publisher.publish_delta(state) is not None
+  assert follower.poll_once() == 1
+  assert reg.peek("stream/served_step/f0") is not None
+  assert reg.peek("stream/last_promote_unixtime/f0") is not None
+  follower.stop()
+  assert reg.peek("stream/served_step/f0") is None
+  assert reg.peek("stream/last_promote_unixtime/f0") is None
+  # the unkeyed last-writer pair goes too: in the single-member
+  # topology nothing else would ever refresh it, so leaving it would
+  # read as a stalled subscriber forever
+  assert reg.peek("stream/served_step") is None
+  assert reg.peek("stream/last_promote_unixtime") is None

@@ -567,9 +567,13 @@ def test_metrics_http_endpoint_serves_and_shuts_down_clean():
     assert 'serve_latency_s{quantile="0.99"}' in body
     # same content as the textfile renderer: one schema, two transports
     assert body == telemetry.prometheus_text(reg)
-    health = urllib.request.urlopen(
-        f"http://{server.host}:{server.port}/healthz", timeout=5).read()
-    assert health == b"ok\n"
+    health = json.loads(urllib.request.urlopen(
+        f"http://{server.host}:{server.port}/healthz", timeout=5).read())
+    # readiness detail (round 18): JSON body; a process that never
+    # promoted reports nulls, never fabricated freshness
+    assert health["ok"] is True
+    assert health["served_step"] is None
+    assert health["staleness_s"] is None
     with pytest.raises(urllib.error.HTTPError):
       urllib.request.urlopen(
           f"http://{server.host}:{server.port}/nope", timeout=5)
@@ -621,3 +625,445 @@ def test_metrics_fleet_rollup_merges_pushed_snapshots():
     fleet = urllib.request.urlopen(server.fleet_url,
                                    timeout=5).read().decode()
     assert "serve_completed 23" in fleet
+
+
+# ---------------------------------------------------------------------------
+# distributed tracing (round 18): contexts, clock offsets, merged timeline
+# ---------------------------------------------------------------------------
+
+
+def test_trace_context_parenting_and_ids():
+  """Spans under a context mint their own span ids, chain parent ->
+  child through the thread-local, and record the batch's full trace-id
+  list — the per-process half of the cross-process timeline."""
+  with telemetry.tracing() as tr:
+    ctx = telemetry.mint_context(["r1", "r2"])
+    with telemetry.use_context(ctx):
+      with telemetry.span("parent"):
+        with telemetry.span("child"):
+          pass
+    with telemetry.span("no_ctx"):
+      pass
+  evs = {e["name"]: e for e in tr.to_chrome()["traceEvents"]
+         if e.get("ph") == "X"}
+  p, c = evs["parent"], evs["child"]
+  assert p["args"]["trace_id"] == c["args"]["trace_id"] == "r1"
+  assert c["args"]["parent_span_id"] == p["args"]["span_id"]
+  assert p["args"]["parent_span_id"] == ctx.span_id
+  assert p["args"]["trace_ids"] == ["r1", "r2"]
+  # a context-free span carries no ids (trainer spans stay lean)
+  assert "args" not in evs["no_ctx"]
+
+
+def test_trace_context_wire_roundtrip():
+  ctx = telemetry.mint_context(["a", "b"])
+  assert telemetry.TraceContext.from_wire(ctx.to_wire()) == ctx
+  solo = telemetry.mint_context()
+  back = telemetry.TraceContext.from_wire(solo.to_wire())
+  assert back.trace_id == solo.trace_id
+  assert back.trace_ids == (solo.trace_id,)
+
+
+def test_clock_offset_recovered_within_stated_uncertainty():
+  """The handshake's bound is structural, not statistical: the remote
+  read happens inside the min round trip, so the TRUE offset is within
+  ±rtt/2 of the estimate — pinned against injected skews (including a
+  deliberately slow remote leg the min-RTT selection must absorb)."""
+  import time as _time
+
+  from distributed_embeddings_tpu.telemetry import trace as trz
+
+  for skew in (0, 25_000_000, -3_600_000_000_000):
+    def remote(skew=skew):
+      _time.sleep(0.0005)  # queueing delay inside the round trip
+      return trz.clock_ns() + skew
+    off = telemetry.estimate_clock_offset(remote, rounds=6)
+    assert abs(off.offset_ns - skew) <= off.uncertainty_ns
+    assert off.uncertainty_ns == max(1, off.rtt_ns // 2)
+    # the mapping direction: a remote stamp maps back near local now
+    local = off.to_local(trz.clock_ns() + skew)
+    assert abs(local - trz.clock_ns()) <= off.uncertainty_ns + 10_000_000
+
+
+def test_merged_trace_rpc_contains_gather_after_correction():
+  """Two 'processes' with a large clock skew: the router's rpc span
+  must STRICTLY contain the owner's gather span — but only after the
+  handshaked offset corrects the owner's clock (uncorrected, the skew
+  throws the gather far outside the rpc window, which proves the
+  correction is load-bearing, not decorative)."""
+  from distributed_embeddings_tpu.telemetry import trace as trz
+
+  SKEW = 3_700_000_000  # 3.7 s — dwarfs the handshake uncertainty
+  a = telemetry.Tracer(label="router")
+  b = telemetry.Tracer(label="owner-0")
+  t0 = trz.clock_ns()
+  ms = 1_000_000
+  a.record_window("fleet/rpc", t0 + 1 * ms, t0 + 9 * ms,
+                  args={"span_id": "S", "trace_id": "T"})
+  # the owner's clock reads SKEW ahead; the true window sits inside
+  b.record_window("fleet/owner/gather",
+                  t0 + 3 * ms + SKEW, t0 + 6 * ms + SKEW,
+                  args={"parent_span_id": "S", "trace_id": "T"})
+  off = telemetry.estimate_clock_offset(lambda: trz.clock_ns() + SKEW,
+                                        rounds=6)
+
+  def spans(merged):
+    out = {}
+    for e in merged["traceEvents"]:
+      if e.get("ph") == "X":
+        out[e["name"]] = e
+    return out
+
+  corrected = spans(telemetry.merge_traces(
+      [{"trace": a.to_chrome()},
+       {"trace": b.to_chrome(), "offset_ns": off.offset_ns}]))
+  rpc, g = corrected["fleet/rpc"], corrected["fleet/owner/gather"]
+  assert rpc["ts"] < g["ts"]
+  assert g["ts"] + g["dur"] < rpc["ts"] + rpc["dur"]
+  assert g["args"]["parent_span_id"] == rpc["args"]["span_id"]
+  # uncorrected: the skew expels the gather from the rpc window
+  raw = spans(telemetry.merge_traces(
+      [{"trace": a.to_chrome()}, {"trace": b.to_chrome()}]))
+  rpc, g = raw["fleet/rpc"], raw["fleet/owner/gather"]
+  assert not (rpc["ts"] < g["ts"]
+              and g["ts"] + g["dur"] < rpc["ts"] + rpc["dur"])
+
+
+def test_merge_traces_one_pid_per_process():
+  a = telemetry.Tracer(label="router")
+  b = telemetry.Tracer(label="owner-1")
+  with telemetry.tracing() as _:
+    pass  # tracing() must not interfere with manual tracers
+  a.record_window("x", a.t0_ns + 10, a.t0_ns + 20)
+  b.record_window("y", b.t0_ns + 10, b.t0_ns + 20)
+  merged = telemetry.merge_traces([{"trace": a.to_chrome()},
+                                   {"trace": b.to_chrome()}])
+  names = {e["pid"]: e["args"]["name"] for e in merged["traceEvents"]
+           if e.get("ph") == "M" and e.get("name") == "process_name"}
+  assert sorted(names.values()) == ["owner-1", "router"]
+  pid_of = {v: k for k, v in names.items()}
+  xs = {e["name"]: e for e in merged["traceEvents"]
+        if e.get("ph") == "X"}
+  assert xs["x"]["pid"] == pid_of["router"]
+  assert xs["y"]["pid"] == pid_of["owner-1"]
+
+
+def test_attach_device_track_anchors_and_preserves_spacing():
+  a = telemetry.Tracer(label="router")
+  a.record_window("serve/dispatch", a.t0_ns + 5_000_000,
+                  a.t0_ns + 9_000_000)
+  merged = telemetry.merge_traces([{"trace": a.to_chrome()}])
+  device = {"traceEvents": [
+      {"ph": "M", "pid": 7, "name": "process_name",
+       "args": {"name": "/device:TPU:0"}},
+      {"ph": "X", "pid": 7, "tid": 1, "name": "fusion", "ts": 100.0,
+       "dur": 2.0},
+      {"ph": "X", "pid": 7, "tid": 1, "name": "fusion.1", "ts": 103.5,
+       "dur": 1.0},
+  ]}
+  anchor_ns = a.t0_ns + 5_000_000  # the dispatch span's start
+  out = telemetry.attach_device_track(merged, device, anchor_ns)
+  names = {e["pid"]: e["args"]["name"] for e in out["traceEvents"]
+           if e.get("ph") == "M" and e.get("name") == "process_name"}
+  assert "device" in names.values()
+  dev = [e for e in out["traceEvents"] if e.get("ph") == "X"
+         and e["name"].startswith("fusion")]
+  dev.sort(key=lambda e: e["ts"])
+  # earliest device event lands AT the anchor; relative spacing exact
+  base = merged["base_ns"]
+  assert abs(dev[0]["ts"] - (anchor_ns - base) / 1e3) < 1e-6
+  assert abs((dev[1]["ts"] - dev[0]["ts"]) - 3.5) < 1e-6
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: ring, stages, trips, bundles
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_ring_trip_and_bundle(tmp_path):
+  from distributed_embeddings_tpu.telemetry import flight
+
+  reg = telemetry.MetricsRegistry()
+  rec = telemetry.FlightRecorder(dir=str(tmp_path), capacity=8,
+                                 registry=reg, min_interval_s=0.0)
+  telemetry.install_flight_recorder(rec)
+  try:
+    import time as _time
+    for i, slow in enumerate([0.0, 0.02, 0.0]):
+      r = rec.begin(f"t{i}")
+      rec.bind(r)
+      _time.sleep(slow)  # slowest = largest real begin->end wall
+      flight.observe_stage("rpc", 0.001 + slow)
+      flight.observe_stage("combine", 0.0005)
+      if i == 1:
+        rec.note("failover", owner=0)
+      rec.bind(None)
+      rec.end(r)
+    path = rec.trip("failover", owner=0)
+    assert path is not None  # no live records -> dumped inline
+    with open(path) as f:
+      bundle = json.load(f)
+    assert bundle["reason"] == "failover"
+    assert len(bundle["requests"]) == 3
+    # the slowest request's critical path names its dominant stage
+    assert bundle["slowest"]["trace_id"] == "t1"
+    assert bundle["slowest"]["critical_stage"] == "rpc"
+    assert any(n["kind"] == "failover"
+               for n in bundle["slowest"]["notes"])
+    # stage taxonomy histograms fed alongside the records
+    assert bundle["stage_s"]["rpc"]["count"] == 3
+    assert reg.histogram("serve/stage_s/combine").count == 3
+    assert reg.counter("flight/trips").value == 1
+    assert reg.counter("flight/bundles").value == 1
+  finally:
+    telemetry.uninstall_flight_recorder()
+
+
+def test_flight_trip_defers_until_inflight_record_ends(tmp_path):
+  """A trip fired mid-dispatch must wait for the in-flight record —
+  the failed-then-retried request belongs IN its own bundle."""
+  reg = telemetry.MetricsRegistry()
+  rec = telemetry.FlightRecorder(dir=str(tmp_path), registry=reg,
+                                 min_interval_s=0.0)
+  r = rec.begin("inflight")
+  assert rec.trip("failover") is None
+  assert rec.bundles == []
+  # a later trip must not overwrite the pending one: the FIRST moment
+  # is the one worth capturing (both are still counted)
+  assert rec.trip("shed/queue_full") is None
+  rec.observe_stage("rpc", 0.25, rec=r)
+  rec.end(r)
+  assert len(rec.bundles) == 1
+  with open(rec.bundles[0]) as f:
+    bundle = json.load(f)
+  assert bundle["reason"] == "failover"
+  assert [q["trace_id"] for q in bundle["requests"]] == ["inflight"]
+  assert bundle["requests"][0]["done"] is True
+  assert reg.counter("flight/trips").value == 2
+
+
+def test_flight_trip_rate_limit_per_reason(tmp_path):
+  reg = telemetry.MetricsRegistry()
+  rec = telemetry.FlightRecorder(dir=str(tmp_path), registry=reg,
+                                 min_interval_s=3600.0)
+  assert rec.trip("shed/queue_full") is not None
+  assert rec.trip("shed/queue_full") is None   # rate-limited
+  assert rec.trip("refusal") is not None       # other reasons pass
+  assert len(rec.bundles) == 2
+  # every trip is counted even when its dump is suppressed
+  assert reg.counter("flight/trips").value == 3
+  assert reg.counter("flight/trips/shed").value == 2
+
+
+def test_batcher_shed_trips_flight_recorder(tmp_path):
+  from distributed_embeddings_tpu.serving import MicroBatcher, Rejected
+
+  reg = telemetry.MetricsRegistry()
+  rec = telemetry.install_flight_recorder(
+      telemetry.FlightRecorder(dir=str(tmp_path), registry=reg,
+                               min_interval_s=0.0))
+  try:
+    mb = MicroBatcher(lambda n, c: n, max_batch=4, queue_rows=4,
+                      start=False)
+    mb.submit(np.zeros((4, 1), np.float32),
+              [np.zeros((4, 1), np.int32)])
+    with pytest.raises(Rejected):
+      mb.submit(np.zeros((4, 1), np.float32),
+                [np.zeros((4, 1), np.int32)])
+    # the shed trips with defer=True (it fires under the batcher's
+    # lock): the dump lands on a short-lived background thread
+    import time as _time
+    deadline = _time.monotonic() + 5.0
+    while not rec.bundles and _time.monotonic() < deadline:
+      _time.sleep(0.01)
+    assert len(rec.bundles) == 1
+    with open(rec.bundles[0]) as f:
+      assert json.load(f)["reason"] == "shed/queue_full"
+  finally:
+    telemetry.uninstall_flight_recorder()
+
+
+def test_batcher_mints_request_ids_onto_dispatch_span():
+  """Admission mints each request's trace id; the dispatch context
+  carries ALL coalesced ids, and pack/dispatch/complete share one
+  trace — the per-process half of the fleet acceptance."""
+  from distributed_embeddings_tpu.serving import MicroBatcher
+
+  with telemetry.tracing() as tr:
+    mb = MicroBatcher(lambda n, c: n, max_batch=8, start=False)
+    f1 = mb.submit(np.zeros((2, 1), np.float32),
+                   [np.zeros((2, 1), np.int32)])
+    f2 = mb.submit(np.zeros((3, 1), np.float32),
+                   [np.zeros((3, 1), np.int32)])
+    mb.flush_now()
+    assert f1.result(1.0).shape[0] == 2 and f2.done()
+  evs = {e["name"]: e for e in tr.to_chrome()["traceEvents"]
+         if e.get("ph") == "X"}
+  disp = evs["serve/dispatch"]
+  ids = disp["args"].get("trace_ids", [disp["args"]["trace_id"]])
+  assert len(set(ids)) == 2  # one id per admitted request
+  assert evs["serve/pack"]["args"]["trace_id"] == disp["args"]["trace_id"]
+  assert evs["serve/complete"]["args"]["trace_id"] \
+      == disp["args"]["trace_id"]
+
+
+def test_batcher_disabled_tracing_mints_nothing():
+  from distributed_embeddings_tpu.serving import MicroBatcher
+
+  mb = MicroBatcher(lambda n, c: n, max_batch=4, start=False)
+  mb.submit(np.zeros((2, 1), np.float32), [np.zeros((2, 1), np.int32)])
+  assert all(p.trace_id is None for p in mb._pending)
+  mb.flush_now()
+
+
+# ---------------------------------------------------------------------------
+# /healthz readiness detail + fleet snapshot TTL
+# ---------------------------------------------------------------------------
+
+
+def test_healthz_reports_watermark_age():
+  import time as _time
+  import urllib.request
+
+  reg = telemetry.MetricsRegistry()
+  with telemetry.MetricsServer(reg) as server:
+    url = f"http://{server.host}:{server.port}/healthz"
+    h = json.loads(urllib.request.urlopen(url, timeout=5).read())
+    assert h == {"ok": True, "served_step": None,
+                 "last_promote_unix": None, "staleness_s": None}
+    # the gauges the subscriber/follower set at each promote
+    reg.gauge("stream/served_step").set(42)
+    reg.gauge("stream/last_promote_unixtime").set(_time.time() - 5.0)
+    h = json.loads(urllib.request.urlopen(url, timeout=5).read())
+    assert h["ok"] is True and h["served_step"] == 42
+    assert 4.0 <= h["staleness_s"] <= 120.0
+    # probing must not have CREATED gauges in an empty registry
+    empty = telemetry.MetricsRegistry()
+    assert empty.peek("stream/served_step") is None
+    telemetry.MetricsServer(empty).close()
+    assert empty.peek("stream/served_step") is None
+
+
+def test_fleet_rollup_snapshot_ttl_expiry():
+  """Pushed member snapshots expire out of ``?scope=fleet`` after the
+  TTL — counted once (the heartbeat-quorum rule on the metrics plane);
+  a re-push revives the member."""
+  import time as _time
+  import urllib.request
+
+  local = telemetry.MetricsRegistry()
+  local.counter("serve/completed").inc(10)
+  member = telemetry.MetricsRegistry()
+  member.counter("serve/completed").inc(7)
+  with telemetry.MetricsServer(local, snapshot_ttl_s=0.25) as server:
+    server.push("owner-0", member)
+    fleet = urllib.request.urlopen(server.fleet_url,
+                                   timeout=5).read().decode()
+    assert "serve_completed 17" in fleet
+    _time.sleep(0.4)
+    fleet = urllib.request.urlopen(server.fleet_url,
+                                   timeout=5).read().decode()
+    assert "serve_completed 10" in fleet          # member dropped
+    assert "telemetry_snapshots_expired 1" in fleet
+    # counted once, not once per scrape
+    fleet = urllib.request.urlopen(server.fleet_url,
+                                   timeout=5).read().decode()
+    assert "telemetry_snapshots_expired 1" in fleet
+    # a re-push revives the member (and can expire again, counted)
+    server.push("owner-0", member)
+    fleet = urllib.request.urlopen(server.fleet_url,
+                                   timeout=5).read().decode()
+    assert "serve_completed 17" in fleet
+    assert "telemetry_snapshots_expired 1" in fleet
+
+
+def test_registry_remove_drops_metric_without_create():
+  reg = telemetry.MetricsRegistry()
+  reg.gauge("stream/last_promote_unixtime/dead").set(1.0)
+  assert reg.remove("stream/last_promote_unixtime/dead") is True
+  assert reg.peek("stream/last_promote_unixtime/dead") is None
+  # removing an absent name is a no-op, not a create
+  assert reg.remove("stream/last_promote_unixtime/dead") is False
+  assert reg.peek("stream/last_promote_unixtime/dead") is None
+
+
+def test_healthz_deregistered_member_leaves_most_stale_scan():
+  """A deliberately removed member's keyed promote gauges drop out of
+  the /healthz most-stale scan (a decommissioned subscriber must not
+  read as a stalled sibling forever); the survivor's freshness wins."""
+  import time as _time
+
+  reg = telemetry.MetricsRegistry()
+  now = _time.time()
+  reg.gauge("stream/last_promote_unixtime/dead").set(now - 3600.0)
+  reg.gauge("stream/served_step/dead").set(1)
+  reg.gauge("stream/last_promote_unixtime/live").set(now - 1.0)
+  reg.gauge("stream/served_step/live").set(9)
+  with telemetry.MetricsServer(reg) as server:
+    h = server.health()
+    assert h["staleness_s"] >= 3000.0  # the dead member dominates
+    for stem in ("stream/served_step", "stream/last_promote_unixtime"):
+      assert reg.remove(f"{stem}/dead")
+    h = server.health()
+    assert h["served_step"] == 9 and h["staleness_s"] < 60.0
+
+
+def test_span_ids_remint_across_fork():
+  """fork()ed children re-mint the process tag + counter, so two
+  processes never emit colliding span ids into one merged timeline.
+  Runs in a jax-free subprocess (trace.py is stdlib-only at import
+  time) — forking the threaded pytest process itself would be the
+  exact hazard the re-mint guards against."""
+  import subprocess
+  import sys
+
+  if not hasattr(os, "fork"):
+    pytest.skip("no fork on this platform")
+  prog = """
+import importlib.util, os, sys
+spec = importlib.util.spec_from_file_location("t", sys.argv[1])
+t = importlib.util.module_from_spec(spec)
+sys.modules["t"] = t
+spec.loader.exec_module(t)
+parent_id = t._next_span_id()
+r, w = os.pipe()
+pid = os.fork()
+if pid == 0:
+    os.write(w, t._next_span_id().encode())
+    os._exit(0)
+os.close(w)
+child_id = b""
+while True:
+    chunk = os.read(r, 64)
+    if not chunk:
+        break
+    child_id += chunk
+os.waitpid(pid, 0)
+print(parent_id, child_id.decode())
+"""
+  trace_py = os.path.join(os.path.dirname(telemetry.trace.__file__),
+                          "trace.py")
+  out = subprocess.run([sys.executable, "-c", prog, trace_py],
+                       capture_output=True, text=True, timeout=60)
+  assert out.returncode == 0, out.stderr
+  parent_id, child_id = out.stdout.split()
+  child_tag, _, child_seq = child_id.partition("-")
+  assert child_tag and child_tag != parent_id.partition("-")[0]
+  assert child_seq == "1"  # the child's counter restarted
+
+
+def test_fleet_snapshot_ttl_sweeps_on_push():
+  """Expired member snapshots are evicted on every PUSH, not only on
+  ?scope=fleet reads — a churning fleet whose operator never scrapes
+  the roll-up must not accumulate dead source ids' sections forever."""
+  import time as _time
+
+  local = telemetry.MetricsRegistry()
+  m1 = telemetry.MetricsRegistry()
+  m1.counter("serve/completed").inc(1)
+  with telemetry.MetricsServer(local, snapshot_ttl_s=0.2) as server:
+    server.push("dead-member", m1)
+    _time.sleep(0.3)
+    server.push("live-member", m1)  # the write sweeps the store
+    assert set(server._server._snapshots) == {"live-member"}
+    assert local.peek("telemetry/snapshots_expired").value == 1

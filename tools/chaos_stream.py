@@ -514,6 +514,45 @@ def run_chaos_stream(steps=12, world=2, publish_every=2, quantize="f32",
             and cold["converged"] and cold["refusals"] == 0
             and _digests_equal(cold_out, ref_digest)}
 
+  # ---- cycle E: a refused delta trips the flight recorder ----------------
+  # Plant an out-of-order delta past the chain head (a copy of the head
+  # delta under the next seq — its manifest seq and base_fingerprint
+  # both break the link) and run one more in-driver subscriber: it
+  # converges through the real chain, REFUSES the bogus link naming the
+  # field, and the refusal trips the installed flight recorder, whose
+  # debug bundle is the verdict's artifact.
+  import shutil as _shutil
+
+  from distributed_embeddings_tpu import telemetry
+  from distributed_embeddings_tpu.streaming import delta_dirname
+
+  flight_dir = os.path.join(work, "flight")
+  recorder = telemetry.install_flight_recorder(
+      telemetry.FlightRecorder(dir=flight_dir, min_interval_s=0.0))
+  try:
+    with open(os.path.join(pub, "chain_done.json")) as f:
+      done_final = json.load(f)
+    head = int(done_final["final_seq"])
+    _shutil.copytree(os.path.join(pub, delta_dirname(head)),
+                     os.path.join(pub, delta_dirname(head + 1)))
+    fl_out = os.path.join(work, "mid_publish", "flight_digest.npz")
+    fl = run_subscriber(pub, world, fl_out,
+                        subscriber_id="chaos-flight")
+  finally:
+    telemetry.uninstall_flight_recorder()
+  bundle_reason = None
+  if recorder.bundles:
+    with open(recorder.bundles[0]) as f:
+      bundle_reason = json.load(f)["reason"]
+  result["cycles"]["refusal_flight"] = {
+      "refusals": fl["refusals"], "last_refusal": fl["last_refusal"],
+      "converged": fl["converged"],
+      "flight_bundles": len(recorder.bundles),
+      "bundle_reason": bundle_reason,
+      "ok": fl["converged"] and fl["refusals"] >= 1
+            and len(recorder.bundles) >= 1
+            and bundle_reason == "refusal"}
+
   result["ok"] = all(c["ok"] for c in result["cycles"].values())
   if verbose:
     print(json.dumps(result, indent=1))

@@ -311,7 +311,11 @@ def sweep_fleet_sizes(cfg, tmp, result):
 
 def check_failover_under_load(cfg, tmp, result):
   """Kill one owner of a fully replicated fleet mid-load: zero wrong
-  answers, zero failed requests, counted failover."""
+  answers, zero failed requests, counted failover — and a flight
+  -recorder bundle: the failover trips the recorder, whose debug bundle
+  must carry the recent request traces and the failover note."""
+  import json as _json
+
   plan, rule, mesh, state, _store, rng = build(cfg)
   path = os.path.join(tmp, "art_failover")
   serve_export(path, plan, rule, state, quantize="f32")
@@ -323,6 +327,9 @@ def check_failover_under_load(cfg, tmp, result):
                       shard_min_phys_rows=16, revive_after_s=3600.0)
   _, owners, oregs, transport, router, rreg = build_fleet(
       path, plan, mesh, 2, replicas=2, config=cfg_f)
+  recorder = telemetry.install_flight_recorder(
+      telemetry.FlightRecorder(dir=os.path.join(tmp, "flight"),
+                               capacity=128))
   mb = MicroBatcher(router.dispatch, max_batch=cfg["max_batch"],
                     max_delay_s=0.002)
   mb.submit(*reqs[0]).result(timeout=300)  # compile off the clock
@@ -333,15 +340,31 @@ def check_failover_under_load(cfg, tmp, result):
                                   rng=rng)
   killer.join()
   mb.close()
+  telemetry.uninstall_flight_recorder()
   wrong = sum(0 if np.array_equal(res, wants[ri]) else 1
               for ri, res in out)
   failovers = rreg.counter("fleet/failovers").value
+  bundles = list(recorder.bundles)
+  bundle_ok = note_ok = False
+  if bundles:
+    with open(bundles[0]) as f:
+      bundle = _json.load(f)
+    bundle_ok = bundle["reason"] == "failover" \
+        and len(bundle["requests"]) >= 1
+    note_ok = any(nt.get("kind") == "failover"
+                  for r in bundle["requests"]
+                  for nt in r.get("notes", []))
   result["failover"] = {"requests": n, "wrong": wrong,
                         "failed": n - len(out) - rejected,
-                        "rejected": rejected, "failovers": failovers}
-  ok = wrong == 0 and len(out) + rejected == n and failovers >= 1
+                        "rejected": rejected, "failovers": failovers,
+                        "flight_bundles": len(bundles),
+                        "flight_bundle_ok": bundle_ok,
+                        "flight_failover_note": note_ok}
+  ok = wrong == 0 and len(out) + rejected == n and failovers >= 1 \
+      and bundle_ok and note_ok
   print(f"failover under load: {n} requests, wrong={wrong}, "
-        f"rejected={rejected}, failovers={failovers} "
+        f"rejected={rejected}, failovers={failovers}, "
+        f"flight bundles={len(bundles)} "
         f"{'OK' if ok else 'FAIL'}")
   router.close()
   return ok

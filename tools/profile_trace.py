@@ -1,0 +1,515 @@
+"""Distributed-tracing budget: overhead, one merged fleet timeline, flight.
+
+The observability acceptance for the fleet serve path (ISSUE 14,
+docs/BENCHMARKS.md round 18). Three measurements:
+
+1. **Overhead**: tracing ENABLED (tracer + flight recorder + per-request
+   trace-context minting) vs disabled on an in-proc world-2 fleet's
+   ``predict`` loop. Acceptance: **<= 3%** on min-of-rounds (the PR 10
+   budget, re-measured on the fleet path); the smoke tier requires it
+   finite.
+
+2. **One merged timeline** from a REAL multi-process world-2 fleet: the
+   router in this process, TWO owner processes spawned over TCP
+   (``--owner`` mode), jax.profiler around the serve loop. After the
+   load: a clock-offset handshake per owner (``clock`` RPC,
+   ``telemetry.estimate_clock_offset``), span-buffer collection
+   (``trace`` RPC), ``telemetry.merge_traces`` + the device track
+   anchored on the first dispatch span. Assertions: the merged JSON
+   contains all THREE process tracks plus the device track; every
+   dispatched request's trace id appears on the router track AND an
+   owner track; every owner gather span's parent is a router rpc span;
+   and after clock correction the rpc span STRICTLY contains its owner
+   gather span.
+
+3. **Failover flight recorder**: a fully replicated in-proc fleet
+   serves an open loop while one owner is killed mid-load. The counted
+   failover trips the flight recorder; acceptance: a bundle is dumped,
+   its slowest request's critical path names the ``rpc`` stage (the
+   failed-then-retried gather), and a ``failover`` note rides the
+   record.
+
+``--smoke`` runs all three at tiny world sizes (wired into ``make
+verify``; overhead only required finite), timeout-guarded like the
+other smoke tiers. Verdict via ``telemetry.emit_verdict``.
+
+Usage: PYTHONPATH=/root/repo python tools/profile_trace.py [--smoke]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+  os.environ["XLA_FLAGS"] = (
+      flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from distributed_embeddings_tpu import telemetry  # noqa: E402
+from distributed_embeddings_tpu.fleet import (  # noqa: E402
+    FleetConfig,
+    FleetOwner,
+    FleetPlan,
+    FleetRouter,
+    InProcTransport,
+    SocketOwnerServer,
+    SocketTransport,
+)
+from distributed_embeddings_tpu.layers.dist_model_parallel import (  # noqa: E402
+    set_weights,
+)
+from distributed_embeddings_tpu.layers.embedding import TableConfig  # noqa: E402
+from distributed_embeddings_tpu.layers.planner import (  # noqa: E402
+    DistEmbeddingStrategy,
+)
+from distributed_embeddings_tpu.ops.packed_table import sparse_rule  # noqa: E402
+from distributed_embeddings_tpu.parallel import create_mesh  # noqa: E402
+from distributed_embeddings_tpu.parallel.lookup_engine import PAD_ID  # noqa: E402
+from distributed_embeddings_tpu.resilience.retry import RetryPolicy  # noqa: E402
+from distributed_embeddings_tpu.serving import MicroBatcher  # noqa: E402
+from distributed_embeddings_tpu.serving.export import (  # noqa: E402
+    export as serve_export,
+)
+from distributed_embeddings_tpu.telemetry.flight import (  # noqa: E402
+    FlightRecorder,
+)
+from distributed_embeddings_tpu.training import (  # noqa: E402
+    init_sparse_state,
+    shard_params,
+)
+
+
+class ActsModel:
+  def apply(self, variables, numerical, cats, emb_acts=None):
+    del variables, numerical, cats
+    return jnp.concatenate(list(emb_acts), axis=-1)
+
+
+BENCH = dict(world=2, sizes=[32768, 8192], widths=[16, 16],
+             hotness=[2, 1], req_rows=4, max_batch=64,
+             n_requests=120, overhead_rounds=60)
+SMOKE = dict(world=2, sizes=[1536, 768], widths=[16, 16],
+             hotness=[2, 1], req_rows=4, max_batch=32,
+             n_requests=60, overhead_rounds=25)
+
+FLEET_CFG = FleetConfig(cache_fraction=0.05, staging_grps=256,
+                        shard_min_phys_rows=16)
+
+
+def make_plan(cfg):
+  tables = [TableConfig(s, w, combiner="sum")
+            for s, w in zip(cfg["sizes"], cfg["widths"])]
+  return DistEmbeddingStrategy(tables, cfg["world"], "memory_balanced",
+                               dense_row_threshold=0,
+                               input_hotness=cfg["hotness"])
+
+
+def build(cfg):
+  rng = np.random.default_rng(7)
+  plan = make_plan(cfg)
+  weights = [(rng.standard_normal((s, w)) / np.sqrt(w)).astype(np.float32)
+             for s, w in zip(cfg["sizes"], cfg["widths"])]
+  params = {"embeddings": {k: jnp.asarray(v)
+                           for k, v in set_weights(plan, weights).items()}}
+  rule = sparse_rule("adagrad", 0.05)
+  mesh = create_mesh(cfg["world"])
+  state = shard_params(init_sparse_state(plan, params, rule,
+                                         optax.sgd(0.01)), mesh)
+  return plan, rule, mesh, state, rng
+
+
+def mkreq(rng, cfg, n):
+  ids = []
+  for s, h in zip(cfg["sizes"], cfg["hotness"]):
+    x = rng.integers(0, s, (n, h)).astype(np.int32)
+    x[rng.random(x.shape) < 0.2] = PAD_ID
+    ids.append(x)
+  return rng.standard_normal((n, 4)).astype(np.float32), ids
+
+
+# ---------------------------------------------------------------------------
+# owner process mode (--owner): one FleetOwner behind a TCP server
+# ---------------------------------------------------------------------------
+
+
+def owner_main(args) -> int:
+  cfg = SMOKE if args.smoke else BENCH
+  telemetry.install_tracer(telemetry.Tracer(label=f"owner-{args.owner_id}"))
+  plan = make_plan(cfg)
+  ranks = tuple(int(r) for r in args.ranks.split(","))
+  owner = FleetOwner(args.path, plan, ranks, owner_id=args.owner_id)
+  server = SocketOwnerServer(owner)
+  telemetry.atomic_write_text(args.portfile,
+                              f"{server.host} {server.port}")
+  stop = threading.Event()
+  signal.signal(signal.SIGTERM, lambda *_: stop.set())
+  while not stop.is_set():
+    stop.wait(0.2)
+  server.close()
+  return 0
+
+
+def spawn_owners(tmp, path, fplan, smoke):
+  """Two real owner processes; returns (procs, addresses)."""
+  procs, portfiles = [], []
+  for k in range(fplan.n_owners):
+    pf = os.path.join(tmp, f"owner{k}.port")
+    ranks = ",".join(str(r) for r in fplan.owned_ranks(k))
+    cmd = [sys.executable, os.path.abspath(__file__), "--owner",
+           "--owner-id", str(k), "--ranks", ranks, "--path", path,
+           "--portfile", pf] + (["--smoke"] if smoke else [])
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+         env.get("PYTHONPATH", "")])
+    procs.append(subprocess.Popen(cmd, env=env))
+    portfiles.append(pf)
+  addresses = {}
+  deadline = time.perf_counter() + 180.0
+  for k, pf in enumerate(portfiles):
+    while not os.path.isfile(pf):
+      if time.perf_counter() > deadline:
+        raise TimeoutError(f"owner {k} never published its port")
+      if procs[k].poll() is not None:
+        raise RuntimeError(f"owner {k} exited rc={procs[k].returncode} "
+                           "before serving")
+      time.sleep(0.1)
+    with open(pf) as f:
+      host, port = f.read().split()
+    addresses[k] = (host, int(port))
+  return procs, addresses
+
+
+def stop_owners(procs):
+  for p in procs:
+    if p.poll() is None:
+      p.terminate()
+  for p in procs:
+    try:
+      p.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+      p.kill()
+      p.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# 1. overhead: tracing-enabled fleet serve vs disabled
+# ---------------------------------------------------------------------------
+
+
+def check_overhead(cfg, tmp, result, smoke):
+  plan, rule, mesh, state, rng = build(cfg)
+  path = os.path.join(tmp, "art_overhead")
+  serve_export(path, plan, rule, state, quantize="f32")
+  fplan = FleetPlan.balanced(cfg["world"], 2)
+  owners = {o: FleetOwner(path, plan, fplan.owned_ranks(o), owner_id=o)
+            for o in range(2)}
+  transport = InProcTransport(owners)
+  router = FleetRouter(ActsModel(), plan, path, fplan, transport,
+                       mesh=mesh, config=FLEET_CFG)
+  reqs = [mkreq(rng, cfg, cfg["req_rows"]) for _ in range(8)]
+  for r in reqs:
+    router.predict(*r)  # compile every staging shape off the clock
+
+  def min_predict_s(n):
+    best = None
+    for i in range(n):
+      t0 = time.perf_counter()
+      router.predict(*reqs[i % len(reqs)])
+      dt = time.perf_counter() - t0
+      best = dt if best is None else min(best, dt)
+    return best
+
+  n = cfg["overhead_rounds"]
+  disabled = min_predict_s(n)
+  rec = telemetry.install_flight_recorder(
+      FlightRecorder(dir=os.path.join(tmp, "flight_ovh")))
+  with telemetry.tracing(label="router"):
+    enabled = min_predict_s(n)
+  telemetry.uninstall_flight_recorder()
+  router.close()
+  overhead = (enabled - disabled) / disabled
+  budget = float("inf") if smoke else 0.03
+  ok = np.isfinite([disabled, enabled]).all() and overhead <= budget
+  result["overhead"] = {
+      "disabled_min_ms": disabled * 1e3, "enabled_min_ms": enabled * 1e3,
+      "overhead_frac": overhead, "budget_frac": None if smoke else 0.03}
+  print(f"tracing overhead on the fleet serve path: disabled "
+        f"{disabled * 1e3:.2f} ms, enabled {enabled * 1e3:.2f} ms "
+        f"({overhead:+.1%}) {'OK' if ok else 'FAIL'}")
+  return bool(ok)
+
+
+# ---------------------------------------------------------------------------
+# 2. the merged timeline: router proc + 2 owner procs + device track
+# ---------------------------------------------------------------------------
+
+
+def _spans(trace, name=None):
+  out = []
+  for ev in trace.get("traceEvents", []):
+    if ev.get("ph") == "X" and (name is None or ev.get("name") == name):
+      out.append(ev)
+  return out
+
+
+def _process_names(trace):
+  return {ev["pid"]: ev["args"]["name"]
+          for ev in trace.get("traceEvents", [])
+          if ev.get("ph") == "M" and ev.get("name") == "process_name"}
+
+
+def check_merged_timeline(cfg, tmp, result, smoke):
+  plan, rule, mesh, state, rng = build(cfg)
+  path = os.path.join(tmp, "art_merged")
+  serve_export(path, plan, rule, state, quantize="f32")
+  fplan = FleetPlan.balanced(cfg["world"], 2)
+  procs, addresses = spawn_owners(tmp, path, fplan, smoke)
+  merged_path = os.path.join(tmp, "merged_trace.json")
+  ok = True
+  try:
+    transport = SocketTransport(addresses)
+    rec = telemetry.install_flight_recorder(
+        FlightRecorder(dir=os.path.join(tmp, "flight_merged")))
+    tdir = os.path.join(tmp, "jprof")
+    with telemetry.tracing(label="router") as tracer:
+      router = FleetRouter(ActsModel(), plan, path, fplan, transport,
+                           mesh=mesh, config=FLEET_CFG)
+      mb = MicroBatcher(router.dispatch, max_batch=cfg["max_batch"],
+                        max_delay_s=0.002)
+      warm = mkreq(rng, cfg, cfg["req_rows"])
+      mb.submit(*warm).result(timeout=300)  # compile off the clock
+      with jax.profiler.trace(tdir):
+        futs = [mb.submit(*mkreq(rng, cfg, cfg["req_rows"]))
+                for _ in range(cfg["n_requests"] // 4)]
+        for f in futs:
+          f.result(timeout=300)
+      # the handshake + collection pass, while the owners are still up
+      offsets = router.store.clock_offsets()
+      owner_traces = router.store.collect_traces()
+      mb.close()
+      router.close()
+    telemetry.uninstall_flight_recorder()
+    router_trace = tracer.to_chrome()
+    merged = telemetry.merge_traces(
+        [{"trace": router_trace, "offset_ns": 0}]
+        + [{"trace": owner_traces[o],
+            "offset_ns": offsets[o].offset_ns,
+            "label": f"owner-{o}"} for o in sorted(owner_traces)])
+    # device track: anchored on the first dispatch span's start (the
+    # dispatch->enqueue latency bounds the alignment error)
+    dispatches = sorted(_spans(router_trace, "serve/dispatch"),
+                        key=lambda e: e["ts"])
+    anchor_ns = int(dispatches[0]["ts"] * 1e3) + router_trace["t0_ns"]
+    import glob
+    import gzip
+    dpaths = sorted(glob.glob(
+        f"{tdir}/plugins/profile/*/*.trace.json.gz"))
+    device_ok = False
+    if dpaths:
+      with gzip.open(dpaths[-1]) as f:
+        device_trace = json.load(f)
+      merged = telemetry.attach_device_track(merged, device_trace,
+                                             anchor_ns)
+      device_ok = True
+    telemetry.trace.save_trace(merged, merged_path)
+
+    # --- assertions on the ONE merged artifact -------------------------
+    names = _process_names(merged)
+    labels = set(names.values())
+    tracks_ok = {"router", "owner-0", "owner-1"} <= labels
+    device_ok = device_ok and "device" in labels
+    pid_of = {v: k for k, v in names.items()}
+
+    def args_of(ev):
+      return ev.get("args") or {}
+
+    # every dispatched request id appears on the router track AND on
+    # at least one owner track (the batch's trace_ids ride the wire)
+    router_ids = set()
+    for ev in _spans(merged, "serve/dispatch"):
+      router_ids.update(args_of(ev).get("trace_ids",
+                                        [args_of(ev).get("trace_id")]))
+    router_ids.discard(None)
+    owner_ids = set()
+    # startup fills (warm cache, rankings) gather with no request
+    # context; the request-path assertions cover the ctx-carrying spans
+    gathers = [ev for ev in _spans(merged, "fleet/owner/gather")
+               if names.get(ev["pid"], "").startswith("owner-")
+               and "trace_id" in args_of(ev)]
+    for ev in gathers:
+      owner_ids.update(args_of(ev).get("trace_ids",
+                                       [args_of(ev).get("trace_id")]))
+    ids_ok = bool(router_ids) and router_ids <= owner_ids
+
+    # parent/child across processes: every owner gather span's parent
+    # is a router fleet/rpc span, and after clock correction the rpc
+    # span STRICTLY contains the gather span
+    rpc_by_span = {args_of(ev)["span_id"]: ev
+                   for ev in _spans(merged, "fleet/rpc")
+                   if names.get(ev["pid"]) == "router"
+                   and "span_id" in args_of(ev)}
+    nested = contained = 0
+    for g in gathers:
+      parent = args_of(g).get("parent_span_id")
+      rpc = rpc_by_span.get(parent)
+      if rpc is None:
+        continue
+      nested += 1
+      if rpc["ts"] < g["ts"] and \
+          g["ts"] + g["dur"] < rpc["ts"] + rpc["dur"]:
+        contained += 1
+    nesting_ok = nested == len(gathers) > 0 and contained == nested
+
+    uncert_ms = max(o.uncertainty_ns for o in offsets.values()) / 1e6
+    ok = tracks_ok and device_ok and ids_ok and nesting_ok
+    result["merged"] = {
+        "path": merged_path, "tracks": sorted(labels),
+        "requests_traced": len(router_ids),
+        "gather_spans": len(gathers), "rpc_contains_gather": contained,
+        "clock_uncertainty_ms": uncert_ms,
+        "offsets_ns": {o: off.to_json() for o, off in offsets.items()},
+        "tracks_ok": tracks_ok, "device_ok": device_ok,
+        "ids_ok": ids_ok, "nesting_ok": nesting_ok}
+    print(f"merged timeline: tracks={sorted(labels)}  "
+          f"{len(router_ids)} request ids across processes, "
+          f"{contained}/{len(gathers)} gather spans strictly inside "
+          f"their rpc span (clock uncertainty {uncert_ms:.3f} ms) "
+          f"{'OK' if ok else 'FAIL'}")
+    transport.close()
+  finally:
+    stop_owners(procs)
+  return bool(ok)
+
+
+# ---------------------------------------------------------------------------
+# 3. failover -> flight-recorder bundle
+# ---------------------------------------------------------------------------
+
+
+def check_failover_flight(cfg, tmp, result, smoke):
+  plan, rule, mesh, state, rng = build(cfg)
+  path = os.path.join(tmp, "art_flight")
+  serve_export(path, plan, rule, state, quantize="f32")
+  fplan = FleetPlan.replicated(cfg["world"], 2, replicas=2,
+                               hot_fraction=1.0)
+  owners = {o: FleetOwner(path, plan, fplan.owned_ranks(o), owner_id=o)
+            for o in range(2)}
+  transport = InProcTransport(owners)
+  cfg_f = FleetConfig(cache_fraction=0.05, staging_grps=256,
+                      shard_min_phys_rows=16, revive_after_s=3600.0)
+  router = FleetRouter(ActsModel(), plan, path, fplan, transport,
+                       mesh=mesh, config=cfg_f,
+                       retry_policy=RetryPolicy(retries=2, backoff=0.05))
+  mb = MicroBatcher(router.dispatch, max_batch=cfg["max_batch"],
+                    max_delay_s=0.002)
+  # ONE request shape repeated, warmed BEFORE the recorder installs:
+  # the ring must hold only load-time records — a warm-up dispatch
+  # carrying the initial jit compile would out-slow the failover's rpc
+  # stall and steal the critical-path assertion
+  req = mkreq(rng, cfg, cfg["req_rows"])
+  for _ in range(2):
+    warm = [mb.submit(*req) for _ in range(6)]
+    for f in warm:
+      f.result(timeout=300)
+  recorder = telemetry.install_flight_recorder(
+      FlightRecorder(dir=os.path.join(tmp, "flight_failover"),
+                     capacity=128))
+  n = max(40, cfg["n_requests"] // 2)
+  killer = threading.Timer(0.2, transport.kill, args=(0,))
+  killer.start()
+  failed = 0
+  futs = []
+  for i in range(n):
+    futs.append(mb.submit(*req))
+    time.sleep(0.005)
+  for f in futs:
+    try:
+      f.result(timeout=300)
+    except Exception:  # noqa: BLE001 — counted, must stay 0
+      failed += 1
+  killer.join()
+  mb.close()
+  router.close()
+  telemetry.uninstall_flight_recorder()
+  failovers = router.telemetry.counter("fleet/failovers").value
+  bundles = list(recorder.bundles)
+  bundle_ok = critical = note_ok = False
+  if bundles:
+    with open(bundles[0]) as f:
+      bundle = json.load(f)
+    bundle_ok = bundle["reason"] == "failover" \
+        and len(bundle["requests"]) >= 1
+    slowest = bundle.get("slowest") or {}
+    critical = slowest.get("critical_stage") == "rpc"
+    note_ok = any(nt.get("kind") == "failover"
+                  for r in bundle["requests"] for nt in r.get("notes", []))
+  ok = (failed == 0 and failovers >= 1 and bundle_ok and critical
+        and note_ok)
+  result["flight"] = {
+      "requests": n, "failed": failed, "failovers": failovers,
+      "bundles": len(bundles),
+      "bundle": bundles[0] if bundles else None,
+      "slowest_critical_stage": (slowest.get("critical_stage")
+                                 if bundles else None),
+      "failover_note_present": note_ok}
+  print(f"failover flight recorder: {n} requests, failed={failed}, "
+        f"failovers={failovers}, bundles={len(bundles)}, slowest "
+        f"critical stage="
+        f"{result['flight']['slowest_critical_stage']!r} "
+        f"{'OK' if ok else 'FAIL'}")
+  return bool(ok)
+
+
+def main(cfg, tag, smoke):
+  tmp = tempfile.mkdtemp(prefix="trace_bench_")
+  result = {"config": dict(cfg)}
+  keep = os.environ.get("DE_TPU_KEEP_TRACE")
+  try:
+    ok = check_overhead(cfg, tmp, result, smoke)
+    ok = check_merged_timeline(cfg, tmp, result, smoke) and ok
+    ok = check_failover_flight(cfg, tmp, result, smoke) and ok
+    if keep:
+      os.makedirs(keep, exist_ok=True)
+      for name in ("merged_trace.json",):
+        src = os.path.join(tmp, name)
+        if os.path.isfile(src):
+          shutil.copy(src, os.path.join(keep, name))
+          result["merged"]["path"] = os.path.join(keep, name)
+  finally:
+    if not keep:
+      shutil.rmtree(tmp, ignore_errors=True)
+  result["ok"] = bool(ok)
+  return telemetry.emit_verdict(tag, result)
+
+
+if __name__ == "__main__":
+  ap = argparse.ArgumentParser()
+  ap.add_argument("--smoke", action="store_true",
+                  help="tiny-world smoke tier (wired into make verify)")
+  ap.add_argument("--owner", action="store_true",
+                  help="internal: run one owner process (spawned by the "
+                       "merged-timeline phase)")
+  ap.add_argument("--owner-id", type=int, default=0)
+  ap.add_argument("--ranks", type=str, default="0")
+  ap.add_argument("--path", type=str, default="")
+  ap.add_argument("--portfile", type=str, default="")
+  args = ap.parse_args()
+  if args.owner:
+    raise SystemExit(owner_main(args))
+  if args.smoke:
+    raise SystemExit(main(SMOKE, "trace-smoke", smoke=True))
+  raise SystemExit(main(BENCH, "trace-bench", smoke=False))
