@@ -6,9 +6,9 @@ PY ?= python
 SHELL := /bin/bash  # verify uses pipefail/PIPESTATUS
 
 .PHONY: test test-fast verify lint native bench dryrun chaos chaos-kill \
-	chaos-stream stream-smoke serve-bench serve-smoke vocab-bench \
-	vocab-smoke obs-bench obs-smoke fresh-bench fresh-smoke \
-	fleet-bench fleet-smoke trace-bench trace-smoke clean
+	chaos-preempt preempt-smoke chaos-stream stream-smoke serve-bench \
+	serve-smoke vocab-bench vocab-smoke obs-bench obs-smoke fresh-bench \
+	fresh-smoke fleet-bench fleet-smoke trace-bench trace-smoke clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -137,7 +137,7 @@ trace-smoke:
 # tests, collection errors surfaced but not fatal to the log); lint runs
 # first so invariant violations fail fast, then the smoke tiers
 verify: lint serve-smoke vocab-smoke obs-smoke fresh-smoke stream-smoke \
-	fleet-smoke trace-smoke
+	fleet-smoke trace-smoke preempt-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -183,6 +183,27 @@ chaos:
 # tests/test_elastic.py)
 chaos-kill:
 	$(PY) tools/chaos_kill.py
+
+# in-run preemption chaos: a REAL pod-member subprocess is SIGKILLed
+# while the pod trains — the surviving trainer quiesces and resizes IN
+# PLACE (resilience.elastic.elastic_resize, no checkpoint restore
+# round-trip: the ckpt root stays empty), then regrows when a
+# replacement member registers; a SIGTERM'd worker drains gracefully
+# (finish the in-flight step, snapshot, exit 0 within its deadline) and
+# resumes bit-exact. Trajectory checked against an unkilled same-data
+# reference; consumed == steps + skipped across the whole run
+# (tools/chaos_preempt.py; the full run adds a shrink-to-world-1 cycle)
+chaos-preempt:
+	PYTHONPATH=$(CURDIR):$$PYTHONPATH $(PY) tools/chaos_preempt.py
+
+# the make-verify tier of the preemption chaos: fewer steps, same
+# assertions (SIGKILL shrink + regrow with no restore round-trip,
+# SIGTERM drain + bit-exact resume), timeout-guarded like the other
+# smoke tiers (the budget covers the reference + pod + drain relaunch
+# worker processes, each of which compiles its own steps)
+preempt-smoke:
+	PYTHONPATH=$(CURDIR):$$PYTHONPATH timeout -k 10 540 \
+	  $(PY) tools/chaos_preempt.py --smoke
 
 # multi-chip compile/execute validation on 8 virtual CPU devices
 dryrun:

@@ -64,6 +64,12 @@ GL115       error      trace ids / clock epochs are minted only inside
                        ``fleet/``, ``streaming/``) is flagged — ids
                        minted elsewhere never land on one trace, and a
                        second clock-epoch source cannot be correlated
+GL116       error      process signaling (``signal.signal`` /
+                       ``os.kill`` / ``os.killpg``) only inside
+                       ``resilience/`` — preemption handling (SIGTERM
+                       drain, SIGKILL chaos, pid liveness probes) is a
+                       resilience contract; a second handler elsewhere
+                       silently replaces the drain path's disposition
 ==========  =========  =====================================================
 
 Trace-reachable scope (GL101/GL102) is structural: any function nested —
@@ -812,6 +818,73 @@ def _check_raw_minting(mod: ParsedModule) -> List[Finding]:
           "telemetry.estimate_clock_offset(...) for clock handshakes, "
           "so ids land on one trace and clock domains stay "
           "correlated."))
+  return out
+
+
+# GL116 guards: handler installation and real signal delivery (os.kill
+# with a live signal is a kill OR the pid-liveness probe — both are
+# membership/preemption machinery; signal.getsignal is a read and fine)
+_GL116_OS_KILLS = frozenset({"kill", "killpg"})
+
+
+@_rule("GL116", "error",
+       "process signaling (signal.signal / os.kill) only in resilience/")
+def _check_raw_signaling(mod: ParsedModule) -> List[Finding]:
+  # Preemption handling is a resilience contract: the SIGTERM graceful
+  # drain installs the ONE handler (ResilientTrainer.install_sigterm_
+  # drain), the chaos harness's kill_at rule delivers the ONE in-library
+  # SIGKILL (faultinject), and pod-membership liveness probes
+  # (elastic.alive_members) own os.kill(pid, 0). A second
+  # signal.signal(SIGTERM, ...) in any other library module silently
+  # REPLACES the drain disposition — the notice arrives, nothing
+  # snapshots, and the follow-up SIGKILL lands on an undrained step.
+  # Scope: the library package outside resilience/; tools and tests
+  # drive their own processes (the chaos drivers kill real workers).
+  norm = mod.path.replace(os.sep, "/")
+  if "distributed_embeddings_tpu/" not in norm or "/resilience/" in norm:
+    return []
+  # both import spellings, so neither is a lint bypass: module aliases
+  # (`import signal as sg; sg.signal(...)`) and from-imports
+  # (`from os import kill [as k]`)
+  mod_alias = {"signal": {"signal"}, "os": {"os"}}
+  from_names: Dict[str, str] = {}
+  for node in ast.walk(mod.tree):
+    if isinstance(node, ast.Import):
+      for a in node.names:
+        if a.name in mod_alias:
+          mod_alias[a.name].add(a.asname or a.name)
+    elif isinstance(node, ast.ImportFrom):
+      if node.module == "signal":
+        for a in node.names:
+          if a.name == "signal":
+            from_names[a.asname or a.name] = "signal.signal"
+      elif node.module == "os":
+        for a in node.names:
+          if a.name in _GL116_OS_KILLS:
+            from_names[a.asname or a.name] = f"os.{a.name}"
+  out = []
+  for node in ast.walk(mod.tree):
+    if not isinstance(node, ast.Call):
+      continue
+    root, name = _call_pair(node)
+    hit = None
+    if root in mod_alias["signal"] and name == "signal":
+      hit = "signal.signal"
+    elif root in mod_alias["os"] and name in _GL116_OS_KILLS:
+      hit = f"os.{name}"
+    elif root is None and isinstance(node.func, ast.Name) \
+        and node.func.id in from_names:
+      hit = from_names[node.func.id]
+    if hit is not None:
+      out.append(mod.finding(
+          "GL116", node,
+          f"raw {hit}() in a library module: process signal "
+          "dispositions and kills belong to resilience/ — install the "
+          "SIGTERM drain via ResilientTrainer.install_sigterm_drain, "
+          "probe liveness via resilience.elastic.alive_members, and "
+          "leave chaos kills to faultinject.kill_at; suppress with the "
+          "reason stated if this genuinely is not preemption "
+          "handling."))
   return out
 
 

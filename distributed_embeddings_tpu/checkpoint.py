@@ -78,7 +78,15 @@ from .parallel.lookup_engine import (
     class_param_name,
     padded_rows,
 )
+from .resilience import elastic as _elastic
 from .resilience import faultinject
+
+# pytree <-> flat-dict helpers moved to resilience.elastic (the shared
+# regroup engine's home) in round 19; re-exported under the historical
+# names — streaming/serving import them from here
+_to_host = _elastic.to_host
+_flatten_with_paths = _elastic.flatten_with_paths
+_unflatten_like = _elastic.unflatten_like
 
 FORMAT_VERSION = 1
 
@@ -198,66 +206,15 @@ def verify(path: str, only=None) -> List[str]:
   return problems
 
 
-def _to_host(leaf) -> np.ndarray:
-  """Fetch a (replicated) leaf to host, multi-process safe.
-
-  In multi-controller runs even replicated arrays are not fully
-  addressable; the local replica shard carries the full value."""
-  if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
-    shard = leaf.addressable_shards[0]
-    data = np.asarray(shard.data)
-    if tuple(data.shape) != tuple(leaf.shape):
-      raise RuntimeError(
-          f"dense leaf of shape {leaf.shape} is sharded across processes "
-          f"(local shard {data.shape}); checkpoint.save expects "
-          "dense/optimizer state replicated (PartitionSpec())")
-    return data
-  return np.asarray(jax.device_get(leaf))
-
-
-def _flatten_with_paths(tree) -> Dict[str, np.ndarray]:
-  flat = {}
-  for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
-    key = "/".join(
-        str(getattr(p, "key", getattr(p, "name", getattr(p, "idx", p))))
-        for p in path)
-    flat[key] = _to_host(leaf)
-  return flat
-
-
-def _unflatten_like(tree, flat: Dict[str, np.ndarray]):
-  paths = jax.tree_util.tree_leaves_with_path(tree)
-  leaves = []
-  for path, leaf in paths:
-    key = "/".join(
-        str(getattr(p, "key", getattr(p, "name", getattr(p, "idx", p))))
-        for p in path)
-    if key not in flat:
-      raise ValueError(f"checkpoint is missing leaf {key!r}")
-    arr = flat[key]
-    if tuple(arr.shape) != tuple(leaf.shape):
-      raise ValueError(f"leaf {key!r} has shape {arr.shape} in the "
-                       f"checkpoint, expected {tuple(leaf.shape)}")
-    leaves.append(jnp.asarray(arr, dtype=leaf.dtype))
-  struct = jax.tree_util.tree_structure(tree)
-  return jax.tree_util.tree_unflatten(struct, leaves)
-
-
 def _plan_fingerprint(plan: DistEmbeddingStrategy) -> Dict[str, Any]:
   # "layout" pins the PHYSICAL placement, not just the logical tables: two
   # plans with identical tables/world/strategy but different row/column
   # slice thresholds produce different per-rank shard windows, and a
   # checkpoint written under one must not restore under the other (the
   # per-rank files would load rows into the wrong vocab windows).
-  layout = {}
-  for key in plan.class_keys:
-    cp = plan.classes[key]
-    layout[class_param_name(*key)] = [
-        [[s.shard.table_id, s.row_offset, s.shard.row_start,
-          s.shard.input_dim, s.shard.col_start, s.shard.col_end,
-          int(s.shard.row_sliced)]
-         for s in slots]
-        for slots in cp.slots_per_rank]
+  # elastic.plan_layout is the shared spelling: the live in-run resize
+  # describes its source world with exactly this structure.
+  layout = _elastic.plan_layout(plan)
   fp = {
       "world_size": plan.world_size,
       "strategy": plan.strategy,
@@ -291,16 +248,8 @@ def _world_section(plan: DistEmbeddingStrategy) -> Dict[str, Any]:
   separately). Combined with the plan fingerprint's ``layout`` (per-slot
   table row/col windows) this makes a world-shape mismatch a re-shard,
   not a refusal."""
-  classes = {}
-  for key in plan.class_keys:
-    cp = plan.classes[key]
-    classes[class_param_name(*key)] = {
-        "kind": cp.kind,
-        "tier": plan.class_tiers.get(key, "device"),
-        "rows": padded_rows(plan, key),
-        "width": cp.width,
-    }
-  return {"ranks": plan.world_size, "classes": classes}
+  return {"ranks": plan.world_size,
+          "classes": _elastic.plan_world_classes(plan)}
 
 
 def _elastic_reason(manifest: Dict[str, Any], want: Dict[str, Any],
@@ -382,57 +331,19 @@ def _remap_tier_counts(path: str, manifest: Dict[str, Any],
   trip) the re-map is exact. Writes ``store.counts`` in place and
   returns the count-descending ``warm_start`` ranking (ties row-id
   ascending, matching the re-rank's tie policy), or None when the
-  checkpoint carries no counts (pre-tiering or hand-built)."""
-  src_classes = manifest["world"]["classes"]
-  src_layout = manifest["plan"]["layout"]
-  n_src = int(manifest["world"]["ranks"])
+  checkpoint carries no counts (pre-tiering or hand-built). The re-map
+  itself is ``elastic.remap_group_counts`` — shared with the in-run
+  resize, which feeds it live store counts instead of npz files."""
   flat = _load_tier_state_flat(path)
   if not any(k.endswith("/counts") for k in flat):
     return None
-  cfgs = plan.global_configs
-  table_counts: Dict[int, np.ndarray] = {}
-  for cname in sorted(src_classes):
-    meta = src_classes[cname]
-    if meta["tier"] != "host":
-      continue
-    lay = PackedLayout(rows=int(meta["rows"]), width=int(meta["width"]),
-                       n_aux=n_aux)
-    rpp = lay.rows_per_phys
-    for rank in range(n_src):
-      cnt = flat.get(f"{cname}/r{rank}/counts")
-      if cnt is None:
-        continue
-      cnt = np.asarray(cnt, np.int64)
-      for slot in src_layout[cname][rank]:
-        t, off, rs0, nrows, _c0, _c1, _rs = (int(v) for v in slot)
-        tc = table_counts.get(t)
-        if tc is None:
-          tc = table_counts[t] = np.zeros((cfgs[t].input_dim,), np.int64)
-        vals = cnt[(off + np.arange(nrows)) // rpp]
-        np.maximum(tc[rs0:rs0 + nrows], vals, out=tc[rs0:rs0 + nrows])
-  ranking: Dict[str, list] = {}
-  for key in plan.host_tier_class_keys():
-    cp = plan.classes[key]
-    name = class_param_name(*key)
-    lay = store.tplan.by_name(name).layout_logical
-    rpp = lay.rows_per_phys
-    per_rank = []
-    for rank in range(plan.world_size):
-      arr = np.zeros((lay.phys_rows,), np.int64)
-      for sh, off in zip(cp.shards_per_rank[rank],
-                         cp.row_offsets_per_rank[rank]):
-        tc = table_counts.get(sh.table_id)
-        if tc is None:
-          continue
-        grp = (off + np.arange(sh.input_dim)) // rpp
-        np.maximum.at(arr, grp,
-                      tc[sh.row_start:sh.row_start + sh.input_dim])
-      if rank in store.owned_ranks:
-        store.counts[name][rank][:] = arr
-      # count-desc, row-id-asc ties (stable argsort over ascending ids)
-      per_rank.append(np.argsort(-arr, kind="stable").astype(np.int32))
-    ranking[name] = per_rank
-  return ranking
+
+  def counts_of(cname, rank):
+    return flat.get(f"{cname}/r{rank}/counts")
+
+  return _elastic.remap_group_counts(
+      manifest["world"]["classes"], manifest["plan"]["layout"],
+      int(manifest["world"]["ranks"]), n_aux, counts_of, plan, store)
 
 
 def _restore_elastic(path: str, manifest: Dict[str, Any],
@@ -470,7 +381,6 @@ def _restore_elastic(path: str, manifest: Dict[str, Any],
   src_classes = world_meta["classes"]
   src_layout = saved["layout"]
   n_aux = rule.n_aux
-  cfgs = plan.global_configs
 
   tiered_names = frozenset(store.tplan.tier_specs) if store is not None \
       else frozenset()
@@ -486,71 +396,38 @@ def _restore_elastic(path: str, manifest: Dict[str, Any],
         f"host-tier classes {sorted(new_host)}: build the HostTierStore "
         "from a TieringPlan of THIS plan")
 
-  # ---- source index: where each sparse table's rows/cols live -------------
-  # table id -> {(file, layout, row_offset, row_start, rows, c0, c1)};
-  # a set because shared tables list the same shard once per feeding slot
-  src_slots: Dict[int, set] = {}
-  for cname in sorted(src_classes):
-    meta = src_classes[cname]
-    if meta["kind"] != "sparse":
-      continue
-    lay = PackedLayout(rows=int(meta["rows"]), width=int(meta["width"]),
-                       n_aux=n_aux)
-    prefix = "cold" if meta["tier"] == "host" else "fused"
-    for rank in range(n_src):
-      fname = f"{prefix}_{cname}_r{rank}.npy"
-      for slot in src_layout[cname][rank]:
-        t, off, rs0, nrows, c0, c1, _rs = (int(v) for v in slot)
-        src_slots.setdefault(t, set()).add(
-            (fname, lay, off, rs0, nrows, c0, c1))
+  # ---- source index + disk reader for the shared regroup engine ----------
+  # elastic.build_source_index tags each source block (class, rank); the
+  # reader maps the tag to its rank file, memory-maps it, and streams
+  # only the covering physical rows — never the block. The window-wise
+  # re-slicing itself (elastic.regroup_rank_block) is the SAME
+  # implementation the checkpoint-free in-run resize runs over live
+  # device buffers, so the two paths cannot drift.
+  src_slots = _elastic.build_source_index(src_classes, src_layout, n_src,
+                                          n_aux)
 
-  def read_rows(fname, lay, lo, hi) -> np.ndarray:
-    """Logical rows ``[lo, hi)`` of one packed rank file as
-    ``[1 + n_aux, hi - lo, width]`` — memory-mapped: only the covering
-    PHYSICAL rows are materialized, never the block."""
+  def read_rows(tag, lay, lo, hi) -> np.ndarray:
+    cname, rank = tag
+    prefix = "cold" if src_classes[cname]["tier"] == "host" else "fused"
+    fname = f"{prefix}_{cname}_r{rank}.npy"
     faultinject.fire("reshard_gather", file=fname, rows=hi - lo)
-    blk = np.load(os.path.join(path, fname), mmap_mode="r")
-    if blk.shape != (lay.phys_rows, lay.phys_width):
-      raise ValueError(
-          f"elastic restore: {fname} has shape {blk.shape}, but the "
-          f"manifest's world section implies "
-          f"{(lay.phys_rows, lay.phys_width)} — manifest and files "
-          "disagree (corrupt or hand-edited checkpoint)")
-    rpp = lay.rows_per_phys
-    p0, p1 = lo // rpp, -(-hi // rpp)
-    sub = np.asarray(blk[p0:p1])
-    sublay = PackedLayout(rows=(p1 - p0) * rpp, width=lay.width,
-                          n_aux=n_aux)
-    tbl, aux = sublay.unpack(sub)
-    skip = lo - p0 * rpp
-    return np.stack([tbl] + list(aux))[:, skip:skip + (hi - lo)]
+
+    def phys(p0, p1):
+      blk = np.load(os.path.join(path, fname), mmap_mode="r")
+      if blk.shape != (lay.phys_rows, lay.phys_width):
+        raise ValueError(
+            f"elastic restore: {fname} has shape {blk.shape}, but the "
+            f"manifest's world section implies "
+            f"{(lay.phys_rows, lay.phys_width)} — manifest and files "
+            "disagree (corrupt or hand-edited checkpoint)")
+      return np.asarray(blk[p0:p1])
+
+    return _elastic.read_logical_rows(lay, phys, lo, hi, n_aux)
 
   # ---- target: packed rank blocks for the NEW plan, window-streamed -------
   def rank_block(key, lay_log, rank) -> np.ndarray:
-    cp = plan.classes[key]
-    parts = np.zeros((1 + n_aux, lay_log.rows, cp.width), np.float32)
-    for s in cp.slots_per_rank[rank]:
-      sh = s.shard
-      # the saved slots of this table partition its rows x cols, so the
-      # 2-D overlaps below jointly cover the target window exactly —
-      # whatever the two worlds' row/column slicings were
-      for (fname, lay, off_s, rs0_s, n_s, c0_s, c1_s) \
-          in sorted(src_slots[sh.table_id]):
-        r0 = max(sh.row_start, rs0_s)
-        r1 = min(sh.row_start + sh.input_dim, rs0_s + n_s)
-        ca = max(sh.col_start, c0_s)
-        cb = min(sh.col_end, c1_s)
-        if r0 >= r1 or ca >= cb:
-          continue
-        win = read_rows(fname, lay, off_s + (r0 - rs0_s),
-                        off_s + (r1 - rs0_s))
-        parts[:, s.row_offset + (r0 - sh.row_start):
-              s.row_offset + (r1 - sh.row_start),
-              ca - sh.col_start:cb - sh.col_start] = \
-            win[:, :, ca - c0_s:cb - c0_s]
-    return np.asarray(
-        lay_log.pack(parts[0], [parts[1 + j] for j in range(n_aux)]),
-        np.float32)
+    return _elastic.regroup_rank_block(plan, key, lay_log, rank, src_slots,
+                                       read_rows, n_aux)
 
   fused: Dict[str, Any] = {}
   for key in plan.class_keys:
@@ -592,51 +469,6 @@ def _restore_elastic(path: str, manifest: Dict[str, Any],
     store.warm_start(ranking)
     fused.update(store.build_fused(mesh, axis_name))
 
-  # ---- dense-kind (MXU) classes: emb_dense + its optimizer leaves --------
-  src_dense = {n: m for n, m in src_classes.items() if m["kind"] == "dense"}
-
-  def regroup(flat_src: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-    """Re-shard class-block-shaped leaves of a flat (path-keyed) dict
-    onto the new plan; other leaves (optax scalars etc.) pass through."""
-    per_prefix: Dict[str, Dict[int, np.ndarray]] = {}
-    out: Dict[str, np.ndarray] = {}
-    for key_str, arr in flat_src.items():
-      head, _, last = key_str.rpartition("/")
-      meta = src_dense.get(last)
-      if meta is None or getattr(arr, "ndim", 0) != 2 \
-          or arr.shape[0] != n_src * int(meta["rows"]):
-        out[key_str] = arr
-        continue
-      rows_src = int(meta["rows"])
-      per_t = per_prefix.setdefault(head, {})
-      for rank in range(n_src):
-        for slot in src_layout[last][rank]:
-          t, off, rs0, nrows, c0, c1, _rs = (int(v) for v in slot)
-          dstt = per_t.get(t)
-          if dstt is None:
-            dstt = per_t[t] = np.zeros(
-                (cfgs[t].input_dim, cfgs[t].output_dim), arr.dtype)
-          base = rank * rows_src + off
-          dstt[rs0:rs0 + nrows, c0:c1] = arr[base:base + nrows]
-    for head, per_t in per_prefix.items():
-      for key in plan.class_keys:
-        cp = plan.classes[key]
-        if cp.kind == "sparse":
-          continue
-        name = class_param_name(*key)
-        rows_dst = padded_rows(plan, key)
-        dtype = next(iter(per_t.values())).dtype
-        block = np.zeros((plan.world_size * rows_dst, cp.width), dtype)
-        for rank in range(plan.world_size):
-          for s in cp.slots_per_rank[rank]:
-            sh = s.shard
-            base = rank * rows_dst + s.row_offset
-            block[base:base + sh.input_dim] = \
-                per_t[sh.table_id][sh.row_start:sh.row_start + sh.input_dim,
-                                   sh.col_start:sh.col_end]
-        out[(head + "/" + name) if head else name] = block
-    return out
-
   # the id space is table-id-keyed (raw id -> logical table row), so an
   # elastic resize does not touch it: load verbatim — and the telemetry
   # counters are world-shape-free facts about the run, same treatment
@@ -655,7 +487,10 @@ def _restore_elastic(path: str, manifest: Dict[str, Any],
     with np.load(os.path.join(path, f"{part}.npz")) as z:
       flat = dict(z)
     if part in ("emb_dense", "emb_dense_opt"):
-      flat = regroup(flat)
+      # dense-kind (MXU) class blocks + their per-row optimizer leaves
+      # re-shard by the same table windows (shared with the live resize)
+      flat = _elastic.regroup_dense_flat(flat, src_classes, src_layout,
+                                         n_src, plan)
     parts[part] = _unflatten_like(state_like[part], flat)
 
   return {

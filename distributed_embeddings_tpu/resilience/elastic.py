@@ -1,0 +1,760 @@
+"""Live elastic resize: checkpoint-free in-run world changes.
+
+PR 6 made elasticity work *across restarts*: a world-N checkpoint
+restores onto a world-M plan by re-slicing rank blocks at logical-row
+granularity. Production pods lose and gain workers while the job is
+RUNNING — spot reclaims and maintenance events do not wait for a
+checkpoint round-trip — so this module makes the same move IN PLACE:
+
+    quiesce  ->  re-shard rank blocks in memory  ->  resume on the new
+    world, no disk round-trip, every logical row f32 bit-exact.
+
+Three layers live here:
+
+- **The shared regroup engine** (:func:`build_source_index`,
+  :func:`regroup_rank_block`, :func:`regroup_dense_flat`,
+  :func:`remap_group_counts`): the window-streamed logical-row
+  re-slicing that ``checkpoint.restore`` has used for elastic restores
+  since PR 6, factored out so the disk path (memory-mapped ``.npy``
+  rank files) and the in-memory path (live device buffers + host-tier
+  images) are ONE implementation parameterized by a row reader — a
+  bit-exactness fix lands in both at once, and the two paths cannot
+  drift.
+- **:func:`elastic_resize`**: the in-run resize. Quiesces the step
+  (``jax.block_until_ready`` over the whole state, then the
+  ``HostTierStore`` write-back flush — timed into the
+  ``elastic/quiesce_s`` histogram), streams every packed rank block
+  (device ``fused_*`` buffers and host-tier images alike, interleaved
+  optimizer lanes riding along) window-wise through the regroup engine,
+  re-packs onto the new world's mesh via
+  ``jax.make_array_from_callback``, re-derives resident sets and
+  re-maps observed counts for tiered plans, and regroups the MXU-dense
+  class blocks + their optimizer leaves. Counted as
+  ``elastic/resizes``. ``ResilientTrainer.resize`` drives it and keeps
+  the ``consumed == steps + skipped`` accounting conserved across the
+  move.
+- **The preemption supervisor** (:class:`PreemptionSupervisor`,
+  :func:`register_member` / :func:`alive_members`): pod membership as
+  pid-based lease files under ``<pod_dir>/members/``. Workers register
+  a lease; the supervisor's :meth:`~PreemptionSupervisor.target_world`
+  maps the count of live members (lease present AND pid alive — a
+  SIGKILLed worker drops out the instant its process is reaped) onto
+  the largest legal mesh size, so the training loop polls it between
+  steps and resizes when the pod shrinks or regrows.
+  ``tools/chaos_preempt.py`` (``make chaos-preempt``) drives the whole
+  protocol with real SIGKILLs.
+
+Process signaling (``signal.signal`` / ``os.kill``) is a resilience
+contract — graftlint GL116 flags it in library modules outside this
+package, so every signal disposition in the tree is either here, in
+:mod:`.faultinject` (the ``kill_at`` chaos rule), or in
+:meth:`~.trainer.ResilientTrainer.install_sigterm_drain` (the
+preemption-notice drain path).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..layers.planner import DistEmbeddingStrategy
+from ..ops.packed_table import PackedLayout, SparseRule
+from ..parallel.lookup_engine import class_param_name, padded_rows
+from .. import telemetry as _telemetry
+from . import faultinject
+
+# fired once per source window a LIVE resize reads — the in-memory
+# counterpart of checkpoint.restore's "reshard_gather", so chaos can
+# interrupt the resize itself
+RESIZE_GATHER_SITE = faultinject.register_site("resize_gather")
+
+MEMBER_DIR = "members"
+
+
+# ---------------------------------------------------------------------------
+# pytree <-> flat-dict helpers (shared with checkpoint.py, which imports
+# them back under its historical underscore names)
+# ---------------------------------------------------------------------------
+
+
+def to_host(leaf) -> np.ndarray:
+  """Fetch a (replicated) leaf to host, multi-process safe.
+
+  In multi-controller runs even replicated arrays are not fully
+  addressable; the local replica shard carries the full value."""
+  if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+    shard = leaf.addressable_shards[0]
+    data = np.asarray(shard.data)
+    if tuple(data.shape) != tuple(leaf.shape):
+      raise RuntimeError(
+          f"dense leaf of shape {leaf.shape} is sharded across processes "
+          f"(local shard {data.shape}); checkpoint.save expects "
+          "dense/optimizer state replicated (PartitionSpec())")
+    return data
+  return np.asarray(jax.device_get(leaf))
+
+
+def flatten_with_paths(tree) -> Dict[str, np.ndarray]:
+  flat = {}
+  for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+    key = "/".join(
+        str(getattr(p, "key", getattr(p, "name", getattr(p, "idx", p))))
+        for p in path)
+    flat[key] = to_host(leaf)
+  return flat
+
+
+def unflatten_like(tree, flat: Dict[str, np.ndarray],
+                   strict_shapes: bool = True):
+  """Rebuild ``tree``'s structure from a path-keyed flat dict.
+
+  ``strict_shapes=False`` matches STRUCTURE only and takes each leaf's
+  shape from ``flat`` — the elastic paths regroup class-shaped leaves
+  onto a different world, so the template tree's shapes are stale."""
+  paths = jax.tree_util.tree_leaves_with_path(tree)
+  leaves = []
+  for path, leaf in paths:
+    key = "/".join(
+        str(getattr(p, "key", getattr(p, "name", getattr(p, "idx", p))))
+        for p in path)
+    if key not in flat:
+      raise ValueError(f"checkpoint is missing leaf {key!r}")
+    arr = flat[key]
+    if strict_shapes and tuple(arr.shape) != tuple(leaf.shape):
+      raise ValueError(f"leaf {key!r} has shape {arr.shape} in the "
+                       f"checkpoint, expected {tuple(leaf.shape)}")
+    leaves.append(jnp.asarray(arr, dtype=leaf.dtype))
+  struct = jax.tree_util.tree_structure(tree)
+  return jax.tree_util.tree_unflatten(struct, leaves)
+
+
+# ---------------------------------------------------------------------------
+# plan -> source-world description (the manifest's layout/world sections
+# are exactly these, so a live plan and a saved manifest feed the same
+# regroup engine)
+# ---------------------------------------------------------------------------
+
+
+def plan_layout(plan: DistEmbeddingStrategy) -> Dict[str, list]:
+  """Per class, per rank, the slot windows ``[table_id, row_offset,
+  row_start, input_dim, col_start, col_end, row_sliced]`` — the
+  checkpoint plan fingerprint's ``layout`` section, and the regroup
+  engine's description of where every logical table row lives."""
+  layout = {}
+  for key in plan.class_keys:
+    cp = plan.classes[key]
+    layout[class_param_name(*key)] = [
+        [[s.shard.table_id, s.row_offset, s.shard.row_start,
+          s.shard.input_dim, s.shard.col_start, s.shard.col_end,
+          int(s.shard.row_sliced)]
+         for s in slots]
+        for slots in cp.slots_per_rank]
+  return layout
+
+
+def plan_world_classes(plan: DistEmbeddingStrategy) -> Dict[str, dict]:
+  """Per class name: kind / tier / per-rank logical rows / width — the
+  checkpoint manifest's ``world.classes`` section (the packed physical
+  geometry follows from ``PackedLayout(rows, width, rule.n_aux)``)."""
+  classes = {}
+  for key in plan.class_keys:
+    cp = plan.classes[key]
+    classes[class_param_name(*key)] = {
+        "kind": cp.kind,
+        "tier": plan.class_tiers.get(key, "device"),
+        "rows": padded_rows(plan, key),
+        "width": cp.width,
+    }
+  return classes
+
+
+def plan_for_world(plan: DistEmbeddingStrategy,
+                   world: int) -> DistEmbeddingStrategy:
+  """The same tables / strategy / knobs re-planned at ``world`` ranks.
+
+  Every layout-shaping knob the plan retains is forwarded, so the only
+  difference between the two plans is placement — exactly the
+  bridgeable class of mismatch. (A plan built at world 1 coerced its
+  strategy to ``'basic'``; growing such a plan keeps ``'basic'``.)"""
+  return DistEmbeddingStrategy(
+      list(plan.global_configs), int(world), plan.strategy,
+      input_table_map=list(plan.input_table_map),
+      column_slice_threshold=plan.column_slice_threshold,
+      dense_row_threshold=plan.dense_row_threshold,
+      max_class_bytes=plan.max_class_bytes,
+      row_slice_threshold=plan.row_slice_threshold,
+      input_hotness=plan.input_hotness,
+      batch_hint=plan.batch_hint,
+      gen_assignment=plan.gen_assignment,
+      host_row_threshold=plan.host_row_threshold,
+      hbm_budget_bytes=plan.hbm_budget_bytes,
+      oov=plan.oov,
+      vocab_capacity=plan.vocab_capacity,
+      admit_threshold=plan.admit_threshold,
+      evict_ttl=plan.evict_ttl,
+      wire_dtype=plan.wire_dtype,
+      dedup_exchange=plan.dedup_exchange,
+      overlap=plan.overlap,
+      exchange_chunks=plan.exchange_chunks,
+      dedup_capacity=plan.dedup_capacity)
+
+
+def resize_reason(old_plan: DistEmbeddingStrategy,
+                  new_plan: DistEmbeddingStrategy) -> Optional[str]:
+  """None when the old world's state can re-shard in place onto
+  ``new_plan``, else the reason it cannot — the live-plan form of
+  ``checkpoint._elastic_reason``. Bridgeable: anything that only moves
+  logical rows between rank blocks (world size, strategy, slicing,
+  generations). Not bridgeable: different tables, a different
+  input->table map, a table changing storage tier or sparse/dense kind
+  (format conversions, not row moves)."""
+
+  def tables(p):
+    return [[c.input_dim, c.output_dim, c.combiner] for c in p.global_configs]
+
+  def kinds(p):
+    out: Dict[int, str] = {}
+    for key in p.class_keys:
+      cp = p.classes[key]
+      for slots in cp.slots_per_rank:
+        for s in slots:
+          out[s.shard.table_id] = cp.kind
+    return out
+
+  if tables(old_plan) != tables(new_plan):
+    return "the logical tables differ (vocab/width/combiner)"
+  if list(old_plan.input_table_map) != list(new_plan.input_table_map):
+    return "the input->table map differs"
+  ko, kn = kinds(old_plan), kinds(new_plan)
+  for t in sorted(ko):
+    if old_plan.table_tier(t) != new_plan.table_tier(t):
+      return (f"table {t} sits on the {old_plan.table_tier(t)!r} tier in "
+              f"the old world but {new_plan.table_tier(t)!r} in the new — "
+              "cross-tier moves need a format conversion, not an elastic "
+              "re-shard (keep host_row_threshold across the resize)")
+    if ko[t] != kn.get(t):
+      return (f"table {t} is {ko[t]!r}-kind in the old world but "
+              f"{kn.get(t)!r}-kind in the new — the sparse<->dense "
+              "storage formats differ (packed aux lanes vs optax state); "
+              "keep dense_row_threshold across the resize")
+  return None
+
+
+# ---------------------------------------------------------------------------
+# the shared regroup engine (checkpoint.restore's elastic path and
+# elastic_resize both run through these)
+# ---------------------------------------------------------------------------
+
+
+def build_source_index(src_classes: Dict[str, dict],
+                       src_layout: Dict[str, list],
+                       n_src: int, n_aux: int) -> Dict[int, set]:
+  """Where each sparse table's rows/cols live in the SOURCE world:
+  ``table_id -> {((class, rank), layout, row_offset, row_start, rows,
+  c0, c1)}`` — a set because shared tables list the same shard once per
+  feeding slot. The ``(class, rank)`` tag keys the caller's row reader
+  (a rank file on disk, a device buffer or host image in memory)."""
+  out: Dict[int, set] = {}
+  for cname in sorted(src_classes):
+    meta = src_classes[cname]
+    if meta["kind"] != "sparse":
+      continue
+    lay = PackedLayout(rows=int(meta["rows"]), width=int(meta["width"]),
+                       n_aux=n_aux)
+    for rank in range(n_src):
+      for slot in src_layout[cname][rank]:
+        t, off, rs0, nrows, c0, c1, _rs = (int(v) for v in slot)
+        out.setdefault(t, set()).add(
+            ((cname, rank), lay, off, rs0, nrows, c0, c1))
+  return out
+
+
+def read_logical_rows(lay: PackedLayout, phys_reader: Callable,
+                      lo: int, hi: int, n_aux: int) -> np.ndarray:
+  """Logical rows ``[lo, hi)`` of one packed rank block as
+  ``[1 + n_aux, hi - lo, width]``. ``phys_reader(p0, p1)`` returns the
+  covering PHYSICAL rows ``[p0, p1)`` — only those are ever
+  materialized, never the block."""
+  rpp = lay.rows_per_phys
+  p0, p1 = lo // rpp, -(-hi // rpp)
+  sub = np.asarray(phys_reader(p0, p1))
+  sublay = PackedLayout(rows=(p1 - p0) * rpp, width=lay.width, n_aux=n_aux)
+  tbl, aux = sublay.unpack(sub)
+  skip = lo - p0 * rpp
+  return np.stack([tbl] + list(aux))[:, skip:skip + (hi - lo)]
+
+
+def regroup_rank_block(plan: DistEmbeddingStrategy, key,
+                       lay_log: PackedLayout, rank: int,
+                       src_slots: Dict[int, set],
+                       read_rows: Callable, n_aux: int) -> np.ndarray:
+  """One TARGET rank's packed block of a sparse class, window-streamed.
+
+  ``read_rows(tag, lay, lo, hi)`` returns logical rows ``[lo, hi)`` of
+  the source block named by ``tag`` as ``[1 + n_aux, hi - lo, width]``.
+  The saved slots of each table partition its rows x cols, so the 2-D
+  overlaps below jointly cover the target window exactly — whatever the
+  two worlds' row/column slicings were. Pack/unpack are exact inverses,
+  so every logical row (table AND optimizer lanes) is f32 bit-exact
+  across the move; padding rows re-initialize to zero."""
+  cp = plan.classes[key]
+  parts = np.zeros((1 + n_aux, lay_log.rows, cp.width), np.float32)
+  for s in cp.slots_per_rank[rank]:
+    sh = s.shard
+    for (tag, lay, off_s, rs0_s, n_s, c0_s, c1_s) \
+        in sorted(src_slots[sh.table_id]):
+      r0 = max(sh.row_start, rs0_s)
+      r1 = min(sh.row_start + sh.input_dim, rs0_s + n_s)
+      ca = max(sh.col_start, c0_s)
+      cb = min(sh.col_end, c1_s)
+      if r0 >= r1 or ca >= cb:
+        continue
+      win = read_rows(tag, lay, off_s + (r0 - rs0_s),
+                      off_s + (r1 - rs0_s))
+      parts[:, s.row_offset + (r0 - sh.row_start):
+            s.row_offset + (r1 - sh.row_start),
+            ca - sh.col_start:cb - sh.col_start] = \
+          win[:, :, ca - c0_s:cb - c0_s]
+  return np.asarray(
+      lay_log.pack(parts[0], [parts[1 + j] for j in range(n_aux)]),
+      np.float32)
+
+
+def regroup_dense_flat(flat_src: Dict[str, np.ndarray],
+                       src_classes: Dict[str, dict],
+                       src_layout: Dict[str, list],
+                       n_src: int,
+                       plan: DistEmbeddingStrategy) -> Dict[str, np.ndarray]:
+  """Re-shard class-block-shaped leaves of a flat (path-keyed) dict
+  onto the new plan's dense-kind (MXU) classes; other leaves (optax
+  scalars etc.) pass through. Covers ``emb_dense`` and every
+  class-shaped ``emb_dense_opt`` leaf by the same table windows."""
+  src_dense = {n: m for n, m in src_classes.items() if m["kind"] == "dense"}
+  cfgs = plan.global_configs
+  per_prefix: Dict[str, Dict[int, np.ndarray]] = {}
+  out: Dict[str, np.ndarray] = {}
+  for key_str, arr in flat_src.items():
+    head, _, last = key_str.rpartition("/")
+    meta = src_dense.get(last)
+    if meta is None or getattr(arr, "ndim", 0) != 2 \
+        or arr.shape[0] != n_src * int(meta["rows"]):
+      out[key_str] = arr
+      continue
+    rows_src = int(meta["rows"])
+    per_t = per_prefix.setdefault(head, {})
+    for rank in range(n_src):
+      for slot in src_layout[last][rank]:
+        t, off, rs0, nrows, c0, c1, _rs = (int(v) for v in slot)
+        dstt = per_t.get(t)
+        if dstt is None:
+          dstt = per_t[t] = np.zeros(
+              (cfgs[t].input_dim, cfgs[t].output_dim), arr.dtype)
+        base = rank * rows_src + off
+        dstt[rs0:rs0 + nrows, c0:c1] = arr[base:base + nrows]
+  for head, per_t in per_prefix.items():
+    for key in plan.class_keys:
+      cp = plan.classes[key]
+      if cp.kind == "sparse":
+        continue
+      name = class_param_name(*key)
+      rows_dst = padded_rows(plan, key)
+      dtype = next(iter(per_t.values())).dtype
+      block = np.zeros((plan.world_size * rows_dst, cp.width), dtype)
+      for rank in range(plan.world_size):
+        for s in cp.slots_per_rank[rank]:
+          sh = s.shard
+          base = rank * rows_dst + s.row_offset
+          block[base:base + sh.input_dim] = \
+              per_t[sh.table_id][sh.row_start:sh.row_start + sh.input_dim,
+                                 sh.col_start:sh.col_end]
+      out[(head + "/" + name) if head else name] = block
+  return out
+
+
+def remap_group_counts(src_classes: Dict[str, dict],
+                       src_layout: Dict[str, list],
+                       n_src: int, n_aux: int,
+                       counts_of: Callable,
+                       plan: DistEmbeddingStrategy,
+                       store) -> Optional[Dict[str, list]]:
+  """Window-wise re-map of host-tier observed counts across a re-shard.
+
+  ``counts_of(cname, rank)`` returns one source rank's per-physical-row
+  (group) counts, or None when the source carries none. Each covered
+  LOGICAL table row inherits its group's count (overlapping sources
+  merge by max — column slices of one table see the same stream), then
+  each target rank's groups max-pool their logical rows; for unchanged
+  windows an N -> N round trip is exact. Writes ``store.counts`` in
+  place for owned ranks and returns the count-descending ``warm_start``
+  ranking (ties row-id ascending, the re-rank's tie policy), or None
+  when no source counts exist."""
+  cfgs = plan.global_configs
+  table_counts: Dict[int, np.ndarray] = {}
+  found = False
+  for cname in sorted(src_classes):
+    meta = src_classes[cname]
+    if meta["tier"] != "host":
+      continue
+    lay = PackedLayout(rows=int(meta["rows"]), width=int(meta["width"]),
+                       n_aux=n_aux)
+    rpp = lay.rows_per_phys
+    for rank in range(n_src):
+      cnt = counts_of(cname, rank)
+      if cnt is None:
+        continue
+      found = True
+      cnt = np.asarray(cnt, np.int64)
+      for slot in src_layout[cname][rank]:
+        t, off, rs0, nrows, _c0, _c1, _rs = (int(v) for v in slot)
+        tc = table_counts.get(t)
+        if tc is None:
+          tc = table_counts[t] = np.zeros((cfgs[t].input_dim,), np.int64)
+        vals = cnt[(off + np.arange(nrows)) // rpp]
+        np.maximum(tc[rs0:rs0 + nrows], vals, out=tc[rs0:rs0 + nrows])
+  if not found:
+    return None
+  ranking: Dict[str, list] = {}
+  for key in plan.host_tier_class_keys():
+    cp = plan.classes[key]
+    name = class_param_name(*key)
+    lay = store.tplan.by_name(name).layout_logical
+    rpp = lay.rows_per_phys
+    per_rank = []
+    for rank in range(plan.world_size):
+      arr = np.zeros((lay.phys_rows,), np.int64)
+      for sh, off in zip(cp.shards_per_rank[rank],
+                         cp.row_offsets_per_rank[rank]):
+        tc = table_counts.get(sh.table_id)
+        if tc is None:
+          continue
+        grp = (off + np.arange(sh.input_dim)) // rpp
+        np.maximum.at(arr, grp,
+                      tc[sh.row_start:sh.row_start + sh.input_dim])
+      if rank in store.owned_ranks:
+        store.counts[name][rank][:] = arr
+      # count-desc, row-id-asc ties (stable argsort over ascending ids)
+      per_rank.append(np.argsort(-arr, kind="stable").astype(np.int32))
+    ranking[name] = per_rank
+  return ranking
+
+
+# ---------------------------------------------------------------------------
+# the in-run resize
+# ---------------------------------------------------------------------------
+
+
+def elastic_resize(state: Dict[str, Any], old_plan: DistEmbeddingStrategy,
+                   new_world, rule: SparseRule, *,
+                   new_mesh=None, axis_name: str = "mp",
+                   old_store=None, new_store=None, telemetry=None
+                   ) -> Tuple[DistEmbeddingStrategy, Dict[str, Any]]:
+  """Re-shard a LIVE train state onto a different world, in memory.
+
+  The in-run form of ``checkpoint.restore``'s elastic path: no disk
+  round-trip, same regroup engine, same guarantee — every logical row
+  (table AND interleaved optimizer lanes) f32 bit-exact across the
+  move, padding rows re-zeroed (pinned training-neutral since PR 6).
+
+  Args:
+    state: the old world's train state (fused / dense / dense_opt /
+      emb_dense / emb_dense_opt / step).
+    old_plan: the plan ``state`` was built under.
+    new_world: the target — a world size (the new plan is re-derived
+      from ``old_plan``'s knobs via :func:`plan_for_world`) or an
+      already-built ``DistEmbeddingStrategy``.
+    rule: the sparse rule (pins ``n_aux``; unchanged across a resize).
+    new_mesh: the new world's mesh — fused buffers assemble directly as
+      mesh-sharded arrays via ``make_array_from_callback`` (None:
+      unsharded host arrays, the test path).
+    old_store / new_store: the two worlds' ``HostTierStore``s for
+      tiered plans. The quiesce flushes resident device rows into
+      ``old_store``'s images first; the re-sharded images land in
+      ``new_store``, its resident sets re-derive from the new
+      ``TieringPlan``, and the observed counts re-map window-wise (the
+      warm-start ranking survives the resize).
+    telemetry: registry for the ``elastic/resizes`` counter and the
+      ``elastic/quiesce_s`` histogram (default: process-wide).
+
+  Returns ``(new_plan, new_state)``. Unbridgeable plan differences
+  (different tables, cross-tier or kind flips) refuse with the reason
+  named, exactly like the restore path.
+  """
+  reg = telemetry if telemetry is not None else _telemetry.get_registry()
+  new_plan = plan_for_world(old_plan, new_world) \
+      if isinstance(new_world, int) else new_world
+  reason = resize_reason(old_plan, new_plan)
+  if reason is not None:
+    raise ValueError(
+        f"the live state cannot be elastically re-sharded onto the new "
+        f"plan ({reason}).")
+  n_aux = rule.n_aux
+
+  old_tiered = frozenset(old_store.tplan.tier_specs) if old_store is not None \
+      else frozenset()
+  old_host = {class_param_name(*k) for k in old_plan.host_tier_class_keys()}
+  if old_host and old_store is None:
+    raise ValueError(
+        "the old plan has host-tier classes but no HostTierStore was "
+        "passed (old_store=...): their authoritative rows live in its "
+        "images, and the quiesce must flush the resident device rows "
+        "into them first.")
+  new_host = {class_param_name(*k) for k in new_plan.host_tier_class_keys()}
+  if new_host and new_store is None:
+    raise ValueError(
+        "the new plan has host-tier classes but no HostTierStore was "
+        "passed (new_store=...): the re-sharded cold images have "
+        "nowhere to live otherwise.")
+  if new_store is not None \
+      and set(new_store.tplan.tier_specs) != new_host:
+    raise ValueError(
+        f"new_store geometry {sorted(new_store.tplan.tier_specs)} does "
+        f"not cover the new plan's host-tier classes {sorted(new_host)}: "
+        "build the HostTierStore from a TieringPlan of the NEW plan")
+  for label, st, world_n in (("old_store", old_store,
+                              old_plan.world_size),
+                             ("new_store", new_store,
+                              new_plan.world_size)):
+    if st is not None and len(st.owned_ranks) != world_n:
+      raise NotImplementedError(
+          f"{label} owns ranks {list(st.owned_ranks)} of {world_n}: "
+          "the in-memory elastic resize reads and writes EVERY rank's "
+          "host-tier image (unowned images are not materialized, and "
+          "unowned observed counts would silently drop from the "
+          "warm-start re-map); rank-owner-sharded (multi-process) pods "
+          "resize through the checkpoint restore path.")
+
+  # ---- quiesce: nothing may be in flight while blocks are read ----------
+  # block_until_ready drains the dispatched step (jax dispatch is
+  # asynchronous — a resize racing an uncommitted scatter would read
+  # pre-update rows), then the write-back flush makes the host images
+  # authoritative for every resident row.
+  with _telemetry.timed("elastic/quiesce_s", reg):
+    jax.block_until_ready([leaf for leaf in jax.tree_util.tree_leaves(state)
+                           if isinstance(leaf, jax.Array)])
+    if old_store is not None:
+      old_store.flush(state["fused"])
+
+  # ---- source index over the live old world ------------------------------
+  src_classes = plan_world_classes(old_plan)
+  src_layout = plan_layout(old_plan)
+  n_src = old_plan.world_size
+  src_slots = build_source_index(src_classes, src_layout, n_src, n_aux)
+
+  def read_rows(tag, lay, lo, hi):
+    cname, rank = tag
+    faultinject.fire("resize_gather", clazz=cname, rank=rank, rows=hi - lo)
+    if cname in old_tiered:
+      img = old_store.images[cname][rank]
+      reader = lambda p0, p1, img=img: img[p0:p1]  # noqa: E731
+    else:
+      arr = state["fused"][cname]
+      if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+        raise NotImplementedError(
+            "in-memory elastic resize indexes the global fused buffers "
+            "and requires fully-addressable arrays (single-controller); "
+            "multi-controller pods resize through the checkpoint "
+            "restore path.")
+      base = rank * lay.phys_rows
+      # one window device_get at a time — peak host memory stays one
+      # target rank block plus one source window, like the restore path
+      reader = lambda p0, p1, arr=arr, base=base: np.asarray(  # noqa: E731
+          jax.device_get(arr[base + p0:base + p1]))
+    return read_logical_rows(lay, reader, lo, hi, n_aux)
+
+  # ---- target: packed rank blocks for the NEW plan, window-streamed ------
+  new_tiered = frozenset(new_store.tplan.tier_specs) if new_store is not None \
+      else frozenset()
+  fused: Dict[str, Any] = {}
+  for key in new_plan.class_keys:
+    cp = new_plan.classes[key]
+    if cp.kind != "sparse":
+      continue
+    name = class_param_name(*key)
+    lay_log = PackedLayout(rows=padded_rows(new_plan, key), width=cp.width,
+                           n_aux=n_aux)
+    if name in new_tiered:
+      for rank in new_store.owned_ranks:
+        new_store.set_image(
+            name, rank,
+            regroup_rank_block(new_plan, key, lay_log, rank, src_slots,
+                               read_rows, n_aux))
+      continue
+    shape = (new_plan.world_size * lay_log.phys_rows, lay_log.phys_width)
+    if new_mesh is None:
+      fused[name] = jnp.asarray(np.concatenate(
+          [regroup_rank_block(new_plan, key, lay_log, r, src_slots,
+                              read_rows, n_aux)
+           for r in range(new_plan.world_size)]))
+    else:
+      sharding = NamedSharding(new_mesh, P(axis_name, None))
+
+      def cb(index, key=key, lay_log=lay_log):
+        rank = (index[0].start or 0) // lay_log.phys_rows
+        return regroup_rank_block(new_plan, key, lay_log, rank, src_slots,
+                                  read_rows, n_aux)
+
+      fused[name] = jax.make_array_from_callback(shape, sharding, cb)
+
+  if new_store is not None and new_tiered:
+    # resident sets / staging geometry re-derive from the new
+    # TieringPlan; observed counts re-map window-wise so the warm-start
+    # hot set is the old world's ranking — no re-rank interval of
+    # warmup after the resize
+    def counts_of(cname, rank):
+      if old_store is None or cname not in old_store.counts:
+        return None
+      return old_store.counts[cname][rank]
+
+    ranking = remap_group_counts(src_classes, src_layout, n_src, n_aux,
+                                 counts_of, new_plan, new_store)
+    if ranking is None:
+      for name in new_store.counts:
+        for rank in new_store.owned_ranks:
+          new_store.counts[name][rank][:] = 0
+    new_store.warm_start(ranking)
+    fused.update(new_store.build_fused(new_mesh, axis_name))
+
+  # ---- dense-kind (MXU) classes + replicated parts ------------------------
+  parts = {}
+  for part in ("dense", "dense_opt", "emb_dense", "emb_dense_opt"):
+    flat = flatten_with_paths(state[part])
+    if part in ("emb_dense", "emb_dense_opt"):
+      flat = regroup_dense_flat(flat, src_classes, src_layout, n_src,
+                                new_plan)
+    parts[part] = unflatten_like(state[part], flat, strict_shapes=False)
+
+  reg.counter("elastic/resizes").inc()
+  return new_plan, {
+      **parts,
+      "fused": fused,
+      "step": jnp.asarray(int(to_host(state["step"])), jnp.int32),
+  }
+
+
+# ---------------------------------------------------------------------------
+# pod membership + preemption supervision
+# ---------------------------------------------------------------------------
+
+
+def member_path(pod_dir: str, member_id: str) -> str:
+  return os.path.join(pod_dir, MEMBER_DIR, f"{member_id}.json")
+
+
+def proc_start_ticks(pid: int) -> Optional[int]:
+  """Kernel start time of ``pid`` in clock ticks (``/proc/<pid>/stat``
+  field 22), or None when the process is gone or /proc is unavailable
+  (non-Linux). Pins a lease to one INCARNATION of a pid: a recycled
+  pid has a different start time, so a stale lease whose pid the OS
+  handed to an unrelated process does not count as alive. Field 2
+  (comm) may contain spaces/parens — parse from the LAST ``)``."""
+  try:
+    with open(f"/proc/{pid}/stat", "rb") as f:
+      data = f.read()
+    return int(data[data.rindex(b")") + 1:].split()[19])
+  except (OSError, ValueError, IndexError):
+    return None
+
+
+def register_member(pod_dir: str, member_id: str,
+                    pid: Optional[int] = None) -> int:
+  """Register one worker's liveness lease under ``<pod_dir>/members/``.
+
+  The lease is pid-based, not heartbeat-based: a SIGKILLed worker
+  cannot write a goodbye, but its pid stops existing the moment the
+  parent reaps it — :func:`alive_members` probes exactly that, so loss
+  detection needs no TTL tuning. Written atomically (the telemetry
+  layer's fsync + replace), so a scan never reads a torn lease."""
+  from ..telemetry import atomic_write_text
+  os.makedirs(os.path.join(pod_dir, MEMBER_DIR), exist_ok=True)
+  pid = os.getpid() if pid is None else int(pid)
+  atomic_write_text(member_path(pod_dir, member_id),
+                    json.dumps({"id": member_id, "pid": pid,
+                                "start": proc_start_ticks(pid)}))
+  return pid
+
+
+def withdraw_member(pod_dir: str, member_id: str) -> None:
+  """Remove a lease — the GRACEFUL leave (a SIGTERM-drained worker
+  withdraws before exit; a SIGKILLed one cannot, and its dead pid
+  drops it from the scan instead)."""
+  try:
+    os.remove(member_path(pod_dir, member_id))
+  except OSError:
+    pass
+
+
+def alive_members(pod_dir: str) -> Dict[str, int]:
+  """``id -> pid`` of members whose lease exists AND whose pid is
+  alive. Unreadable/foreign files are skipped (the heartbeat-scan
+  robustness convention); a pid we may not signal still counts as
+  alive (EPERM means it exists)."""
+  out: Dict[str, int] = {}
+  d = os.path.join(pod_dir, MEMBER_DIR)
+  try:
+    names = os.listdir(d)
+  except OSError:
+    return out
+  for name in sorted(names):
+    if not name.endswith(".json"):
+      continue
+    try:
+      with open(os.path.join(d, name)) as f:
+        rec = json.load(f)
+      pid = int(rec["pid"])
+      mid = str(rec["id"])
+    except (OSError, ValueError, KeyError, TypeError):
+      continue
+    try:
+      os.kill(pid, 0)  # liveness probe: signal 0 delivers nothing
+    except ProcessLookupError:
+      continue  # dead (and reaped): the lease is stale
+    except PermissionError:
+      pass  # exists, owned by another user: alive
+    start = rec.get("start")
+    if start is not None:
+      cur = proc_start_ticks(pid)
+      if cur is not None and cur != int(start):
+        continue  # pid recycled: the lease's own process is gone
+    out[mid] = pid
+  return out
+
+
+class PreemptionSupervisor:
+  """Maps live pod membership onto the world the run should be.
+
+  Between steps the training loop asks :meth:`target_world`; when the
+  answer differs from the current world it quiesces and resizes in
+  place (``ResilientTrainer.resize``) — shrink when a worker was
+  SIGKILLed, regrow when a replacement registered. No checkpoint
+  round-trip is involved at any point.
+
+  Args:
+    pod_dir: the directory whose ``members/`` leases define the pod.
+    allowed_worlds: legal mesh sizes (ascending; e.g. the divisors of
+      the device count the batch also divides by).
+      ``target_world() = max(w in allowed_worlds with w <= alive)``,
+      clamped to the smallest allowed world — a pod must keep training
+      on its last survivor, not divide by zero."""
+
+  def __init__(self, pod_dir: str, allowed_worlds=(1, 2, 4, 8)):
+    worlds = tuple(sorted(set(int(w) for w in allowed_worlds)))
+    if not worlds or worlds[0] < 1:
+      raise ValueError(
+          f"allowed_worlds must name at least one world >= 1, got "
+          f"{allowed_worlds!r}")
+    self.pod_dir = pod_dir
+    self.allowed_worlds = worlds
+
+  def members(self) -> Dict[str, int]:
+    return alive_members(self.pod_dir)
+
+  def target_world(self) -> int:
+    n = len(self.members())
+    fit = [w for w in self.allowed_worlds if w <= n]
+    return fit[-1] if fit else self.allowed_worlds[0]

@@ -13,9 +13,14 @@ Policy notes:
   including :class:`faultinject.InjectedCrash` and real ``IndexError``
   bounds violations — propagates immediately. A retry loop that eats a
   correctness error turns a crash into silent data corruption.
-- Backoff is deterministic (no jitter): ``backoff * 2**attempt`` seconds.
-  These are single-controller host-side calls, not a thundering herd of
-  clients against one service; determinism buys reproducible tests.
+- Backoff defaults to deterministic exponential (``backoff *
+  2**attempt`` seconds, no jitter) — reproducible tests, and fine for a
+  lone single-controller host. ``jitter='full'`` draws each sleep
+  uniformly from ``[0, that cap]`` (AWS full jitter): an elastically
+  resized pod has MANY workers whose retries against the same shared
+  filesystem or cold store would otherwise fire on identical schedules
+  — thundering-herd shaped. ``seed`` pins the draw sequence so jittered
+  tests stay exact (None: OS entropy, the production decorrelation).
 - When retries are exhausted the LAST exception is re-raised with the
   attempt count noted, so the root cause is never swallowed.
 """
@@ -35,9 +40,33 @@ class RetryPolicy:
   backoff: float = 0.05      # base sleep seconds; doubles per attempt
   max_backoff: float = 2.0
   retry_on: Tuple[Type[BaseException], ...] = (OSError,)
+  # "none": sleep exactly the exponential cap (deterministic, the
+  # historical behavior). "full": sleep uniform(0, cap) — decorrelates
+  # a resized pod's workers retrying the same storage on one schedule.
+  jitter: str = "none"
+  # full-jitter determinism knob: a fixed seed reproduces the exact
+  # sleep sequence per retried call (tests); None draws OS entropy.
+  seed: Optional[int] = None
 
-  def sleep_for(self, attempt: int) -> float:
-    return min(self.backoff * (2 ** attempt), self.max_backoff)
+  def __post_init__(self):
+    if self.jitter not in ("none", "full"):
+      raise ValueError(
+          f"jitter must be 'none' or 'full', got {self.jitter!r}")
+
+  def make_rng(self):
+    """One RNG per retried CALL (not per policy — a frozen shared
+    policy object must not thread hidden mutable state between
+    callers): None under deterministic backoff."""
+    if self.jitter == "none":
+      return None
+    import random
+    return random.Random(self.seed)
+
+  def sleep_for(self, attempt: int, rng=None) -> float:
+    cap = min(self.backoff * (2 ** attempt), self.max_backoff)
+    if rng is None:
+      return cap
+    return rng.uniform(0.0, cap)
 
 
 DEFAULT_POLICY = RetryPolicy()
@@ -56,6 +85,7 @@ def retry_call(fn: Callable, *args,
   from ..telemetry import counter as _counter
 
   attempt = 0
+  rng = policy.make_rng()  # full-jitter draws; None = deterministic
   while True:
     try:
       return fn(*args, **kwargs)
@@ -67,7 +97,7 @@ def retry_call(fn: Callable, *args,
       _counter("retry/attempts").inc()
       if on_retry is not None:
         on_retry(attempt, e)
-      sleep(policy.sleep_for(attempt))
+      sleep(policy.sleep_for(attempt, rng))
       attempt += 1
 
 

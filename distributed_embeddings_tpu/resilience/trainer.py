@@ -27,6 +27,7 @@ stream without that batch (pinned by tests/test_resilience.py).
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Any, Dict, Iterable, List, Optional
 
@@ -248,6 +249,12 @@ class ResilientTrainer:
     # skip that preceded the snapshot. Persisted in each checkpoint's
     # manifest (``extra``) and restored with it.
     self.consumed = 0
+    # SIGTERM graceful drain (install_sigterm_drain): the preemption
+    # NOTICE path — finish the in-flight step, snapshot, exit clean
+    self._drain_requested = threading.Event()
+    self._drained = threading.Event()  # watchdog disarm (set on failure too)
+    self._drain_ok = False             # drain snapshot durably on disk
+    self.drain_deadline_s: Optional[float] = None
     self._last_snapshot = self.step_count if not resume else None
     if resume:
       self.maybe_resume()
@@ -355,6 +362,196 @@ class ResilientTrainer:
       self.dedup_overflow_totals = {
           str(k): int(v)
           for k, v in extra.get("dedup_overflow", {}).items()}
+    return True
+
+  # ---- live elastic resize (checkpoint-free in-run world change) ---------
+  def resize(self, new_plan, step_fn=None, *, new_mesh=None,
+             new_store=None, tiered_factory=None, reason: str = ""):
+    """Checkpoint-free IN-RUN world change: quiesce, re-shard every rank
+    block in memory (:func:`resilience.elastic.elastic_resize` — the
+    same window-wise regroup path ``checkpoint.restore`` uses for
+    elastic restores), swap in the new world's step function, and keep
+    training. No restore round-trip: ``resumed_from`` does not change,
+    the checkpoint root is untouched, and the cumulative accounting
+    (``consumed``, ``skipped_steps``, OOV/overflow totals, the bad-step
+    streak) carries across unchanged — ``consumed == step_count +
+    skipped_steps`` is conserved through any shrink/grow sequence
+    (pinned by tests/test_preempt.py and ``make chaos-preempt``).
+
+    Sparse mode: pass ``step_fn`` built against the new plan/mesh
+    (``make_sparse_train_step`` traces against shapes, not values, so a
+    freshly-initialized new-world state serves as its template).
+
+    Tiered mode: pass ``new_store`` (the NEW world's ``HostTierStore``
+    — the re-sharded images land in it, resident sets re-derive, and
+    the observed counts re-map window-wise) and
+    ``tiered_factory(new_state) -> TieredTrainer`` built around that
+    store. The new TieredTrainer adopts the old one's cumulative
+    hit/skip/OOV bookkeeping so nothing is lost or double-counted.
+
+    A ``DeltaPublisher`` (``stream=...``) is explicitly RE-ROOTED after
+    the resize (``DeltaPublisher.re_root``): the chain's plan
+    fingerprint pins the world shape, so the old chain cannot continue
+    — re-rooting here (counted ``stream/re_roots``, reason recorded in
+    the new base manifest) replaces the old failure mode of the next
+    publish raising ``ChainDivergedError`` and the operator wiping the
+    pubdir by hand. Subscribers adopt via the existing new-base rebase
+    path.
+
+    ``new_plan`` may be a world size (int) — the plan is then re-derived
+    from the current plan's knobs (``elastic.plan_for_world``). Returns
+    the new plan."""
+    if self.dynvocab is not None:
+      raise NotImplementedError(
+          "resize with dynvocab=...: the translator state is "
+          "world-free, but the DynVocabTrainer's translate/step wiring "
+          "is not rebuilt in place yet — snapshot and relaunch at the "
+          "new world instead (the elastic restore path preserves the id "
+          "space exactly).")
+    if self.writer_active:
+      # an in-flight async snapshot reads the OLD state's buffers
+      self.join_writer()
+    from . import elastic as _elastic
+
+    old_world = self.plan.world_size
+    if self.tiered is not None:
+      if tiered_factory is None or new_store is None:
+        raise ValueError(
+            "resize of a tiered trainer needs new_store (the new "
+            "world's HostTierStore) and tiered_factory(new_state) -> "
+            "TieredTrainer built around it")
+    elif step_fn is None:
+      raise ValueError(
+          "resize needs the new world's step_fn (build it with "
+          "make_sparse_train_step against the new plan/mesh before "
+          "calling resize)")
+    if self.mesh is not None and new_mesh is None:
+      raise ValueError(
+          "this trainer runs on a device mesh; pass new_mesh (the NEW "
+          "world's mesh) — resizing onto unsharded host arrays would "
+          "silently stop placing state and batches on devices")
+    new_plan, new_state = _elastic.elastic_resize(
+        self.state, self.plan, new_plan, self.rule,
+        new_mesh=new_mesh, axis_name=self.axis_name,
+        old_store=self.store, new_store=new_store,
+        telemetry=self.telemetry)
+    if self.tiered is not None:
+      old_t = self.tiered
+      new_t = tiered_factory(new_state)
+      if not getattr(new_t, "guard", False):
+        raise ValueError(
+            "tiered_factory must build a guard=True TieredTrainer (the "
+            "same requirement as ResilientTrainer(tiered=...)).")
+      new_t.telemetry = self.telemetry
+      new_t.prefetcher.telemetry = self.telemetry
+      # the protocol's cumulative bookkeeping survives the resize — the
+      # conservation story is end-to-end, not per-world
+      new_t.steps = old_t.steps
+      new_t.bad_steps = old_t.bad_steps
+      new_t.oov_totals = dict(old_t.oov_totals)
+      new_t.dedup_overflow_totals = dict(old_t.dedup_overflow_totals)
+      for name, m in old_t.hits.items():
+        if name in new_t.hits:
+          new_t.hits[name] = new_t.hits[name] + m
+      pf_old, pf_new = old_t.prefetcher, new_t.prefetcher
+      pf_new.total_host_gather_bytes = pf_old.total_host_gather_bytes
+      pf_new.spill_steps = pf_old.spill_steps
+      pf_new.host_gather_retries = pf_old.host_gather_retries
+      new_t.state = new_state
+      new_t.prefetcher.refresh_resident()
+      self.tiered = new_t
+      self.store = new_t.store
+    else:
+      self._step_fn = step_fn
+      self.store = new_store
+    self.state = new_state
+    self.plan = new_plan
+    self.mesh = new_mesh
+    if self.stream is not None:
+      from ..streaming.generations import RowGenerationTracker
+      self.stream.re_root(
+          self.state,
+          reason=reason or (f"elastic resize world {old_world} -> "
+                            f"{new_plan.world_size}"),
+          plan=new_plan, tracker=RowGenerationTracker(new_plan),
+          store=self.store)
+    return new_plan
+
+  # ---- SIGTERM graceful drain (the preemption NOTICE path) ---------------
+  def install_sigterm_drain(self, deadline_s: float = 30.0) -> None:
+    """Arm the preemption-notice path: on SIGTERM, finish the in-flight
+    step, take one durable snapshot, and let the caller exit 0 — all
+    within ``deadline_s`` of the signal.
+
+    The handler only sets a flag (Python delivers it between bytecodes
+    of the main thread, so a step already dispatched into XLA runs to
+    completion first — exactly "finish the in-flight step") and arms a
+    watchdog. :meth:`run` checks the flag after every step and calls
+    :meth:`maybe_drain`; custom loops call it themselves. The watchdog
+    guards HANGS, not failures: if the drain has not completed when the
+    deadline passes it hard-exits (status 3) — the notice window is
+    about to end in a SIGKILL, and dying now with the previous
+    checkpoint intact beats dying mid-manifest later (the durable
+    protocol makes the torn ``.tmp`` harmless either way). A snapshot
+    that RAISES disarms the watchdog and propagates — the caller exits
+    nonzero promptly on its own.
+
+    Main-thread only (``signal.signal``'s own constraint); call once,
+    early. Process signaling is a resilience/ contract — graftlint
+    GL116 keeps it out of other library modules."""
+    import signal
+
+    self.drain_deadline_s = float(deadline_s)
+
+    def _handler(signum, frame):
+      del signum, frame
+      if self._drain_requested.is_set():
+        return  # a second notice changes nothing; the first deadline holds
+      self._drain_requested.set()
+      threading.Thread(target=self._drain_watchdog,
+                       name="sigterm-drain-watchdog", daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _handler)
+
+  def _drain_watchdog(self) -> None:
+    if not self._drained.wait(self.drain_deadline_s):
+      os._exit(3)  # drain overran the notice window: see install docstring
+
+  @property
+  def drain_requested(self) -> bool:
+    """A SIGTERM preemption notice arrived (drain pending or done)."""
+    return self._drain_requested.is_set()
+
+  @property
+  def drained(self) -> bool:
+    """The drain snapshot is durably on disk; exiting 0 is safe.
+
+    False while the drain is pending AND after a drain snapshot that
+    RAISED — watchdog disarming is tracked separately, so a failed
+    drain never reads as a completed one (exiting 0 on it would record
+    a clean drain with no snapshot behind it)."""
+    return self._drain_ok
+
+  def maybe_drain(self) -> bool:
+    """Complete a requested SIGTERM drain; returns True when the caller
+    should stop feeding batches and exit 0 (False: no notice arrived,
+    keep training). Idempotent on success — the snapshot is taken once
+    and repeated calls keep returning True; a snapshot that RAISES
+    propagates (the caller exits nonzero) and the next call retries it,
+    so :attr:`drained` only ever turns True on a durable snapshot."""
+    if not self._drain_requested.is_set():
+      return False
+    if not self._drain_ok:
+      try:
+        self.join_writer()
+        self.snapshot()
+        self.telemetry.counter("train/sigterm_drains").inc()
+        self._drain_ok = True
+      finally:
+        # disarm the watchdog on failure too: the raised exception
+        # propagates to the caller, which exits nonzero on its own —
+        # the watchdog exists for hangs, and a hang never reaches here
+        self._drained.set()
     return True
 
   def snapshot(self, async_: bool = False) -> str:
@@ -616,9 +813,14 @@ class ResilientTrainer:
     for batch in batches:
       if self.tiered is not None or self.dynvocab is not None:
         losses.append(self.step(*batch))
-        continue
-      sb = shard_batch(tuple(batch), self.mesh, self.axis_name)
-      losses.append(self.step(*sb))
+      else:
+        sb = shard_batch(tuple(batch), self.mesh, self.axis_name)
+        losses.append(self.step(*sb))
+      if self.maybe_drain():
+        # SIGTERM preemption notice: the in-flight step finished and a
+        # drain snapshot is durably down — stop consuming the stream
+        # (a relaunch resumes at trainer.consumed, bit-exact)
+        break
     self.join_writer()  # a run's last periodic snapshot must be durable
     if snapshot_final:
       self.snapshot()

@@ -52,7 +52,8 @@ from ..telemetry import flight as _flight
 from ..telemetry import trace as _trace
 
 
-REJECT_REASONS = ("queue_full", "deadline_expired", "priority_shed")
+REJECT_REASONS = ("queue_full", "deadline_expired", "priority_shed",
+                  "flusher_died")
 
 
 class Rejected(RuntimeError):
@@ -65,7 +66,12 @@ class Rejected(RuntimeError):
   - ``'deadline_expired'``: the request's own deadline passed before a
     flush could dispatch it;
   - ``'priority_shed'``: a higher-priority request evicted this one
-    from the full queue.
+    from the full queue;
+  - ``'flusher_died'``: the batcher's flusher or completer thread died
+    of an unexpected exception — every queued request failed with this
+    reason instead of hanging forever, the flight recorder tripped,
+    and ``/healthz`` names the dead thread (the batcher is closed;
+    rebuild it).
 
   Each reason has its own counter (``serve/rejected/<reason>``);
   ``serve/rejected`` stays the exact total."""
@@ -157,6 +163,11 @@ class MicroBatcher:
       exactly-counted per batcher, and two batchers sharing names would
       merge counts. Pass ``telemetry.get_registry()`` to publish into
       the process-wide registry. ``stats`` stays the classic dict view.
+    name: thread-name prefix (``<name>-flush`` / ``<name>-complete``),
+      and therefore the key of the per-thread ``/healthz`` dead-thread
+      gauges. Give each batcher SHARING a registry its own name, or a
+      rebuild of one batcher cannot be told apart from its siblings on
+      the readiness plane.
   """
 
   def __init__(self, dispatch_fn: Callable, max_batch: int,
@@ -164,7 +175,8 @@ class MicroBatcher:
                queue_rows: Optional[int] = None,
                pipeline_depth: int = 2,
                start: bool = True,
-               registry: Optional[MetricsRegistry] = None):
+               registry: Optional[MetricsRegistry] = None,
+               name: str = "serve-batcher"):
     if max_batch < 1:
       raise ValueError(f"max_batch must be >= 1, got {max_batch}")
     self.dispatch_fn = dispatch_fn
@@ -190,15 +202,118 @@ class MicroBatcher:
                                                            pipeline_depth))
     self._flusher: Optional[threading.Thread] = None
     self._completer: Optional[threading.Thread] = None
+    # (thread name, exception) once a worker thread died unexpectedly
+    self._dead: Optional[tuple] = None
+    # requests a dying thread had already popped from a queue (neither
+    # pending nor in-flight — they would be invisible to the drain)
+    self._orphans: List[_Pending] = []
+    # a REBUILT batcher on the same registry supersedes the dead one
+    # with the SAME name (the Rejected message says "rebuild the
+    # batcher"): clear ITS OWN dead-thread gauges only — a still-dead
+    # sibling batcher (distinct name=) must keep /healthz failing — and
+    # re-derive the unkeyed aggregate from whatever keyed gauges remain
+    from ..telemetry.http import DEAD_THREAD_GAUGE_STEM
+    self._flush_name = f"{name}-flush"
+    self._complete_name = f"{name}-complete"
+    metrics = self.telemetry.metrics()
+    for t in (self._flush_name, self._complete_name):
+      key = f"{DEAD_THREAD_GAUGE_STEM}/{t}"
+      if key in metrics:
+        self.telemetry.gauge(key).set(0)
+    if DEAD_THREAD_GAUGE_STEM in metrics:
+      others = any(
+          n.startswith(DEAD_THREAD_GAUGE_STEM + "/") and m.value
+          for n, m in self.telemetry.metrics().items())
+      self.telemetry.gauge(DEAD_THREAD_GAUGE_STEM).set(1 if others else 0)
     if start:
-      self._flusher = threading.Thread(target=self._flush_loop,
-                                       name="serve-batcher-flush",
-                                       daemon=True)
-      self._completer = threading.Thread(target=self._complete_loop,
-                                         name="serve-batcher-complete",
-                                         daemon=True)
+      self._flusher = threading.Thread(
+          target=self._guarded_loop,
+          args=(self._flush_name, self._flush_loop),
+          name=self._flush_name, daemon=True)
+      self._completer = threading.Thread(
+          target=self._guarded_loop,
+          args=(self._complete_name, self._complete_loop),
+          name=self._complete_name, daemon=True)
       self._flusher.start()
       self._completer.start()
+
+  # ---- worker-thread death (no request may hang forever) ------------------
+  def _guarded_loop(self, name: str, loop: Callable) -> None:
+    try:
+      loop()
+    except BaseException as e:  # noqa: BLE001 — the thread IS the engine
+      # room: an escaped exception here used to kill the thread silently
+      # and leave every queued waiter blocked forever
+      self._on_worker_death(name, e)
+
+  def _on_worker_death(self, name: str, exc: BaseException) -> None:
+    """A flusher/completer thread died of an UNEXPECTED exception (a
+    dispatch failure is expected and delivered per request; this is a
+    bug in the batcher's own machinery or a monkey-wrenched callback).
+    Queued requests would otherwise hang forever: fail every pending
+    and in-flight request with a counted ``flusher_died`` shed, close
+    the batcher, trip the flight recorder (via the shed path), and
+    surface the dead thread through the gauge ``/healthz`` scans
+    (``telemetry.http.DEAD_THREAD_GAUGE_STEM`` — visible when the
+    batcher shares the probe's registry)."""
+    from ..telemetry.http import DEAD_THREAD_GAUGE_STEM
+    with self._nonempty:
+      if self._dead is None:
+        self._dead = (name, exc)
+      self._closed = True
+      pending = self._pending[:]
+      self._pending.clear()
+      self._pending_rows = 0
+      self._nonempty.notify_all()
+    self.telemetry.gauge(DEAD_THREAD_GAUGE_STEM).set(1)
+    self.telemetry.gauge(f"{DEAD_THREAD_GAUGE_STEM}/{name}").set(1)
+    # one shed count PER failed request (the exact-accounting contract)
+    orphans, self._orphans = self._orphans, []
+    for p in pending + orphans:
+      if not p.future.done():
+        p.future._fail(self._dead_rejected())
+    self._drain_inflight_dead()
+
+  def _drain_inflight_dead(self) -> None:
+    """Fail every dispatched-but-uncompleted in-flight item: their
+    waiters block on the completer, which may be the thread that just
+    died (and a flusher blocked on a full in-flight queue is unblocked
+    by this). Called by the death handler AND by ``_dispatch`` after an
+    enqueue that raced the handler's one-shot drain — idempotent
+    (already-failed futures are skipped), so both draining is safe and
+    no item can land in the queue after the last drain unseen."""
+    _name, exc = self._dead
+    items = []
+    while True:
+      try:
+        item = self._inflight.get_nowait()
+      except _queue.Empty:
+        break
+      if item is not None:
+        items.append(item)
+    try:
+      self._inflight.put_nowait(None)  # stop the surviving loop thread
+    except _queue.Full:
+      pass
+    for taken, _out, rec, _ctx, fr in items:
+      for p in taken:
+        if not p.future.done():
+          p.future._fail(self._dead_rejected())
+      if fr is not None and rec is not None:
+        try:
+          fr.end(rec, error=exc)
+        except BaseException:  # noqa: BLE001 — a broken recorder may be
+          pass  # WHY the thread died; it must not abort the drain and
+          # strand the remaining items' waiters
+
+  def _dead_rejected(self) -> Rejected:
+    name, exc = self._dead
+    return self._reject(
+        "flusher_died",
+        f"MicroBatcher thread {name!r} died: {exc!r} — the batcher is "
+        "closed; queued requests were failed (counted "
+        "serve/rejected/flusher_died) and /healthz reports the dead "
+        "thread. Rebuild the batcher; re-submit with backoff.")
 
   @property
   def stats(self) -> Dict[str, int]:
@@ -278,6 +393,12 @@ class MicroBatcher:
           "split oversized queries client-side")
     fut = ServeFuture(n)
     with self._nonempty:
+      if self._dead is not None:
+        # a counted shed rides a counted submit attempt, like every
+        # other reject path (accepted = submitted - rejected must not
+        # go negative); plain closed below stays an un-counted error
+        self._counters["submitted"].inc()
+        raise self._dead_rejected()
       if self._closed:
         raise RuntimeError("MicroBatcher is closed")
       self._counters["submitted"].inc()
@@ -385,11 +506,33 @@ class MicroBatcher:
           else:
             self._nonempty.wait(timeout=0.05)
         if self._closed and not self._pending:
-          self._inflight.put(None)  # completer shutdown sentinel
-          return
-        taken = self._take_batch_locked()
+          taken = None  # shutdown: deliver the completer sentinel below
+        else:
+          taken = self._take_batch_locked()
+      if taken is None:
+        # completer shutdown sentinel, outside the lock and death-aware:
+        # after a completer death the handler owns sentinel delivery and
+        # its own sentinel may hold the last queue slot — a plain
+        # blocking put here wedged this thread forever (and close()'s
+        # join for its full timeout)
+        while True:
+          with self._lock:
+            if self._dead is not None:
+              return
+          try:
+            self._inflight.put(None, timeout=0.05)
+            return
+          except _queue.Full:
+            continue
       if taken:
-        self._dispatch(taken)
+        try:
+          self._dispatch(taken)
+        except BaseException:
+          # already popped from pending: record the batch so the death
+          # handler can fail its waiters (a dispatch-fn failure is
+          # handled INSIDE _dispatch; reaching here is machinery death)
+          self._orphans.extend(taken)
+          raise
 
   def flush_now(self) -> int:
     """Synchronous flush (tests / drain): packs and dispatches pending
@@ -464,8 +607,32 @@ class MicroBatcher:
     # the record (and wedge pending trips) across a recorder swap
     if inline:
       return (taken, out, rec, ctx, fr)
-    self._inflight.put((taken, out, rec, ctx, fr))
-    return None
+    # enqueue with a death-aware timed put: a plain blocking put could
+    # wedge forever against a dead completer (the death handler's
+    # sentinel may occupy the last slot), and a check-then-put could
+    # land the item AFTER the handler's one-shot drain — so re-check
+    # death on every Full timeout AND after a successful put, and
+    # self-drain in the latter case (idempotent, see
+    # _drain_inflight_dead) so the waiters can never be stranded
+    while True:
+      with self._lock:
+        dead = self._dead is not None
+      if dead:
+        for p in taken:
+          if not p.future.done():
+            p.future._fail(self._dead_rejected())
+        if rec is not None:
+          fr.end(rec, error=self._dead[1])
+        return None
+      try:
+        self._inflight.put((taken, out, rec, ctx, fr), timeout=0.05)
+      except _queue.Full:
+        continue
+      with self._lock:
+        dead = self._dead is not None
+      if dead:
+        self._drain_inflight_dead()
+      return None
 
   def _complete(self, taken: List[_Pending], out: Any, rec=None,
                 ctx=None, fr=None) -> None:
@@ -501,7 +668,14 @@ class MicroBatcher:
       item = self._inflight.get()
       if item is None:
         return
-      self._complete(*item)
+      try:
+        self._complete(*item)
+      except BaseException:
+        # popped from in-flight already: hand the batch to the death
+        # handler (expected completion failures are delivered per
+        # request inside _complete; this is machinery death)
+        self._orphans.extend(item[0])
+        raise
 
   # ---- lifecycle ----------------------------------------------------------
   def close(self, drain: bool = True) -> None:

@@ -350,40 +350,18 @@ class DeltaPublisher:
     if quantize not in QUANTIZE_MODES:
       raise ValueError(f"unknown quantize mode {quantize!r}; "
                        f"have {list(QUANTIZE_MODES)}")
-    if tracker.plan is not plan:
-      raise ValueError(
-          "tracker was built for a different plan object: the routing "
-          "recipe and class geometry must be THIS plan's.")
-    if store is None and plan.host_tier_class_keys():
-      raise ValueError(
-          "plan has host-tier classes but no HostTierStore was passed: "
-          "the cold images hold the authoritative rows the delta must "
-          "read. Pass the run's store.")
     if jax.process_count() > 1:
       raise NotImplementedError(
           "delta publication is a single-controller operation (like the "
           "full export): publish from a single-controller run or a "
           "restored checkpoint.")
     self.path = path
-    self.plan = plan
     self.rule = rule
-    self.tracker = tracker
     self.quantize = quantize
-    self.store = store
     self.vocab = vocab
     self.telemetry = telemetry if telemetry is not None else _registry()
     os.makedirs(path, exist_ok=True)
-
-    engine = DistributedLookup(plan)
-    self._layouts = engine.fused_layouts(
-        rule, rows_overrides=store.tplan.rows_overrides if store else None)
-    self._tiered_names = frozenset(store.tplan.tier_specs) \
-        if store is not None else frozenset()
-    # the SAME geometry derivation as freeze() — shared helper, so a
-    # delta row and a full re-export of the same logical row are
-    # byte-identical by construction
-    self.meta, self._full_lay = serve_class_meta(
-        plan, rule, quantize, self._tiered_names)
+    self._bind_plan(plan, tracker, store)
 
     if max_subscriber_lag is not None and max_subscriber_lag < 1:
       raise ValueError(
@@ -405,6 +383,36 @@ class DeltaPublisher:
     self._expired_ids: set = set()
     self._throttled_pending = False
 
+  def _bind_plan(self, plan: DistEmbeddingStrategy,
+                 tracker: RowGenerationTracker, store) -> None:
+    """Validate and adopt one (plan, tracker, store) binding — the
+    constructor tail AND :meth:`re_root`'s re-bind across an elastic
+    resize, so a constructed and a re-rooted publisher can never derive
+    different extraction geometry."""
+    if tracker.plan is not plan:
+      raise ValueError(
+          "tracker was built for a different plan object: the routing "
+          "recipe and class geometry must be THIS plan's.")
+    if store is None and plan.host_tier_class_keys():
+      raise ValueError(
+          "plan has host-tier classes but no HostTierStore was passed: "
+          "the cold images hold the authoritative rows the delta must "
+          "read. Pass the run's store.")
+    self.plan = plan
+    self.tracker = tracker
+    self.store = store
+    engine = DistributedLookup(plan)
+    self._layouts = engine.fused_layouts(
+        self.rule,
+        rows_overrides=store.tplan.rows_overrides if store else None)
+    self._tiered_names = frozenset(store.tplan.tier_specs) \
+        if store is not None else frozenset()
+    # the SAME geometry derivation as freeze() — shared helper, so a
+    # delta row and a full re-export of the same logical row are
+    # byte-identical by construction
+    self.meta, self._full_lay = serve_class_meta(
+        plan, self.rule, self.quantize, self._tiered_names)
+
   # ---- observation (delegates to the tracker) -----------------------------
   def observe_batch(self, cats) -> int:
     """Stamp one global batch (call with the ids the STEP consumes —
@@ -412,14 +420,22 @@ class DeltaPublisher:
     return self.tracker.observe(cats)
 
   # ---- base ---------------------------------------------------------------
-  def publish_base(self, state: Dict[str, Any]) -> str:
-    """Full frozen-table export rooting (or re-rooting) the chain."""
+  def publish_base(self, state: Dict[str, Any],
+                   re_root_note: Optional[Dict[str, Any]] = None) -> str:
+    """Full frozen-table export rooting (or re-rooting) the chain.
+
+    ``re_root_note`` (set by :meth:`re_root`, never by hand): recorded
+    under the base manifest's ``stream.re_rooted`` so a chain fork is
+    auditable from the artifact alone."""
     base = os.path.join(self.path, BASE_DIR)
     clock = self.tracker.clock
+    stream_extra: Dict[str, Any] = {"clock": clock,
+                                    "published_wall": time.time()}
+    if re_root_note is not None:
+      stream_extra["re_rooted"] = re_root_note
     full_export(base, self.plan, self.rule, state, quantize=self.quantize,
                 store=self.store, vocab=self.vocab,
-                extra={"stream": {"clock": clock,
-                                  "published_wall": time.time()}})
+                extra={"stream": stream_extra})
     self.seq = 0
     self.fingerprint = self.base_fingerprint = manifest_fingerprint(base)
     self.chain_root = self.base_fingerprint
@@ -430,6 +446,64 @@ class DeltaPublisher:
     self.telemetry.counter("stream/base_published").inc()
     self.telemetry.counter("stream/bytes_published").inc(
         self.last_publish_bytes)
+    return base
+
+  def re_root(self, state: Dict[str, Any], reason: str,
+              plan: Optional[DistEmbeddingStrategy] = None,
+              tracker: Optional[RowGenerationTracker] = None,
+              store=None) -> str:
+    """Explicit, counted, fingerprint-logged chain re-root.
+
+    The ONE sanctioned way to start a new chain in a pubdir that
+    already has one. The canonical caller is an ELASTIC RESIZE
+    (``ResilientTrainer.resize``): the chain's plan fingerprint pins
+    the world shape, so a resized trainer's deltas would be refused by
+    every subscriber and :meth:`attach` would raise
+    ``ChainDivergedError`` — previously the operator had to wipe the
+    pubdir by hand. ``re_root`` instead:
+
+    - requires a non-empty ``reason`` (it forces every subscriber
+      through a full-artifact rebase; the decision must be named);
+    - optionally RE-BINDS the publisher to the new world: pass the new
+      ``plan`` + a fresh ``tracker`` built for it (+ ``store`` when the
+      plan has host-tier classes) and the extraction geometry, serve
+      metadata, and layouts are rebuilt — leave them None to re-root on
+      the current geometry (the operator-decision case);
+    - publishes a full base whose manifest records
+      ``stream.re_rooted = {reason, prev_chain_root, prev_seq,
+      prev_fingerprint}`` — the fork point is auditable from the
+      artifact alone (the fingerprint log);
+    - counts ``stream/re_roots``.
+
+    Subscribers adopt through the EXISTING new-base rebase path: they
+    detect the changed base fingerprint and reload from the new base —
+    staleness for one cycle, never wrong rows. Returns the base path."""
+    if not reason or not str(reason).strip():
+      raise ValueError(
+          "re_root requires a reason: it forces every subscriber "
+          "through a full-artifact rebase, and the new base's manifest "
+          "records why the old chain was abandoned.")
+    if (plan is None) != (tracker is None):
+      raise ValueError(
+          "pass plan and tracker together: the tracker's row geometry "
+          "is the plan's, and re-binding one without the other would "
+          "stamp rows of a world that no longer exists")
+    if plan is None and store is not None:
+      raise ValueError(
+          "store was passed without plan/tracker: re-binding the cold "
+          "store alone would extract rows laid out for a plan the "
+          "publisher is not bound to — pass all three (or none, to "
+          "re-root on the current binding).")
+    note = {
+        "reason": str(reason),
+        "prev_chain_root": self.chain_root,
+        "prev_seq": self.seq,
+        "prev_fingerprint": self.fingerprint,
+    }
+    if plan is not None:
+      self._bind_plan(plan, tracker, store)
+    base = self.publish_base(state, re_root_note=note)
+    self.telemetry.counter("stream/re_roots").inc()
     return base
 
   # ---- chain-state persistence (the checkpoint `stream` section) ----------

@@ -64,6 +64,13 @@ PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 PROMOTE_GAUGE_STEMS = ("stream/served_step",
                        "stream/last_promote_unixtime")
 
+# set (unkeyed + keyed by thread name) by a MicroBatcher whose
+# flusher/completer thread died of an unexpected exception — the probe
+# turns it into ok=False + the dead thread names, so a serving process
+# whose batcher silently lost its engine room fails readiness instead
+# of answering "ok" while every request times out
+DEAD_THREAD_GAUGE_STEM = "serve/flusher_dead"
+
 
 def record_promote(registry: MetricsRegistry, step: int,
                    subscriber_id: Optional[str] = None) -> None:
@@ -241,6 +248,7 @@ class _Server(ThreadingHTTPServer):
     step_g, wall_g = PROMOTE_GAUGE_STEMS
     lasts: Dict[str, float] = {}
     steps: Dict[str, int] = {}
+    dead_threads: list = []
     for name, m in self.registry.metrics().items():
       if name == wall_g:
         lasts[""] = float(m.value)
@@ -250,20 +258,30 @@ class _Server(ThreadingHTTPServer):
         steps[""] = int(m.value)
       elif name.startswith(step_g + "/"):
         steps[name.rsplit("/", 1)[1]] = int(m.value)
+      elif name.startswith(DEAD_THREAD_GAUGE_STEM + "/") and m.value:
+        # a batcher worker thread died (MicroBatcher._on_worker_death):
+        # the process is alive but cannot serve — readiness must say so
+        dead_threads.append(name.rsplit("/", 1)[1])
+    out: Dict[str, Any]
     if not lasts:
       step = steps.get("")
-      return {"ok": True, "served_step": step,
-              "last_promote_unix": None, "staleness_s": None}
-    stalest = min(lasts, key=lambda k: lasts[k])
-    last_wall = lasts[stalest]
-    step = steps.get(stalest, steps.get(""))
-    return {
-        "ok": True,
-        "served_step": step,
-        "last_promote_unix": last_wall,
-        "staleness_s": max(0.0, time.time() - last_wall),
-        "members": len([k for k in lasts if k]) or None,
-    }
+      out = {"ok": True, "served_step": step,
+             "last_promote_unix": None, "staleness_s": None}
+    else:
+      stalest = min(lasts, key=lambda k: lasts[k])
+      last_wall = lasts[stalest]
+      step = steps.get(stalest, steps.get(""))
+      out = {
+          "ok": True,
+          "served_step": step,
+          "last_promote_unix": last_wall,
+          "staleness_s": max(0.0, time.time() - last_wall),
+          "members": len([k for k in lasts if k]) or None,
+      }
+    if dead_threads:
+      out["ok"] = False
+      out["dead_threads"] = sorted(dead_threads)
+    return out
 
 
 class MetricsServer:

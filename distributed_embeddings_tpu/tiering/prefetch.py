@@ -62,11 +62,7 @@ class TieredPrefetcher:
                mesh=None, axis_name: str = "mp",
                retry_policy: _retry.RetryPolicy = _retry.DEFAULT_POLICY,
                telemetry=None):
-    self.tplan = tplan
-    self.store = store
-    self.plan = tplan.plan
-    self.mesh = mesh
-    self.axis_name = axis_name
+    self.axis_name = axis_name  # rebind() below derives the rest
     # the registry the gather/spill counters land in (default: the
     # process-wide one; a wrapping trainer may re-point it so isolated
     # accounting captures the WHOLE protocol's counters)
@@ -83,18 +79,41 @@ class TieredPrefetcher:
       self.host_gather_retries += 1
       self.telemetry.counter("tiered/host_gather_retries").inc()
 
-    self._gather = _retry.retrying(store.gather, policy=retry_policy,
-                                   on_retry=_count_retry)
-    # routing recipe: class key -> per rank -> [(input_id, row_offset,
-    # row_start, shard_rows, vocab, row_sliced)] — the plan's shared
-    # host-side replica of the traced routing (also consumed by the
-    # streaming row-generation tracker)
-    self._recipe: Dict[tuple, List[list]] = {
-        key: self.plan.routing_recipe(key) for key in tplan.classes}
-    self._resident_dev = store.resident_arrays(mesh, axis_name)
-    self.steps_since_rerank = 0
+    self._count_retry = _count_retry
+    self._retry_policy = retry_policy
     self.total_host_gather_bytes = 0
     self.spill_steps = 0
+    # binding-dependent state (_gather/_recipe/_resident_dev/re-rank
+    # phase) derives in ONE place so a constructed and a rebound
+    # prefetcher can never route differently
+    self.rebind(tplan, store, mesh=mesh, axis_name=axis_name)
+
+  def rebind(self, tplan: TieringPlan, store: HostTierStore,
+             mesh=None, axis_name: Optional[str] = None) -> None:
+    """(Re-)point this prefetcher at a plan + store — the constructor
+    tail, and the live elastic resize's hook
+    (``resilience.elastic.elastic_resize`` built a new
+    ``TieringPlan``/``HostTierStore`` for the new world, and the
+    classify/stage pipeline must route against them from the next
+    step). Re-derives the routing recipe (class key -> per rank ->
+    [(input_id, row_offset, row_start, shard_rows, vocab, row_sliced)]
+    — the plan's shared host-side replica of the traced routing, also
+    consumed by the streaming row-generation tracker) and the device
+    resident maps, re-wraps the retried gather around the new store,
+    and resets the re-rank phase; the cumulative gather/spill/retry
+    counters survive — they describe the run, not the world shape."""
+    self.tplan = tplan
+    self.store = store
+    self.plan = tplan.plan
+    self.mesh = mesh
+    if axis_name is not None:
+      self.axis_name = axis_name
+    self._gather = _retry.retrying(store.gather, policy=self._retry_policy,
+                                   on_retry=self._count_retry)
+    self._recipe: Dict[tuple, List[list]] = {
+        key: self.plan.routing_recipe(key) for key in tplan.classes}
+    self._resident_dev = store.resident_arrays(self.mesh, self.axis_name)
+    self.steps_since_rerank = 0
 
   def refresh_resident(self) -> None:
     """Re-derive the device resident maps from the store.

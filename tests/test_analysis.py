@@ -646,6 +646,87 @@ def legacy():
                      CTX, ["GL115"]) == []
 
 
+def test_gl116_flags_raw_signaling_in_library_modules():
+  src = """
+import os
+import signal
+
+def hook():
+  signal.signal(signal.SIGTERM, lambda s, f: None)
+
+def reap(pid):
+  os.kill(pid, 9)
+  os.killpg(pid, 15)
+"""
+  for path in ("distributed_embeddings_tpu/serving/batcher.py",
+               "distributed_embeddings_tpu/training.py",
+               "distributed_embeddings_tpu/tiering/prefetch.py"):
+    out = lint_source(src, path, CTX, ["GL116"])
+    assert _rules(out) == ["GL116", "GL116", "GL116"], path
+    assert "resilience" in out[0].message
+
+
+def test_gl116_from_import_and_alias_forms():
+  src = """
+from signal import signal as sig
+from os import kill
+
+def hook():
+  sig(15, None)
+  kill(123, 0)
+"""
+  out = lint_source(src, "distributed_embeddings_tpu/fleet/owner.py",
+                    CTX, ["GL116"])
+  assert _rules(out) == ["GL116", "GL116"]
+  aliased = """
+import signal as sg
+import os as o
+
+def hook():
+  sg.signal(15, None)
+  o.kill(123, 9)
+"""
+  out = lint_source(aliased, "distributed_embeddings_tpu/fleet/owner.py",
+                    CTX, ["GL116"])
+  assert _rules(out) == ["GL116", "GL116"]
+
+
+def test_gl116_scope_and_suppression():
+  src = """
+import os
+import signal
+
+def hook():
+  signal.signal(signal.SIGTERM, lambda s, f: None)
+  os.kill(os.getpid(), 0)
+"""
+  # resilience/ is the sanctioned home (the drain path, chaos kill_at,
+  # membership probes); tools and tests drive their own processes
+  for path in ("distributed_embeddings_tpu/resilience/trainer.py",
+               "distributed_embeddings_tpu/resilience/elastic.py",
+               "distributed_embeddings_tpu/resilience/faultinject.py",
+               "tools/chaos_preempt.py", "tests/test_preempt.py"):
+    assert lint_source(src, path, CTX, ["GL116"]) == [], path
+  # non-signaling uses of the modules stay legal
+  ok = """
+import os
+import signal
+
+def fine():
+  return os.getpid(), signal.getsignal(signal.SIGTERM)
+"""
+  assert lint_source(ok, "distributed_embeddings_tpu/serving/engine.py",
+                     CTX, ["GL116"]) == []
+  sup = """
+import os
+
+def probe(pid):
+  os.kill(pid, 0)  # graftlint: disable=GL116 (liveness probe, reviewed)
+"""
+  assert lint_source(sup, "distributed_embeddings_tpu/fleet/owner.py",
+                     CTX, ["GL116"]) == []
+
+
 # ---------------------------------------------------------------------------
 # repo-context parsing + HEAD cleanliness
 # ---------------------------------------------------------------------------
@@ -657,13 +738,14 @@ def test_repo_context_parses_markers_and_sites():
   # SITES literal members plus register_site-registered extensions
   # ("sigkill" in faultinject.py, the streaming sites in
   # streaming/publish.py|subscribe.py|compact.py, the fleet RPC site in
-  # fleet/transport.py — all registered at module level) — test files'
-  # ad-hoc registrations are deliberately NOT scanned
+  # fleet/transport.py, the in-run resize site in resilience/elastic.py —
+  # all registered at module level) — test files' ad-hoc registrations
+  # are deliberately NOT scanned
   assert ctx.fault_sites == frozenset(
       {"ckpt_write", "ckpt_rename", "host_gather", "ckpt_owner_write",
        "reshard_gather", "sigkill", "delta_extract", "delta_seal",
        "stream_attach", "stream_read", "delta_promote", "compact_fold",
-       "fleet_rpc"})
+       "fleet_rpc", "resize_gather"})
   assert "test_extension_site" not in ctx.fault_sites
 
 

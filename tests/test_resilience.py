@@ -726,6 +726,65 @@ def test_resilient_trainer_drives_tiered_steps(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Full-jitter backoff (ISSUE 15 satellite): a resized pod's workers
+# retrying host-tier gathers / checkpoint I/O on identical exponential
+# schedules are thundering-herd shaped — jitter='full' decorrelates
+# them, and the seed parameter keeps tests exact
+# ---------------------------------------------------------------------------
+
+
+def test_retry_jitter_none_is_the_historical_schedule():
+  p = RetryPolicy(backoff=0.05, max_backoff=2.0)
+  assert p.jitter == "none" and p.make_rng() is None
+  assert [p.sleep_for(a) for a in range(6)] == \
+      [0.05, 0.1, 0.2, 0.4, 0.8, 1.6]
+  assert p.sleep_for(10) == 2.0  # capped
+
+
+def test_retry_full_jitter_is_bounded_and_seed_deterministic():
+  p = RetryPolicy(backoff=0.05, max_backoff=2.0, jitter="full", seed=42)
+  seq1 = [p.sleep_for(a, rng) for rng in [p.make_rng()] for a in range(8)]
+  seq2 = [p.sleep_for(a, rng) for rng in [p.make_rng()] for a in range(8)]
+  assert seq1 == seq2  # same seed -> same sleep sequence, exactly
+  caps = [min(0.05 * 2 ** a, 2.0) for a in range(8)]
+  assert all(0.0 <= s <= c for s, c in zip(seq1, caps))
+  assert any(s != c for s, c in zip(seq1, caps))  # actually jittered
+  # different seeds decorrelate (the whole point)
+  other = RetryPolicy(backoff=0.05, max_backoff=2.0, jitter="full", seed=7)
+  rng_o = other.make_rng()
+  assert [other.sleep_for(a, rng_o) for a in range(8)] != seq1
+
+
+def test_retry_call_uses_jittered_sleeps():
+  from distributed_embeddings_tpu.resilience import retry as retry_mod
+
+  p = RetryPolicy(retries=3, backoff=0.05, max_backoff=2.0,
+                  jitter="full", seed=123)
+  calls = {"n": 0}
+
+  def flaky():
+    calls["n"] += 1
+    if calls["n"] <= 3:
+      raise OSError("transient")
+    return "ok"
+
+  slept = []
+  assert retry_mod.retry_call(flaky, policy=p, sleep=slept.append) == "ok"
+  rng = p.make_rng()
+  assert slept == [p.sleep_for(a, rng) for a in range(3)]
+  # and a second identical call sequence sleeps identically (seeded)
+  calls["n"] = 0
+  slept2 = []
+  retry_mod.retry_call(flaky, policy=p, sleep=slept2.append)
+  assert slept2 == slept
+
+
+def test_retry_policy_rejects_unknown_jitter():
+  with pytest.raises(ValueError, match="jitter"):
+    RetryPolicy(jitter="half")
+
+
+# ---------------------------------------------------------------------------
 # Chaos harness (tools/chaos_train.py): long variant is slow-marked so
 # tier-1 stays fast; `make chaos` runs the short standalone form
 # ---------------------------------------------------------------------------

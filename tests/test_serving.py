@@ -690,6 +690,117 @@ def test_batcher_drain_failure_fails_queued_waiters():
       f.result(timeout=1)
 
 
+def test_batcher_flusher_death_fails_queued_requests():
+  """A flusher thread killed by an UNEXPECTED exception (machinery
+  death, not a dispatch failure) must fail every queued request with a
+  counted ``flusher_died`` shed instead of leaving the waiters hanging
+  forever, close the batcher, and set the /healthz dead-thread gauge
+  (ISSUE 15 satellite)."""
+  mb = MicroBatcher(_echo_dispatch, max_batch=8, max_delay_s=0.002)
+
+  def wrenched():
+    raise RuntimeError("wrenched machinery")
+
+  mb._take_batch_locked = wrenched  # dies on its next flush wakeup
+  futs = [mb.submit(np.zeros((2, 2), np.float32), [np.zeros(2, np.int32)])
+          for _ in range(3)]
+  for f in futs:
+    with pytest.raises(Rejected) as exc:
+      f.result(timeout=10)  # bounded: the death handler failed them
+    assert exc.value.reason == "flusher_died"
+    assert "serve-batcher-flush" in str(exc.value)
+  assert mb.stats["rejected/flusher_died"] == 3
+  assert mb.stats["rejected"] == 3
+  # new submissions shed with the same counted reason, naming the thread
+  with pytest.raises(Rejected) as exc:
+    mb.submit(np.zeros((1, 2), np.float32), [np.zeros(1, np.int32)])
+  assert exc.value.reason == "flusher_died"
+  assert mb.stats["rejected/flusher_died"] == 4
+  # the dead thread is surfaced for /healthz
+  from distributed_embeddings_tpu.telemetry.http import (
+      DEAD_THREAD_GAUGE_STEM,
+  )
+  assert mb.telemetry.gauge(DEAD_THREAD_GAUGE_STEM).value == 1
+  key = f"{DEAD_THREAD_GAUGE_STEM}/serve-batcher-flush"
+  assert mb.telemetry.gauge(key).value == 1
+  mb.close()
+
+
+def test_batcher_completer_death_fails_inflight_requests():
+  """The completer dying mid-item must fail THAT item's waiters too
+  (it was already popped from the in-flight queue), and the flusher
+  must not wedge behind a dead completer."""
+  mb = MicroBatcher(_echo_dispatch, max_batch=4, max_delay_s=0.002,
+                    pipeline_depth=1)
+
+  def wrenched(*a, **k):
+    raise RuntimeError("completer wrenched")
+
+  mb._complete = wrenched
+  fut = mb.submit(np.zeros((2, 2), np.float32), [np.zeros(2, np.int32)])
+  with pytest.raises(Rejected) as exc:
+    fut.result(timeout=10)
+  assert exc.value.reason == "flusher_died"
+  assert mb.stats["rejected/flusher_died"] >= 1
+  mb.close()
+
+
+def test_healthz_reports_dead_batcher_thread():
+  """A MetricsServer sharing the batcher's registry turns the dead
+  thread into ok=False + its name in the /healthz body — readiness
+  fails instead of the process answering 'ok' while every request
+  sheds."""
+  from distributed_embeddings_tpu.telemetry import (
+      MetricsRegistry,
+      MetricsServer,
+  )
+  reg = MetricsRegistry()
+  with MetricsServer(registry=reg) as srv:
+    assert srv.health()["ok"] is True
+    mb = MicroBatcher(_echo_dispatch, max_batch=4, max_delay_s=0.002,
+                      registry=reg)
+
+    def wrenched():
+      raise RuntimeError("boom")
+
+    mb._take_batch_locked = wrenched
+    fut = mb.submit(np.zeros((1, 2), np.float32), [np.zeros(1, np.int32)])
+    with pytest.raises(Rejected):
+      fut.result(timeout=10)
+    health = srv.health()
+    assert health["ok"] is False
+    assert health["dead_threads"] == ["serve-batcher-flush"]
+    mb.close()
+    # the sanctioned recovery ("rebuild the batcher") restores
+    # readiness: a replacement on the same registry clears the gauges
+    mb2 = MicroBatcher(_echo_dispatch, max_batch=4, max_delay_s=0.002,
+                       registry=reg)
+    health = srv.health()
+    assert health["ok"] is True
+    assert "dead_threads" not in health
+    fut = mb2.submit(np.zeros((1, 2), np.float32),
+                     [np.zeros(1, np.int32)])
+    assert fut.result(timeout=10).shape[0] == 1
+    mb2.close()
+    # the clear is scoped to the rebuilt batcher's OWN thread names: a
+    # still-dead SIBLING (distinct name=) keeps readiness failing even
+    # while another batcher is rebuilt on the shared registry
+    sib = MicroBatcher(_echo_dispatch, max_batch=4, max_delay_s=0.002,
+                       registry=reg, name="sibling")
+    sib._take_batch_locked = wrenched
+    with pytest.raises(Rejected):
+      sib.submit(np.zeros((1, 2), np.float32),
+                 [np.zeros(1, np.int32)]).result(timeout=10)
+    assert srv.health()["dead_threads"] == ["sibling-flush"]
+    mb3 = MicroBatcher(_echo_dispatch, max_batch=4, max_delay_s=0.002,
+                       registry=reg)  # rebuild of the DEFAULT batcher
+    health = srv.health()
+    assert health["ok"] is False
+    assert health["dead_threads"] == ["sibling-flush"]
+    mb3.close()
+    sib.close()
+
+
 @pytest.mark.slow
 def test_profile_serve_full_sweep():
   """The full serve-bench sweep (throughput + latency-vs-QPS across

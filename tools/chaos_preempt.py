@@ -1,0 +1,483 @@
+"""In-run preemption chaos: SIGKILL a pod member, the survivors resize
+IN PLACE — no checkpoint restore round-trip — then regrow; a SIGTERM'd
+worker drains gracefully.
+
+``tools/chaos_kill.py`` proved elasticity ACROSS restarts: kill the
+trainer, relaunch at a different world, restore through the elastic
+checkpoint path. Production preemption is gentler and harsher at once —
+spot reclaims take ONE worker (the job should keep running without a
+restore round-trip), and a maintenance notice is a SIGTERM with a
+deadline (the worker should finish its step, snapshot, and exit 0).
+This driver closes both gaps:
+
+1. **reference**: one uninterrupted pod trains a fixed stream at world
+   4 to completion (``--static``: membership ignored).
+2. **preempt cycle**: the pod process (the trainer, owning the virtual
+   mesh) registers a ``members/`` lease and polls a
+   ``resilience.elastic.PreemptionSupervisor`` between steps; the
+   driver spawns 3 lightweight member subprocesses (pid leases, no jax)
+   and SIGKILLs one of them while the pod is mid-run. The pod detects
+   the loss (pid probe), QUIESCES, and ``ResilientTrainer.resize``s
+   4 -> 2 in place (``elastic_resize``: same regroup path as the
+   elastic restore, every logical row f32 bit-exact); when the driver
+   spawns a replacement member it regrows 2 -> 4. The verdict checks:
+   the killed member really died by SIGKILL; the pod NEVER touched a
+   checkpoint (``resumed_from`` is None, zero ``ckpt/restores``, the
+   ckpt root stays empty); ``elastic/resizes`` counts both moves and
+   ``elastic/quiesce_s`` observed them; the stitched trajectory matches
+   the reference — bit-exact before the first resize, within the
+   fp-associativity bound after (a resized mesh reduces in a different
+   order; the resharded STATE itself is bit-exact, pinned by
+   tests/test_preempt.py) — and ``consumed == steps + skipped`` holds
+   across the whole run with every injected NaN batch skipped exactly
+   once.
+3. **drain cycle**: a worker runs with
+   ``ResilientTrainer.install_sigterm_drain``; the driver SIGTERMs it
+   mid-run. The worker finishes the in-flight step, snapshots, and
+   exits 0 (the armed watchdog would have hard-exited 3 had the drain
+   overrun its deadline — exit 0 IS the within-deadline proof); a
+   relaunch auto-resumes and the stitched trajectory is bit-exact vs
+   the reference.
+
+``--smoke`` is the make-verify tier (fewer steps, same assertions);
+the full run adds a double-shrink (4 -> 2 -> 1 -> 4) cycle. Verdicts go
+through ``telemetry.emit_verdict`` (exit 0/1, $DE_TPU_VERDICT_LOG).
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+if __name__ == "__main__":  # standalone: build the virtual CPU mesh
+  flags = os.environ.get("XLA_FLAGS", "")
+  if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+  os.environ.setdefault("JAX_PLATFORMS", "cpu")
+  sys.path.insert(0, _REPO)
+
+VOCAB = [500, 300, 150, 20]
+GLOBAL_BATCH = 32  # divisible by every world size the cycles use
+
+
+def _batches(n, seed=7, n_unique=6):
+  """World-independent cycled batch stream (chaos_kill's recipe)."""
+  import numpy as np
+  rng = np.random.default_rng(seed)
+  out = []
+  for _ in range(n_unique):
+    numerical = rng.standard_normal((GLOBAL_BATCH, 13)).astype(np.float32)
+    cats = [rng.integers(0, v, GLOBAL_BATCH).astype(np.int32)
+            for v in VOCAB]
+    labels = (numerical[:, 0] > 0).astype(np.float32)
+    out.append((numerical, cats, labels))
+  return [out[i % n_unique] for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# member: a pod worker's liveness lease (NO jax import — a member is a
+# process whose pid exists, nothing more; the pod leader owns the mesh)
+# ---------------------------------------------------------------------------
+
+
+def run_member(pod_dir: str, member_id: str) -> None:
+  d = os.path.join(pod_dir, "members")
+  os.makedirs(d, exist_ok=True)
+  # lease format = elastic.register_member's, incl. the pid-incarnation
+  # start time (elastic.proc_start_ticks, inlined to stay jax-free)
+  try:
+    with open(f"/proc/{os.getpid()}/stat", "rb") as f:
+      stat = f.read()
+    start = int(stat[stat.rindex(b")") + 1:].split()[19])
+  except (OSError, ValueError, IndexError):
+    start = None
+  path = os.path.join(d, f"{member_id}.json")
+  tmp = path + ".tmp"
+  with open(tmp, "w") as f:
+    json.dump({"id": member_id, "pid": os.getpid(), "start": start}, f)
+    f.flush()
+    os.fsync(f.fileno())
+  os.replace(tmp, path)
+  while True:  # live until killed (SIGKILL: the lease pid goes dead)
+    time.sleep(1.0)
+
+
+# ---------------------------------------------------------------------------
+# pod: the trainer process — polls membership, resizes IN PLACE
+# ---------------------------------------------------------------------------
+
+
+def _build_world(world):
+  """Model/plan/step/state for one world size (chaos_kill's recipe)."""
+  import jax
+  import numpy as np
+  import optax
+
+  from distributed_embeddings_tpu.layers.planner import DistEmbeddingStrategy
+  from distributed_embeddings_tpu.models import DLRM, bce_loss
+  from distributed_embeddings_tpu.ops.packed_table import sparse_rule
+  from distributed_embeddings_tpu.parallel import create_mesh
+  from distributed_embeddings_tpu.training import (
+      init_sparse_state,
+      make_sparse_train_step,
+      shard_params,
+  )
+
+  mesh = create_mesh(world)
+  model = DLRM(vocab_sizes=VOCAB, embedding_dim=16, bottom_mlp=(32, 16),
+               top_mlp=(32, 1), world_size=world, dense_row_threshold=32)
+  plan = DistEmbeddingStrategy(
+      [dict(input_dim=v, output_dim=16,
+            initializer={"name": "uniform", "scale": 0.05}) for v in VOCAB],
+      world, "basic", dense_row_threshold=32)
+  rule = sparse_rule("adagrad", 0.05)
+  opt = optax.adagrad(0.05)
+  batches = _batches(4)
+  numerical, cats, _ = batches[0]
+  params = model.init(jax.random.PRNGKey(0), numerical,
+                      [np.asarray(c) for c in cats])["params"]
+  state = shard_params(init_sparse_state(plan, params, rule, opt), mesh)
+  step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
+                                state, batches[0], donate=False, guard=True)
+  return mesh, plan, rule, step, state
+
+
+def run_pod(pod_dir: str, log_path: str, world: int, steps: int,
+            nan_every: int = 6, static: bool = False,
+            step_delay: float = 0.12,
+            drain_deadline: float = 0.0) -> dict:
+  """One pod-leader lifetime: train the fixed stream, resizing in place
+  whenever the supervisor's target world changes. Appends
+  ``{"i", "loss"}`` JSONL per step to ``log_path`` and resize events to
+  ``log_path + '.events'``."""
+  from distributed_embeddings_tpu import telemetry
+  from distributed_embeddings_tpu.resilience import elastic, faultinject
+  from distributed_embeddings_tpu.resilience.trainer import ResilientTrainer
+  from distributed_embeddings_tpu.training import shard_batch
+
+  mesh, plan, rule, step, state = _build_world(world)
+  batches = _batches(steps)
+  nan_steps = set(range(nan_every - 1, steps, nan_every)) if nan_every \
+      else set()
+  stream = list(faultinject.nan_batches(batches, at_steps=nan_steps))
+
+  root = os.path.join(pod_dir, "ckpts")
+  t = ResilientTrainer(step, state, plan, rule, root, mesh=mesh,
+                       snapshot_every=0, resume=drain_deadline > 0)
+  if drain_deadline > 0:
+    t.install_sigterm_drain(deadline_s=drain_deadline)
+  elastic.register_member(pod_dir, "leader")
+  sup = elastic.PreemptionSupervisor(pod_dir, allowed_worlds=(1, 2, 4))
+  reg = telemetry.get_registry()
+
+  cur = world
+  worlds_seen = [world]
+  events = []
+  drained = False
+  with open(log_path, "a") as log:
+    for i in range(t.consumed, steps):
+      if not static:
+        target = sup.target_world()
+        if target != cur:
+          # a member died (or a replacement joined) while the previous
+          # step was in flight: quiesce and re-shard IN PLACE — the
+          # checkpoint root is never touched
+          new_mesh, new_plan, _rule, new_step, _s0 = _build_world(target)
+          t.resize(new_plan, step_fn=new_step, new_mesh=new_mesh)
+          events.append({"event": "resize", "i": i, "from": cur,
+                         "to": target})
+          with open(log_path + ".events", "a") as ev:
+            ev.write(json.dumps(events[-1]) + "\n")
+          cur = target
+          worlds_seen.append(target)
+      loss = t.step(*shard_batch(stream[i], t.mesh))
+      log.write(json.dumps({"i": i, "loss": loss}) + "\n")
+      log.flush()
+      if t.maybe_drain():
+        drained = True
+        break
+      if step_delay:
+        time.sleep(step_delay)  # pace the run so chaos lands mid-run
+  summary = {
+      "world": cur,
+      "worlds_seen": worlds_seen,
+      "steps": t.step_count,
+      "consumed": t.consumed,
+      "skipped": t.skipped_steps,
+      "expected_skips": len(nan_steps),
+      "invariant_ok": t.consumed == t.step_count + t.skipped_steps,
+      "resumed_from": t.resumed_from,
+      "resizes": reg.counter("elastic/resizes").value,
+      "quiesce_observations": reg.histogram("elastic/quiesce_s").count,
+      "restores": reg.counter("ckpt/restores").value,
+      "ckpt_root_entries": (sorted(os.listdir(root))
+                            if os.path.isdir(root) else []),
+      "drained": drained,
+      "events": events,
+  }
+  with open(log_path + ".summary", "w") as f:
+    json.dump(summary, f)
+  return summary
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def _spawn(mode: str, *args: str, wait: bool = True):
+  env = dict(os.environ)
+  env.setdefault("JAX_PLATFORMS", "cpu")
+  flags = env.get("XLA_FLAGS", "")
+  if "xla_force_host_platform_device_count" not in flags:
+    env["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+  cmd = [sys.executable, os.path.abspath(__file__), mode, *args]
+  if wait:
+    return subprocess.run(cmd, cwd=_REPO, env=env).returncode
+  return subprocess.Popen(cmd, cwd=_REPO, env=env)
+
+
+def _read_log(log) -> list:
+  out = []
+  if os.path.exists(log):
+    with open(log) as f:
+      for line in f:
+        rec = json.loads(line)
+        out.append((rec["i"], rec["loss"]))
+  return out
+
+
+def _read_summary(log):
+  p = log + ".summary"
+  if not os.path.exists(p):
+    return None
+  with open(p) as f:
+    return json.load(f)
+
+
+def _stitch(records) -> list:
+  merged = {}
+  for i, loss in records:
+    merged[i] = loss  # later lifetime wins (the drain-relaunch overlap)
+  return [merged[i] for i in sorted(merged)]
+
+
+def _traj_equal(a, b) -> bool:
+  import numpy as np
+  return len(a) == len(b) and all(
+      (np.isnan(x) and np.isnan(y)) or x == y for x, y in zip(a, b))
+
+
+def _traj_close(a, b, resized_at, rtol=5e-4, atol=1e-5) -> bool:
+  """Exact before the first resize, fp-associativity bound after (the
+  resized mesh reduces grads/losses in a different order; the resharded
+  state itself is bit-exact — tests/test_preempt.py)."""
+  import numpy as np
+  if len(a) != len(b):
+    return False
+  for i, (x, y) in enumerate(zip(a, b)):
+    if np.isnan(x) or np.isnan(y):
+      if not (np.isnan(x) and np.isnan(y)):
+        return False
+    elif i < resized_at:
+      if x != y:
+        return False
+    elif not np.isclose(x, y, rtol=rtol, atol=atol):
+      return False
+  return True
+
+
+def _events_of(log) -> list:
+  path = log + ".events"
+  if not os.path.exists(path):
+    return []
+  with open(path) as f:
+    return [json.loads(line) for line in f]
+
+
+def _wait_for(cond, proc=None, timeout=240.0) -> bool:
+  """Poll ``cond()`` until true; gives up at ``timeout`` or (after one
+  final check) when ``proc`` has already exited — a finished pod will
+  produce no further lines or events, so waiting on is pointless."""
+  deadline = time.monotonic() + timeout
+  while time.monotonic() < deadline:
+    if cond():
+      return True
+    if proc is not None and proc.poll() is not None:
+      return bool(cond())
+    time.sleep(0.05)
+  return bool(cond())
+
+
+def _wait_lines(log, n, proc=None, timeout=240.0) -> int:
+  _wait_for(lambda: len(_read_log(log)) >= n, proc=proc, timeout=timeout)
+  return len(_read_log(log))
+
+
+def run_chaos_preempt(steps: int = 26, verbose: bool = True,
+                      extra_cycles: bool = False) -> dict:
+  """The full driver scenario; returns a verdict dict with ``ok``."""
+  work = tempfile.mkdtemp(prefix="chaos_preempt_")
+  result = {"steps": steps, "cycles": {}}
+
+  def cycle(name):
+    pod = os.path.join(work, name)
+    log = os.path.join(pod, "losses.jsonl")
+    os.makedirs(pod, exist_ok=True)
+    return pod, log
+
+  # ---- reference: one uninterrupted static pod at world 4 ----------------
+  pod, log = cycle("ref")
+  rc = _spawn("--pod", "--pod-dir", pod, "--log", log, "--world", "4",
+              "--steps", str(steps), "--static", "--step-delay", "0")
+  ref_summary = _read_summary(log)
+  ref = _stitch(_read_log(log))
+  result["cycles"]["ref"] = {
+      "rc": rc, "summary": ref_summary,
+      "ok": rc == 0 and len(ref) == steps and bool(
+          ref_summary and ref_summary["invariant_ok"])}
+
+  # ---- preempt cycle: SIGKILL members, shrink in place, regrow -----------
+  def preempt_cycle(name, kill_n, expected_min):
+    """SIGKILL ``kill_n`` of the 3 member subprocesses mid-run (the pod
+    should shrink in place to ``expected_min``), then register as many
+    replacements (it should regrow to 4). Membership changes need not
+    map 1:1 onto resize events — e.g. two quick kills can collapse into
+    one 4 -> 2 move — so the assertions are on the WORLD trajectory:
+    reached expected_min, ended back at 4, never restored."""
+    pod, log = cycle(name)
+    members = [_spawn("--member", "--pod-dir", pod, "--id", f"w{k}",
+                      wait=False) for k in range(1, 4)]
+    killed_rcs = []
+    try:
+      proc = _spawn("--pod", "--pod-dir", pod, "--log", log, "--world",
+                    "4", "--steps", str(steps), wait=False)
+      _wait_lines(log, 4, proc=proc)
+      for k in range(kill_n):
+        victim = members[k]
+        victim.send_signal(signal.SIGKILL)
+        killed_rcs.append(victim.wait())  # reap: the lease pid goes dead
+      _wait_for(lambda: any(e["to"] == expected_min
+                            for e in _events_of(log)), proc=proc)
+      _wait_lines(log, len(_read_log(log)) + 2, proc=proc)
+      members.extend(_spawn("--member", "--pod-dir", pod, "--id",
+                            f"r{k}", wait=False) for k in range(kill_n))
+      _wait_for(lambda: _events_of(log)
+                and _events_of(log)[-1]["to"] == 4, proc=proc)
+      rc = proc.wait(timeout=600)
+    finally:
+      for m in members:
+        if m.poll() is None:
+          m.kill()
+          m.wait()
+    summary = _read_summary(log)
+    events = _events_of(log)
+    traj = _stitch(_read_log(log))
+    resized_at = events[0]["i"] if events else steps
+    worlds = [4] + [e["to"] for e in (summary or {}).get("events", [])]
+    no_restore = bool(
+        summary and summary["resumed_from"] is None
+        and summary["restores"] == 0 and not summary["ckpt_root_entries"])
+    return {
+        "rc": rc, "killed_rcs": killed_rcs, "events": events,
+        "worlds": worlds, "summary": summary,
+        "no_restore_roundtrip": no_restore,
+        "trajectory_matches": _traj_close(traj, ref, resized_at),
+        "ok": rc == 0
+              and all(k == -signal.SIGKILL for k in killed_rcs)
+              and len(events) >= 2 and worlds[-1] == 4
+              and min(worlds) == expected_min
+              and no_restore
+              and _traj_close(traj, ref, resized_at)
+              and bool(summary and summary["invariant_ok"]
+                       and summary["skipped"] == summary["expected_skips"]
+                       and summary["resizes"] == len(summary["events"])
+                       and summary["quiesce_observations"]
+                       >= summary["resizes"])}
+
+  result["cycles"]["preempt"] = preempt_cycle("preempt", kill_n=1,
+                                              expected_min=2)
+
+  # ---- drain cycle: SIGTERM mid-run -> snapshot, exit 0, resume exact ----
+  pod, log = cycle("drain")
+  proc = _spawn("--pod", "--pod-dir", pod, "--log", log, "--world", "4",
+                "--steps", str(steps), "--static",
+                "--drain-deadline", "60", wait=False)
+  _wait_lines(log, 4, proc=proc)
+  proc.send_signal(signal.SIGTERM)
+  rc1 = proc.wait(timeout=600)
+  s1 = _read_summary(log)
+  root = os.path.join(pod, "ckpts")
+  snapshot_present = os.path.isdir(root) and any(
+      d.startswith("ckpt_") and not d.endswith(".tmp")
+      for d in os.listdir(root))
+  # relaunch: auto-resume from the drain snapshot, finish the stream
+  rc2 = _spawn("--pod", "--pod-dir", pod, "--log", log, "--world", "4",
+               "--steps", str(steps), "--static", "--step-delay", "0",
+               "--drain-deadline", "60")
+  s2 = _read_summary(log)
+  traj = _stitch(_read_log(log))
+  result["cycles"]["drain"] = {
+      "sigterm_rc": rc1, "relaunch_rc": rc2,
+      "drained_summary": s1, "final_summary": s2,
+      "snapshot_present": snapshot_present,
+      "trajectory_bit_exact": _traj_equal(traj, ref),
+      "ok": rc1 == 0 and rc2 == 0 and snapshot_present
+            and bool(s1 and s1["drained"] and s1["invariant_ok"])
+            and bool(s2 and s2["resumed_from"] and s2["invariant_ok"]
+                     and s2["skipped"] == s2["expected_skips"])
+            and _traj_equal(traj, ref)}
+
+  if extra_cycles:
+    # deep shrink: every member SIGKILLed — the pod must keep training
+    # on its last survivor (world 1, the floor), then regrow to 4 when
+    # three replacements register
+    result["cycles"]["deep_shrink"] = preempt_cycle(
+        "deep_shrink", kill_n=3, expected_min=1)
+
+  result["ok"] = all(c["ok"] for c in result["cycles"].values())
+  if verbose:
+    print(json.dumps(result, indent=1))
+  return result
+
+
+def main(argv=None) -> int:
+  p = argparse.ArgumentParser(description=__doc__)
+  p.add_argument("--pod", action="store_true")
+  p.add_argument("--member", action="store_true")
+  p.add_argument("--pod-dir", default="")
+  p.add_argument("--id", default="")
+  p.add_argument("--log", default="")
+  p.add_argument("--world", type=int, default=4)
+  p.add_argument("--steps", type=int, default=26)
+  p.add_argument("--static", action="store_true")
+  p.add_argument("--step-delay", type=float, default=0.12)
+  p.add_argument("--drain-deadline", type=float, default=0.0)
+  p.add_argument("--smoke", action="store_true")
+  args = p.parse_args(argv)
+  if args.member:
+    run_member(args.pod_dir, args.id)
+    return 0
+  if args.pod:
+    run_pod(args.pod_dir, args.log, args.world, args.steps,
+            static=args.static, step_delay=args.step_delay,
+            drain_deadline=args.drain_deadline)
+    return 0
+  from distributed_embeddings_tpu.telemetry import emit_verdict
+
+  steps = 18 if args.smoke else args.steps
+  res = run_chaos_preempt(steps=steps, extra_cycles=not args.smoke,
+                          verbose=False)
+  return emit_verdict("chaos-preempt", res)
+
+
+if __name__ == "__main__":
+  sys.exit(main())
