@@ -8,7 +8,8 @@ SHELL := /bin/bash  # verify uses pipefail/PIPESTATUS
 .PHONY: test test-fast verify lint native bench dryrun chaos chaos-kill \
 	chaos-preempt preempt-smoke chaos-stream stream-smoke serve-bench \
 	serve-smoke vocab-bench vocab-smoke obs-bench obs-smoke fresh-bench \
-	fresh-smoke fleet-bench fleet-smoke trace-bench trace-smoke clean
+	fresh-smoke fleet-bench fleet-smoke trace-bench trace-smoke \
+	control-bench control-smoke clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -133,11 +134,26 @@ trace-smoke:
 	PYTHONPATH=$(CURDIR):$$PYTHONPATH timeout -k 10 480 \
 	  $(PY) tools/profile_trace.py --smoke
 
+# control-plane budget: hedging off/on p99.9 on a slow-replica fleet
+# (zero wrong answers, measurable tightening) and a 3x-QPS-step ramp
+# where the autoscaler re-sizes the fleet through apply_fleet mid-load
+# with zero wrong/zero dropped requests, every decision in the
+# replayable control/decisions stream (tools/profile_control.py;
+# budgets in docs/BENCHMARKS.md round 20)
+control-bench:
+	PYTHONPATH=$(CURDIR):$$PYTHONPATH $(PY) tools/profile_control.py
+
+# the make-verify tier of the control bench: tiny world, same
+# assertions, timeout-guarded like the other smoke tiers
+control-smoke:
+	PYTHONPATH=$(CURDIR):$$PYTHONPATH timeout -k 10 300 \
+	  $(PY) tools/profile_control.py --smoke
+
 # the tier-1 gate, exactly as ROADMAP.md specifies it (CPU mesh, no slow
 # tests, collection errors surfaced but not fatal to the log); lint runs
 # first so invariant violations fail fast, then the smoke tiers
 verify: lint serve-smoke vocab-smoke obs-smoke fresh-smoke stream-smoke \
-	fleet-smoke trace-smoke preempt-smoke
+	fleet-smoke trace-smoke preempt-smoke control-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -70,6 +70,13 @@ GL116       error      process signaling (``signal.signal`` /
                        drain, SIGKILL chaos, pid liveness probes) is a
                        resilience contract; a second handler elsewhere
                        silently replaces the drain path's disposition
+GL117       error      fleet mutation surfaces (``fleet.reshard``,
+                       ``apply_fleet``/``set_fleet`` replica-set edits,
+                       ``compact_once``/``gc_deltas``/``compact_chain``
+                       folds) are unreachable from library modules
+                       outside ``control/`` and the surfaces' home
+                       packages — mutations route through decision-
+                       logged control daemons or operator tools
 ==========  =========  =====================================================
 
 Trace-reachable scope (GL101/GL102) is structural: any function nested —
@@ -625,6 +632,71 @@ def _check_fleet_train_surfaces(mod: ParsedModule) -> List[Finding]:
   # one package over). faultinject/retry stay legal — the fleet rides
   # the durable/retry machinery by design.
   return _train_surface_findings(mod, "GL114", "fleet", "fleet")
+
+
+# The fleet MUTATION surface: the operations that change what the fleet
+# IS — re-cut the published artifact (``fleet.reshard``), edit the
+# replica set the router routes through (``apply_fleet``/``set_fleet``),
+# fold or garbage-collect the delta chain (``compact_once``/
+# ``gc_deltas``/``compact_chain``). Each maps to its sanctioned home
+# package (the module that DEFINES it); everywhere else in the library
+# the only legitimate callers are ``control/`` daemons — operator tools
+# and tests live outside the library package and stay unrestricted.
+_FLEET_MUTATION_NAMES = {
+    "reshard": "fleet",
+    "apply_fleet": "fleet",
+    "set_fleet": "fleet",
+    "compact_once": "streaming",
+    "gc_deltas": "streaming",
+    "compact_chain": "streaming",
+}
+
+
+@_rule("GL117", "error",
+       "fleet mutation surfaces are reachable only from control/ daemons")
+def _check_fleet_mutation_surfaces(mod: ParsedModule) -> List[Finding]:
+  # The control plane's authority boundary: a data-path module (router
+  # gather, subscriber fold, batcher flush) that can trigger a reshard,
+  # a replica-set edit, or a chain compaction can wedge the fleet from
+  # a request handler — exactly the accidental-operator bug class the
+  # autonomous control plane exists to absorb. Mutations route through
+  # control/ (decision-logged, hysteresis-guarded) or the operator
+  # tools; the home packages keep their own definitions and internal
+  # plumbing.
+  norm = mod.path.replace(os.sep, "/")
+  if "distributed_embeddings_tpu/" not in norm or "/control/" in norm:
+    return []
+  out = []
+  for node in ast.walk(mod.tree):
+    hits = []
+    if isinstance(node, ast.Import):
+      hits = [last for alias in node.names
+              for last in [alias.name.split(".")[-1]]
+              if last in _FLEET_MUTATION_NAMES]
+    elif isinstance(node, ast.ImportFrom):
+      hits = [a.name for a in node.names
+              if a.name in _FLEET_MUTATION_NAMES]
+    elif isinstance(node, (ast.Name, ast.Attribute)):
+      name = node.id if isinstance(node, ast.Name) else node.attr
+      if name in _FLEET_MUTATION_NAMES:
+        hits = [name]
+    for name in hits:
+      if f"/{_FLEET_MUTATION_NAMES[name]}/" in norm:
+        continue  # the surface's own home package
+      out.append(mod.finding(
+          "GL117", node,
+          f"fleet mutation surface {name!r} referenced from a library "
+          "module outside control/: resharding, replica-set edits, and "
+          "compactor folds are control-plane actuations — route the "
+          "need through a control/ daemon (decision-logged, "
+          "hysteresis-guarded) or an operator tool."))
+  seen = set()
+  uniq = []
+  for f in out:
+    if f.line not in seen:
+      seen.add(f.line)
+      uniq.append(f)
+  return uniq
 
 
 # The dynamic-vocabulary translation surface: every entry point that

@@ -1,0 +1,55 @@
+"""The autonomous serving control plane: observe, decide, actuate, log.
+
+Everything under :mod:`distributed_embeddings_tpu.control` is a CLOSED
+LOOP over machinery the repo already has — no new data paths, no new
+formats.  Four loops share one discipline:
+
+- **hedged requests** live in the router itself
+  (:class:`~..fleet.FleetConfig.hedge_quantile` — a slow gather past
+  the per-owner recent latency quantile is duplicated to a second
+  replica, first answer wins); this package supplies the windows and
+  the accounting conventions it uses;
+- :class:`FleetAutoscaler` moves the replica count when QPS per
+  replica or serve staleness leaves its band — hysteresis + cooldown,
+  actuating through ``apply_fleet``/``fleet.reshard``;
+- :class:`CompactorDaemon` schedules delta-chain folds: lag-aware
+  ``through_seq`` (never past the slowest live subscriber), priority-
+  aware fold order (hot classes first);
+- :class:`ControlPolicy` converts deadline-class latency budgets into
+  the batcher's shed threshold via ``set_admission``.
+
+The shared discipline: every decision is a pure function of an explicit
+inputs snapshot, every decision is logged to the replayable
+``control/decisions`` stream (:class:`DecisionLog`), nothing in the
+decision paths reads a wall clock (callers pass ``now``), and a
+DISABLED loop is a true no-op — the governed components behave
+byte-for-byte as they did before this package existed.
+
+Actuation boundary (graftlint GL117): the fleet/chain mutation surfaces
+(``reshard``, ``apply_fleet``, ``set_fleet``, ``compact_once``,
+``gc_deltas``, ``compact_chain``) are reachable only from this package,
+the owning packages' internals, and operator tools — serving/request
+code cannot resize a fleet as a side effect.
+"""
+
+from __future__ import annotations
+
+from .admission import AdmissionConfig, ControlPolicy
+from .autoscaler import AutoscalerConfig, FleetAutoscaler
+from .compactor import CompactorConfig, CompactorDaemon
+from .decisions import DecisionLog, decision_key, replay_decisions
+from .signals import ControlSnapshot, CounterRate
+
+__all__ = [
+    "AdmissionConfig",
+    "AutoscalerConfig",
+    "CompactorConfig",
+    "CompactorDaemon",
+    "ControlPolicy",
+    "ControlSnapshot",
+    "CounterRate",
+    "DecisionLog",
+    "FleetAutoscaler",
+    "decision_key",
+    "replay_decisions",
+]

@@ -1,0 +1,191 @@
+"""SLO-driven admission: deadline-class budgets become shed thresholds.
+
+The :class:`~..serving.MicroBatcher` already sheds load when its queue
+fills — but ``queue_rows`` is a static constructor argument, and the
+right bound is a function of how fast the backend is moving RIGHT NOW.
+:class:`ControlPolicy` closes the loop: operators declare latency
+budgets per deadline class (``{"realtime": 0.010, "bulk": 0.100}`` —
+p99 seconds), the policy watches the recent p99 through its own
+:class:`~..telemetry.WindowedHistogram`, and each tick it moves the
+batcher's admission bound through
+:meth:`~..serving.MicroBatcher.set_admission`:
+
+- **tighten** (geometrically, by ``step``) toward ``min_queue_rows``
+  while recent p99 exceeds ``slack × budget`` — a shorter queue sheds
+  sooner, which converts would-be deadline misses into counted, fast
+  rejections the client can retry elsewhere (the "fail fast beats fail
+  slow" admission doctrine);
+- **relax** (same factor, inverted) toward the original bound while
+  recent p99 sits under ``relax × budget`` — capacity that recovered
+  is capacity re-admitted, gradually (the asymmetric band between
+  ``relax`` and ``slack`` is the hysteresis: no flapping on a p99 that
+  hovers at the budget);
+- the **effective budget is the tightest class** — the batcher has one
+  queue, so the strictest declared deadline governs it.
+
+Like every control loop here, the decision is a pure function of the
+observed p99 and the policy's state, and each tick logs one replayable
+decision record.  Disabled (no budgets) the policy never calls
+``set_admission`` — the batcher behaves exactly as shipped.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+from ..telemetry import WindowedHistogram
+from .decisions import DecisionLog
+
+__all__ = ["AdmissionConfig", "ControlPolicy"]
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionConfig:
+  """The admission controller's band.
+
+  Attributes:
+    slack: tighten while ``recent p99 > slack * budget`` (>= 1.0 means
+      "only act on an actual breach"; the default 0.9 acts just before).
+    relax: relax while ``recent p99 < relax * budget``; must sit below
+      ``slack`` — the gap is the hysteresis dead-band.
+    step: geometric step per tick (0.7 → each tighten cuts the bound to
+      70%; each relax grows it by 1/0.7).  Geometric, not linear: the
+      right bound can be an order of magnitude away, and a linear
+      crawl would take the whole incident to get there.
+    min_queue_rows: the tighten floor (never below the batcher's
+      ``max_batch`` — :meth:`~..serving.MicroBatcher.set_admission`
+      enforces that refusal; this floor should sit at or above it).
+    min_samples: recent-window observation count below which the policy
+      holds — a p99 of three requests is noise, not a signal.
+    window_slots / window_rotate_s: the recent-latency window shape
+      (see :class:`~..telemetry.WindowedHistogram`).
+  """
+
+  slack: float = 0.9
+  relax: float = 0.5
+  step: float = 0.7
+  min_queue_rows: int = 1
+  min_samples: int = 20
+  window_slots: int = 6
+  window_rotate_s: float = 1.0
+
+  def __post_init__(self):
+    if not 0.0 < self.relax < self.slack:
+      raise ValueError(
+          f"need 0 < relax ({self.relax}) < slack ({self.slack}) — the "
+          "gap between them is the anti-flap dead-band")
+    if not 0.0 < self.step < 1.0:
+      raise ValueError(f"step must be in (0, 1), got {self.step}")
+    if self.min_queue_rows < 1 or self.min_samples < 1:
+      raise ValueError("min_queue_rows and min_samples must be >= 1")
+
+
+class ControlPolicy:
+  """Deadline-class budgets driving the batcher's shed threshold.
+
+  Args:
+    batcher: the :class:`~..serving.MicroBatcher` to govern (anything
+      with ``queue_rows``/``max_batch`` attributes and a
+      ``set_admission`` method).
+    budgets: ``{class_name: p99_budget_seconds}``; the minimum governs.
+      Empty: the policy is a no-op (every tick logs ``hold``/
+      ``no_budgets`` and touches nothing).
+    config: the band (:class:`AdmissionConfig`).
+    decisions: shared :class:`~.decisions.DecisionLog`.
+  """
+
+  SOURCE = "admission"
+
+  def __init__(self, batcher, budgets: Dict[str, float],
+               config: AdmissionConfig = AdmissionConfig(),
+               decisions: Optional[DecisionLog] = None):
+    for name, b in dict(budgets).items():
+      if not (b > 0.0 and math.isfinite(b)):
+        raise ValueError(
+            f"budget for class {name!r} must be a finite positive "
+            f"seconds value, got {b!r}")
+    self.batcher = batcher
+    self.budgets = dict(budgets)
+    self.config = config
+    self.decisions = decisions if decisions is not None else DecisionLog()
+    self._window = WindowedHistogram(
+        "control/admission_latency_s", slots=config.window_slots,
+        rotate_every_s=config.window_rotate_s)
+    # the relax ceiling is wherever the operator started the batcher —
+    # the policy borrows admission during pressure, it never grants
+    # more than the deployment configured
+    self._baseline_rows = int(batcher.queue_rows)
+    self._tick = 0
+
+  @property
+  def effective_budget_s(self) -> Optional[float]:
+    """The tightest declared class budget (``None``: no budgets)."""
+    return min(self.budgets.values()) if self.budgets else None
+
+  def observe_latency(self, seconds: float, now: Optional[float] = None) \
+      -> None:
+    """Feed one served request's latency (``future.latency_s``) into
+    the recent window; ``now`` (telemetry-clock seconds) drives slot
+    rotation when given."""
+    if now is not None:
+      self._window.maybe_rotate(now)
+    self._window.observe(seconds)
+
+  # ---- the pure part ------------------------------------------------------
+  def decide(self, p99_s: float, samples: int, tick: int,
+             current_rows: int) -> Dict[str, Any]:
+    """One tick's tighten/relax/hold choice given the recent p99 —
+    pure, so the logged decisions replay."""
+    cfg = self.config
+    budget = self.effective_budget_s
+    inputs = {"p99_s": None if math.isnan(p99_s) else p99_s,
+              "samples": int(samples), "queue_rows": int(current_rows),
+              "budget_s": budget}
+    if budget is None:
+      return self.decisions.record(
+          self.SOURCE, tick, "hold", "no_budgets", inputs=inputs,
+          target_rows=current_rows)
+    if samples < cfg.min_samples or math.isnan(p99_s):
+      return self.decisions.record(
+          self.SOURCE, tick, "hold", "insufficient_samples", inputs=inputs,
+          target_rows=current_rows)
+    floor = max(cfg.min_queue_rows, int(self.batcher.max_batch))
+    if p99_s > cfg.slack * budget:
+      target = max(floor, int(math.floor(current_rows * cfg.step)))
+      if target < current_rows:
+        return self.decisions.record(
+            self.SOURCE, tick, "tighten", "p99_over_budget", inputs=inputs,
+            target_rows=target)
+      return self.decisions.record(
+          self.SOURCE, tick, "hold", "at_floor", inputs=inputs,
+          target_rows=current_rows)
+    if p99_s < cfg.relax * budget:
+      target = min(self._baseline_rows,
+                   int(math.ceil(current_rows / cfg.step)))
+      if target > current_rows:
+        return self.decisions.record(
+            self.SOURCE, tick, "relax", "p99_under_budget", inputs=inputs,
+            target_rows=target)
+      return self.decisions.record(
+          self.SOURCE, tick, "hold", "at_baseline", inputs=inputs,
+          target_rows=current_rows)
+    return self.decisions.record(
+        self.SOURCE, tick, "hold", "in_band", inputs=inputs,
+        target_rows=current_rows)
+
+  # ---- decide + actuate ---------------------------------------------------
+  def tick(self, now: Optional[float] = None) -> Dict[str, Any]:
+    """One control cycle: read the recent window, decide, and apply the
+    new bound through ``set_admission`` when the decision moves it."""
+    self._tick += 1
+    if now is not None:
+      self._window.maybe_rotate(now)
+    view = self._window.view()
+    p99 = view.percentile(99.0) if view.count else math.nan
+    rec = self.decide(p99, view.count, self._tick,
+                      int(self.batcher.queue_rows))
+    if rec["action"] in ("tighten", "relax"):
+      self.batcher.set_admission(queue_rows=rec["target_rows"])
+    return rec

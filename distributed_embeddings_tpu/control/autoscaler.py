@@ -1,0 +1,165 @@
+"""QPS/staleness-driven replica scaling with hysteresis and cooldown.
+
+The serve-side twin of the trainer's elastic resize: the fleet's
+replica count becomes a CONTROLLED variable.  :class:`FleetAutoscaler`
+watches per-tick :class:`~.signals.ControlSnapshot` readings and moves
+the replica count when the load per replica leaves its band:
+
+- **scale up** when recent QPS per replica exceeds
+  ``qps_high_per_replica`` (or serve staleness exceeds
+  ``staleness_high_s`` — a fleet that cannot keep up with its delta
+  chain is capacity-starved) for ``up_after`` CONSECUTIVE ticks;
+- **scale down** when QPS per replica has been below
+  ``qps_low_per_replica`` for ``down_after`` consecutive ticks — the
+  longer streak on the way down is deliberate asymmetry: under-capacity
+  costs users latency, over-capacity costs only machines;
+- **never flap**: after any scaling action the loop holds for
+  ``cooldown_ticks`` regardless of the signals (a resize changes the
+  very signals being watched — deciding on mid-transition readings is
+  how oscillation starts), and the consecutive-streak requirement means
+  a single noisy tick moves nothing.
+
+The decision function is deterministic: given the same snapshot
+sequence and config, the same decisions come out (pinned in
+tests/test_control.py).  Actuation is a callback — the deployment
+supplies "spawn owners + :meth:`~..fleet.FleetRouter.apply_fleet`" (or
+``fleet.reshard`` for a rank re-cut); the decision logic never imports
+the machinery it drives, so it unit-tests without a fleet.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Dict, Optional
+
+from .decisions import DecisionLog
+from .signals import ControlSnapshot
+
+__all__ = ["AutoscalerConfig", "FleetAutoscaler"]
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalerConfig:
+  """The scaling band and its anti-flap guards.
+
+  Attributes:
+    qps_high_per_replica: recent QPS per replica above which the fleet
+      is under-provisioned.
+    qps_low_per_replica: recent QPS per replica below which it is
+      over-provisioned (must sit well under ``high`` after a downsize:
+      the no-flap test checks ``high * (r-1)/r > low`` for adjacent
+      sizes, or a downsize immediately re-triggers an upsize).
+    staleness_high_s: serve staleness above which the fleet scales up
+      regardless of QPS (``inf`` disables the staleness trigger).
+    min_replicas / max_replicas: the hard bounds.
+    up_after / down_after: consecutive breached ticks required before
+      acting (hysteresis; down is slower than up on purpose).
+    cooldown_ticks: ticks to hold after ANY action.
+  """
+
+  qps_high_per_replica: float
+  qps_low_per_replica: float
+  staleness_high_s: float = math.inf
+  min_replicas: int = 1
+  max_replicas: int = 4
+  up_after: int = 2
+  down_after: int = 3
+  cooldown_ticks: int = 3
+
+  def __post_init__(self):
+    if not 0.0 <= self.qps_low_per_replica < self.qps_high_per_replica:
+      raise ValueError(
+          f"need 0 <= qps_low ({self.qps_low_per_replica}) < qps_high "
+          f"({self.qps_high_per_replica}) — an inverted band scales up "
+          "and down on the same reading")
+    if not 1 <= self.min_replicas <= self.max_replicas:
+      raise ValueError(
+          f"need 1 <= min_replicas ({self.min_replicas}) <= "
+          f"max_replicas ({self.max_replicas})")
+    if self.up_after < 1 or self.down_after < 1 or self.cooldown_ticks < 0:
+      raise ValueError("up_after/down_after must be >= 1 and "
+                       "cooldown_ticks >= 0")
+
+
+class FleetAutoscaler:
+  """The replica-scaling decision loop.
+
+  Args:
+    config: the band (:class:`AutoscalerConfig`).
+    actuate: ``actuate(target_replicas, decision_record)`` — performs
+      the resize (owner spawn/drain + ``apply_fleet``, or a full
+      ``fleet.reshard``); called only for scale actions, AFTER the
+      decision is logged.  An actuation that raises logs a follow-up
+      ``actuate_failed`` record and re-raises — the log never silently
+      claims a resize that did not happen.
+    decisions: the shared :class:`~.decisions.DecisionLog` (one stream
+      for the whole control plane; default: a fresh in-memory log).
+  """
+
+  SOURCE = "autoscaler"
+
+  def __init__(self, config: AutoscalerConfig,
+               actuate: Optional[Callable[[int, Dict[str, Any]], None]]
+               = None,
+               decisions: Optional[DecisionLog] = None):
+    self.config = config
+    self.actuate = actuate
+    self.decisions = decisions if decisions is not None else DecisionLog()
+    self._high_streak = 0
+    self._low_streak = 0
+    self._cooldown = 0
+
+  # ---- the pure part ------------------------------------------------------
+  def decide(self, snap: ControlSnapshot) -> Dict[str, Any]:
+    """One tick's decision (state update + logged record, no
+    actuation).  Deterministic: same snapshot sequence in, same
+    decision sequence out."""
+    cfg = self.config
+    r = max(1, int(snap.replicas))
+    per_replica = snap.qps / r
+    stale = snap.staleness_s > cfg.staleness_high_s
+    high = per_replica > cfg.qps_high_per_replica or stale
+    low = per_replica < cfg.qps_low_per_replica and not stale
+
+    # streaks advance even through cooldown — a breach that persists
+    # ACROSS the cooldown window acts on its first eligible tick
+    self._high_streak = self._high_streak + 1 if high else 0
+    self._low_streak = self._low_streak + 1 if low else 0
+
+    action, target, reason = "hold", r, "in_band"
+    if self._cooldown > 0:
+      self._cooldown -= 1
+      reason = "cooldown"
+    elif self._high_streak >= cfg.up_after and r < cfg.max_replicas:
+      action, target = "scale_up", r + 1
+      reason = "staleness_high" if stale and per_replica \
+          <= cfg.qps_high_per_replica else "qps_high"
+    elif self._high_streak >= cfg.up_after:
+      reason = "at_max_replicas"
+    elif self._low_streak >= cfg.down_after and r > cfg.min_replicas:
+      action, target, reason = "scale_down", r - 1, "qps_low"
+    elif self._low_streak >= cfg.down_after:
+      reason = "at_min_replicas"
+    if action != "hold":
+      self._cooldown = cfg.cooldown_ticks
+      self._high_streak = self._low_streak = 0
+    return self.decisions.record(
+        self.SOURCE, snap.tick, action, reason,
+        inputs=snap.to_inputs(), target_replicas=target,
+        qps_per_replica=per_replica,
+        high_streak=self._high_streak, low_streak=self._low_streak)
+
+  # ---- decide + actuate ---------------------------------------------------
+  def tick(self, snap: ControlSnapshot) -> Dict[str, Any]:
+    rec = self.decide(snap)
+    if rec["action"] in ("scale_up", "scale_down") \
+        and self.actuate is not None:
+      try:
+        self.actuate(rec["target_replicas"], rec)
+      except BaseException as e:  # noqa: BLE001 — logged, then re-raised
+        self.decisions.record(
+            self.SOURCE, snap.tick, "actuate_failed", repr(e),
+            inputs={"target_replicas": rec["target_replicas"]})
+        raise
+    return rec

@@ -1,0 +1,190 @@
+"""Scheduled delta compaction: lag-aware, priority-aware, decision-logged.
+
+PR 16 gave the chain a manual compactor
+(:class:`~..streaming.DeltaCompactor`): an operator hand-picks
+``through_seq`` and runs a fold.  :class:`CompactorDaemon` closes that
+loop.  Each tick it reads the chain's observable state — the base
+anchor, the contiguous published run, the live subscribers' fsynced
+heartbeats — and decides:
+
+- **lag-aware ``through_seq``**: never fold past the slowest LIVE
+  subscriber's ``applied_seq`` floor.  Folding further is *correct*
+  (the stranded subscriber would rebase onto the compacted base), but
+  a rebase is a staleness spike the scheduler exists to avoid; expired
+  heartbeats drop out of the floor (the publisher's quorum rule — a
+  dead subscriber must not pin the chain forever);
+- **fold only when worth it**: at least ``min_deltas`` foldable deltas
+  (each fold rewrites every class image — folding per-delta would turn
+  the compactor into the bottleneck it exists to remove);
+- **priority-aware promotion**: the fold order feeds
+  ``class_priority`` (hot classes first — typically the serve plan's
+  hotness ranking), so a mid-fold kill leaves the freshest work on the
+  classes that matter.
+
+Every tick logs one decision (``fold`` / ``hold``) with the full chain
+state as ``inputs`` — :meth:`decide` is a pure function of that state,
+so the log replays (pinned in tests/test_control.py).  ``start()`` runs
+the tick on a daemon thread at ``interval_s``; deployments that already
+have a control loop call :meth:`tick` themselves.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Any, Dict, Optional
+
+from ..checkpoint import manifest_fingerprint, read_manifest
+from ..streaming.compact import DeltaCompactor
+from ..streaming.publish import (
+    BASE_DIR,
+    chain_anchor,
+    published_delta_seqs,
+    read_heartbeats,
+)
+from ..telemetry import get_registry as _registry
+from .decisions import DecisionLog
+
+__all__ = ["CompactorConfig", "CompactorDaemon"]
+
+
+@dataclasses.dataclass(frozen=True)
+class CompactorConfig:
+  """The fold schedule's knobs.
+
+  Attributes:
+    interval_s: tick period of the daemon thread (:meth:`start`).
+    min_deltas: smallest foldable backlog worth a fold.
+    heartbeat_ttl_s: heartbeats older than this drop out of the lag
+      floor (must match the publisher's quorum TTL).
+  """
+
+  interval_s: float = 30.0
+  min_deltas: int = 4
+  heartbeat_ttl_s: float = 30.0
+
+  def __post_init__(self):
+    if self.min_deltas < 1:
+      raise ValueError(f"min_deltas must be >= 1, got {self.min_deltas}")
+
+
+class CompactorDaemon:
+  """The compaction scheduler over one publish directory."""
+
+  SOURCE = "compactor"
+
+  def __init__(self, path: str,
+               config: CompactorConfig = CompactorConfig(),
+               class_priority: Optional[Dict[str, float]] = None,
+               decisions: Optional[DecisionLog] = None,
+               telemetry=None):
+    self.path = str(path)
+    self.config = config
+    self.class_priority = dict(class_priority or {})
+    self.decisions = decisions if decisions is not None else DecisionLog()
+    self.telemetry = telemetry if telemetry is not None else _registry()
+    self._compactor = DeltaCompactor(
+        self.path, heartbeat_ttl_s=config.heartbeat_ttl_s,
+        telemetry=self.telemetry)
+    self._tick = 0
+    self._thread: Optional[threading.Thread] = None
+    self._stop = threading.Event()
+
+  # ---- observation --------------------------------------------------------
+  def observe(self) -> Dict[str, Any]:
+    """The chain's state as the decision's inputs: base anchor,
+    contiguous published run end, and the live-subscriber lag floor
+    (``None`` when no live subscriber is registered)."""
+    base = os.path.join(self.path, BASE_DIR)
+    if not os.path.isfile(os.path.join(base, "manifest.json")):
+      return {"anchor_seq": None, "run_end": None, "live_floor": None,
+              "live_subscribers": 0, "expired_subscribers": 0}
+    bman = read_manifest(base)
+    anchor_seq, _fp, _root = chain_anchor(bman, manifest_fingerprint(base))
+    seqs = published_delta_seqs(self.path)
+    run_end = anchor_seq
+    while run_end + 1 in seqs:
+      run_end += 1
+    live, expired = read_heartbeats(self.path,
+                                    self.config.heartbeat_ttl_s)
+    floor = min((int(hb["applied_seq"]) for hb in live.values()),
+                default=None) if live else None
+    return {"anchor_seq": anchor_seq, "run_end": run_end,
+            "live_floor": floor, "live_subscribers": len(live),
+            "expired_subscribers": len(expired)}
+
+  # ---- the pure part ------------------------------------------------------
+  def decide(self, state: Dict[str, Any], tick: int) -> Dict[str, Any]:
+    """Pure fold/hold decision over an :meth:`observe` state dict —
+    deterministic, so the decision log replays against recorded
+    inputs."""
+    cfg = self.config
+    if state["anchor_seq"] is None:
+      return self.decisions.record(
+          self.SOURCE, tick, "hold", "no_base", inputs=state,
+          through_seq=None)
+    k = int(state["run_end"])
+    if state["live_floor"] is not None:
+      # the lag-aware clamp: the slowest live subscriber's heartbeat is
+      # the fold ceiling — nobody gets stranded behind the compaction
+      # point while their heartbeat is current
+      k = min(k, int(state["live_floor"]))
+    foldable = k - int(state["anchor_seq"])
+    if foldable < cfg.min_deltas:
+      reason = "backlog_below_min" if int(state["run_end"]) \
+          - int(state["anchor_seq"]) < cfg.min_deltas else "subscriber_lag"
+      return self.decisions.record(
+          self.SOURCE, tick, "hold", reason, inputs=state, through_seq=k)
+    return self.decisions.record(
+        self.SOURCE, tick, "fold", "backlog", inputs=state,
+        through_seq=k, deltas=foldable,
+        fold_priority=sorted(self.class_priority,
+                             key=lambda n: (-self.class_priority[n], n)))
+
+  # ---- decide + actuate ---------------------------------------------------
+  def tick(self) -> Dict[str, Any]:
+    """One scheduling cycle: observe, decide, and run the fold when the
+    decision says so.  Returns the decision record (with the fold's
+    summary attached in memory on success)."""
+    self._tick += 1
+    rec = self.decide(self.observe(), self._tick)
+    if rec["action"] == "fold":
+      try:
+        result = self._compactor.compact_once(
+            through_seq=rec["through_seq"], gc=True,
+            class_priority=self.class_priority)
+      except BaseException as e:  # noqa: BLE001 — logged, then re-raised
+        self.decisions.record(
+            self.SOURCE, self._tick, "fold_failed", repr(e),
+            inputs={"through_seq": rec["through_seq"]})
+        raise
+      rec["result"] = result
+    return rec
+
+  # ---- the daemon ---------------------------------------------------------
+  def start(self) -> "CompactorDaemon":
+    if self._thread is not None:
+      raise RuntimeError("CompactorDaemon already started")
+    self._stop.clear()
+
+    def loop():
+      while not self._stop.wait(self.config.interval_s):
+        try:
+          self.tick()
+        except Exception:  # noqa: BLE001 — the failure is in the log
+          # a failed fold (torn chain, transient IO) must not kill the
+          # scheduler: the fold_failed decision is recorded, the old
+          # base is untouched (manifest-last), and the next tick retries
+          continue
+
+    self._thread = threading.Thread(target=loop, name="compactor-daemon",
+                                    daemon=True)
+    self._thread.start()
+    return self
+
+  def stop(self) -> None:
+    self._stop.set()
+    if self._thread is not None:
+      self._thread.join(timeout=10.0)
+      self._thread = None

@@ -1,0 +1,117 @@
+"""The control plane's decision record: structured, durable, replayable.
+
+Every loop in :mod:`~distributed_embeddings_tpu.control` — the
+autoscaler, the compactor daemon, the admission policy — emits one
+record per tick through :class:`DecisionLog`: what it saw (``inputs``),
+what it did (``action``), and why (``reason``).  Three consumers:
+
+- **operations**: the ``control/decisions`` JSONL stream (the
+  :class:`~..telemetry.JsonlWriter` fsync-per-line protocol) is the
+  audit trail "why did the fleet shrink at 03:12" reads — each line is
+  self-contained;
+- **determinism**: a decision is a pure function of its ``inputs`` plus
+  the loop's declared config, so replaying the logged inputs through a
+  fresh loop instance must reproduce the logged actions exactly —
+  :func:`replay_decisions` + the pinned tests in tests/test_control.py
+  are that contract (the wall stamp is the ONE non-deterministic field,
+  and it is excluded from the comparison by construction);
+- **verdicts**: the in-memory mirror (:attr:`DecisionLog.records`)
+  feeds the bench tools' ``emit_verdict`` sections without re-reading
+  the file.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..telemetry import JsonlWriter, get_registry as _registry
+
+__all__ = ["DecisionLog", "decision_key", "replay_decisions"]
+
+# the deterministic identity of a decision: every field EXCEPT the wall
+# stamp and the log sequence — what replay compares
+_NONDETERMINISTIC_FIELDS = ("wall", "log_seq")
+
+
+def decision_key(record: Dict[str, Any]) -> Dict[str, Any]:
+  """The record minus its non-deterministic fields (wall stamp, log
+  sequence) — the value two replays of the same inputs must agree on."""
+  return {k: v for k, v in record.items()
+          if k not in _NONDETERMINISTIC_FIELDS}
+
+
+class DecisionLog:
+  """Append-only decision stream: JSONL on disk, mirrored in memory.
+
+  Args:
+    path: the ``control/decisions`` JSONL file (rotated, fsync-per-line
+      — a SIGKILLed control process keeps every decision it made).
+      ``None``: in-memory only (unit tests, dry runs).
+    telemetry: registry for the ``control/decisions`` counter (default
+      process-wide).
+  """
+
+  def __init__(self, path: Optional[str] = None, telemetry=None):
+    self._writer = JsonlWriter(path) if path else None
+    self._lock = threading.Lock()
+    self._records: List[Dict[str, Any]] = []
+    self._seq = 0
+    self.telemetry = telemetry if telemetry is not None else _registry()
+
+  def record(self, source: str, tick: int, action: str, reason: str,
+             inputs: Optional[Dict[str, Any]] = None,
+             **detail) -> Dict[str, Any]:
+    """Append one decision; returns the full record (with its stamp).
+
+    ``inputs`` must be everything the decision read — the replay
+    contract depends on the record being self-contained."""
+    rec: Dict[str, Any] = {
+        "source": source,
+        "tick": int(tick),
+        "action": action,
+        "reason": reason,
+        "inputs": dict(inputs or {}),
+    }
+    rec.update(detail)
+    with self._lock:
+      rec["log_seq"] = self._seq
+      self._seq += 1
+      rec["wall"] = time.time()
+      self._records.append(rec)
+      if self._writer is not None:
+        self._writer.write(rec)
+    self.telemetry.counter("control/decisions").inc()
+    self.telemetry.counter(f"control/decisions/{source}").inc()
+    return rec
+
+  @property
+  def records(self) -> List[Dict[str, Any]]:
+    with self._lock:
+      return list(self._records)
+
+  def close(self) -> None:
+    with self._lock:
+      if self._writer is not None:
+        self._writer.close()
+
+  def __enter__(self) -> "DecisionLog":
+    return self
+
+  def __exit__(self, exc_type, exc, tb):
+    self.close()
+    return False
+
+
+def replay_decisions(path: str) -> List[Dict[str, Any]]:
+  """Read a decision log back (main file only — rotation archives are
+  the operator's history, not the replay's)."""
+  out = []
+  with open(path) as f:
+    for line in f:
+      line = line.strip()
+      if line:
+        out.append(json.loads(line))
+  return out

@@ -1,0 +1,90 @@
+"""Control-plane inputs: recent-window readings over existing signals.
+
+The control loops decide on what the system already exports — fleet
+counter roll-ups, ``serve/latency_s`` histograms, ``/healthz``-style
+staleness gauges — but every decision needs the RECENT value, not the
+lifetime-cumulative one.  This module is the small adapter layer:
+
+- :class:`CounterRate` turns a monotone cumulative counter into a
+  per-interval rate (the QPS signal: successive samples of
+  ``serve/submitted`` over the tick interval);
+- :class:`ControlSnapshot` is the frozen per-tick reading every loop's
+  ``decide`` consumes — and, verbatim, the ``inputs`` field of the
+  decision it logs, which is what makes the log replayable: the
+  snapshot IS everything the decision saw.
+
+Clock discipline: nothing here reads a clock.  Callers pass ``now`` (a
+seconds reading from the telemetry clock) into :meth:`CounterRate.sample`
+and stamp snapshots with their own tick counter — control stays a
+deterministic function of its inputs, and GL113 stays true without
+suppressions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+__all__ = ["ControlSnapshot", "CounterRate"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ControlSnapshot:
+  """One control tick's reading of the world.
+
+  Attributes:
+    tick: the control loop's monotone tick counter (its logical clock).
+    qps: recent offered request rate (a :class:`CounterRate` sample of
+      the batcher's ``serve/submitted``).
+    p99_s / p999_s: RECENT latency quantiles (a
+      :class:`~..telemetry.WindowedHistogram` view, not the lifetime
+      histogram).
+    staleness_s: the serve tier's freshness lag (the ``/healthz``
+      most-stale promote reading, or ``stream/freshness_s``).
+    replicas: the fleet's current replica count for the hot rank set.
+    pending_rows: the batcher's queued row count (queue pressure).
+  """
+
+  tick: int
+  qps: float = 0.0
+  p99_s: float = math.nan
+  p999_s: float = math.nan
+  staleness_s: float = 0.0
+  replicas: int = 1
+  pending_rows: int = 0
+
+  def to_inputs(self) -> Dict[str, Any]:
+    """The snapshot as a decision record's ``inputs`` dict (NaNs to
+    None: the log is JSON, and ``NaN`` is not)."""
+    out = {}
+    for f in dataclasses.fields(self):
+      v = getattr(self, f.name)
+      if isinstance(v, float) and math.isnan(v):
+        v = None
+      out[f.name] = v
+    return out
+
+
+class CounterRate:
+  """Per-interval rate from a monotone cumulative counter.
+
+  ``sample(value, now)`` returns the rate over the elapsed interval
+  since the previous sample (0.0 on the first sample, or when no time
+  has passed — a rate needs an interval).  The caller supplies both the
+  counter reading and the clock reading, so the sampler itself is a
+  pure difference engine — replayable and clock-free."""
+
+  __slots__ = ("_last_value", "_last_now")
+
+  def __init__(self):
+    self._last_value: Optional[float] = None
+    self._last_now: Optional[float] = None
+
+  def sample(self, value: float, now: float) -> float:
+    value, now = float(value), float(now)
+    last_v, last_t = self._last_value, self._last_now
+    self._last_value, self._last_now = value, now
+    if last_v is None or now <= last_t:
+      return 0.0
+    return max(0.0, value - last_v) / (now - last_t)

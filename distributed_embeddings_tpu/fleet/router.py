@@ -44,6 +44,7 @@ from ..resilience import faultinject, retry
 from ..serving.engine import ServeEngine, ServeTierConfig, ServeTierPlan
 from ..serving.export import ServeClassMeta, np_dtype_of
 from ..serving.export import load as serve_load
+from ..telemetry import WindowedHistogram
 from ..telemetry import get_registry as _registry, span as _span
 from ..telemetry import flight as _flight
 from ..telemetry import trace as _trace
@@ -77,6 +78,21 @@ class FleetConfig:
       rotation before the router probes it again.
     fanout_threads: concurrent owner gathers per dispatch (the fan-out
       width of the stage's remote reads).
+    hedge_quantile: hedge a gather whose primary replica has been in
+      flight longer than this RECENT per-owner latency quantile (a
+      fraction, e.g. 0.99 — the tail-at-scale lever). ``None`` (the
+      default) disables hedging entirely: the gather path is the plain
+      failover call, byte-for-byte the pre-control behavior.
+    hedge_min_s: hedge-delay floor — never hedge earlier than this,
+      and the effective delay before the per-owner window has
+      ``hedge_min_samples`` recent observations (a quantile over three
+      samples is noise, not a policy).
+    hedge_min_samples: recent observations required before the
+      windowed quantile replaces the floor.
+    hedge_window_slots / hedge_window_rotate_s: the per-owner rolling
+      window's geometry — ``slots`` sealed sub-histograms rotated every
+      ``rotate_s`` seconds, so the hedge threshold tracks the last
+      ``slots x rotate_s`` seconds of that owner, not its lifetime.
   """
 
   cache_fraction: float = 0.05
@@ -85,6 +101,18 @@ class FleetConfig:
   shard_min_phys_rows: int = 256
   revive_after_s: float = 5.0
   fanout_threads: int = 8
+  hedge_quantile: Optional[float] = None
+  hedge_min_s: float = 0.005
+  hedge_min_samples: int = 20
+  hedge_window_slots: int = 6
+  hedge_window_rotate_s: float = 1.0
+
+  def __post_init__(self):
+    if self.hedge_quantile is not None \
+        and not 0.0 < self.hedge_quantile < 1.0:
+      raise ValueError(
+          f"hedge_quantile must be in (0, 1) or None, got "
+          f"{self.hedge_quantile}")
 
 
 class FleetStore:
@@ -137,9 +165,12 @@ class FleetStore:
     self._dead: Dict[int, float] = {}  # owner -> monotonic death stamp
     self._prefetched: Dict[tuple, tuple] = {}
     self._pool = None
+    self._hedge_pool = None
+    self._gather_window: Dict[int, WindowedHistogram] = {}
     self._counters = {k: self.telemetry.counter(f"fleet/{k}")
                       for k in ("rpcs", "rpc_bytes", "rpc_retries",
-                                "failovers", "dead_rank_errors")}
+                                "failovers", "dead_rank_errors",
+                                "hedges", "hedges_won", "hedges_wasted")}
     self._dead_gauge = self.telemetry.gauge("fleet/owners_dead")
 
   @property
@@ -351,6 +382,200 @@ class FleetStore:
         f"(last error: {last!r}). The request fails explicitly — the "
         "router never substitutes rows it cannot fetch.")
 
+  # ---- request hedging (the control plane's tail lever) --------------------
+  def _observe_gather(self, owner: int, seconds: float) -> None:
+    """Feed one WINNING gather's latency into the owner's ROLLING
+    window (the hedge threshold's input — recent, not lifetime) and the
+    lifetime ``fleet/gather_s`` histogram. Only winners are observed:
+    feeding a losing attempt's latency back into its own threshold
+    would teach the window that slow is normal — a persistently slow
+    replica would raise its own quantile until hedging stopped firing
+    against exactly the owner that needs it. A loser contributes
+    nothing; its window drains over rotations until the
+    ``hedge_min_s`` floor re-arms aggressive hedging. Only the hedged
+    path calls this: with hedging off the gather path allocates
+    nothing new."""
+    with self._lock:
+      w = self._gather_window.get(owner)
+      if w is None:
+        w = WindowedHistogram(
+            f"fleet/gather_s/owner{owner}",
+            slots=self.config.hedge_window_slots,
+            rotate_every_s=self.config.hedge_window_rotate_s)
+        self._gather_window[owner] = w
+    w.maybe_rotate(self._now())
+    w.observe(seconds)
+    self.telemetry.histogram("fleet/gather_s").observe(seconds)
+
+  def _hedge_threshold_s(self, owner: int) -> float:
+    """How long the primary may be in flight before the hedge fires:
+    the owner's RECENT ``hedge_quantile`` latency, floored at
+    ``hedge_min_s`` (and the floor alone until the window has enough
+    samples to make the quantile a policy rather than noise)."""
+    cfg = self.config
+    with self._lock:
+      w = self._gather_window.get(owner)
+    p = 0.0
+    if w is not None:
+      w.maybe_rotate(self._now())
+      if w.count >= cfg.hedge_min_samples:
+        p = w.percentile(cfg.hedge_quantile * 100.0)
+    if not (p == p):  # NaN: empty window
+      p = 0.0
+    return max(cfg.hedge_min_s, p)
+
+  def _hedge_pool_get(self):
+    """The hedge race's executor — separate from the fan-out pool:
+    hedged calls run ON fan-out threads, and a saturated pool
+    submitting to itself would deadlock."""
+    from concurrent.futures import ThreadPoolExecutor
+    with self._lock:
+      if self._hedge_pool is None:
+        self._hedge_pool = ThreadPoolExecutor(
+            max_workers=max(2, 2 * self.config.fanout_threads),
+            thread_name_prefix="fleet-hedge")
+      return self._hedge_pool
+
+  def _gather_call(self, for_rank: int, **kwargs) -> Dict[str, Any]:
+    if self.config.hedge_quantile is None:
+      return self._failover_call(for_rank, "gather", **kwargs)
+    return self._hedged_call(for_rank, "gather", **kwargs)
+
+  def _hedged_call(self, for_rank: int, method: str, **kwargs
+                   ) -> Dict[str, Any]:
+    """First-answer-wins gather race: the primary replica runs
+    immediately; if it is still in flight past the recent per-owner
+    quantile (:meth:`_hedge_threshold_s`), a duplicate fires at the
+    next live replica. Whichever answers first wins — replicas serve
+    identical immutable images, so the winner's rows are the SAME f32
+    bytes either way; the loser is cancelled by never launching (the
+    common case) or discarded and counted ``fleet/hedges_wasted`` when
+    it completes. Exactly-once accounting: ``fleet/hedges`` increments
+    at hedge LAUNCH (never per retry inside an attempt),
+    ``hedges_won`` when the hedge's answer is used, ``hedges_wasted``
+    when a losing attempt completes anyway. Both attempts run under
+    the caller's trace context, so a hedged request shows both rpc
+    spans on one timeline. A rank whose every replica fails still
+    raises :class:`OwnerUnavailableError` — hedging never substitutes
+    rows."""
+    owners = self.fplan.owners_of(for_rank)
+    self._maybe_probe(owners)
+    order = self._replica_order(owners)
+    with self._lock:
+      live = [o for o in order if o not in self._dead]
+    if len(live) < 2:
+      # nothing to race against: the plain counted-failover path
+      return self._failover_call(for_rank, method, **kwargs)
+    primary, backup = live[0], live[1]
+    threshold = self._hedge_threshold_s(primary)
+    pool = self._hedge_pool_get()
+    ctx = _trace.get_current_context()
+    fr = _flight.current_flight_recorder()
+    rec = fr.current() if fr is not None else None
+
+    cond = threading.Condition()
+    st: Dict[str, Any] = {"outcomes": {}, "winner": None,
+                          "hedge_launched": False}
+
+    def run(owner: int, role: str) -> None:
+      fr2 = _flight.current_flight_recorder()
+      if fr2 is not None and rec is not None:
+        fr2.bind(rec)
+      try:
+        with _trace.use_context(ctx):
+          t0 = _trace.clock_ns()
+          if role == "hedge":
+            with _span("fleet/hedge",
+                       args={"owner": owner, "rank": for_rank}):
+              out = self._call(owner, method, **kwargs)
+          else:
+            out = self._call(owner, method, **kwargs)
+        dt = (_trace.clock_ns() - t0) / 1e9
+        self._mark_alive(owner)
+        with cond:
+          st["outcomes"][role] = ("ok", out)
+          if st["winner"] is None:
+            st["winner"] = role
+          else:
+            # the losing attempt ran to completion: real work the race
+            # discarded — counted exactly once, here and nowhere else
+            self._counters["hedges_wasted"].inc()
+          won = st["winner"] == role
+          cond.notify_all()
+        if won:
+          self._observe_gather(owner, dt)
+      except OSError as e:
+        # same bookkeeping as the sequential failover loop: the
+        # replica is abandoned, counted, and noted on the request
+        self._mark_dead(owner)
+        self._counters["failovers"].inc()
+        if fr2 is not None:
+          fr2.note("failover", owner=owner, rank=for_rank,
+                   error=repr(e))
+        _flight.flight_trip("failover", owner=owner, rank=for_rank)
+        with cond:
+          st["outcomes"][role] = ("oserror", e)
+          cond.notify_all()
+      except BaseException as e:  # noqa: BLE001 — re-raised by caller
+        # RemoteRefusal / injected crashes: terminal for the request
+        # (a replica would refuse identically — retrying elsewhere
+        # would mask a real bug)
+        with cond:
+          st["outcomes"][role] = ("fatal", e)
+          cond.notify_all()
+      finally:
+        if fr2 is not None and rec is not None:
+          fr2.bind(None)
+
+    pool.submit(run, primary, "primary")
+    with cond:
+      cond.wait_for(lambda: "primary" in st["outcomes"],
+                    timeout=threshold)
+      got = st["outcomes"].get("primary")
+      if got is not None and got[0] == "ok":
+        return got[1]
+      if got is not None and got[0] == "fatal":
+        raise got[1]
+      # primary slow (past the recent quantile) or already failed:
+      # launch the duplicate at the next live replica
+      st["hedge_launched"] = True
+    self._counters["hedges"].inc()
+    if fr is not None:
+      fr.note("hedge", primary=primary, backup=backup, rank=for_rank,
+              threshold_s=threshold)
+    pool.submit(run, backup, "hedge")
+    with cond:
+      cond.wait_for(lambda: st["winner"] is not None
+                    or any(o[0] == "fatal"
+                           for o in st["outcomes"].values())
+                    or len(st["outcomes"]) == 2)
+      for o in st["outcomes"].values():
+        if o[0] == "fatal":
+          raise o[1]
+      if st["winner"] is not None:
+        if st["winner"] == "hedge":
+          self._counters["hedges_won"].inc()
+        return st["outcomes"][st["winner"]][1]
+    # both racers failed with OSErrors: fall through to any replicas
+    # the race did not touch, then fail the request explicitly
+    last = next(iter(st["outcomes"].values()))[1]
+    for owner in [o for o in order if o not in (primary, backup)]:
+      try:
+        out = self._call(owner, method, **kwargs)
+      except OSError as e:
+        self._mark_dead(owner)
+        last = e
+        self._counters["failovers"].inc()
+        _flight.flight_trip("failover", owner=owner, rank=for_rank)
+        continue
+      self._mark_alive(owner)
+      return out
+    self._counters["dead_rank_errors"].inc()
+    raise OwnerUnavailableError(
+        f"rank {for_rank}: every replica {list(owners)} is unreachable "
+        f"(last error: {last!r}). The request fails explicitly — the "
+        "router never substitutes rows it cannot fetch, hedged or not.")
+
   def _fetch_meta(self, name: str, rank: int,
                   grps: np.ndarray) -> np.ndarray:
     m = self.meta[name]
@@ -358,8 +583,7 @@ class FleetStore:
     grps = np.asarray(grps, np.int64)
     if not grps.size:
       return np.zeros((0, lay.phys_width), self.dtype)
-    out = self._failover_call(rank, "gather", name=name, rank=rank,
-                              grps=grps)
+    out = self._gather_call(rank, name=name, rank=rank, grps=grps)
     rows = m.from_disk(np.asarray(out["rows"]))
     if rows.shape != (grps.size, lay.phys_width):
       raise ValueError(
@@ -455,10 +679,40 @@ class FleetStore:
       return pre[1]
     return self._fetch(name, rank, np.asarray(grps, np.int64))
 
+  def set_fleet(self, fplan: FleetPlan, transport=None) -> None:
+    """Replica-set edit: adopt a new fleet plan (and optionally a new
+    transport carrying spawned/drained owners). A CONTROL surface —
+    graftlint GL117 keeps it unreachable from library code outside
+    ``control/``; callers must hold the router's dispatch lock so the
+    swap lands between dispatches (zero in-flight requests see a
+    half-changed rotation)."""
+    if fplan.world_size != self.plan.world_size:
+      raise ValueError(
+          f"fleet plan world_size {fplan.world_size} != serving plan "
+          f"world_size {self.plan.world_size} — a replica-set edit "
+          "cannot change the artifact's rank cut (that is "
+          "fleet.reshard)")
+    with self._lock:
+      self.fplan = fplan
+      if transport is not None:
+        self.transport = transport
+      for o in range(fplan.n_owners):
+        self._inflight.setdefault(o, 0)
+      # owners outside the new plan are drained: their death stamps and
+      # windows go with them (a re-added owner starts fresh)
+      self._dead = {o: t for o, t in self._dead.items()
+                    if o < fplan.n_owners}
+      self._gather_window = {o: w for o, w in self._gather_window.items()
+                             if o < fplan.n_owners}
+      self._dead_gauge.set(len(self._dead))
+
   def close(self) -> None:
     if self._pool is not None:
       self._pool.shutdown(wait=False)
       self._pool = None
+    if self._hedge_pool is not None:
+      self._hedge_pool.shutdown(wait=False)
+      self._hedge_pool = None
 
 
 class FleetRouter(ServeEngine):
@@ -669,6 +923,22 @@ class FleetRouter(ServeEngine):
 
   def adopt_step(self, step: int) -> None:
     self.step = int(step)
+
+  def apply_fleet(self, fleet_plan: FleetPlan, transport=None) -> None:
+    """Autoscaler actuation: adopt a grown/shrunk replica set under the
+    dispatch lock. In-flight dispatches complete before the swap (the
+    promote-lock contract — zero requests dropped during a resize); the
+    new plan's owners must pass the same handshake the startup path
+    enforces (plan fingerprint, quantize, class geometry, coverage), so
+    a half-deployed owner set refuses rather than serving wrong. A
+    CONTROL surface (graftlint GL117): only ``control/`` daemons and
+    operator tools may call it."""
+    self._validate_fleet(
+        transport if transport is not None else self.store.transport,
+        fleet_plan)
+    with self.lock:
+      self.fleet_plan = fleet_plan
+      self.store.set_fleet(fleet_plan, transport)
 
   def close(self) -> None:
     self.store.close()

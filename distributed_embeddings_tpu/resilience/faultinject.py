@@ -129,6 +129,7 @@ class FaultInjector:
     self._fail_until: Dict[str, Tuple[int, type]] = {}
     self._kill_at: Dict[str, int] = {}
     self._delay: Dict[str, float] = {}
+    self._delay_when: Dict[str, Tuple[float, Dict[str, object]]] = {}
 
   # ---- rule installation -------------------------------------------------
   @staticmethod
@@ -176,6 +177,24 @@ class FaultInjector:
     self._delay[self._check_site(site)] = float(seconds)
     return self
 
+  def delay_when(self, site: str, seconds: float,
+                 **match) -> "FaultInjector":
+    """Sleep ``seconds`` at events at ``site`` whose :func:`fire` info
+    matches every ``match`` key (e.g. ``delay_when("fleet_rpc", 0.05,
+    owner=0)`` slows exactly one replica — the straggler workload the
+    hedging tests need). An event missing a matched key does not match;
+    ``match`` must name at least one key (otherwise use
+    :meth:`delay_each`)."""
+    if seconds < 0:
+      raise ValueError(f"delay must be >= 0, got {seconds}")
+    if not match:
+      raise ValueError("delay_when without match keys would fire on "
+                       "every event — that is delay_each; name at least "
+                       "one info key to match on")
+    self._delay_when[self._check_site(site)] = (float(seconds),
+                                                dict(match))
+    return self
+
   # ---- observation -------------------------------------------------------
   def count(self, site: str) -> int:
     """Events observed at ``site`` so far (including failed ones)."""
@@ -191,6 +210,13 @@ class FaultInjector:
     if delay:
       import time
       time.sleep(delay)
+    cond = self._delay_when.get(site)
+    if cond is not None:
+      seconds, match = cond
+      if seconds and all(k in info and info[k] == v
+                         for k, v in match.items()):
+        import time
+        time.sleep(seconds)
     kill = self._kill_at.get(site)
     if kill is not None and n == kill:
       import os

@@ -36,6 +36,7 @@ import numpy as np
 
 from .. import telemetry as _telemetry
 from ..telemetry import span as _span
+from ..telemetry.flight import flight_trip as _flight_trip
 from . import durable, guards, retry
 
 
@@ -342,8 +343,17 @@ class ResilientTrainer:
       # published past the restored watermark must be re-validated and
       # their rows force-re-stamped (the superset rule) before the next
       # publication. A forked/diverged chain raises ChainDivergedError
-      # with the field named — never a silent re-root.
-      self.stream.attach()
+      # with the field named — never a silent re-root. A divergence here
+      # is the hardest incident this trainer can hit (two writers, or a
+      # wiped pubdir), so it ships a flight bundle before propagating.
+      try:
+        self.stream.attach()
+      except Exception as e:
+        field = getattr(e, "field", None)
+        if field is not None:
+          _flight_trip("chain_diverged", field=field, error=repr(e),
+                       resumed_from=path, step=step)
+        raise
     self.resumed_from = path
     self._last_snapshot = step
     extra = manifest.get("extra", {})
@@ -669,6 +679,15 @@ class ResilientTrainer:
       # exception and resumes gets the full K-consecutive allowance
       # again, not an instant re-abort on the next single bad step
       self._bad.consecutive = 0
+      # the guard trip is exactly the moment the post-mortem needs a
+      # flight bundle: what the run looked like in the steps leading up
+      # to the abort, captured before the supervisor's catch-and-resume
+      # overwrites it (no-op when no recorder is installed)
+      _flight_trip("guard_abort", limit=limit, step=self.step_count,
+                   consumed=self.consumed,
+                   rolled_back_to=resumed,
+                   checkpoint=self.resumed_from if resumed is not None
+                   else None)
       raise TooManyBadSteps(
           f"{limit} consecutive non-finite steps: the run has diverged "
           "(skipping more batches cannot recover it). "

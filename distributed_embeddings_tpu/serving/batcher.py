@@ -320,6 +320,29 @@ class MicroBatcher:
     """The classic counter view (now registry-backed)."""
     return {k: c.value for k, c in self._counters.items()}
 
+  def set_admission(self, queue_rows: Optional[int] = None,
+                    max_delay_s: Optional[float] = None) -> None:
+    """Adjust the admission knobs between flushes — the control plane's
+    actuation hook (:class:`~..control.ControlPolicy` tightens
+    ``queue_rows`` as recent latency approaches a deadline-class
+    budget, so overload sheds at the edge BEFORE the queue melts into
+    p99 blowout). Same locked-swap discipline as
+    :meth:`set_dispatch_fn`: pending requests already admitted stay
+    admitted — a tightened bound applies to arrivals, never
+    retroactively sheds queued work."""
+    with self._lock:
+      if queue_rows is not None:
+        if int(queue_rows) < self.max_batch:
+          raise ValueError(
+              f"queue_rows {queue_rows} < max_batch {self.max_batch}: "
+              "the queue could never admit one full dispatch")
+        self.queue_rows = int(queue_rows)
+      if max_delay_s is not None:
+        if max_delay_s <= 0:
+          raise ValueError(f"max_delay_s must be > 0, got {max_delay_s}")
+        self.max_delay_s = float(max_delay_s)
+      self._nonempty.notify_all()
+
   def set_dispatch_fn(self, dispatch_fn: Callable) -> None:
     """Swap the dispatch binding between flushes (the streaming
     subscriber's rebase hook: re-point the batcher at a freshly loaded

@@ -118,10 +118,22 @@ class DeltaCompactor:
     return manifests
 
   def compact_once(self, through_seq: Optional[int] = None,
-                   gc: bool = True) -> Optional[Dict[str, Any]]:
+                   gc: bool = True,
+                   class_priority: Optional[Dict[str, float]] = None
+                   ) -> Optional[Dict[str, Any]]:
     """Fold the contiguous chain prefix (through ``through_seq``, or
     the whole published tail) into a new base; returns a summary dict,
-    or None when there is nothing to fold."""
+    or None when there is nothing to fold.
+
+    ``class_priority`` orders the per-class fold schedule: higher
+    priority folds FIRST (hot classes reach the new base earliest — a
+    compactor killed mid-fold leaves its freshest work on the classes
+    that matter; the :class:`~..control.CompactorDaemon` feeds the
+    serve hotness ranking here). Ties and unlisted classes fold in
+    name order — the schedule is deterministic either way, and the
+    published result is identical regardless of order (the fold is a
+    per-class scatter; ordering only changes crash-interruption
+    exposure)."""
     base = os.path.join(self.path, BASE_DIR)
     if not os.path.isfile(os.path.join(base, "manifest.json")):
       raise ChainDivergedError(
@@ -164,7 +176,10 @@ class DeltaCompactor:
         checksums[os.path.basename(fpath)] = _crc32_file(fpath)
 
       # --- fold the row images, one class at a time ---
-      for name in sorted(metas):
+      prio = class_priority or {}
+      fold_order = sorted(metas,
+                          key=lambda n: (-float(prio.get(n, 0.0)), n))
+      for name in fold_order:
         m = metas[name]
         faultinject.fire("compact_fold", clazz=name)
         lay = m.packed
@@ -269,7 +284,8 @@ class DeltaCompactor:
     reg.counter("stream/deltas_compacted").inc(k - anchor_seq)
     removed = self.gc_deltas(k) if gc else []
     return {"through_seq": k, "deltas_folded": k - anchor_seq,
-            "chain_root": root, "gc_removed": removed}
+            "chain_root": root, "gc_removed": removed,
+            "fold_order": fold_order}
 
   # ---- garbage collection -------------------------------------------------
   def gc_deltas(self, through_seq: int) -> List[int]:

@@ -46,6 +46,7 @@ from .registry import (
     Gauge,
     Histogram,
     MetricsRegistry,
+    WindowedHistogram,
     counter,
     gauge,
     get_registry,
@@ -90,6 +91,7 @@ __all__ = [
     "MetricsServer",
     "TraceContext",
     "Tracer",
+    "WindowedHistogram",
     "atomic_write_text",
     "attach_device_track",
     "clear_promote",

@@ -44,6 +44,7 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "WindowedHistogram",
     "get_registry",
     "counter",
     "gauge",
@@ -307,6 +308,124 @@ class Histogram:
         # a persisted unbounded (or wider-bound) histogram adopts this
         # configuration's bound on load
         self._collapse_locked()
+
+
+class WindowedHistogram:
+  """Rolling-window view over a :class:`Histogram` stream.
+
+  A cumulative histogram answers "what has the p99 been since the
+  process started" — useless to a control loop, which must react to the
+  LAST few seconds.  This class keeps a ring of ``slots`` sealed
+  sub-histograms plus one open slot: observations land in the open
+  slot, :meth:`rotate` seals it into the ring (evicting the oldest
+  sealed slot once the ring is full), and every read merges the ring
+  plus the open slot into a throwaway cumulative view.  Because
+  :meth:`Histogram.merge` is EXACT (bucket counts add; identical
+  geometry by construction), the windowed percentile carries the same
+  ``rel_err`` bound as a single histogram fed the same recent stream —
+  pinned in tests/test_telemetry.py.
+
+  Rotation is the CALLER's clock: the control tick (or any scheduler)
+  calls :meth:`rotate` at its cadence, so the window span is
+  ``slots x tick`` and — critically for the replayable decision log —
+  the view is a deterministic function of the observation/rotation
+  sequence, with no wall clock hidden inside.  ``maybe_rotate(now)``
+  is the convenience for callers that do hold a clock reading: it
+  rotates when ``rotate_every_s`` has elapsed since the last seal.
+
+  Not a registry kind: windows are control-plane working state, not
+  run-cumulative telemetry, so they never enter ``state_dict`` (a
+  resumed run's "recent" is by definition empty).
+  """
+
+  __slots__ = ("name", "rel_err", "slots", "max_buckets", "_lock",
+               "_open", "_ring", "_rotations", "rotate_every_s",
+               "_last_rotate")
+
+  def __init__(self, name: str = "", slots: int = 6,
+               rel_err: float = 0.01,
+               max_buckets: Optional[int] = None,
+               rotate_every_s: Optional[float] = None):
+    if slots < 1:
+      raise ValueError(f"slots must be >= 1, got {slots}")
+    self.name = name
+    self.rel_err = float(rel_err)
+    self.slots = int(slots)
+    self.max_buckets = max_buckets
+    self._lock = threading.RLock()
+    self._open = self._fresh()
+    self._ring: list = []  # oldest first, at most ``slots`` sealed
+    self._rotations = 0
+    self.rotate_every_s = rotate_every_s
+    self._last_rotate: Optional[float] = None
+
+  def _fresh(self) -> Histogram:
+    return Histogram(self.name, rel_err=self.rel_err, lock=self._lock,
+                     max_buckets=self.max_buckets)
+
+  # ---- recording ----------------------------------------------------------
+  def observe(self, x: float) -> None:
+    self._open.observe(x)
+
+  def rotate(self) -> Histogram:
+    """Seal the open slot into the ring and start a new one; returns
+    the sealed sub-histogram (callers that also feed a lifetime
+    histogram merge it there)."""
+    with self._lock:
+      sealed, self._open = self._open, self._fresh()
+      self._ring.append(sealed)
+      if len(self._ring) > self.slots:
+        del self._ring[:len(self._ring) - self.slots]
+      self._rotations += 1
+      return sealed
+
+  def maybe_rotate(self, now: float) -> bool:
+    """Rotate if ``rotate_every_s`` elapsed since the last seal (the
+    caller supplies the clock reading — this class never reads one)."""
+    if self.rotate_every_s is None:
+      return False
+    with self._lock:
+      if self._last_rotate is None:
+        self._last_rotate = float(now)
+        return False
+      if now - self._last_rotate < self.rotate_every_s:
+        return False
+      self._last_rotate = float(now)
+    self.rotate()
+    return True
+
+  # ---- reads --------------------------------------------------------------
+  def view(self) -> Histogram:
+    """The window as one cumulative histogram: sealed ring + open slot
+    merged into a fresh (caller-owned) Histogram — reads never mutate
+    the window."""
+    out = Histogram(self.name, rel_err=self.rel_err,
+                    max_buckets=self.max_buckets)
+    with self._lock:
+      for h in self._ring:
+        out.merge(h)
+      out.merge(self._open)
+    return out
+
+  def percentile(self, q: float) -> float:
+    return self.view().percentile(q)
+
+  @property
+  def p50(self) -> float:
+    return self.percentile(50.0)
+
+  @property
+  def p99(self) -> float:
+    return self.percentile(99.0)
+
+  @property
+  def count(self) -> int:
+    with self._lock:
+      return self._open.count + sum(h.count for h in self._ring)
+
+  @property
+  def rotations(self) -> int:
+    return self._rotations
 
 
 _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}

@@ -1078,3 +1078,92 @@ def test_fingerprint_drift_detected():
   # vanished artifact and missing baseline both report
   assert diff_fingerprints(base, {}) != []
   assert diff_fingerprints({}, cur) != []
+
+
+# ---------------------------------------------------------------------------
+# GL117: fleet mutation surfaces are control-plane actuations
+# ---------------------------------------------------------------------------
+
+
+def test_gl117_flags_mutation_surfaces_in_library_modules():
+  """A data-path module that can reshard the fleet, edit the replica
+  set, or fold the chain is an accidental operator — mutations route
+  through control/ daemons or operator tools."""
+  src = """
+from distributed_embeddings_tpu.fleet import reshard
+
+def on_pressure(router, fplan):
+  router.apply_fleet(fplan)
+
+def on_idle(compactor):
+  compactor.compact_once()
+"""
+  out = lint_source(src, "distributed_embeddings_tpu/serving/engine.py",
+                    CTX, ["GL117"])
+  assert _rules(out) == ["GL117", "GL117", "GL117"]
+  assert "control" in out[0].message
+
+
+def test_gl117_home_packages_and_control_are_exempt():
+  fleet_src = """
+def set_fleet(self, fplan, transport=None):
+  self.fplan = fplan
+
+def promote(store, fplan):
+  store.set_fleet(fplan)
+"""
+  # the home package keeps its definitions and internal plumbing
+  assert lint_source(fleet_src,
+                     "distributed_embeddings_tpu/fleet/router.py",
+                     CTX, ["GL117"]) == []
+  stream_src = """
+def daemon_tick(compactor, k):
+  return compactor.compact_once(through_seq=k)
+"""
+  assert lint_source(stream_src,
+                     "distributed_embeddings_tpu/streaming/compact.py",
+                     CTX, ["GL117"]) == []
+  # control/ is the sanctioned caller of EVERY surface
+  control_src = """
+def actuate(router, fplan, compactor, k):
+  router.apply_fleet(fplan)
+  compactor.compact_once(through_seq=k)
+  compactor.gc_deltas(k)
+"""
+  assert lint_source(control_src,
+                     "distributed_embeddings_tpu/control/autoscaler.py",
+                     CTX, ["GL117"]) == []
+  # but fleet/ calling the STREAMING surfaces is still a violation —
+  # the exemption is per-surface, not package-wide
+  cross = """
+def tidy(compactor):
+  compactor.compact_once()
+"""
+  out = lint_source(cross, "distributed_embeddings_tpu/fleet/stream.py",
+                    CTX, ["GL117"])
+  assert _rules(out) == ["GL117"]
+
+
+def test_gl117_scope_and_suppression():
+  src = """
+from distributed_embeddings_tpu.fleet import reshard
+
+def main(path, world):
+  reshard(path, world)
+"""
+  # operator tools and tests live outside the library package
+  assert lint_source(src, "tools/fleet_reshard.py", CTX, ["GL117"]) == []
+  assert lint_source(src, "tests/test_fleet.py", CTX, ["GL117"]) == []
+  sup = """
+def drain(router, fplan):
+  router.apply_fleet(fplan)  # graftlint: disable=GL117 (drain hook, reviewed)
+"""
+  assert lint_source(sup, "distributed_embeddings_tpu/serving/engine.py",
+                     CTX, ["GL117"]) == []
+  # unrelated same-shape names stay legal
+  ok = """
+def apply_fleet_discount(prices):
+  return [p * 0.9 for p in prices]
+"""
+  assert lint_source(ok, "distributed_embeddings_tpu/serving/engine.py",
+                     CTX, ["GL117"]) == []

@@ -1,0 +1,448 @@
+"""Control-plane tests (`distributed_embeddings_tpu/control/` + hedging).
+
+The contracts under test:
+
+- **decisions are deterministic and replayable**: every loop's decision
+  is a pure function of its logged ``inputs`` + config — feeding the
+  same snapshot sequence through a fresh loop reproduces the logged
+  actions exactly (``decision_key`` strips the two stamp fields; the
+  rest must match byte-for-byte);
+- **the autoscaler never flaps**: consecutive-streak hysteresis plus a
+  post-action cooldown — a single noisy tick moves nothing, and a
+  scale action is followed by a hold window no matter what the signals
+  do;
+- **the compactor daemon never folds past a live subscriber**: the
+  ``through_seq`` it picks is clamped to the slowest LIVE heartbeat,
+  expired heartbeats drop out of the floor, and the fold only happens
+  when the backlog is worth it;
+- **admission tightens before the SLO breaks and re-admits after**:
+  deadline-class budgets map to ``set_admission`` moves with a
+  hysteresis dead-band, never below the batch size, never above the
+  operator's configured bound;
+- **hedged gathers are bit-exact and exactly-once counted**: a slow
+  replica's request is duplicated, the first answer wins (f32 bitwise
+  vs the single-process engine), ``fleet/hedges{,_won,_wasted}`` count
+  each logical gather once (retries inside an attempt do not
+  double-count), and a rank whose every replica is dead still FAILS
+  the request.
+"""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from distributed_embeddings_tpu.control import (
+    AdmissionConfig,
+    AutoscalerConfig,
+    CompactorConfig,
+    CompactorDaemon,
+    ControlPolicy,
+    ControlSnapshot,
+    CounterRate,
+    DecisionLog,
+    FleetAutoscaler,
+    decision_key,
+    replay_decisions,
+)
+from distributed_embeddings_tpu.telemetry import MetricsRegistry
+
+
+# ---------------------------------------------------------------------------
+# DecisionLog: durable, replayable, counted
+# ---------------------------------------------------------------------------
+
+
+def test_decision_log_roundtrip_and_counters(tmp_path):
+  reg = MetricsRegistry()
+  path = os.path.join(str(tmp_path), "decisions.jsonl")
+  with DecisionLog(path, telemetry=reg) as log:
+    r1 = log.record("autoscaler", 1, "hold", "in_band",
+                    inputs={"qps": 10.0}, target_replicas=2)
+    r2 = log.record("compactor", 1, "fold", "backlog",
+                    inputs={"run_end": 7}, through_seq=5)
+  assert r1["log_seq"] == 0 and r2["log_seq"] == 1
+  assert reg.counter("control/decisions").value == 2
+  assert reg.counter("control/decisions/autoscaler").value == 1
+  assert reg.counter("control/decisions/compactor").value == 1
+  back = replay_decisions(path)
+  assert [decision_key(r) for r in back] == [decision_key(r1),
+                                             decision_key(r2)]
+  # every line is self-contained JSON (the fsync-per-line contract)
+  with open(path) as f:
+    for line in f:
+      json.loads(line)
+
+
+def test_decision_key_strips_only_the_stamps():
+  rec = {"source": "x", "tick": 1, "action": "hold", "reason": "r",
+         "inputs": {"a": 1}, "wall": 123.4, "log_seq": 9, "extra": "kept"}
+  key = decision_key(rec)
+  assert "wall" not in key and "log_seq" not in key
+  assert key["extra"] == "kept" and key["inputs"] == {"a": 1}
+
+
+# ---------------------------------------------------------------------------
+# signals: rates and snapshots
+# ---------------------------------------------------------------------------
+
+
+def test_counter_rate_samples():
+  r = CounterRate()
+  assert r.sample(100, 1.0) == 0.0  # first sample: no interval yet
+  assert r.sample(150, 2.0) == pytest.approx(50.0)
+  assert r.sample(150, 2.0) == 0.0  # non-advancing clock: no rate
+  assert r.sample(140, 3.0) == 0.0  # counter reset: clamped, not negative
+  assert r.sample(200, 4.0) == pytest.approx(60.0)
+
+
+def test_snapshot_inputs_are_json_safe():
+  snap = ControlSnapshot(tick=3, qps=12.5, replicas=2)
+  inputs = snap.to_inputs()
+  assert inputs["p99_s"] is None and inputs["p999_s"] is None  # NaN -> None
+  assert inputs["tick"] == 3 and inputs["qps"] == 12.5
+  json.dumps(inputs)  # the record must be JSON-serializable
+
+
+# ---------------------------------------------------------------------------
+# autoscaler: hysteresis, cooldown, determinism
+# ---------------------------------------------------------------------------
+
+ASCFG = AutoscalerConfig(qps_high_per_replica=100.0,
+                         qps_low_per_replica=30.0,
+                         min_replicas=1, max_replicas=3,
+                         up_after=2, down_after=3, cooldown_ticks=2)
+
+
+def _snaps(qps_seq, replicas_seq=None):
+  out = []
+  r = 1
+  for i, q in enumerate(qps_seq):
+    if replicas_seq is not None:
+      r = replicas_seq[i]
+    out.append(ControlSnapshot(tick=i + 1, qps=q, replicas=r))
+  return out
+
+
+def test_autoscaler_requires_consecutive_breaches():
+  a = FleetAutoscaler(ASCFG)
+  # one high tick, one in-band, one high: never two CONSECUTIVE -> hold
+  acts = [a.decide(s)["action"]
+          for s in _snaps([150.0, 50.0, 150.0, 50.0])]
+  assert acts == ["hold"] * 4
+
+
+def test_autoscaler_scales_up_then_cools_down():
+  actuations = []
+  a = FleetAutoscaler(ASCFG, actuate=lambda t, rec: actuations.append(t))
+  recs = [a.tick(s) for s in _snaps(
+      [150.0, 150.0, 150.0, 150.0, 150.0], [1, 1, 2, 2, 2])]
+  acts = [(r["action"], r["reason"]) for r in recs]
+  # up after 2 consecutive highs, then cooldown_ticks=2 holds even
+  # though qps/replica (75) is back in band — then in_band
+  assert acts[0] == ("hold", "in_band")
+  assert acts[1] == ("scale_up", "qps_high")
+  assert acts[2] == ("hold", "cooldown")
+  assert acts[3] == ("hold", "cooldown")
+  assert acts[4] == ("hold", "in_band")
+  assert actuations == [2]
+
+
+def test_autoscaler_scale_down_is_slower_and_bounded():
+  a = FleetAutoscaler(ASCFG)
+  # 3 consecutive lows at 2 replicas -> down; at min it refuses by name
+  recs = [a.decide(s) for s in _snaps(
+      [10.0, 10.0, 10.0, 10.0, 10.0, 10.0, 10.0, 10.0],
+      [2, 2, 2, 1, 1, 1, 1, 1])]
+  acts = [(r["action"], r["reason"]) for r in recs]
+  assert acts[2] == ("scale_down", "qps_low")
+  assert acts[3] == ("hold", "cooldown")
+  assert acts[4] == ("hold", "cooldown")
+  # streak kept advancing through cooldown: first eligible tick decides
+  assert acts[5] == ("hold", "at_min_replicas")
+  assert recs[2]["target_replicas"] == 1
+
+
+def test_autoscaler_staleness_triggers_and_names_itself():
+  cfg = AutoscalerConfig(qps_high_per_replica=100.0,
+                         qps_low_per_replica=30.0,
+                         staleness_high_s=5.0, up_after=1,
+                         cooldown_ticks=0, max_replicas=3)
+  a = FleetAutoscaler(cfg)
+  rec = a.decide(ControlSnapshot(tick=1, qps=50.0, replicas=1,
+                                 staleness_s=30.0))
+  assert (rec["action"], rec["reason"]) == ("scale_up", "staleness_high")
+  # a stale fleet never scales DOWN, however low the qps
+  a2 = FleetAutoscaler(dataclasses_replace(cfg, down_after=1))
+  rec = a2.decide(ControlSnapshot(tick=1, qps=0.0, replicas=3,
+                                  staleness_s=30.0))
+  assert rec["action"] != "scale_down"
+
+
+def dataclasses_replace(cfg, **kw):
+  import dataclasses
+  return dataclasses.replace(cfg, **kw)
+
+
+def test_autoscaler_at_max_holds_by_name():
+  cfg = dataclasses_replace(ASCFG, up_after=1, cooldown_ticks=0)
+  a = FleetAutoscaler(cfg)
+  rec = a.decide(ControlSnapshot(tick=1, qps=900.0, replicas=3))
+  assert (rec["action"], rec["reason"]) == ("hold", "at_max_replicas")
+
+
+def test_autoscaler_config_refusals():
+  with pytest.raises(ValueError, match="inverted band"):
+    AutoscalerConfig(qps_high_per_replica=10.0, qps_low_per_replica=20.0)
+  with pytest.raises(ValueError, match="min_replicas"):
+    AutoscalerConfig(qps_high_per_replica=10.0, qps_low_per_replica=1.0,
+                     min_replicas=5, max_replicas=2)
+  with pytest.raises(ValueError, match="up_after"):
+    AutoscalerConfig(qps_high_per_replica=10.0, qps_low_per_replica=1.0,
+                     up_after=0)
+
+
+def test_autoscaler_decisions_replay_deterministically(tmp_path):
+  """The pinned replay contract: the same snapshots through a fresh
+  loop reproduce the logged decisions exactly (minus the stamps)."""
+  snaps = _snaps([150.0, 150.0, 150.0, 10.0, 10.0, 10.0, 10.0, 10.0],
+                 [1, 1, 2, 2, 2, 2, 2, 2])
+  path = os.path.join(str(tmp_path), "d.jsonl")
+  with DecisionLog(path, telemetry=MetricsRegistry()) as log:
+    a = FleetAutoscaler(ASCFG, decisions=log)
+    for s in snaps:
+      a.decide(s)
+  logged = [decision_key(r) for r in replay_decisions(path)]
+  fresh = FleetAutoscaler(ASCFG, decisions=DecisionLog(
+      telemetry=MetricsRegistry()))
+  replayed = [decision_key(fresh.decide(s)) for s in snaps]
+  assert replayed == logged
+
+
+def test_autoscaler_actuate_failure_is_logged_and_raised():
+  log = DecisionLog(telemetry=MetricsRegistry())
+
+  def boom(target, rec):
+    raise RuntimeError("transport down")
+
+  a = FleetAutoscaler(dataclasses_replace(ASCFG, up_after=1),
+                      actuate=boom, decisions=log)
+  with pytest.raises(RuntimeError, match="transport down"):
+    a.tick(ControlSnapshot(tick=1, qps=900.0, replicas=1))
+  acts = [r["action"] for r in log.records]
+  assert acts == ["scale_up", "actuate_failed"]
+
+
+# ---------------------------------------------------------------------------
+# compactor daemon: lag-aware through_seq, worth-it threshold
+# ---------------------------------------------------------------------------
+
+CDCFG = CompactorConfig(min_deltas=3, heartbeat_ttl_s=30.0)
+
+
+def _daemon(tmp_path, **kw):
+  return CompactorDaemon(os.path.join(str(tmp_path), "pub"),
+                         config=kw.pop("config", CDCFG),
+                         decisions=DecisionLog(telemetry=MetricsRegistry()),
+                         telemetry=MetricsRegistry(), **kw)
+
+
+def test_compactor_decide_clamps_to_live_floor(tmp_path):
+  d = _daemon(tmp_path)
+  # backlog of 6 but the slowest live subscriber sits at seq 4
+  rec = d.decide({"anchor_seq": 0, "run_end": 6, "live_floor": 4,
+                  "live_subscribers": 2, "expired_subscribers": 0}, 1)
+  assert rec["action"] == "fold" and rec["through_seq"] == 4
+  # the laggard pins the chain: floor below the worth-it threshold
+  rec = d.decide({"anchor_seq": 0, "run_end": 6, "live_floor": 1,
+                  "live_subscribers": 1, "expired_subscribers": 0}, 2)
+  assert (rec["action"], rec["reason"]) == ("hold", "subscriber_lag")
+  # no live subscriber at all: the full backlog folds
+  rec = d.decide({"anchor_seq": 0, "run_end": 6, "live_floor": None,
+                  "live_subscribers": 0, "expired_subscribers": 1}, 3)
+  assert rec["action"] == "fold" and rec["through_seq"] == 6
+  # thin backlog: not worth a full-image rewrite
+  rec = d.decide({"anchor_seq": 4, "run_end": 6, "live_floor": None,
+                  "live_subscribers": 0, "expired_subscribers": 0}, 4)
+  assert (rec["action"], rec["reason"]) == ("hold", "backlog_below_min")
+  # no base yet: nothing to fold onto
+  rec = d.decide({"anchor_seq": None, "run_end": None, "live_floor": None,
+                  "live_subscribers": 0, "expired_subscribers": 0}, 5)
+  assert (rec["action"], rec["reason"]) == ("hold", "no_base")
+
+
+def test_compactor_fold_priority_is_deterministic(tmp_path):
+  d = _daemon(tmp_path, class_priority={"cold": 0.5, "hot": 3.0,
+                                        "warm": 1.0, "also_warm": 1.0})
+  rec = d.decide({"anchor_seq": 0, "run_end": 5, "live_floor": None,
+                  "live_subscribers": 0, "expired_subscribers": 0}, 1)
+  # hot first; ties broken by name — the order is a pure function
+  assert rec["fold_priority"] == ["hot", "also_warm", "warm", "cold"]
+
+
+def test_compactor_observe_on_empty_dir(tmp_path):
+  d = _daemon(tmp_path)
+  state = d.observe()
+  assert state["anchor_seq"] is None
+  rec = d.tick()
+  assert (rec["action"], rec["reason"]) == ("hold", "no_base")
+
+
+def test_compactor_config_refusal():
+  with pytest.raises(ValueError, match="min_deltas"):
+    CompactorConfig(min_deltas=0)
+
+
+@pytest.mark.slow
+def test_compactor_daemon_folds_real_chain(tmp_path):
+  """Integration: observe/decide/actuate over an actual delta chain —
+  the fold respects a live heartbeat and the result matches what the
+  manual compactor reports."""
+  from test_streaming import _chain_of
+  from distributed_embeddings_tpu.streaming import (
+      published_delta_seqs,
+      write_heartbeat,
+  )
+  plan, rule, mesh, state, publisher, sub, rng, b = _chain_of(
+      tmp_path, 4)
+  write_heartbeat(sub.path, "live_sub", 3)
+  d = CompactorDaemon(sub.path, config=CompactorConfig(min_deltas=2),
+                      decisions=DecisionLog(telemetry=MetricsRegistry()),
+                      telemetry=MetricsRegistry())
+  st = d.observe()
+  assert st["run_end"] == 4 and st["live_floor"] == 3
+  rec = d.tick()
+  assert rec["action"] == "fold" and rec["through_seq"] == 3
+  assert rec["result"]["through_seq"] == 3
+  # GC keeps only what the live subscriber still needs (it has applied
+  # through 3, so only the un-folded tail survives)
+  assert published_delta_seqs(sub.path) == [4]
+  # the very next tick holds: backlog is now thin
+  rec = d.tick()
+  assert rec["action"] == "hold"
+
+
+# ---------------------------------------------------------------------------
+# admission: budgets -> shed thresholds
+# ---------------------------------------------------------------------------
+
+
+class _FakeBatcher:
+  """The admission surface only: queue_rows/max_batch + set_admission
+  (the real MicroBatcher's refusal semantics included)."""
+
+  def __init__(self, max_batch=8, queue_rows=64):
+    self.max_batch = max_batch
+    self.queue_rows = queue_rows
+    self.calls = []
+
+  def set_admission(self, queue_rows=None, max_delay_s=None):
+    if queue_rows is not None:
+      if queue_rows < self.max_batch:
+        raise ValueError("queue_rows below max_batch")
+      self.queue_rows = int(queue_rows)
+      self.calls.append(int(queue_rows))
+
+
+def _policy(batcher=None, budgets=None, **cfg_kw):
+  b = batcher if batcher is not None else _FakeBatcher()
+  cfg = AdmissionConfig(**cfg_kw) if cfg_kw else AdmissionConfig()
+  return ControlPolicy(b, budgets if budgets is not None
+                       else {"realtime": 0.010}, config=cfg,
+                       decisions=DecisionLog(telemetry=MetricsRegistry())), b
+
+
+def test_admission_tightens_under_breach_and_relaxes_after():
+  pol, b = _policy()
+  # sustained p99 of 50ms against a 10ms budget: tighten
+  for _ in range(30):
+    pol.observe_latency(0.050)
+  rec = pol.tick()
+  assert rec["action"] == "tighten" and b.queue_rows < 64
+  tightened = b.queue_rows
+  # recovery: fast requests dominate a fresh window -> relax back up
+  for _ in range(8):
+    pol._window.rotate()  # age the breach out of the recent window
+  for _ in range(30):
+    pol.observe_latency(0.001)
+  rec = pol.tick()
+  assert rec["action"] == "relax" and b.queue_rows > tightened
+  # relax never exceeds the operator's configured bound
+  for _ in range(20):
+    for _ in range(30):
+      pol.observe_latency(0.001)
+    pol.tick()
+  assert b.queue_rows == 64
+  last = pol.decisions.records[-1]
+  assert (last["action"], last["reason"]) == ("hold", "at_baseline")
+
+
+def test_admission_floor_is_the_batch_size():
+  pol, b = _policy(batcher=_FakeBatcher(max_batch=8, queue_rows=16))
+  for tick in range(10):
+    for _ in range(30):
+      pol.observe_latency(0.050)
+    pol.tick()
+  assert b.queue_rows == 8  # never below max_batch, however bad the p99
+  last = pol.decisions.records[-1]
+  assert (last["action"], last["reason"]) == ("hold", "at_floor")
+
+
+def test_admission_effective_budget_is_the_tightest_class():
+  pol, _ = _policy(budgets={"bulk": 0.5, "realtime": 0.010})
+  assert pol.effective_budget_s == 0.010
+
+
+def test_admission_holds_without_signal():
+  pol, b = _policy()
+  rec = pol.tick()  # no observations at all
+  assert (rec["action"], rec["reason"]) == ("hold", "insufficient_samples")
+  pol2, b2 = _policy(budgets={})
+  for _ in range(30):
+    pol2.observe_latency(0.050)
+  rec = pol2.tick()  # no budgets: a declared no-op, never a surprise
+  assert (rec["action"], rec["reason"]) == ("hold", "no_budgets")
+  assert b2.calls == []
+
+
+def test_admission_in_band_dead_zone_does_not_flap():
+  pol, b = _policy()
+  # p99 ~ 8ms against a 10ms budget: inside [relax*b, slack*b) -> hold
+  for _ in range(30):
+    pol.observe_latency(0.008)
+  rec = pol.tick()
+  assert (rec["action"], rec["reason"]) == ("hold", "in_band")
+  assert b.calls == []
+
+
+def test_admission_config_refusals():
+  with pytest.raises(ValueError, match="dead-band"):
+    AdmissionConfig(slack=0.5, relax=0.9)
+  with pytest.raises(ValueError, match="step"):
+    AdmissionConfig(step=1.5)
+  with pytest.raises(ValueError, match="budget"):
+    ControlPolicy(_FakeBatcher(), {"rt": -1.0})
+
+
+def test_admission_decisions_replay(tmp_path):
+  path = os.path.join(str(tmp_path), "adm.jsonl")
+  seq = [(0.050, 30), (0.050, 30), (0.001, 30), (0.008, 30)]
+  with DecisionLog(path, telemetry=MetricsRegistry()) as log:
+    pol = ControlPolicy(_FakeBatcher(), {"rt": 0.010},
+                        decisions=log)
+    for i, (p99, n) in enumerate(seq):
+      pol.decide(p99, n, i + 1, pol.batcher.queue_rows)
+      if pol.decisions.records[-1]["action"] in ("tighten", "relax"):
+        pol.batcher.queue_rows = pol.decisions.records[-1]["target_rows"]
+  logged = [decision_key(r) for r in replay_decisions(path)]
+  fresh = ControlPolicy(_FakeBatcher(), {"rt": 0.010},
+                        decisions=DecisionLog(telemetry=MetricsRegistry()))
+  replayed = []
+  for i, (p99, n) in enumerate(seq):
+    rec = fresh.decide(p99, n, i + 1, fresh.batcher.queue_rows)
+    if rec["action"] in ("tighten", "relax"):
+      fresh.batcher.queue_rows = rec["target_rows"]
+    replayed.append(decision_key(rec))
+  assert replayed == logged

@@ -740,3 +740,146 @@ def test_follower_stop_leaves_healthz_quorum(tmp_path):
   # read as a stalled subscriber forever
   assert reg.peek("stream/served_step") is None
   assert reg.peek("stream/last_promote_unixtime") is None
+
+
+# ---------------------------------------------------------------------------
+# hedged gathers: first answer wins, bit-exact, exactly-once counted
+# ---------------------------------------------------------------------------
+
+
+def _hedged_fleet(tmp_path, plan, rule, mesh, state, rng, world,
+                  **cfg_over):
+  """Fully replicated 2-owner fleet with hedging armed low enough that
+  an injected slow replica always trips it."""
+  from distributed_embeddings_tpu.telemetry import MetricsRegistry
+  path = _export(tmp_path, plan, rule, state, "f32")
+  fplan = FleetPlan.replicated(world, 2, replicas=2, hot_fraction=1.0)
+  cfg_kw = dict(cache_fraction=0.1, staging_grps=64,
+                shard_min_phys_rows=16, revive_after_s=3600.0,
+                hedge_quantile=0.5, hedge_min_s=0.005,
+                hedge_min_samples=5)
+  cfg_kw.update(cfg_over)
+  cfg = FleetConfig(**cfg_kw)
+  owners, transport, router = _fleet(path, plan, fplan, mesh, config=cfg,
+                                     telemetry=MetricsRegistry())
+  return path, owners, transport, router
+
+
+def _settle(counter, want, deadline_s=5.0):
+  """Wait for a counter racing against a late loser thread to settle."""
+  import time
+  t0 = time.time()
+  while counter.value < want and time.time() - t0 < deadline_s:
+    time.sleep(0.005)
+  return counter.value
+
+
+def test_hedged_gather_first_answer_wins_bit_exact(tmp_path):
+  world = 2
+  plan, rule, mesh, state, rng = _fixture(world)
+  path, owners, transport, router = _hedged_fleet(
+      tmp_path, plan, rule, mesh, state, rng, world)
+  art = serve_load(path, plan, mesh=mesh)
+  single = ServeEngine(ActsModel(), plan, art, mesh=mesh)
+  numerical, ids = _mkbatch(rng, 4 * world)
+  want = single.predict(numerical, ids)
+  for _ in range(8):  # warm the per-owner recent-latency windows
+    np.testing.assert_array_equal(want, router.predict(numerical, ids))
+  c = router.store._counters
+  assert c["hedges"].value == 0  # a healthy fleet never hedges
+  # one replica turns slow: ONLY requests whose primary is owner 0
+  # stall past the recent quantile and duplicate to the other replica
+  inj = faultinject.FaultInjector()
+  inj.delay_when("fleet_rpc", 0.25, owner=0)
+  with faultinject.injected(inj):
+    got = router.predict(numerical, ids)
+  np.testing.assert_array_equal(want, got)  # same f32 bytes, hedged
+  assert c["hedges"].value >= 1
+  assert c["hedges_won"].value >= 1
+  assert c["failovers"].value == 0  # slow is not dead: nobody abandoned
+  # the slow loser finishes eventually: counted wasted EXACTLY once per
+  # hedge that raced to completion, never more
+  wasted = _settle(c["hedges_wasted"], c["hedges"].value)
+  assert wasted <= c["hedges"].value
+  router.close()
+
+
+def test_hedged_gather_exactly_once_accounting(tmp_path):
+  """Pin the counters on ONE hedged gather: a retried attempt inside
+  the race does not double-count the hedge, and the loser's eventual
+  completion is one wasted increment."""
+  from distributed_embeddings_tpu.resilience import retry as _retry
+  world = 2
+  plan, rule, mesh, state, rng = _fixture(world)
+  path, owners, transport, router = _hedged_fleet(
+      tmp_path, plan, rule, mesh, state, rng, world)
+  store = router.store
+  store.retry_policy = _retry.RetryPolicy(retries=3, backoff=0.0)
+  name = next(n for n in sorted(store.meta)
+              if n not in router.replicated_names)
+  rank = 0
+  order = store._replica_order(store.fplan.owners_of(rank))
+  grps = np.arange(4, dtype=np.int64)
+  c = store._counters
+  inj = faultinject.FaultInjector()
+  # primary: one transient fault (absorbed by retry), THEN slow — the
+  # hedge must fire once for the logical gather, not once per attempt
+  inj.fail_first("fleet_rpc", 1)
+  inj.delay_when("fleet_rpc", 0.25, owner=order[0])
+  with faultinject.injected(inj):
+    out = store._gather_call(rank, name=name, rank=rank, grps=grps)
+  direct = owners[order[1]].rpc_gather(name, rank, grps)
+  np.testing.assert_array_equal(np.asarray(out["rows"]),
+                                np.asarray(direct["rows"]))
+  assert c["hedges"].value == 1
+  assert c["hedges_won"].value == 1
+  # the slow primary completes after losing: exactly one wasted, even
+  # given time to double-count
+  assert _settle(c["hedges_wasted"], 1) == 1
+  import time
+  time.sleep(0.1)
+  assert c["hedges_wasted"].value == 1
+  # the transient WAS retried inside the losing attempt — and the
+  # retried attempt did not re-count the hedge
+  assert c["rpc_retries"].value >= 1
+  assert c["hedges"].value == 1
+  assert c["failovers"].value == 0
+  router.close()
+
+
+def test_hedged_gather_every_replica_dead_fails(tmp_path):
+  world = 2
+  plan, rule, mesh, state, rng = _fixture(world)
+  path, owners, transport, router = _hedged_fleet(
+      tmp_path, plan, rule, mesh, state, rng, world)
+  numerical, ids = _mkbatch(rng, 4 * world)
+  want = router.predict(numerical, ids)
+  transport.kill(0)
+  # one dead replica: the race degrades to counted failover, answers
+  # stay bit-exact
+  np.testing.assert_array_equal(want, router.predict(numerical, ids))
+  transport.kill(1)
+  with pytest.raises(OwnerUnavailableError, match="every replica"):
+    router.predict(numerical, ids)
+  assert router.store._counters["dead_rank_errors"].value >= 1
+  router.close()
+
+
+def test_hedging_disabled_is_a_true_noop(tmp_path):
+  """hedge_quantile=None (the default): no hedge counters move, no
+  latency windows exist — the pre-control router, byte for byte."""
+  world = 2
+  plan, rule, mesh, state, rng = _fixture(world)
+  path, owners, transport, router = _hedged_fleet(
+      tmp_path, plan, rule, mesh, state, rng, world, hedge_quantile=None)
+  numerical, ids = _mkbatch(rng, 4 * world)
+  inj = faultinject.FaultInjector()
+  inj.delay_when("fleet_rpc", 0.05, owner=0)
+  with faultinject.injected(inj):
+    router.predict(numerical, ids)  # slow replica, nobody hedges
+  c = router.store._counters
+  assert c["hedges"].value == 0
+  assert c["hedges_won"].value == 0
+  assert c["hedges_wasted"].value == 0
+  assert router.store._gather_window == {}  # not even allocated
+  router.close()

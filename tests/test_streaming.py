@@ -26,6 +26,7 @@ The contracts under test:
 
 import os
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -672,6 +673,13 @@ def test_promote_under_concurrent_batcher_traffic(tmp_path):
       publisher.observe_batch(batch[1])
       state, _ = step(state, *shard_batch(batch, mesh))
       publisher.publish_delta(state)
+    # let the subscriber catch the last delta WHILE the clients still
+    # hammer it — stopping right at the final publish races the poll
+    # loop (the fold itself is what's under test, not the shutdown
+    # timing)
+    deadline = time.monotonic() + 30.0
+    while sub.applied_seq < publisher.seq and time.monotonic() < deadline:
+      time.sleep(0.02)
   finally:
     stop.set()
     for t in threads:

@@ -43,6 +43,7 @@ from distributed_embeddings_tpu.telemetry import (
     Histogram,
     JsonlWriter,
     MetricsRegistry,
+    WindowedHistogram,
     emit_verdict,
     prometheus_text,
     span,
@@ -123,6 +124,64 @@ def test_histogram_state_roundtrip_through_json():
     assert h2.percentile(q) == h.percentile(q)
   with pytest.raises(ValueError, match="rel_err"):
     Histogram("t", rel_err=0.05).load(st)
+
+
+def test_windowed_histogram_rotation_expires_old_samples():
+  w = WindowedHistogram("t", slots=3, rel_err=0.01)
+  for _ in range(100):
+    w.observe(10.0)  # an old latency regime
+  w.rotate()
+  assert w.rotations == 1
+  for _ in range(100):
+    w.observe(0.001)  # the new regime
+  # both regimes visible while the old slot is in the ring
+  assert w.count == 200
+  assert w.percentile(99) > 1.0
+  # rotate the old regime past the ring depth: the view forgets it —
+  # the new regime's slot is still inside the window
+  for _ in range(3):
+    w.rotate()
+  assert w.count == 100
+  assert w.percentile(99) < 1.0  # the 10.0 regime is GONE from p99
+  assert abs(w.percentile(99) - 0.001) <= 0.001 * 0.03
+  # and once the new regime ages past the ring too, the window is empty
+  w.rotate()
+  assert w.count == 0
+
+
+def test_windowed_histogram_view_merge_is_exact():
+  """The window's view is EXACTLY the merge of its live sub-histograms:
+  same counts, same percentile estimates as one histogram fed the same
+  stream (merge exactness is the DDSketch bucket-union property)."""
+  rng = np.random.default_rng(7)
+  w = WindowedHistogram("t", slots=4, rel_err=0.01)
+  ref = Histogram("t", rel_err=0.01)
+  for chunk in range(4):
+    xs = rng.lognormal(0, 2, 300)
+    for x in xs:
+      w.observe(x)
+      ref.observe(x)
+    if chunk < 3:
+      w.rotate()
+  # nothing aged out (3 rotations < 4 slots): the union must be exact
+  view = w.view()
+  assert view.count == ref.count
+  for q in (50, 90, 99, 99.9):
+    assert view.percentile(q) == ref.percentile(q)
+  # the view is caller-owned: observing more does not mutate it
+  w.observe(1e9)
+  assert view.count == ref.count
+
+
+def test_windowed_histogram_clocked_rotation_and_refusals():
+  w = WindowedHistogram("t", slots=2, rotate_every_s=1.0)
+  assert not w.maybe_rotate(100.0)  # first call stamps, never rotates
+  w.observe(5.0)
+  assert not w.maybe_rotate(100.5)  # not due yet
+  assert w.maybe_rotate(101.1)  # due: the open slot seals
+  assert w.rotations == 1
+  with pytest.raises(ValueError, match="slots"):
+    WindowedHistogram("t", slots=0)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,379 @@
+"""Control-plane budget: hedged tail latency and self-scaling under load.
+
+The closed-loop control-plane exerciser (``make control-bench``). Two
+measurements on the 8-way CPU mesh:
+
+1. **Hedging tightens the tail**: a fully replicated fleet serves an
+   open-loop Poisson load while ONE replica is made slow (a
+   ``fleet_rpc`` delay rule matched to that owner). The same load runs
+   with hedging disabled and enabled. Acceptance: ZERO wrong answers in
+   both modes (every completed request bitwise-matches the
+   single-process engine — a hedge returns the same f32 bytes or
+   nothing), at least one hedge fired, and the hedged p99.9 is
+   measurably below the unhedged p99.9 (the recorded budget lives in
+   docs/BENCHMARKS.md).
+
+2. **Self-scaling under a 3x QPS step**: the fleet starts at one owner
+   per rank with a :class:`FleetAutoscaler` ticking on a background
+   thread (QPS sampled from the batcher's ``serve/submitted`` counter
+   through :class:`CounterRate`). The offered load steps to ~3x the
+   initial rate mid-run. Acceptance: the autoscaler issues a
+   ``scale_up`` actuated through ``apply_fleet`` (owner spawn + replica
+   promotion) WHILE requests are in flight, with zero wrong answers and
+   zero dropped requests (every submitted request either completes
+   bit-exactly or was shed as a counted rejection), finite p99.9, and
+   every decision recorded in the replayable ``control/decisions``
+   stream. The phase latencies also drive one :class:`ControlPolicy`
+   tick against a deadline-class budget, so the SLO-admission wiring is
+   exercised end to end.
+
+``--smoke`` runs a tiny-world tier wired into ``make verify`` (same
+assertions, ~half the requests). Verdict via ``telemetry.emit_verdict``
+either way.
+
+Usage: PYTHONPATH=/root/repo python tools/profile_control.py [--smoke]
+"""
+
+import argparse
+import os
+import shutil
+import tempfile
+import threading
+import time
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+  os.environ["XLA_FLAGS"] = (
+      flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402,F401  (device platform must initialize first)
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from distributed_embeddings_tpu import telemetry  # noqa: E402
+from distributed_embeddings_tpu.control import (  # noqa: E402
+    AutoscalerConfig,
+    ControlPolicy,
+    ControlSnapshot,
+    CounterRate,
+    DecisionLog,
+    FleetAutoscaler,
+)
+from distributed_embeddings_tpu.fleet import (  # noqa: E402
+    FleetConfig,
+    FleetOwner,
+    FleetPlan,
+    FleetRouter,
+    InProcTransport,
+)
+from distributed_embeddings_tpu.layers.dist_model_parallel import (  # noqa: E402
+    set_weights,
+)
+from distributed_embeddings_tpu.layers.embedding import TableConfig  # noqa: E402
+from distributed_embeddings_tpu.layers.planner import (  # noqa: E402
+    DistEmbeddingStrategy,
+)
+from distributed_embeddings_tpu.ops.packed_table import sparse_rule  # noqa: E402
+from distributed_embeddings_tpu.parallel import create_mesh  # noqa: E402
+from distributed_embeddings_tpu.parallel.lookup_engine import PAD_ID  # noqa: E402
+from distributed_embeddings_tpu.resilience import faultinject  # noqa: E402
+from distributed_embeddings_tpu.serving import (  # noqa: E402
+    MicroBatcher,
+    Rejected,
+    ServeEngine,
+)
+from distributed_embeddings_tpu.serving.export import (  # noqa: E402
+    export as serve_export,
+)
+from distributed_embeddings_tpu.serving.export import load as serve_load  # noqa: E402
+from distributed_embeddings_tpu.training import (  # noqa: E402
+    init_sparse_state,
+    shard_params,
+)
+
+
+class ActsModel:
+  def apply(self, variables, numerical, cats, emb_acts=None):
+    del variables, numerical, cats
+    return jnp.concatenate(list(emb_acts), axis=-1)
+
+
+BENCH = dict(world=4, sizes=[65536, 16384, 4096], widths=[16, 16, 16],
+             hotness=[4, 2, 1], req_rows=4, max_batch=64,
+             n_hedge=240, n_ramp=240, slow_s=0.05, hedge_qps=10.0)
+SMOKE = dict(world=2, sizes=[1536, 768], widths=[16, 16],
+             hotness=[2, 1], req_rows=4, max_batch=32,
+             n_hedge=100, n_ramp=120, slow_s=0.04, hedge_qps=12.0)
+
+HEDGE_KW = dict(hedge_quantile=0.5, hedge_min_s=0.005,
+                hedge_min_samples=10)
+
+
+def build(cfg):
+  rng = np.random.default_rng(7)
+  tables = [TableConfig(s, w, combiner="sum")
+            for s, w in zip(cfg["sizes"], cfg["widths"])]
+  plan = DistEmbeddingStrategy(tables, cfg["world"], "memory_balanced",
+                               dense_row_threshold=0,
+                               input_hotness=cfg["hotness"])
+  weights = [(rng.standard_normal((s, w)) / np.sqrt(w)).astype(np.float32)
+             for s, w in zip(cfg["sizes"], cfg["widths"])]
+  params = {"embeddings": {k: jnp.asarray(v)
+                           for k, v in set_weights(plan, weights).items()}}
+  rule = sparse_rule("adagrad", 0.05)
+  mesh = create_mesh(cfg["world"])
+  state = shard_params(init_sparse_state(plan, params, rule,
+                                         optax.sgd(0.01)), mesh)
+  return plan, rule, mesh, state, rng
+
+
+def mkreq(rng, cfg, n):
+  ids = []
+  for s, h in zip(cfg["sizes"], cfg["hotness"]):
+    x = rng.integers(0, s, (n, h)).astype(np.int32)
+    x[rng.random(x.shape) < 0.2] = PAD_ID
+    ids.append(x)
+  return rng.standard_normal((n, 4)).astype(np.float32), ids
+
+
+def build_fleet(path, plan, mesh, fplan, **fleet_kw):
+  owners = {o: FleetOwner(path, plan, fplan.owned_ranks(o), owner_id=o)
+            for o in range(fplan.n_owners)}
+  transport = InProcTransport(owners)
+  reg = telemetry.MetricsRegistry()
+  router = FleetRouter(ActsModel(), plan, path, fplan, transport,
+                       mesh=mesh, telemetry=reg, **fleet_kw)
+  return owners, transport, router, reg
+
+
+def pcts(lats):
+  if not lats:
+    return float("nan"), float("nan"), float("nan")
+  a = np.sort(np.asarray(lats))
+  pick = lambda q: float(a[min(len(a) - 1, int(q * len(a)))])  # noqa: E731
+  return pick(0.50), pick(0.99), pick(0.999)
+
+
+def open_loop(mb, reqs, qps, n_requests, rng):
+  futs, rejected = [], 0
+  t = time.perf_counter()
+  for i in range(n_requests):
+    t += float(rng.exponential(1.0 / qps))
+    now = time.perf_counter()
+    if t > now:
+      time.sleep(t - now)
+    numerical, ids = reqs[i % len(reqs)]
+    try:
+      futs.append((i % len(reqs), mb.submit(numerical, ids)))
+    except Rejected:
+      rejected += 1
+  out, lats = [], []
+  for ri, f in futs:
+    out.append((ri, f.result(timeout=300)))
+    lats.append(f.latency_s)
+  return lats, rejected, out
+
+
+# ---------------------------------------------------------------------------
+# measurements
+# ---------------------------------------------------------------------------
+
+
+def check_hedging_tightens_tail(cfg, tmp, result):
+  """One slow replica, same Poisson load, hedging off vs on: zero
+  wrong answers both ways, and the hedged p99.9 beats the unhedged."""
+  plan, rule, mesh, state, rng = build(cfg)
+  path = os.path.join(tmp, "art_hedge")
+  serve_export(path, plan, rule, state, quantize="f32")
+  single = ServeEngine(ActsModel(), plan,
+                       serve_load(path, plan, mesh=mesh), mesh=mesh)
+  reqs = [mkreq(rng, cfg, cfg["req_rows"]) for _ in range(8)]
+  wants = [np.asarray(single.predict(*r)) for r in reqs]
+  fplan = FleetPlan.replicated(plan.world_size, 2, replicas=2,
+                               hot_fraction=1.0)
+  rows = {}
+  ok = True
+  for mode, hedge_kw in (("off", {}), ("on", HEDGE_KW)):
+    fcfg = FleetConfig(cache_fraction=0.05, staging_grps=256,
+                       shard_min_phys_rows=16, revive_after_s=3600.0,
+                       **hedge_kw)
+    owners, transport, router, reg = build_fleet(path, plan, mesh,
+                                                 fplan, config=fcfg)
+    mb = MicroBatcher(router.dispatch, max_batch=cfg["max_batch"],
+                      max_delay_s=0.002)
+    mb.submit(*reqs[0]).result(timeout=300)  # compile off the clock
+    for _ in range(12):  # warm the per-owner recent-latency windows
+      mb.submit(*reqs[1]).result(timeout=300)
+    inj = faultinject.FaultInjector()
+    inj.delay_when("fleet_rpc", cfg["slow_s"], owner=0)
+    # offered BELOW the slow replica's service rate: the measured tail
+    # is per-request latency, not a saturated queue (a saturated queue
+    # hides the hedge behind queueing delay in both modes)
+    with faultinject.injected(inj):
+      lats, rejected, out = open_loop(mb, reqs, qps=cfg["hedge_qps"],
+                                      n_requests=cfg["n_hedge"], rng=rng)
+    mb.close()
+    wrong = sum(0 if np.array_equal(res, wants[ri]) else 1
+                for ri, res in out)
+    p50, p99, p999 = pcts(lats)
+    c = router.store._counters
+    rows[mode] = {"wrong": wrong, "rejected": rejected,
+                  "p50": p50, "p99": p99, "p999": p999,
+                  "hedges": c["hedges"].value,
+                  "hedges_won": c["hedges_won"].value,
+                  "hedges_wasted": c["hedges_wasted"].value}
+    ok &= wrong == 0 and len(out) + rejected == cfg["n_hedge"]
+    if mode == "off":
+      # the disabled control plane is a true no-op: nothing counted,
+      # nothing allocated
+      ok &= c["hedges"].value == 0 and not router.store._gather_window
+    print(f"hedging {mode:>3}: p50 {p50 * 1e3:6.1f}  p99 "
+          f"{p99 * 1e3:6.1f}  p99.9 {p999 * 1e3:6.1f} ms  "
+          f"wrong={wrong} hedges={c['hedges'].value} "
+          f"won={c['hedges_won'].value}")
+    router.close()
+  ok &= rows["on"]["hedges"] >= 1 and rows["on"]["hedges_won"] >= 1
+  ok &= rows["on"]["p999"] < rows["off"]["p999"]
+  rows["p999_tightening"] = (rows["off"]["p999"] - rows["on"]["p999"]) \
+      / max(rows["off"]["p999"], 1e-9)
+  print(f"hedging p99.9: {rows['off']['p999'] * 1e3:.1f} -> "
+        f"{rows['on']['p999'] * 1e3:.1f} ms "
+        f"({rows['p999_tightening']:.0%} tighter) "
+        f"{'OK' if ok else 'FAIL'}")
+  result["hedging"] = rows
+  return ok
+
+
+def check_autoscale_ramp(cfg, tmp, result):
+  """3x QPS step under a live autoscaler: the fleet re-sizes through
+  ``apply_fleet`` mid-load with zero wrong answers and zero dropped
+  requests, every decision logged."""
+  plan, rule, mesh, state, rng = build(cfg)
+  path = os.path.join(tmp, "art_ramp")
+  serve_export(path, plan, rule, state, quantize="f32")
+  single = ServeEngine(ActsModel(), plan,
+                       serve_load(path, plan, mesh=mesh), mesh=mesh)
+  reqs = [mkreq(rng, cfg, cfg["req_rows"]) for _ in range(8)]
+  wants = [np.asarray(single.predict(*r)) for r in reqs]
+  world = plan.world_size
+  fplan1 = FleetPlan.balanced(world, 2)  # one owner per rank
+  fcfg = FleetConfig(cache_fraction=0.05, staging_grps=256,
+                     shard_min_phys_rows=16, revive_after_s=3600.0)
+  owners, transport, router, reg = build_fleet(path, plan, mesh, fplan1,
+                                               config=fcfg)
+  # one registry for batcher + router: the ticker's QPS probe samples
+  # serve/submitted and the decision counters land beside it
+  mb = MicroBatcher(router.dispatch, max_batch=cfg["max_batch"],
+                    max_delay_s=0.002, registry=reg)
+  mb.submit(*reqs[0]).result(timeout=300)  # compile off the clock
+  # closed-loop saturation estimate calibrates the band
+  t0 = time.perf_counter()
+  n_sat = 30
+  for i in range(n_sat):
+    mb.submit(*reqs[i % len(reqs)]).result(timeout=300)
+  sat_qps = n_sat / (time.perf_counter() - t0)
+  base_qps = max(5.0, 0.2 * sat_qps)
+
+  spawned = {}  # actuation artifacts, closed at the end
+
+  def actuate(target_replicas, rec):
+    fplan2 = FleetPlan.replicated(world, 2, replicas=target_replicas,
+                                  hot_fraction=1.0)
+    owners2 = {o: FleetOwner(path, plan, fplan2.owned_ranks(o),
+                             owner_id=o)
+               for o in range(fplan2.n_owners)}
+    router.apply_fleet(fplan2, InProcTransport(owners2))
+    spawned["owners"] = owners2
+    replicas_now[0] = target_replicas
+
+  decisions = DecisionLog(os.path.join(tmp, "decisions.jsonl"),
+                          telemetry=reg)
+  scaler = FleetAutoscaler(
+      AutoscalerConfig(qps_high_per_replica=2.0 * base_qps,
+                       qps_low_per_replica=0.1 * base_qps,
+                       min_replicas=1, max_replicas=2,
+                       up_after=2, down_after=50, cooldown_ticks=4),
+      actuate=actuate, decisions=decisions)
+  replicas_now = [1]
+  rate = CounterRate()
+  stop = threading.Event()
+  tick_n = [0]
+
+  def ticker():
+    while not stop.wait(0.05):
+      tick_n[0] += 1
+      qps = rate.sample(reg.counter("serve/submitted").value,
+                        time.time())
+      scaler.tick(ControlSnapshot(tick=tick_n[0], qps=qps,
+                                  replicas=replicas_now[0]))
+
+  th = threading.Thread(target=ticker, daemon=True)
+  th.start()
+  # phase A: in-band load; phase B: the 3x step the band cannot absorb
+  # at one replica per rank
+  latsA, rejA, outA = open_loop(mb, reqs, base_qps,
+                                cfg["n_ramp"] // 3, rng)
+  latsB, rejB, outB = open_loop(mb, reqs, 3.0 * base_qps,
+                                cfg["n_ramp"], rng)
+  stop.set()
+  th.join(timeout=5.0)
+  mb.close()
+  wrong = sum(0 if np.array_equal(res, wants[ri]) else 1
+              for ri, res in outA + outB)
+  n_total = cfg["n_ramp"] // 3 + cfg["n_ramp"]
+  completed = len(outA) + len(outB)
+  rejected = rejA + rejB
+  scale_ups = [r for r in decisions.records if r["action"] == "scale_up"]
+  p50, p99, p999 = pcts(latsA + latsB)
+  decisions.close()
+  # the phase latencies drive one SLO-admission tick end to end
+  policy = ControlPolicy(mb, {"interactive": max(0.05, 4 * p99)},
+                         decisions=DecisionLog(telemetry=reg))
+  for s in latsA + latsB:
+    policy.observe_latency(s)
+  adm = policy.tick()
+  result["ramp"] = {
+      "sat_qps": sat_qps, "base_qps": base_qps,
+      "requests": n_total, "completed": completed,
+      "rejected": rejected, "wrong": wrong,
+      "scale_ups": len(scale_ups), "replicas_final": replicas_now[0],
+      "p50": p50, "p99": p99, "p999": p999,
+      "decisions": len(decisions.records),
+      "admission_action": adm["action"],
+  }
+  ok = (wrong == 0 and completed + rejected == n_total
+        and len(scale_ups) >= 1 and replicas_now[0] == 2
+        and bool(np.isfinite([p50, p99, p999]).all()))
+  print(f"autoscale ramp: {n_total} requests ({base_qps:.0f} -> "
+        f"{3 * base_qps:.0f} req/s), wrong={wrong}, "
+        f"dropped={n_total - completed - rejected}, "
+        f"rejected={rejected}, scale_ups={len(scale_ups)}, "
+        f"replicas={replicas_now[0]}, p99.9 {p999 * 1e3:.1f} ms, "
+        f"decisions={len(decisions.records)} "
+        f"{'OK' if ok else 'FAIL'}")
+  router.close()
+  return ok
+
+
+def main(cfg, tag):
+  tmp = tempfile.mkdtemp(prefix="control_bench_")
+  result = {"config": {k: v for k, v in cfg.items()}}
+  try:
+    ok = check_hedging_tightens_tail(cfg, tmp, result)
+    ok = check_autoscale_ramp(cfg, tmp, result) and ok
+  finally:
+    shutil.rmtree(tmp, ignore_errors=True)
+  result["ok"] = bool(ok)
+  return telemetry.emit_verdict(tag, result)
+
+
+if __name__ == "__main__":
+  ap = argparse.ArgumentParser()
+  ap.add_argument("--smoke", action="store_true",
+                  help="tiny-world smoke tier (wired into make verify)")
+  args = ap.parse_args()
+  if args.smoke:
+    raise SystemExit(main(SMOKE, "control-smoke"))
+  raise SystemExit(main(BENCH, "control-bench"))
