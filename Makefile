@@ -6,7 +6,8 @@ PY ?= python
 SHELL := /bin/bash  # verify uses pipefail/PIPESTATUS
 
 .PHONY: test test-fast verify lint native bench dryrun chaos chaos-kill \
-	chaos-preempt preempt-smoke chaos-stream stream-smoke serve-bench \
+	chaos-preempt preempt-smoke chaos-multiproc multiproc-smoke \
+	chaos-stream stream-smoke serve-bench \
 	serve-smoke vocab-bench vocab-smoke obs-bench obs-smoke fresh-bench \
 	fresh-smoke fleet-bench fleet-smoke trace-bench trace-smoke \
 	control-bench control-smoke clean
@@ -153,7 +154,7 @@ control-smoke:
 # tests, collection errors surfaced but not fatal to the log); lint runs
 # first so invariant violations fail fast, then the smoke tiers
 verify: lint serve-smoke vocab-smoke obs-smoke fresh-smoke stream-smoke \
-	fleet-smoke trace-smoke preempt-smoke control-smoke
+	fleet-smoke trace-smoke preempt-smoke multiproc-smoke control-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
@@ -220,6 +221,25 @@ chaos-preempt:
 preempt-smoke:
 	PYTHONPATH=$(CURDIR):$$PYTHONPATH timeout -k 10 540 \
 	  $(PY) tools/chaos_preempt.py --smoke
+
+# multi-controller chaos: a REAL 2-process jax.distributed pod (gloo
+# collectives) shrinks 8 -> 4 through the membership barrier when a
+# member is SIGKILLed, regrows on a replacement, survives a DUAL
+# SIGKILL of both trainer processes plus a torn newest checkpoint (the
+# relaunch must broadcast-agree on the newest VALID one and land the
+# reference trajectory), and a socket-transport fleet owner process is
+# SIGKILLed mid-gather (zero wrong answers), then drained out by a
+# scale-down under load (tools/chaos_multiproc.py)
+chaos-multiproc:
+	PYTHONPATH=$(CURDIR):$$PYTHONPATH $(PY) tools/chaos_multiproc.py
+
+# the make-verify tier: fewer steps/requests, same assertions. The
+# budget covers 3 pod lifetimes x 2 controller processes (each pays
+# jax.distributed init + per-world step compiles) + the owner
+# subprocesses of the fleet cycle
+multiproc-smoke:
+	PYTHONPATH=$(CURDIR):$$PYTHONPATH timeout -k 10 780 \
+	  $(PY) tools/chaos_multiproc.py --smoke
 
 # multi-chip compile/execute validation on 8 virtual CPU devices
 dryrun:

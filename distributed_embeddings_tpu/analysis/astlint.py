@@ -77,6 +77,13 @@ GL117       error      fleet mutation surfaces (``fleet.reshard``,
                        outside ``control/`` and the surfaces' home
                        packages — mutations route through decision-
                        logged control daemons or operator tools
+GL118       error      every multi-controller refusal branch
+                       (``jax.process_count() > 1`` raising
+                       ``NotImplementedError``) must name a literal
+                       reason string AND appear in the checked
+                       :data:`REFUSAL_INVENTORY` — closing a refusal
+                       without pruning the inventory, or adding one
+                       without inventorying it, fails the lint
 ==========  =========  =====================================================
 
 Trace-reachable scope (GL101/GL102) is structural: any function nested —
@@ -990,6 +997,105 @@ def _check_fault_sites(mod: ParsedModule) -> List[Finding]:
   return out
 
 
+# The multi-controller refusal inventory: every `jax.process_count() > 1`
+# branch in the LIBRARY package that raises NotImplementedError must match
+# one `(path_suffix, reason_snippet)` entry here. The inventory is checked
+# BOTH ways: a refusal branch matching no entry fails GL118 at its line
+# (adding a refusal silently is impossible), and an entry whose file is in
+# the linted set but whose snippet matches no branch there fails GL118 as
+# a stale-inventory finding (closing a refusal forces this list to shrink
+# with it — the doc's refusal matrix and the code cannot drift). Remaining
+# by design after the multi-controller pod work (round 21):
+# - export/delta publication are single-controller by contract (the chain
+#   fingerprint protocol has exactly one writer);
+# - async snapshots need every process's main thread in the save barriers.
+REFUSAL_INVENTORY = (
+    ("serving/export.py", "export is a single-controller operation"),
+    ("resilience/trainer.py", "snapshot(async_=True) under multi-controller"),
+    ("streaming/publish.py", "delta publication is a single-controller"),
+)
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+  """The literal text of a string expression: a Constant, an f-string's
+  constant parts, or a `+`/implicit concatenation of those. None when
+  any part is non-literal beyond f-string interpolations."""
+  if isinstance(node, ast.Constant) and isinstance(node.value, str):
+    return node.value
+  if isinstance(node, ast.JoinedStr):
+    return "".join(v.value for v in node.values
+                   if isinstance(v, ast.Constant) and isinstance(v.value, str))
+  if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+    left, right = _const_str(node.left), _const_str(node.right)
+    if left is not None and right is not None:
+      return left + right
+  return None
+
+
+def multicontroller_refusals(tree: ast.Module):
+  """``(if_node, reason_or_None)`` for every multi-controller refusal:
+  an ``if`` comparing ``process_count()`` against 1 (``> 1`` / ``1 <``)
+  whose body raises ``NotImplementedError``. The reason is the raise's
+  literal message (None when the message is not extractable)."""
+  out = []
+  for node in ast.walk(tree):
+    if not isinstance(node, ast.If) or not isinstance(node.test, ast.Compare):
+      continue
+    sides = [node.test.left] + list(node.test.comparators)
+    if not any(isinstance(s, ast.Call)
+               and _call_pair(s)[1] == "process_count" for s in sides):
+      continue
+    if not any(isinstance(s, ast.Constant) and s.value == 1 for s in sides):
+      continue
+    for stmt in node.body:
+      if isinstance(stmt, ast.Raise) and stmt.exc is not None:
+        exc = stmt.exc
+        name = _dotted(exc.func) if isinstance(exc, ast.Call) else _dotted(exc)
+        if name and name.split(".")[-1] == "NotImplementedError":
+          reason = None
+          if isinstance(exc, ast.Call) and exc.args:
+            reason = _const_str(exc.args[0])
+          out.append((node, reason))
+  return out
+
+
+@_rule("GL118", "error",
+       "multi-controller refusals must name a reason and be inventoried")
+def _check_refusal_inventory(mod: ParsedModule) -> List[Finding]:
+  # The multi-controller pod work (round 21) closed the elastic-resize,
+  # prefetcher-write-back, and barrier-validation refusals; the ones that
+  # REMAIN are design decisions, and this rule pins them as such: every
+  # `process_count() > 1 -> raise NotImplementedError` branch in the
+  # library package must carry an extractable literal reason and match
+  # the REFUSAL_INVENTORY. A new refusal added without inventorying it
+  # (the easy way out of a hard multi-controller path) fails review
+  # here; lint_paths' staleness pass fails the OTHER direction.
+  norm = mod.path.replace(os.sep, "/")
+  if "distributed_embeddings_tpu/" not in norm:
+    return []
+  out = []
+  for node, reason in multicontroller_refusals(mod.tree):
+    if not reason:
+      out.append(mod.finding(
+          "GL118", node,
+          "multi-controller refusal branch raises NotImplementedError "
+          "without an extractable literal reason string: the refusal "
+          "matrix (ARCHITECTURE §24) is built from these messages — "
+          "name what is refused and why in a string literal."))
+      continue
+    if not any(norm.endswith(sfx) and snippet in reason
+               for sfx, snippet in REFUSAL_INVENTORY):
+      out.append(mod.finding(
+          "GL118", node,
+          f"multi-controller refusal {reason[:80]!r}... is not in "
+          "analysis.astlint.REFUSAL_INVENTORY: refusing under "
+          "process_count() > 1 is a design decision that must be "
+          "inventoried (add a (path_suffix, reason_snippet) entry and "
+          "the ARCHITECTURE §24 matrix row) — or implement the "
+          "multi-controller path."))
+  return out
+
+
 # ---------------------------------------------------------------------------
 # repo-context parsing (no imports of the target package)
 # ---------------------------------------------------------------------------
@@ -1099,6 +1205,15 @@ def lint_paths(paths: Sequence[str],
       root = os.path.dirname(root)
   ctx = LintContext.for_repo(root)
   out = []
+  # GL118 staleness (the aggregate direction): inventory entries whose
+  # file IS in the linted set but whose snippet matched no refusal there
+  # are stale — the refusal was closed without pruning the inventory.
+  # Tracked per inventory entry so partial-tree lints (a single file
+  # from another package) never false-positive.
+  inv_file_seen = [False] * len(REFUSAL_INVENTORY)
+  inv_matched = [False] * len(REFUSAL_INVENTORY)
+  inv_lines: Dict[int, str] = {}
+  want_gl118 = rules is None or "GL118" in set(rules)
   for path in _iter_py_files(paths):
     with open(path) as f:
       source = f.read()
@@ -1107,4 +1222,29 @@ def lint_paths(paths: Sequence[str],
     except SyntaxError as e:
       out.append(Finding("GL000", "error", path, e.lineno or 0,
                          f"syntax error: {e.msg}"))
+      continue
+    if not want_gl118:
+      continue
+    norm = path.replace(os.sep, "/")
+    hits = [i for i, (sfx, _) in enumerate(REFUSAL_INVENTORY)
+            if norm.endswith(sfx)]
+    if not hits:
+      continue
+    refusals = multicontroller_refusals(ast.parse(source))
+    for i in hits:
+      inv_file_seen[i] = True
+      inv_lines[i] = path
+      if any(reason and REFUSAL_INVENTORY[i][1] in reason
+             for _, reason in refusals):
+        inv_matched[i] = True
+  if want_gl118:
+    for i, (sfx, snippet) in enumerate(REFUSAL_INVENTORY):
+      if inv_file_seen[i] and not inv_matched[i]:
+        out.append(Finding(
+            "GL118", "error", inv_lines[i], 0,
+            f"stale REFUSAL_INVENTORY entry ({sfx!r}, {snippet!r}): no "
+            "multi-controller refusal in this file matches the snippet "
+            "— the refusal was closed (congratulations), so prune the "
+            "inventory entry and update the ARCHITECTURE §24 refusal "
+            "matrix."))
   return out

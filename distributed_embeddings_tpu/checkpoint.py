@@ -464,8 +464,8 @@ def _restore_elastic(path: str, manifest: Dict[str, Any],
     ranking = _remap_tier_counts(path, manifest, plan, store, n_aux)
     if ranking is None:
       for name in store.counts:
-        for rank in store.owned_ranks:
-          store.counts[name][rank][:] = 0
+        for cnt in store.counts[name]:
+          cnt[:] = 0
     store.warm_start(ranking)
     fused.update(store.build_fused(mesh, axis_name))
 
@@ -616,6 +616,51 @@ def publish_manifest_last(tmp: str, path: str,
   _fsync_dir(os.path.dirname(os.path.abspath(path)))
 
 
+def _pod_clock_record(rounds: int = 8) -> Dict[str, int]:
+  """This process's trace-clock offset vs process 0, measured over
+  ``rounds`` broadcast round trips (NTP-shaped: local read, broadcast of
+  p0's ``telemetry.clock_ns``, local read; min-RTT round wins with the
+  structural ±rtt/2 bound — ``telemetry.estimate_clock_offset`` over a
+  collective instead of a fleet RPC). Returned ``offset_ns`` is THIS
+  process's clock MINUS process 0's — exactly ``merge_traces``' per-entry
+  sign with p0's trace as the first/reference entry. Collective: every
+  process must call it at the same point (save() runs it right after the
+  tmp-ready barrier, when the pod is maximally aligned and the RTT bound
+  tightest)."""
+  from jax.experimental import multihost_utils
+  from .telemetry.trace import clock_ns, estimate_clock_offset
+
+  def remote_clock() -> int:
+    local = clock_ns() if jax.process_index() == 0 else 0
+    return int(multihost_utils.broadcast_one_to_all(np.int64(local)))
+
+  rec = estimate_clock_offset(remote_clock, rounds=rounds).to_json()
+  # estimate measures p0 (remote) vs local; merge_traces wants local vs p0
+  rec["offset_ns"] = -rec["offset_ns"]
+  rec["process"] = int(jax.process_index())
+  if jax.process_index() == 0:
+    rec["offset_ns"] = 0  # the reference clock, by definition
+    rec["uncertainty_ns"] = 0
+  return rec
+
+
+def read_pod_clock(path: str) -> Dict[int, Dict[str, int]]:
+  """Per-process clock-offset records a multi-controller save
+  piggybacked on its barriers (``pod_clock.json``), keyed by process
+  index. ``entry[i]["offset_ns"]`` feeds ``telemetry.merge_traces``
+  directly as ``traces[i]["offset_ns"]`` with process 0's trace as the
+  first (reference) entry — the training-side counterpart of the fleet
+  router's ``clock_offsets`` handshake, so one merged timeline covers
+  trainer processes too. ``{}`` for single-controller checkpoints
+  (one process, nothing to correlate)."""
+  try:
+    with open(os.path.join(path, "pod_clock.json")) as f:
+      data = json.load(f)
+  except OSError:
+    return {}
+  return {int(k): dict(v) for k, v in data.items()}
+
+
 def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
          state: Dict[str, Any], store=None,
          extra: Optional[Dict[str, Any]] = None, vocab=None,
@@ -723,6 +768,15 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
       err = e
   _barrier("de_tpu_ckpt_tmp_ready")
 
+  # Clock-offset piggyback: the pod just aligned at a barrier — the
+  # cheapest, tightest moment for the cross-process clock handshake
+  # (closing the training side of the fleet's tracing story). Pure
+  # collectives + local clock reads, so nothing here can fail one
+  # process without failing the collective itself.
+  clock_rec = None
+  if jax.process_count() > 1:
+    clock_rec = _pod_clock_record()
+
   # Every exception below still reaches the written-barrier (otherwise the
   # other processes deadlock inside sync_global_devices). Success is
   # advertised POSITIVELY via a DONE marker per process: the rename only
@@ -816,6 +870,12 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
         fpath = os.path.join(tmp, f"{part}.npz")
         np.savez(fpath, **_flatten_with_paths(state[part]))
         _seal(fpath)
+    if clock_rec is not None:
+      # transport to p0 like the marker crcs (merged into pod_clock.json
+      # at publication, then removed — not itself checkpoint data)
+      with open(os.path.join(
+          tmp, f"clock_p{jax.process_index()}.json"), "w") as f:
+        json.dump(clock_rec, f)
     with open(os.path.join(
         tmp, f"DONE_p{jax.process_index()}"), "w") as f:
       json.dump(local_crcs, f)  # the marker carries this writer's crcs
@@ -866,6 +926,23 @@ def save(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
       with open(mk) as f:
         checksums.update(json.load(f))
       os.remove(mk)
+    # merge the piggybacked clock records into one pod_clock.json (the
+    # per-process transport files vanish like the markers); the
+    # defensive crc pass below seals it into the manifest's table
+    clocks: Dict[str, Dict[str, int]] = {}
+    for p in range(n_proc):
+      cpath = os.path.join(tmp, f"clock_p{p}.json")
+      if not os.path.exists(cpath):
+        continue
+      with open(cpath) as f:
+        clocks[str(p)] = json.load(f)
+      os.remove(cpath)
+    if clocks:
+      cpath = os.path.join(tmp, "pod_clock.json")
+      with open(cpath, "w") as f:
+        json.dump(clocks, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
     for fname in sorted(os.listdir(tmp)):
       if fname not in checksums:  # defensive: a file no writer claimed
         checksums[fname] = _crc32_file(os.path.join(tmp, fname))
@@ -1129,10 +1206,16 @@ def restore(path: str, plan: DistEmbeddingStrategy, rule: SparseRule,
     # 'tiering_p<k>.npz' files from a sharded one — merge whatever exists
     # (only this store's ranks are read either way)
     flat = _load_tier_state_flat(path)
+    owned = frozenset(store.owned_ranks)
     for name in sorted(tiered_names):
-      for rank in store.owned_ranks:
-        store.set_image(name, rank, np.load(
-            os.path.join(path, f"cold_{name}_r{rank}.npy")))
+      for rank in range(store.plan.world_size):
+        if rank in owned:  # images shard by owner...
+          store.set_image(name, rank, np.load(
+              os.path.join(path, f"cold_{name}_r{rank}.npy")))
+        # ...but the resident/count bookkeeping is replicated: every
+        # process adopts EVERY rank's saved state (merged from the
+        # per-owner tiering_p<k>.npz parts), or the pod's processes
+        # would classify against diverging hot/cold splits
         grps = np.asarray(flat[f"{name}/r{rank}/resident_grps"], np.int32)
         rmap = store.resident_map[name][rank]
         rmap[:] = -1

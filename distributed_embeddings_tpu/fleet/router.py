@@ -93,6 +93,10 @@ class FleetConfig:
       window's geometry — ``slots`` sealed sub-histograms rotated every
       ``rotate_s`` seconds, so the hedge threshold tracks the last
       ``slots x rotate_s`` seconds of that owner, not its lifetime.
+    drain_deadline_s: how long a scale-DOWN waits for an owner's
+      in-flight gathers to finish before it leaves the replica set
+      anyway (``apply_fleet`` -> :meth:`FleetStore.drain_owner`; the
+      drained gathers are counted ``fleet/drained_gathers``).
   """
 
   cache_fraction: float = 0.05
@@ -106,6 +110,7 @@ class FleetConfig:
   hedge_min_samples: int = 20
   hedge_window_slots: int = 6
   hedge_window_rotate_s: float = 1.0
+  drain_deadline_s: float = 5.0
 
   def __post_init__(self):
     if self.hedge_quantile is not None \
@@ -170,7 +175,8 @@ class FleetStore:
     self._counters = {k: self.telemetry.counter(f"fleet/{k}")
                       for k in ("rpcs", "rpc_bytes", "rpc_retries",
                                 "failovers", "dead_rank_errors",
-                                "hedges", "hedges_won", "hedges_wasted")}
+                                "hedges", "hedges_won", "hedges_wasted",
+                                "drained_gathers")}
     self._dead_gauge = self.telemetry.gauge("fleet/owners_dead")
 
   @property
@@ -205,6 +211,16 @@ class FleetStore:
     from jax.sharding import NamedSharding, PartitionSpec as P
     spec = P(axis_name) if arr.ndim == 1 else P(axis_name, None)
     return jax.device_put(arr, NamedSharding(mesh, spec))
+
+  def _global_or_callback(self, name: str, per_rank_rows: int, width,
+                          block_of, mesh, axis_name: str):
+    """``HostTierStore._global_or_callback`` for the fully-owned case:
+    the router addresses every rank, so the staged device arrays are a
+    plain concatenation of the per-rank blocks (no callback sharding —
+    a router is a single process over its own mesh)."""
+    del name, per_rank_rows, width
+    blocks = [block_of(r) for r in range(self.plan.world_size)]
+    return self._put(np.concatenate(blocks), mesh, axis_name)
 
   def warm_start(self, ranking: Optional[Dict[str, List[np.ndarray]]] = None
                  ) -> None:
@@ -679,6 +695,33 @@ class FleetStore:
       return pre[1]
     return self._fetch(name, rank, np.asarray(grps, np.int64))
 
+  def drain_owner(self, owner: int, deadline_s: Optional[float] = None
+                  ) -> bool:
+    """Bounded wait for OWNER's in-flight gathers to finish before a
+    scale-down drops it from the replica set — an owner yanked
+    mid-gather turns live requests into failovers; an owner drained
+    first leaves without a trace. Gathers that completed during the
+    wait are counted ``fleet/drained_gathers``. Returns True when the
+    owner drained fully; False means the deadline passed with calls
+    still in flight (they will failover like any owner death — bounded
+    actuation beats an unbounded wait on a wedged gather)."""
+    import time
+    if deadline_s is None:
+      deadline_s = self.config.drain_deadline_s
+    with self._lock:
+      start = self._inflight.get(owner, 0)
+    if start == 0:
+      return True
+    deadline = self._now() + deadline_s
+    while True:
+      with self._lock:
+        left = self._inflight.get(owner, 0)
+      if left == 0 or self._now() >= deadline:
+        break
+      time.sleep(0.005)
+    self._counters["drained_gathers"].inc(max(0, start - left))
+    return left == 0
+
   def set_fleet(self, fplan: FleetPlan, transport=None) -> None:
     """Replica-set edit: adopt a new fleet plan (and optionally a new
     transport carrying spawned/drained owners). A CONTROL surface —
@@ -937,6 +980,12 @@ class FleetRouter(ServeEngine):
         transport if transport is not None else self.store.transport,
         fleet_plan)
     with self.lock:
+      # scale-down: drain each departing owner's in-flight gathers
+      # (bounded) before the rotation forgets it — prefetcher fan-out
+      # threads run outside the dispatch lock, so the promote-lock
+      # contract alone does not cover them
+      for o in range(fleet_plan.n_owners, self.fleet_plan.n_owners):
+        self.store.drain_owner(o)
       self.fleet_plan = fleet_plan
       self.store.set_fleet(fleet_plan, transport)
 

@@ -34,6 +34,42 @@ def create_mesh(world_size: Optional[int] = None,
   return Mesh(np.asarray(devices[:world_size]), (axis_name,))
 
 
+def balanced_devices(world_size: int,
+                     devices: Optional[Sequence[jax.Device]] = None):
+  """``world_size`` devices drawn EVENLY across processes.
+
+  ``create_mesh(w)`` takes the first ``w`` entries of ``jax.devices()``,
+  which in a multi-controller pod are all process 0's — a shrunken mesh
+  built that way strands every other controller outside the computation
+  and its collectives hang. This helper keeps each surviving process
+  holding exactly ``world_size / process_count`` devices so a
+  membership-barrier resize can shrink *in place* with every controller
+  still participating. Requires ``process_count | world_size``.
+  """
+  if devices is None:
+    devices = jax.devices()
+  by_proc = {}
+  for d in devices:
+    by_proc.setdefault(d.process_index, []).append(d)
+  procs = sorted(by_proc)
+  n_proc = len(procs)
+  if world_size % n_proc != 0:
+    raise ValueError(
+        f"world_size {world_size} not divisible by process count {n_proc}: "
+        "a balanced multi-controller submesh needs the same device count "
+        "on every controller")
+  per = world_size // n_proc
+  short = [p for p in procs if len(by_proc[p]) < per]
+  if short:
+    raise ValueError(
+        f"processes {short} hold fewer than {per} devices; cannot build a "
+        f"balanced {world_size}-device submesh")
+  out = []
+  for p in procs:
+    out.extend(by_proc[p][:per])
+  return out
+
+
 def initialize_multihost(coordinator_address: Optional[str] = None,
                          num_processes: Optional[int] = None,
                          process_id: Optional[int] = None) -> Mesh:

@@ -56,6 +56,8 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -65,6 +67,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..layers.planner import DistEmbeddingStrategy
 from ..ops.packed_table import PackedLayout, SparseRule
+from ..parallel.mesh import addressable_row_spans
 from ..parallel.lookup_engine import class_param_name, padded_rows
 from .. import telemetry as _telemetry
 from . import faultinject
@@ -75,6 +78,44 @@ from . import faultinject
 RESIZE_GATHER_SITE = faultinject.register_site("resize_gather")
 
 MEMBER_DIR = "members"
+BARRIER_DIR = "barriers"
+
+
+def _sync(tag: str) -> None:
+  """Cross-process fence (no-op single-controller) — the same collective
+  ``checkpoint.save`` uses for its write/verify/rename barriers, so the
+  resize's spill/read/cleanup phases order identically on every
+  controller."""
+  if jax.process_count() > 1:
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(tag)
+
+
+def _spill_write(dirpath: str, name: str, arr: np.ndarray) -> None:
+  """Atomically publish one spilled rank block (tmp + rename, so a
+  reader polling across NFS never maps a torn file)."""
+  tmp = os.path.join(dirpath, f".{name}.tmp.{os.getpid()}")
+  with open(tmp, "wb") as f:
+    np.save(f, np.ascontiguousarray(arr))
+  os.replace(tmp, os.path.join(dirpath, name))
+
+
+def _spill_load(dirpath: str, name: str, deadline_s: float = 30.0):
+  """Memory-map a peer's spilled rank block, absorbing cross-host
+  rename-visibility lag with a bounded existence poll (the writer
+  published before the spill barrier; only the filesystem can still be
+  behind)."""
+  path = os.path.join(dirpath, name)
+  deadline = time.monotonic() + deadline_s  # graftlint: disable=GL113 (deadline arithmetic, not timing)
+  while not os.path.exists(path):
+    if time.monotonic() >= deadline:  # graftlint: disable=GL113 (deadline arithmetic)
+      raise RuntimeError(
+          f"spilled resize block {path} did not appear within "
+          f"{deadline_s:.0f}s: the owning process either crashed before "
+          "the spill barrier or the spill directory is not shared "
+          "between the pod's hosts")
+    time.sleep(0.05)
+  return np.load(path, mmap_mode="r")
 
 
 # ---------------------------------------------------------------------------
@@ -391,7 +432,7 @@ def remap_group_counts(src_classes: Dict[str, dict],
   merge by max — column slices of one table see the same stream), then
   each target rank's groups max-pool their logical rows; for unchanged
   windows an N -> N round trip is exact. Writes ``store.counts`` in
-  place for owned ranks and returns the count-descending ``warm_start``
+  place for every materialized rank and returns the count-descending ``warm_start``
   ranking (ties row-id ascending, the re-rank's tie policy), or None
   when no source counts exist."""
   cfgs = plan.global_configs
@@ -436,8 +477,9 @@ def remap_group_counts(src_classes: Dict[str, dict],
         grp = (off + np.arange(sh.input_dim)) // rpp
         np.maximum.at(arr, grp,
                       tc[sh.row_start:sh.row_start + sh.input_dim])
-      if rank in store.owned_ranks:
-        store.counts[name][rank][:] = arr
+      dst = store.counts[name][rank]
+      if dst is not None:
+        dst[:] = arr
       # count-desc, row-id-asc ties (stable argsort over ascending ids)
       per_rank.append(np.argsort(-arr, kind="stable").astype(np.int32))
     ranking[name] = per_rank
@@ -452,7 +494,8 @@ def remap_group_counts(src_classes: Dict[str, dict],
 def elastic_resize(state: Dict[str, Any], old_plan: DistEmbeddingStrategy,
                    new_world, rule: SparseRule, *,
                    new_mesh=None, axis_name: str = "mp",
-                   old_store=None, new_store=None, telemetry=None
+                   old_store=None, new_store=None, telemetry=None,
+                   spill_dir: Optional[str] = None
                    ) -> Tuple[DistEmbeddingStrategy, Dict[str, Any]]:
   """Re-shard a LIVE train state onto a different world, in memory.
 
@@ -480,6 +523,14 @@ def elastic_resize(state: Dict[str, Any], old_plan: DistEmbeddingStrategy,
       warm-start ranking survives the resize).
     telemetry: registry for the ``elastic/resizes`` counter and the
       ``elastic/quiesce_s`` histogram (default: process-wide).
+    spill_dir: pod-shared directory for the MULTI-CONTROLLER source
+      exchange. When the fused buffers are not fully addressable or the
+      stores are rank-owner-sharded, each process first spills its
+      addressable rank blocks / owned host-tier images there
+      (atomic-renamed ``.npy``, one barrier after), so every survivor
+      can window-read the FULL source world while writing only its own
+      targets; process 0 removes the spill after a completion barrier.
+      Required under multi-controller, ignored single-controller.
 
   Returns ``(new_plan, new_state)``. Unbridgeable plan differences
   (different tables, cross-tier or kind flips) refuse with the reason
@@ -516,18 +567,22 @@ def elastic_resize(state: Dict[str, Any], old_plan: DistEmbeddingStrategy,
         f"new_store geometry {sorted(new_store.tplan.tier_specs)} does "
         f"not cover the new plan's host-tier classes {sorted(new_host)}: "
         "build the HostTierStore from a TieringPlan of the NEW plan")
-  for label, st, world_n in (("old_store", old_store,
-                              old_plan.world_size),
-                             ("new_store", new_store,
-                              new_plan.world_size)):
-    if st is not None and len(st.owned_ranks) != world_n:
-      raise NotImplementedError(
-          f"{label} owns ranks {list(st.owned_ranks)} of {world_n}: "
-          "the in-memory elastic resize reads and writes EVERY rank's "
-          "host-tier image (unowned images are not materialized, and "
-          "unowned observed counts would silently drop from the "
-          "warm-start re-map); rank-owner-sharded (multi-process) pods "
-          "resize through the checkpoint restore path.")
+  multi = any(isinstance(a, jax.Array) and not a.is_fully_addressable
+              for a in state["fused"].values()) \
+      or any(st is not None and not st.owns_all
+             for st in (old_store, new_store))
+  if multi and spill_dir is None:
+    raise ValueError(
+        "multi-controller elastic resize (rank-owner-sharded stores or "
+        "non-fully-addressable fused buffers) needs spill_dir=...: each "
+        "process spills its addressable rank blocks / owned host-tier "
+        "images there so every survivor can read the full source world. "
+        "Pass a pod-shared directory (e.g. <pod_dir>/spill).")
+  if multi and new_mesh is None:
+    raise ValueError(
+        "multi-controller elastic resize needs new_mesh=...: the new "
+        "world's buffers must assemble as mesh-sharded global arrays "
+        "(make_array_from_callback), not per-process host arrays.")
 
   # ---- quiesce: nothing may be in flight while blocks are read ----------
   # block_until_ready drains the dispatched step (jax dispatch is
@@ -546,25 +601,70 @@ def elastic_resize(state: Dict[str, Any], old_plan: DistEmbeddingStrategy,
   n_src = old_plan.world_size
   src_slots = build_source_index(src_classes, src_layout, n_src, n_aux)
 
+  # ---- multi-controller: spill addressable source blocks, then fence ----
+  # Each process publishes the rank blocks only IT can read (device
+  # shards of non-addressable fused buffers, owned host-tier images and
+  # counts); after one barrier every survivor window-reads the full
+  # source world from the shared spill while still writing only its own
+  # targets — owner-local in, owner-local out.
+  spill_sub = None
+  if multi:
+    step_now = int(to_host(state["step"]))
+    spill_sub = os.path.join(
+        spill_dir,
+        f"resize_{step_now:010d}_w{n_src}to{new_plan.world_size}")
+    os.makedirs(spill_sub, exist_ok=True)
+    for cname in sorted(src_classes):
+      meta = src_classes[cname]
+      if meta["kind"] != "sparse":
+        continue
+      lay = PackedLayout(rows=int(meta["rows"]), width=int(meta["width"]),
+                         n_aux=n_aux)
+      if cname in old_tiered:
+        for rank in old_store.owned_ranks:
+          _spill_write(spill_sub, f"src_{cname}_r{rank}.npy",
+                       old_store.images[cname][rank])
+          cnt = old_store.counts.get(cname)
+          if cnt is not None and cnt[rank] is not None:
+            _spill_write(spill_sub, f"cnt_{cname}_r{rank}.npy",
+                         np.asarray(cnt[rank], np.int64))
+      else:
+        arr = state["fused"][cname]
+        if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+          for s0, s1, shard in addressable_row_spans(arr):
+            if s0 % lay.phys_rows or (s1 - s0) % lay.phys_rows:
+              raise ValueError(
+                  f"{cname}: addressable shard rows [{s0}, {s1}) do not "
+                  f"align to the {lay.phys_rows}-physical-row rank "
+                  "blocks — fused buffers must shard P(axis, None)")
+            blk = np.asarray(shard.data)
+            for j in range((s1 - s0) // lay.phys_rows):
+              rank = s0 // lay.phys_rows + j
+              _spill_write(
+                  spill_sub, f"src_{cname}_r{rank}.npy",
+                  blk[j * lay.phys_rows:(j + 1) * lay.phys_rows])
+    _sync("de_tpu_resize_spilled")
+
   def read_rows(tag, lay, lo, hi):
     cname, rank = tag
     faultinject.fire("resize_gather", clazz=cname, rank=rank, rows=hi - lo)
     if cname in old_tiered:
-      img = old_store.images[cname][rank]
-      reader = lambda p0, p1, img=img: img[p0:p1]  # noqa: E731
+      img = old_store.images[cname][rank] \
+          if rank in old_store.owned_ranks else None
+      if img is None:
+        img = _spill_load(spill_sub, f"src_{cname}_r{rank}.npy")
+      reader = lambda p0, p1, img=img: np.asarray(img[p0:p1])  # noqa: E731
     else:
       arr = state["fused"][cname]
       if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
-        raise NotImplementedError(
-            "in-memory elastic resize indexes the global fused buffers "
-            "and requires fully-addressable arrays (single-controller); "
-            "multi-controller pods resize through the checkpoint "
-            "restore path.")
-      base = rank * lay.phys_rows
-      # one window device_get at a time — peak host memory stays one
-      # target rank block plus one source window, like the restore path
-      reader = lambda p0, p1, arr=arr, base=base: np.asarray(  # noqa: E731
-          jax.device_get(arr[base + p0:base + p1]))
+        blk = _spill_load(spill_sub, f"src_{cname}_r{rank}.npy")
+        reader = lambda p0, p1, blk=blk: np.asarray(blk[p0:p1])  # noqa: E731
+      else:
+        base = rank * lay.phys_rows
+        # one window device_get at a time — peak host memory stays one
+        # target rank block plus one source window, like the restore path
+        reader = lambda p0, p1, arr=arr, base=base: np.asarray(  # noqa: E731
+            jax.device_get(arr[base + p0:base + p1]))
     return read_logical_rows(lay, reader, lo, hi, n_aux)
 
   # ---- target: packed rank blocks for the NEW plan, window-streamed ------
@@ -609,14 +709,18 @@ def elastic_resize(state: Dict[str, Any], old_plan: DistEmbeddingStrategy,
     def counts_of(cname, rank):
       if old_store is None or cname not in old_store.counts:
         return None
-      return old_store.counts[cname][rank]
+      cnt = old_store.counts[cname][rank]
+      if cnt is None:  # rank-owner-sharded: the owner spilled its counts
+        return _spill_load(spill_sub, f"cnt_{cname}_r{rank}.npy")
+      return cnt
 
     ranking = remap_group_counts(src_classes, src_layout, n_src, n_aux,
                                  counts_of, new_plan, new_store)
     if ranking is None:
       for name in new_store.counts:
-        for rank in new_store.owned_ranks:
-          new_store.counts[name][rank][:] = 0
+        for cnt in new_store.counts[name]:
+          if cnt is not None:
+            cnt[:] = 0
     new_store.warm_start(ranking)
     fused.update(new_store.build_fused(new_mesh, axis_name))
 
@@ -628,6 +732,13 @@ def elastic_resize(state: Dict[str, Any], old_plan: DistEmbeddingStrategy,
       flat = regroup_dense_flat(flat, src_classes, src_layout, n_src,
                                 new_plan)
     parts[part] = unflatten_like(state[part], flat, strict_shapes=False)
+
+  if multi:
+    # every survivor finished its window reads — only then may the
+    # spill vanish (p0 cleans; survivors do not wait on the removal)
+    _sync("de_tpu_resize_regrouped")
+    if jax.process_index() == 0 and spill_sub is not None:
+      shutil.rmtree(spill_sub, ignore_errors=True)
 
   reg.counter("elastic/resizes").inc()
   return new_plan, {
@@ -723,6 +834,80 @@ def alive_members(pod_dir: str) -> Dict[str, int]:
         continue  # pid recycled: the lease's own process is gone
     out[mid] = pid
   return out
+
+
+def membership_barrier(pod_dir: str, epoch: int, member_id: str,
+                       n_participants: int, step: int, world: int,
+                       timeout_s: float = 60.0) -> Tuple[int, int]:
+  """All survivors of a membership change agree on ONE step boundary.
+
+  Each participant posts ``{"id", "step", "world"}`` under
+  ``<pod_dir>/barriers/<epoch>/`` (atomic rename, so peers never read a
+  torn record) and polls until ``n_participants`` records exist. Every
+  record must carry the same ``(step, world)`` — a survivor that raced
+  one extra step past the preemption notice, or computed a different
+  target world, fails LOUDLY here instead of silently regrouping rank
+  blocks cut at different step boundaries (which would merge two
+  inconsistent versions of the same logical rows). Returns the agreed
+  ``(step, world)``; raises RuntimeError naming the laggards or the
+  disagreeing members. ``epoch`` must be bumped per membership change
+  (stale epochs' records cannot collide with the current barrier)."""
+  from ..telemetry import atomic_write_text
+  d = os.path.join(pod_dir, BARRIER_DIR, f"{int(epoch):06d}")
+  os.makedirs(d, exist_ok=True)
+  atomic_write_text(
+      os.path.join(d, f"{member_id}.json"),
+      json.dumps({"id": member_id, "step": int(step), "world": int(world)}))
+  deadline = time.monotonic() + timeout_s  # graftlint: disable=GL113 (deadline arithmetic, not timing)
+  while True:
+    recs: Dict[str, Tuple[int, int]] = {}
+    try:
+      names = sorted(os.listdir(d))
+    except OSError:
+      names = []
+    for name in names:
+      if not name.endswith(".json"):
+        continue
+      try:
+        with open(os.path.join(d, name)) as f:
+          rec = json.load(f)
+        recs[str(rec["id"])] = (int(rec["step"]), int(rec["world"]))
+      except (OSError, ValueError, KeyError, TypeError):
+        continue  # torn/foreign record: the poll will see it next pass
+    if len(recs) >= int(n_participants):
+      break
+    if time.monotonic() >= deadline:  # graftlint: disable=GL113 (deadline arithmetic)
+      raise RuntimeError(
+          f"membership barrier epoch {epoch}: only {sorted(recs)} of "
+          f"{n_participants} participants arrived within {timeout_s:.0f}s "
+          "— a survivor died between the membership change and the "
+          "barrier; re-derive the target world and retry at a new epoch")
+    time.sleep(0.05)
+  want = (int(step), int(world))
+  wrong = {m: sw for m, sw in recs.items() if sw != want}
+  if wrong:
+    raise RuntimeError(
+        f"membership barrier epoch {epoch} DISAGREES: this member is at "
+        f"step {step} targeting world {world}, but {wrong} — survivors "
+        "must quiesce on a common step boundary before rank blocks "
+        "regroup (resize exactly at the barrier's agreed step)")
+  return want
+
+
+def agreed_target_world(supervisor: "PreemptionSupervisor") -> int:
+  """The pod's resize target as ONE collectively-agreed number.
+
+  Each controller's lease scan races preemptions independently — p1
+  might still see a dying member that p0's scan already dropped. Only
+  process 0's observation counts: it is broadcast so every controller
+  compares its current world against the SAME target (the broadcast is
+  a collective — call this at the same point of every process's step
+  loop, like the checkpoint barriers)."""
+  if jax.process_count() <= 1:
+    return supervisor.target_world()
+  from jax.experimental import multihost_utils
+  t = supervisor.target_world() if jax.process_index() == 0 else 0
+  return int(multihost_utils.broadcast_one_to_all(np.int32(t)))
 
 
 class PreemptionSupervisor:

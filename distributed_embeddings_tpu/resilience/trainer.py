@@ -376,7 +376,10 @@ class ResilientTrainer:
 
   # ---- live elastic resize (checkpoint-free in-run world change) ---------
   def resize(self, new_plan, step_fn=None, *, new_mesh=None,
-             new_store=None, tiered_factory=None, reason: str = ""):
+             new_store=None, tiered_factory=None, reason: str = "",
+             spill_dir=None, pod_dir=None, barrier_epoch=None,
+             member_id=None, n_participants=None,
+             barrier_timeout_s: float = 60.0):
     """Checkpoint-free IN-RUN world change: quiesce, re-shard every rank
     block in memory (:func:`resilience.elastic.elastic_resize` — the
     same window-wise regroup path ``checkpoint.restore`` uses for
@@ -407,6 +410,17 @@ class ResilientTrainer:
     publish raising ``ChainDivergedError`` and the operator wiping the
     pubdir by hand. Subscribers adopt via the existing new-base rebase
     path.
+
+    Multi-controller pods: pass ``pod_dir`` + ``barrier_epoch`` +
+    ``member_id`` + ``n_participants`` and every survivor first posts
+    its ``(step_count, world)`` to the membership-change barrier
+    (:func:`resilience.elastic.membership_barrier`) — the resize only
+    regroups after ALL survivors agree on the same step boundary, and a
+    divergent member raises naming the laggard/disagreer instead of
+    regrouping from inconsistent worlds. ``spill_dir`` (default
+    ``<pod_dir>/spill`` when ``pod_dir`` is given) is where each
+    process publishes the rank blocks only it can read so survivors
+    window-read the full source world; see ``elastic_resize``.
 
     ``new_plan`` may be a world size (int) — the plan is then re-derived
     from the current plan's knobs (``elastic.plan_for_world``). Returns
@@ -440,11 +454,27 @@ class ResilientTrainer:
           "this trainer runs on a device mesh; pass new_mesh (the NEW "
           "world's mesh) — resizing onto unsharded host arrays would "
           "silently stop placing state and batches on devices")
+    if pod_dir is not None:
+      if barrier_epoch is None or member_id is None \
+          or n_participants is None:
+        raise ValueError(
+            "a membership-change barrier needs barrier_epoch (one per "
+            "membership change, same on every survivor), member_id and "
+            "n_participants (the agreed survivor count) along with "
+            "pod_dir")
+      if spill_dir is None:
+        spill_dir = os.path.join(pod_dir, "spill")
+      _elastic.membership_barrier(
+          pod_dir, barrier_epoch, member_id, n_participants,
+          step=self.step_count, world=old_world,
+          timeout_s=barrier_timeout_s)
+      if self.telemetry is not None:
+        self.telemetry.counter("elastic/membership_barriers").inc()
     new_plan, new_state = _elastic.elastic_resize(
         self.state, self.plan, new_plan, self.rule,
         new_mesh=new_mesh, axis_name=self.axis_name,
         old_store=self.store, new_store=new_store,
-        telemetry=self.telemetry)
+        telemetry=self.telemetry, spill_dir=spill_dir)
     if self.tiered is not None:
       old_t = self.tiered
       new_t = tiered_factory(new_state)

@@ -231,26 +231,50 @@ def read_heartbeats(path: str, ttl_s: float
   back-pressure quorum and the GC retention floor (a dead serving
   process must not stall the publisher forever — staleness degrades,
   correctness never does: if it revives past GC it rebases onto the
-  compacted base instead of folding deleted deltas). Unreadable or
-  foreign files are ignored, like the delta seq scan."""
+  compacted base instead of folding deleted deltas). Foreign or
+  malformed files are ignored, like the delta seq scan. Transient
+  ``OSError`` reads (an NFS pubdir under a lag quorum or the
+  compactor's floor scan flakes) are RETRIED (counted
+  ``retry/attempts``); a file still unreadable after the retries is
+  returned as EXPIRED with ``unreadable: True`` — the member leaves
+  the quorum/floor like a dead one, it never crashes the publisher or
+  the compactor daemon."""
+  from ..resilience import retry
+
   live: Dict[str, Dict[str, Any]] = {}
   expired: Dict[str, Dict[str, Any]] = {}
   hb_dir = os.path.join(path, HEARTBEAT_DIR)
   try:
-    names = os.listdir(hb_dir)
+    names = retry.retry_call(os.listdir, hb_dir)
+  except FileNotFoundError:
+    return live, expired  # no heartbeat dir yet: no subscribers
   except OSError:
-    return live, expired
+    return live, expired  # directory unreadable even after retries
   now = time.time()
   for name in names:
     if not name.endswith(".json"):
       continue
+    fp = os.path.join(hb_dir, name)
+
+    def read_one(fp=fp):
+      with open(fp) as f:
+        return json.load(f)
+
     try:
-      with open(os.path.join(hb_dir, name)) as f:
-        rec = json.load(f)
+      rec = retry.retry_call(read_one)
       sid = str(rec["id"])
       rec["applied_seq"] = int(rec["applied_seq"])
       rec["wall"] = float(rec["wall"])
-    except (OSError, ValueError, KeyError, TypeError):
+    except FileNotFoundError:
+      continue  # withdrawn between the listing and the read: gone, not sick
+    except OSError:
+      # retries exhausted: the member is expired, not a crash — it
+      # neither stalls the lag quorum nor holds the GC retention floor
+      sid = name[:-len(".json")]
+      expired[sid] = {"id": sid, "applied_seq": -1, "wall": 0.0,
+                      "unreadable": True}
+      continue
+    except (ValueError, KeyError, TypeError):
       continue
     (expired if now - rec["wall"] > ttl_s else live)[sid] = rec
   return live, expired

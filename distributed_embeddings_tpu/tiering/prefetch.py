@@ -200,26 +200,36 @@ class TieredPrefetcher:
     grps_dev, rows_dev, s_eff = {}, {}, {}
     nbytes = 0
     spilled = False
+    owned = frozenset(self.store.owned_ranks)
     for c in self.tplan.classes.values():
       per_rank_cold = cold[c.name]
       lay = c.layout_logical
+      # the padded size is a GLOBAL max over every rank's cold count —
+      # classify runs over the replicated batch on every process, so a
+      # sharded pod's processes derive the same s and the staged arrays
+      # have one global shape
       s = max(self._bucket(c, len(g)) for g in per_rank_cold)
       spilled |= s > c.spec.staging_grps
-      g_blocks, r_blocks = [], []
+      g_blocks: Dict[int, np.ndarray] = {}
+      r_blocks: Dict[int, np.ndarray] = {}
       for rank, g in enumerate(per_rank_cold):
         pad = s - g.shape[0]
-        g_blocks.append(np.concatenate(
-            [g, np.full((pad,), TIER_PAD_GRP, np.int32)]))
+        g_blocks[rank] = np.concatenate(
+            [g, np.full((pad,), TIER_PAD_GRP, np.int32)])
+        if rank not in owned:
+          continue  # the owner host-gathers its own image
         rows = self._gather(c.name, rank, g)  # bounds-checked, retried
         nbytes += rows.nbytes
-        r_blocks.append(np.concatenate(
+        r_blocks[rank] = np.concatenate(
             # pad in the image dtype: f32 training stores, and the serve
             # tier's stripped f32/int8 images ride the same pipeline
-            [rows, np.zeros((pad, lay.phys_width), rows.dtype)]))
-      grps_dev[c.name] = self.store._put(
-          np.concatenate(g_blocks), self.mesh, self.axis_name)
-      rows_dev[c.name] = self.store._put(
-          np.concatenate(r_blocks), self.mesh, self.axis_name)
+            [rows, np.zeros((pad, lay.phys_width), rows.dtype)])
+      grps_dev[c.name] = self.store._global_or_callback(
+          c.name, s, None, lambda r, b=g_blocks: b[r],
+          self.mesh, self.axis_name)
+      rows_dev[c.name] = self.store._global_or_callback(
+          c.name, s, lay.phys_width, lambda r, b=r_blocks: b[r],
+          self.mesh, self.axis_name)
       s_eff[c.name] = s
     self.total_host_gather_bytes += nbytes
     self.spill_steps += int(spilled)
@@ -239,16 +249,24 @@ class TieredPrefetcher:
   def write_back(self, staged: StagedBatch,
                  staged_out: Dict[str, jax.Array]) -> None:
     """Overwrite the staged rows in the host images with the
-    post-scatter device values."""
+    post-scatter device values.
+
+    Owner-local under rank-owner sharding: each process fetches only
+    its owned ranks' windows of the staged output (addressable-shard
+    reads — global indexing of a non-addressable array is an error)
+    and scatters them into only its own images; every process doing so
+    covers the world with no cross-process row ever moving."""
+    from .store import read_row_window
+    owned = frozenset(self.store.owned_ranks)
     with _span("tiered/write_back"):
       for c in self.tplan.classes.values():
         s = staged.s_eff[c.name]
-        out_np = np.asarray(staged_out[c.name])
         for rank, g in enumerate(staged.cold[c.name]):
-          if not g.shape[0]:
+          if not g.shape[0] or rank not in owned:
             continue
-          self.store.scatter(c.name, rank, g,
-                             out_np[rank * s:rank * s + g.shape[0]])
+          rows = read_row_window(staged_out[c.name], rank * s,
+                                 rank * s + g.shape[0])
+          self.store.scatter(c.name, rank, g, rows)
 
   # ---- promotion / eviction ----------------------------------------------
   def maybe_rerank(self, fused: Dict[str, jax.Array], decay: bool = True
@@ -277,6 +295,8 @@ class TieredPrefetcher:
 
   def _rerank(self, fused: Dict[str, jax.Array], decay: bool = True
               ) -> Dict[str, jax.Array]:
+    if not self.store.owns_all:
+      return self._rerank_sharded(fused, decay=decay)
     fused = dict(fused)
     for c in self.tplan.classes.values():
       spec, lay = c.spec, c.layout_logical
@@ -321,6 +341,46 @@ class TieredPrefetcher:
       if decay:
         for rank in range(self.plan.world_size):
           self.store.counts[name][rank] >>= 1
+    self._resident_dev = self.store.resident_arrays(self.mesh,
+                                                    self.axis_name)
+    return fused
+
+  def _rerank_sharded(self, fused: Dict[str, jax.Array], decay: bool = True
+                      ) -> Dict[str, jax.Array]:
+    """Owner-local re-rank for rank-owner-sharded stores.
+
+    The incremental path's eager ``.at[idx].set`` would need every
+    process to issue the same global update — but each process only
+    knows its own ranks' rows. Instead: flush (owned cache rows become
+    authoritative in the images), recompute the top-K resident set for
+    EVERY rank from the replicated counts (all processes agree on the
+    new maps — counts evolve identically from the replicated batch
+    stream), then rebuild the fused blocks from the images via
+    ``make_array_from_callback`` (each process uploads only its owned
+    ranks). Same resident set as the incremental path; slot ASSIGNMENT
+    may differ (wholesale rebuild vs in-place swaps), which only the
+    translation maps see — and they are refreshed here too."""
+    fused = dict(fused)
+    self.store.flush(fused)
+    for c in self.tplan.classes.values():
+      name, spec = c.name, c.spec
+      k = spec.cache_grps
+      for rank in range(self.plan.world_size):
+        counts = self.store.counts[name][rank]
+        # same top-K-by-count policy as the incremental path: rows above
+        # the K-th count outright, ties filled lowest-row-id-first
+        cand = np.argpartition(-counts, k - 1)[:k]
+        cstar = counts[cand].min()
+        sure = np.where(counts > cstar)[0]
+        ties = np.where(counts == cstar)[0][:k - sure.shape[0]]
+        top = np.sort(np.concatenate([sure, ties]).astype(np.int32))
+        rmap = self.store.resident_map[name][rank]
+        rmap[:] = -1
+        rmap[top] = np.arange(k, dtype=np.int32)
+        self.store.resident_grps[name][rank] = top.copy()
+        if decay:
+          counts >>= 1
+    fused.update(self.store.build_fused(self.mesh, self.axis_name))
     self._resident_dev = self.store.resident_arrays(self.mesh,
                                                     self.axis_name)
     return fused

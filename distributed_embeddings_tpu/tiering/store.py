@@ -20,15 +20,21 @@ staged rows back every step). ``flush`` reconciles before checkpointing.
 
 Rank-owner sharding (elastic pods): under multi-controller each process
 constructs its store with ``owned_ranks`` = the mesh ranks its devices
-hold, and materializes ONLY those ranks' images/resident state — the
-cold store's bytes shard across hosts exactly like the device buffers
-shard across chips. Accessing an un-owned rank raises (it names the
-owner contract); ``checkpoint.save`` writes per-owner
-``cold_*_r<rank>.npy`` blocks and seals them through the DONE-marker
-protocol, and ``build_fused``/``resident_arrays`` assemble the global
-device arrays via ``jax.make_array_from_callback`` so each process
-uploads only its blocks. The single-controller default
-(``owned_ranks=None``) owns every rank and behaves as before.
+hold, and materializes ONLY those ranks' images — the cold store's
+BYTES shard across hosts exactly like the device buffers shard across
+chips. The resident-set BOOKKEEPING (``resident_map`` /
+``resident_grps`` / ``counts``) stays materialized for every rank on
+every process: it is tiny (ints per physical row), it derives
+deterministically from the globally-replicated batch stream, and the
+prefetcher's classify must agree on every rank's hot/cold split across
+processes for the staged device arrays to have one global shape.
+Gather/scatter on an un-owned rank's IMAGE raises (it names the owner
+contract); ``checkpoint.save`` writes per-owner ``cold_*_r<rank>.npy``
+blocks and seals them through the DONE-marker protocol, and
+``build_fused``/``resident_arrays`` assemble the global device arrays
+via ``jax.make_array_from_callback`` so each process uploads only its
+blocks. The single-controller default (``owned_ranks=None``) owns
+every rank and behaves as before.
 """
 
 from __future__ import annotations
@@ -46,6 +52,31 @@ from ..ops.packed_table import (
 )
 from ..resilience import faultinject
 from .plan import TieringPlan
+
+
+def read_row_window(arr, lo: int, hi: int) -> np.ndarray:
+  """Rows ``[lo, hi)`` of a device array, multi-controller safe.
+
+  Global indexing of a non-fully-addressable array is an error, so the
+  window assembles from this process's addressable shards instead — the
+  rank-owner contract guarantees an owner's windows are local; asking
+  for a peer's raises with the contract named. Fully-addressable arrays
+  take the plain slice."""
+  if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+    from ..parallel.mesh import addressable_row_spans
+    out = np.empty((hi - lo,) + tuple(arr.shape[1:]), arr.dtype)
+    have = 0
+    for s0, s1, shard in addressable_row_spans(arr):
+      a, b = max(s0, lo), min(s1, hi)
+      if a < b:
+        out[a - lo:b - lo] = np.asarray(shard.data[a - s0:b - s0])
+        have += b - a
+    if have != hi - lo:
+      raise RuntimeError(
+          f"rows [{lo}, {hi}) are not fully addressable by this process "
+          "— each rank's window must be read on its owner")
+    return out
+  return np.asarray(arr[lo:hi])
 
 
 class HostTierStore:
@@ -81,18 +112,17 @@ class HostTierStore:
     self.counts: Dict[str, List[Optional[np.ndarray]]] = {}
     for c in tplan.classes.values():
       lay = c.layout_logical
+      # images shard by owner; the resident/count bookkeeping replicates
+      # (every process must agree on every rank's hot/cold split)
       self.images[c.name] = [
           np.zeros((lay.phys_rows, lay.phys_width), self.dtype)
           if r in owned else None for r in range(world)]
       self.resident_map[c.name] = [
-          np.full((lay.phys_rows,), -1, np.int32)
-          if r in owned else None for r in range(world)]
+          np.full((lay.phys_rows,), -1, np.int32) for _ in range(world)]
       self.resident_grps[c.name] = [
-          np.zeros((c.spec.cache_grps,), np.int32)
-          if r in owned else None for r in range(world)]
+          np.zeros((c.spec.cache_grps,), np.int32) for _ in range(world)]
       self.counts[c.name] = [
-          np.zeros((lay.phys_rows,), np.int64)
-          if r in owned else None for r in range(world)]
+          np.zeros((lay.phys_rows,), np.int64) for _ in range(world)]
     self.warm_start()
 
   @property
@@ -155,9 +185,10 @@ class HostTierStore:
     for the id-sorted-by-frequency vocabularies recommender pipelines
     emit (and the synthetic power-law streams), that IS the hot set; the
     periodic re-rank repairs any other distribution."""
+    world = self.plan.world_size
     for name, maps in self.resident_map.items():
       cache = self.tplan.by_name(name).spec.cache_grps
-      for rank in self.owned_ranks:
+      for rank in range(world):
         if ranking is not None and name in ranking:
           grps = np.asarray(ranking[name][rank][:cache], np.int32)
           if grps.shape[0] < cache:
@@ -181,8 +212,10 @@ class HostTierStore:
     a corrupt id stream must fail with the class named and the offending
     index shown, not as a bare numpy fancy-index ``IndexError`` three
     frames deep (or — worse, for negative indices — as a silent
-    wrap-around read of the wrong rows)."""
-    self._own(name, rank)
+    wrap-around read of the wrong rows). Pure bounds arithmetic against
+    the class geometry: valid for ANY rank, owned or not (a sharded
+    pod's classify checks every rank; only image access is
+    owner-gated)."""
     grps = np.asarray(grps)
     if not grps.size:
       return grps
@@ -209,6 +242,7 @@ class HostTierStore:
     milliseconds, not the run."""
     faultinject.fire("host_gather", clazz=name, rank=rank,
                      rows=int(np.asarray(grps).size))
+    rank = self._own(name, rank)
     grps = self.check_rows(name, rank, grps)
     return host_gather_rows(self.tplan.by_name(name).layout_logical,
                             self.images[name][rank], grps)
@@ -216,6 +250,7 @@ class HostTierStore:
   def scatter(self, name: str, rank: int, grps: np.ndarray,
               rows: np.ndarray) -> None:
     """Bounds-checked write-back into one rank's host image."""
+    rank = self._own(name, rank)
     grps = self.check_rows(name, rank, grps)
     host_scatter_rows(self.tplan.by_name(name).layout_logical,
                       self.images[name][rank], grps, rows)
@@ -298,26 +333,8 @@ class HostTierStore:
                        rank: int) -> np.ndarray:
     spec = self.tplan.by_name(name).spec
     per = spec.cache_grps + spec.staging_grps
-    arr = fused[name]
-    lo, hi = rank * per, rank * per + spec.cache_grps
-    if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
-      # multi-controller: read the window from this process's shards
-      # (global indexing of a non-addressable array is an error); the
-      # owner contract guarantees the window is local
-      from ..parallel.mesh import addressable_row_spans
-      out = np.empty((spec.cache_grps, arr.shape[1]), arr.dtype)
-      have = 0
-      for s0, s1, shard in addressable_row_spans(arr):
-        a, b = max(s0, lo), min(s1, hi)
-        if a < b:
-          out[a - lo:b - lo] = np.asarray(shard.data[a - s0:b - s0])
-          have += b - a
-      if have != spec.cache_grps:
-        raise RuntimeError(
-            f"rank {rank}'s cache window of class {name!r} is not fully "
-            "addressable by this process — flush each rank on its owner")
-      return out
-    return np.asarray(arr[lo:hi])
+    return read_row_window(fused[name], rank * per,
+                           rank * per + spec.cache_grps)
 
   def flush(self, fused: Dict[str, jax.Array]) -> None:
     """Copy every OWNED resident row's device value back into the host

@@ -24,6 +24,20 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache, scoped to this pytest run.  The in-memory
+# pjit cache is keyed on function identity, so the same DLRM step function
+# re-traced in a different test module recompiles from scratch; the
+# persistent cache is keyed on the HLO hash, so those duplicate compiles
+# become disk hits.  A fresh per-run directory keeps runs hermetic (no
+# stale artifacts across jax/XLA upgrades) while still deduping the many
+# identical step functions the suite compiles across files.
+import tempfile  # noqa: E402
+
+_cache_dir = tempfile.mkdtemp(prefix="jax_test_compile_cache_")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
 assert len(jax.devices()) == 8, (
     f"expected 8 virtual CPU devices, got {jax.devices()}")
 

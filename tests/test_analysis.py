@@ -1167,3 +1167,96 @@ def apply_fleet_discount(prices):
 """
   assert lint_source(ok, "distributed_embeddings_tpu/serving/engine.py",
                      CTX, ["GL117"]) == []
+
+
+# GL118: multi-controller refusals must name a reason and be inventoried
+def test_gl118_flags_uninventoried_refusal():
+  src = """
+import jax
+
+def publish(path):
+  if jax.process_count() > 1:
+    raise NotImplementedError(
+        "frobnication is a single-controller operation: run it from a "
+        "restored checkpoint.")
+"""
+  out = lint_source(src, "distributed_embeddings_tpu/streaming/frob.py",
+                    CTX, ["GL118"])
+  assert _rules(out) == ["GL118"]
+  assert "REFUSAL_INVENTORY" in out[0].message
+  # the same refusal in an INVENTORIED file+snippet is the sanctioned form
+  inv = src.replace(
+      "frobnication is a single-controller operation",
+      "delta publication is a single-controller operation")
+  assert lint_source(inv, "distributed_embeddings_tpu/streaming/publish.py",
+                     CTX, ["GL118"]) == []
+
+
+def test_gl118_requires_literal_reason():
+  src = """
+import jax
+
+def save(msg):
+  if jax.process_count() > 1:
+    raise NotImplementedError(msg)
+"""
+  out = lint_source(src, "distributed_embeddings_tpu/streaming/frob.py",
+                    CTX, ["GL118"])
+  assert _rules(out) == ["GL118"]
+  assert "reason string" in out[0].message
+
+
+def test_gl118_scope_and_suppression():
+  src = """
+import jax
+
+def run():
+  if jax.process_count() > 1:
+    raise NotImplementedError("tools do their own thing")
+"""
+  # tools and tests live outside the library package
+  assert lint_source(src, "tools/chaos_thing.py", CTX, ["GL118"]) == []
+  # behavior branches (no raise) and other exception types are not refusals
+  ok = """
+import jax
+
+def save():
+  if jax.process_count() > 1:
+    barrier()
+  if jax.process_count() > 1:
+    raise RuntimeError("a real error, not a refusal")
+"""
+  assert lint_source(ok, "distributed_embeddings_tpu/streaming/frob.py",
+                     CTX, ["GL118"]) == []
+  sup = """
+import jax
+
+def run():
+  if jax.process_count() > 1:  # graftlint: disable=GL118 (migration shim)
+    raise NotImplementedError("temporary refusal under review")
+"""
+  assert lint_source(sup, "distributed_embeddings_tpu/streaming/frob.py",
+                     CTX, ["GL118"]) == []
+
+
+def test_gl118_stale_inventory_entry_fails(tmp_path):
+  # a file that IS named by an inventory entry but no longer carries the
+  # refusal must produce the stale-inventory finding from lint_paths
+  pkg = tmp_path / "distributed_embeddings_tpu" / "streaming"
+  pkg.mkdir(parents=True)
+  (tmp_path / "pyproject.toml").write_text("")
+  f = pkg / "publish.py"
+  f.write_text("def publish():\n  return 1\n")
+  out = [x for x in lint_paths([str(f)], root=str(tmp_path),
+                               rules=["GL118"]) if x.rule == "GL118"]
+  assert len(out) == 1 and "stale" in out[0].message
+  # restore the inventoried refusal: the staleness finding clears
+  f.write_text("""
+import jax
+
+def publish():
+  if jax.process_count() > 1:
+    raise NotImplementedError(
+        "delta publication is a single-controller operation")
+""")
+  assert lint_paths([str(f)], root=str(tmp_path), rules=["GL118"]) == []

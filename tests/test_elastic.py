@@ -510,6 +510,13 @@ def test_multicontroller_tiered_save_publishes(tmp_path, monkeypatch):
   plan, model, tplan, store, b0, state = tiered_fresh(4, mesh4)
   path = os.path.join(tmp_path, "ck_mc")
   monkeypatch.setattr(checkpoint, "_barrier", lambda tag: None)
+  # the clock handshake is a real collective (broadcast_one_to_all) —
+  # stubbed here like the barriers; the spawned-process tests exercise
+  # the real one
+  monkeypatch.setattr(
+      checkpoint, "_pod_clock_record",
+      lambda rounds=8: {"process": 0, "offset_ns": 0, "uncertainty_ns": 0,
+                        "rtt_ns": 0, "rounds": rounds})
   monkeypatch.setattr(jax, "process_count", lambda: 2)
 
   done = {}
@@ -519,6 +526,9 @@ def test_multicontroller_tiered_save_publishes(tmp_path, monkeypatch):
     deadline = time.monotonic() + 30.0
     while time.monotonic() < deadline:
       if os.path.exists(os.path.join(tmp, "DONE_p0")):
+        with open(os.path.join(tmp, "clock_p1.json"), "w") as f:
+          f.write('{"process": 1, "offset_ns": 1234, '
+                  '"uncertainty_ns": 7, "rtt_ns": 14, "rounds": 8}')
         with open(os.path.join(tmp, "DONE_p1"), "w") as f:
           f.write("{}")
         done["planted"] = True
@@ -534,6 +544,11 @@ def test_multicontroller_tiered_save_publishes(tmp_path, monkeypatch):
   monkeypatch.undo()
   assert done.get("planted")
   assert checkpoint.verify(path) == []
+  # the piggybacked clock records merged into pod_clock.json (and the
+  # per-process transport files vanished with the markers)
+  clocks = checkpoint.read_pod_clock(path)
+  assert clocks[1]["offset_ns"] == 1234 and clocks[0]["offset_ns"] == 0
+  assert not [f for f in os.listdir(path) if f.startswith("clock_p")]
   _, _, tplan_c, store_c, _, s_like = tiered_fresh(4, mesh4, seed=11)
   restored = checkpoint.restore(path, plan, RULE, s_like, mesh=mesh4,
                                 store=store_c)

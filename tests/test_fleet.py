@@ -883,3 +883,52 @@ def test_hedging_disabled_is_a_true_noop(tmp_path):
   assert c["hedges_wasted"].value == 0
   assert router.store._gather_window == {}  # not even allocated
   router.close()
+
+
+def test_scale_down_drains_inflight_gathers(tmp_path):
+  """A scale-DOWN drains the departing owner's in-flight gathers
+  (bounded) before the rotation forgets it — counted
+  ``fleet/drained_gathers``; a wedged gather only holds actuation to
+  the deadline (drain_owner returns False, the call fails over like
+  any owner death)."""
+  import threading
+  import time
+
+  world = 2
+  plan, rule, mesh, state, rng = _fixture(world)
+  path = _export(tmp_path, plan, rule, state, "f32")
+  fplan2 = FleetPlan.balanced(world, 2)
+  owners, transport, router = _fleet(path, plan, fplan2, mesh)
+  store = router.store
+
+  # a gather in flight on owner 1 (the one being dropped)
+  with store._lock:
+    store._inflight[1] += 1
+
+  def finish():
+    time.sleep(0.15)
+    with store._lock:
+      store._inflight[1] -= 1
+
+  t = threading.Thread(target=finish)
+  t.start()
+  owners1 = {0: FleetOwner(path, plan, (0, 1), owner_id=0)}
+  t0 = time.monotonic()
+  router.apply_fleet(FleetPlan.balanced(world, 1), InProcTransport(owners1))
+  waited = time.monotonic() - t0
+  t.join()
+  assert waited >= 0.1  # actuation waited for the in-flight gather
+  assert router.fleet_plan.n_owners == 1
+  assert store._counters["drained_gathers"].value == 1
+
+  # wedged: the drain is bounded, not an unbounded wait
+  with store._lock:
+    store._inflight[0] += 1
+  t0 = time.monotonic()
+  assert store.drain_owner(0, deadline_s=0.05) is False
+  assert time.monotonic() - t0 < 2.0
+  with store._lock:
+    store._inflight[0] -= 1
+  # nothing NEW completed during the wedged wait
+  assert store._counters["drained_gathers"].value == 1
+  router.close()

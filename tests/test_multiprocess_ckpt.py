@@ -18,22 +18,15 @@ filesystem replace the collectives.
 """
 
 import os
-import socket
-import subprocess
 import sys
 
 import pytest
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from multiproc import spawn_world2  # noqa: E402
+
 _WORKER = r"""
-import os, sys, json
-proc_id = int(sys.argv[1]); port = sys.argv[2]; tmpdir = sys.argv[3]
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-os.environ.pop("JAX_PLATFORMS", None)
-import jax
-jax.config.update("jax_platforms", "cpu")
-jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
-                           num_processes=2, process_id=proc_id)
-assert jax.process_count() == 2 and len(jax.devices()) == 8
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -109,28 +102,4 @@ print("PROC", proc_id, "OK")
 
 @pytest.mark.slow
 def test_two_process_checkpoint(tmp_path):
-  script = tmp_path / "worker.py"
-  script.write_text(_WORKER)
-  with socket.socket() as s:
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-  env = {k: v for k, v in os.environ.items()
-         if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "PYTHONPATH")}
-  env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
-  procs = [subprocess.Popen(
-      [sys.executable, str(script), str(i), str(port), str(tmp_path)],
-      env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-      for i in range(2)]
-  outs = []
-  try:
-    for p in procs:
-      out, _ = p.communicate(timeout=300)
-      outs.append(out)
-  finally:
-    for p in procs:  # a hung worker must not leak past the test
-      if p.poll() is None:
-        p.kill()
-        p.wait()
-  for i, (p, out) in enumerate(zip(procs, outs)):
-    assert p.returncode == 0, f"proc {i} rc={p.returncode}\n{out[-3000:]}"
-    assert f"PROC {i} OK" in out, out[-3000:]
+  spawn_world2(tmp_path, _WORKER)

@@ -315,24 +315,125 @@ def test_tiered_resize_remaps_counts_and_warm_starts():
   assert total2 > 0
 
 
-def test_resize_refuses_partially_owned_store():
-  """A rank-owner-sharded store (multi-process pods) cannot feed the
-  in-memory resize — unowned images are not materialized; the refusal
-  names the restore path instead of crashing mid-regroup."""
-  mesh4 = create_mesh(4)
+def test_resize_partially_owned_store_via_spill(tmp_path):
+  """A rank-owner-sharded store (one multi-controller process's view)
+  resizes through the shared spill directory: each process publishes
+  the rank blocks only IT can read, unowned source ranks are
+  window-read back from the spill.  A single process plays both sides
+  here — it owns ranks (0, 1), the peer's images are pre-planted where
+  the spill protocol puts them — and the result must be bit-exact with
+  a fully-owned in-memory resize."""
+  mesh4, mesh2 = create_mesh(4), create_mesh(2)
   plan4, model4, tplan4, store4, b0, state4 = tiered_fresh(4, mesh4)
-  partial = HostTierStore(tplan4, owned_ranks=(0, 1))
   plan2, _ = tiered_build(2)
   tplan2 = TieringPlan(plan2, RULE, T_CFG)
-  with pytest.raises(NotImplementedError, match="owns ranks"):
+
+  # argument contract (configuration errors, not process-count refusals)
+  partial = HostTierStore(tplan4, owned_ranks=(0, 1))
+  with pytest.raises(ValueError, match="needs spill_dir"):
     elastic.elastic_resize(state4, plan4, plan2, RULE, old_store=partial,
                            new_store=HostTierStore(tplan2))
-  full4 = HostTierStore(tplan4)
-  full4.init_uniform(3)
-  with pytest.raises(NotImplementedError, match="owns ranks"):
-    elastic.elastic_resize(state4, plan4, plan2, RULE, old_store=full4,
-                           new_store=HostTierStore(tplan2,
-                                                   owned_ranks=(0,)))
+  with pytest.raises(ValueError, match="needs new_mesh"):
+    elastic.elastic_resize(state4, plan4, plan2, RULE, old_store=partial,
+                           new_store=HostTierStore(tplan2),
+                           spill_dir=str(tmp_path))
+
+  # reference: fully-owned resize (flushes store4's images on the way)
+  ref_store = HostTierStore(tplan2)
+  _, ref_state = elastic.elastic_resize(state4, plan4, plan2, RULE,
+                                        new_mesh=mesh2, old_store=store4,
+                                        new_store=ref_store)
+
+  # the partial view mirrors store4's owned images + the replicated
+  # bookkeeping every process carries (resident sets, counts)
+  for name in store4.images:
+    for rank in range(4):
+      if rank in (0, 1):
+        partial.set_image(name, rank, store4.images[name][rank])
+      partial.resident_map[name][rank][:] = store4.resident_map[name][rank]
+      partial.resident_grps[name][rank] = \
+          store4.resident_grps[name][rank].copy()
+      partial.counts[name][rank][:] = store4.counts[name][rank]
+
+  # plant the peer's spill exactly where its process would have put it
+  step_now = int(np.asarray(jax.device_get(state4["step"])))
+  sub = os.path.join(str(tmp_path), f"resize_{step_now:010d}_w4to2")
+  os.makedirs(sub, exist_ok=True)
+  for name in store4.images:
+    for rank in (2, 3):
+      np.save(os.path.join(sub, f"src_{name}_r{rank}.npy"),
+              store4.images[name][rank])
+
+  got_store = HostTierStore(tplan2)
+  _, got_state = elastic.elastic_resize(state4, plan4, plan2, RULE,
+                                        new_mesh=mesh2, old_store=partial,
+                                        new_store=got_store,
+                                        spill_dir=str(tmp_path))
+  for name in ref_store.images:
+    for rank in range(2):
+      np.testing.assert_array_equal(got_store.images[name][rank],
+                                    ref_store.images[name][rank])
+      np.testing.assert_array_equal(got_store.counts[name][rank],
+                                    ref_store.counts[name][rank])
+  for k in ref_state["fused"]:
+    np.testing.assert_array_equal(jax.device_get(got_state["fused"][k]),
+                                  jax.device_get(ref_state["fused"][k]))
+  # the spill sub-directory is cleaned up after the completion fence
+  assert not os.path.exists(sub)
+
+
+def test_membership_barrier(tmp_path):
+  """Survivors agree on one (step, world); a laggard times out with the
+  arrivals named; a disagreeing member fails LOUDLY before any rank
+  block regroups."""
+  import threading
+
+  pod = str(tmp_path)
+  res = {}
+
+  def post(mid):
+    res[mid] = elastic.membership_barrier(pod, 1, mid, 2, step=7, world=4)
+
+  t = threading.Thread(target=post, args=("m1",))
+  t.start()
+  got = elastic.membership_barrier(pod, 1, "m0", 2, step=7, world=4)
+  t.join()
+  assert got == (7, 4) and res["m1"] == (7, 4)
+
+  # epoch isolation: epoch 1's records cannot satisfy epoch 2's barrier
+  with pytest.raises(RuntimeError, match="only \\['m0'\\] of 2"):
+    elastic.membership_barrier(pod, 2, "m0", 2, step=8, world=4,
+                               timeout_s=0.3)
+
+  # a survivor that raced one step past the boundary is named
+  d = os.path.join(pod, "barriers", "000003")
+  os.makedirs(d)
+  with open(os.path.join(d, "m1.json"), "w") as f:
+    f.write('{"id": "m1", "step": 9, "world": 4}')
+  with pytest.raises(RuntimeError, match="DISAGREES.*m1"):
+    elastic.membership_barrier(pod, 3, "m0", 2, step=8, world=4)
+
+
+def test_resize_membership_barrier_wiring(tmp_path):
+  """ResilientTrainer.resize(pod_dir=...) posts to the membership
+  barrier before regrouping, defaults spill_dir under the pod, and a
+  half-specified barrier is a loud configuration error."""
+  reg = telemetry.MetricsRegistry()
+  mesh4, plan4, step4, state = sparse_world(4, guard=True)
+  tr = ResilientTrainer(step4, state, plan4, RULE,
+                        os.path.join(str(tmp_path), "ckpts"), mesh=mesh4,
+                        resume=False, telemetry=reg)
+  mesh2, plan2b, step2, _ = sparse_world(2, guard=True)
+  with pytest.raises(ValueError, match="membership-change barrier"):
+    tr.resize(plan2b, step2, new_mesh=mesh2, pod_dir=str(tmp_path))
+  # a single survivor (n_participants=1) barriers with itself and
+  # proceeds through the normal single-controller resize
+  got = tr.resize(plan2b, step2, new_mesh=mesh2, pod_dir=str(tmp_path),
+                  barrier_epoch=1, member_id="m0", n_participants=1)
+  assert got.world_size == 2
+  rec = os.path.join(str(tmp_path), "barriers", "000001", "m0.json")
+  assert os.path.exists(rec)
+  assert reg.counter("elastic/membership_barriers").value == 1
 
 
 def test_prefetcher_rebind():

@@ -1279,6 +1279,34 @@ def test_subscriber_retries_transient_reads(tmp_path):
   assert _t.get_registry().counter("retry/attempts").value - before == 2
 
 
+def test_heartbeat_reads_retry_then_degrade_to_expired(tmp_path):
+  """A heartbeat file unreadable after the bounded retries leaves that
+  member EXPIRED (``unreadable: True``, counted ``retry/attempts``) —
+  the publisher's lag quorum and the compactor's retention floor
+  degrade to the readable set instead of crashing on a flaky NFS
+  pubdir."""
+  from distributed_embeddings_tpu import telemetry as _t
+  from distributed_embeddings_tpu.streaming.publish import heartbeat_path
+
+  pub = os.path.join(str(tmp_path), "pub")
+  write_heartbeat(pub, "healthy", 5)
+  # a permanently unreadable record: a DIRECTORY where the json should
+  # be (open() raises IsADirectoryError — an OSError — every attempt)
+  os.makedirs(heartbeat_path(pub, "sick"))
+  before = _t.get_registry().counter("retry/attempts").value
+  live, expired = read_heartbeats(pub, ttl_s=30.0)
+  assert live["healthy"]["applied_seq"] == 5
+  assert "sick" not in live
+  assert expired["sick"]["unreadable"] is True
+  assert expired["sick"]["applied_seq"] == -1
+  # each unreadable file burned the policy's full retry budget
+  assert _t.get_registry().counter("retry/attempts").value - before \
+      == retry.DEFAULT_POLICY.retries
+  # no heartbeat dir at all stays a clean empty answer
+  assert read_heartbeats(os.path.join(str(tmp_path), "nope"),
+                         ttl_s=30.0) == ({}, {})
+
+
 def test_subscriber_exhausted_reads_surface_without_advancing(tmp_path):
   plan, rule, mesh, state, publisher, sub, rng, b = _device_run(
       tmp_path, 1, "f32")

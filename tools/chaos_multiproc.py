@@ -1,0 +1,822 @@
+"""Multi-controller chaos: REAL jax.distributed processes, dual kill.
+
+``tools/chaos_preempt.py`` proved in-place elasticity on one
+controller with a virtual mesh. This driver removes the last
+simulation: the pod is TWO real ``jax.distributed`` processes (4
+virtual CPU devices each, an 8-device global mesh over gloo
+collectives), the fleet owners are real processes behind TCP sockets,
+and the chaos kills BOTH kinds in one run:
+
+1. **reference**: an unkilled 2-process pod trains the fixed stream at
+   world 8 to completion (``--static``: membership ignored).
+2. **pod cycle** (trainer-process kill): both controllers register
+   pid leases; 6 lightweight member subprocesses fill the pod to 8.
+   The driver SIGKILLs a member — every controller agrees on the
+   shrink target through ``elastic.agreed_target_world`` (a broadcast,
+   so both compare against the SAME number), posts its
+   ``(step, world)`` to the **membership-change barrier**, and
+   ``ResilientTrainer.resize`` regroups 8 -> 4 through the shared
+   spill directory (each process publishes only the rank blocks it
+   alone can address). A replacement member regrows the pod to 8.
+   After two post-regrow barrier-protocol checkpoints land, the driver
+   SIGKILLs trainer process 1 MID-STEP and process 0 moments later
+   (stuck in the orphaned collective), then tears the newest
+   checkpoint's rank-0 fused file in half. The relaunch must agree —
+   via the restore-choice broadcast — on the newest VALID checkpoint
+   on both controllers, resume, and finish the stream. The verdict
+   checks: killed rcs are SIGKILL, relaunch rcs are 0, the torn dir
+   was NOT the one resumed from, the stitched trajectory matches the
+   reference (f32 bit-exact before the first resize, within the
+   fp-associativity bound after), ``consumed == steps + skipped``
+   holds across process lifetimes with every injected NaN batch
+   skipped exactly once, and both membership barriers were counted.
+3. **fleet cycle** (owner-process kill): a fully 2-way-replicated
+   fleet of TWO owner subprocesses behind ``SocketTransport`` serves
+   an open loop; the driver SIGKILLs owner 0 mid-gather. Acceptance:
+   zero wrong answers (every completed request bitwise-matches the
+   single-process engine), zero lost requests, a counted failover.
+   Then the fleet scales DOWN under load: ``router.apply_fleet``
+   drains the departing owner before the swap and the post-transition
+   answers still bitwise-match.
+
+``--smoke`` is the make-verify tier (fewer steps/requests, same
+assertions). Verdicts via ``telemetry.emit_verdict`` (exit 0/1,
+$DE_TPU_VERDICT_LOG).
+
+Usage: python tools/chaos_multiproc.py [--smoke]
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+if __name__ == "__main__":
+  # the env must be set BEFORE jax imports, and differs per mode: a pod
+  # controller owns 4 of the 8 global devices; the driver and the fleet
+  # owners run their own single-process 8-device world; a member is
+  # jax-free (a pid lease needs no devices).
+  if "--pod" in sys.argv:
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ.pop("JAX_PLATFORMS", None)
+  elif "--member" not in sys.argv:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+      os.environ["XLA_FLAGS"] = (
+          flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+  sys.path.insert(0, _REPO)
+
+VOCAB = [500, 300, 150, 20]
+GLOBAL_BATCH = 32  # divisible by every world size the cycles use
+POD_WORLDS = (4, 8)  # both split evenly across the 2 controllers
+N_LIGHT_MEMBERS = 6  # 2 controllers + 6 = a full world-8 pod
+
+FLEET = dict(sizes=[1536, 768], widths=[16, 16], hotness=[2, 1],
+             req_rows=4, max_batch=32)
+
+
+def _batches(n, seed=7, n_unique=6):
+  """World-independent cycled batch stream (chaos_preempt's recipe)."""
+  import numpy as np
+  rng = np.random.default_rng(seed)
+  out = []
+  for _ in range(n_unique):
+    numerical = rng.standard_normal((GLOBAL_BATCH, 13)).astype(np.float32)
+    cats = [rng.integers(0, v, GLOBAL_BATCH).astype(np.int32)
+            for v in VOCAB]
+    labels = (numerical[:, 0] > 0).astype(np.float32)
+    out.append((numerical, cats, labels))
+  return [out[i % n_unique] for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# member: a pod worker's liveness lease (NO jax import — a member is a
+# process whose pid exists, nothing more; the controllers own the mesh)
+# ---------------------------------------------------------------------------
+
+
+def run_member(pod_dir: str, member_id: str) -> None:
+  d = os.path.join(pod_dir, "members")
+  os.makedirs(d, exist_ok=True)
+  # lease format = elastic.register_member's, incl. the pid-incarnation
+  # start time (elastic.proc_start_ticks, inlined to stay jax-free)
+  try:
+    with open(f"/proc/{os.getpid()}/stat", "rb") as f:
+      stat = f.read()
+    start = int(stat[stat.rindex(b")") + 1:].split()[19])
+  except (OSError, ValueError, IndexError):
+    start = None
+  path = os.path.join(d, f"{member_id}.json")
+  tmp = path + ".tmp"
+  with open(tmp, "w") as f:
+    json.dump({"id": member_id, "pid": os.getpid(), "start": start}, f)
+    f.flush()
+    os.fsync(f.fileno())
+  os.replace(tmp, path)
+  while True:  # live until killed (SIGKILL: the lease pid goes dead)
+    time.sleep(1.0)
+
+
+# ---------------------------------------------------------------------------
+# pod: ONE controller of the 2-process trainer (--pod --proc-id {0,1})
+# ---------------------------------------------------------------------------
+
+
+def _put_global(x, mesh, spec):
+  """Place a host array as a (possibly non-addressable) global array.
+
+  ``jax.device_put`` onto a multi-process sharding runs an
+  ``assert_equal`` broadcast per array; interleaved with sub-mesh step
+  collectives those broadcasts wedge gloo. The callback constructor
+  places purely locally — no cross-process traffic at all."""
+  import jax
+  import numpy as np
+  from jax.sharding import NamedSharding
+  x = np.asarray(jax.device_get(x))
+  return jax.make_array_from_callback(
+      x.shape, NamedSharding(mesh, spec), lambda idx, x=x: x[idx])
+
+
+def _put_tree(tree, mesh, axis_name="mp"):
+  import jax
+  from distributed_embeddings_tpu.layers import hybrid_partition_specs
+  specs = hybrid_partition_specs(tree, axis_name)
+  return jax.tree_util.tree_map(
+      lambda x, s: _put_global(x, mesh, s), tree, specs)
+
+
+def _put_batch(batch, mesh, axis_name="mp"):
+  import jax
+  import numpy as np
+  from jax.sharding import PartitionSpec as P
+
+  def put(x):
+    x = np.asarray(x)
+    spec = P(axis_name) if x.ndim else P()
+    return _put_global(x, mesh, spec)
+
+  return jax.tree_util.tree_map(put, batch)
+
+
+def _build_world(world):
+  """Model/plan/step/state for one world size on the GLOBAL mesh.
+
+  All-sparse (``dense_row_threshold=0``): the multi-controller resize
+  requires dense/optimizer leaves replicated, and a dense-class
+  embedding table would be mp-sharded across processes."""
+  import jax
+  import numpy as np
+  import optax
+
+  from jax.sharding import Mesh
+  from distributed_embeddings_tpu.layers.planner import DistEmbeddingStrategy
+  from distributed_embeddings_tpu.models import DLRM, bce_loss
+  from distributed_embeddings_tpu.ops.packed_table import sparse_rule
+  from distributed_embeddings_tpu.parallel.mesh import balanced_devices
+  from distributed_embeddings_tpu.training import (
+      init_sparse_state,
+      make_sparse_train_step,
+  )
+
+  mesh = Mesh(np.array(balanced_devices(world)), ("mp",))
+  model = DLRM(vocab_sizes=VOCAB, embedding_dim=16, bottom_mlp=(32, 16),
+               top_mlp=(32, 1), world_size=world, dense_row_threshold=0)
+  plan = DistEmbeddingStrategy(
+      [dict(input_dim=v, output_dim=16,
+            initializer={"name": "uniform", "scale": 0.05}) for v in VOCAB],
+      world, "basic", dense_row_threshold=0)
+  rule = sparse_rule("adagrad", 0.05)
+  opt = optax.adagrad(0.05)
+  batches = _batches(4)
+  numerical, cats, _ = batches[0]
+  params = model.init(jax.random.PRNGKey(0), numerical,
+                      [np.asarray(c) for c in cats])["params"]
+  state = _put_tree(init_sparse_state(plan, params, rule, opt), mesh)
+  step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
+                                state, batches[0], donate=False, guard=True)
+  return mesh, plan, rule, step, state
+
+
+def run_pod(args) -> int:
+  """One controller lifetime: join the 2-process world, train the fixed
+  stream, resizing through the membership barrier whenever the agreed
+  target world changes. Process 0 appends ``{"i", "loss"}`` JSONL per
+  step to ``--log`` plus resize events and the final summary."""
+  import jax
+  jax.config.update("jax_platforms", "cpu")
+  # real cross-process collectives on the CPU backend run over gloo
+  jax.config.update("jax_cpu_collectives_implementation", "gloo")
+  jax.distributed.initialize(
+      coordinator_address=f"127.0.0.1:{args.port}",
+      num_processes=2, process_id=args.proc_id)
+  assert jax.process_count() == 2 and len(jax.devices()) == 8
+
+  from distributed_embeddings_tpu import telemetry
+  from distributed_embeddings_tpu.resilience import elastic, faultinject
+  from distributed_embeddings_tpu.resilience.trainer import ResilientTrainer
+
+  p0 = args.proc_id == 0
+  me = f"p{args.proc_id}"
+  steps = args.steps
+  if not args.static:
+    # lease FIRST: the build/restore below takes tens of seconds, and
+    # the other controller's first membership scan must not see this
+    # process's stale (relaunch) or missing (first launch) lease
+    elastic.register_member(args.pod_dir, me)
+  mesh, plan, rule, step, state = _build_world(8)
+  nan_steps = set(range(args.nan_every - 1, steps, args.nan_every)) \
+      if args.nan_every else set()
+  stream = list(faultinject.nan_batches(_batches(steps),
+                                        at_steps=nan_steps))
+
+  root = os.path.join(args.pod_dir, "ckpts")
+  t = ResilientTrainer(step, state, plan, rule, root, mesh=mesh,
+                       snapshot_every=0, resume=True)
+  if not args.static:
+    sup = elastic.PreemptionSupervisor(args.pod_dir,
+                                       allowed_worlds=POD_WORLDS)
+  reg = telemetry.get_registry()
+
+  cur = t.plan.world_size
+  epoch = args.epoch_base
+  events = []
+  last_snap = -1
+  log = open(args.log, "a") if p0 else None
+  for i in range(t.consumed, steps):
+    if not args.static:
+      # ONE collectively-agreed target: p0's lease scan is broadcast,
+      # so both controllers resize (or don't) at the same step boundary
+      target = elastic.agreed_target_world(sup)
+      if target != cur:
+        new_mesh, new_plan, _rule, new_step, _s0 = _build_world(target)
+        epoch += 1
+        t.resize(new_plan, step_fn=new_step, new_mesh=new_mesh,
+                 pod_dir=args.pod_dir, barrier_epoch=epoch,
+                 member_id=me, n_participants=2)
+        events.append({"event": "resize", "i": i, "from": cur,
+                       "to": target})
+        if p0:
+          with open(args.log + ".events", "a") as ev:
+            ev.write(json.dumps(events[-1]) + "\n")
+        cur = target
+    loss = t.step(*_put_batch(stream[i], t.mesh))
+    if p0:
+      log.write(json.dumps({"i": i, "loss": loss}) + "\n")
+      log.flush()
+    # barrier-protocol checkpoints, only at world 8 so the relaunch
+    # (which restores before it can resize) rebuilds the same world
+    if args.snapshot_every and cur == 8 and t.step_count \
+        and t.step_count % args.snapshot_every == 0 \
+        and t.step_count != last_snap:
+      t.snapshot()
+      last_snap = t.step_count
+    if args.step_delay:
+      time.sleep(args.step_delay)  # pace the run so chaos lands mid-run
+  if p0:
+    log.close()
+    summary = {
+        "world": cur,
+        "steps": t.step_count,
+        "consumed": t.consumed,
+        "skipped": t.skipped_steps,
+        "expected_skips": len(nan_steps),
+        "invariant_ok": t.consumed == t.step_count + t.skipped_steps,
+        "resumed_from": t.resumed_from,
+        "resizes": reg.counter("elastic/resizes").value,
+        "membership_barriers":
+            reg.counter("elastic/membership_barriers").value,
+        "events": events,
+    }
+    with open(args.log + ".summary", "w") as f:
+      json.dump(summary, f)
+  print("POD", args.proc_id, "OK")
+  return 0
+
+
+# ---------------------------------------------------------------------------
+# owner: one FleetOwner process behind a TCP server (--owner)
+# ---------------------------------------------------------------------------
+
+
+def _fleet_plan():
+  from distributed_embeddings_tpu.layers.embedding import TableConfig
+  from distributed_embeddings_tpu.layers.planner import DistEmbeddingStrategy
+  tables = [TableConfig(s, w, combiner="sum")
+            for s, w in zip(FLEET["sizes"], FLEET["widths"])]
+  return DistEmbeddingStrategy(tables, 2, "memory_balanced",
+                               dense_row_threshold=0,
+                               input_hotness=FLEET["hotness"])
+
+
+def run_owner(args) -> int:
+  from distributed_embeddings_tpu import telemetry
+  from distributed_embeddings_tpu.fleet import FleetOwner, SocketOwnerServer
+
+  plan = _fleet_plan()
+  ranks = tuple(int(r) for r in args.ranks.split(","))
+  owner = FleetOwner(args.path, plan, ranks, owner_id=args.owner_id)
+  server = SocketOwnerServer(owner)
+  telemetry.atomic_write_text(args.portfile,
+                              f"{server.host} {server.port}")
+  stop = threading.Event()
+  signal.signal(signal.SIGTERM, lambda *_: stop.set())
+  while not stop.is_set():
+    stop.wait(0.2)
+  server.close()
+  return 0
+
+
+# ---------------------------------------------------------------------------
+# driver helpers
+# ---------------------------------------------------------------------------
+
+
+def _spawn(mode, *args, wait=True, env=None, outfile=None):
+  cmd = [sys.executable, os.path.abspath(__file__), mode, *args]
+  if env is None:
+    env = dict(os.environ)
+  out = open(outfile, "a") if outfile else None
+  try:
+    if wait:
+      return subprocess.run(cmd, cwd=_REPO, env=env, stdout=out,
+                            stderr=subprocess.STDOUT if out else None
+                            ).returncode
+    return subprocess.Popen(cmd, cwd=_REPO, env=env, stdout=out,
+                            stderr=subprocess.STDOUT if out else None)
+  finally:
+    if out:
+      out.close()
+
+
+def _pod_env():
+  """The controllers set their own XLA flags in --pod mode; scrub the
+  driver's 8-device single-process env so it cannot leak through."""
+  env = {k: v for k, v in os.environ.items()
+         if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "PYTHONPATH")}
+  env["PYTHONPATH"] = _REPO
+  return env
+
+
+def _free_port() -> int:
+  import socket
+  with socket.socket() as s:
+    s.bind(("127.0.0.1", 0))
+    return s.getsockname()[1]
+
+
+def _spawn_pod(pod, log, port, steps, *, static=False, epoch_base=0,
+               snapshot_every=0, step_delay=0.2, tag="a"):
+  """Both controllers of one pod lifetime; stdout kept for debugging."""
+  return [_spawn("--pod", "--pod-dir", pod, "--log", log,
+                 "--port", str(port), "--proc-id", str(i),
+                 "--steps", str(steps),
+                 "--epoch-base", str(epoch_base),
+                 "--snapshot-every", str(snapshot_every),
+                 "--step-delay", str(step_delay),
+                 *(["--static"] if static else []),
+                 wait=False, env=_pod_env(),
+                 outfile=os.path.join(pod, f"proc{i}.{tag}.out"))
+          for i in range(2)]
+
+
+def _read_log(log) -> list:
+  out = []
+  if os.path.exists(log):
+    with open(log) as f:
+      for line in f:
+        rec = json.loads(line)
+        out.append((rec["i"], rec["loss"]))
+  return out
+
+
+def _read_summary(log):
+  p = log + ".summary"
+  if not os.path.exists(p):
+    return None
+  with open(p) as f:
+    return json.load(f)
+
+
+def _stitch(records) -> list:
+  merged = {}
+  for i, loss in records:
+    merged[i] = loss  # later lifetime wins (the relaunch overlap)
+  return [merged[i] for i in sorted(merged)]
+
+
+def _traj_close(a, b, resized_at, rtol=5e-4, atol=1e-5) -> bool:
+  """Exact before the first resize, fp-associativity bound after (the
+  resized mesh reduces grads/losses in a different order; the resharded
+  state itself is bit-exact — tests/test_multiprocess_pod.py)."""
+  import numpy as np
+  if len(a) != len(b):
+    return False
+  for i, (x, y) in enumerate(zip(a, b)):
+    if np.isnan(x) or np.isnan(y):
+      if not (np.isnan(x) and np.isnan(y)):
+        return False
+    elif i < resized_at:
+      if x != y:
+        return False
+    elif not np.isclose(x, y, rtol=rtol, atol=atol):
+      return False
+  return True
+
+
+def _events_of(log) -> list:
+  path = log + ".events"
+  if not os.path.exists(path):
+    return []
+  with open(path) as f:
+    return [json.loads(line) for line in f]
+
+
+def _wait_for(cond, procs=(), timeout=300.0) -> bool:
+  """Poll ``cond()`` until true; gives up at ``timeout`` or (after one
+  final check) when any watched process already exited."""
+  deadline = time.monotonic() + timeout
+  while time.monotonic() < deadline:
+    if cond():
+      return True
+    if any(p.poll() is not None for p in procs):
+      return bool(cond())
+    time.sleep(0.05)
+  return bool(cond())
+
+
+def _wait_lines(log, n, procs=(), timeout=300.0) -> int:
+  _wait_for(lambda: len(_read_log(log)) >= n, procs=procs,
+            timeout=timeout)
+  return len(_read_log(log))
+
+
+def _ckpt_names(root):
+  if not os.path.isdir(root):
+    return set()
+  return {d for d in os.listdir(root)
+          if d.startswith("ckpt_") and not d.endswith(".tmp")}
+
+
+def _kill_all(procs):
+  for p in procs:
+    if p.poll() is None:
+      p.kill()
+  for p in procs:
+    if p.poll() is None:
+      p.wait()
+
+
+# ---------------------------------------------------------------------------
+# cycles
+# ---------------------------------------------------------------------------
+
+
+def run_reference(work, steps, result):
+  pod = os.path.join(work, "ref")
+  os.makedirs(pod, exist_ok=True)
+  log = os.path.join(pod, "losses.jsonl")
+  procs = _spawn_pod(pod, log, _free_port(), steps, static=True,
+                     step_delay=0.0)
+  rcs = []
+  try:
+    for p in procs:
+      rcs.append(p.wait(timeout=600))
+  finally:
+    _kill_all(procs)
+  summary = _read_summary(log)
+  ref = _stitch(_read_log(log))
+  result["cycles"]["ref"] = {
+      "rcs": rcs, "summary": summary,
+      "ok": rcs == [0, 0] and len(ref) == steps
+            and bool(summary and summary["invariant_ok"])}
+  return ref
+
+
+def run_pod_cycle(work, steps, ref, result):
+  """Shrink/regrow through the membership barrier, then the dual kill:
+  both trainer processes SIGKILLed, the newest checkpoint torn, the
+  relaunch agreeing on the newest VALID one."""
+  pod = os.path.join(work, "pod")
+  os.makedirs(pod, exist_ok=True)
+  log = os.path.join(pod, "losses.jsonl")
+  root = os.path.join(pod, "ckpts")
+  members_dir = os.path.join(pod, "members")
+
+  members = [_spawn("--member", "--pod-dir", pod, "--id", f"m{k}",
+                    wait=False) for k in range(N_LIGHT_MEMBERS)]
+  killed_rcs = []
+  procs = []
+  try:
+    # all 6 light leases must exist before the controllers first scan
+    # membership, or the pod would open by shrinking to 4
+    _wait_for(lambda: os.path.isdir(members_dir) and sum(
+        n.startswith("m") and n.endswith(".json")
+        for n in os.listdir(members_dir)) >= N_LIGHT_MEMBERS,
+        procs=members, timeout=60)
+    port = _free_port()
+    procs = _spawn_pod(pod, log, port, steps, snapshot_every=3)
+    _wait_lines(log, 3, procs=procs)
+
+    # ---- preemption: one member dies -> barrier-coordinated 8 -> 4 ----
+    victim = members[0]
+    victim.send_signal(signal.SIGKILL)
+    killed_rcs.append(victim.wait())  # reap: the lease pid goes dead
+    _wait_for(lambda: any(e["to"] == 4 for e in _events_of(log)),
+              procs=procs)
+    _wait_lines(log, len(_read_log(log)) + 2, procs=procs)
+
+    # ---- replacement joins -> regrow 4 -> 8 ---------------------------
+    members.append(_spawn("--member", "--pod-dir", pod, "--id", "m_r0",
+                          wait=False))
+    _wait_for(lambda: _events_of(log)
+              and _events_of(log)[-1]["to"] == 8, procs=procs)
+    at_regrow = _ckpt_names(root)
+    # two fresh post-regrow checkpoints: the newest will be torn, the
+    # one beneath it must already carry the post-resize counters
+    _wait_for(lambda: len(_ckpt_names(root) - at_regrow) >= 2,
+              procs=procs)
+    fresh = sorted(_ckpt_names(root) - at_regrow,
+                   key=lambda d: int(d.split("_")[1]))
+    dual_kill_armed = len(fresh) >= 2 and all(
+        p.poll() is None for p in procs)
+
+    # ---- the dual kill: trainer 1 mid-step, trainer 0 mid-collective --
+    procs[1].send_signal(signal.SIGKILL)
+    rc1 = procs[1].wait()
+    time.sleep(0.7)
+    procs[0].send_signal(signal.SIGKILL)
+    rc0 = procs[0].wait()
+
+    # tear the newest checkpoint: truncate its rank-0 fused file so the
+    # relaunch must broadcast-agree on the one beneath it
+    names = sorted(_ckpt_names(root), key=lambda d: int(d.split("_")[1]))
+    torn_dir = names[-1] if names else None
+    if torn_dir:
+      d = os.path.join(root, torn_dir)
+      fused = sorted(n for n in os.listdir(d)
+                     if n.startswith("fused_") and n.endswith("_r0.npy"))
+      tf = os.path.join(d, fused[0])
+      with open(tf, "r+b") as f:
+        f.truncate(os.path.getsize(tf) // 2)
+
+    # ---- relaunch: both controllers restore the newest VALID ----------
+    procs = _spawn_pod(pod, log, _free_port(), steps, epoch_base=100,
+                       snapshot_every=3, step_delay=0.0, tag="b")
+    relaunch_rcs = [p.wait(timeout=600) for p in procs]
+  finally:
+    _kill_all(members)
+    _kill_all(procs)
+
+  summary = _read_summary(log)
+  events = _events_of(log)
+  traj = _stitch(_read_log(log))
+  resized_at = events[0]["i"] if events else steps
+  resumed = (summary or {}).get("resumed_from") or ""
+  result["cycles"]["pod"] = {
+      "member_killed_rcs": killed_rcs,
+      "trainer_killed_rcs": [rc0, rc1],
+      "relaunch_rcs": relaunch_rcs,
+      "dual_kill_armed": dual_kill_armed,
+      "events": events,
+      "torn_dir": torn_dir,
+      "resumed_from": resumed,
+      "summary": summary,
+      "trajectory_matches": _traj_close(traj, ref, resized_at),
+      "ok": dual_kill_armed
+            and all(k == -signal.SIGKILL for k in killed_rcs)
+            and rc1 == -signal.SIGKILL and rc0 != 0
+            and relaunch_rcs == [0, 0]
+            and [e["to"] for e in events] == [4, 8]
+            and bool(torn_dir) and bool(resumed)
+            and os.path.basename(resumed) != torn_dir
+            and len(traj) == steps
+            and _traj_close(traj, ref, resized_at)
+            and bool(summary and summary["invariant_ok"]
+                     and summary["skipped"] == summary["expected_skips"]
+                     and summary["resizes"] >= 2
+                     and summary["membership_barriers"] >= 2)}
+
+
+def run_fleet_cycle(work, n_requests, result):
+  """Owner-process SIGKILL mid-gather over sockets, then a drained
+  scale-down under load."""
+  import numpy as np
+
+  from distributed_embeddings_tpu import telemetry
+  from distributed_embeddings_tpu.fleet import (
+      FleetConfig, FleetPlan, FleetRouter, SocketTransport)
+  from distributed_embeddings_tpu.parallel import create_mesh
+  from distributed_embeddings_tpu.parallel.lookup_engine import PAD_ID
+  from distributed_embeddings_tpu.serving import (
+      MicroBatcher, Rejected, ServeEngine)
+  from distributed_embeddings_tpu.serving.export import (
+      export as serve_export, load as serve_load)
+  from distributed_embeddings_tpu.layers.dist_model_parallel import (
+      set_weights)
+  from distributed_embeddings_tpu.ops.packed_table import sparse_rule
+  from distributed_embeddings_tpu.training import init_sparse_state
+  import jax.numpy as jnp
+  import optax
+
+  class ActsModel:
+    def apply(self, variables, numerical, cats, emb_acts=None):
+      del variables, numerical, cats
+      return jnp.concatenate(list(emb_acts), axis=-1)
+
+  rng = np.random.default_rng(7)
+  plan = _fleet_plan()
+  weights = [(rng.standard_normal((s, w)) / np.sqrt(w)).astype(np.float32)
+             for s, w in zip(FLEET["sizes"], FLEET["widths"])]
+  params = {"embeddings": {k: jnp.asarray(v)
+                           for k, v in set_weights(plan, weights).items()}}
+  rule = sparse_rule("adagrad", 0.05)
+  mesh = create_mesh(2)
+  from distributed_embeddings_tpu.training import shard_params
+  state = shard_params(init_sparse_state(plan, params, rule,
+                                         optax.sgd(0.01)), mesh)
+  path = os.path.join(work, "fleet_art")
+  serve_export(path, plan, rule, state, quantize="f32")
+  single = ServeEngine(ActsModel(), plan,
+                       serve_load(path, plan, mesh=mesh), mesh=mesh)
+
+  def mkreq(n):
+    ids = []
+    for s, h in zip(FLEET["sizes"], FLEET["hotness"]):
+      x = rng.integers(0, s, (n, h)).astype(np.int32)
+      x[rng.random(x.shape) < 0.2] = PAD_ID
+      ids.append(x)
+    return rng.standard_normal((n, 4)).astype(np.float32), ids
+
+  reqs = [mkreq(FLEET["req_rows"]) for _ in range(8)]
+  wants = [np.asarray(single.predict(*r)) for r in reqs]
+
+  def spawn_owner(owner_id, ranks, portfile):
+    pf = os.path.join(work, portfile)
+    p = _spawn("--owner", "--owner-id", str(owner_id), "--ranks",
+               ",".join(str(r) for r in ranks), "--path", path,
+               "--portfile", pf, wait=False,
+               outfile=os.path.join(work, portfile + ".out"))
+    deadline = time.monotonic() + 180.0
+    while not os.path.isfile(pf):
+      if p.poll() is not None:
+        raise RuntimeError(f"owner {owner_id} exited rc={p.returncode} "
+                           "before serving")
+      if time.monotonic() > deadline:
+        raise TimeoutError(f"owner {owner_id} never published its port")
+      time.sleep(0.1)
+    with open(pf) as f:
+      host, port = f.read().split()
+    return p, (host, int(port))
+
+  fplan = FleetPlan.replicated(2, 2, replicas=2, hot_fraction=1.0)
+  owner_procs = []
+  p0, a0 = spawn_owner(0, fplan.owned_ranks(0), "owner0.port")
+  owner_procs.append(p0)
+  p1, a1 = spawn_owner(1, fplan.owned_ranks(1), "owner1.port")
+  owner_procs.append(p1)
+  cfg_f = FleetConfig(cache_fraction=0.05, staging_grps=256,
+                      shard_min_phys_rows=16, revive_after_s=3600.0)
+  rreg = telemetry.MetricsRegistry()
+  router = FleetRouter(ActsModel(), plan, path, fplan,
+                       SocketTransport({0: a0, 1: a1}), mesh=mesh,
+                       config=cfg_f, telemetry=rreg)
+  mb = MicroBatcher(router.dispatch, max_batch=FLEET["max_batch"],
+                    max_delay_s=0.002)
+  try:
+    mb.submit(*reqs[0]).result(timeout=300)  # compile off the clock
+
+    # ---- owner-process SIGKILL mid-gather over the socket transport --
+    killer = threading.Timer(0.25, owner_procs[0].send_signal,
+                             args=(signal.SIGKILL,))
+    killer.start()
+    futs, rejected = [], 0
+    t = time.perf_counter()
+    for i in range(n_requests):
+      t += float(rng.exponential(1.0 / 150.0))
+      now = time.perf_counter()
+      if t > now:
+        time.sleep(t - now)
+      try:
+        futs.append((i % len(reqs), mb.submit(*reqs[i % len(reqs)])))
+      except Rejected:
+        rejected += 1
+    out = [(ri, f.result(timeout=300)) for ri, f in futs]
+    killer.join()
+    killed_rc = owner_procs[0].wait(timeout=30)
+    wrong = sum(0 if np.array_equal(res, wants[ri]) else 1
+                for ri, res in out)
+    failovers = rreg.counter("fleet/failovers").value
+
+    # ---- scale-down under load: drain, swap, still bit-exact ---------
+    p2, a2 = spawn_owner(0, (0, 1), "owner2.port")
+    owner_procs.append(p2)
+    stop_pump = threading.Event()
+
+    def pump():
+      j = 0
+      while not stop_pump.is_set():
+        try:
+          mb.submit(*reqs[j % len(reqs)]).result(timeout=60)
+        except Exception:
+          pass
+        j += 1
+
+    pumper = threading.Thread(target=pump, daemon=True)
+    pumper.start()
+    time.sleep(0.2)
+    router.apply_fleet(FleetPlan.balanced(2, 1),
+                       transport=SocketTransport({0: a2}))
+    drained = rreg.counter("fleet/drained_gathers").value
+    stop_pump.set()
+    pumper.join(timeout=60)
+    post_wrong = sum(
+        0 if np.array_equal(np.asarray(router.predict(*reqs[k])),
+                            wants[k]) else 1
+        for k in range(len(reqs)))
+  finally:
+    mb.close()
+    router.close()
+    for p in owner_procs:
+      if p.poll() is None:
+        p.terminate()
+    for p in owner_procs:
+      if p.poll() is None:
+        try:
+          p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+          p.kill()
+          p.wait()
+
+  result["cycles"]["fleet"] = {
+      "requests": n_requests, "wrong": wrong,
+      "failed": n_requests - len(out) - rejected, "rejected": rejected,
+      "failovers": failovers, "owner_killed_rc": killed_rc,
+      "drained_gathers": drained, "post_scale_down_wrong": post_wrong,
+      "ok": wrong == 0 and len(out) + rejected == n_requests
+            and failovers >= 1 and killed_rc == -signal.SIGKILL
+            and post_wrong == 0}
+
+
+def run_chaos_multiproc(steps=26, n_requests=80, verbose=True) -> dict:
+  work = tempfile.mkdtemp(prefix="chaos_multiproc_")
+  result = {"steps": steps, "work": work, "cycles": {}}
+  ref = run_reference(work, steps, result)
+  if result["cycles"]["ref"]["ok"]:
+    run_pod_cycle(work, steps, ref, result)
+  else:
+    result["cycles"]["pod"] = {"ok": False, "skipped": "reference failed"}
+  run_fleet_cycle(work, n_requests, result)
+  result["ok"] = all(c["ok"] for c in result["cycles"].values())
+  if verbose:
+    print(json.dumps(result, indent=1))
+  return result
+
+
+def main(argv=None) -> int:
+  p = argparse.ArgumentParser(description=__doc__)
+  p.add_argument("--pod", action="store_true")
+  p.add_argument("--member", action="store_true")
+  p.add_argument("--owner", action="store_true")
+  p.add_argument("--pod-dir", default="")
+  p.add_argument("--id", default="")
+  p.add_argument("--log", default="")
+  p.add_argument("--port", default="")
+  p.add_argument("--proc-id", type=int, default=0)
+  p.add_argument("--steps", type=int, default=26)
+  p.add_argument("--static", action="store_true")
+  p.add_argument("--step-delay", type=float, default=0.2)
+  p.add_argument("--nan-every", type=int, default=6)
+  p.add_argument("--epoch-base", type=int, default=0)
+  p.add_argument("--snapshot-every", type=int, default=0)
+  p.add_argument("--owner-id", type=int, default=0)
+  p.add_argument("--ranks", default="")
+  p.add_argument("--path", default="")
+  p.add_argument("--portfile", default="")
+  p.add_argument("--smoke", action="store_true")
+  args = p.parse_args(argv)
+  if args.member:
+    run_member(args.pod_dir, args.id)
+    return 0
+  if args.pod:
+    return run_pod(args)
+  if args.owner:
+    return run_owner(args)
+  from distributed_embeddings_tpu.telemetry import emit_verdict
+
+  steps = 22 if args.smoke else args.steps
+  n_requests = 60 if args.smoke else 120
+  res = run_chaos_multiproc(steps=steps, n_requests=n_requests,
+                            verbose=False)
+  return emit_verdict("chaos-multiproc", res)
+
+
+if __name__ == "__main__":
+  sys.exit(main(sys.argv[1:]))
