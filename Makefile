@@ -10,7 +10,7 @@ SHELL := /bin/bash  # verify uses pipefail/PIPESTATUS
 	chaos-stream stream-smoke serve-bench \
 	serve-smoke vocab-bench vocab-smoke obs-bench obs-smoke fresh-bench \
 	fresh-smoke fleet-bench fleet-smoke trace-bench trace-smoke \
-	control-bench control-smoke clean
+	control-bench control-smoke overlap-bench overlap-smoke clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -150,11 +150,30 @@ control-smoke:
 	PYTHONPATH=$(CURDIR):$$PYTHONPATH timeout -k 10 300 \
 	  $(PY) tools/profile_control.py --smoke
 
+# host-device overlap budget: the same tiered power-law workload run
+# serial (overlap_host=False) vs overlapped (batch k+1's classify/gather
+# on the HostWorker while step k runs on device) — acceptance: >= 25%
+# step-wall reduction with >= 70% of the host pipeline hidden, the
+# overlapped wall within 1.15x of max(host, device), the two loss
+# streams BIT-IDENTICAL, and the trace showing worker spans strictly
+# inside device windows (tools/profile_overlap.py; budgets in
+# docs/BENCHMARKS.md round 22)
+overlap-bench:
+	PYTHONPATH=$(CURDIR):$$PYTHONPATH $(PY) tools/profile_overlap.py
+
+# the make-verify tier of the overlap bench: tiny world, parity + the
+# worker-span structural assertion only (CPU step times at toy scale are
+# noise), timeout-guarded like the other smoke tiers
+overlap-smoke:
+	PYTHONPATH=$(CURDIR):$$PYTHONPATH timeout -k 10 300 \
+	  $(PY) tools/profile_overlap.py --smoke
+
 # the tier-1 gate, exactly as ROADMAP.md specifies it (CPU mesh, no slow
 # tests, collection errors surfaced but not fatal to the log); lint runs
 # first so invariant violations fail fast, then the smoke tiers
 verify: lint serve-smoke vocab-smoke obs-smoke fresh-smoke stream-smoke \
-	fleet-smoke trace-smoke preempt-smoke multiproc-smoke control-smoke
+	fleet-smoke trace-smoke preempt-smoke multiproc-smoke control-smoke \
+	overlap-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -84,6 +84,14 @@ GL118       error      every multi-controller refusal branch
                        :data:`REFUSAL_INVENTORY` — closing a refusal
                        without pruning the inventory, or adding one
                        without inventorying it, fails the lint
+GL119       error      no raw ``threading.Thread`` / executor
+                       construction in the step-adjacent training
+                       packages (``tiering/``, ``dynvocab/``,
+                       ``resilience/``, ``streaming/``, ``training.py``)
+                       outside ``pipeline.py`` — ``HostWorker`` is the
+                       one sanctioned host/device overlap surface, so
+                       overlap stays bit-exact, joined before
+                       accounting, and on one trace
 ==========  =========  =====================================================
 
 Trace-reachable scope (GL101/GL102) is structural: any function nested —
@@ -812,6 +820,87 @@ def _check_raw_timing(mod: ParsedModule) -> List[Finding]:
           "(or telemetry.timed(...) for histogram aggregation) so it "
           "lands on the shared trace and registry; suppress with the "
           "reason stated if this is deadline arithmetic, not timing."))
+  return out
+
+
+# GL119 guards: thread/executor CONSTRUCTION (not use) in the training
+# packages that sit next to the step loop. Scope mirrors where a stray
+# thread can race device dispatch, write-back, guard rollback, or a
+# snapshot; serving/fleet/control run their own audited thread pools.
+_GL119_PKGS = ("tiering", "dynvocab", "resilience", "streaming")
+_GL119_EXECUTORS = frozenset({"ThreadPoolExecutor", "ProcessPoolExecutor"})
+
+
+@_rule("GL119", "error",
+       "step-adjacent training modules spawn threads only via "
+       "pipeline.HostWorker")
+def _check_raw_threads(mod: ParsedModule) -> List[Finding]:
+  # The overlap schedulers' bit-exactness rests on ONE worker with ONE
+  # join discipline: jobs sequenced in submission order, results joined
+  # BEFORE accounting (so a guard rollback never races an in-flight
+  # gather/translate), failures re-raised as step failures, and job time
+  # on the shared trace/registry. A raw Thread or executor next to the
+  # step loop re-creates exactly the hazard classes pipeline.py exists
+  # to absorb — write-back tears, snapshot-over-mutation, silent
+  # swallowed worker exceptions. pipeline.py is the sanctioned home;
+  # long-lived service threads that predate it (the SIGTERM watchdog,
+  # the async checkpoint writer, the subscriber poll loop) suppress with
+  # their reason — each holds no step-loop state and joins on its own
+  # shutdown path. Tools and tests stay unrestricted.
+  norm = mod.path.replace(os.sep, "/")
+  if "distributed_embeddings_tpu/" not in norm \
+      or norm.endswith("distributed_embeddings_tpu/pipeline.py"):
+    return []
+  if not (any(f"/{pkg}/" in norm for pkg in _GL119_PKGS)
+          or norm.endswith("distributed_embeddings_tpu/training.py")):
+    return []
+  # both import spellings, either surface — a rename or a from-import
+  # must not be a lint bypass (the GL113 alias discipline)
+  thread_aliases = {"threading"}
+  cf_aliases = {"concurrent"}
+  from_names: Dict[str, str] = {}  # local alias -> flagged surface
+  for node in ast.walk(mod.tree):
+    if isinstance(node, ast.Import):
+      for a in node.names:
+        if a.name == "threading":
+          thread_aliases.add(a.asname or "threading")
+        elif a.name in ("concurrent", "concurrent.futures"):
+          cf_aliases.add(a.asname or "concurrent")
+    elif isinstance(node, ast.ImportFrom):
+      if node.module == "threading":
+        for a in node.names:
+          if a.name == "Thread":
+            from_names[a.asname or a.name] = "threading.Thread"
+      elif node.module == "concurrent.futures":
+        for a in node.names:
+          if a.name in _GL119_EXECUTORS:
+            from_names[a.asname or a.name] = f"concurrent.futures.{a.name}"
+      elif node.module == "concurrent":
+        for a in node.names:
+          if a.name == "futures":
+            cf_aliases.add(a.asname or "futures")
+  out = []
+  for node in ast.walk(mod.tree):
+    if not isinstance(node, ast.Call):
+      continue
+    root, name = _call_pair(node)
+    surface = None
+    if root in thread_aliases and name == "Thread":
+      surface = "threading.Thread"
+    elif root in cf_aliases and name in _GL119_EXECUTORS:
+      surface = f"concurrent.futures.{name}"
+    elif root is None and isinstance(node.func, ast.Name) \
+        and node.func.id in from_names:
+      surface = from_names[node.func.id]
+    if surface is not None:
+      out.append(mod.finding(
+          "GL119", node,
+          f"raw {surface}(...) in a step-adjacent training module: "
+          "host/device overlap routes through pipeline.HostWorker (one "
+          "worker, jobs joined before accounting, failures re-raised, "
+          "spans on the shared trace) — submit a job there instead, or "
+          "suppress with the reason if this is a long-lived service "
+          "thread that holds no step-loop state."))
   return out
 
 

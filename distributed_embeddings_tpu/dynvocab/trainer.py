@@ -353,7 +353,8 @@ class DynVocabTrainer:
                mesh, state: Dict[str, Any], batch_example: Any,
                axis_name: str = "mp", emb_dense_optimizer=None,
                micro_batches: int = 1, guard: bool = False,
-               donate: bool = True, telemetry=None):
+               donate: bool = True, telemetry=None,
+               overlap_host: bool = False):
     from ..training import make_sparse_train_step
     if getattr(plan, "oov", "clip") != "allocate":
       raise ValueError(
@@ -370,6 +371,7 @@ class DynVocabTrainer:
     self.axis_name = axis_name
     self.state = state
     self.guard = guard
+    self.overlap_host = overlap_host
     # lifecycle counters/gauges emit here (default: process registry)
     self.telemetry = telemetry if telemetry is not None else _registry()
     self.engine = DistributedLookup(plan, dp_input=True,
@@ -435,35 +437,58 @@ class DynVocabTrainer:
     return out
 
   # ---- stepping ----------------------------------------------------------
+  def _apply_zero(self, zero) -> None:
+    """Main-thread half of translation: clear recycled rows on device
+    BEFORE the step that may read them (the engine contract — the
+    overlap scheduler translates on its worker but always applies the
+    zero work here, pre-dispatch)."""
+    self.state["fused"], zeroed = apply_zero_work(
+        self.layouts, self.state["fused"], zero)
+    self.rows_zeroed += zeroed
+
   def _translate(self, cats):
     with _span("dynvocab/translate"):
       cats_t, vocab_metrics, zero = self.engine.translate_dynamic_ids(
           cats, self.translator)
-      self.state["fused"], zeroed = apply_zero_work(
-          self.layouts, self.state["fused"], zero)
-      self.rows_zeroed += zeroed
+      self._apply_zero(zero)
       return cats_t, vocab_metrics
 
-  def step(self, numerical, cats, labels) -> float:
-    """One train step on a GLOBAL host batch of RAW ids."""
+  def _dispatch(self, numerical, cats_t, labels):
+    """Dispatch one TRANSLATED batch; returns ``(loss, metrics|None)``
+    as device values with the device span left open on
+    ``self._dev_span`` — the caller's first host sync ends the window
+    and must finish the span."""
     from ..training import shard_batch
-    cats_t, vocab_metrics = self._translate(cats)
-    dev = _span("device/step", track="device").start()
+    self._dev_span = _span("device/step", track="device").start()
     batch = shard_batch((numerical, list(cats_t), labels), self.mesh,
                         self.axis_name)
     if self.guard:
       self.state, loss, metrics = self._step_fn(self.state, *batch)
-      loss = float(np.asarray(loss))  # the host sync ending the window
-      dev.finish()
+      return loss, metrics
+    self.state, loss = self._step_fn(self.state, *batch)
+    return loss, None
+
+  def step(self, numerical, cats, labels) -> float:
+    """One train step on a GLOBAL host batch of RAW ids."""
+    cats_t, vocab_metrics = self._translate(cats)
+    loss, metrics = self._dispatch(numerical, cats_t, labels)
+    loss = float(np.asarray(loss))  # the host sync ending the window
+    self._dev_span.finish()
+    if self.guard:
       self._account(metrics)
     else:
-      self.state, loss = self._step_fn(self.state, *batch)
-      loss = float(np.asarray(loss))
-      dev.finish()
       self.steps += 1
     self.account_vocab(vocab_metrics)
     return loss
 
   def run(self, batches: Iterable) -> list:
-    """Train over host batches of ``(numerical, cats, labels)``."""
+    """Train over host batches of ``(numerical, cats, labels)``.
+
+    With ``overlap_host=True`` the translate pass for batch k+1 runs on
+    the pipeline worker while step k executes on device — bit-exact
+    with the serial loop (the translator mutates in batch order on the
+    single worker; see ``pipeline.run_dynvocab_overlapped``)."""
+    if self.overlap_host:
+      from ..pipeline import run_dynvocab_overlapped
+      return run_dynvocab_overlapped(self, batches)
     return [self.step(*b) for b in batches]

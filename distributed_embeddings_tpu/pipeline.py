@@ -1,0 +1,348 @@
+"""Step pipeline scheduler: overlap per-step host work with device compute.
+
+Every trainer in this repo has a host-side pass welded to each device
+step: the tiered path classifies the batch against the resident maps and
+gathers cold rows out of the host images (``TieredPrefetcher.prepare``),
+and the dynvocab path translates raw int64 ids through the stateful
+host translator. Run serially, a step costs host + device wall time.
+This module runs batch k+1's host pass on ONE worker thread while the
+device executes step k, driving the step wall toward
+``max(host, device)`` — the overlap discipline of the production
+recommender trainers the paper builds on, applied to the host side the
+way PR 7 applied it to collectives.
+
+The schedulers here are bit-exact with the serial loops they shadow.
+That takes three rules:
+
+1. **Write-back conflict repair (tiered).** The prefetcher's historical
+   contract was "the stage gather must wait for the previous
+   write-back": step k's write-back scatters updated staged rows into
+   the same host images the k+1 gather reads. Instead of serializing,
+   the worker gathers concurrently and the main thread re-gathers ONLY
+   ``intersect(cold rows staged for k+1, rows written back by k)`` after
+   the write-back lands (`TieredPrefetcher.repair_conflicts`). Rows
+   outside the intersection are untouched by the write-back; rows inside
+   it get the post-write-back value — exactly what the serial gather
+   would have read. A guard-skipped step's write-back rewrites byte-
+   identical rows, so its conflict set is empty and repair is skipped.
+
+2. **Deferred side effects (tiered).** The worker's classify is the pure
+   half (`classify_pure`): frequency-count updates are returned as data
+   and committed by the main thread (`apply_counts`) only AFTER the
+   step's snapshot/drain hooks ran, so a snapshot taken after step j
+   observes counts covering exactly batches 1..j — the serial
+   ordering. Device uploads and the gather counters likewise commit on
+   the main thread (`upload_staged`).
+
+3. **Sequenced translation (dynvocab).** ``translate_batch`` mutates the
+   translator (sketch admits, rows allocate, TTL clock ticks), so the
+   translate-ahead job runs on the single worker in batch order — the
+   mutation sequence is byte-identical to the serial loop's. Because the
+   mutation cannot be deferred, overlap is conservatively DISABLED for
+   any step whose successor might be snapshotted or drained
+   (``defer_overlap``): a snapshot never observes a translator half a
+   batch ahead of the consumed stream. On SIGTERM with a translated
+   batch already pending, the drain consumes that one batch first, so
+   the translator clock equals the consumed count at the drain snapshot.
+
+Worker failures are step failures: `HostWorker.result` re-raises the
+job's exception on the main thread — there is no silent fall-back to
+the serial path. The worker is the ONE sanctioned overlap surface in
+the step-adjacent training modules (graftlint GL119); its jobs land on
+their own trace track via the usual `telemetry.timed` spans, and the
+per-step hidden host time is observed as `tiered/overlap_hidden_s` /
+`dynvocab/overlap_hidden_s`.
+
+`overlap_host=False` (every trainer's default) never imports this
+module's schedulers and is a byte-for-byte no-op on the serial paths.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from .telemetry import span as _span, timed as _timed
+
+
+class _Job:
+  """One submitted unit: result or error, plus the job's own elapsed
+  seconds (used to compute how much host time the overlap hid)."""
+
+  __slots__ = ("fn", "label", "done", "result", "error", "elapsed")
+
+  def __init__(self, fn: Callable[[], Any], label: str):
+    self.fn = fn
+    self.label = label
+    self.done = threading.Event()
+    self.result: Any = None
+    self.error: Optional[BaseException] = None
+    self.elapsed = 0.0
+
+
+class HostWorker:
+  """ONE worker thread executing host-side pipeline jobs in submission
+  order.
+
+  Single-threaded by design: stateful host passes (the dynvocab
+  translator) stay sequenced exactly like the serial loop, and the
+  tiered gather never races itself. Jobs are timed with
+  ``telemetry.timed`` under their label, so they show up as spans on the
+  worker's own trace track and as histograms in the registry.
+
+  ``result`` re-raises a failed job's exception on the caller's thread:
+  a broken host pass fails the step that needed it, never silently
+  degrading to the serial path. ``close`` drains and joins without
+  raising for jobs whose results were deliberately discarded (e.g. a
+  prepared-ahead batch dropped at a SIGTERM drain).
+  """
+
+  def __init__(self, name: str = "host-pipeline"):
+    self.name = name
+    self._q: "queue.Queue[Optional[_Job]]" = queue.Queue()
+    self._thread = threading.Thread(target=self._loop, daemon=True,
+                                    name=name)
+    self._thread.start()
+
+  def _loop(self) -> None:
+    while True:
+      job = self._q.get()
+      if job is None:
+        return
+      try:
+        with _timed(job.label) as t:
+          job.result = job.fn()
+        job.elapsed = t.elapsed
+      except BaseException as e:  # re-raised at result()
+        job.error = e
+      finally:
+        job.done.set()
+
+  def submit(self, fn: Callable[..., Any], *args: Any,
+             label: str = "pipeline/job") -> _Job:
+    if not self._thread.is_alive():
+      raise RuntimeError(f"HostWorker {self.name!r} is closed")
+    job = _Job((lambda: fn(*args)), label)
+    self._q.put(job)
+    return job
+
+  def result(self, job: _Job) -> Tuple[Any, float]:
+    """Wait for ``job``; return ``(result, elapsed_seconds)`` or re-raise
+    the exception the job died with."""
+    job.done.wait()
+    if job.error is not None:
+      raise job.error
+    return job.result, job.elapsed
+
+  def close(self) -> None:
+    if self._thread.is_alive():
+      self._q.put(None)
+      self._thread.join()
+
+  def __enter__(self) -> "HostWorker":
+    return self
+
+  def __exit__(self, *exc: Any) -> None:
+    self.close()
+
+
+def _hidden(reg, name: str, job_s: float, wait_s: float) -> None:
+  # host seconds the device window absorbed: job time minus the tail the
+  # main thread still had to wait for
+  reg.histogram(name).observe(max(0.0, job_s - wait_s))
+
+
+# ---------------------------------------------------------------------------
+# tiered: double-buffered classify + gather
+# ---------------------------------------------------------------------------
+
+
+def _tiered_host_job(pf, cats) -> Tuple[Any, Any]:
+  cold, count_updates = pf.classify_pure(cats)
+  return count_updates, pf.gather_cold(cold)
+
+
+def run_tiered_overlapped(trainer, batches: Iterable, *,
+                          account: Optional[Callable] = None,
+                          on_dispatch: Optional[Callable] = None,
+                          after_step: Optional[Callable] = None
+                          ) -> List[float]:
+  """The overlapped form of ``TieredTrainer.run``: while step j runs on
+  device, the worker classifies batch j+1 and gathers its cold rows.
+
+  Bit-exactness vs the serial loop: the staged values batch j+1 trains
+  on equal a serial gather's — rows the j write-back touched are
+  re-gathered by ``repair_conflicts`` after the write-back lands, rows
+  it did not touch were stable all along (a snapshot's flush only
+  writes RESIDENT rows, disjoint from the cold set) — and the
+  frequency counts commit on the main thread after the step's hooks, so
+  re-rank and snapshot decisions read the serial counts. Re-rank steps
+  rebuild the resident maps, so overlap across a re-rank boundary is
+  never attempted: the successor batch is staged serially against the
+  new maps, exactly like the serial loop's deferral.
+
+  Hooks (the ResilientTrainer wiring):
+    ``account(metrics)``     — replaces ``trainer._account``;
+    ``on_dispatch()``        — right after dispatch (stream position);
+    ``after_step(loss, metrics, stepped, pending_ahead)`` — after
+      write-back/accounting/re-rank with the fetched host scalars,
+      BEFORE the prepared-ahead blocks commit; return True to stop
+      consuming the stream (SIGTERM drain). ``pending_ahead`` is True
+      when a worker job for the next batch is in flight (always safe to
+      snapshot over: the tiered job is pure).
+  """
+  pf = trainer.prefetcher
+  interval = trainer.tplan.config.rerank_interval
+  reg = trainer.telemetry
+  losses: List[float] = []
+  it = iter(batches)
+  cur = next(it, None)
+  if cur is None:
+    return losses
+  with HostWorker("tiered-overlap") as worker:
+    staged = pf.prepare(cur[1])
+    while cur is not None:
+      numerical, cats, labels = cur
+      nxt = next(it, None)
+      staged_out, metrics, loss = trainer._dispatch(staged, numerical, cats,
+                                                    labels)
+      if on_dispatch is not None:
+        on_dispatch()
+      # the device is computing now; start batch j+1's host pass unless
+      # this step re-ranks (serial loop defers classify there too)
+      will_rerank = bool(interval) and (
+          pf.steps_since_rerank + 1 >= interval)
+      job = None
+      if nxt is not None and not will_rerank:
+        job = worker.submit(_tiered_host_job, pf, nxt[1],
+                            label="tiered/host_prepare")
+      loss_h, metrics_h, stepped = jax.device_get(
+          (loss, metrics, trainer.state["step"]))
+      trainer._dev_span.finish()
+      pf.write_back(staged, staged_out)
+      # join the worker BEFORE accounting: a guard rollback restores
+      # store state, and it must never race an in-flight gather
+      prepared = None
+      if job is not None:
+        with _timed("tiered/overlap_wait") as w:
+          prepared, job_s = worker.result(job)
+        _hidden(reg, "tiered/overlap_hidden_s", job_s, w.elapsed)
+      (account or trainer._account)(metrics_h)
+      trainer.state["fused"] = pf.maybe_rerank(trainer.state["fused"])
+      losses.append(float(np.asarray(loss_h)))
+      stop = bool(after_step(loss_h, metrics_h, stepped,
+                             prepared is not None)) \
+          if after_step is not None else False
+      if stop or nxt is None:
+        break
+      if prepared is not None:
+        count_updates, blocks = prepared
+        skipped = bool(np.asarray(metrics_h["bad_step"])) \
+            if trainer.guard else False
+        if not skipped:
+          pf.repair_conflicts(blocks, staged.cold)
+        pf.apply_counts(count_updates)
+        staged = pf.upload_staged(blocks)
+      else:
+        staged = pf.prepare(nxt[1])  # re-rank step: stage vs the new maps
+      cur = nxt
+  return losses
+
+
+# ---------------------------------------------------------------------------
+# dynvocab: translate-ahead
+# ---------------------------------------------------------------------------
+
+
+def _dynvocab_translate_job(trainer, cats):
+  return trainer.engine.translate_dynamic_ids(cats, trainer.translator)
+
+
+def run_dynvocab_overlapped(trainer, batches: Iterable, *,
+                            account: Optional[Callable] = None,
+                            on_dispatch: Optional[Callable] = None,
+                            after_step: Optional[Callable] = None,
+                            defer_overlap: Optional[Callable] = None
+                            ) -> List[float]:
+  """The overlapped form of ``DynVocabTrainer.run``: while step j runs
+  on device, the worker translates batch j+1's raw ids.
+
+  Translation mutates the translator, so the ahead-translation is only
+  submitted when the caller's ``defer_overlap(prev_stepped)`` predicate
+  allows it: the ResilientTrainer defers around snapshot boundaries and
+  drain requests so a snapshot never captures a translator that is a
+  batch ahead of the consumed stream. Zero-work (row clearing for
+  recycled ids) always applies on the main thread before dispatch, per
+  the engine contract. When ``after_step`` requests a stop while a
+  translated batch is pending, that batch is consumed as one more step
+  before stopping — the translator clock equals the consumed count at
+  the drain snapshot.
+  """
+  losses: List[float] = []
+  it = iter(batches)
+  cur = next(it, None)
+  if cur is None:
+    return losses
+  reg = trainer.telemetry
+  prev_stepped = int(np.asarray(jax.device_get(trainer.state["step"])))
+  pending = None  # (cats_t, vocab_metrics, zero) translated ahead for cur
+  with HostWorker("dynvocab-overlap") as worker:
+    while cur is not None:
+      numerical, cats, labels = cur
+      if pending is None:
+        with _span("dynvocab/translate"):
+          cats_t, vocab_metrics, zero = trainer.engine.translate_dynamic_ids(
+              cats, trainer.translator)
+      else:
+        cats_t, vocab_metrics, zero = pending
+        pending = None
+      trainer._apply_zero(zero)  # device mutation: main thread, pre-dispatch
+      nxt = next(it, None)
+      loss, metrics = trainer._dispatch(numerical, cats_t, labels)
+      if on_dispatch is not None:
+        on_dispatch()
+      job = None
+      if nxt is not None and not (
+          defer_overlap(prev_stepped) if defer_overlap is not None
+          else False):
+        job = worker.submit(_dynvocab_translate_job, trainer, nxt[1],
+                            label="dynvocab/translate_ahead")
+      if metrics is not None:
+        loss_h, metrics_h, stepped = jax.device_get(
+            (loss, metrics, trainer.state["step"]))
+      else:
+        loss_h, stepped = jax.device_get((loss, trainer.state["step"]))
+        metrics_h = None
+      trainer._dev_span.finish()
+      # join the worker BEFORE accounting: a guard rollback restores the
+      # translator, and it must never race an in-flight translation
+      if job is not None:
+        with _timed("dynvocab/overlap_wait") as w:
+          pending, job_s = worker.result(job)
+        _hidden(reg, "dynvocab/overlap_hidden_s", job_s, w.elapsed)
+      if account is not None:
+        account(metrics_h, vocab_metrics)
+      else:
+        if trainer.guard:
+          trainer._account(metrics_h)
+        else:
+          trainer.steps += 1
+        trainer.account_vocab(vocab_metrics)
+      losses.append(float(np.asarray(loss_h)))
+      prev_stepped = int(np.asarray(stepped))
+      stop = bool(after_step(loss_h, metrics_h, prev_stepped,
+                             pending is not None)) \
+          if after_step is not None else False
+      if stop and pending is None:
+        break
+      if nxt is None:
+        break
+      cur = nxt
+      # a stop with a translated batch pending falls through: cur is the
+      # pending batch, no new job is submitted (defer_overlap sees the
+      # drain), and the next after_step stops with pending None
+  return losses

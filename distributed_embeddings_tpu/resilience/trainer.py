@@ -135,7 +135,8 @@ class ResilientTrainer:
                resume: bool = True, store=None,
                retry_policy: retry.RetryPolicy = retry.DEFAULT_POLICY,
                async_snapshots: bool = False,
-               tiered=None, dynvocab=None, telemetry=None, stream=None):
+               tiered=None, dynvocab=None, telemetry=None, stream=None,
+               overlap_host: bool = False):
     # The metrics registry this trainer emits through (and persists:
     # snapshots write its state into the checkpoint manifest's
     # ``telemetry`` section, and the FIRST resume of a fresh process
@@ -189,8 +190,9 @@ class ResilientTrainer:
             "async_snapshots with a dynvocab trainer: checkpoint.save "
             "serializes the translator's live host state (mapping, "
             "sketch, freelist), which every step's translate pass "
-            "mutates — a background save would tear it (same limit as "
-            "the HostTierStore's images).")
+            "mutates — a background save would tear it. (The tiered "
+            "store solved this with a copy-on-snapshot view; the "
+            "translator has no equivalent frozen surface yet.)")
       state = dynvocab.state if state is None else state
     self.vocab = dynvocab.translator if dynvocab is not None else None
     self.tiered = tiered
@@ -206,12 +208,10 @@ class ResilientTrainer:
         raise ValueError(
             "ResilientTrainer(tiered=...) drives the TieredTrainer's own "
             "step; pass step_fn=None (the two would race on the state).")
-      if async_snapshots:
-        raise NotImplementedError(
-            "async_snapshots with a tiered trainer: checkpoint.save "
-            "reads AND writes the store's live host images, which the "
-            "per-step write-back mutates — a background save would tear "
-            "them (same limit as snapshot(async_=True) with a store).")
+      # async snapshots with a tiered trainer are served by the store's
+      # copy-on-snapshot view (snapshot_view): the writer serializes a
+      # frozen reconciled copy while the per-step write-back keeps
+      # mutating the live images
       state = tiered.state if state is None else state
       store = tiered.store if store is None else store
     self.stream = stream
@@ -222,6 +222,14 @@ class ResilientTrainer:
           "observe_batch mutates — a background save would tear the "
           "chain state it seals (same limit as the translator). "
           "Snapshot streaming runs synchronously.")
+    self.overlap_host = overlap_host
+    if overlap_host and tiered is None and dynvocab is None:
+      raise ValueError(
+          "overlap_host=True without a tiered or dynvocab trainer: the "
+          "sparse step has no per-step host pass to overlap (its batch "
+          "sharding is already inside the device dispatch). Drop the "
+          "flag, or wrap the host pass you mean into a TieredTrainer/"
+          "DynVocabTrainer.")
     self._step_fn = step_fn
     self.state = state
     self.plan = plan
@@ -548,7 +556,9 @@ class ResilientTrainer:
       if self._drain_requested.is_set():
         return  # a second notice changes nothing; the first deadline holds
       self._drain_requested.set()
-      threading.Thread(target=self._drain_watchdog,
+      # deadline watchdog, not step work: holds no step-loop state and
+      # must outlive a wedged step — not a HostWorker job
+      threading.Thread(target=self._drain_watchdog,  # graftlint: disable=GL119
                        name="sigterm-drain-watchdog", daemon=True).start()
 
     signal.signal(signal.SIGTERM, _handler)
@@ -608,10 +618,13 @@ class ResilientTrainer:
     writer is always joined first — with its error re-raised — so at
     most one snapshot is in flight and the rotate-after-publish
     invariant holds; :meth:`join_writer` flushes before exit.
-    Single-controller, store-less runs only: the save's cross-process
-    barriers must run on every main thread, and a ``HostTierStore``'s
-    images are live mutable host state a background save would tear
-    (both limits raise below)."""
+    A ``HostTierStore`` rides along via its copy-on-snapshot view
+    (``store.snapshot_view``): the writer serializes a frozen reconciled
+    image copy, so the live images stay free for the per-step write-back
+    (and the overlap worker's gathers). Single-controller only: the
+    save's cross-process barriers must run on every main thread (raises
+    below; the dynvocab translator's live host state is the other
+    remaining refusal — it has no frozen view yet)."""
     self.join_writer()
     self.telemetry.counter("ckpt/snapshots").inc()
     extra = {"consumed": self.consumed,
@@ -640,32 +653,33 @@ class ResilientTrainer:
           "step's translate pass mutates — a background save would tear "
           "the id space it checksums. Snapshot dynvocab runs "
           "synchronously.")
-    if self.store is not None:
-      raise NotImplementedError(
-          "snapshot(async_=True) with a HostTierStore: checkpoint.save "
-          "both reads the store's images (cold-block serialization) and "
-          "writes them (the resident-row flush), and a tiered trainer "
-          "mutates the same images every step's write-back — a "
-          "background save would tear the blocks it checksums and could "
-          "clobber newer write-backs with snapshot-time rows. Snapshot "
-          "tiered runs synchronously (the store has no immutable "
-          "device-side copy to hand a writer thread).")
     state_host = jax.device_get(self.state)
     step_now = int(np.asarray(state_host["step"]))
     # capture the registry synchronously, like the state: later steps
     # mutate the live counters while the writer flushes
     telemetry_state = self.telemetry.state_dict()
+    # and the store the same way: a frozen reconciled copy of the images
+    # (checkpoint.save both reads the blocks it checksums and flushes
+    # resident rows — on the view the flush is a no-op because the
+    # reconciliation happened here, synchronously, against THIS step's
+    # fused buffers). The live images stay free for the next step's
+    # write-back and the overlap worker's gathers.
+    store_view = self.store.snapshot_view(state_host["fused"]) \
+        if self.store is not None else None
 
     def _write():
       try:
         durable.save_rotating(self.ckpt_root, self.plan, self.rule,
-                              state_host, store=self.store, keep=self.keep,
+                              state_host, store=store_view, keep=self.keep,
                               policy=self.retry_policy, extra=extra,
                               telemetry=telemetry_state)
       except BaseException as e:  # surfaced at the next join_writer
         self._writer_err = e
 
-    self._writer = threading.Thread(target=_write, daemon=True,
+    # I/O writer over frozen copies, not step work: it must overlap an
+    # UNBOUNDED number of steps and joins at join_writer, not per-step —
+    # a HostWorker job would serialize the next overlap submission
+    self._writer = threading.Thread(target=_write, daemon=True,  # graftlint: disable=GL119
                                     name=f"ckpt-writer-{step_now}")
     self._writer.start()
     self._last_snapshot = step_now
@@ -806,7 +820,7 @@ class ResilientTrainer:
     loss = float(np.asarray(loss))
     if self.snapshot_every and \
         int(stepped) - self._last_snapshot >= self.snapshot_every:
-      self.snapshot()
+      self.snapshot(async_=self.async_snapshots)
     return loss
 
   def _step_dynvocab(self, numerical, cats, labels) -> float:
@@ -855,25 +869,110 @@ class ResilientTrainer:
     the SAME stream minus the first ``trainer.consumed`` batches — the
     checkpointed stream position, which counts committed AND skipped
     batches (``step_count`` alone would replay one committed batch per
-    skip that preceded the snapshot)."""
+    skip that preceded the snapshot).
+
+    With ``overlap_host=True`` (tiered/dynvocab modes) the host pass
+    for batch k+1 runs on the pipeline worker while step k executes —
+    bit-exact with this serial loop, snapshots/drains included (see
+    ``pipeline``'s module docstring for the ordering rules)."""
     from ..training import shard_batch
 
-    losses = []
-    for batch in batches:
-      if self.tiered is not None or self.dynvocab is not None:
-        losses.append(self.step(*batch))
-      else:
-        sb = shard_batch(tuple(batch), self.mesh, self.axis_name)
-        losses.append(self.step(*sb))
-      if self.maybe_drain():
-        # SIGTERM preemption notice: the in-flight step finished and a
-        # drain snapshot is durably down — stop consuming the stream
-        # (a relaunch resumes at trainer.consumed, bit-exact)
-        break
+    if self.overlap_host and self.tiered is not None:
+      losses = self._run_tiered_overlapped(batches)
+    elif self.overlap_host and self.dynvocab is not None:
+      losses = self._run_dynvocab_overlapped(batches)
+    else:
+      losses = []
+      for batch in batches:
+        if self.tiered is not None or self.dynvocab is not None:
+          losses.append(self.step(*batch))
+        else:
+          sb = shard_batch(tuple(batch), self.mesh, self.axis_name)
+          losses.append(self.step(*sb))
+        if self.maybe_drain():
+          # SIGTERM preemption notice: the in-flight step finished and a
+          # drain snapshot is durably down — stop consuming the stream
+          # (a relaunch resumes at trainer.consumed, bit-exact)
+          break
     self.join_writer()  # a run's last periodic snapshot must be durable
     if snapshot_final:
       self.snapshot()
     return losses
+
+  def _on_dispatch(self) -> None:
+    # the overlap schedulers' stream-position hook: identical to the
+    # serial steps' consumed accounting, at the same point (right after
+    # dispatch, before the fetch)
+    self.consumed += 1
+    self.telemetry.counter("train/consumed").inc()
+
+  def _run_tiered_overlapped(self, batches: Iterable) -> List[float]:
+    from ..pipeline import run_tiered_overlapped
+
+    t = self.tiered
+    t.state = self.state
+
+    def account(m):
+      # same split as _step_tiered: tier bookkeeping with the
+      # TieredTrainer, guard verdict/OOV/rollback with this trainer
+      t.account_tier(m["tier"])
+      t.steps += 1
+      self._account(m)
+
+    def after_step(loss, metrics, stepped, pending_ahead):
+      del loss, metrics, pending_ahead  # the tiered worker job is pure:
+      # snapshotting over it is safe (flush writes resident rows, the
+      # worker gathers cold rows — disjoint), and the deferred
+      # apply_counts keeps the persisted counts at exactly this step
+      self.state = t.state
+      if self.snapshot_every and \
+          int(stepped) - self._last_snapshot >= self.snapshot_every:
+        self.snapshot(async_=self.async_snapshots)
+      return self.maybe_drain()
+
+    return run_tiered_overlapped(t, batches, account=account,
+                                 on_dispatch=self._on_dispatch,
+                                 after_step=after_step)
+
+  def _run_dynvocab_overlapped(self, batches: Iterable) -> List[float]:
+    from ..pipeline import run_dynvocab_overlapped
+
+    d = self.dynvocab
+    d.state = self.state
+
+    def account(metrics, vocab_metrics):
+      d.account_vocab(vocab_metrics)
+      d.steps += 1
+      self.state = d.state
+      self._account(metrics)
+
+    def defer_overlap(prev_stepped):
+      # the translate-ahead job MUTATES the translator, so never submit
+      # one when the NEXT step's hooks might snapshot: the periodic
+      # predicate is conservative (a skipped step just loses one
+      # overlap), and a drain notice stops look-ahead cold
+      if self._drain_requested.is_set():
+        return True
+      return bool(self.snapshot_every) and \
+          prev_stepped + 1 - self._last_snapshot >= self.snapshot_every
+
+    def after_step(loss, metrics, stepped, pending_ahead):
+      del loss, metrics
+      self.state = d.state
+      if self.snapshot_every and \
+          int(stepped) - self._last_snapshot >= self.snapshot_every:
+        self.snapshot()  # sync: dynvocab async snapshots are refused
+      if pending_ahead:
+        # the worker already translated the next batch into the id
+        # space; consume it first, then drain — the translator clock
+        # equals the consumed count at the drain snapshot
+        return False
+      return self.maybe_drain()
+
+    return run_dynvocab_overlapped(d, batches, account=account,
+                                   on_dispatch=self._on_dispatch,
+                                   after_step=after_step,
+                                   defer_overlap=defer_overlap)
 
   def metrics_summary(self) -> Dict[str, Any]:
     out = {

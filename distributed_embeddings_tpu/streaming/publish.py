@@ -735,11 +735,12 @@ class DeltaPublisher:
   # ---- delta --------------------------------------------------------------
   def _reader(self, name: str, state: Dict[str, Any], rank: int):
     """Physical-row window reader over one rank's AUTHORITATIVE packed
-    block: the flushed host image for tiered classes, the device buffer
-    (one window device_get at a time) otherwise."""
+    block: a flush-free overlay over the host image for tiered classes
+    (resident windows patched from the device cache on the fly — the
+    image itself is never mutated, see ``HostTierStore.overlay_reader``),
+    the device buffer (one window device_get at a time) otherwise."""
     if name in self._tiered_names:
-      img = self.store.images[name][rank]
-      return lambda p0, p1: img[p0:p1]
+      return self.store.overlay_reader(name, rank, state["fused"])
     arr = state["fused"][name]
     if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
       raise NotImplementedError(
@@ -791,8 +792,9 @@ class DeltaPublisher:
     path = os.path.join(self.path, delta_dirname(seq))
 
     with _span("stream/extract", args={"seq": seq}):
-      if self.store is not None:
-        self.store.flush(state["fused"])
+      # flush-free: tiered readers overlay the device cache onto the host
+      # image per window (no store mutation, no bulk device_get) — the
+      # bytes equal a flush-then-read of the same watermark exactly
       changed = self.tracker.changed_rows(self.watermark)
       payload: Dict[str, List[tuple]] = {}
       n_rows = 0

@@ -296,7 +296,9 @@ class DeltaSubscriber:
     if self._thread is not None and self._thread.is_alive():
       return self
     self._stop.clear()
-    self._thread = threading.Thread(target=self._poll_loop,
+    # serve-side poll loop, not step work: it lives on the SUBSCRIBER
+    # process (no trainer, no step loop), joins at stop()
+    self._thread = threading.Thread(target=self._poll_loop,  # graftlint: disable=GL119
                                     name="stream-delta-subscriber",
                                     daemon=True)
     self._thread.start()

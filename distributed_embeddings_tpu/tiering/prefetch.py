@@ -23,8 +23,19 @@ Runs AHEAD of the jitted train step, host-side. Per step:
 
 The classify step is independent of the previous step's results, so a
 trainer can run it on a worker thread while the device computes
-(`train.TieredTrainer`); the stage gather must wait for the previous
-write-back (a row staged twice in a row needs its updated value).
+(`pipeline.run_tiered_overlapped` via ``TieredTrainer(overlap_host=
+True)``). The stage gather historically had to wait for the previous
+write-back (a row staged twice in a row needs its updated value); the
+overlap path gathers concurrently instead and REPAIRS the conflict set
+afterward — once step k's write-back lands, only
+``intersect(cold rows staged for k+1, rows staged by k)`` can hold a
+stale or torn value, and :meth:`TieredPrefetcher.repair_conflicts`
+re-gathers exactly those rows, making the staged block byte-identical
+to a serial gather's. The worker half is side-effect-free: classify
+returns its count updates as data (`classify_pure` / `apply_counts`)
+and the gather builds host blocks only (`gather_cold`); the device
+upload and the shared counters commit on the main thread
+(`upload_staged`).
 """
 
 from __future__ import annotations
@@ -51,6 +62,20 @@ class StagedBatch:
   device: dict                       # step input: {"grps", "rows", "resident"}
   cold: Dict[str, List[np.ndarray]]  # per class, per rank: staged row ids
   s_eff: Dict[str, int]              # per class: padded staging size
+  host_gather_bytes: int
+  spilled: bool
+
+
+@dataclasses.dataclass
+class ColdBlocks:
+  """The host half of one batch's staging: padded id/row blocks, all
+  numpy. Built by ``gather_cold`` (worker-thread safe), optionally
+  patched by ``repair_conflicts``, committed by ``upload_staged``."""
+
+  cold: Dict[str, List[np.ndarray]]          # per class, per rank: sorted ids
+  s_eff: Dict[str, int]                      # per class: padded staging size
+  g_blocks: Dict[str, Dict[int, np.ndarray]]  # per class, per rank: padded ids
+  r_blocks: Dict[str, Dict[int, np.ndarray]]  # owned ranks: padded row blocks
   host_gather_bytes: int
   spilled: bool
 
@@ -143,11 +168,25 @@ class TieredPrefetcher:
       return self._classify(cats)
 
   def _classify(self, cats: Sequence) -> Dict[str, List[np.ndarray]]:
+    cold, updates = self.classify_pure(cats)
+    self.apply_counts(updates)
+    return cold
+
+  def classify_pure(self, cats: Sequence):
+    """The classify pass WITHOUT its side effect: returns ``(cold,
+    count_updates)`` where the observed-count increments come back as
+    data (``{name: [(req, occ), ...]}`` per rank) for ``apply_counts``
+    at the main thread's commit point. This is the overlap worker's
+    form — it reads only plan geometry and the resident maps (stable
+    between re-ranks), so it may run while the device computes and
+    while a snapshot serializes the counts."""
     from ..layers.planner import routed_rows
     cold: Dict[str, List[np.ndarray]] = {}
+    updates: Dict[str, list] = {}
     for key, c in self.tplan.classes.items():
       rpp = c.spec.rpp
       per_rank = []
+      per_rank_updates = []
       for rank in range(self.plan.world_size):
         # the shared numpy replica of the traced routing (planner.
         # routed_rows — also the streaming tracker's), then physical
@@ -161,11 +200,23 @@ class TieredPrefetcher:
         # batch-derived indices: bounds-check against the image before
         # any fancy indexing (descriptive error instead of numpy's)
         req = self.store.check_rows(c.name, rank, req.astype(np.int32))
-        self.store.counts[c.name][rank][req] += occ
+        per_rank_updates.append((req, occ))
         rmap = self.store.resident_map[c.name][rank]
         per_rank.append(req[rmap[req] < 0])
       cold[c.name] = per_rank
-    return cold
+      updates[c.name] = per_rank_updates
+    return cold, updates
+
+  def apply_counts(self, count_updates: Dict[str, list]) -> None:
+    """Commit ``classify_pure``'s deferred observed-count increments.
+
+    Main thread only, AFTER the preceding step's snapshot/drain hooks:
+    a snapshot taken after committed step j then observes counts
+    covering exactly batches 1..j — the serial ordering — even though
+    batch j+1's classify already ran on the worker."""
+    for name, per_rank in count_updates.items():
+      for rank, (req, occ) in enumerate(per_rank):
+        self.store.counts[name][rank][req] += occ
 
   # ---- staging -----------------------------------------------------------
   def _bucket(self, c, n: int) -> int:
@@ -197,7 +248,20 @@ class TieredPrefetcher:
       return self._stage(cold)
 
   def _stage(self, cold: Dict[str, List[np.ndarray]]) -> StagedBatch:
-    grps_dev, rows_dev, s_eff = {}, {}, {}
+    return self.upload_staged(self.gather_cold(cold))
+
+  def gather_cold(self, cold: Dict[str, List[np.ndarray]]) -> ColdBlocks:
+    """The host half of staging: padded id blocks for every rank plus
+    host-gathered row blocks for the OWNED ranks, all numpy.
+
+    Worker-thread safe: reads plan geometry and the host images only,
+    and touches no shared mutable state (the cumulative gather/spill
+    counters commit in ``upload_staged``). A concurrent write-back may
+    race this gather — only on rows both batches staged, which
+    ``repair_conflicts`` re-reads afterward."""
+    g_blocks_all: Dict[str, Dict[int, np.ndarray]] = {}
+    r_blocks_all: Dict[str, Dict[int, np.ndarray]] = {}
+    s_eff: Dict[str, int] = {}
     nbytes = 0
     spilled = False
     owned = frozenset(self.store.owned_ranks)
@@ -224,22 +288,66 @@ class TieredPrefetcher:
             # pad in the image dtype: f32 training stores, and the serve
             # tier's stripped f32/int8 images ride the same pipeline
             [rows, np.zeros((pad, lay.phys_width), rows.dtype)])
+      g_blocks_all[c.name] = g_blocks
+      r_blocks_all[c.name] = r_blocks
+      s_eff[c.name] = s
+    return ColdBlocks(cold=cold, s_eff=s_eff, g_blocks=g_blocks_all,
+                      r_blocks=r_blocks_all, host_gather_bytes=nbytes,
+                      spilled=spilled)
+
+  def repair_conflicts(self, blocks: ColdBlocks,
+                       prev_cold: Dict[str, List[np.ndarray]]) -> int:
+    """Re-gather the rows a concurrent write-back may have raced.
+
+    ``blocks`` was gathered while the PREVIOUS step's write-back was
+    landing; only rows in ``intersect(blocks.cold, prev_cold)`` were
+    scattered under the gather, so re-reading exactly those (after the
+    write-back returned) makes every row block byte-identical to a
+    serial gather-after-write-back. Both id sets are sorted-unique
+    (np.unique upstream), so the intersection and the patch positions
+    are a couple of merges. Returns the number of rows re-gathered."""
+    owned = frozenset(self.store.owned_ranks)
+    repaired = 0
+    for c in self.tplan.classes.values():
+      for rank in range(self.plan.world_size):
+        if rank not in owned:
+          continue
+        g = blocks.cold[c.name][rank]
+        conflict = np.intersect1d(g, prev_cold[c.name][rank],
+                                  assume_unique=True)
+        if not conflict.size:
+          continue
+        rows = self._gather(c.name, rank, conflict.astype(np.int32))
+        blocks.r_blocks[c.name][rank][np.searchsorted(g, conflict)] = rows
+        repaired += int(conflict.size)
+    if repaired:
+      self.telemetry.counter("tiered/conflict_rows_regathered").inc(repaired)
+    return repaired
+
+  def upload_staged(self, blocks: ColdBlocks) -> StagedBatch:
+    """The device half of staging (main thread): upload the padded
+    blocks and commit the cumulative gather/spill counters."""
+    grps_dev, rows_dev = {}, {}
+    for c in self.tplan.classes.values():
+      s = blocks.s_eff[c.name]
+      lay = c.layout_logical
       grps_dev[c.name] = self.store._global_or_callback(
-          c.name, s, None, lambda r, b=g_blocks: b[r],
+          c.name, s, None, lambda r, b=blocks.g_blocks[c.name]: b[r],
           self.mesh, self.axis_name)
       rows_dev[c.name] = self.store._global_or_callback(
-          c.name, s, lay.phys_width, lambda r, b=r_blocks: b[r],
+          c.name, s, lay.phys_width, lambda r, b=blocks.r_blocks[c.name]: b[r],
           self.mesh, self.axis_name)
-      s_eff[c.name] = s
-    self.total_host_gather_bytes += nbytes
-    self.spill_steps += int(spilled)
-    self.telemetry.counter("tiered/host_gather_bytes").inc(nbytes)
-    if spilled:
+    self.total_host_gather_bytes += blocks.host_gather_bytes
+    self.spill_steps += int(blocks.spilled)
+    self.telemetry.counter("tiered/host_gather_bytes").inc(
+        blocks.host_gather_bytes)
+    if blocks.spilled:
       self.telemetry.counter("tiered/spill_steps").inc()
     return StagedBatch(
         device={"grps": grps_dev, "rows": rows_dev,
                 "resident": self._resident_dev},
-        cold=cold, s_eff=s_eff, host_gather_bytes=nbytes, spilled=spilled)
+        cold=blocks.cold, s_eff=blocks.s_eff,
+        host_gather_bytes=blocks.host_gather_bytes, spilled=blocks.spilled)
 
   def prepare(self, cats: Sequence) -> StagedBatch:
     """classify + stage in one call (the synchronous path)."""

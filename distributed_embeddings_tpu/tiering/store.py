@@ -348,3 +348,95 @@ class HostTierStore:
         host_scatter_rows(lay, self.images[name][rank],
                           self.resident_grps[name][rank],
                           self._rank_cache_rows(fused, name, rank))
+
+  # ---- read-only reconciled views ---------------------------------------
+  def snapshot_view(self, fused: Dict[str, jax.Array]
+                    ) -> "TierStoreSnapshot":
+    """Copy-on-snapshot view for async checkpointing: every OWNED image
+    is COPIED with the resident rows' device values scattered into the
+    copy — the same reconciliation ``flush`` applies to the live images,
+    without mutating them. The device reads happen here, synchronously;
+    the returned view is frozen host state a background writer can
+    serialize while training (and the overlap worker) keep mutating this
+    store. Cost: one image copy per owned (class, rank)."""
+    return TierStoreSnapshot(self, fused)
+
+  def overlay_reader(self, name: str, rank: int,
+                     fused: Dict[str, jax.Array]):
+    """Flush-free window reader over one rank's RECONCILED image:
+    ``reader(p0, p1)`` returns a COPY of physical rows ``[p0, p1)`` with
+    the resident rows' values overlaid from the device cache —
+    byte-identical to flushing then slicing, with the live image left
+    untouched (the overlap worker may be gathering cold rows from it
+    concurrently, and the authority convention deliberately keeps
+    resident rows' image copies stale between flushes). The device
+    cache window is fetched once, lazily, on the first window that
+    needs a resident row."""
+    rank = self._own(name, rank)
+    img = self.images[name][rank]
+    grps = self.resident_grps[name][rank]
+    lay = self.tplan.by_name(name).layout_logical
+    cache: Dict[str, np.ndarray] = {}
+
+    def read(p0: int, p1: int) -> np.ndarray:
+      win = img[p0:p1].copy()
+      sel = np.where((grps >= p0) & (grps < p1))[0]
+      if sel.size:
+        if "rows" not in cache:
+          cache["rows"] = self._rank_cache_rows(fused, name, rank)
+        # mirror host_scatter_rows' bounds discipline on the window
+        self.check_rows(name, rank, grps[sel])
+        win[grps[sel] - p0] = cache["rows"][sel]
+      assert win.shape[1] == lay.phys_width
+      return win
+
+    return read
+
+
+class TierStoreSnapshot:
+  """Frozen, reconciled copy of a :class:`HostTierStore`'s checkpoint
+  surface.
+
+  Duck-types exactly what ``checkpoint.save``'s tier path reads —
+  ``tplan``/``plan``, ``owned_ranks``/``owns_all``, ``images``,
+  ``resident_grps``, ``resident_map``, ``counts`` — with ``flush`` a
+  no-op because the resident rows were already scattered into the image
+  COPIES at construction. This is what lets ``snapshot(async_=True)``
+  coexist with a live mutable store: the writer thread serializes this
+  view while the training loop keeps gathering/scattering the real one.
+  """
+
+  def __init__(self, store: HostTierStore, fused: Dict[str, jax.Array]):
+    self.tplan = store.tplan
+    self.plan = store.plan
+    self.dtype = store.dtype
+    self.owned_ranks = store.owned_ranks
+    owned = frozenset(store.owned_ranks)
+    self.images: Dict[str, List[Optional[np.ndarray]]] = {}
+    self.resident_map: Dict[str, List[np.ndarray]] = {}
+    self.resident_grps: Dict[str, List[np.ndarray]] = {}
+    self.counts: Dict[str, List[np.ndarray]] = {}
+    for name in store.images:
+      lay = store.tplan.by_name(name).layout_logical
+      imgs: List[Optional[np.ndarray]] = []
+      for rank in range(store.plan.world_size):
+        if rank not in owned:
+          imgs.append(None)
+          continue
+        img = store.images[name][rank].copy()
+        host_scatter_rows(lay, img, store.resident_grps[name][rank],
+                          store._rank_cache_rows(fused, name, rank))
+        imgs.append(img)
+      self.images[name] = imgs
+      self.resident_map[name] = [m.copy()
+                                 for m in store.resident_map[name]]
+      self.resident_grps[name] = [g.copy()
+                                  for g in store.resident_grps[name]]
+      self.counts[name] = [c.copy() for c in store.counts[name]]
+
+  @property
+  def owns_all(self) -> bool:
+    return len(self.owned_ranks) == self.plan.world_size
+
+  def flush(self, fused: Dict[str, jax.Array]) -> None:
+    """No-op: the view was reconciled at construction time."""

@@ -253,13 +253,15 @@ class TieredTrainer:
                exact: bool = False,
                donate: bool = True,
                guard: bool = False,
-               telemetry=None):
+               telemetry=None,
+               overlap_host: bool = False):
     self.tplan = tplan
     self.store = store
     self.mesh = mesh
     self.axis_name = axis_name
     self.state = state
     self.guard = guard
+    self.overlap_host = overlap_host
     # hit/lookup counters emit here (default: the process registry);
     # the prefetcher shares it so one registry sees the whole protocol
     self.telemetry = telemetry if telemetry is not None else _registry()
@@ -388,7 +390,16 @@ class TieredTrainer:
 
   def run(self, batches: Iterable) -> list:
     """Train over ``batches`` of ``(numerical, cats, labels)`` with the
-    classify stage prefetched one batch ahead of the device step."""
+    classify stage prefetched one batch ahead of the device step.
+
+    With ``overlap_host=True`` the WHOLE host pass for batch k+1
+    (classify + cold-row gather) runs on the pipeline worker while step
+    k executes on device, with write-back conflicts repaired afterward
+    — bit-exact with this serial loop (see
+    ``pipeline.run_tiered_overlapped``)."""
+    if self.overlap_host:
+      from ..pipeline import run_tiered_overlapped
+      return run_tiered_overlapped(self, batches)
     losses = []
     it = iter(batches)
     nxt = next(it, None)

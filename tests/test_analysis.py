@@ -1260,3 +1260,91 @@ def publish():
         "delta publication is a single-controller operation")
 """)
   assert lint_paths([str(f)], root=str(tmp_path), rules=["GL118"]) == []
+
+
+# ---------------------------------------------------------------------------
+# GL119: raw thread/executor construction next to the step loop
+# ---------------------------------------------------------------------------
+
+
+def test_gl119_raw_thread_in_step_adjacent_module():
+  """threading.Thread construction in the training packages that sit
+  next to the step loop: pipeline.HostWorker is the one sanctioned
+  overlap surface (one worker, joined before accounting, failures
+  re-raised as step failures, spans on the shared trace)."""
+  src = """
+import threading
+
+def start(self):
+  t = threading.Thread(target=self._loop, daemon=True)
+  t.start()
+"""
+  out = lint_source(src, "distributed_embeddings_tpu/tiering/prefetch.py",
+                    CTX, ["GL119"])
+  assert _rules(out) == ["GL119"]
+  assert "pipeline.HostWorker" in out[0].message
+  assert "threading.Thread" in out[0].message
+
+
+def test_gl119_alias_and_executor_forms():
+  """Renames and from-imports are not a bypass, and executors count the
+  same as bare threads."""
+  src = """
+import threading as thr
+from threading import Thread as T
+from concurrent.futures import ThreadPoolExecutor
+from concurrent import futures
+
+def overlap():
+  a = thr.Thread(target=work)
+  b = T(target=work)
+  c = ThreadPoolExecutor(max_workers=2)
+  d = futures.ProcessPoolExecutor()
+  return a, b, c, d
+"""
+  out = lint_source(src, "distributed_embeddings_tpu/dynvocab/trainer.py",
+                    CTX, ["GL119"])
+  assert _rules(out) == ["GL119"] * 4
+  assert "concurrent.futures.ThreadPoolExecutor" in out[2].message
+
+
+def test_gl119_scope_and_suppression():
+  src = """
+import threading
+
+def start(self):
+  return threading.Thread(target=self._poll)
+"""
+  # pipeline.py IS the sanctioned home of the worker thread
+  assert lint_source(src, "distributed_embeddings_tpu/pipeline.py",
+                     CTX, ["GL119"]) == []
+  # training.py sits next to the step loop: in scope
+  assert _rules(lint_source(src, "distributed_embeddings_tpu/training.py",
+                            CTX, ["GL119"])) == ["GL119"]
+  # serving/fleet run their own audited pools; layers never thread;
+  # tools and tests drive their own harnesses
+  for path in ("distributed_embeddings_tpu/serving/batcher.py",
+               "distributed_embeddings_tpu/fleet/transport.py",
+               "distributed_embeddings_tpu/layers/embedding.py",
+               "tools/chaos_thing.py", "tests/test_thing.py"):
+    assert lint_source(src, path, CTX, ["GL119"]) == [], path
+  # a long-lived service thread suppresses with its reason
+  sup = """
+import threading
+
+def start(self):
+  self._writer = threading.Thread(target=self._write,  # graftlint: disable=GL119
+                                  daemon=True)
+"""
+  assert lint_source(sup, "distributed_embeddings_tpu/resilience/trainer.py",
+                     CTX, ["GL119"]) == []
+  # a Thread ATTRIBUTE access (isinstance checks, current_thread) is use,
+  # not construction — only the constructor call is flagged
+  ok = """
+import threading
+
+def is_worker():
+  return threading.current_thread().name == "host-pipeline"
+"""
+  assert lint_source(ok, "distributed_embeddings_tpu/tiering/prefetch.py",
+                     CTX, ["GL119"]) == []

@@ -1,0 +1,420 @@
+"""Host-device overlap scheduler tests (`distributed_embeddings_tpu/pipeline.py`).
+
+The contract under test: running batch k+1's host pass (tiered
+classify + cold-row gather, dynvocab translation) on the pipeline
+worker while step k executes on device is a SCHEDULING change, never a
+numerics change —
+
+- a tiered overlapped run is bit-exact vs the serial loop it shadows:
+  losses, fused device state, host images, and the tier counters — with
+  the guard on, across guard-skipped (NaN) steps, and across re-rank
+  boundaries (where overlap is deferred like the serial loop defers its
+  look-ahead classify);
+- a dynvocab overlapped run is bit-exact vs serial — losses, fused
+  state, AND the translator's id space (the worker mutates it in batch
+  order, exactly the serial sequence) — across worlds and micro-batch
+  accumulation;
+- `overlap_host=False` (the default) never calls into pipeline.py at
+  all: the serial paths are a true no-op, proven by poisoning the
+  schedulers and running serially anyway;
+- a worker-job failure FAILS THE STEP: the exception re-raises out of
+  ``run`` on the main thread — there is no silent fall-back to the
+  serial path;
+- under the ResilientTrainer, the overlapped run snapshots/accounts
+  identically to serial, an async snapshot of the live tiered store
+  goes through the copy-on-snapshot view and restores to the same
+  trajectory, and an injected kill mid-overlap (crash during the
+  checkpoint write while a worker job is in flight) auto-resumes to a
+  bit-exact tail.
+"""
+
+import os
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from distributed_embeddings_tpu import pipeline
+from distributed_embeddings_tpu import telemetry
+from distributed_embeddings_tpu.layers.dist_model_parallel import (
+    get_weights,
+    set_weights,
+)
+from distributed_embeddings_tpu.models import DLRM, bce_loss
+from distributed_embeddings_tpu.ops.packed_table import sparse_rule
+from distributed_embeddings_tpu.parallel import create_mesh
+from distributed_embeddings_tpu.pipeline import HostWorker
+from distributed_embeddings_tpu.resilience import (
+    FaultInjector,
+    InjectedCrash,
+    durable,
+    faultinject,
+)
+from distributed_embeddings_tpu.resilience.trainer import ResilientTrainer
+from distributed_embeddings_tpu.tiering import (
+    HostTierStore,
+    TieredTrainer,
+    TieringConfig,
+    TieringPlan,
+    init_tiered_state_from_params,
+)
+from distributed_embeddings_tpu.training import shard_params
+
+import test_dynvocab as tdv
+import test_tiering as tt
+
+
+# ---------------------------------------------------------------------------
+# HostWorker unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_worker_runs_jobs_in_submission_order():
+  seen = []
+  with HostWorker("t") as w:
+    jobs = [w.submit(lambda i=i: (seen.append(i), i * i)[1], label="j")
+            for i in range(16)]
+    results = [w.result(j)[0] for j in jobs]
+  assert seen == list(range(16))  # one thread, FIFO — never reordered
+  assert results == [i * i for i in range(16)]
+  assert all(w.result(j)[1] >= 0.0 for j in jobs)
+
+
+def test_worker_reraises_job_error_and_survives():
+  def boom():
+    raise ValueError("job exploded")
+  with HostWorker("t") as w:
+    bad = w.submit(boom, label="j")
+    ok = w.submit(lambda: 7, label="j")
+    with pytest.raises(ValueError, match="job exploded"):
+      w.result(bad)
+    # one failed job does not poison the worker: later jobs still run
+    assert w.result(ok)[0] == 7
+
+
+def test_worker_submit_after_close_refuses():
+  w = HostWorker("t")
+  w.close()
+  w.close()  # idempotent
+  with pytest.raises(RuntimeError, match="closed"):
+    w.submit(lambda: None)
+
+
+def test_worker_close_drains_discarded_jobs():
+  # a prepared-ahead job whose result is deliberately dropped (SIGTERM
+  # drain) must not wedge or raise at close
+  done = []
+  w = HostWorker("t")
+  w.submit(lambda: done.append(1), label="j")
+  w.close()
+  assert done == [1]
+
+
+# ---------------------------------------------------------------------------
+# tiered: overlap-ON is bit-exact vs serial (guard, NaN skip, re-rank)
+# ---------------------------------------------------------------------------
+
+
+def _tiered_trainer(overlap, batch0):
+  """A guarded tiered trainer from DETERMINISTIC params, with re-rank
+  every 3 steps so the paired runs cross re-rank boundaries."""
+  plan_b = tt._plan(None)
+  plan_t = tt._plan(1000)
+  model = tt._model()
+  mesh = create_mesh(tt.WORLD)
+  rule = sparse_rule("adagrad", 0.05)
+  opt = optax.adam(1e-3)
+  params_b = model.init(jax.random.PRNGKey(0), batch0[0], batch0[1])["params"]
+  tables_t = set_weights(plan_t, get_weights(plan_b, params_b["embeddings"]))
+  params_t = {k: v for k, v in params_b.items() if k != "embeddings"}
+  params_t["embeddings"] = {k: np.asarray(v) for k, v in tables_t.items()}
+  tplan = TieringPlan(plan_t, rule, TieringConfig(cache_fraction=0.3,
+                                                  staging_grps=64,
+                                                  rerank_interval=3))
+  store = HostTierStore(tplan)
+  state = shard_params(
+      init_tiered_state_from_params(tplan, store, rule, params_t, opt,
+                                    mesh=mesh), mesh)
+  return TieredTrainer(model, tplan, store, bce_loss, opt, rule, mesh,
+                       state, batch0, donate=False, guard=True,
+                       overlap_host=overlap)
+
+
+def test_tiered_overlap_bit_exact_vs_serial_with_guard_skip(monkeypatch):
+  """Serial vs overlapped tiered runs over one stream with a NaN batch
+  in the middle and re-rank boundaries inside the window: losses, fused
+  state, host images, and guard/tier accounting all bit-identical. The
+  serial arm runs with the scheduler poisoned — overlap_host=False
+  must never touch pipeline.py."""
+  batch0 = tt._batch(100)
+  batches = list(faultinject.nan_batches(
+      [tt._batch(200 + i) for i in range(7)], at_steps={2}))
+
+  t_ser = _tiered_trainer(False, batch0)
+  with monkeypatch.context() as m:
+    m.setattr(pipeline, "run_tiered_overlapped",
+              lambda *a, **k: pytest.fail("serial run called the scheduler"))
+    losses_ser = t_ser.run(batches)
+
+  t_ovl = _tiered_trainer(True, batch0)
+  repairs = {"n": 0}
+  orig_repair = t_ovl.prefetcher.repair_conflicts
+
+  def counted_repair(*a, **k):
+    repairs["n"] += 1
+    return orig_repair(*a, **k)
+  t_ovl.prefetcher.repair_conflicts = counted_repair
+  reg = telemetry.get_registry()
+  h0 = reg.histogram("tiered/overlap_hidden_s").count
+  losses_ovl = t_ovl.run(batches)
+
+  # float-for-float identical (equal_nan covers the skipped step's NaN)
+  np.testing.assert_allclose(losses_ser, losses_ovl, rtol=0, atol=0)
+  assert not np.isfinite(losses_ovl[2])  # the poison batch skipped
+  assert t_ser.bad_steps == t_ovl.bad_steps == 1
+  assert t_ser.steps == t_ovl.steps
+  for name in t_ser.hits:
+    assert np.array_equal(t_ser.hits[name], t_ovl.hits[name]), name
+  # the scheduler actually overlapped (and repaired write-back hazards)
+  assert reg.histogram("tiered/overlap_hidden_s").count > h0
+  assert repairs["n"] >= 1
+  # full state parity: fused device buffers and flushed host images
+  for name in t_ser.state["fused"]:
+    assert np.array_equal(np.asarray(t_ser.state["fused"][name]),
+                          np.asarray(t_ovl.state["fused"][name])), name
+  t_ser.flush()
+  t_ovl.flush()
+  for name, imgs in t_ser.store.images.items():
+    for r, img in enumerate(imgs):
+      np.testing.assert_array_equal(img, t_ovl.store.images[name][r],
+                                    err_msg=f"{name} rank {r}")
+
+
+def test_tiered_worker_failure_fails_the_run():
+  """A broken host pass on the worker must surface as the step's
+  exception — never a silent serial fall-back."""
+  batch0 = tt._batch(100)
+  t = _tiered_trainer(True, batch0)
+
+  def broken_gather(cold):
+    raise RuntimeError("cold store unreachable")
+  t.prefetcher.gather_cold = broken_gather  # only the worker job calls it
+  with pytest.raises(RuntimeError, match="cold store unreachable"):
+    t.run([tt._batch(300 + i) for i in range(3)])
+
+
+# ---------------------------------------------------------------------------
+# dynvocab: translate-ahead is bit-exact vs serial
+# ---------------------------------------------------------------------------
+
+
+def _dynvocab_trainer(world, overlap, batch0, micro_batches=1):
+  plan = tdv._plan(world, oov="allocate")
+  _, _, trainer = tdv._fresh(world, plan, batch0, guard=True,
+                             micro_batches=micro_batches)
+  trainer.overlap_host = overlap
+  # pre-admit the identity mapping so both arms train the same rows
+  trainer.translator.translate_batch(
+      [np.arange(v, dtype=np.int64) for v in tdv.VOCAB])
+  return trainer
+
+
+@pytest.mark.parametrize("world,micro_batches", [(1, 1), (2, 1), (4, 2)])
+def test_dynvocab_overlap_bit_exact_vs_serial(world, micro_batches,
+                                              monkeypatch):
+  batch0 = tdv._batch(100)
+  batches = [tdv._batch(200 + s) for s in range(5)]
+
+  t_ser = _dynvocab_trainer(world, False, batch0, micro_batches)
+  with monkeypatch.context() as m:
+    m.setattr(pipeline, "run_dynvocab_overlapped",
+              lambda *a, **k: pytest.fail("serial run called the scheduler"))
+    losses_ser = t_ser.run(batches)
+
+  t_ovl = _dynvocab_trainer(world, True, batch0, micro_batches)
+  reg = telemetry.get_registry()
+  h0 = reg.histogram("dynvocab/overlap_hidden_s").count
+  losses_ovl = t_ovl.run(batches)
+
+  assert losses_ser == losses_ovl
+  assert reg.histogram("dynvocab/overlap_hidden_s").count > h0
+  for name in t_ser.state["fused"]:
+    assert np.array_equal(np.asarray(t_ser.state["fused"][name]),
+                          np.asarray(t_ovl.state["fused"][name])), name
+  # the id space evolved through the identical mutation sequence
+  tr_s, tr_o = t_ser.translator, t_ovl.translator
+  for t in tr_s.dynamic_tables:
+    a, b = tr_s.tables[t].items(), tr_o.tables[t].items()
+    assert np.array_equal(a[0], b[0]) and np.array_equal(a[1], b[1])
+    assert np.array_equal(tr_s.totals[t], tr_o.totals[t])
+
+
+def test_dynvocab_worker_failure_fails_the_run():
+  batch0 = tdv._batch(100)
+  t = _dynvocab_trainer(2, True, batch0)
+  orig = t.engine.translate_dynamic_ids
+  calls = {"n": 0}
+
+  def flaky(cats, translator):
+    calls["n"] += 1
+    if calls["n"] >= 2:  # first call serves batch 0 on the main thread
+      raise RuntimeError("translator wedged")
+    return orig(cats, translator)
+  t.engine.translate_dynamic_ids = flaky
+  with pytest.raises(RuntimeError, match="translator wedged"):
+    t.run([tdv._batch(300 + s) for s in range(3)])
+  assert calls["n"] == 2  # the failure came from the worker's call
+
+
+# ---------------------------------------------------------------------------
+# ResilientTrainer: overlap x snapshots x chaos
+# ---------------------------------------------------------------------------
+
+_RVOCAB = [5000, 300, 40]
+
+
+def _resilient_tiered(tmp_path, root, seed, overlap, async_snapshots=False):
+  """The test_resilience tiered fixture, with the overlap/async knobs."""
+  world = 4
+  mesh = create_mesh(world)
+  plan = tt._plan(1000, _RVOCAB)
+  model = DLRM(vocab_sizes=_RVOCAB, embedding_dim=16, bottom_mlp=(32, 16),
+               top_mlp=(32, 1), world_size=world,
+               strategy="memory_balanced", dense_row_threshold=0)
+  rule = sparse_rule("adagrad", 0.05)
+  opt = optax.adam(1e-3)
+  batch0 = tt._batch(0, _RVOCAB)
+  tplan = TieringPlan(plan, rule, TieringConfig(cache_fraction=0.3,
+                                                staging_grps=64,
+                                                rerank_interval=3))
+  store = HostTierStore(tplan)
+  params = model.init(jax.random.PRNGKey(seed), batch0[0],
+                      batch0[1])["params"]
+  plan_b = tt._plan(None, _RVOCAB)
+  params_b = model.init(jax.random.PRNGKey(0), batch0[0],
+                        batch0[1])["params"]
+  tables_t = set_weights(tplan.plan,
+                         get_weights(plan_b, params_b["embeddings"]))
+  params = {k: v for k, v in params.items() if k != "embeddings"}
+  params["embeddings"] = {k: np.asarray(v) for k, v in tables_t.items()}
+  state = shard_params(
+      init_tiered_state_from_params(tplan, store, rule, params, opt,
+                                    mesh=mesh), mesh)
+  tt_trainer = TieredTrainer(model, tplan, store, bce_loss, opt, rule,
+                             mesh, state, batch0, donate=False, guard=True,
+                             overlap_host=overlap)
+  return ResilientTrainer(None, None, plan, rule,
+                          os.path.join(str(tmp_path), root), mesh=mesh,
+                          snapshot_every=2, tiered=tt_trainer,
+                          overlap_host=overlap,
+                          async_snapshots=async_snapshots)
+
+
+def _rstream():
+  batches = [tt._batch(500 + i, _RVOCAB) for i in range(6)]
+  return list(faultinject.nan_batches(batches, at_steps={3}))
+
+
+def test_resilient_tiered_overlap_parity_async_and_kill_resume(tmp_path):
+  """One stream (NaN batch included), three arms against a serial sync
+  reference: (a) overlapped + ASYNC snapshots lands the identical
+  trajectory and accounting — the copy-on-snapshot store view snapshots
+  a live mutating store mid-overlap; (b) a resume from those
+  async-written snapshots replays a bit-exact tail; (c) an injected
+  crash during the second snapshot's writes — mid-run, worker job in
+  flight — auto-resumes from the first snapshot to a bit-exact tail."""
+  batches = _rstream()
+
+  ref = _resilient_tiered(tmp_path, "ref", 7, overlap=False)
+  with faultinject.injected(FaultInjector()) as probe:
+    ref_losses = ref.run(batches)
+  writes = probe.count("ckpt_write")
+  n_snaps = len(durable.list_checkpoints(os.path.join(str(tmp_path),
+                                                      "ref")))
+  assert n_snaps > 0 and writes % n_snaps == 0
+  per_snap = writes // n_snaps
+
+  # (a) overlap + async snapshots: identical losses and accounting
+  ovl = _resilient_tiered(tmp_path, "run", 7, overlap=True,
+                          async_snapshots=True)
+  losses = ovl.run(batches)
+  ovl.close()
+  np.testing.assert_allclose(losses, ref_losses, rtol=0, atol=0)
+  assert not np.isfinite(losses[3])
+  assert (ovl.step_count, ovl.skipped_steps, ovl.consumed) == \
+      (ref.step_count, ref.skipped_steps, ref.consumed)
+  steps_ref = [s for s, _ in durable.list_checkpoints(
+      os.path.join(str(tmp_path), "ref"))]
+  steps_run = [s for s, _ in durable.list_checkpoints(
+      os.path.join(str(tmp_path), "run"))]
+  assert steps_ref == steps_run  # async view published the same snapshots
+
+  # (b) resume from the async-written root: bit-exact tail (different
+  # init seed — the restore must overwrite it)
+  res = _resilient_tiered(tmp_path, "run", 99, overlap=True)
+  assert res.resumed_from is not None
+  start = res.consumed
+  assert 0 < start <= len(batches)
+  tail = res.run(batches[start:])
+  np.testing.assert_allclose(tail, ref_losses[start:], rtol=0, atol=0)
+
+  # (c) crash on the second write of the SECOND snapshot: mid-run, with
+  # the overlap worker active; snapshot 1 is durable, the run dies
+  kill = _resilient_tiered(tmp_path, "kill", 7, overlap=True)
+  with faultinject.injected(
+      FaultInjector().crash_after("ckpt_write", per_snap + 1)):
+    with pytest.raises(InjectedCrash):
+      kill.run(batches)
+  res2 = _resilient_tiered(tmp_path, "kill", 98, overlap=True)
+  assert res2.resumed_from is not None
+  start2 = res2.consumed
+  assert 0 < start2 < len(batches)
+  tail2 = res2.run(batches[start2:])
+  np.testing.assert_allclose(tail2, ref_losses[start2:], rtol=0, atol=0)
+
+
+def _resilient_dynvocab(tmp_path, root, overlap, batch0):
+  plan = tdv._plan(2, oov="allocate", admit_threshold=1)
+  _, mesh, dvt = tdv._fresh(2, plan, batch0, guard=True)
+  dvt.overlap_host = overlap
+  return ResilientTrainer(None, None, plan, tdv.RULE,
+                          os.path.join(str(tmp_path), root), mesh=mesh,
+                          snapshot_every=2, resume=True, dynvocab=dvt,
+                          overlap_host=overlap)
+
+
+def test_resilient_dynvocab_overlap_parity_and_resume(tmp_path):
+  """Overlapped dynvocab under the ResilientTrainer: same losses, fused
+  state, and id space as serial (the snapshot-deferral predicate keeps
+  every snapshot's translator at the consumed-stream position), and an
+  interrupted overlapped run resumes from its snapshots bit-exactly."""
+  batch0 = tdv._batch(100)
+  stream = [tdv._batch(700 + s) for s in range(6)]
+
+  ref = _resilient_dynvocab(tmp_path, "ref", False, batch0)
+  ref_losses = ref.run(stream)
+
+  ovl = _resilient_dynvocab(tmp_path, "run", True, batch0)
+  losses = ovl.run(stream)
+  assert losses == ref_losses
+  assert (ovl.step_count, ovl.consumed) == (ref.step_count, ref.consumed)
+  tr_ref, tr_ovl = ref.dynvocab.translator, ovl.dynvocab.translator
+  for t in tr_ref.dynamic_tables:
+    a, b = tr_ref.tables[t].items(), tr_ovl.tables[t].items()
+    assert np.array_equal(a[0], b[0]) and np.array_equal(a[1], b[1])
+    assert np.array_equal(tr_ref.totals[t], tr_ovl.totals[t])
+  for name in ref.state["fused"]:
+    assert np.array_equal(np.asarray(ref.state["fused"][name]),
+                          np.asarray(ovl.state["fused"][name])), name
+
+  # interrupted overlapped run: consume 4 batches, drop the trainer,
+  # resume a fresh overlapped one from the snapshots
+  t1 = _resilient_dynvocab(tmp_path, "cut", True, batch0)
+  first = t1.run(stream[:4])
+  t2 = _resilient_dynvocab(tmp_path, "cut", True, batch0)
+  assert t2.resumed_from is not None
+  start = t2.consumed
+  assert 0 < start <= 4
+  rest = t2.run(stream[start:])
+  assert first[:start] + rest == ref_losses

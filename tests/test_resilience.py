@@ -343,17 +343,49 @@ def test_async_snapshot_failure_propagates_at_join(tmp_path):
   assert not checkpoint.verify(path)
 
 
-def test_async_snapshot_rejects_live_store(tmp_path):
-  """A HostTierStore's images are live mutable host state — checkpoint
-  .save both reads and writes them, so a background save would tear the
-  blocks it checksums. Rejected up front."""
+def test_async_snapshot_store_view_is_frozen_and_reconciled():
+  """A HostTierStore's images are live mutable host state, which used to
+  refuse async snapshots outright. ``snapshot(async_=True)`` now hands
+  the writer ``store.snapshot_view(fused)``: owned images COPIED with
+  the resident rows' device values scattered in (the same reconciliation
+  ``flush`` applies to the live images) — frozen at the call, immune to
+  later training/overlap mutation, and byte-identical to the flush-free
+  ``overlay_reader`` at the same instant. The end-to-end async-tiered
+  restore parity lives in test_pipeline.py."""
   mesh = create_mesh(WORLD)
-  batches, fresh_trainer = _trainer_fixture(tmp_path, mesh,
-                                            snapshot_every=0)
-  t = fresh_trainer("async_store")
-  t.store = object()  # stand-in: presence alone must refuse
-  with pytest.raises(NotImplementedError, match="HostTierStore"):
-    t.snapshot(async_=True)
+  _, tplan, store = _tiered_fixture()
+  fused = store.build_fused(mesh=mesh)
+  name = next(iter(tplan.tier_specs))
+  phys = tplan.by_name(name).layout_logical.phys_rows
+  # drift the live image under the resident rows: between flushes the
+  # device cache is authoritative there and the image copies go stale
+  for r in store.owned_ranks:
+    grps = store.resident_grps[name][r]
+    assert grps.size > 0
+    store.images[name][r][grps] += 3.0
+  live_before = {r: store.images[name][r].copy() for r in store.owned_ranks}
+
+  view = store.snapshot_view(fused)
+  for r in store.owned_ranks:
+    # taking the view never mutates the live image (flush would have)
+    np.testing.assert_array_equal(store.images[name][r], live_before[r])
+    # the view equals the flush-free overlay read of the whole image,
+    # and both took the DEVICE values at the resident rows, not the
+    # stale image bytes
+    read = store.overlay_reader(name, r, fused)
+    np.testing.assert_array_equal(view.images[name][r], read(0, phys))
+    grps = store.resident_grps[name][r]
+    assert not np.array_equal(view.images[name][r][grps],
+                              live_before[r][grps])
+
+  # later mutation of the live store (training, the overlap worker)
+  # cannot reach the frozen view, and the view's flush is a no-op
+  frozen = {r: view.images[name][r].copy() for r in store.owned_ranks}
+  for r in store.owned_ranks:
+    store.images[name][r] += 1.0
+  view.flush(fused)
+  for r in store.owned_ranks:
+    np.testing.assert_array_equal(view.images[name][r], frozen[r])
 
 
 # ---------------------------------------------------------------------------

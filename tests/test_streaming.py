@@ -369,6 +369,39 @@ def test_delta_parity_tiered(tmp_path, world, quantize):
   assert all(int(v[2]) == 0 for v in ma["tier"].values())  # no misses
 
 
+def test_delta_extraction_is_flush_free(tmp_path):
+  """publish_delta reads tiered classes through the store's overlay
+  reader: the live host images are NOT mutated (no bulk flush, no
+  device_get of the whole cache), yet the shipped bytes fold to the
+  full export — so the trainer-side overlap worker can keep gathering
+  cold rows from the images while a publish is extracting."""
+  (plan, model, mesh, rule, trainer, store, publisher, sub, cfg,
+   batch) = _tiered_run(tmp_path, 2, "f32")
+  bt = batch(300)
+  publisher.observe_batch(bt[1])
+  trainer.step(*bt)
+  before = {name: [None if img is None else img.copy()
+                   for img in imgs]
+            for name, imgs in store.images.items()}
+  assert publisher.publish_delta(trainer.state) is not None
+  for name, imgs in store.images.items():
+    for r, img in enumerate(imgs):
+      if img is not None:
+        np.testing.assert_array_equal(img, before[name][r],
+                                      err_msg=f"{name} rank {r}")
+  # and the flush-free bytes still land the exact serve state
+  assert sub.poll_once() == 2
+  full = os.path.join(str(tmp_path), "full")
+  serve_export(full, plan, rule, trainer.state, quantize="f32",
+               store=store)
+  art = serve_load(full, plan, mesh=mesh)
+  for name, images in art.host_images.items():
+    for r, img in enumerate(images):
+      np.testing.assert_array_equal(
+          sub.engine.store.images[name][r].view(np.uint8),
+          np.asarray(img).view(np.uint8))
+
+
 def test_tiered_hot_set_adapts_to_shipped_counts(tmp_path):
   """The publisher's counts re-rank the serve cache: after the fold,
   every rank's resident set is a top-count set under the shipped

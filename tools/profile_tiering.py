@@ -8,7 +8,12 @@ would defeat the cache) and reports, per (alpha, cache_fraction) point:
   - hot-tier cache hit rate (cumulative over the run)
   - host-gather bytes/step (the staging upload the cold tier costs)
   - spill steps (batches whose deduped cold rows overflowed staging)
-  - wall-clock step time, tiered vs. the all-device baseline
+  - wall-clock step time, tiered vs. the all-device baseline, SPLIT
+    into its host-pipeline part (classify + stage + write-back +
+    re-rank, summed from the trace spans) and its device part
+    (``device/step`` windows) — the split is what the overlap scheduler
+    (``pipeline.py``, ``tools/profile_overlap.py``) can hide: serial
+    wall ~ host + device, overlapped wall ~ max(host, device)
 
 CPU-mesh numbers size the PROTOCOL (hit rate, bytes, spills are platform
 independent); real-TPU host-gather bandwidth is a ROADMAP open item.
@@ -56,6 +61,7 @@ from distributed_embeddings_tpu.training import (  # noqa: E402
     shard_batch,
     shard_params,
 )
+from distributed_embeddings_tpu import telemetry  # noqa: E402
 
 WORLD = 4
 VOCAB = [200_000, 20_000, 300]
@@ -64,6 +70,20 @@ BATCH = 512
 STEPS = 24
 WARM = 4
 STAGING = 2048
+
+
+# the serial step's host-pipeline stages (everything the overlap worker
+# could hide) vs the device window, summed from the trace span durations
+HOST_SPANS = ("tiered/classify", "tiered/stage", "tiered/write_back",
+              "tiered/rerank")
+
+
+def _host_device_ms(events, n_steps):
+  host = sum(dur for ph, _track, name, _t0, dur, _args in events
+             if ph == "X" and name in HOST_SPANS)
+  dev = sum(dur for ph, _track, name, _t0, dur, _args in events
+            if ph == "X" and name == "device/step")
+  return host / n_steps / 1e6, dev / n_steps / 1e6
 
 
 def make_batches(alpha, n):
@@ -124,7 +144,7 @@ def main():
   print(f"all-device baseline: {base_ms:7.2f} ms/step")
 
   hdr = (f"{'alpha':>5} {'cache%':>6} | {'hit%':>6} {'gatherB/step':>12} "
-         f"{'spills':>6} {'ms/step':>8}")
+         f"{'spills':>6} {'ms/step':>8} {'host-ms':>8} {'dev-ms':>8}")
   print(hdr)
   print("-" * len(hdr))
   for alpha in (1.05, 1.2):
@@ -146,13 +166,20 @@ def main():
       trainer.steps = 0
       trainer.prefetcher.total_host_gather_bytes = 0
       trainer.prefetcher.spill_steps = 0
-      t0 = time.perf_counter()
-      trainer.run(batches[WARM:])
-      dt = (time.perf_counter() - t0) / (STEPS - WARM)
+      tracer = telemetry.Tracer()
+      telemetry.install_tracer(tracer)
+      try:
+        t0 = time.perf_counter()
+        trainer.run(batches[WARM:])
+        dt = (time.perf_counter() - t0) / (STEPS - WARM)
+      finally:
+        telemetry.uninstall_tracer()
+      host_ms, dev_ms = _host_device_ms(tracer.events(), STEPS - WARM)
       m = trainer.metrics_summary()
       print(f"{alpha:5.2f} {frac * 100:5.0f}% | {m['hit_rate'] * 100:5.1f}% "
             f"{m['host_gather_bytes'] // m['steps']:12,} "
-            f"{m['spill_steps']:6d} {dt * 1e3:8.2f}")
+            f"{m['spill_steps']:6d} {dt * 1e3:8.2f} {host_ms:8.2f} "
+            f"{dev_ms:8.2f}")
 
 
 if __name__ == "__main__":
