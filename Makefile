@@ -15,8 +15,12 @@ SHELL := /bin/bash  # verify uses pipefail/PIPESTATUS
 test:
 	$(PY) -m pytest tests/ -q
 
-# repo-invariant linter: AST rules (GL1xx) + trace-time jaxpr audit of
-# the step builders against committed fingerprints (tests/data/).
+# repo-invariant linter: AST rules (GL1xx, incl. GL124 stale
+# suppressions), the concurrency pass (threadlint GL120-GL123 lock
+# discipline + GL125 thread-root registry — library package only,
+# sharing the one pyproject/repo context parse, so verify cost stays
+# flat) + trace-time jaxpr audit of the step builders against committed
+# fingerprints (tests/data/).
 # Regenerate fingerprints after an INTENTIONAL structural change with
 #   $(PY) tools/graftlint.py --update-fingerprints
 lint:

@@ -11,7 +11,16 @@ makes the rules machine-checked:
 
 - :mod:`astlint`: an AST lint pass over the repo's Python sources with a
   rule registry (`GL1xx` rules, error/warning severity, line-level
-  ``# graftlint: disable=RULE`` suppressions).
+  ``# graftlint: disable=RULE`` suppressions; GL124 reports stale
+  suppressions so the swept baseline cannot rot).
+- :mod:`threadlint`: the concurrency pass — ``# guarded-by: <lock>``
+  annotation discipline (GL120), lock-acquisition-graph cycles (GL121),
+  attributes mutated from multiple thread roots with no synchronization
+  (GL122), condition-variable misuse (GL123), and a two-way cross-check
+  of the ``pyproject.toml [tool.graftlint] thread-roots`` registry
+  against discovered Thread/executor roots (GL125). The runtime half is
+  :mod:`..telemetry.lockorder`, a test-time lock wrapper validating
+  actual acquisition order against the static graph.
 - :mod:`jaxpr_audit`: abstractly traces the REAL step builders
   (``make_sparse_train_step`` guarded and not, ``make_tiered_train_step``,
   the fused eval step) on a virtual CPU mesh via ``jax.make_jaxpr`` and
@@ -19,8 +28,8 @@ makes the rules machine-checked:
   per-artifact "jaxpr fingerprint" (op-class counts) so regressions diff
   loudly.
 
-``tools/graftlint.py`` (``make lint``) runs both; ``make verify`` runs
-lint before the tier-1 tests.
+``tools/graftlint.py`` (``make lint``) runs all three; ``make verify``
+runs lint before the tier-1 tests.
 """
 
 from .astlint import (  # noqa: F401
@@ -29,5 +38,6 @@ from .astlint import (  # noqa: F401
     lint_paths,
     lint_source,
 )
+from . import threadlint  # noqa: F401
 
-__all__ = ["Finding", "RULES", "lint_paths", "lint_source"]
+__all__ = ["Finding", "RULES", "lint_paths", "lint_source", "threadlint"]

@@ -92,7 +92,19 @@ GL119       error      no raw ``threading.Thread`` / executor
                        one sanctioned host/device overlap surface, so
                        overlap stays bit-exact, joined before
                        accounting, and on one trace
+GL124       error      every ``# graftlint: disable=<ID>`` comment must
+                       suppress a finding that actually fires on its
+                       line, and name a known rule id — stale or typo'd
+                       suppressions rot the swept baseline silently
+                       (ids owned by the threadlint pass are judged
+                       there; see ``EXTERNAL_RULE_IDS``)
 ==========  =========  =====================================================
+
+The concurrency rules GL120–GL123 and the thread-root registry check
+GL125 live in the sibling :mod:`.threadlint` pass (lock discipline,
+lock-graph cycles, multi-root mutation, condvar misuse) — same
+``Finding`` type, same suppression comment, run side by side by
+``tools/graftlint.py``.
 
 Trace-reachable scope (GL101/GL102) is structural: any function nested —
 at any depth — inside a module-level builder whose name matches
@@ -109,14 +121,24 @@ end to end.
 from __future__ import annotations
 
 import ast
+import io
 import os
 import re
+import tokenize
 from dataclasses import dataclass, field
-from typing import Callable, Dict, Iterable, List, Optional, Sequence
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 STEP_BUILDER_RE = re.compile(r"^make_\w*(step|eval)\w*$")
 DURABLE_PATH_RE = re.compile(r"(checkpoint|durable)")
 SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+# Rule ids owned by the threadlint pass (analysis.threadlint). GL124's
+# staleness judgment skips them here — a suppression for a concurrency
+# rule only looks stale to astlint because astlint never runs that rule
+# — and threadlint judges them in its own pass. A literal set (not an
+# import) keeps astlint importable standalone, the property the CLI's
+# --ast-only mode depends on.
+EXTERNAL_RULE_IDS = frozenset({"GL120", "GL121", "GL122", "GL123", "GL125"})
 
 # pytest's own marks — always registered
 BUILTIN_MARKS = frozenset({
@@ -1249,6 +1271,72 @@ def _parse_fault_sites(root: str) -> Optional[frozenset]:
 
 
 # ---------------------------------------------------------------------------
+# GL124: stale-suppression detection
+# ---------------------------------------------------------------------------
+
+
+def _suppression_comments(source: str) -> List[Tuple[int, List[str]]]:
+  """``(line, [rule ids])`` for every REAL ``# graftlint: disable``
+  comment. Scans tokenize COMMENT tokens, not raw lines: disable text
+  inside string literals (this repo's own lint-test fixtures) is not a
+  live suppression and must not be judged as one."""
+  out = []
+  try:
+    for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+      if tok.type == tokenize.COMMENT:
+        m = SUPPRESS_RE.search(tok.string)
+        if m:
+          ids = [s.strip() for s in m.group(1).split(",") if s.strip()]
+          out.append((tok.start[0], ids))
+  except (tokenize.TokenError, IndentationError):
+    pass
+  return out
+
+
+@_rule("GL124", "error",
+       "suppression comments must suppress something (no stale or "
+       "unknown-id disables)")
+def _check_stale_suppression(mod: ParsedModule) -> List[Finding]:
+  # Registered for the catalog and --list-rules; the real judgment is
+  # aggregate over the run's raw findings (a rule check cannot see the
+  # other rules' findings), so it lives in lint_source below.
+  return []
+
+
+def _stale_suppressions(mod: ParsedModule, raw: List[Finding],
+                        run_ids: Set[str]) -> List[Finding]:
+  """GL124 findings: disable comments whose ids fire nothing on their
+  line. Only ids whose rule actually RAN are judged (a partial-rules
+  lint must not call the others' suppressions stale), and threadlint's
+  ids (:data:`EXTERNAL_RULE_IDS`) are left to that pass."""
+  fired: Dict[int, Set[str]] = {}
+  for f in raw:
+    fired.setdefault(f.line, set()).add(f.rule)
+  out = []
+  for line, ids in _suppression_comments(mod.source):
+    for rid in ids:
+      if rid in ("all", "GL124") or rid in EXTERNAL_RULE_IDS:
+        continue
+      if rid not in RULES:
+        out.append(Finding(
+            "GL124", "error", mod.path, line,
+            f"unknown rule id {rid!r} in graftlint suppression — a "
+            "typo'd id suppresses nothing while looking reviewed; fix "
+            "the id (known: GL101..GL125) or delete the comment."))
+        continue
+      if rid not in run_ids:
+        continue
+      if rid not in fired.get(line, set()):
+        out.append(Finding(
+            "GL124", "error", mod.path, line,
+            f"suppression for {rid} suppresses nothing: no {rid} "
+            "finding fires on this line — the violation moved or was "
+            "fixed; delete the stale comment so the swept baseline "
+            "cannot rot."))
+  return out
+
+
+# ---------------------------------------------------------------------------
 # entry points
 # ---------------------------------------------------------------------------
 
@@ -1258,11 +1346,13 @@ def lint_source(source: str, path: str,
                 rules: Optional[Iterable[str]] = None) -> List[Finding]:
   """Lint one source string; returns unsuppressed findings."""
   mod = ParsedModule(path, source, ast.parse(source), ctx or LintContext())
-  out = []
+  run_ids = set(rules) if rules is not None else set(RULES)
+  raw = []
   for rule_id in sorted(rules or RULES):
-    for f in RULES[rule_id].check(mod):
-      if not mod.suppressed(f):
-        out.append(f)
+    raw.extend(RULES[rule_id].check(mod))
+  if "GL124" in run_ids:
+    raw.extend(_stale_suppressions(mod, raw, run_ids))
+  out = [f for f in raw if not mod.suppressed(f)]
   return sorted(out, key=lambda f: (f.path, f.line, f.rule))
 
 

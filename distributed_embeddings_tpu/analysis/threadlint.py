@@ -1,0 +1,951 @@
+"""Concurrency lint: lock discipline and race analysis (stdlib-only).
+
+PRs 11-18 made the library genuinely multi-threaded — the batcher's
+flusher/completer pair, the delta subscriber's poll thread, the fleet
+router's fan-out/hedge pools, the flight recorder's deferred dump, the
+compactor daemon — and every thread race shipped so far was found by
+eye.  This module makes the locking contracts machine-checked, the way
+:mod:`astlint` pinned the trace/durability contracts.
+
+The analyzer builds an explicit **concurrency model** per run:
+
+- *thread roots*: functions that start life on their own thread —
+  ``threading.Thread(target=...)`` targets (including ``self.*`` methods
+  passed through ``args``, the batcher's ``_guarded_loop`` idiom),
+  executor/`HostWorker` ``.submit(fn, ...)`` first arguments, resolved
+  to class methods, local defs, or module functions.  The model is
+  REGISTERED in ``pyproject.toml [tool.graftlint] thread-roots`` and
+  cross-checked both ways (GL125), so a new thread cannot appear
+  silently.
+- *locks*: attributes assigned from ``threading.Lock()`` / ``RLock()``
+  / ``Condition(...)`` (a ``Condition(self._lock)`` aliases to its
+  underlying lock: holding either is holding both), plus attributes
+  used as ``with self.<attr>:`` whose constructor passes the lock in
+  (the metrics classes' shared-registry-lock idiom).
+- *guards*: the annotation discipline below.
+
+Annotation grammar (trailing comments, like ``# graftlint: disable``)::
+
+    self._pending = []        # guarded-by: _lock
+    self._value = 0           # guarded-by: _lock [writes]
+    self.engine = engine      # guarded-by: engine.lock [writes]
+
+    def _take_batch_locked(self):  # requires-lock: _lock
+
+``guarded-by: <lock>`` on the attribute's assignment line declares that
+every read and write of the attribute (lexically, anywhere in the
+class) must happen inside ``with self.<lock>:`` — or inside a method
+annotated ``requires-lock: <lock>``, which states the caller-holds
+contract instead.  The ``[writes]`` qualifier restricts the check to
+mutations: the single-writer / racy-read-then-verify idioms (a metric's
+lock-free ``value`` property, the subscriber's ``eng = self.engine``
+re-check under the lock) stay legal without suppressions while the
+writes remain locked.  The dotted form ``a.b`` is satisfied by
+``with self.a.b:`` or ``with x.b:`` where ``x = self.a`` earlier in the
+same function (the subscriber's ``eng = self.engine; with eng.lock:``
+idiom).  ``__init__`` is exempt: ``Thread.start()`` is a happens-before
+edge, so construction-time writes need no lock.
+
+Rules (same suppression mechanism as astlint —
+``# graftlint: disable=<ID>`` on the finding's line):
+
+==========  =========  ====================================================
+ID          severity   invariant
+==========  =========  ====================================================
+GL120       error      every read/write of a ``guarded-by`` annotated
+                       attribute holds the named lock (lexically inside
+                       ``with self.<lock>``, or in a ``requires-lock``
+                       method); ``[writes]`` checks mutations only
+GL121       error      the repo-wide lock-acquisition graph (built from
+                       lexically nested ``with`` lock blocks, with
+                       ``requires-lock`` contracts as held context) is
+                       acyclic, and no non-reentrant ``threading.Lock``
+                       is re-acquired while held
+GL122       error      an attribute mutated from >= 2 distinct thread
+                       roots must be synchronized (mutations under some
+                       lock) or ``guarded-by``-annotated — unannotated
+                       multi-root mutation is a data race by default
+GL123       error      condition variables are used correctly:
+                       ``wait()`` only inside a ``while`` (spurious
+                       wakeups; ``wait_for`` loops internally and is
+                       exempt), ``notify()``/``notify_all()`` only with
+                       the condvar's lock held
+GL125       error      the thread-root registry in ``pyproject.toml``
+                       matches the discovered model BOTH ways: every
+                       discovered root is registered, every registered
+                       root (whose file is in the linted set) is
+                       discovered
+==========  =========  ====================================================
+
+Stale suppressions of these IDs are reported as GL124 (the rule itself
+lives in :mod:`astlint`; this module emits the findings for the IDs it
+owns, astlint's pass skips them — see ``astlint.EXTERNAL_RULE_IDS``).
+
+``tools/graftlint.py`` (``make lint``) runs this pass over the library
+package next to the astlint pass; the runtime half of the contract is
+:mod:`..telemetry.lockorder`, a test-time lock wrapper that records the
+ACTUAL acquisition order and asserts it agrees with the static graph.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .astlint import Finding, SUPPRESS_RE, _suppression_comments
+
+__all__ = [
+    "THREAD_RULES",
+    "Finding",
+    "build_model",
+    "lint_paths",
+    "lint_source",
+    "lint_sources",
+    "parse_thread_roots",
+    "static_lock_edges",
+]
+
+# rule id -> (severity, one-line title)
+THREAD_RULES: Dict[str, Tuple[str, str]] = {
+    "GL120": ("error",
+              "guarded-by annotated attribute accessed without its lock"),
+    "GL121": ("error",
+              "lock-acquisition cycle / non-reentrant re-acquisition"),
+    "GL122": ("error",
+              "attribute mutated from multiple thread roots with no "
+              "synchronization or guarded-by annotation"),
+    "GL123": ("error",
+              "condition-variable misuse (wait outside while / notify "
+              "without the lock)"),
+    "GL125": ("error",
+              "thread-root registry out of sync with discovered roots"),
+}
+
+GUARDED_RE = re.compile(
+    r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)\s*(\[writes\])?")
+REQUIRES_RE = re.compile(r"#\s*requires-lock:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+
+# method names that mutate their receiver in place
+_MUTATORS = frozenset({
+    "append", "extend", "insert", "remove", "pop", "popitem", "clear",
+    "update", "setdefault", "add", "discard", "appendleft", "extendleft",
+    "sort", "reverse",
+})
+
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition"}
+
+
+def _is_self_attr(node: ast.AST) -> Optional[str]:
+  if isinstance(node, ast.Attribute) and \
+      isinstance(node.value, ast.Name) and node.value.id == "self":
+    return node.attr
+  return None
+
+
+def _self_attr_path(node: ast.AST) -> Optional[str]:
+  """``self.a.b.c`` -> ``"a.b.c"`` (None for anything else)."""
+  parts = []
+  while isinstance(node, ast.Attribute):
+    parts.append(node.attr)
+    node = node.value
+  if isinstance(node, ast.Name) and node.id == "self" and parts:
+    return ".".join(reversed(parts))
+  return None
+
+
+class _Imports:
+  """threading import aliases for one module."""
+
+  def __init__(self, tree: ast.AST):
+    self.mod_aliases: Set[str] = set()
+    self.ctor_names: Dict[str, str] = {}  # local name -> lock kind
+    self.thread_names: Set[str] = set()   # local names bound to Thread
+    for node in ast.walk(tree):
+      if isinstance(node, ast.Import):
+        for a in node.names:
+          if a.name == "threading":
+            self.mod_aliases.add(a.asname or "threading")
+      elif isinstance(node, ast.ImportFrom) and node.module == "threading":
+        for a in node.names:
+          if a.name in _LOCK_CTORS:
+            self.ctor_names[a.asname or a.name] = _LOCK_CTORS[a.name]
+          elif a.name == "Thread":
+            self.thread_names.add(a.asname or a.name)
+
+  def lock_kind_of_call(self, call: ast.Call) -> Optional[str]:
+    """"lock"/"rlock"/"condition" when ``call`` constructs one."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name) \
+        and fn.value.id in self.mod_aliases:
+      return _LOCK_CTORS.get(fn.attr)
+    if isinstance(fn, ast.Name):
+      return self.ctor_names.get(fn.id)
+    return None
+
+  def is_thread_ctor(self, call: ast.Call) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name) \
+        and fn.value.id in self.mod_aliases:
+      return fn.attr == "Thread"
+    return isinstance(fn, ast.Name) and fn.id in self.thread_names
+
+
+class _ClassInfo:
+  def __init__(self, name: str):
+    self.name = name
+    self.lock_attrs: Dict[str, str] = {}   # attr -> kind
+    self.alias: Dict[str, str] = {}        # condvar attr -> underlying lock
+    self.guarded: Dict[str, Tuple[str, bool, int]] = {}
+    self.requires: Dict[str, str] = {}     # top-level method -> lock spec
+    self.methods: Set[str] = set()
+    self.assigned_attrs: Set[str] = set()
+    self.with_used: Set[str] = set()
+
+  def canon(self, attr: str) -> str:
+    """Canonical lock token for a self lock attr (condvars resolve to
+    their underlying lock: holding either is holding both)."""
+    return f"{self.name}.{self.alias.get(attr, attr)}"
+
+  def kind(self, attr: str) -> str:
+    under = self.alias.get(attr, attr)
+    return self.lock_attrs.get(under, self.lock_attrs.get(attr, "unknown"))
+
+
+class _FileScan:
+  """Everything threadlint learns about one module."""
+
+  def __init__(self, path: str, source: str):
+    self.path = path
+    self.source = source
+    self.lines = source.splitlines()
+    self.tree = ast.parse(source)
+    self.imports = _Imports(self.tree)
+    self.classes: Dict[str, _ClassInfo] = {}
+    self.module_funcs: Set[str] = set()
+    # analysis sinks
+    self.findings: List[Finding] = []
+    self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    self.roots: Dict[Tuple[Optional[str], str], int] = {}  # (cls, qual)->line
+    # per class: attr -> list of (qual, line, synced)
+    self.mutations: Dict[str, Dict[str, List[Tuple[str, int, bool]]]] = {}
+    # per class: caller qual -> called method/local-def quals
+    self.calls: Dict[str, Dict[str, Set[str]]] = {}
+
+  def line_of(self, lineno: int) -> str:
+    return self.lines[lineno - 1] if 0 < lineno <= len(self.lines) else ""
+
+  def finding(self, rule: str, line: int, msg: str) -> None:
+    self.findings.append(
+        Finding(rule, THREAD_RULES[rule][0], self.path, line, msg))
+
+  # ---- pass A: collect locks / annotations / methods ----------------------
+  def collect(self) -> None:
+    for node in self.tree.body:
+      if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        self.module_funcs.add(node.name)
+    for node in ast.walk(self.tree):
+      if isinstance(node, ast.ClassDef):
+        self._collect_class(node)
+
+  def _collect_class(self, cls_node: ast.ClassDef) -> None:
+    info = _ClassInfo(cls_node.name)
+    self.classes[cls_node.name] = info
+    for stmt in cls_node.body:
+      if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        info.methods.add(stmt.name)
+        m = REQUIRES_RE.search(self.line_of(stmt.lineno))
+        if m:
+          info.requires[stmt.name] = m.group(1)
+    for node in ast.walk(cls_node):
+      if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        value = node.value
+        for t in targets:
+          attr = _is_self_attr(t)
+          if attr is None:
+            continue
+          info.assigned_attrs.add(attr)
+          if isinstance(node, ast.Assign) and value is not None:
+            for sub in ast.walk(value):
+              if isinstance(sub, ast.Call):
+                kind = self.imports.lock_kind_of_call(sub)
+                if kind:
+                  info.lock_attrs[attr] = kind
+                  if kind == "condition" and sub.args:
+                    under = _is_self_attr(sub.args[0])
+                    if under:
+                      info.alias[attr] = under
+          m = GUARDED_RE.search(self.line_of(node.lineno))
+          if m:
+            info.guarded[attr] = (m.group(1), bool(m.group(2)),
+                                  node.lineno)
+      elif isinstance(node, (ast.With, ast.AsyncWith)):
+        for item in node.items:
+          attr = _is_self_attr(item.context_expr)
+          if attr:
+            info.with_used.add(attr)
+    # with-used assigned attrs are locks even when the constructor call
+    # is not visible (the lock is passed in, e.g. the metric classes
+    # sharing the registry's RLock)
+    for attr in info.with_used & info.assigned_attrs:
+      info.lock_attrs.setdefault(attr, "unknown")
+
+  # ---- pass B: analyze ----------------------------------------------------
+  def analyze(self) -> None:
+    for node in self.tree.body:
+      if isinstance(node, ast.ClassDef):
+        info = self.classes[node.name]
+        self.mutations.setdefault(info.name, {})
+        self.calls.setdefault(info.name, {})
+        for stmt in node.body:
+          if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _FuncWalker(self, info, stmt.name).walk_function(stmt)
+      elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        _FuncWalker(self, None, node.name).walk_function(node)
+
+
+class _FuncWalker:
+  """Lexical walk of one function with a held-lock set.
+
+  Tracks: the held canonical lock tokens (``with`` nesting plus the
+  ``requires-lock`` contract), the enclosing-``while`` depth (GL123's
+  wait check), local lock/condvar variables, and ``x = self.a`` aliases
+  (the dotted-guard and lock-graph resolution for ``with x.lock:``).
+  """
+
+  def __init__(self, scan: _FileScan, info: Optional[_ClassInfo],
+               qual: str):
+    self.scan = scan
+    self.info = info
+    self.qual = qual  # method name, "method.local", or module func name
+    self.held: Set[str] = set()
+    self.while_depth = 0
+    self.local_defs: Set[str] = set()
+    self.self_alias: Dict[str, str] = {}   # var -> self-attr path
+    self.local_locks: Dict[str, Tuple[str, str]] = {}  # var->(token, kind)
+
+  # -- token resolution -----------------------------------------------------
+  def _owner(self) -> str:
+    return self.info.name if self.info is not None else self.qual
+
+  def _qual_prefix(self) -> str:
+    owner = self.info.name + "." if self.info is not None else ""
+    return f"{owner}{self.qual}"
+
+  def resolve_lock_expr(self, node: ast.AST) -> Optional[Tuple[str, str]]:
+    """``with`` context expr -> (canonical token, kind) when it is a
+    known lock; None for unrelated context managers."""
+    attr = _is_self_attr(node)
+    if attr is not None and self.info is not None:
+      if attr in self.info.lock_attrs:
+        return self.info.canon(attr), self.info.kind(attr)
+      return None
+    path = _self_attr_path(node)
+    if path is not None and "." in path and self.info is not None:
+      return f"{self.info.name}.<{path}>", "unknown"
+    if isinstance(node, ast.Attribute) and \
+        isinstance(node.value, ast.Name):
+      base = self.self_alias.get(node.value.id)
+      if base is not None and self.info is not None:
+        return f"{self.info.name}.<{base}.{node.attr}>", "unknown"
+    if isinstance(node, ast.Name) and node.id in self.local_locks:
+      return self.local_locks[node.id]
+    return None
+
+  def guard_tokens(self, spec: str) -> Set[str]:
+    """Tokens whose presence in the held set satisfies guard ``spec``."""
+    if self.info is None:
+      return set()
+    if "." in spec:
+      return {f"{self.info.name}.<{spec}>"}
+    return {self.info.canon(spec), f"{self.info.name}.{spec}"}
+
+  # -- entry ----------------------------------------------------------------
+  def walk_function(self, fn: ast.AST) -> None:
+    if self.info is not None:
+      spec = self.info.requires.get(self.qual)
+      if spec is not None:
+        self.held |= self.guard_tokens(spec)
+    self.walk_body(fn.body)
+
+  # -- statements -----------------------------------------------------------
+  def walk_body(self, stmts: Sequence[ast.stmt]) -> None:
+    for s in stmts:
+      self.walk_stmt(s)
+
+  def walk_stmt(self, s: ast.stmt) -> None:
+    if isinstance(s, (ast.With, ast.AsyncWith)):
+      self._walk_with(s)
+    elif isinstance(s, ast.While):
+      self.process_expr(s.test)
+      self.while_depth += 1
+      self.walk_body(s.body)
+      self.walk_body(s.orelse)
+      self.while_depth -= 1
+    elif isinstance(s, (ast.For, ast.AsyncFor)):
+      self.process_expr(s.iter)
+      self.walk_body(s.body)
+      self.walk_body(s.orelse)
+    elif isinstance(s, ast.If):
+      self.process_expr(s.test)
+      self.walk_body(s.body)
+      self.walk_body(s.orelse)
+    elif isinstance(s, ast.Try):
+      self.walk_body(s.body)
+      for h in s.handlers:
+        self.walk_body(h.body)
+      self.walk_body(s.orelse)
+      self.walk_body(s.finalbody)
+    elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+      # a local def is a closure that may run on another thread (or
+      # later): analyze it with an EMPTY held set, under a nested qual
+      self.local_defs.add(s.name)
+      sub = _FuncWalker(self.scan, self.info, f"{self.qual}.{s.name}")
+      sub.self_alias = dict(self.self_alias)
+      sub.walk_function(s)
+    elif isinstance(s, ast.ClassDef):
+      pass  # nested classes: out of scope
+    else:
+      self.process_leaf(s)
+
+  def _walk_with(self, s: ast.With) -> None:
+    acquired: List[str] = []
+    for item in s.items:
+      resolved = self.resolve_lock_expr(item.context_expr)
+      if resolved is None:
+        self.process_expr(item.context_expr)
+        continue
+      token, kind = resolved
+      if token in self.held:
+        if kind == "lock":
+          self.scan.finding(
+              "GL121", s.lineno,
+              f"non-reentrant threading.Lock {token!r} re-acquired "
+              "while already held on this path — this deadlocks at "
+              "runtime (use an RLock, or restructure so the inner "
+              "block runs outside the lock).")
+        continue  # reentrant acquisition: no edge, nothing to release
+      for h in self.held:
+        self.scan.edges.setdefault((h, token),
+                                   (self.scan.path, s.lineno))
+      self.held.add(token)
+      acquired.append(token)
+    self.walk_body(s.body)
+    for token in acquired:
+      self.held.discard(token)
+
+  # -- expressions / accesses -----------------------------------------------
+  def process_expr(self, e: Optional[ast.AST]) -> None:
+    if e is not None:
+      self._scan_tree(e, writes=set())
+
+  def process_leaf(self, s: ast.stmt) -> None:
+    # track `x = self.a[.b]` aliases and local lock constructions first
+    if isinstance(s, ast.Assign) and len(s.targets) == 1 and \
+        isinstance(s.targets[0], ast.Name):
+      var = s.targets[0].id
+      path = _self_attr_path(s.value)
+      if path is not None:
+        self.self_alias[var] = path
+      else:
+        self.self_alias.pop(var, None)
+      if isinstance(s.value, ast.Call):
+        kind = self.scan.imports.lock_kind_of_call(s.value)
+        if kind:
+          self.local_locks[var] = (f"{self._qual_prefix()}.{var}", kind)
+    self._scan_tree(s, writes=self._write_nodes(s))
+
+  def _write_nodes(self, s: ast.stmt) -> Set[int]:
+    """ids of self-attr Attribute nodes that are WRITES in ``s``."""
+    writes: Set[int] = set()
+    for node in ast.walk(s):
+      if isinstance(node, ast.Attribute) and \
+          isinstance(node.ctx, (ast.Store, ast.Del)) and \
+          _is_self_attr(node) is not None:
+        writes.add(id(node))
+      elif isinstance(node, ast.Subscript) and \
+          isinstance(node.ctx, (ast.Store, ast.Del)) and \
+          _is_self_attr(node.value) is not None:
+        writes.add(id(node.value))
+      elif isinstance(node, ast.Call) and \
+          isinstance(node.func, ast.Attribute) and \
+          node.func.attr in _MUTATORS and \
+          _is_self_attr(node.func.value) is not None:
+        writes.add(id(node.func.value))
+    return writes
+
+  def _scan_tree(self, tree: ast.AST, writes: Set[int]) -> None:
+    for node in ast.walk(tree):
+      if isinstance(node, ast.Call):
+        self._scan_call(node)
+      attr = _is_self_attr(node)
+      if attr is None:
+        continue
+      self._record_access(node, attr, is_write=id(node) in writes)
+
+  def _record_access(self, node: ast.Attribute, attr: str,
+                     is_write: bool) -> None:
+    info = self.info
+    if info is None:
+      return
+    in_init = self.qual == "__init__" or self.qual.startswith("__init__.")
+    # GL122 bookkeeping: every mutation of a non-lock attr
+    if is_write and attr not in info.lock_attrs and not in_init:
+      self.scan.mutations.setdefault(info.name, {}).setdefault(
+          attr, []).append((self.qual, node.lineno, bool(self.held)))
+    # GL120: the annotation discipline
+    guard = info.guarded.get(attr)
+    if guard is None or in_init:
+      return
+    spec, writes_only, _ = guard
+    if writes_only and not is_write:
+      return
+    if self.guard_tokens(spec) & self.held:
+      return
+    verb = "written" if is_write else "read"
+    hint = f"hold 'with self.{spec}:'" if "." not in spec else \
+        f"hold 'with self.{spec}:' (or via a local bound from 'self."\
+        f"{spec.rsplit('.', 1)[0]}')"
+    self.scan.finding(
+        "GL120", node.lineno,
+        f"attribute 'self.{attr}' is guarded-by '{spec}' but {verb} "
+        f"without it — {hint}, or annotate the enclosing method "
+        f"'# requires-lock: {spec}' if the caller holds it.")
+
+  def _scan_call(self, call: ast.Call) -> None:
+    info = self.info
+    # intra-class call graph (GL122 reachability)
+    callee = _is_self_attr(call.func)
+    if info is not None and callee in info.methods:
+      self.scan.calls.setdefault(info.name, {}).setdefault(
+          self.qual, set()).add(callee)
+    if isinstance(call.func, ast.Name) and \
+        call.func.id in self.local_defs:
+      self.scan.calls.setdefault(
+          info.name if info is not None else "<module>", {}).setdefault(
+          self.qual, set()).add(f"{self.qual}.{call.func.id}")
+    # condvar discipline (GL123)
+    if isinstance(call.func, ast.Attribute) and \
+        call.func.attr in ("wait", "wait_for", "notify", "notify_all"):
+      self._check_condvar(call)
+    # thread-root discovery
+    if self.scan.imports.is_thread_ctor(call):
+      exprs = [kw.value for kw in call.keywords if kw.arg == "target"]
+      for kw in call.keywords:
+        if kw.arg == "args" and isinstance(kw.value, (ast.Tuple, ast.List)):
+          exprs.extend(kw.value.elts)
+      for e in exprs:
+        self._record_root(e, call.lineno)
+    elif isinstance(call.func, ast.Attribute) and \
+        call.func.attr == "submit" and call.args:
+      self._record_root(call.args[0], call.lineno, methods_only=True)
+
+  def _check_condvar(self, call: ast.Call) -> None:
+    recv = call.func.value
+    token_kind = None
+    attr = _is_self_attr(recv)
+    if attr is not None and self.info is not None and \
+        self.info.lock_attrs.get(attr) == "condition":
+      token_kind = (self.info.canon(attr), "condition")
+    elif isinstance(recv, ast.Name) and recv.id in self.local_locks and \
+        self.local_locks[recv.id][1] == "condition":
+      token_kind = self.local_locks[recv.id]
+    if token_kind is None:
+      return  # an Event / queue / unknown receiver: not a condvar
+    token, _ = token_kind
+    op = call.func.attr
+    if op == "wait" and self.while_depth == 0:
+      self.scan.finding(
+          "GL123", call.lineno,
+          f"condition variable {token!r}: wait() outside a 'while' "
+          "loop — spurious wakeups and stolen predicates make a bare "
+          "wait a latent hang; re-test the predicate in a while (or "
+          "use wait_for, which loops internally).")
+    elif op in ("notify", "notify_all") and token not in self.held:
+      self.scan.finding(
+          "GL123", call.lineno,
+          f"condition variable {token!r}: {op}() without its lock "
+          "held — CPython raises RuntimeError at runtime; wrap the "
+          "call in 'with' on the condvar (or its underlying lock).")
+
+  def _record_root(self, e: ast.AST, line: int,
+                   methods_only: bool = False) -> None:
+    info = self.info
+    attr_path = _self_attr_path(e)
+    if attr_path is not None and info is not None:
+      head = attr_path.split(".", 1)[0]
+      if "." not in attr_path:
+        if attr_path in info.methods:
+          self.scan.roots.setdefault((info.name, attr_path), line)
+        return  # a non-method self attr (a string arg, a payload)
+      if head in info.methods or methods_only:
+        return
+      # e.g. self._server.serve_forever: a foreign object's method
+      self.scan.roots.setdefault((info.name, attr_path), line)
+      return
+    if isinstance(e, ast.Name):
+      if e.id in self.local_defs:
+        owner = info.name if info is not None else None
+        self.scan.roots.setdefault((owner, f"{self.qual}.{e.id}"), line)
+      elif e.id in self.scan.module_funcs:
+        self.scan.roots.setdefault((None, e.id), line)
+
+
+# ---------------------------------------------------------------------------
+# aggregate analyses: GL121 cycles, GL122 multi-root mutation, GL125
+# ---------------------------------------------------------------------------
+
+
+def _find_cycles(edges: Dict[Tuple[str, str], Tuple[str, int]]
+                 ) -> List[List[str]]:
+  """Strongly connected components of size >= 2 (iterative Tarjan),
+  each returned as a sorted node list — one finding per deadlock knot,
+  not one per elementary cycle."""
+  graph: Dict[str, Set[str]] = {}
+  for a, b in edges:
+    graph.setdefault(a, set()).add(b)
+    graph.setdefault(b, set())
+  index: Dict[str, int] = {}
+  low: Dict[str, int] = {}
+  on_stack: Set[str] = set()
+  stack: List[str] = []
+  sccs: List[List[str]] = []
+  counter = [0]
+
+  for start in sorted(graph):
+    if start in index:
+      continue
+    work = [(start, iter(sorted(graph[start])))]
+    index[start] = low[start] = counter[0]
+    counter[0] += 1
+    stack.append(start)
+    on_stack.add(start)
+    while work:
+      v, it = work[-1]
+      advanced = False
+      for w in it:
+        if w not in index:
+          index[w] = low[w] = counter[0]
+          counter[0] += 1
+          stack.append(w)
+          on_stack.add(w)
+          work.append((w, iter(sorted(graph[w]))))
+          advanced = True
+          break
+        if w in on_stack:
+          low[v] = min(low[v], index[w])
+      if advanced:
+        continue
+      work.pop()
+      if work:
+        parent = work[-1][0]
+        low[parent] = min(low[parent], low[v])
+      if low[v] == index[v]:
+        comp = []
+        while True:
+          w = stack.pop()
+          on_stack.discard(w)
+          comp.append(w)
+          if w == v:
+            break
+        if len(comp) >= 2:
+          sccs.append(sorted(comp))
+  return sccs
+
+
+def _reachable(calls: Dict[str, Set[str]], root: str) -> Set[str]:
+  seen = {root}
+  frontier = [root]
+  while frontier:
+    q = frontier.pop()
+    for callee in calls.get(q, ()):
+      if callee not in seen:
+        seen.add(callee)
+        frontier.append(callee)
+  return seen
+
+
+class ThreadModel:
+  """The merged model over every scanned file (exposed for the runtime
+  sanitizer and tests)."""
+
+  def __init__(self, scans: List[_FileScan]):
+    self.scans = scans
+    self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    self.roots: Dict[Tuple[str, str], int] = {}  # (path, qual) -> line
+    for s in scans:
+      for edge, site in s.edges.items():
+        self.edges.setdefault(edge, site)
+      for (cls, qual), line in s.roots.items():
+        name = f"{cls}.{qual}" if cls else qual
+        self.roots[(s.path, name)] = line
+
+  def lock_edges(self) -> Set[Tuple[str, str]]:
+    return set(self.edges)
+
+
+def build_model(sources: Dict[str, str]) -> ThreadModel:
+  scans = []
+  for path, source in sorted(sources.items()):
+    scan = _FileScan(path, source)
+    scan.collect()
+    scan.analyze()
+    scans.append(scan)
+  return ThreadModel(scans)
+
+
+def _aggregate_findings(model: ThreadModel,
+                        registered: Optional[List[Tuple[str, int]]],
+                        registry_path: str) -> List[Finding]:
+  out: List[Finding] = []
+  # GL121: cycles across the whole linted set
+  for comp in _find_cycles(model.edges):
+    comp_set = set(comp)
+    site = min((site for (a, b), site in model.edges.items()
+                if a in comp_set and b in comp_set),
+               key=lambda s: (s[0], s[1]))
+    out.append(Finding(
+        "GL121", "error", site[0], site[1],
+        "lock-acquisition cycle (potential deadlock): "
+        f"{' -> '.join(comp + [comp[0]])} — two threads taking these "
+        "locks in opposite orders can each hold one and wait forever "
+        "on the other; pick one global order and restructure the "
+        "nested 'with' blocks to follow it."))
+  # GL122: per class, mutations reachable from >= 2 distinct roots
+  for scan in model.scans:
+    class_roots: Dict[str, List[str]] = {}
+    for (cls, qual), _line in scan.roots.items():
+      if cls is not None:
+        class_roots.setdefault(cls, []).append(qual)
+    for cls, roots in sorted(class_roots.items()):
+      if len(set(roots)) < 2:
+        continue
+      info = scan.classes.get(cls)
+      if info is None:
+        continue
+      calls = scan.calls.get(cls, {})
+      reach = {r: _reachable(calls, r) for r in set(roots)}
+      for attr, sites in sorted(scan.mutations.get(cls, {}).items()):
+        if attr in info.guarded or attr in info.lock_attrs:
+          continue
+        mutating_roots = sorted(
+            r for r, rs in reach.items()
+            if any(q in rs for q, _l, _s in sites))
+        unsynced = [(q, l) for q, l, synced in sites
+                    if not synced and
+                    any(q in reach[r] for r in mutating_roots)]
+        if len(mutating_roots) >= 2 and unsynced:
+          line = min(l for _q, l in unsynced)
+          out.append(Finding(
+              "GL122", "error", scan.path, line,
+              f"attribute 'self.{attr}' of {cls} is mutated from "
+              f"{len(mutating_roots)} distinct thread roots "
+              f"({', '.join(mutating_roots)}) with at least one "
+              "mutation under no lock and no guarded-by annotation — "
+              "a data race by construction; lock the mutations and "
+              "annotate the attribute."))
+  # GL125: registry staleness, both directions
+  if registered is not None:
+    discovered = sorted(
+        (path, path.replace(os.sep, "/"), qual, line)
+        for (path, qual), line in model.roots.items())
+    # the in-linted-set gate goes over every SCANNED file, not just
+    # files that still have roots — else removing a file's last thread
+    # also removes the evidence that its registry entry went stale
+    linted_files = [s.path.replace(os.sep, "/") for s in model.scans]
+    registered_names = set()
+    for entry, entry_line in registered:
+      if "::" not in entry:
+        out.append(Finding(
+            "GL125", "error", registry_path, entry_line,
+            f"malformed thread-root entry {entry!r}: expected "
+            "'<relpath>::<Qual.Name>'."))
+        continue
+      epath, equal = entry.split("::", 1)
+      registered_names.add((epath, equal))
+      seen_file = any(np.endswith(epath) for np in linted_files)
+      matched = any(np.endswith(epath) and qual == equal
+                    for _p, np, qual, _l in discovered)
+      if seen_file and not matched:
+        out.append(Finding(
+            "GL125", "error", registry_path, entry_line,
+            f"stale thread-root registry entry {entry!r}: the file is "
+            "in the linted set but no Thread target / executor submit "
+            "resolving to that function was discovered — the thread "
+            "was removed (prune the entry) or renamed (update it)."))
+    for path, np, qual, line in discovered:
+      if not any(np.endswith(ep) and qual == eq
+                 for ep, eq in registered_names):
+        out.append(Finding(
+            "GL125", "error", path, line,
+            f"discovered thread root '{qual}' is not registered in "
+            "pyproject.toml [tool.graftlint] thread-roots — the "
+            "concurrency model is explicit by contract; register "
+            f"'<repo-relative path>::{qual}'."))
+  return out
+
+
+# ---------------------------------------------------------------------------
+# suppression + staleness (GL124 for the IDs this module owns)
+# ---------------------------------------------------------------------------
+
+
+def _apply_suppressions(findings: List[Finding],
+                        sources: Dict[str, str],
+                        run_ids: Set[str]) -> List[Finding]:
+  comments = {path: _suppression_comments(src)
+              for path, src in sources.items()}
+  by_line: Dict[Tuple[str, int], Set[str]] = {}
+  for path, entries in comments.items():
+    for line, ids in entries:
+      by_line.setdefault((path, line), set()).update(ids)
+  fired: Dict[Tuple[str, int], Set[str]] = {}
+  for f in findings:
+    fired.setdefault((f.path, f.line), set()).add(f.rule)
+  out = []
+  for f in findings:
+    ids = by_line.get((f.path, f.line), set())
+    if f.rule in ids or "all" in ids:
+      continue
+    out.append(f)
+  # GL124 for this module's ids: a suppression that suppresses nothing
+  for path, entries in comments.items():
+    for line, ids in entries:
+      for rid in ids:
+        if rid not in THREAD_RULES or rid not in run_ids:
+          continue
+        if rid not in fired.get((path, line), set()):
+          out.append(Finding(
+              "GL124", "error", path, line,
+              f"suppression for {rid} suppresses nothing: no {rid} "
+              "finding fires on this line — stale disables rot the "
+              "baseline; delete the comment (or fix the id)."))
+  return out
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+def lint_sources(sources: Dict[str, str],
+                 rules: Optional[Iterable[str]] = None,
+                 registered_roots: Optional[
+                     List[Tuple[str, int]]] = None,
+                 registry_path: str = "pyproject.toml") -> List[Finding]:
+  """Lint a set of sources together (the lock graph and the GL122 root
+  model are aggregate by nature). ``registered_roots`` is the parsed
+  ``[tool.graftlint] thread-roots`` list as ``(entry, line)`` pairs;
+  None disables the GL125 registry cross-check entirely."""
+  run_ids = set(rules) if rules is not None else set(THREAD_RULES)
+  run_ids.add("GL124")
+  findings: List[Finding] = []
+  parsed: Dict[str, str] = {}
+  scans: List[_FileScan] = []
+  for path, source in sorted(sources.items()):
+    try:
+      scan = _FileScan(path, source)
+    except SyntaxError as e:
+      findings.append(Finding("GL000", "error", path, e.lineno or 0,
+                              f"syntax error: {e.msg}"))
+      continue
+    scan.collect()
+    scan.analyze()
+    scans.append(scan)
+    parsed[path] = source
+    findings.extend(scan.findings)
+  model = ThreadModel(scans)
+  findings.extend(_aggregate_findings(
+      model,
+      registered_roots if "GL125" in run_ids else None,
+      registry_path))
+  findings = [f for f in findings
+              if f.rule in run_ids or f.rule == "GL000"]
+  findings = _apply_suppressions(findings, parsed, run_ids)
+  return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def lint_source(source: str, path: str = "<memory>",
+                rules: Optional[Iterable[str]] = None) -> List[Finding]:
+  """Lint one source string (no registry cross-check)."""
+  return lint_sources({path: source}, rules=rules)
+
+
+_ENTRY_RE = re.compile(r"[\"']([^\"']+::[^\"']+)[\"']")
+
+
+def parse_thread_roots(root: str) -> Optional[List[Tuple[str, int]]]:
+  """``[tool.graftlint] thread-roots`` entries as ``(entry, line)``
+  pairs; None when pyproject.toml (or the section) is absent — the
+  GL125 cross-check is then skipped, mirroring GL107's marker
+  context."""
+  pyproject = os.path.join(root, "pyproject.toml")
+  if not os.path.exists(pyproject):
+    return None
+  with open(pyproject) as f:
+    text = f.read()
+  try:
+    import tomllib
+    data = tomllib.loads(text)
+    entries = (data.get("tool", {}).get("graftlint", {})
+               .get("thread-roots"))
+    if entries is None:
+      return None
+  except ModuleNotFoundError:  # py3.10: scrape the array
+    m = re.search(r"thread-roots\s*=\s*\[(.*?)\]", text, re.S)
+    if m is None:
+      return None
+    entries = _ENTRY_RE.findall(m.group(1))
+  lines = []
+  by_line = text.splitlines()
+  for entry in entries:
+    line = next((i + 1 for i, l in enumerate(by_line) if entry in l), 0)
+    lines.append((entry, line))
+  return lines
+
+
+def lint_paths(paths: Sequence[str],
+               root: Optional[str] = None,
+               rules: Optional[Iterable[str]] = None) -> List[Finding]:
+  """Lint files/directories; ``root`` anchors the thread-root registry
+  parse (pyproject.toml). With no root, the common-parent search
+  mirrors astlint's."""
+  from .astlint import _iter_py_files
+  if root is None:
+    root = os.path.commonpath([os.path.abspath(p) for p in paths]) \
+        if paths else os.getcwd()
+    while root != os.path.dirname(root) and not os.path.exists(
+        os.path.join(root, "pyproject.toml")):
+      root = os.path.dirname(root)
+  sources = {}
+  for path in _iter_py_files(paths):
+    with open(path) as f:
+      sources[path] = f.read()
+  return lint_sources(
+      sources, rules=rules,
+      registered_roots=parse_thread_roots(root),
+      registry_path=os.path.join(root, "pyproject.toml"))
+
+
+def static_lock_edges(root: Optional[str] = None) -> Set[Tuple[str, str]]:
+  """The static lock-acquisition graph over the library package — the
+  runtime sanitizer (:mod:`..telemetry.lockorder`) validates observed
+  acquisition order against exactly this edge set."""
+  from .astlint import _iter_py_files
+  if root is None:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+  pkg = os.path.join(root, "distributed_embeddings_tpu")
+  sources = {}
+  for path in _iter_py_files([pkg if os.path.isdir(pkg) else root]):
+    with open(path) as f:
+      src = f.read()
+    try:
+      ast.parse(src)
+    except SyntaxError:
+      continue
+    sources[path] = src
+  return build_model(sources).lock_edges()

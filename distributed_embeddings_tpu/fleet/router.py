@@ -166,12 +166,15 @@ class FleetStore:
       self.counts[c.name] = [
           np.zeros((lay.phys_rows,), np.int64) for _ in range(world)]
     self._lock = threading.Lock()
-    self._inflight: Dict[int, int] = {o: 0 for o in range(fplan.n_owners)}
-    self._dead: Dict[int, float] = {}  # owner -> monotonic death stamp
+    # owner -> in-flight gather count (drain_owner's wait predicate)
+    self._inflight: Dict[int, int] = {  # guarded-by: _lock
+        o: 0 for o in range(fplan.n_owners)}
+    # owner -> monotonic death stamp
+    self._dead: Dict[int, float] = {}   # guarded-by: _lock
     self._prefetched: Dict[tuple, tuple] = {}
-    self._pool = None
-    self._hedge_pool = None
-    self._gather_window: Dict[int, WindowedHistogram] = {}
+    self._pool = None                   # guarded-by: _lock [writes]
+    self._hedge_pool = None             # guarded-by: _lock [writes]
+    self._gather_window: Dict[int, WindowedHistogram] = {}  # guarded-by: _lock
     self._counters = {k: self.telemetry.counter(f"fleet/{k}")
                       for k in ("rpcs", "rpc_bytes", "rpc_retries",
                                 "failovers", "dead_rank_errors",
@@ -661,10 +664,11 @@ class FleetStore:
     Fetch errors are re-raised on consumption (the dispatch fails, the
     batcher delivers it per request)."""
     from concurrent.futures import ThreadPoolExecutor
-    if self._pool is None:
-      self._pool = ThreadPoolExecutor(
-          max_workers=max(1, self.config.fanout_threads),
-          thread_name_prefix="fleet-gather")
+    with self._lock:
+      if self._pool is None:
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, self.config.fanout_threads),
+            thread_name_prefix="fleet-gather")
     fr = _flight.current_flight_recorder()
     rec = fr.current() if fr is not None else None
     with _span("fleet/fanout"), \
@@ -750,12 +754,15 @@ class FleetStore:
       self._dead_gauge.set(len(self._dead))
 
   def close(self) -> None:
-    if self._pool is not None:
-      self._pool.shutdown(wait=False)
-      self._pool = None
-    if self._hedge_pool is not None:
-      self._hedge_pool.shutdown(wait=False)
-      self._hedge_pool = None
+    # under the lock: close racing _hedge_pool_get's lazy construction
+    # could otherwise leak a just-built executor (threadlint GL120)
+    with self._lock:
+      pool, self._pool = self._pool, None
+      hedge, self._hedge_pool = self._hedge_pool, None
+    if pool is not None:
+      pool.shutdown(wait=False)
+    if hedge is not None:
+      hedge.shutdown(wait=False)
 
 
 class FleetRouter(ServeEngine):
@@ -788,14 +795,14 @@ class FleetRouter(ServeEngine):
     self.axis_name = axis_name
     self.meta = art.meta
     self.quantize = art.quantize
-    self.step = int(art.step)
+    self.step = int(art.step)     # guarded-by: lock [writes]
     self.with_metrics = with_metrics
     self.donate_batch = donate_batch
-    self.translator = art.vocab
+    self.translator = art.vocab   # guarded-by: lock [writes]
     self.telemetry = telemetry if telemetry is not None else _registry()
-    self._steps: Dict[Any, Any] = {}
+    self._steps: Dict[Any, Any] = {}  # guarded-by: lock
     self.lock = threading.RLock()
-    self.fleet_plan = fleet_plan
+    self.fleet_plan = fleet_plan  # guarded-by: lock [writes]
 
     self._validate_fleet(transport, fleet_plan)
 
@@ -840,7 +847,7 @@ class FleetRouter(ServeEngine):
       serve[name] = self.store._put(np.concatenate(blocks), mesh,
                                     axis_name)
     state["serve"] = serve
-    self.state = state
+    self.state = state  # guarded-by: lock
     self.prefetcher = TieredPrefetcher(
         self.tplan, self.store, mesh, axis_name,
         retry_policy=retry_policy,
@@ -965,7 +972,11 @@ class FleetRouter(ServeEngine):
         self.translator = ReadonlyIdTranslator.from_arrays(vocab_arrays)
 
   def adopt_step(self, step: int) -> None:
-    self.step = int(step)
+    # under the dispatch lock like every other promote-path write: the
+    # watermark must move atomically with respect to a concurrent
+    # status/dispatch reader (threadlint GL120 caught the bare write)
+    with self.lock:
+      self.step = int(step)
 
   def apply_fleet(self, fleet_plan: FleetPlan, transport=None) -> None:
     """Autoscaler actuation: adopt a grown/shrunk replica set under the

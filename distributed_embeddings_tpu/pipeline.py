@@ -99,6 +99,18 @@ class HostWorker:
   degrading to the serial path. ``close`` drains and joins without
   raising for jobs whose results were deliberately discarded (e.g. a
   prepared-ahead batch dropped at a SIGTERM drain).
+
+  Locking (threadlint-checked): the worker is deliberately LOCK-FREE —
+  no ``guarded-by`` state exists here. All cross-thread handoff is the
+  internally synchronized ``queue.Queue`` plus each ``_Job``'s
+  ``Event``: a job's ``result``/``error``/``elapsed`` fields are
+  written only by the worker thread BEFORE ``done.set()`` and read
+  only by callers AFTER ``done.wait()`` returns — the Event is the
+  happens-before edge, so the fields are thread-confined-by-protocol
+  rather than lock-guarded. ``_loop`` is a registered thread root in
+  ``pyproject.toml [tool.graftlint] thread-roots``, as are the module
+  job functions (``_tiered_host_job``/``_dynvocab_translate_job``)
+  submitted to it.
   """
 
   def __init__(self, name: str = "host-pipeline"):

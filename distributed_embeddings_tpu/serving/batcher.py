@@ -168,6 +168,20 @@ class MicroBatcher:
       gauges. Give each batcher SHARING a registry its own name, or a
       rebuild of one batcher cannot be told apart from its siblings on
       the readiness plane.
+
+  Locking (threadlint-checked — the ``guarded-by`` annotations in
+  ``__init__`` are the machine-readable form): ONE plain ``Lock``
+  (``_lock``, with ``_nonempty = Condition(_lock)`` over it — holding
+  either is holding both) protects all cross-thread state: the queue
+  (``_pending``/``_pending_rows``/``_seq``), lifecycle
+  (``_closed``/``_dead``/``_orphans``), the admission knobs
+  (``queue_rows``/``max_delay_s``) and the ``dispatch_fn`` binding.
+  ``_dead`` and ``dispatch_fn`` are locked-write/racy-read by design
+  (set-once death flag; one binding captured per flush) — annotated
+  ``[writes]``. The ``*_locked`` helpers carry ``requires-lock``
+  contracts: callers hold ``_lock``. The in-flight handoff between
+  flusher and completer is the (internally synchronized)
+  ``_inflight`` queue, not the lock.
   """
 
   def __init__(self, dispatch_fn: Callable, max_batch: int,
@@ -179,16 +193,16 @@ class MicroBatcher:
                name: str = "serve-batcher"):
     if max_batch < 1:
       raise ValueError(f"max_batch must be >= 1, got {max_batch}")
-    self.dispatch_fn = dispatch_fn
+    self.dispatch_fn = dispatch_fn          # guarded-by: _lock [writes]
     self.max_batch = int(max_batch)
-    self.max_delay_s = float(max_delay_s)
+    self.max_delay_s = float(max_delay_s)   # guarded-by: _lock [writes]
     self.queue_rows = int(queue_rows) if queue_rows is not None \
-        else 8 * self.max_batch
+        else 8 * self.max_batch             # guarded-by: _lock [writes]
     self._lock = threading.Lock()
     self._nonempty = threading.Condition(self._lock)
-    self._pending: List[_Pending] = []
-    self._pending_rows = 0
-    self._closed = False
+    self._pending: List[_Pending] = []      # guarded-by: _lock
+    self._pending_rows = 0                  # guarded-by: _lock
+    self._closed = False                    # guarded-by: _lock
     self.telemetry = registry if registry is not None else MetricsRegistry()
     self._counters = {k: self.telemetry.counter(f"serve/{k}")
                       for k in ("submitted", "rejected", "batches",
@@ -196,17 +210,20 @@ class MicroBatcher:
     self._counters.update(
         {f"rejected/{r}": self.telemetry.counter(f"serve/rejected/{r}")
          for r in REJECT_REASONS})
-    self._seq = 0  # arrival order (FIFO tie-break within a priority)
+    # arrival order (FIFO tie-break within a priority)
+    self._seq = 0                           # guarded-by: _lock
     self._latency = self.telemetry.histogram("serve/latency_s")
     self._inflight: _queue.Queue = _queue.Queue(maxsize=max(1,
                                                            pipeline_depth))
     self._flusher: Optional[threading.Thread] = None
     self._completer: Optional[threading.Thread] = None
-    # (thread name, exception) once a worker thread died unexpectedly
-    self._dead: Optional[tuple] = None
+    # (thread name, exception) once a worker thread died unexpectedly;
+    # written once under the lock, read racily (benign: set-once, and
+    # every reader path is only reachable after the locked write)
+    self._dead: Optional[tuple] = None      # guarded-by: _lock [writes]
     # requests a dying thread had already popped from a queue (neither
     # pending nor in-flight — they would be invisible to the drain)
-    self._orphans: List[_Pending] = []
+    self._orphans: List[_Pending] = []      # guarded-by: _lock
     # a REBUILT batcher on the same registry supersedes the dead one
     # with the SAME name (the Rejected message says "rebuild the
     # batcher"): clear ITS OWN dead-thread gauges only — a still-dead
@@ -264,11 +281,14 @@ class MicroBatcher:
       pending = self._pending[:]
       self._pending.clear()
       self._pending_rows = 0
+      # the swap must happen under the lock: the OTHER worker thread's
+      # exception path appends orphans too, and a racy swap here could
+      # strand its orphan forever (threadlint GL120 caught this)
+      orphans, self._orphans = self._orphans, []
       self._nonempty.notify_all()
     self.telemetry.gauge(DEAD_THREAD_GAUGE_STEM).set(1)
     self.telemetry.gauge(f"{DEAD_THREAD_GAUGE_STEM}/{name}").set(1)
     # one shed count PER failed request (the exact-accounting contract)
-    orphans, self._orphans = self._orphans, []
     for p in pending + orphans:
       if not p.future.done():
         p.future._fail(self._dead_rejected())
@@ -366,7 +386,7 @@ class MicroBatcher:
     _flight.flight_trip(f"shed/{reason}", defer=True)
     return Rejected(msg, reason=reason)
 
-  def _evict_for_locked(self, n: int, priority: int) -> None:
+  def _evict_for_locked(self, n: int, priority: int) -> None:  # requires-lock: _lock
     """Make room for an incoming higher-priority request by shedding
     pending LOWER-priority requests — lowest priority first, youngest
     first within a priority (the request that waited longest keeps its
@@ -462,7 +482,7 @@ class MicroBatcher:
     return fut
 
   # ---- flush policy -------------------------------------------------------
-  def _purge_expired_locked(self) -> None:
+  def _purge_expired_locked(self) -> None:  # requires-lock: _lock
     """Shed pending requests whose own deadline passed — counted
     ``deadline_expired``; their waiters fail immediately instead of
     riding a dispatch whose answer is already too late."""
@@ -476,7 +496,7 @@ class MicroBatcher:
           f"request deadline passed after {now - p.future.t_submit:.4f}s "
           "in the serve queue — shed instead of dispatched late."))
 
-  def _take_batch_locked(self) -> List[_Pending]:
+  def _take_batch_locked(self) -> List[_Pending]:  # requires-lock: _lock
     """Pop whole requests while they fit in max_batch rows: highest
     priority first, FIFO within a priority (all-default-priority
     traffic keeps the classic FIFO order exactly). Expired requests
@@ -495,7 +515,7 @@ class MicroBatcher:
     self._pending_rows -= rows
     return taken
 
-  def _flush_ready_locked(self) -> bool:
+  def _flush_ready_locked(self) -> bool:  # requires-lock: _lock
     # purge expired waiters HERE (they fail at their own deadline — the
     # wait timeout wakes the loop then) rather than treating expiry as
     # flush-readiness: an expired co-tenant must not force the live
@@ -553,8 +573,11 @@ class MicroBatcher:
         except BaseException:
           # already popped from pending: record the batch so the death
           # handler can fail its waiters (a dispatch-fn failure is
-          # handled INSIDE _dispatch; reaching here is machinery death)
-          self._orphans.extend(taken)
+          # handled INSIDE _dispatch; reaching here is machinery death).
+          # Under the lock: the completer's death handler swaps the
+          # orphan list concurrently (threadlint GL120 caught this)
+          with self._lock:
+            self._orphans.extend(taken)
           raise
 
   def flush_now(self) -> int:
@@ -696,8 +719,10 @@ class MicroBatcher:
       except BaseException:
         # popped from in-flight already: hand the batch to the death
         # handler (expected completion failures are delivered per
-        # request inside _complete; this is machinery death)
-        self._orphans.extend(item[0])
+        # request inside _complete; this is machinery death). Locked:
+        # the flusher's death handler may swap the list concurrently
+        with self._lock:
+          self._orphans.extend(item[0])
         raise
 
   # ---- lifecycle ----------------------------------------------------------

@@ -442,7 +442,7 @@ class ServeEngine:
     # watermark. A DeltaSubscriber advances it (under `lock`) with each
     # promoted delta, so operators/chaos can ask a live engine "whose
     # training state am I serving" without touching the pubdir.
-    self.step = int(getattr(artifact, "step", 0))
+    self.step = int(getattr(artifact, "step", 0))  # guarded-by: lock [writes]
     self.with_metrics = with_metrics
     self.donate_batch = donate_batch
     # where this engine's gather/combine stage observations land when
@@ -451,7 +451,7 @@ class ServeEngine:
     # taxonomy (wire the batcher's registry here for that)
     self.telemetry = telemetry if telemetry is not None \
         else _get_registry()
-    self._steps: Dict[Any, Any] = {}
+    self._steps: Dict[Any, Any] = {}  # guarded-by: lock
     # The promote point (streaming deltas): dispatch holds this lock for
     # the brief host-side dispatch window, and a DeltaSubscriber holds
     # it while SWAPPING the serve state references — so a swap lands
@@ -475,13 +475,13 @@ class ServeEngine:
       self.prefetcher = TieredPrefetcher(self.tplan, store, mesh,
                                          axis_name)
       state["serve"].update(store.build_fused(mesh, axis_name))
-    self.state = state
+    self.state = state  # guarded-by: lock
 
   @property
   def tiered(self) -> bool:
     return self.prefetcher is not None
 
-  def _step_for(self, batch_example, s_eff=None):
+  def _step_for(self, batch_example, s_eff=None):  # requires-lock: lock
     numerical, cats = batch_example
     key = (numerical.shape, tuple(np.shape(c) for c in cats),
            tuple(sorted(s_eff.items())) if s_eff else None)

@@ -128,6 +128,21 @@ class DeltaSubscriber:
   directly from an existing engine + the base fingerprint it was built
   from. ``poll_once`` is the deterministic test surface; ``start`` runs
   it on a daemon thread every ``poll_interval_s``.
+
+  Locking (threadlint-checked): the subscriber owns NO lock — the one
+  shared-state boundary is the ENGINE's ``lock``. ``self.engine`` and
+  ``self.translator`` are locked-write/racy-read (annotated
+  ``guarded-by: engine.lock [writes]``): ``dispatch`` snapshots
+  ``eng = self.engine`` lock-free, then re-checks ``eng is
+  self.engine`` under ``eng.lock`` before dispatching, so a rebase
+  swapping both references can never split one dispatch across two
+  engines; ``_apply``/``_rebase`` write them (plus ``eng.state`` /
+  ``eng.step``) only inside ``with eng.lock``. Everything else
+  (``applied_seq``/``fingerprint``/``chain_root``/``last_refusal``/
+  ``last_error``/``_comp_cache``/``poll_walls``) is confined to the
+  poll thread — ``poll_once`` and ``start``'s daemon loop are the only
+  writers, never concurrent with each other by contract — and needs no
+  lock (readers of ``status`` accept a torn-but-valid snapshot).
   """
 
   def __init__(self, engine: ServeEngine, path: str,
@@ -139,10 +154,10 @@ class DeltaSubscriber:
                retry_policy: retry.RetryPolicy = retry.DEFAULT_POLICY,
                base_manifest: Optional[Dict[str, Any]] = None,
                poll_jitter_s: float = 0.0):
-    self.engine = engine
+    self.engine = engine          # guarded-by: engine.lock [writes]
     self.path = path
     self.plan = plan
-    self.translator = translator
+    self.translator = translator  # guarded-by: engine.lock [writes]
     self.poll_interval_s = float(poll_interval_s)
     self.poll_jitter_s = float(poll_jitter_s)
     self.telemetry = telemetry if telemetry is not None else _registry()

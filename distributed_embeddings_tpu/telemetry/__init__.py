@@ -28,6 +28,11 @@ plus jax.profiler's device trace into ONE timeline, and
 traces with per-stage critical paths, dumping a debug bundle whenever
 a failover/refusal/shed fires.
 
+Round 19 adds :mod:`.lockorder`: a test-time lock wrapper
+(:class:`LockOrderMonitor`) that records actual lock-acquisition order
+and asserts agreement with threadlint's static lock graph (GL121) —
+the runtime half of the concurrency lint.
+
 graftlint GL113 makes spans the sanctioned timing form: raw
 ``time.perf_counter``/``time.monotonic`` calls in library modules
 outside this package are lint errors; GL115 pins trace-id/clock-epoch
@@ -53,6 +58,7 @@ from .registry import (
     histogram,
 )
 from .http import MetricsServer, clear_promote, record_promote
+from .lockorder import InstrumentedLock, LockOrderError, LockOrderMonitor
 from .flight import (
     FlightRecorder,
     current_flight_recorder,
@@ -86,7 +92,10 @@ __all__ = [
     "FlightRecorder",
     "Gauge",
     "Histogram",
+    "InstrumentedLock",
     "JsonlWriter",
+    "LockOrderError",
+    "LockOrderMonitor",
     "MetricsRegistry",
     "MetricsServer",
     "TraceContext",

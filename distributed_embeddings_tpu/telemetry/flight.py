@@ -139,17 +139,18 @@ class FlightRecorder:
     self.max_bundles = int(max_bundles)
     self.min_interval_s = float(min_interval_s)
     self._lock = threading.Lock()
-    self._ring: List[RequestRecord] = []
-    self._live: Dict[int, RequestRecord] = {}
-    self._pending_trip: Optional[Dict[str, Any]] = None
+    self._ring: List[RequestRecord] = []            # guarded-by: _lock
+    self._live: Dict[int, RequestRecord] = {}       # guarded-by: _lock
+    self._pending_trip: Optional[Dict[str, Any]] = None  # guarded-by: _lock
     # the records that were live AT TRIP TIME: the dump fires when THEY
     # end, not when the pipeline fully drains — under sustained load
     # _live never empties, and waiting for it would starve the bundle
     # past the ring's memory of the triggering request
-    self._pending_waits: set = set()
-    self._last_dump: Dict[str, float] = {}  # reason -> monotonic stamp
-    self._seq = 0
-    self.bundles: List[str] = []
+    self._pending_waits: set = set()                # guarded-by: _lock
+    # reason -> monotonic stamp
+    self._last_dump: Dict[str, float] = {}          # guarded-by: _lock
+    self._seq = 0                                   # guarded-by: _lock
+    self.bundles: List[str] = []                    # guarded-by: _lock
 
   # ---- request records ----------------------------------------------------
   def begin(self, trace_id: str, trace_ids=()) -> RequestRecord:

@@ -62,7 +62,7 @@ class Counter:
   def __init__(self, name: str, lock: threading.RLock):
     self.name = name
     self._lock = lock
-    self._value = 0
+    self._value = 0    # guarded-by: _lock [writes]
 
   def inc(self, n: int = 1) -> None:
     if n < 0:
@@ -93,7 +93,7 @@ class Gauge:
   def __init__(self, name: str, lock: threading.RLock):
     self.name = name
     self._lock = lock
-    self._value = 0.0
+    self._value = 0.0  # guarded-by: _lock [writes]
 
   def set(self, v: float) -> None:
     with self._lock:
@@ -158,14 +158,15 @@ class Histogram:
     self.rel_err = float(rel_err)
     self._gamma = (1.0 + rel_err) / (1.0 - rel_err)
     self._log_gamma = math.log(self._gamma)
-    self._buckets: Dict[int, int] = {}
-    self._zero = 0
-    self._count = 0
-    self._sum = 0.0
-    self._min = math.inf
-    self._max = -math.inf
+    self._buckets: Dict[int, int] = {}  # guarded-by: _lock
+    self._zero = 0         # guarded-by: _lock [writes]
+    self._count = 0        # guarded-by: _lock [writes]
+    self._sum = 0.0        # guarded-by: _lock [writes]
+    self._min = math.inf   # guarded-by: _lock [writes]
+    self._max = -math.inf  # guarded-by: _lock [writes]
     self.max_buckets = max_buckets
-    self._collapsed = 0  # observations folded upward by bucket collapse
+    # observations folded upward by bucket collapse
+    self._collapsed = 0  # guarded-by: _lock [writes]
 
   # ---- recording ----------------------------------------------------------
   def observe(self, x: float) -> None:
@@ -186,7 +187,7 @@ class Histogram:
             and len(self._buckets) > self.max_buckets:
           self._collapse_locked()
 
-  def _collapse_locked(self) -> None:
+  def _collapse_locked(self) -> None:  # requires-lock: _lock
     """Merge the lowest buckets upward until the cardinality bound
     holds (caller holds the lock). Count/sum/min/max are exact
     regardless; only the collapsed samples' bucket resolution is lost."""
@@ -353,11 +354,12 @@ class WindowedHistogram:
     self.slots = int(slots)
     self.max_buckets = max_buckets
     self._lock = threading.RLock()
-    self._open = self._fresh()
-    self._ring: list = []  # oldest first, at most ``slots`` sealed
-    self._rotations = 0
+    self._open = self._fresh()  # guarded-by: _lock [writes]
+    # oldest first, at most ``slots`` sealed
+    self._ring: list = []       # guarded-by: _lock
+    self._rotations = 0         # guarded-by: _lock [writes]
     self.rotate_every_s = rotate_every_s
-    self._last_rotate: Optional[float] = None
+    self._last_rotate: Optional[float] = None  # guarded-by: _lock
 
   def _fresh(self) -> Histogram:
     return Histogram(self.name, rel_err=self.rel_err, lock=self._lock,
@@ -444,7 +446,7 @@ class MetricsRegistry:
 
   def __init__(self):
     self._lock = threading.RLock()
-    self._metrics: Dict[str, Any] = {}
+    self._metrics: Dict[str, Any] = {}  # guarded-by: _lock
 
   def _get(self, name: str, kind: str, **kwargs):
     with self._lock:

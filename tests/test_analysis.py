@@ -1348,3 +1348,509 @@ def is_worker():
 """
   assert lint_source(ok, "distributed_embeddings_tpu/tiering/prefetch.py",
                      CTX, ["GL119"]) == []
+
+
+# ---------------------------------------------------------------------------
+# threadlint (GL120-GL123, GL125): the concurrency pass
+# ---------------------------------------------------------------------------
+
+from distributed_embeddings_tpu.analysis import threadlint as tlint  # noqa: E402
+from distributed_embeddings_tpu.telemetry.lockorder import (  # noqa: E402
+    LockOrderError,
+    LockOrderMonitor,
+)
+
+
+def test_gl120_guarded_attribute_fires_and_locked_access_clean():
+  src = """
+import threading
+
+class Box:
+  def __init__(self):
+    self._lock = threading.Lock()
+    self._items = []  # guarded-by: _lock
+
+  def good(self):
+    with self._lock:
+      self._items.append(1)
+      return len(self._items)
+
+  def bad_write(self):
+    self._items.append(1)
+
+  def bad_read(self):
+    return len(self._items)
+"""
+  out = tlint.lint_source(src, "x.py", rules=["GL120"])
+  assert _rules(out) == ["GL120", "GL120"]
+  assert "written" in out[0].message and "read" in out[1].message
+  assert "'with self._lock:'" in out[0].message
+
+
+def test_gl120_init_exempt_and_suppression():
+  src = """
+import threading
+
+class Box:
+  def __init__(self):
+    self._lock = threading.Lock()
+    self._n = 0  # guarded-by: _lock
+    self._n = 1  # construction writes need no lock (pre-start)
+
+  def bump(self):
+    self._n += 1  # graftlint: disable=GL120 (single-writer by contract)
+"""
+  assert tlint.lint_source(src, "x.py", rules=["GL120"]) == []
+
+
+def test_gl120_writes_mode_exempts_reads():
+  """[writes]: locked-write/racy-read state (metric values, the
+  subscriber's engine binding) needs no read-side suppressions."""
+  src = """
+import threading
+
+class Metric:
+  def __init__(self):
+    self._lock = threading.RLock()
+    self._value = 0  # guarded-by: _lock [writes]
+
+  def inc(self):
+    with self._lock:
+      self._value += 1
+
+  @property
+  def value(self):
+    return self._value
+
+  def reset(self):
+    self._value = 0
+"""
+  out = tlint.lint_source(src, "x.py", rules=["GL120"])
+  assert [(f.rule, f.line) for f in out] == [("GL120", 18)]
+
+
+def test_gl120_requires_lock_contract_and_condition_alias():
+  """A requires-lock method is checked as lock-held, and holding a
+  Condition built over the lock IS holding the lock (the batcher's
+  _nonempty/_lock pair)."""
+  src = """
+import threading
+
+class Q:
+  def __init__(self):
+    self._lock = threading.Lock()
+    self._nonempty = threading.Condition(self._lock)
+    self._pending = []  # guarded-by: _lock
+
+  def _take_locked(self):  # requires-lock: _lock
+    return self._pending.pop()
+
+  def submit(self, x):
+    with self._nonempty:
+      self._pending.append(x)
+      self._nonempty.notify()
+
+  def broken_helper(self):
+    return self._pending.pop()
+"""
+  out = tlint.lint_source(src, "x.py", rules=["GL120"])
+  assert [(f.rule, f.line) for f in out] == [("GL120", 19)]
+
+
+def test_gl120_dotted_guard_via_local_alias():
+  """guarded-by: engine.lock is satisfied through the racy-read-verify
+  idiom: a local bound from self.engine, then `with eng.lock:`."""
+  src = """
+class Sub:
+  def __init__(self, engine):
+    self.engine = engine  # guarded-by: engine.lock [writes]
+
+  def rebase(self, new):
+    old = self.engine
+    with old.lock:
+      self.engine = new
+
+  def broken(self, new):
+    self.engine = new
+"""
+  out = tlint.lint_source(src, "x.py", rules=["GL120"])
+  assert [(f.rule, f.line) for f in out] == [("GL120", 12)]
+
+
+def test_gl121_seeded_deadlock_cycle():
+  """Two methods nesting the same pair of locks in opposite orders:
+  the classic two-lock deadlock, one finding per knot."""
+  src = """
+import threading
+
+class AB:
+  def __init__(self):
+    self._a = threading.Lock()
+    self._b = threading.Lock()
+
+  def fwd(self):
+    with self._a:
+      with self._b:
+        pass
+
+  def rev(self):
+    with self._b:
+      with self._a:
+        pass
+"""
+  out = tlint.lint_source(src, "x.py", rules=["GL121"])
+  assert _rules(out) == ["GL121"]
+  assert "cycle" in out[0].message
+  assert "AB._a" in out[0].message and "AB._b" in out[0].message
+  # one consistent global order: no cycle, no finding
+  ok = src.replace("with self._b:\n      with self._a:",
+                   "with self._a:\n      with self._b:")
+  assert tlint.lint_source(ok, "x.py", rules=["GL121"]) == []
+
+
+def test_gl121_plain_lock_reacquire_deadlocks_rlock_does_not():
+  src = """
+import threading
+
+class R:
+  def __init__(self):
+    self._lock = threading.{KIND}()
+
+  def outer(self):
+    with self._lock:
+      self.inner()
+
+  def inner(self):
+    with self._lock:  {SUP}
+      pass
+"""
+  bad = src.replace("{KIND}", "Lock").replace("{SUP}", "")
+  # lexical nesting of the SAME plain Lock (via a requires-lock-less
+  # helper there is none — seed a direct nest)
+  direct = """
+import threading
+
+class R:
+  def __init__(self):
+    self._lock = threading.Lock()
+
+  def outer(self):
+    with self._lock:
+      with self._lock:
+        pass
+"""
+  out = tlint.lint_source(direct, "x.py", rules=["GL121"])
+  assert _rules(out) == ["GL121"]
+  assert "re-acquired" in out[0].message
+  # an RLock is reentrant: same shape, no finding
+  assert tlint.lint_source(
+      direct.replace("threading.Lock()", "threading.RLock()"),
+      "x.py", rules=["GL121"]) == []
+  # and the suppression silences the plain-Lock form
+  sup = direct.replace("with self._lock:\n        pass",
+                       "with self._lock:  # graftlint: disable=GL121\n"
+                       "        pass")
+  assert tlint.lint_source(sup, "x.py", rules=["GL121"]) == []
+  del bad
+
+
+def test_gl122_multi_root_unsynchronized_mutation():
+  src = """
+import threading
+
+class W:
+  def __init__(self):
+    self._lock = threading.Lock()
+    self.items = []
+    self._t1 = threading.Thread(target=self._produce)
+    self._t2 = threading.Thread(target=self._consume)
+
+  def _produce(self):
+    self.items.append(1)
+
+  def _consume(self):
+    self.items.pop()
+"""
+  out = tlint.lint_source(src, "x.py", rules=["GL122"])
+  assert _rules(out) == ["GL122"]
+  assert "_produce" in out[0].message and "_consume" in out[0].message
+  # locking every mutation clears it ...
+  locked = src.replace(
+      "def _produce(self):\n    self.items.append(1)",
+      "def _produce(self):\n    with self._lock:\n      self.items.append(1)"
+  ).replace(
+      "def _consume(self):\n    self.items.pop()",
+      "def _consume(self):\n    with self._lock:\n      self.items.pop()")
+  assert tlint.lint_source(locked, "x.py", rules=["GL122"]) == []
+  # ... and so does annotating (GL120 then owns the discipline)
+  annotated = src.replace("self.items = []",
+                          "self.items = []  # guarded-by: _lock")
+  assert tlint.lint_source(annotated, "x.py", rules=["GL122"]) == []
+  # suppression on the first unsynced mutation line silences
+  sup = src.replace("self.items.append(1)",
+                    "self.items.append(1)  # graftlint: disable=GL122")
+  assert tlint.lint_source(sup, "x.py", rules=["GL122"]) == []
+
+
+def test_gl122_single_root_is_not_a_race():
+  """One thread root mutating freely is thread-confined state (the
+  subscriber's poll-thread fields), not a race."""
+  src = """
+import threading
+
+class S:
+  def __init__(self):
+    self._t = threading.Thread(target=self._loop)
+    self.seen = 0
+
+  def _loop(self):
+    self.seen += 1
+"""
+  assert tlint.lint_source(src, "x.py", rules=["GL122"]) == []
+
+
+def test_gl123_wait_outside_while_and_notify_without_lock():
+  src = """
+import threading
+
+class C:
+  def __init__(self):
+    self._lock = threading.Lock()
+    self._cv = threading.Condition(self._lock)
+    self.ready = False
+
+  def bad_wait(self):
+    with self._cv:
+      if not self.ready:
+        self._cv.wait()
+
+  def bad_notify(self):
+    self._cv.notify()
+
+  def good(self):
+    with self._cv:
+      while not self.ready:
+        self._cv.wait()
+      self._cv.notify_all()
+"""
+  out = tlint.lint_source(src, "x.py", rules=["GL123"])
+  assert [(f.rule, f.line) for f in out] == [("GL123", 13), ("GL123", 16)]
+  assert "while" in out[0].message
+  assert "notify" in out[1].message
+  # suppressions silence both
+  sup = src.replace("self._cv.wait()\n\n",
+                    "self._cv.wait()  # graftlint: disable=GL123\n\n", 1
+                    ).replace("self._cv.notify()",
+                              "self._cv.notify()  # graftlint: disable=GL123")
+  assert tlint.lint_source(sup, "x.py", rules=["GL123"]) == []
+
+
+def test_gl123_wait_for_and_events_exempt():
+  """wait_for loops internally; Event.wait has no predicate to re-test
+  — neither is condvar misuse."""
+  src = """
+import threading
+
+class C:
+  def __init__(self):
+    self._cv = threading.Condition()
+    self._stop = threading.Event()
+
+  def ok(self):
+    with self._cv:
+      self._cv.wait_for(lambda: True, timeout=1.0)
+    self._stop.wait(timeout=1.0)
+
+  def notify_under_own_lock(self):
+    with self._cv:
+      self._cv.notify_all()
+"""
+  assert tlint.lint_source(src, "x.py", rules=["GL123"]) == []
+
+
+def test_gl124_stale_and_unknown_suppressions():
+  # a live suppression is fine; a stale one (rule never fires on that
+  # line) and an unknown id are both GL124
+  stale = """
+def f():
+  x = 1  # graftlint: disable=GL103
+  return x
+"""
+  out = lint_source(stale, "tools/x.py", CTX, ["GL103", "GL124"])
+  assert _rules(out) == ["GL124"]
+  assert "suppresses nothing" in out[0].message
+  live = """
+def f():
+  try:
+    pass
+  except:  # graftlint: disable=GL103
+    pass
+"""
+  assert lint_source(live, "tools/x.py", CTX, ["GL103", "GL124"]) == []
+  unknown = """
+def f():
+  return 1  # graftlint: disable=GL999
+"""
+  out = lint_source(unknown, "tools/x.py", CTX, ["GL124"])
+  assert _rules(out) == ["GL124"]
+  assert "unknown rule id" in out[0].message
+
+
+def test_gl124_scope_rules_and_string_literals():
+  # ids whose rule did NOT run this lint are not judged (a partial-rules
+  # lint must not call other rules' suppressions stale) ...
+  partial = """
+def f():
+  x = 1  # graftlint: disable=GL103
+  return x
+"""
+  assert lint_source(partial, "tools/x.py", CTX, ["GL106", "GL124"]) == []
+  # ... threadlint-owned ids are left to the threadlint pass ...
+  external = """
+def f():
+  return 1  # graftlint: disable=GL120
+"""
+  assert lint_source(external, "tools/x.py", CTX, ["GL124"]) == []
+  # ... and disable text inside a STRING (this suite's own fixtures) is
+  # not a suppression at all
+  fixture = '''
+SRC = """
+x = 1  # graftlint: disable=GL103
+"""
+'''
+  assert lint_source(fixture, "tests/x.py", CTX, ["GL124"]) == []
+
+
+def test_gl124_threadlint_judges_its_own_ids():
+  src = """
+import threading
+
+class B:
+  def __init__(self):
+    self._lock = threading.Lock()
+    self._n = 0  # guarded-by: _lock
+
+  def ok(self):
+    with self._lock:
+      self._n += 1  # graftlint: disable=GL120
+"""
+  out = tlint.lint_source(src, "x.py")
+  assert _rules(out) == ["GL124"]
+  assert "GL120" in out[0].message
+
+
+def test_gl125_registry_staleness_both_ways(tmp_path):
+  (tmp_path / "pkg").mkdir()
+  mod = tmp_path / "pkg" / "svc.py"
+  mod.write_text("""
+import threading
+
+class Svc:
+  def start(self):
+    self._t = threading.Thread(target=self._loop, daemon=True)
+    self._t.start()
+
+  def _loop(self):
+    pass
+""")
+  # discovered but unregistered: flagged at the construction site
+  (tmp_path / "pyproject.toml").write_text(
+      "[tool.graftlint]\nthread-roots = []\n")
+  out = tlint.lint_paths([str(mod)], root=str(tmp_path))
+  assert _rules(out) == ["GL125"]
+  assert "not registered" in out[0].message and "Svc._loop" in out[0].message
+  # registered and discovered: clean
+  (tmp_path / "pyproject.toml").write_text(
+      '[tool.graftlint]\nthread-roots = [\n    "pkg/svc.py::Svc._loop",\n]\n')
+  assert tlint.lint_paths([str(mod)], root=str(tmp_path)) == []
+  # registered but no longer discovered (thread removed): the ENTRY is
+  # stale, flagged at its pyproject line
+  mod.write_text("class Svc:\n  pass\n")
+  out = tlint.lint_paths([str(mod)], root=str(tmp_path))
+  assert _rules(out) == ["GL125"]
+  assert "stale" in out[0].message
+  assert out[0].path.endswith("pyproject.toml")
+  # an entry for a file OUTSIDE the linted set is not judged
+  (tmp_path / "pyproject.toml").write_text(
+      '[tool.graftlint]\nthread-roots = [\n'
+      '    "other/mod.py::Other._loop",\n]\n')
+  assert tlint.lint_paths([str(mod)], root=str(tmp_path)) == []
+
+
+def test_threadlint_repo_is_clean_at_head():
+  """The annotated baseline: every guarded attribute in the batcher /
+  engine / subscriber / router / registry / flight recorder is
+  annotated, the thread-root registry matches discovery exactly, the
+  lock graph is acyclic, and no suppression is stale."""
+  pkg = os.path.join(REPO, "distributed_embeddings_tpu")
+  findings = tlint.lint_paths([pkg], root=REPO)
+  assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_threadlint_discovers_the_registered_concurrency_model():
+  """The registry IS the model: parse_thread_roots and discovery agree
+  entry-for-entry (the GL125 invariant, asserted directly), and the
+  known long-lived service threads are all present."""
+  roots = tlint.parse_thread_roots(REPO)
+  assert roots is not None and len(roots) >= 10
+  names = {e.split("::", 1)[1] for e, _ in roots}
+  for expected in ("MicroBatcher._flush_loop", "MicroBatcher._complete_loop",
+                   "DeltaSubscriber._poll_loop", "HostWorker._loop",
+                   "FleetStore._hedged_call.run", "FlightRecorder._dump"):
+    assert expected in names, expected
+
+
+# ---------------------------------------------------------------------------
+# the runtime sanitizer: lockorder agrees with the static graph
+# ---------------------------------------------------------------------------
+
+
+def test_lockorder_inverted_acquisition_trips():
+  import threading
+  mon = LockOrderMonitor()
+  a = mon.wrap(threading.Lock(), "T.a")
+  b = mon.wrap(threading.Lock(), "T.b")
+  with a:
+    with b:
+      pass
+  with pytest.raises(LockOrderError, match="inversion"):
+    with b:
+      with a:
+        pass
+
+
+def test_lockorder_reentrant_and_condition_share_name():
+  import threading
+  mon = LockOrderMonitor()
+  lock = threading.RLock()
+  wrapped = mon.wrap(lock, "T.lock")
+  cv = mon.wrap(threading.Condition(lock), "T.lock")
+  with wrapped:
+    with cv:  # same name: reentrant, no self-edge
+      cv.notify_all()
+  assert mon.edges() == set()
+
+
+def test_lockorder_consistency_with_static_graph():
+  import threading
+  mon = LockOrderMonitor()
+  a = mon.wrap(threading.Lock(), "T.a")
+  b = mon.wrap(threading.Lock(), "T.b")
+  with a:
+    with b:
+      pass
+  # consistent with an empty static graph and with a same-order edge
+  mon.assert_consistent_with(set())
+  mon.assert_consistent_with({("T.a", "T.b")})
+  # a static edge in the OPPOSITE order closes a cycle: the runtime
+  # truth contradicts the checked-in model
+  with pytest.raises(LockOrderError, match="cycle"):
+    mon.assert_consistent_with({("T.b", "T.a")})
+
+
+def test_lockorder_static_graph_is_empty_and_acyclic_at_head():
+  """The library holds at most one lock at a time lexically (cross-
+  object nesting like router-over-store is runtime-only, covered by
+  the instrumented tests) — pin that, so the first nested `with`
+  must consciously pick an order."""
+  assert tlint.static_lock_edges(REPO) == set()

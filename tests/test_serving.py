@@ -820,7 +820,11 @@ def test_profile_serve_full_sweep():
 def test_batcher_end_to_end_with_engine():
   """Real engine behind the batcher: concurrent variable-size requests
   against a frozen DLRM, each result matching a direct dispatch of the
-  same rows."""
+  same rows.  The batcher's locks run instrumented (telemetry.lockorder)
+  and the observed acquisition order must stay consistent with
+  threadlint's static lock graph."""
+  from distributed_embeddings_tpu.analysis import threadlint
+  from distributed_embeddings_tpu.telemetry import LockOrderMonitor
   (plan_b, plan_t, model, mesh, rule, state_b, state_t, store,
    batch) = _tiered_fixture()
   numerical, cats, _ = batch
@@ -828,6 +832,11 @@ def test_batcher_end_to_end_with_engine():
   eng = ServeEngine(model, plan_b, frozen, mesh=mesh)
   max_batch = 16
   mb = MicroBatcher(eng.dispatch, max_batch=max_batch, max_delay_s=0.005)
+  mon = LockOrderMonitor()
+  # _nonempty is Condition(self._lock): one lock, one name
+  mb._lock = mon.wrap(mb._lock, "MicroBatcher._lock")
+  mb._nonempty = mon.wrap(mb._nonempty, "MicroBatcher._lock")
+  eng.lock = mon.wrap(eng.lock, "ServeEngine.lock")
 
   def direct(rows):
     n = rows[0].shape[0]
@@ -850,3 +859,6 @@ def test_batcher_end_to_end_with_engine():
     got = fut.result(timeout=60)
     np.testing.assert_allclose(got, want, atol=1e-5)
   mb.close()
+  # the runtime sanitizer saw real flush/complete/submit interleavings;
+  # merged with the static graph the order must still be acyclic
+  mon.assert_consistent_with(threadlint.static_lock_edges())

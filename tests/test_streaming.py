@@ -680,6 +680,16 @@ def test_promote_under_concurrent_batcher_traffic(tmp_path):
                                 _mkbatch(rng, b), donate=False)
   batcher = MicroBatcher(sub.dispatch, max_batch=b, max_delay_s=0.001,
                          registry=MetricsRegistry())
+  # run the whole storm under the lockorder sanitizer: batcher flush/
+  # complete loops, client submits, and the subscriber's fold-vs-
+  # dispatch exclusion on the engine lock all record real acquisition
+  # edges, checked against threadlint's static graph at the end
+  from distributed_embeddings_tpu.analysis import threadlint
+  from distributed_embeddings_tpu.telemetry import LockOrderMonitor
+  mon = LockOrderMonitor()
+  batcher._lock = mon.wrap(batcher._lock, "MicroBatcher._lock")
+  batcher._nonempty = mon.wrap(batcher._nonempty, "MicroBatcher._lock")
+  sub.engine.lock = mon.wrap(sub.engine.lock, "ServeEngine.lock")
   stop = threading.Event()
   failures = []
 
@@ -720,6 +730,7 @@ def test_promote_under_concurrent_batcher_traffic(tmp_path):
     sub.stop()
     batcher.close()
   assert not failures, failures
+  mon.assert_consistent_with(threadlint.static_lock_edges())
   assert sub.last_error is None
   assert sub.applied_seq == publisher.seq  # converged under load
   engB, art = _full_engine(tmp_path, plan, rule, mesh, state, "f32")

@@ -1,15 +1,23 @@
 #!/usr/bin/env python
 """graftlint: the repo-invariant linter (`make lint`).
 
-Two passes (both on by default):
+Three passes (all on by default):
 
 1. AST lint (``distributed_embeddings_tpu.analysis.astlint``): the GL1xx
    rule registry over every Python source in the tree — host syncs in
    step-builder code, bare excepts, un-fsynced renames in durable paths,
    wall clock/RNG in manifests, int32 index-arithmetic narrowing,
-   unregistered pytest marks, unknown fault-injection sites. Line-level
-   ``# graftlint: disable=GLnnn`` suppresses.
-2. Jaxpr audit (``...analysis.jaxpr_audit``): traces the real step
+   unregistered pytest marks, unknown fault-injection sites, stale
+   suppressions (GL124). Line-level ``# graftlint: disable=GLnnn``
+   suppresses.
+2. Concurrency lint (``...analysis.threadlint``): lock discipline over
+   the LIBRARY package only — ``# guarded-by`` annotation enforcement
+   (GL120), lock-acquisition-graph cycles (GL121), unannotated
+   multi-thread-root mutation (GL122), condition-variable misuse
+   (GL123), and the ``pyproject.toml [tool.graftlint] thread-roots``
+   registry cross-check (GL125). Tests/tools spawn throwaway threads by
+   design and are out of scope.
+3. Jaxpr audit (``...analysis.jaxpr_audit``): traces the real step
    builders on a virtual CPU mesh and asserts structural invariants
    (exactly one scatter-add per fused class, collective axis hygiene,
    guard pmin iff guarded, no f64, no host callbacks), then diffs each
@@ -17,14 +25,17 @@ Two passes (both on by default):
    jaxpr_fingerprints.json``.
 
 Exit status 1 on any error-severity finding, audit violation, or
-fingerprint drift; 0 otherwise.
+fingerprint drift; 0 otherwise. ``--json`` additionally emits the
+normalized tool verdict through ``telemetry.emit_verdict`` (appended to
+``$DE_TPU_VERDICT_LOG`` when set), like the chaos/soak tools.
 
 Usage:
-  python tools/graftlint.py                  # both passes, whole tree
+  python tools/graftlint.py                  # all passes, whole tree
   python tools/graftlint.py --ast-only [PATH ...]
   python tools/graftlint.py --jaxpr-only
   python tools/graftlint.py --update-fingerprints
   python tools/graftlint.py --list-rules
+  python tools/graftlint.py --json
 """
 
 import argparse
@@ -38,6 +49,9 @@ DEFAULT_PATHS = [
     "distributed_embeddings_tpu", "tests", "tools", "examples",
     "bench.py", "__graft_entry__.py",
 ]
+
+# the concurrency pass lints the library package only (see module doc)
+THREADLINT_PATHS = ["distributed_embeddings_tpu"]
 
 
 def _setup_cpu_mesh_env():
@@ -58,27 +72,34 @@ def main(argv=None) -> int:
   ap.add_argument("paths", nargs="*", help="files/dirs for the AST pass "
                   f"(default: {' '.join(DEFAULT_PATHS)})")
   ap.add_argument("--ast-only", action="store_true",
-                  help="skip the jaxpr audit (no jax import)")
+                  help="skip the jaxpr audit (no jax import); the AST "
+                  "and concurrency passes both run")
   ap.add_argument("--jaxpr-only", action="store_true",
-                  help="skip the AST pass")
+                  help="skip the AST and concurrency passes")
   ap.add_argument("--update-fingerprints", action="store_true",
                   help="rewrite tests/data/jaxpr_fingerprints.json from "
                   "the current trace instead of diffing against it")
   ap.add_argument("--list-rules", action="store_true")
+  ap.add_argument("--json", action="store_true",
+                  help="emit the normalized tool verdict via "
+                  "telemetry.emit_verdict ($DE_TPU_VERDICT_LOG hook)")
   ap.add_argument("-q", "--quiet", action="store_true")
   args = ap.parse_args(argv)
   if args.update_fingerprints and args.ast_only:
     ap.error("--update-fingerprints needs the jaxpr pass; drop --ast-only")
 
-  from distributed_embeddings_tpu.analysis import astlint
+  from distributed_embeddings_tpu.analysis import astlint, threadlint
 
   if args.list_rules:
     for rid, rule in sorted(astlint.RULES.items()):
       print(f"{rid}  {rule.severity:<7}  {rule.title}")
+    for rid, (severity, title) in sorted(threadlint.THREAD_RULES.items()):
+      print(f"{rid}  {severity:<7}  {title}  [threadlint]")
     return 0
 
   say = (lambda *_: None) if args.quiet else print
   errors = 0
+  result = {"ok": True}
 
   if not args.jaxpr_only:
     paths = args.paths or [os.path.join(REPO, p) for p in DEFAULT_PATHS]
@@ -88,6 +109,20 @@ def main(argv=None) -> int:
       errors += f.severity == "error"
     say(f"graftlint ast: {len(findings)} finding(s) over "
         f"{len(list(astlint._iter_py_files(paths)))} file(s)")
+    result["ast_findings"] = len(findings)
+
+    # concurrency pass: fixed library scope regardless of positional
+    # paths UNLESS explicit paths were given (then lint their
+    # intersection story the simple way: the explicit paths)
+    tpaths = args.paths or [os.path.join(REPO, p)
+                            for p in THREADLINT_PATHS]
+    tfindings = threadlint.lint_paths(tpaths, root=REPO)
+    for f in tfindings:
+      print(f.render())
+      errors += f.severity == "error"
+    say(f"graftlint thread: {len(tfindings)} finding(s) over "
+        f"{len(list(astlint._iter_py_files(tpaths)))} file(s)")
+    result["thread_findings"] = len(tfindings)
 
   if not args.ast_only:
     _setup_cpu_mesh_env()
@@ -101,7 +136,13 @@ def main(argv=None) -> int:
     errors += len(violations)
     say(f"graftlint jaxpr: {len(prints)} artifact(s), "
         f"{len(violations)} violation(s)")
+    result["jaxpr_violations"] = len(violations)
 
+  result["ok"] = errors == 0
+  result["errors"] = errors
+  if args.json:
+    from distributed_embeddings_tpu.telemetry import emit_verdict
+    return emit_verdict("graftlint", result, verbose=not args.quiet)
   if errors:
     print(f"graftlint: FAILED ({errors} error(s))")
     return 1
