@@ -10,7 +10,8 @@ SHELL := /bin/bash  # verify uses pipefail/PIPESTATUS
 	chaos-stream stream-smoke serve-bench \
 	serve-smoke vocab-bench vocab-smoke obs-bench obs-smoke fresh-bench \
 	fresh-smoke fleet-bench fleet-smoke trace-bench trace-smoke \
-	control-bench control-smoke overlap-bench overlap-smoke clean
+	control-bench control-smoke overlap-bench overlap-smoke \
+	exchange-occupancy exchange-smoke clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -172,12 +173,25 @@ overlap-smoke:
 	PYTHONPATH=$(CURDIR):$$PYTHONPATH timeout -k 10 300 \
 	  $(PY) tools/profile_overlap.py --smoke
 
+# the round-20 fused-exchange pricing: per-round wall, gather-hidden
+# fraction (schedule accounting), wire bytes, fused vs pipelined step
+exchange-occupancy:
+	PYTHONPATH=$(CURDIR):$$PYTHONPATH $(PY) tools/profile_exchange.py \
+	  --overlap-occupancy
+
+# the make-verify tier: tiny workload, machinery + loss parity + the
+# schedule accounting only (CPU step times at toy scale are noise),
+# timeout-guarded like the other smoke tiers
+exchange-smoke:
+	PYTHONPATH=$(CURDIR):$$PYTHONPATH timeout -k 10 300 \
+	  $(PY) tools/profile_exchange.py --overlap-occupancy --smoke
+
 # the tier-1 gate, exactly as ROADMAP.md specifies it (CPU mesh, no slow
 # tests, collection errors surfaced but not fatal to the log); lint runs
 # first so invariant violations fail fast, then the smoke tiers
 verify: lint serve-smoke vocab-smoke obs-smoke fresh-smoke stream-smoke \
 	fleet-smoke trace-smoke preempt-smoke multiproc-smoke control-smoke \
-	overlap-smoke
+	overlap-smoke exchange-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -92,6 +92,17 @@ GL119       error      no raw ``threading.Thread`` / executor
                        one sanctioned host/device overlap surface, so
                        overlap stays bit-exact, joined before
                        accounting, and on one trace
+GL126       error      hand-written TPU kernel entry points
+                       (``pl.pallas_call`` / ``pltpu.
+                       make_async_remote_copy``) live only in
+                       ``ops/pallas_*.py`` modules, and every
+                       ``DE_TPU_PALLAS_*`` env gate read in the library
+                       package must match a :data:`PALLAS_GATE_REGISTRY`
+                       entry whose ``_use_pallas_*`` predicate is
+                       defined in that file — BOTH ways: an
+                       unregistered gate fails at its line, a registry
+                       entry whose file no longer reads the env (or
+                       lost its predicate) fails as stale
 GL124       error      every ``# graftlint: disable=<ID>`` comment must
                        suppress a finding that actually fires on its
                        line, and name a known rule id — stale or typo'd
@@ -1204,6 +1215,118 @@ def _check_refusal_inventory(mod: ParsedModule) -> List[Finding]:
           "inventoried (add a (path_suffix, reason_snippet) entry and "
           "the ARCHITECTURE §24 matrix row) — or implement the "
           "multi-controller path."))
+  return out
+
+
+# The sanctioned Pallas gates, BOTH directions checked by GL126. Each env
+# knob that can route a step onto a hand-written TPU kernel flows through
+# exactly one predicate in one file: the predicate is what tests force
+# (and what the CPU tier proves stays False when the env is set), so a
+# gate read outside its predicate's home file — or a second read of the
+# same knob — would let the kernel engage on a path tier-1 never guards.
+# An env read matching no entry fails at its line; an entry whose file is
+# linted but no longer reads the env, or no longer defines the predicate,
+# fails as a stale-registry finding at the file.
+PALLAS_GATE_REGISTRY = (
+    ("ops/packed_table.py", "DE_TPU_PALLAS_APPLY", "_use_pallas_apply"),
+    ("ops/pallas_interact.py", "DE_TPU_PALLAS_INTERACT",
+     "use_pallas_interact"),
+    ("parallel/lookup_engine.py", "DE_TPU_PALLAS_DELTA", "_use_pallas_delta"),
+    ("ops/pallas_exchange.py", "DE_TPU_PALLAS_EXCHANGE",
+     "_use_pallas_exchange"),
+)
+
+PALLAS_ENV_PREFIX = "DE_TPU_PALLAS_"
+PALLAS_KERNEL_CALLS = ("pallas_call", "make_async_remote_copy")
+_PALLAS_HOME_RE = re.compile(r"ops/pallas_[^/]*\.py$")
+
+
+def _pallas_env_reads(tree: ast.Module) -> List[Tuple[ast.AST, str]]:
+  """``(node, env_name)`` for every ``DE_TPU_PALLAS_*`` env access:
+  ``environ.get(...)`` / ``os.getenv(...)`` calls and ``environ[...]``
+  subscripts. Docstrings/comments mentioning a gate never match — only
+  actual access expressions do."""
+  out = []
+  for node in ast.walk(tree):
+    if isinstance(node, ast.Call):
+      _, name = _call_pair(node)
+      if name in ("get", "getenv") and node.args:
+        a0 = node.args[0]
+        if isinstance(a0, ast.Constant) and isinstance(a0.value, str) \
+            and a0.value.startswith(PALLAS_ENV_PREFIX):
+          out.append((node, a0.value))
+    elif isinstance(node, ast.Subscript):
+      sl = node.slice
+      if isinstance(sl, ast.Constant) and isinstance(sl.value, str) \
+          and sl.value.startswith(PALLAS_ENV_PREFIX):
+        d = _dotted(node.value)
+        if d and d.split(".")[-1] == "environ":
+          out.append((node, sl.value))
+  return out
+
+
+@_rule("GL126", "error",
+       "Pallas kernel calls and env gates are registered and homed")
+def _check_pallas_gates(mod: ParsedModule) -> List[Finding]:
+  # Two invariants, scoped to the library package (tests/tools stay free
+  # to force gates and build kernel fixtures):
+  # 1. `pl.pallas_call` / `pltpu.make_async_remote_copy` appear only in
+  #    `ops/pallas_*.py` — the kernel modules with interpret-mode twins
+  #    and TPU smoke coverage. A kernel call elsewhere has neither.
+  # 2. Every `DE_TPU_PALLAS_*` env read matches a PALLAS_GATE_REGISTRY
+  #    entry for this file, and each entry for this file still holds
+  #    (env read present, predicate defined) — the stale direction, so
+  #    renaming or removing a gate forces the registry (and the
+  #    ARCHITECTURE gate table) to move with it.
+  norm = mod.path.replace(os.sep, "/")
+  if "distributed_embeddings_tpu/" not in norm:
+    return []
+  out = []
+  in_kernel_home = bool(_PALLAS_HOME_RE.search(norm))
+  if not in_kernel_home:
+    for node in ast.walk(mod.tree):
+      if isinstance(node, ast.Call):
+        _, name = _call_pair(node)
+        if name in PALLAS_KERNEL_CALLS:
+          out.append(mod.finding(
+              "GL126", node,
+              f"{name} outside ops/pallas_*.py: hand-written kernel "
+              "entry points live in the kernel modules (with their "
+              "interpret-mode twins and TPU smoke coverage) and are "
+              "reached through a registered _use_pallas_* gate — a "
+              "kernel call here has neither a sim twin nor a gate "
+              "tier-1 can prove off."))
+  entries = [e for e in PALLAS_GATE_REGISTRY if norm.endswith(e[0])]
+  reads = _pallas_env_reads(mod.tree)
+  for node, env in reads:
+    if not any(env == e[1] for e in entries):
+      out.append(mod.finding(
+          "GL126", node,
+          f"unregistered Pallas gate {env!r}: every DE_TPU_PALLAS_* "
+          "env knob must have a (file, env, predicate) entry in "
+          "analysis.astlint.PALLAS_GATE_REGISTRY homing it to ONE "
+          "_use_pallas_* predicate in ONE file — a second read of a "
+          "gate (or a gate without a predicate) can engage a kernel "
+          "on a path tier-1 never guards."))
+  if entries:
+    defined = {n.name for n in ast.walk(mod.tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    read_envs = {env for _, env in reads}
+    for sfx, env, pred in entries:
+      if env not in read_envs:
+        out.append(Finding(
+            "GL126", "error", mod.path, 0,
+            f"stale PALLAS_GATE_REGISTRY entry ({sfx!r}, {env!r}): "
+            "this file no longer reads the env gate — the gate moved "
+            "or was removed, so prune/update the registry entry (and "
+            "the ARCHITECTURE gate table) to match."))
+      if pred not in defined:
+        out.append(Finding(
+            "GL126", "error", mod.path, 0,
+            f"stale PALLAS_GATE_REGISTRY entry ({sfx!r}, {pred!r}): "
+            "this file does not define the registered predicate — "
+            "the gate's decision point moved, so update the registry "
+            "entry to the predicate that actually guards the kernel."))
   return out
 
 

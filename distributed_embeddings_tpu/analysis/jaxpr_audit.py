@@ -28,6 +28,11 @@ the traced program:
   — exactly ``(world - 1) * exchange_chunks`` rounds per exchange, zero
   ``all_to_all``s — and the float dtype check covers the ppermute
   payloads (the fp8 wire's blocks must actually fly as float8_e4m3).
+  Plans with ``overlap='fused'`` keep the same round pin AND pin the
+  total ``gather`` op count: the just-in-time schedule gathers each
+  round's rows inside the round body instead of a monolithic pre-pass,
+  so the count is strictly higher than the pipelined trace of the same
+  fixture — a drift back down means the pre-gather was re-hoisted.
 - **No f64 leaks**: no equation produces a float64 value (CPU tracing
   would hide what TPU lowering rejects; an f64 constant also doubles a
   buffer).
@@ -181,6 +186,13 @@ class Expectation:
   # all_to_alls; a drifting count means a chunk (or a whole exchange)
   # silently fell out of — or was added to — the schedule.
   ppermute_count: Optional[int] = None
+  # exact TOTAL gather count (None: not checked). The fused-exchange
+  # artifacts pin this: overlap='fused' replaces each bucket's single
+  # monolithic pre-gather with one gather per (round, chunk) issued
+  # just-in-time before that round's send, so the count RISES vs the
+  # pipelined trace of the same fixture. A regression back to a
+  # monolithic pre-pass collapses the count and fails here.
+  gather_count: Optional[int] = None
   # exact TOTAL scatter count, any variant, any operand shape (None:
   # not checked). The serve artifacts pin 0: a forward-only inference
   # step that scatters anywhere is reverse-mode (or a write) leaking in.
@@ -237,6 +249,14 @@ def audit_summary(name: str, s: JaxprSummary, expect: Expectation
         "the pipelined schedule drifted: a missing round strands a "
         "chunk's blocks on their source ranks, an extra one is wire "
         "traffic the budget does not account for")
+  n_gather = s.counts.get("gather", 0)
+  if expect.gather_count is not None and n_gather != expect.gather_count:
+    out.append(
+        f"{name}: {n_gather} gather op(s), expected "
+        f"{expect.gather_count} — the fused just-in-time schedule "
+        "drifted: fewer gathers means rounds re-grew a monolithic "
+        "pre-gather (row staging the overlap was built to hide); more "
+        "means a round body gathers twice")
   if expect.wire_float_dtype is not None:
     bad = sorted({d for d in s.a2a_dtypes + s.ppermute_dtypes
                   if "float" in d and d != expect.wire_float_dtype})
@@ -311,6 +331,11 @@ def build_artifacts() -> Dict[str, Tuple[Any, Expectation]]:
     all_to_alls, exactly ``3 buckets x (world-1) x chunks`` ppermute
     rounds, float payloads in the mode's wire dtype (the fp8 artifact
     also dedups, pinning the pipelined x dedup composition)
+  - ``sparse_step_fused_f32`` / ``..._fp8``: the same step on
+    ``overlap='fused'`` plans (raw and dedup) — same ppermute-round and
+    zero-all_to_all pins as pipelined, plus an exact total ``gather``
+    count pinning the just-in-time per-(round, chunk) gather schedule
+    (the absence of a monolithic pre-gather)
   - ``tiered_step``:        ``make_tiered_train_step`` (host-tier class)
   - ``tiered_step_guard``:  ``make_tiered_train_step(guard=True)`` —
     the commit gate's pmin must appear exactly once here too, so a
@@ -504,6 +529,38 @@ def build_artifacts() -> Dict[str, Tuple[Any, Expectation]]:
                     ppermute_count=3 * nb_p * (WORLD - 1) * CHUNKS,
                     wire_float_dtype={
                         "f32": "float32", "bf16": "bfloat16",
+                        "fp8": "float8_e4m3fn"}[wname]))
+
+  # ---- fused exchange steps (just-in-time per-round gathers) -------------
+  # overlap='fused' keeps the pipelined ROUND schedule (ids still ride
+  # the chunked ppermute wire, and the k=0 self-round sends nothing, so
+  # the ppermute pin is the SAME 3 x buckets x (world-1) x chunks
+  # formula) but moves each round's row gather inside the round body.
+  # The gather_count pin is the structural evidence: the pipelined
+  # trace of this exact fixture carries 22 gathers (one monolithic
+  # pre-gather per bucket plus model/reassembly takes); fused f32 raw
+  # splits those into per-(round, chunk) gathers — 34 — and fused fp8
+  # dedup (uniq-block rows gathered per round, plus the dedup build's
+  # own takes) carries 42. A refactor that quietly re-hoists the
+  # gather to a pre-pass collapses the count back toward 22 and fails.
+  for wname, dedup, n_gather in (("f32", False, 34), ("fp8", True, 42)):
+    plan_f = DistEmbeddingStrategy(
+        [TableConfig(input_dim=v, output_dim=WIDTH,
+                     initializer=_dlrm_initializer(v)) for v in VOCAB],
+        WORLD, "memory_balanced", dense_row_threshold=60,
+        wire_dtype=wname, dedup_exchange=dedup,
+        overlap="fused", exchange_chunks=CHUNKS)
+    step_f = make_sparse_train_step(model, plan_f, bce_loss, opt, rule,
+                                    mesh, state, batch0, donate=False)
+    jx = jax.make_jaxpr(step_f)(state, *bt)
+    nb_f = n_padded_buckets(plan_f)
+    artifacts[f"sparse_step_fused_{wname}"] = (
+        jx.jaxpr,
+        Expectation(shapes, mesh_axes, guard=False, a2a_count=0,
+                    ppermute_count=3 * nb_f * (WORLD - 1) * CHUNKS,
+                    gather_count=n_gather,
+                    wire_float_dtype={
+                        "f32": "float32",
                         "fp8": "float8_e4m3fn"}[wname]))
 
   # ---- tiered step (host-tier class + device tiers) ----------------------

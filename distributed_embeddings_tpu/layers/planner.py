@@ -354,6 +354,14 @@ class DistEmbeddingStrategy:
     # receiving side's gather/combine of chunk k overlaps chunk k+1's
     # flight (wire.pipelined_float_exchange / pipelined_exchange_ids;
     # f32 pipelined is bit-exact vs monolithic — pure data movement).
+    # "overlap='fused'": the just-in-time form of the pipelined schedule
+    # — sparse-class activation/cotangent rows are gathered (and, under
+    # dedup_exchange, expanded/segment-summed) per ROUND immediately
+    # before each wire.fused_block_send instead of in one monolithic
+    # pre-gather, so round k's collective can overlap round k+1's gather
+    # (and on a real TPU the ops/pallas_exchange.py remote-DMA kernel
+    # takes over). Id exchanges and dense-class floats still ride the
+    # pipelined schedule; f32 fused is bit-exact vs both other modes.
     # None of these knobs changes any buffer layout, so checkpoints
     # restore across knob changes; training step builders reject
     # exact=True with a narrowed (bf16/fp8) wire (the exact path's
@@ -363,17 +371,18 @@ class DistEmbeddingStrategy:
           f"wire_dtype must be 'f32', 'bf16' or 'fp8', got {wire_dtype!r}")
     self.wire_dtype = wire_dtype
     self.dedup_exchange = bool(dedup_exchange)
-    if overlap not in ("none", "pipelined"):
+    if overlap not in ("none", "pipelined", "fused"):
       raise ValueError(
-          f"overlap must be 'none' or 'pipelined', got {overlap!r}")
+          f"overlap must be 'none', 'pipelined' or 'fused', got {overlap!r}")
     if not isinstance(exchange_chunks, int) or exchange_chunks < 1:
       raise ValueError(
           f"exchange_chunks must be a positive int, got {exchange_chunks!r}")
-    if exchange_chunks > 1 and overlap != "pipelined":
+    if exchange_chunks > 1 and overlap == "none":
       raise ValueError(
           f"exchange_chunks={exchange_chunks} without overlap='pipelined' "
-          "would be silently ignored: the monolithic all_to_all has no "
-          "chunk axis. Set overlap='pipelined' (or exchange_chunks=1).")
+          "or 'fused' would be silently ignored: the monolithic all_to_all "
+          "has no chunk axis. Set overlap='pipelined'/'fused' (or "
+          "exchange_chunks=1).")
     self.overlap = overlap
     self.exchange_chunks = exchange_chunks
     # "dedup_capacity": override the dedup'd exchange's per-block unique
@@ -1136,8 +1145,12 @@ class DistEmbeddingStrategy:
     element size of activation/cotangent payloads under ``wire_dtype``.
     ``rounds_per_exchange`` is the pipelined schedule's collective count
     per exchange: ``(world - 1) * exchange_chunks`` ppermute rounds
-    under ``overlap='pipelined'`` (the jaxpr audit pins exactly this per
-    artifact), 1 monolithic all_to_all otherwise.
+    under ``overlap='pipelined'`` or ``'fused'`` (the jaxpr audit pins
+    exactly this per artifact; fused sparse-class exchanges may carry
+    fewer when a block has fewer rows than chunks — the per-bucket chunk
+    count caps at the row count), 1 monolithic all_to_all otherwise.
+    ``jit_gather`` reports whether the fused just-in-time per-round
+    gather schedule is active.
     """
     from ..parallel.lookup_engine import class_param_name
     classes = {}
@@ -1149,7 +1162,8 @@ class DistEmbeddingStrategy:
           "dedup": bool(self.dedup_exchange and cp.kind == "sparse"
                         and self.world_size > 1),
       }
-    pipelined = self.overlap == "pipelined" and self.world_size > 1
+    pipelined = (self.overlap in ("pipelined", "fused")
+                 and self.world_size > 1)
     return {
         "wire_dtype": self.wire_dtype,
         "dedup_exchange": self.dedup_exchange,
@@ -1161,6 +1175,7 @@ class DistEmbeddingStrategy:
         "rounds_per_exchange": ((self.world_size - 1) * self.exchange_chunks
                                 if pipelined else
                                 (1 if self.world_size > 1 else 0)),
+        "jit_gather": self.overlap == "fused" and self.world_size > 1,
         "world_size": self.world_size,
         "classes": classes,
     }

@@ -1,0 +1,54 @@
+"""Interpret-mode twin of the fused gather->send kernel, runnable on CPU.
+
+Unlike ``pallas_apply`` — whose input/output aliasing has no faithful
+interpret-mode equivalent and therefore ships a statement-for-statement
+NUMPY simulator — the exchange kernel has no aliasing, so its twin runs
+the REAL kernel body (`pallas_exchange._exchange_kernel`: the same chunk
+loop, the same double-buffer slot protocol, the same per-row DMA
+start/wait/mask sequence) under Pallas interpret mode. Tier-1 exercises
+it on the CPU proxy against the shared golden vectors
+(`tests/test_pallas_goldens.py`), so any drift between the kernel body
+and ``packed_table.gather_fused`` semantics fails in CI, not on
+hardware.
+
+The one divergence from the TPU build is the transport: interpret mode
+has a single logical device, so ``make_async_remote_copy`` is modeled as
+a LOCAL async copy into the same-offset chunk of the out buffer
+(``remote=False`` — exactly what a rotate-by-0 round does on hardware).
+The neighbor barrier and remote semaphore pairing are TPU-smoke
+territory, same discipline as the apply kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .pallas_exchange import LANES, gather_rows
+
+
+def gather_rows_sim(layout, buf: jax.Array, ids: jax.Array, *,
+                    chunk: int = 128) -> jax.Array:
+  """`pallas_exchange.gather_rows` run in interpret mode on CPU."""
+  return gather_rows(layout, buf, ids, chunk=chunk, interpret=True)
+
+
+def gather_send_rows_sim(buf: jax.Array, ids: jax.Array, *,
+                         chunk: int = 128) -> jax.Array:
+  """One fused exchange round with the transport looped back to this
+  device (a rotate-by-0 round): the full chunk/double-buffer/OOB body
+  runs; only the remote DMA is modeled as its local equivalent."""
+  if buf.ndim != 2 or buf.shape[1] != LANES or buf.dtype != jnp.float32:
+    raise ValueError(f"buf must be [rows, {LANES}] float32, got "
+                     f"{buf.shape} {buf.dtype}")
+  # remote=False + interpret: same call tree as gather_send_rows minus
+  # the make_async_remote_copy transport and its neighbor barrier
+  from .pallas_exchange import _call_exchange
+  flat = ids.reshape(-1).astype(jnp.int32)
+  n = flat.shape[0]
+  if n == 0:
+    return jnp.zeros((0, LANES), buf.dtype)
+  nbr = jnp.zeros((2,), jnp.int32)
+  out = _call_exchange(buf, flat, nbr, chunk, remote=False,
+                       interpret=True, collective_id=None)
+  return out[:n]

@@ -47,7 +47,13 @@ keeps the inverse map, expands and combines locally, and the expansion's
 transpose segment-sums duplicate cotangents before the reverse exchange),
 and ``overlap='pipelined'`` replaces each monolithic exchange with
 ``(world - 1) * exchange_chunks`` ppermute rounds so consumption of chunk k
-overlaps chunk k+1's flight. See ARCHITECTURE.md §13 and §15.
+overlaps chunk k+1's flight. ``overlap='fused'`` goes one step further on
+the fused sparse path: each round's activation payload is GATHERED
+just-in-time immediately before its own send (:class:`FusedChunks`,
+:meth:`DistributedLookup._z_sparse_fused_jit`), so round k's collective
+can overlap round k+1's gather — and the reverse cotangent rounds each
+carry only their own segment-sum/expand work. See ARCHITECTURE.md §13,
+§15 and §26.
 """
 
 from __future__ import annotations
@@ -96,6 +102,26 @@ from ..ops.sparse_grad import expand_unique_rows, unique_ids_map
 from . import wire
 
 PAD_ID = -1  # marks hotness padding in dense-padded ragged inputs
+
+
+def _use_pallas_delta() -> bool:
+  """True when the Pallas delta-build kernel (`ops/pallas_delta.py`) may
+  run: ``DE_TPU_PALLAS_DELTA=1`` AND a real TPU backend (the graftlint
+  GL126 gate/predicate contract).
+
+  Default OFF: measured NET-NEGATIVE on Tiny (178 vs 162 ms wall) — the
+  kernel runs 16.7 ms where the XLA chain's removable share is smaller
+  than it traced: h=1 parts pay a whole extra HBM round-trip the XLA
+  form never materializes (its delta fuses into the scatter's
+  producer), and the batch-minor copies it targeted partially remain on
+  the gather side. Kept as measured infrastructure + the delta_lanes
+  twins (docs/BENCHMARKS.md round-5 staging study)."""
+  if os.environ.get("DE_TPU_PALLAS_DELTA", "0") != "1":
+    return False
+  try:
+    return jax.default_backend() == "tpu"
+  except RuntimeError:
+    return False
 
 
 def class_param_name(width: int, combiner: Optional[str],
@@ -357,6 +383,50 @@ class SparseResiduals:
                aux_rows=dict(zip(ak, children[len(ik):])))
 
 
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class FusedChunks:
+  """Round-major fused-exchange payload for one sparse bucket
+  (``overlap='fused'``).
+
+  ``blocks[k][c]`` is chunk ``c`` of the activations this rank gathered
+  for ROUND ``k``'s destination, rank ``(i + k) % world`` — ``[n_b,
+  rows_c, w]`` combined activations for raw/ragged buckets (``kind ==
+  "raw"``), ``[rows_c, w]`` unique rows for dedup'd buckets (``kind ==
+  "dedup"``). Keeping the rounds as SEPARATE pytree leaves instead of
+  one dest-major array is the whole point of the fused schedule: each
+  leaf's producer chain (slice ids -> gather -> combine) feeds exactly
+  one :func:`wire.fused_block_send`, so the traced program has no
+  monolithic pre-gather and round ``k``'s collective can overlap round
+  ``k + 1``'s gather. The structure flows through
+  ``jax.value_and_grad`` as a registered pytree: the cotangent comes
+  back in the same per-round form (each reverse send is preceded only
+  by ITS round's expand-transpose/segment-sum work), and
+  :meth:`DistributedLookup._sparse_parts_by_class` reassembles it into
+  the standard dest-major layout — pure data movement, so f32 stays
+  bit-exact vs the monolithic and pipelined forms.
+
+  Like :class:`DedupRouted`, deliberately NOT a tuple: routed ragged
+  buckets travel as plain tuples and consumers dispatch on isinstance.
+  """
+
+  blocks: tuple  # blocks[k][c]: round k's c-th row chunk
+  kind: str      # "raw" | "dedup"
+
+  def tree_flatten(self):
+    counts = tuple(len(blk) for blk in self.blocks)
+    return (tuple(c for blk in self.blocks for c in blk),
+            (counts, self.kind))
+
+  @classmethod
+  def tree_unflatten(cls, aux, children):
+    counts, kind = aux
+    it = iter(children)
+    return cls(
+        blocks=tuple(tuple(next(it) for _ in range(n)) for n in counts),
+        kind=kind)
+
+
 def _batch_of(inputs) -> int:
   x = inputs[0]
   return x.nrows if isinstance(x, RaggedIds) else x.shape[0]
@@ -569,8 +639,21 @@ class DistributedLookup:
   # ---- the plan's wire, in one place -------------------------------------
   def _pipelined_wire(self) -> bool:
     """The plan asked for the chunked ppermute pipeline (inert at world
-    1 — there is no wire to pipeline)."""
-    return (wire.plan_overlap(self.plan) == "pipelined"
+    1 — there is no wire to pipeline). ``overlap='fused'`` rides the
+    same pipeline for every exchange that has no per-round gather to
+    fuse (ids, ragged value streams, dense-class floats, the simple
+    differentiable forward)."""
+    return (wire.plan_overlap(self.plan) in ("pipelined", "fused")
+            and self.plan.world_size > 1)
+
+  def _fused_wire(self) -> bool:
+    """The plan asked for the just-in-time fused schedule: sparse-class
+    activations are gathered per ROUND immediately before each
+    :func:`wire.fused_block_send` (:meth:`_z_sparse_fused_jit` /
+    :meth:`_exchange_fused`) instead of in one monolithic pre-gather.
+    Inert at world 1 — there is no wire to overlap, and the monolithic
+    gather is already optimal."""
+    return (wire.plan_overlap(self.plan) == "fused"
             and self.plan.world_size > 1)
 
   def _wire_exchange_ids(self, x: jax.Array) -> jax.Array:
@@ -1028,6 +1111,174 @@ class DistributedLookup:
     zf = self._combine(fused, ids_all, key, rs)  # [n_b, G, stride]
     return zf[..., :w], fused
 
+  # ---- just-in-time fused schedule (overlap='fused') ---------------------
+  def _fused_chunk_slices(self, rows: int):
+    """Static ``(start, size)`` row chunks of one fused round block.
+
+    The fused schedule chunks along gathered ROWS (rows gather whole —
+    chunking the flattened payload like the pipelined wire would split
+    rows across gathers), capped at the block's row count so no chunk
+    is empty (an empty fp8 chunk has no amax). The tail chunk may be
+    smaller; every rank computes the same static bounds, so each chunk
+    is a legal uniform ppermute payload."""
+    chunks = max(1, min(wire.plan_exchange_chunks(self.plan), rows))
+    per = -(-rows // chunks)
+    return [(s, min(per, rows - s)) for s in range(0, rows, per)]
+
+  def _fused_gather(self, layout: PackedLayout, buf_local: jax.Array,
+                    ids: jax.Array, masked_phys: bool = False) -> jax.Array:
+    """One round block's gather, with the optional Pallas send-buffer
+    kernel (``ops/pallas_exchange.py``, gated ``DE_TPU_PALLAS_EXCHANGE``
+    + real TPU) fusing the row gather into the send staging for
+    plain-row (rpp == 1) f32 classes. Off-TPU (and for every layout the
+    kernel does not serve) this IS ``gather_fused_chunked`` — the XLA
+    gather the monolithic path uses, so fused f32 numerics are the same
+    gather's numerics."""
+    if (not masked_phys and layout.rows_per_phys == 1
+        and buf_local.dtype == jnp.float32):
+      from ..ops import pallas_exchange
+      if pallas_exchange._use_pallas_exchange():
+        return pallas_exchange.gather_rows(layout, buf_local, ids)
+    return gather_fused_chunked(layout, buf_local, ids,
+                                masked_phys=masked_phys)
+
+  def _fused_reassemble(self, per_round, kind: str) -> jax.Array:
+    """Round-major blocks -> the standard dest-major layout.
+
+    ``per_round[k]`` is round ``k``'s full block (chunks already
+    concatenated): the payload for rank ``(i + k) % world``. Destination
+    ``d`` therefore sits at round ``(d - i) % world``; one stack + take
+    + (for raw payloads) moveaxis/reshape rebuilds exactly the layout
+    the monolithic path produces — pure data movement, bit-exact. Used
+    for the non-diff aux residuals (so :meth:`apply_sparse` and the
+    delta streams see their usual layouts) and for the FusedChunks
+    cotangent in :meth:`_sparse_parts_by_class`."""
+    world = self.plan.world_size
+    i = self._my_rank()
+    stacked = jnp.stack(per_round)  # [world (round-major), ...]
+    dst_pos = jnp.mod(jnp.arange(world, dtype=jnp.int32) - i, world)
+    out = jnp.take(stacked, dst_pos, axis=0)
+    if kind == "dedup":
+      return out  # [world_req, K, ...]
+    out = jnp.moveaxis(out, 0, 1)  # [n_b, world, rows, ...]
+    return out.reshape((out.shape[0], world * out.shape[2])
+                       + out.shape[3:])
+
+  def _z_sparse_fused_jit(self, key, layout: PackedLayout,
+                          buf_local: jax.Array, ids_all, rs: bool = False,
+                          keep_rows: bool = False):
+    """Just-in-time counterpart of :meth:`_z_sparse_fused`.
+
+    Returns ``(FusedChunks, aux)``: instead of one monolithic gather
+    over all routed ids, each ppermute round's payload is gathered (and
+    combined / segment-summed) from ONLY the ids that round ships —
+    round ``k`` slices destination ``(i + k) % world``'s id block out of
+    the routing tensor (a dynamic slice: pure data movement), gathers
+    its rows per chunk, and hands each chunk straight to
+    :func:`wire.fused_block_send` in :meth:`_exchange_fused`. Gather and
+    combine are elementwise per (slot, sample) over the hotness axis, so
+    slicing ids BEFORE the gather+combine equals slicing the monolithic
+    result after it — f32 is bit-exact vs both other schedules, branch
+    by branch (same gather, same combine code). The aux residuals are
+    reassembled to their standard dest-major layouts here (non-diff
+    side, off the wire's critical path) so the apply/delta machinery is
+    untouched.
+
+    Ragged value streams gather per ROUND (each destination block's CSR
+    segmentation is self-contained) and chunk the combined rows — the
+    segment-sum cannot split mid-sample."""
+    world = self.plan.world_size
+    i = self._my_rank()
+    w = layout.width
+    if isinstance(ids_all, DedupRouted):
+      # one row per unique id, gathered per round: round k gathers ONLY
+      # rank (i + k) % world's unique block (the dp side expands and
+      # combines after the return, _exchange_dedup semantics)
+      kcap = ids_all.uniq.shape[1]
+      blocks, aux_rounds = [], []
+      for k in range(world):
+        d = jnp.mod(i + k, world)
+        uniq_d = lax.dynamic_index_in_dim(ids_all.uniq, d, axis=0,
+                                          keepdims=False)  # [K]
+        zc, ac = [], []
+        for s0, sz in self._fused_chunk_slices(kcap):
+          fused = self._fused_gather(layout, buf_local,
+                                     lax.slice_in_dim(uniq_d, s0, s0 + sz))
+          zc.append(fused[..., :w])
+          ac.append(fused if (layout.n_aux or keep_rows)
+                    else fused[..., w:])
+        blocks.append(tuple(zc))
+        aux_rounds.append(ac[0] if len(ac) == 1
+                          else jnp.concatenate(ac, axis=0))
+      aux = self._fused_reassemble(aux_rounds, "dedup")
+      return FusedChunks(tuple(blocks), "dedup"), aux
+    if isinstance(ids_all, tuple):  # ragged value stream
+      vals, lens = ids_all  # [n_b, world, V], [n_b, world, B]
+      b = lens.shape[2]
+      blocks, aux_rounds = [], []
+      for k in range(world):
+        d = jnp.mod(i + k, world)
+        vals_d = lax.dynamic_index_in_dim(vals, d, axis=1)  # [n_b, 1, V]
+        lens_d = lax.dynamic_index_in_dim(lens, d, axis=1)
+        fused = self._fused_gather(layout, buf_local, vals_d)
+        zblk = self._combine_ragged(fused[..., :w], vals_d, lens_d, key,
+                                    rs)  # [n_b, b, w]
+        blocks.append(tuple(
+            lax.slice_in_dim(zblk, s0, s0 + sz, axis=1)
+            for s0, sz in self._fused_chunk_slices(b)))
+        aux_rounds.append(fused if (layout.n_aux or keep_rows)
+                          else fused[..., w:])
+      aux = self._fused_reassemble(aux_rounds, "raw")  # [n_b, world, V, .]
+      return FusedChunks(tuple(blocks), "raw"), aux
+    # padded routing tensor [n_b, G(, h)], G = world * B dest-major
+    bsz = ids_all.shape[1] // world
+    masked = (layout.rows_per_phys > 1 and layout.n_aux
+              and ids_all.ndim == 3 and ids_all.shape[-1] > 1)
+    cp = self.plan.classes[key]
+    if masked and cp.combiner is None:
+      raise ValueError("combiner=None requires hotness-1 inputs in the "
+                       "distributed path (2-D model-parallel outputs)")
+    sentinel = padded_rows(self.plan, key)
+    blocks, aux_rounds = [], []
+    for k in range(world):
+      d = jnp.mod(i + k, world)
+      ids_d = lax.dynamic_slice_in_dim(ids_all, d * bsz, bsz, axis=1)
+      zc, ac = [], []
+      for s0, sz in self._fused_chunk_slices(bsz):
+        ids_c = lax.slice_in_dim(ids_d, s0, s0 + sz, axis=1)
+        if masked:
+          # multi-hot narrow class: same phys-width masked pipeline as
+          # _z_sparse_fused, per chunk
+          mrows = self._fused_gather(layout, buf_local, ids_c,
+                                     masked_phys=True)
+          bag = jnp.sum(mrows, axis=2)  # [n_b, sz, rpp*stride]
+          rpp, stride = layout.rows_per_phys, layout.stride
+          folded = jnp.sum(
+              bag.reshape(bag.shape[:-1] + (rpp, stride)), axis=-2)
+          z = folded[..., :w]
+          if cp.combiner == "mean" and not rs:
+            counts = jnp.sum(ids_c < sentinel, axis=2).astype(z.dtype)
+            z = z / jnp.maximum(counts, 1)[..., None]
+          zc.append(z)
+          ac.append(mrows)
+          continue
+        fused = self._fused_gather(layout, buf_local, ids_c)
+        if layout.n_aux == 0:
+          zc.append(self._combine(fused, ids_c, key, rs))
+          ac.append(fused if keep_rows else fused[..., w:])
+        elif ids_c.ndim == 2 or ids_c.shape[-1] == 1:
+          zc.append(self._combine(fused[..., :w], ids_c, key, rs))
+          ac.append(fused)
+        else:
+          zf = self._combine(fused, ids_c, key, rs)  # [n_b, sz, stride]
+          zc.append(zf[..., :w])
+          ac.append(fused)
+      blocks.append(tuple(zc))
+      aux_rounds.append(ac[0] if len(ac) == 1
+                        else jnp.concatenate(ac, axis=1))
+    aux = self._fused_reassemble(aux_rounds, "raw")  # [n_b, G(, h), .]
+    return FusedChunks(tuple(blocks), "raw"), aux
+
   # ---- mp -> dp exchange + assembly --------------------------------------
   def exchange(self, z: Dict[tuple, jax.Array], batch_local: int,
                ids_all: Optional[Dict[tuple, jax.Array]] = None
@@ -1052,6 +1303,9 @@ class DistributedLookup:
     received = {}
     for bk, zb in z.items():
       dr = ids_all.get(bk) if ids_all is not None else None
+      if isinstance(zb, FusedChunks):
+        received[bk] = self._exchange_fused(bk, zb, dr)
+        continue
       if isinstance(dr, DedupRouted):
         received[bk] = self._exchange_dedup(bk, zb, dr)
         continue
@@ -1061,6 +1315,69 @@ class DistributedLookup:
         zb = self._wire_exchange_float(zb)
       received[bk] = zb
     return received
+
+  def _exchange_fused(self, bk, fz: FusedChunks,
+                      dr: Optional["DedupRouted"]) -> jax.Array:
+    """mp->dp return of a :class:`FusedChunks` payload, one send per
+    just-gathered chunk (``overlap='fused'``).
+
+    Round ``k``'s chunks each ride their own
+    :func:`wire.fused_block_send` — the only ops between a chunk's
+    gather (:meth:`_z_sparse_fused_jit`) and its send are that chunk's
+    own encode, so XLA can launch round ``k``'s collective while round
+    ``k + 1`` is still gathering. Received round ``k`` came FROM rank
+    ``(i - k) % world``; one stack + take places the rounds
+    source-major, reproducing the monolithic exchange bit-for-bit under
+    f32 (pure data movement). Dedup'd buckets expand AND combine PER
+    ROUND through the round's own inverse-map slice — the whole dp-side
+    tail (expand, h-sum, mean divisor) runs inside the round body, so
+    the stack + take reassembles COMBINED rows (``B`` per round, not
+    ``B x h`` expanded occurrences), and on the backward each reverse
+    send is preceded only by ITS round's combine transpose +
+    segment-sum (the expand transpose) — the fused reverse-cotangent
+    schedule. The combine is the one shared :meth:`_combine` (the same
+    h-sum/mean-divisor code the monolithic/pipelined tail runs, per
+    source block — combine never mixes source blocks, so running it
+    round-by-round is the same math on the same values in the same
+    order: bit-exact)."""
+    world = self.plan.world_size
+    wd = wire.plan_wire_dtype(self.plan)
+    i = self._my_rank()
+    src_pos = jnp.mod(i - jnp.arange(world, dtype=jnp.int32), world)
+    if fz.kind == "dedup":
+      w = fz.blocks[0][0].shape[-1]
+      inv_shape = dr.inv.shape  # [world, n_b, B(, h)]
+      m = int(np.prod(inv_shape[1:]))
+      inv_flat = dr.inv.reshape(world, m)
+      combined_rounds = []
+      for k, blk in enumerate(fz.blocks):
+        got = [wire.fused_block_send(c, self.axis_name, k, world, wd)
+               for c in blk]
+        ret_k = got[0] if len(got) == 1 else jnp.concatenate(got, axis=0)
+        # round k's rows answer the unique block I sent to (i - k) %
+        # world — expand through THAT destination's inverse map
+        j = jnp.mod(i - k, world)
+        inv_j = lax.dynamic_index_in_dim(inv_flat, j, axis=0,
+                                         keepdims=False)
+        rows_k = expand_unique_rows(ret_k, inv_j).reshape(
+            inv_shape[1:] + (w,))  # [n_b, B(, h), w]
+        if len(inv_shape) == 3:  # hotness-1: ids only carry the 2-D tag
+          ids_k = inv_j.reshape(inv_shape[1:])
+        else:  # rebuild ORIGINAL logical ids: the combiner's sentinels
+          uniq_j = lax.dynamic_index_in_dim(dr.uniq_local, j, axis=0,
+                                            keepdims=False)
+          ids_k = jnp.take(uniq_j, inv_j, axis=0).reshape(inv_shape[1:])
+        combined_rounds.append(
+            self._combine(rows_k, ids_k, bk.class_key, bk.rs))
+      return jnp.take(jnp.stack(combined_rounds), src_pos, axis=0)
+    rounds = []
+    for k, blk in enumerate(fz.blocks):
+      got = [wire.fused_block_send(c, self.axis_name, k, world, wd)
+             for c in blk]
+      rounds.append(got[0] if len(got) == 1
+                    else jnp.concatenate(got, axis=1))
+    # [world (round-major), n_b, B, w] -> source-major [world, n_b, B, w]
+    return jnp.take(jnp.stack(rounds), src_pos, axis=0)
 
   def _exchange_dedup(self, bk, z_u: jax.Array, dr: DedupRouted
                       ) -> jax.Array:
@@ -1077,21 +1394,33 @@ class DistributedLookup:
     h-axis sum and the mean divisor run over the same values in the same
     order as the raw path's mp-side combine, and row-sliced buckets
     defer their mean division to :meth:`assemble` exactly as before."""
-    key = bk.class_key
     world = self.plan.world_size
     w = z_u.shape[-1]
     ret = self._wire_exchange_float(z_u)
     inv_shape = dr.inv.shape  # [world, n_b, B] | [world, n_b, B, h]
     m = int(np.prod(inv_shape[1:]))
     expanded = jax.vmap(expand_unique_rows)(ret, dr.inv.reshape(world, m))
-    expanded = expanded.reshape(inv_shape + (w,))
-    # run the ONE shared combiner (:meth:`_combine` — the bit-exact
-    # parity contract rides its h-sum/mean-divisor code being the same
-    # code): fold [world, n_b] into the leading axis it expects. Hot-1
-    # buckets pass 2-D ids through untouched, so they skip the id
-    # reconstruction; multi-hot buckets rebuild the ORIGINAL logical ids
-    # (uniq_local[inv]) so the combiner sees exactly the sentinel
-    # pattern the raw path's mp-side combine saw.
+    return self._dedup_combine_tail(bk, expanded.reshape(inv_shape + (w,)),
+                                    dr)
+
+  def _dedup_combine_tail(self, bk, expanded: jax.Array, dr: DedupRouted
+                          ) -> jax.Array:
+    """Shared dp-side combine of re-expanded dedup rows — the monolithic
+    and pipelined dedup returns end here (the fused return runs the
+    same expand + :meth:`_combine` sequence per round inside
+    :meth:`_exchange_fused`, on h-fold-smaller reassembly copies).
+
+    Runs the ONE shared combiner (:meth:`_combine` — the bit-exact
+    parity contract rides its h-sum/mean-divisor code being the same
+    code): fold [world, n_b] into the leading axis it expects. Hot-1
+    buckets pass 2-D ids through untouched, so they skip the id
+    reconstruction; multi-hot buckets rebuild the ORIGINAL logical ids
+    (uniq_local[inv]) so the combiner sees exactly the sentinel
+    pattern the raw path's mp-side combine saw."""
+    key = bk.class_key
+    world = self.plan.world_size
+    inv_shape = dr.inv.shape
+    m = int(np.prod(inv_shape[1:]))
     n_b = inv_shape[1]
     rows = expanded.reshape((world * n_b,) + expanded.shape[2:])
     if len(inv_shape) == 3:  # hotness-1: ids only carry the ndim==2 tag
@@ -1373,7 +1702,14 @@ class DistributedLookup:
     its cotangent into :meth:`apply_sparse`. ``keep_rows`` saves the
     forward-time table rows in the residuals even for aux-free rules
     (needed by ``rule.weight_decay``; n_aux > 0 residuals carry them
-    already)."""
+    already).
+
+    Under ``overlap='fused'`` (world > 1) each bucket's ``z`` is a
+    :class:`FusedChunks` of per-round just-in-time gathers instead of
+    one monolithic array (:meth:`_z_sparse_fused_jit`); the residual aux
+    rows keep their standard layouts either way, so everything
+    downstream of the cotangent reassembly is schedule-blind."""
+    jit_gather = self._fused_wire()
     z: Dict[tuple, jax.Array] = {}
     aux: Dict[tuple, jax.Array] = {}
     for bk, ids in ids_all.items():
@@ -1382,8 +1718,13 @@ class DistributedLookup:
         continue
       name = class_param_name(*key)
       buf_local = self._squeeze_local(fused_params[name])
-      zb, auxb = self._z_sparse_fused(key, layouts[name], buf_local, ids,
-                                      bk.rs, keep_rows=keep_rows)
+      if jit_gather:
+        zb, auxb = self._z_sparse_fused_jit(key, layouts[name], buf_local,
+                                            ids, bk.rs,
+                                            keep_rows=keep_rows)
+      else:
+        zb, auxb = self._z_sparse_fused(key, layouts[name], buf_local, ids,
+                                        bk.rs, keep_rows=keep_rows)
       z[bk] = zb
       aux[bk] = auxb
     return z, SparseResiduals(ids_all=dict(ids_all), aux_rows=aux)
@@ -1473,6 +1814,15 @@ class DistributedLookup:
       key, h = bk.class_key, bk.h
       if plan.classes[key].kind != "sparse":
         continue
+      if isinstance(dzb, FusedChunks):
+        # fused schedule: the cotangent arrives per (round, chunk) — the
+        # reverse sends already happened round by round inside the
+        # backward; reassembling to the standard dest-major layout here
+        # is pure data movement, so everything below is schedule-blind
+        dzb = self._fused_reassemble(
+            [blk[0] if len(blk) == 1 else jnp.concatenate(
+                blk, axis=0 if dzb.kind == "dedup" else 1)
+             for blk in dzb.blocks], dzb.kind)
       if os.environ.get("DE_TPU_COTANGENT_PIN", "0") == "1":
         # EXPERIMENT (default off — measured NEUTRAL-to-negative on Tiny:
         # 162 -> 167 ms): pinning the per-sample cotangent row-major here
@@ -1534,14 +1884,7 @@ class DistributedLookup:
     ``delta_lanes`` twin, a 128-lane physical layout, f32, and no
     weight_decay (the decay path needs forward-row extraction the kernel
     does not carry)."""
-    # Default OFF: measured NET-NEGATIVE on Tiny (178 vs 162 ms wall) —
-    # the kernel runs 16.7 ms where the XLA chain's removable share is
-    # smaller than it traced: h=1 parts pay a whole extra HBM round-trip
-    # the XLA form never materializes (its delta fuses into the scatter's
-    # producer), and the batch-minor copies it targeted partially remain
-    # on the gather side. Kept as measured infrastructure + the
-    # delta_lanes twins (docs/BENCHMARKS.md round-5 staging study).
-    if os.environ.get("DE_TPU_PALLAS_DELTA", "0") != "1":
+    if not _use_pallas_delta():
       return None
     if (rule.delta_lanes is None or rule.linear_scale is not None
         or rule.weight_decay):
@@ -1549,11 +1892,6 @@ class DistributedLookup:
     if layout.phys_width != 128 or dzb.dtype != jnp.float32:
       return None
     if rule.n_aux and (aux is None or aux.dtype != jnp.float32):
-      return None
-    try:
-      if jax.default_backend() != "tpu":
-        return None
-    except RuntimeError:
       return None
     hh = max(1, int(h))  # h == 0: ragged parts arrive pre-expanded per occ
     n = int(np.prod(ids.shape))

@@ -23,9 +23,10 @@ Four plan knobs (``DistEmbeddingStrategy``) govern the format:
   segment-summed per unique id) in f32, then narrowed for the wire, then
   widened on the owning side. ``'fp8'`` (float8_e4m3) additionally ships
   ONE f32 amax scale per destination block (per chunk under the
-  pipelined wire), bit-packed into the block's own payload (4 fp8 lanes
-  carry the f32 bits), so the quantization window tracks each block's
-  dynamic range and no second collective is needed for the scales.
+  pipelined/fused wire), bit-packed into the block's own payload (4 fp8
+  lanes carry the f32 bits), so the quantization window tracks each
+  block's dynamic range and no second collective is needed for the
+  scales.
 - ``dedup_exchange=True``: see ``lookup_engine.DedupRouted`` — the id
   exchange ships sorted-unique id blocks and the float exchanges ship one
   row per unique id instead of one per sample/occurrence.
@@ -39,12 +40,31 @@ Four plan knobs (``DistEmbeddingStrategy``) govern the format:
   cotangent exchange is pipelined identically through the ``custom_vjp``
   below. The permutation is pure data movement, so the f32 pipelined
   wire is BIT-EXACT against the monolithic one.
+- ``overlap='fused'``: the just-in-time form of the pipelined schedule.
+  The engine no longer gathers ALL routed rows in one monolithic
+  pre-pass before the rounds start: each round's payload is gathered
+  (and, under ``dedup_exchange``, expanded/segment-summed) immediately
+  before its own :func:`fused_block_send`, and the rounds are emitted as
+  independent gather -> encode -> ppermute -> decode chains whose only
+  data dependence is the rows that round actually ships — which is what
+  lets XLA's scheduler (and, on a real TPU, the
+  ``ops/pallas_exchange.py`` double-buffered remote-DMA kernel) overlap
+  round ``k``'s collective with round ``k + 1``'s gather. Integer
+  payloads and the dense-class float exchanges still ride the pipelined
+  schedule (there is no per-round gather to fuse). f32 stays BIT-exact
+  vs both the monolithic and the pipelined forms — the per-round gather
+  slices rows per destination before the elementwise gather/combine
+  instead of after it, and every placement step is pure data movement.
 - ``exchange_chunks=N``: chunk count of the pipelined split (along the
   flattened per-destination payload, so every shape — padded, ragged
   value streams, dedup'd unique blocks — chunks uniformly and chunk
   counts that do not divide the payload pad the tail). The traced
   program carries exactly ``(world - 1) * N`` ppermute rounds per
-  exchange, which the jaxpr audit pins per artifact.
+  exchange, which the jaxpr audit pins per artifact. Under
+  ``overlap='fused'`` the sparse-class chunks split along gathered ROWS
+  instead of the flattened payload (rows gather whole), capped at the
+  block's row count — fp8 scales are still one per (destination block,
+  chunk), now computed over each just-gathered row chunk.
 
 With ``world_size == 1`` there is no wire: nothing is exchanged, nothing
 is narrowed, and every knob is inert (numerics stay bit-identical to the
@@ -103,9 +123,10 @@ def plan_dedup_exchange(plan) -> bool:
 def plan_overlap(plan) -> str:
   """The plan's ``overlap`` knob (default 'none' for old plans)."""
   name = getattr(plan, "overlap", "none")
-  if name not in ("none", "pipelined"):
+  if name not in ("none", "pipelined", "fused"):
     raise ValueError(
-        f"unknown overlap mode {name!r}; have ['none', 'pipelined']")
+        f"unknown overlap mode {name!r}; have ['none', 'pipelined', "
+        f"'fused']")
   return name
 
 
@@ -344,3 +365,77 @@ def _pipe_bwd(axis_name, wire_name, compute_dtype, chunks, res, ct):
 
 
 _pipelined_float.defvjp(_pipe_fwd, _pipe_bwd)
+
+
+# ---------------------------------------------------------------------------
+# fused exchange: one send per just-gathered block, no monolithic pre-pass
+# ---------------------------------------------------------------------------
+
+
+def fused_round_perm(k: int, world: int):
+  """Round ``k``'s rotate-by-k permutation (the pipelined schedule's)."""
+  return [(s, (s + k) % world) for s in range(world)]
+
+
+def fused_block_send(x: jax.Array, axis_name: str, k: int, world: int,
+                     wire_dtype=None) -> jax.Array:
+  """Ship ONE just-gathered block over round ``k``'s rotation.
+
+  ``x`` is the payload this rank gathered for rank ``(i + k) % world``
+  (one chunk of it); the return value is the block rank
+  ``(i - k) % world`` gathered for me. Round 0 is the self block and
+  never crosses the wire (but is still narrowed/widened under a narrow
+  wire, exactly like the pipelined schedule's round 0). f32 rides a
+  native ``lax.ppermute`` — linear, so autodiff's transpose is the
+  inverse rotation on the cotangent and the reverse exchange fuses per
+  round for free; narrow wires go through a ``custom_vjp`` that encodes
+  the cotangent chunk with its OWN amax scale, mirroring
+  :func:`pipelined_float_exchange`.
+
+  Unlike :func:`pipelined_float_exchange` this takes one block, not the
+  ``[world, ...]`` dest-major stack — the caller gathers each block
+  immediately before its send, so the traced round body depends only on
+  the rows it ships and XLA can overlap round ``k``'s collective with
+  round ``k + 1``'s gather."""
+  if world == 1:
+    return x
+  if wire_dtype is None or jnp.dtype(wire_dtype) == x.dtype:
+    if k == 0:
+      return x
+    return lax.ppermute(x, axis_name, fused_round_perm(k, world))
+  return _fused_block(axis_name, str(jnp.dtype(wire_dtype)), str(x.dtype),
+                      int(k), int(world), x)
+
+
+def _fused_block_send_raw(axis_name, wire_name, compute_dtype, k, world, x):
+  """encode -> (rotate-by-k) -> decode for one narrow-wire block."""
+  enc = _chunk_encode(wire_name, x.reshape(1, -1))
+  if k:
+    enc = lax.ppermute(enc, axis_name, fused_round_perm(k, world))
+  dec = _chunk_decode(wire_name, compute_dtype, enc)
+  return dec.reshape(x.shape).astype(compute_dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _fused_block(axis_name: str, wire_name: str, compute_dtype: str,
+                 k: int, world: int, x: jax.Array) -> jax.Array:
+  return _fused_block_send_raw(axis_name, wire_name, compute_dtype, k,
+                               world, x)
+
+
+def _fused_fwd(axis_name, wire_name, compute_dtype, k, world, x):
+  return _fused_block_send_raw(axis_name, wire_name, compute_dtype, k,
+                               world, x), None
+
+
+def _fused_bwd(axis_name, wire_name, compute_dtype, k, world, res, ct):
+  # the rotate-by-k rotation's transpose is rotate-by-(world - k): my
+  # forward round-k block went to (i + k) % world, so my cotangent for it
+  # comes back FROM (i + k) % world — narrowed with the cotangent chunk's
+  # own amax, exactly like the pipelined backward
+  del res
+  return (_fused_block_send_raw(axis_name, wire_name, compute_dtype,
+                                (world - k) % world, world, ct),)
+
+
+_fused_block.defvjp(_fused_fwd, _fused_bwd)

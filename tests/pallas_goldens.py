@@ -1,0 +1,135 @@
+"""Shared golden vectors for the Pallas twin pairs.
+
+One case list drives every consumer, so the sim and TPU builds of a
+kernel are checked against the SAME streams:
+
+- `tests/test_pallas_goldens.py` (tier-1, CPU): the apply simulator
+  (`ops/pallas_apply_sim.py`) against ``np.add.at`` at the documented
+  f32-associativity tolerance, and the exchange interpret twin
+  (`ops/pallas_exchange_sim.py`) against ``packed_table.gather_fused``
+  BIT-for-bit (a gather is pure data movement — no summation order to
+  forgive).
+- `tools/smoke_pallas_apply.py` (real TPU): the hardware apply kernel
+  replays the same cases against XLA's scatter AND against the
+  simulator, so a hardware/sim divergence fails with the exact case
+  name tier-1 already knows.
+
+The directed names pin the state-machine corners (duplicate hits, slot
+collision chains, eviction round-trips, OOB drops, cross-chunk
+persistence); the seeded names add power-law and uniform fuzz at fixed
+seeds so every consumer sees identical streams.
+"""
+
+import numpy as np
+
+APPLY_WIDTH = 8        # apply-pair row width (the sim is width-agnostic)
+EXCHANGE_LANES = 128   # exchange kernel serves 128-lane physical rows
+
+# name -> (rows, slots, chunk, builder). ``slots`` parameterizes the
+# apply pair's cache; ``chunk`` the exchange pair's double buffer.
+_CASES = {}
+
+
+def _case(name, rows, slots, chunk):
+  def deco(fn):
+    _CASES[name] = (rows, slots, chunk, fn)
+    return fn
+  return deco
+
+
+@_case("unique", rows=16, slots=4, chunk=4)
+def _(rng, rows):
+  return np.array([0, 1, 2, 3], np.int32)
+
+
+@_case("duplicate_hits", rows=16, slots=4, chunk=4)
+def _(rng, rows):
+  return np.array([5, 5, 5], np.int32)
+
+
+@_case("evict_and_return", rows=16, slots=4, chunk=2)
+def _(rng, rows):
+  return np.array([1, 5, 1], np.int32)
+
+
+@_case("slot_collision_chain", rows=16, slots=4, chunk=4)
+def _(rng, rows):
+  return np.array([1, 5, 9, 13, 1, 5], np.int32)
+
+
+@_case("alternating_evict", rows=32, slots=16, chunk=8)
+def _(rng, rows):
+  # two rows sharing one slot, alternating: every access evicts
+  # (list repeat, values <= 19 — no overflow)
+  return np.array([3, 19] * 30, np.int32)  # graftlint: disable=GL106
+
+
+@_case("full_sweep_twice", rows=64, slots=16, chunk=32)
+def _(rng, rows):
+  # the second sweep must observe the first sweep's values
+  return np.concatenate([np.arange(rows), np.arange(rows)]).astype(np.int32)
+
+
+@_case("oob_mixed", rows=32, slots=4, chunk=4)
+def _(rng, rows):
+  return np.array([-1, 0, 31, 32, 1000, -2**31, 5, 5, 5], np.int32)
+
+
+@_case("cross_chunk_duplicates", rows=128, slots=16, chunk=128)
+def _(rng, rows):
+  # duplicates recurring across chunk/grid boundaries: cache tags and
+  # pending writes must persist between steps
+  return np.asarray((list(range(100)) * 6)[:555], np.int32)
+
+
+@_case("uniform_fuzz", rows=200, slots=32, chunk=64)
+def _(rng, rows):
+  return rng.integers(-3, 2 * rows, 400).astype(np.int32)
+
+
+@_case("power_law", rows=256, slots=8, chunk=128)
+def _(rng, rows):
+  r = rng.random(2000)
+  gamma = -0.05
+  ids = ((r * (float(rows + 1) ** gamma - 1.0) + 1.0) ** (1.0 / gamma))
+  return (np.clip(ids.astype(np.int64) - 1, 0, rows - 1)).astype(np.int32)
+
+
+CASE_NAMES = tuple(_CASES)
+
+
+def golden_ids(name):
+  """(ids[int32], rows, slots, chunk) for one named case; the stream is
+  a pure function of the name (seeded rng), identical in every
+  consumer."""
+  rows, slots, chunk, fn = _CASES[name]
+  rng = np.random.default_rng(_seed(name))
+  return fn(rng, rows), rows, slots, chunk
+
+
+def _seed(name):
+  # stable across processes (hash() is salted): fold the name's bytes
+  return int.from_bytes(name.encode()[:8].ljust(8, b"\0"), "little") % (2**31)
+
+
+def apply_vectors(name, width=APPLY_WIDTH):
+  """(buf, ids, delta, slots, chunk) for the apply pair: the kernel /
+  simulator compute ``buf[ids] += delta`` on these. The id stream and
+  cache geometry are width-independent; tier-1 runs the simulator at
+  ``APPLY_WIDTH`` while the TPU smoke replays the same streams at the
+  hardware kernel's 128-lane row width."""
+  ids, rows, slots, chunk = golden_ids(name)
+  rng = np.random.default_rng(_seed(name) ^ 0xA11E)
+  buf = rng.standard_normal((rows, width)).astype(np.float32)
+  delta = rng.standard_normal((len(ids), width)).astype(np.float32)
+  return buf, ids, delta, slots, chunk
+
+
+def exchange_vectors(name):
+  """(buf, ids, chunk) for the exchange pair: the kernel / interpret
+  twin gather ``buf[ids]`` (OOB -> zero rows) through the
+  double-buffered send staging."""
+  ids, rows, _, chunk = golden_ids(name)
+  rng = np.random.default_rng(_seed(name) ^ 0xE8C4)
+  buf = rng.standard_normal((rows, EXCHANGE_LANES)).astype(np.float32)
+  return buf, ids, chunk

@@ -1239,6 +1239,94 @@ def run():
                      CTX, ["GL118"]) == []
 
 
+# GL126: Pallas kernel calls and env gates are registered and homed
+def test_gl126_kernel_call_outside_home():
+  src = """
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+def fancy(x):
+  return pl.pallas_call(lambda r, o: None, out_shape=x)(x)
+
+def ship(src, dst, sems):
+  pltpu.make_async_remote_copy(src, dst, *sems, device_id=(1,)).start()
+"""
+  out = lint_source(src, "distributed_embeddings_tpu/parallel/fast.py",
+                    CTX, ["GL126"])
+  assert _rules(out) == ["GL126", "GL126"]
+  assert "ops/pallas_" in out[0].message
+  # the kernel modules themselves are the sanctioned home
+  assert lint_source(src, "distributed_embeddings_tpu/ops/pallas_fast.py",
+                     CTX, ["GL126"]) == []
+  # tools/tests live outside the library package
+  assert lint_source(src, "tools/smoke_thing.py", CTX, ["GL126"]) == []
+
+
+def test_gl126_unregistered_gate_fires_registered_is_clean():
+  src = """
+import os
+
+def _use_pallas_frob():
+  return os.environ.get("DE_TPU_PALLAS_FROB", "0") == "1"
+"""
+  out = lint_source(src, "distributed_embeddings_tpu/ops/pallas_frob.py",
+                    CTX, ["GL126"])
+  assert _rules(out) == ["GL126"]
+  assert "PALLAS_GATE_REGISTRY" in out[0].message
+  # a docstring MENTIONING a gate is not a read
+  doc = '''
+def helper():
+  """Gated by DE_TPU_PALLAS_FROB on real TPUs."""
+  return 0
+'''
+  assert lint_source(doc, "distributed_embeddings_tpu/ops/pallas_frob.py",
+                     CTX, ["GL126"]) == []
+  # the registered (file, env, predicate) triple is the sanctioned form
+  reg = """
+import os
+import jax
+
+def _use_pallas_exchange():
+  if os.environ.get("DE_TPU_PALLAS_EXCHANGE", "0") != "1":
+    return False
+  return jax.default_backend() == "tpu"
+"""
+  assert lint_source(reg, "distributed_embeddings_tpu/ops/pallas_exchange.py",
+                     CTX, ["GL126"]) == []
+
+
+def test_gl126_stale_registry_entry_fails():
+  # the registered file without the env read: stale (gate moved/removed)
+  out = lint_source("def gather_rows():\n  return 1\n",
+                    "distributed_embeddings_tpu/ops/pallas_exchange.py",
+                    CTX, ["GL126"])
+  assert [f.rule for f in out] == ["GL126", "GL126"]
+  assert all("stale" in f.message for f in out)
+  # env read present but the registered predicate renamed away: stale
+  src = """
+import os
+
+def _kernel_enabled():
+  return os.environ.get("DE_TPU_PALLAS_EXCHANGE", "0") == "1"
+"""
+  out = lint_source(src, "distributed_embeddings_tpu/ops/pallas_exchange.py",
+                    CTX, ["GL126"])
+  assert _rules(out) == ["GL126"]
+  assert "_use_pallas_exchange" in out[0].message
+
+
+def test_gl126_suppression():
+  src = """
+import os
+
+def probe():
+  # transition shim reviewed in round 20
+  return os.environ.get("DE_TPU_PALLAS_LEGACY")  # graftlint: disable=GL126
+"""
+  assert lint_source(src, "distributed_embeddings_tpu/ops/pallas_x.py",
+                     CTX, ["GL126"]) == []
+
+
 def test_gl118_stale_inventory_entry_fails(tmp_path):
   # a file that IS named by an inventory entry but no longer carries the
   # refusal must produce the stale-inventory finding from lint_paths

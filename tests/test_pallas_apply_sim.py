@@ -5,6 +5,13 @@ mode breaks its input/output aliasing), so its claim/evict/flush state
 machine is validated here through the statement-for-statement numpy
 simulator (`ops/pallas_apply_sim.py`). Any divergence from np.add.at on
 these streams is a real logic bug in the shared algorithm.
+
+The DIRECTED state-machine corners (duplicate hits, slot-collision
+chains, OOB drops, alternating evictions, full sweeps) live in the
+shared golden vectors (`tests/pallas_goldens.py`, run by
+`tests/test_pallas_goldens.py` and replayed on hardware by
+`tools/smoke_pallas_apply.py`); this file keeps the RANDOMIZED property
+sweeps that would bloat a fixed vector list.
 """
 
 import numpy as np
@@ -54,41 +61,6 @@ def test_power_law_streams(seed):
   ids = np.clip(ids, 0, rows - 1)
   delta = rng.standard_normal((n, width)).astype(np.float32)
   check(buf, ids, delta, slots=8)
-
-
-def test_oob_ids_dropped():
-  rng = np.random.default_rng(7)
-  buf = rng.standard_normal((32, 4)).astype(np.float32)
-  ids = np.array([-1, 0, 31, 32, 1000, -2**31, 5, 5, 5], np.int64)
-  delta = rng.standard_normal((len(ids), 4)).astype(np.float32)
-  check(buf, ids, delta, slots=4)
-
-
-def test_same_slot_alternating_rows():
-  """Two rows mapping to one slot, alternating: every access evicts."""
-  rng = np.random.default_rng(8)
-  buf = rng.standard_normal((32, 4)).astype(np.float32)
-  ids = np.array([3, 3 + 16, 3, 3 + 16, 3, 3 + 16] * 10, np.int64)
-  delta = rng.standard_normal((len(ids), 4)).astype(np.float32)
-  check(buf, ids, delta, slots=16)
-
-
-def test_single_row_all_hits():
-  buf = np.zeros((8, 4), np.float32)
-  ids = np.full((100,), 5, np.int64)
-  delta = np.ones((100, 4), np.float32)
-  got = apply_rows_cached_sim(buf, ids, delta, slots=2)
-  np.testing.assert_allclose(got[5], 100.0)
-
-
-def test_every_row_once_then_again():
-  """Full sweep twice: second sweep must see first sweep's values."""
-  rows = 64
-  buf = np.zeros((rows, 4), np.float32)
-  ids = np.concatenate([np.arange(rows), np.arange(rows)]).astype(np.int64)
-  delta = np.ones((2 * rows, 4), np.float32)
-  got = apply_rows_cached_sim(buf, ids, delta, slots=16)
-  np.testing.assert_allclose(got, 2.0)
 
 
 def test_chunk_edge_equivalence():

@@ -1,0 +1,101 @@
+"""Sim/TPU kernel lockstep: the shared golden vectors, run on CPU.
+
+One parametrized case list (`tests/pallas_goldens.py`) drives both twin
+pairs — the SAME streams the real-TPU smoke replays against the
+hardware builds (`tools/smoke_pallas_apply.py`), replacing the ad-hoc
+per-file vectors each test used to invent:
+
+- apply pair: `ops/pallas_apply_sim.apply_rows_cached_sim` vs
+  ``np.add.at`` at the documented f32 tolerance (the cache combines a
+  row's duplicate deltas in VMEM before the single add — an
+  associativity reordering, so bitwise equality is not the claim);
+- exchange pair: `ops/pallas_exchange_sim` (the REAL kernel body under
+  Pallas interpret mode) vs ``packed_table.gather_fused`` BIT-for-bit —
+  a gather is pure data movement, nothing to forgive.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_embeddings_tpu.ops.packed_table import (
+    PackedLayout,
+    gather_fused,
+)
+from distributed_embeddings_tpu.ops.pallas_apply_sim import (
+    apply_rows_cached_sim,
+)
+from distributed_embeddings_tpu.ops.pallas_exchange_sim import (
+    gather_rows_sim,
+    gather_send_rows_sim,
+)
+
+from pallas_goldens import (
+    CASE_NAMES,
+    apply_vectors,
+    exchange_vectors,
+)
+
+import jax.numpy as jnp
+
+
+@pytest.mark.parametrize("name", CASE_NAMES)
+def test_apply_pair_golden(name):
+  buf, ids, delta, slots, _ = apply_vectors(name)
+  got = apply_rows_cached_sim(buf, ids.astype(np.int64), delta,
+                              slots=slots)
+  want = np.array(buf, np.float32)
+  ok = (ids >= 0) & (ids < buf.shape[0])
+  np.add.at(want, ids[ok], delta[ok])
+  np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5,
+                             err_msg=name)
+
+
+@pytest.mark.parametrize("name", CASE_NAMES)
+def test_exchange_pair_golden_bitexact(name):
+  buf, ids, chunk = exchange_vectors(name)
+  layout = PackedLayout(rows=buf.shape[0], width=buf.shape[1])
+  assert layout.rows_per_phys == 1 and layout.stride == buf.shape[1]
+  jbuf, jids = jnp.asarray(buf), jnp.asarray(ids)
+  want = np.asarray(gather_fused(layout, jbuf, jids))
+  got = np.asarray(gather_rows_sim(layout, jbuf, jids, chunk=chunk))
+  np.testing.assert_array_equal(got, want, err_msg=name)
+
+
+@pytest.mark.parametrize("name", CASE_NAMES[:4])
+def test_exchange_send_golden_loopback(name):
+  """The full gather->send body (loopback transport): the received
+  buffer equals the gathered rows bit-for-bit."""
+  buf, ids, chunk = exchange_vectors(name)
+  layout = PackedLayout(rows=buf.shape[0], width=buf.shape[1])
+  jbuf, jids = jnp.asarray(buf), jnp.asarray(ids)
+  want = np.asarray(gather_fused(layout, jbuf, jids))
+  got = np.asarray(gather_send_rows_sim(jbuf, jids, chunk=chunk))
+  np.testing.assert_array_equal(got, want, err_msg=name)
+
+
+def test_exchange_kernel_rejects_unserved_layouts():
+  """The kernel's validation mirrors its TPU limits: narrow (rpp > 1)
+  layouts, non-f32 buffers and non-128-lane rows go to the XLA path."""
+  from distributed_embeddings_tpu.ops import pallas_exchange as pe
+  buf = jnp.zeros((8, 128), jnp.float32)
+  ids = jnp.zeros((4,), jnp.int32)
+  narrow = PackedLayout(rows=8, width=16)
+  with pytest.raises(ValueError, match="rows_per_phys"):
+    pe.gather_rows(narrow, jnp.zeros(narrow.shape, jnp.float32), ids)
+  wide = PackedLayout(rows=8, width=128)
+  with pytest.raises(ValueError, match="float32"):
+    pe.gather_rows(wide, buf.astype(jnp.bfloat16), ids)
+  with pytest.raises(ValueError, match="128"):
+    pe.gather_rows(wide, jnp.zeros((8, 256), jnp.float32), ids)
+
+
+def test_exchange_gate_off_on_cpu(monkeypatch):
+  """Both gate directions on the CPU proxy: unset -> off; forced on ->
+  still off (no TPU backend), so tier-1 never lowers the kernel."""
+  from distributed_embeddings_tpu.ops import pallas_exchange as pe
+  monkeypatch.delenv("DE_TPU_PALLAS_EXCHANGE", raising=False)
+  assert pe._use_pallas_exchange() is False
+  monkeypatch.setenv("DE_TPU_PALLAS_EXCHANGE", "1")
+  assert pe._use_pallas_exchange() is False  # CPU backend
+  monkeypatch.setenv("DE_TPU_PALLAS_EXCHANGE", "0")
+  assert pe._use_pallas_exchange() is False

@@ -89,12 +89,14 @@ def _batch(seed, vocab=VOCAB, batch=32, alpha=1.05):
   return numerical, cats, labels
 
 
-def _paired_runs(cfg, n_steps=6, vocab=VOCAB, alpha=1.05, batch=32):
+def _paired_runs(cfg, n_steps=6, vocab=VOCAB, alpha=1.05, batch=32,
+                 plan_kw=None):
   """Train the all-device baseline and the tiered run from identical
   params on an identical skewed stream; return (losses_b, losses_t,
-  weights_b, weights_t, trainer)."""
+  weights_b, weights_t, trainer). ``plan_kw`` applies to the TIERED
+  plan only (wire-knob composition tests)."""
   plan_b = _plan(None, vocab)
-  plan_t = _plan(1000, vocab)
+  plan_t = _plan(1000, vocab, **(plan_kw or {}))
   model = _model(vocab)
   mesh = create_mesh(WORLD)
   rule = sparse_rule("adagrad", 0.05)
@@ -262,6 +264,17 @@ def test_tiered_parity_vs_all_device():
   assert m["steps"] == 6
   assert all(v["missed"] == 0 for v in m["per_class"].values())
   assert m["host_gather_bytes"] > 0
+
+
+def test_tiered_fused_wire_parity():
+  """The tiered trainer composes with ``overlap='fused'``: the device
+  tier's exchange runs the just-in-time per-(round, chunk) schedule and
+  parity vs the all-device baseline still holds (the schedule is pure
+  data movement, so the tiered run's numerics are unchanged)."""
+  cfg = TieringConfig(cache_fraction=0.3, staging_grps=64, rerank_interval=3)
+  losses_b, losses_t, w_b, w_t, _ = _paired_runs(
+      cfg, n_steps=4, plan_kw={"overlap": "fused", "exchange_chunks": 2})
+  _assert_parity(losses_b, losses_t, w_b, w_t)
 
 
 def test_hbm_budget_end_to_end():

@@ -43,6 +43,8 @@ Round-7 additions (``overlap='pipelined'``, ``exchange_chunks``,
   paths report the psum'd per-class distinct-overflow count.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -149,7 +151,8 @@ def test_forward_bitexact_f32_dedup(combiner):
 
 
 @pytest.mark.parametrize("pipe_kw", [
-    {}, {"overlap": "pipelined", "exchange_chunks": 3}])
+    {}, {"overlap": "pipelined", "exchange_chunks": 3},
+    {"overlap": "fused", "exchange_chunks": 3}])
 def test_forward_bitexact_f32_dedup_row_sliced(pipe_kw):
   rng = np.random.default_rng(1)
   sizes = [96, 64, 48, 40, 88, 56, 72, 104]
@@ -181,7 +184,8 @@ def test_forward_bitexact_f32_dedup_row_sliced(pipe_kw):
 
 
 @pytest.mark.parametrize("pipe_kw", [
-    {}, {"overlap": "pipelined", "exchange_chunks": 5}])
+    {}, {"overlap": "pipelined", "exchange_chunks": 5},
+    {"overlap": "fused", "exchange_chunks": 5}])
 def test_forward_bitexact_f32_dedup_ragged(pipe_kw):
   """A ragged input rides the raw value-stream exchange even under
   ``dedup_exchange=True`` (there is nothing padded to dedup), while the
@@ -285,7 +289,25 @@ def _fused_setup(rule_name, batch=32, **plan_kw):
   return model, plan, rule, opt, state, batch_tree, mesh
 
 
+_RUN_STEPS_CACHE = {}
+
+
 def _run_steps(rule_name, steps=3, step_kw=None, **plan_kw):
+  # Memoized: every parity test re-runs the same seeded baseline arm
+  # (e.g. plain f32) against its own variant, and each arm pays a fresh
+  # train+eval compile. The run is pure (fresh PRNG-seeded state per
+  # call, callers only compare the results), so identical configs can
+  # share one run.
+  key = (rule_name, steps,
+         tuple(sorted((step_kw or {}).items())),
+         tuple(sorted(plan_kw.items())))
+  if key not in _RUN_STEPS_CACHE:
+    _RUN_STEPS_CACHE[key] = _run_steps_uncached(rule_name, steps,
+                                                step_kw, **plan_kw)
+  return _RUN_STEPS_CACHE[key]
+
+
+def _run_steps_uncached(rule_name, steps=3, step_kw=None, **plan_kw):
   model, plan, rule, opt, state, bt, mesh = _fused_setup(rule_name,
                                                          **plan_kw)
   step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
@@ -641,6 +663,9 @@ def test_overlap_knob_validation():
   # chunks without the pipeline would be silently ignored -> refused
   with pytest.raises(ValueError, match="overlap='pipelined'"):
     DistEmbeddingStrategy([TableConfig(8, 4)], 1, exchange_chunks=2)
+  # round 20: fused is a registered overlap and carries the chunk axis
+  DistEmbeddingStrategy([TableConfig(8, 4)], 1, overlap="fused",
+                        exchange_chunks=2)
   # fp8 is a registered wire dtype now; junk still isn't
   DistEmbeddingStrategy([TableConfig(8, 4)], 1, wire_dtype="fp8")
   with pytest.raises(ValueError, match="wire_dtype"):
@@ -659,6 +684,7 @@ def test_exchange_report_rounds_geometry():
   assert rep["exchange_chunks"] == 3
   assert rep["rounds_per_exchange"] == (WORLD - 1) * 3
   assert rep["float_wire_bytes_per_value"] == 1
+  assert rep["jit_gather"] is False  # fused-only flag
   # monolithic: one all_to_all per exchange; world 1: no wire at all
   rep_m = DistEmbeddingStrategy([TableConfig(100, 8)], WORLD).exchange_report()
   assert rep_m["overlap"] == "none" and rep_m["rounds_per_exchange"] == 1
@@ -780,3 +806,239 @@ def test_route_ids_emits_dedup_routed():
             if plan.classes[bk.class_key].kind == "sparse" else "ndarray")
     got = tname if tname == "DedupRouted" else "ndarray"
     assert got == want, (bk, tname)
+
+
+# ---------------------------------------------------------------------------
+# round 20: fused (just-in-time gather) exchange — bit-exact parity matrix
+# ---------------------------------------------------------------------------
+#
+# ``overlap='fused'`` restructures WHEN each wire round's payload is
+# gathered (immediately before its send, per (round, chunk)) but not WHAT
+# is gathered: every per-chunk gather + combine is elementwise over the
+# same (slot, sample, h) values the monolithic pre-pass reads, and all
+# placement is dynamic_slice / stack / take / reshape — pure data
+# movement. f32 must therefore be BIT-exact vs the monolithic wire AND vs
+# the pipelined schedule, forward and reverse (the backward rounds fall
+# out of native autodiff of the per-round sends). bf16 narrows
+# elementwise (same bits as pipelined); fp8 chunks split the gathered
+# ROWS rather than the flat payload, so its amax windows differ from the
+# pipelined wire's — fp8 fused is tolerance-compared against f32 and
+# bit-compared against the monolithic wire only at chunks=1 (one window =
+# the whole destination block, same as the monolithic amax).
+
+
+@pytest.mark.parametrize("world,chunks", [
+    (1, 2),   # no wire: fused must be inert, not crash
+    (2, 2),
+    (4, 1),   # one chunk per round: pure schedule rewrite
+    (4, 2),
+    (4, 3),   # does not divide some blocks' row counts
+    (4, 5),   # exceeds some blocks' row counts (chunk count caps at rows)
+])
+@pytest.mark.parametrize("combiner", ["sum", "mean"])
+def test_fused_f32_forward_bitexact(combiner, world, chunks):
+  rng = np.random.default_rng(20)
+  plan_a, params_a, inputs_a = _mixed_fixture(combiner, rng, world=world)
+  rng = np.random.default_rng(20)
+  plan_b, params_b, inputs_b = _mixed_fixture(
+      combiner, rng, world=world, overlap="fused", exchange_chunks=chunks)
+  out_a = _forward_outs(plan_a, params_a, inputs_a, world=world)
+  out_b = _forward_outs(plan_b, params_b, inputs_b, world=world)
+  for t, (a, b) in enumerate(zip(out_a, out_b)):
+    np.testing.assert_array_equal(a, b, err_msg=f"table {t}")
+
+
+def test_fused_f32_dedup_forward_bitexact():
+  """Fused x dedup'd routing: each round's unique-block rows are gathered
+  just-in-time and the return rows expand per round — still bit-exact vs
+  the raw monolithic exchange."""
+  rng = np.random.default_rng(21)
+  plan_a, params_a, inputs_a = _mixed_fixture("mean", rng)
+  rng = np.random.default_rng(21)
+  plan_b, params_b, inputs_b = _mixed_fixture(
+      "mean", rng, dedup_exchange=True, overlap="fused",
+      exchange_chunks=3)
+  out_a = _forward_outs(plan_a, params_a, inputs_a)
+  out_b = _forward_outs(plan_b, params_b, inputs_b)
+  for t, (a, b) in enumerate(zip(out_a, out_b)):
+    np.testing.assert_array_equal(a, b, err_msg=f"table {t}")
+
+
+def test_fused_train_eval_bitexact_vs_monolithic_and_pipelined():
+  """Full train steps under the fused f32 wire: losses, eval predictions
+  AND final packed tables bit-identical to BOTH the monolithic and the
+  pipelined schedules — the per-round reverse cotangent sends (native
+  autodiff of the round body) deliver exactly the same bits."""
+  la, pa, para = _run_steps("adagrad")
+  lp, pp, parp = _run_steps("adagrad", overlap="pipelined",
+                            exchange_chunks=2)
+  lb, pb, parb = _run_steps("adagrad", overlap="fused",
+                            exchange_chunks=2)
+  assert la == lb == lp
+  np.testing.assert_array_equal(pa, pb)
+  np.testing.assert_array_equal(pp, pb)
+  for k in para["embeddings"]:
+    np.testing.assert_array_equal(np.asarray(para["embeddings"][k]),
+                                  np.asarray(parb["embeddings"][k]),
+                                  err_msg=k)
+    np.testing.assert_array_equal(np.asarray(parp["embeddings"][k]),
+                                  np.asarray(parb["embeddings"][k]),
+                                  err_msg=k)
+
+
+def test_fused_dedup_train_bitexact():
+  """The dedup'd backward's per-round form: cotangent chunks ship per
+  round and segment-sum between sends — same bits as the monolithic
+  dedup'd exchange."""
+  la, pa, _ = _run_steps("adagrad", dedup_exchange=True)
+  lb, pb, _ = _run_steps("adagrad", dedup_exchange=True,
+                         overlap="fused", exchange_chunks=3)
+  assert la == lb
+  np.testing.assert_array_equal(pa, pb)
+
+
+def test_fused_micro_batch_bitexact():
+  la, pa, para = _run_steps("adagrad", step_kw={"micro_batches": 2})
+  lb, pb, parb = _run_steps("adagrad", step_kw={"micro_batches": 2},
+                            overlap="fused", exchange_chunks=2)
+  assert la == lb
+  np.testing.assert_array_equal(pa, pb)
+  for k in para["embeddings"]:
+    np.testing.assert_array_equal(np.asarray(para["embeddings"][k]),
+                                  np.asarray(parb["embeddings"][k]),
+                                  err_msg=k)
+
+
+def test_fused_guarded_step_skips_poison_batch():
+  """The guard composes with the fused wire: a poison batch commits
+  nothing (state bit-identical), good steps commit."""
+  model, plan, rule, opt, state, bt, mesh = _fused_setup(
+      "adagrad", dedup_exchange=True, overlap="fused",
+      exchange_chunks=2)
+  step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
+                                state, bt, donate=False, guard=True)
+  state1, loss, metrics = step(state, *bt)
+  assert int(metrics["bad_step"]) == 0
+  bad_labels = jnp.full_like(bt[2], jnp.nan)
+  state2, loss2, metrics2 = step(state1, bt[0], bt[1], bad_labels)
+  assert int(metrics2["bad_step"]) == 1
+  before = jax.device_get(state1)
+  after = jax.device_get(state2)
+  for name in before["fused"]:
+    np.testing.assert_array_equal(np.asarray(before["fused"][name]),
+                                  np.asarray(after["fused"][name]))
+  assert int(after["step"]) == int(before["step"])
+
+
+def test_fused_exact_composes_f32():
+  """exact=True + fused f32: a pure-data-movement rewrite keeps the
+  bit-for-bit dedup'd backward claim, so the builder accepts it."""
+  model, plan, rule, opt, state, bt, mesh = _fused_setup(
+      "adagrad", overlap="fused", exchange_chunks=2)
+  step = make_sparse_train_step(model, plan, bce_loss, opt, rule, mesh,
+                                state, bt, donate=False, exact=True)
+  _, loss = step(state, *bt)
+  assert np.isfinite(float(loss))
+
+
+def test_fused_bf16_matches_pipelined_bitexact():
+  """bf16 narrows each payload element independently of the chunk
+  geometry (no per-block scale), so the fused schedule's bf16 bits equal
+  the pipelined schedule's exactly."""
+  rng = np.random.default_rng(22)
+  plan_a, params_a, inputs_a = _mixed_fixture(
+      "sum", rng, wire_dtype="bf16", overlap="pipelined",
+      exchange_chunks=2)
+  rng = np.random.default_rng(22)
+  plan_b, params_b, inputs_b = _mixed_fixture(
+      "sum", rng, wire_dtype="bf16", overlap="fused", exchange_chunks=2)
+  out_a = _forward_outs(plan_a, params_a, inputs_a)
+  out_b = _forward_outs(plan_b, params_b, inputs_b)
+  for t, (a, b) in enumerate(zip(out_a, out_b)):
+    np.testing.assert_array_equal(a, b, err_msg=f"table {t}")
+
+
+def test_fp8_fused_matches_monolithic_one_chunk():
+  """With one chunk the fused fp8 wire's amax window is the whole
+  per-destination block — the same window the monolithic wire uses, so
+  the two schedules agree to the bit."""
+  rng = np.random.default_rng(23)
+  plan_a, params_a, inputs_a = _mixed_fixture("sum", rng,
+                                              wire_dtype="fp8")
+  rng = np.random.default_rng(23)
+  plan_b, params_b, inputs_b = _mixed_fixture(
+      "sum", rng, wire_dtype="fp8", overlap="fused", exchange_chunks=1)
+  out_a = _forward_outs(plan_a, params_a, inputs_a)
+  out_b = _forward_outs(plan_b, params_b, inputs_b)
+  for t, (a, b) in enumerate(zip(out_a, out_b)):
+    np.testing.assert_array_equal(a, b, err_msg=f"table {t}")
+
+
+def test_train_fp8_fused_dedup_converges_close():
+  """fp8 x dedup x fused: the row-chunked amax windows differ from the
+  pipelined wire's flat-payload windows, so the claim is the f32-relative
+  tolerance, not bitwise agreement with another fp8 schedule."""
+  la, _, _ = _run_steps("sgd")
+  lb, _, _ = _run_steps("sgd", wire_dtype="fp8", dedup_exchange=True,
+                        overlap="fused", exchange_chunks=2)
+  assert all(np.isfinite(lb))
+  np.testing.assert_allclose(la, lb, rtol=0, atol=5e-2)
+
+
+def test_fused_report_and_gate():
+  """exchange_report announces the just-in-time gather schedule, and the
+  DE_TPU_PALLAS_EXCHANGE gate stays off on the CPU proxy even when
+  forced (no tier-1 behavior change with the env flag set)."""
+  tables, tmap, hotness = expand_tables(CFG)
+  plan = DistEmbeddingStrategy(
+      tables, WORLD, "memory_balanced", input_table_map=tmap,
+      input_hotness=hotness, dense_row_threshold=60,
+      overlap="fused", exchange_chunks=2)
+  rep = plan.exchange_report()
+  assert rep["overlap"] == "fused"
+  assert rep["jit_gather"] is True
+  assert rep["rounds_per_exchange"] == (WORLD - 1) * 2
+  # world 1: fused is inert, no jit-gather schedule to run
+  rep1 = DistEmbeddingStrategy([TableConfig(100, 8)], 1,
+                               overlap="fused").exchange_report()
+  assert rep1["jit_gather"] is False
+
+  from distributed_embeddings_tpu.ops import pallas_exchange
+  import os
+  old = os.environ.get("DE_TPU_PALLAS_EXCHANGE")
+  os.environ["DE_TPU_PALLAS_EXCHANGE"] = "1"
+  try:
+    assert not pallas_exchange._use_pallas_exchange()
+    rng = np.random.default_rng(24)
+    plan_a, params_a, inputs_a = _mixed_fixture("sum", rng)
+    rng = np.random.default_rng(24)
+    plan_b, params_b, inputs_b = _mixed_fixture(
+        "sum", rng, overlap="fused", exchange_chunks=2)
+    out_a = _forward_outs(plan_a, params_a, inputs_a)
+    out_b = _forward_outs(plan_b, params_b, inputs_b)
+    for t, (a, b) in enumerate(zip(out_a, out_b)):
+      np.testing.assert_array_equal(a, b, err_msg=f"table {t}")
+  finally:
+    if old is None:
+      del os.environ["DE_TPU_PALLAS_EXCHANGE"]
+    else:
+      os.environ["DE_TPU_PALLAS_EXCHANGE"] = old
+
+
+@pytest.mark.slow
+def test_profile_exchange_occupancy_full_sweep():
+  """The full fused-exchange pricing (`tools/profile_exchange.py
+  --overlap-occupancy`: pipelined f32 vs fused f32/fp8 at production
+  scale, per-round wall + gather-hidden accounting + the fused <=
+  pipelined step bar) passes its acceptance; the smoke tier rides
+  `make verify` as exchange-smoke instead."""
+  import subprocess
+  import sys
+  repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+  env = dict(os.environ)
+  env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+  r = subprocess.run(
+      [sys.executable, os.path.join(repo, "tools", "profile_exchange.py"),
+       "--overlap-occupancy"],
+      env=env, capture_output=True, text=True, timeout=1200)
+  assert r.returncode == 0, r.stdout[-4000:] + r.stderr[-4000:]
